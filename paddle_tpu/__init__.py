@@ -1,0 +1,42 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+Capability parity with the reference framework (see SURVEY.md), re-designed
+TPU-first: ops lower to XLA via JAX, fused kernels are Pallas, distribution
+is mesh-sharded compilation (pjit/shard_map) over ICI/DCN, and the compiler
+is XLA itself.
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# int64 is the reference's default index dtype; enable x64 so it exists.
+# Default float stays float32 (bf16 on the accelerator path); kernels cast
+# index operands to int32 internally where TPU prefers it.
+_jax.config.update("jax_enable_x64", True)
+
+from .core.dtype import (  # noqa: F401
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, set_default_dtype, get_default_dtype,
+)
+from .core.tensor import Tensor, to_tensor  # noqa: F401
+from .core.autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled, grad  # noqa: F401
+from .core.random import seed, get_rng_state, set_rng_state, get_cuda_rng_state, set_cuda_rng_state  # noqa: F401
+from .core.flags import set_flags, get_flags  # noqa: F401
+from .tensor import *  # noqa: F401,F403
+from .tensor import einsum  # noqa: F401
+from . import tensor  # noqa: F401
+from . import device  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import autograd  # noqa: F401
+from .framework import save, load, in_dynamic_mode, is_compiled_with_cuda, is_compiled_with_xpu, is_compiled_with_rocm, is_compiled_with_custom_device  # noqa: F401
+from .nn.layer.layers import Layer  # noqa: F401
+from .nn.parameter import Parameter, create_parameter  # noqa: F401
+
+disable_static = lambda place=None: None  # dygraph-first: always dynamic
+enable_static = lambda: (_ for _ in ()).throw(
+    NotImplementedError("static graph mode is jit.to_static in paddle_tpu"))
+
+__version__ = "0.1.0"

@@ -1,0 +1,182 @@
+"""AMP (parity: python/paddle/amp/ — auto_cast O1/O2 + GradScaler +
+debugging). TPU-first: bfloat16 is the default low-precision dtype; bf16
+shares float32's exponent range so loss scaling is mathematically
+unnecessary — GradScaler keeps the reference API (scale/step/update/minimize,
+dynamic scaling state) and automatically becomes a passthrough for bf16,
+while implementing true dynamic loss scaling for float16.
+Reference: python/paddle/amp/auto_cast.py:273 amp_guard,
+python/paddle/amp/grad_scaler.py:201.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core import amp_state
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate", "amp_decorate",
+           "debugging"]
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """Autocast context (parity: paddle.amp.auto_cast). Under O1, white-listed
+    (MXU) ops run in ``dtype``; under O2 everything except the black list
+    does."""
+    s = amp_state.STATE
+    prev = (s.enabled, s.dtype, s.level, s.custom_white, s.custom_black)
+    s.enabled = enable
+    s.dtype = convert_dtype(dtype)
+    s.level = level
+    s.custom_white = set(custom_white_list or ())
+    s.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        s.enabled, s.dtype, s.level, s.custom_white, s.custom_black = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to low precision, enable master
+    weights in the optimizer (parity: paddle.amp.decorate)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    dt = convert_dtype(dtype)
+    if level == "O2":
+        for m in model_list:
+            for _, p in m.named_parameters():
+                if jnp.issubdtype(p._data.dtype, jnp.floating):
+                    p._data = p._data.astype(dt)
+        if optimizers is not None:
+            opts = [optimizers] if not isinstance(optimizers, (list, tuple)) \
+                else optimizers
+            for o in opts:
+                o._multi_precision = True if master_weight is not False else False
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+amp_decorate = decorate
+
+
+class GradScaler:
+    """Dynamic loss scaling (parity: paddle.amp.GradScaler). For bfloat16
+    training (TPU default) scaling is an identity passthrough."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def _passthrough(self) -> bool:
+        return not self._enable or amp_state.STATE.dtype == jnp.bfloat16
+
+    def scale(self, var: Tensor) -> Tensor:
+        if self._passthrough():
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if self._passthrough():
+            self._found_inf = False
+            return
+        found = False
+        for p in optimizer._parameter_list or []:
+            if p.grad is None:
+                continue
+            g = p.grad._data.astype(jnp.float32) / self._scale
+            if not bool(jnp.all(jnp.isfinite(g))):
+                found = True
+            p.grad._data = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if self._passthrough():
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if self._passthrough() or not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, d):
+        self._scale = d.get("scale", self._scale)
+        self._good_steps = d.get("good_steps", 0)
+        self._bad_steps = d.get("bad_steps", 0)
+
+
+class debugging:
+    """Numeric debugging shims (parity: paddle.amp.debugging — the op-level
+    NaN/Inf checker maps to FLAGS_check_nan_inf in the dispatch funnel)."""
+
+    @staticmethod
+    def enable_operator_stats_collection():
+        from ..core import flags
+        flags.set_flags({"low_precision_op_list": 1})
+
+    @staticmethod
+    def disable_operator_stats_collection():
+        from ..core import flags
+        flags.set_flags({"low_precision_op_list": 0})
+
+    @staticmethod
+    def check_numerics(tensor, op_type="", var_name=""):
+        bad = bool(jnp.any(~jnp.isfinite(tensor._data)))
+        if bad:
+            raise FloatingPointError(
+                f"NaN/Inf detected in {op_type}:{var_name}")
+        return tensor

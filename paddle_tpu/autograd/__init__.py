@@ -1,0 +1,115 @@
+"""paddle_tpu.autograd — user-facing autograd API.
+
+Parity: python/paddle/autograd/ (backward, grad, PyLayer, no_grad) over the
+tape engine in core/autograd.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import (  # noqa: F401
+    backward, grad, no_grad, enable_grad, set_grad_enabled, is_grad_enabled,
+    TapeNode, tape_paused,
+)
+from ..core.tensor import Tensor
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+           "is_grad_enabled", "PyLayer", "PyLayerContext", "hessian", "jacobian"]
+
+
+class PyLayerContext:
+    """Context passed to PyLayer.forward/backward
+    (parity: python/paddle/autograd/py_layer.py PyLayerContext)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined autograd function (parity: paddle.autograd.PyLayer,
+    reference paddle/fluid/pybind/eager_py_layer.cc). Subclass and implement
+    static ``forward(ctx, *args)`` and ``backward(ctx, *grads)``."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core import autograd as _ag
+
+        ctx = PyLayerContext()
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        out_list = (outs,) if single else tuple(outs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = _ag.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        wrapped = []
+        if needs_grad:
+            diff_inputs = [t for t in tensor_inputs if not t.stop_gradient
+                           and jnp.issubdtype(jnp.result_type(t._data), jnp.inexact)]
+
+            def vjp_fn(cts):
+                grads_in = cls.backward(
+                    ctx, *[Tensor(c, stop_gradient=True) for c in cts])
+                if not isinstance(grads_in, (tuple, list)):
+                    grads_in = (grads_in,)
+                # backward returns one grad per *differentiable* forward input
+                out = []
+                gi = list(grads_in)
+                for t in diff_inputs:
+                    g = gi.pop(0) if gi else None
+                    out.append(None if g is None else
+                               (g._data if isinstance(g, Tensor) else jnp.asarray(g)))
+                return tuple(out)
+
+            node = _ag.TapeNode(
+                cls.__name__, diff_inputs, vjp_fn,
+                [jax.ShapeDtypeStruct(o._data.shape, o._data.dtype) for o in out_list])
+            for i, o in enumerate(out_list):
+                t = Tensor(o._data if isinstance(o, Tensor) else o,
+                           stop_gradient=False)
+                t._node = node
+                t._out_idx = i
+                wrapped.append(t)
+        else:
+            for o in out_list:
+                wrapped.append(o if isinstance(o, Tensor) else Tensor(o))
+        return wrapped[0] if single else tuple(wrapped)
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """Dense Jacobian via jax.jacrev on the captured graph is not available
+    on the tape; compute row-by-row with grad() (parity surface of
+    paddle.autograd.jacobian for small problems)."""
+    raise NotImplementedError(
+        "use jax.jacfwd/jacrev on a functional model (paddle_tpu.jit) — "
+        "tape-level dense jacobian is not provided")
+
+
+def hessian(func, xs, batch_axis=None):
+    raise NotImplementedError(
+        "use jax.hessian on a functional model (paddle_tpu.jit)")

@@ -1,0 +1,54 @@
+"""AMP autocast state consulted by the op-dispatch funnel.
+
+Capability parity with the reference's C++ autocast inserted into every
+generated forward (reference: eager_gen.py:515 AMP template +
+paddle/fluid/eager/amp_utils.h white/black lists). TPU-first difference:
+bfloat16 is the default low-precision dtype and needs no loss scaling.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+# Ops that always run in low precision under O1 (matmul-class: MXU ops).
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "conv2d", "conv1d", "conv3d", "einsum",
+    "addmm", "attention", "flash_attention", "linear",
+}
+# Ops that must stay in float32 under O1 (numerically sensitive).
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",
+    "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "mean", "sum", "norm", "cumsum", "logsumexp", "layer_norm", "rms_norm",
+    "erf", "erfinv", "sigmoid", "cos_sim", "reduce_prod",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+STATE = _AmpState()
+
+
+def amp_cast_dtype(op_name: str):
+    """Return the dtype to cast floating inputs to for this op, or None."""
+    s = STATE
+    if not s.enabled:
+        return None
+    if s.level == "O2":
+        if op_name in BLACK_LIST or op_name in s.custom_black:
+            return jnp.float32
+        return s.dtype
+    # O1: cast only white-listed ops down; black-listed ops up to f32.
+    if op_name in s.custom_black or op_name in BLACK_LIST:
+        return jnp.float32
+    if op_name in s.custom_white or op_name in WHITE_LIST:
+        return s.dtype
+    return None

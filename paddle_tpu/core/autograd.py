@@ -1,0 +1,282 @@
+"""Imperative autograd engine (tape-based) over JAX vjp.
+
+Capability parity with the reference's eager autograd engine
+(reference: paddle/fluid/eager/backward.cc RunBackward, grad_node_info.h
+GradNodeBase/Edge, general_grad.h). The reference builds a C++ grad-node graph
+per op; here each differentiable op call records a TapeNode holding the
+``jax.vjp`` closure of its functional implementation, and ``backward()`` walks
+the node DAG in reverse topological order accumulating cotangents.
+
+Two execution regimes:
+  * eager: ops run op-by-op on device, tape records, ``Tensor.backward()`` works.
+  * functional (the performance path): the trainer wraps the whole step in
+    ``jax.jit``/``jax.grad`` with the tape paused — differentiation is done by
+    JAX's tracer, one fused XLA program, no per-op tape overhead.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "no_grad", "enable_grad", "set_grad_enabled", "is_grad_enabled",
+    "tape_paused", "is_tape_active", "TapeNode", "backward", "grad",
+]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True   # user-visible grad mode (paddle.no_grad)
+        self.paused = 0       # functional-trace pause depth (internal)
+
+
+_STATE = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _STATE.enabled and _STATE.paused == 0
+
+
+def is_tape_active() -> bool:
+    return is_grad_enabled()
+
+
+class set_grad_enabled:
+    """Context manager / function to toggle grad mode (parity: paddle.set_grad_enabled)."""
+
+    def __init__(self, mode: bool):
+        self.prev = _STATE.enabled
+        _STATE.enabled = bool(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.enabled = self.prev
+        return False
+
+
+class no_grad:
+    """Disable gradient tracking (parity: paddle.no_grad). Usable as context
+    manager or decorator."""
+
+    def __enter__(self):
+        self.prev = _STATE.enabled
+        _STATE.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.enabled = self.prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+        wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapper
+
+
+class enable_grad:
+    """Re-enable gradient tracking inside a no_grad scope (parity: paddle.enable_grad)."""
+
+    def __enter__(self):
+        self.prev = _STATE.enabled
+        _STATE.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.enabled = self.prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with enable_grad():
+                return fn(*args, **kwargs)
+        wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapper
+
+
+class tape_paused:
+    """Internal: pause tape recording (used by the functional/jit path, where
+    JAX's own tracer performs differentiation)."""
+
+    def __enter__(self):
+        _STATE.paused += 1
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.paused -= 1
+        return False
+
+
+class TapeNode:
+    """One recorded differentiable op call.
+
+    ``vjp_fn(cotangents_tuple) -> tuple`` returns input cotangents aligned
+    with ``inputs`` (the Tensors this op differentiates with respect to).
+    """
+
+    __slots__ = ("name", "inputs", "vjp_fn", "out_avals", "__weakref__")
+
+    def __init__(self, name: str, inputs: Sequence[Any], vjp_fn, out_avals):
+        self.name = name
+        self.inputs = list(inputs)
+        self.vjp_fn = vjp_fn
+        self.out_avals = list(out_avals)  # jax.ShapeDtypeStruct per output
+
+
+def _toposort(roots: Sequence[TapeNode]) -> List[TapeNode]:
+    """Reverse DFS postorder over the producer DAG: consumers before producers."""
+    seen = set()
+    post: List[TapeNode] = []
+    for root in roots:
+        if id(root) in seen:
+            continue
+        stack: List[Tuple[TapeNode, int]] = [(root, 0)]
+        seen.add(id(root))
+        while stack:
+            node, idx = stack.pop()
+            if idx < len(node.inputs):
+                stack.append((node, idx + 1))
+                t = node.inputs[idx]
+                prod = t._node
+                if prod is not None and id(prod) not in seen:
+                    seen.add(id(prod))
+                    stack.append((prod, 0))
+            else:
+                post.append(node)
+    post.reverse()
+    return post
+
+
+def _zeros(aval) -> jnp.ndarray:
+    return jnp.zeros(aval.shape, aval.dtype)
+
+
+def _ones(aval) -> jnp.ndarray:
+    return jnp.ones(aval.shape, aval.dtype)
+
+
+def _accum(a, b):
+    return b if a is None else a + b
+
+
+def _run_backward(
+    root_tensors: Sequence[Any],
+    root_grads: Sequence[Optional[Any]],
+    retain_graph: bool,
+    targets: Optional[Sequence[Any]] = None,
+    accumulate_leaf: bool = True,
+):
+    """Shared engine for ``backward()`` (accumulate into ``.grad``) and
+    ``grad()`` (return grads for explicit targets).
+
+    Mirrors the in-degree/ready-queue walk of reference backward.cc:105 but as
+    a reverse-topological sweep (the DAG is fully known up front here).
+    """
+    from .tensor import Tensor  # local import to avoid cycle
+
+    # cotangent store keyed by (id(node), out_idx)
+    node_cts: Dict[Tuple[int, int], Any] = {}
+    target_ids = None
+    target_grads: Dict[int, Any] = {}
+    if targets is not None:
+        target_ids = {id(t): i for i, t in enumerate(targets)}
+
+    roots: List[TapeNode] = []
+    for t, g in zip(root_tensors, root_grads):
+        if g is None:
+            aval = jax.ShapeDtypeStruct(t._data.shape, t._data.dtype)
+            g = _ones(aval)
+        else:
+            g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        if t._node is None:
+            if target_ids is not None and id(t) in target_ids:
+                target_grads[id(t)] = _accum(target_grads.get(id(t)), g)
+            elif accumulate_leaf and not t.stop_gradient:
+                t._accumulate_grad(g)
+            continue
+        key = (id(t._node), t._out_idx)
+        node_cts[key] = _accum(node_cts.get(key), g)
+        roots.append(t._node)
+
+    order = _toposort(roots)
+    for node in order:
+        cts = tuple(
+            node_cts.pop((id(node), i), None)
+            for i in range(len(node.out_avals))
+        )
+        cts = tuple(
+            c if c is not None else _zeros(node.out_avals[i])
+            for i, c in enumerate(cts)
+        )
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"backward through op '{node.name}' a second time: the graph "
+                "was freed. Call backward(retain_graph=True) the first time."
+            )
+        in_grads = node.vjp_fn(cts)
+        if not retain_graph:
+            node.vjp_fn = None
+        for t, g in zip(node.inputs, in_grads):
+            if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
+                continue
+            if target_ids is not None and id(t) in target_ids:
+                target_grads[id(t)] = _accum(target_grads.get(id(t)), g)
+                # targets may themselves be intermediate: keep propagating
+            if t._node is not None:
+                key = (id(t._node), t._out_idx)
+                node_cts[key] = _accum(node_cts.get(key), g)
+            elif accumulate_leaf and not t.stop_gradient and target_ids is None:
+                t._accumulate_grad(g)
+    return target_grads
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
+    """Run reverse accumulation from ``tensors`` into leaf ``.grad`` slots
+    (parity: paddle.autograd.backward / Tensor.backward)."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    _run_backward(tensors, grad_tensors, retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph: bool = False, only_inputs: bool = True,
+         allow_unused: bool = False, no_grad_vars=None):
+    """Compute grads of ``outputs`` w.r.t. ``inputs`` without touching ``.grad``
+    (parity: paddle.grad, reference general_grad.h partial-graph Grad)."""
+    from .tensor import Tensor
+
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    tg = _run_backward(outputs, grad_outputs, retain_graph, targets=inputs,
+                       accumulate_leaf=False)
+    results = []
+    for t in inputs:
+        g = tg.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the inputs receives no gradient; pass "
+                    "allow_unused=True to return None for it")
+            results.append(None)
+        else:
+            out = Tensor(g, stop_gradient=not create_graph)
+            results.append(out)
+    return results

@@ -1,0 +1,149 @@
+"""Op dispatch: the single funnel every tensor op goes through.
+
+Capability parity with the reference's generated op call path
+(reference: paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:251
+forward template + paddle/phi/api/lib generated C++ API): AMP cast → autograd
+capture → kernel call → NaN/Inf check. Here the "kernel" is a pure JAX
+function (XLA lowers it to the TPU); autograd capture is a ``jax.vjp``
+closure recorded on the tape (core/autograd.py); there is no kernel-key
+dispatch because XLA owns backend/dtype/layout selection — a thin registry
+only selects Pallas vs plain-XLA implementations for fused ops.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import amp_state
+from . import autograd as _ag
+from . import flags as _flags
+from .tensor import Tensor
+
+__all__ = ["run_op", "OP_REGISTRY", "register_op_impl"]
+
+# name -> {"xla": fn, "pallas": fn}; selection by FLAGS_use_pallas_kernels.
+OP_REGISTRY: Dict[str, Dict[str, Callable]] = {}
+
+
+def register_op_impl(name: str, impl: str = "xla"):
+    def deco(fn):
+        OP_REGISTRY.setdefault(name, {})[impl] = fn
+        return fn
+    return deco
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return x
+
+
+def _is_inexact(arr) -> bool:
+    return jnp.issubdtype(jnp.result_type(arr), jnp.inexact)
+
+
+def _check_finite(name: str, arrays):
+    for a in arrays:
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.inexact):
+            if not bool(jnp.all(jnp.isfinite(a))):
+                raise FloatingPointError(
+                    f"NaN or Inf found in output of op '{name}' "
+                    "(FLAGS_check_nan_inf=1)")
+
+
+def run_op(
+    name: str,
+    jax_fn: Callable,
+    operands: Sequence[Any],
+    num_nondiff_outputs: int = 0,
+    out_stop_gradient: Optional[bool] = None,
+):
+    """Execute one op.
+
+    ``jax_fn`` is a pure function of exactly ``len(operands)`` arrays
+    (static attrs must already be closed over). ``operands`` may be Tensors,
+    arrays, numpy values, or python scalars; non-Tensor operands are treated
+    as constants. The trailing ``num_nondiff_outputs`` outputs (e.g. argmax
+    indices, softmax_lse) get zero cotangents routed automatically by the
+    tape and are marked stop_gradient.
+    """
+    arrays = [_unwrap(o) for o in operands]
+
+    cast_to = amp_state.amp_cast_dtype(name)
+    if cast_to is not None:
+        inner_fn = jax_fn
+
+        def jax_fn(*a, _inner=inner_fn, _dt=cast_to):
+            a = tuple(
+                x.astype(_dt)
+                if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+                and x.dtype != _dt else x
+                for x in a)
+            return _inner(*a)
+
+    tape_on = _ag.is_tape_active()
+    diff_idx = []
+    if tape_on:
+        for i, o in enumerate(operands):
+            if isinstance(o, Tensor) and not o.stop_gradient and _is_inexact(o._data):
+                diff_idx.append(i)
+
+    if not diff_idx:
+        outs = jax_fn(*arrays)
+        node = None
+    else:
+        const = list(arrays)
+
+        def f(*diff_arrays):
+            buf = list(const)
+            for k, i in enumerate(diff_idx):
+                buf[i] = diff_arrays[k]
+            return jax_fn(*buf)
+
+        outs, raw_vjp = jax.vjp(f, *[arrays[i] for i in diff_idx])
+        node_inputs = [operands[i] for i in diff_idx]
+
+        def vjp_fn(cts, _raw=raw_vjp, _single=not isinstance(outs, tuple)):
+            if _single:
+                return _raw(cts[0])
+            return _raw(tuple(cts))
+
+        out_list = outs if isinstance(outs, tuple) else (outs,)
+        node = _ag.TapeNode(
+            name, node_inputs, vjp_fn,
+            [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_list])
+
+    single = not isinstance(outs, tuple)
+    out_list = (outs,) if single else outs
+
+    if _flags.get_flag("check_nan_inf"):
+        _check_finite(name, out_list)
+
+    if out_stop_gradient is None:
+        out_stop_gradient = not diff_idx
+
+    n = len(out_list)
+    wrapped = []
+    for i, o in enumerate(out_list):
+        nondiff = i >= n - num_nondiff_outputs
+        t = Tensor(o, stop_gradient=out_stop_gradient or nondiff)
+        if node is not None and not nondiff:
+            t._node = node
+            t._out_idx = i
+        wrapped.append(t)
+    return wrapped[0] if single else tuple(wrapped)
+
+
+def select_impl(name: str):
+    """Pick the Pallas implementation when registered and enabled, else XLA.
+    (Thin analog of the reference KernelFactory::SelectKernelOrThrowError,
+    paddle/phi/core/kernel_factory.h:326 — XLA subsumes backend/dtype keys.)"""
+    impls = OP_REGISTRY.get(name, {})
+    if _flags.get_flag("use_pallas_kernels") and "pallas" in impls:
+        return impls["pallas"]
+    if "xla" in impls:
+        return impls["xla"]
+    raise KeyError(f"no implementation registered for op '{name}'")

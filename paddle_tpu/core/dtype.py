@@ -1,0 +1,99 @@
+"""Dtype system for the TPU-native framework.
+
+Capability parity with the reference's phi dtype enum and type-promotion
+machinery (reference: paddle/phi/common/data_type.h, paddle/fluid/eager type
+promotion step in eager_gen.py), re-based on JAX/numpy dtypes. bfloat16 is the
+first-class accelerator dtype (TPU MXU native), unlike the reference's
+fp16-first CUDA design.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (numpy dtype instances; jax arrays carry these).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_DEFAULT_DTYPE = [jnp.float32]
+
+
+def set_default_dtype(d) -> None:
+    """Set default floating dtype (parity: paddle.set_default_dtype)."""
+    d = convert_dtype(d)
+    if np.dtype(d).kind not in "f" and d != bfloat16:
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _DEFAULT_DTYPE[0] = d
+
+
+def get_default_dtype():
+    """Get default floating dtype (parity: paddle.get_default_dtype)."""
+    return _DEFAULT_DTYPE[0]
+
+
+def convert_dtype(d):
+    """Normalize a dtype-like (str, np.dtype, jnp scalar type) to a canonical type."""
+    if d is None:
+        return None
+    if isinstance(d, str):
+        if d not in _STR_TO_DTYPE:
+            raise ValueError(f"unknown dtype string {d!r}")
+        return _STR_TO_DTYPE[d]
+    if d is bool:
+        return bool_
+    if d is int:
+        return int64
+    if d is float:
+        return get_default_dtype()
+    if d is complex:
+        return complex64
+    # numpy dtype or jnp scalar type
+    nd = np.dtype(d)
+    name = nd.name
+    if name in _STR_TO_DTYPE:
+        return _STR_TO_DTYPE[name]
+    raise ValueError(f"unsupported dtype {d!r}")
+
+
+def dtype_name(d) -> str:
+    return np.dtype(d).name
+
+
+def is_floating(d) -> bool:
+    nd = np.dtype(convert_dtype(d))
+    return nd.kind == "f" or nd == np.dtype(bfloat16)
+
+
+def is_integer(d) -> bool:
+    return np.dtype(convert_dtype(d)).kind in ("i", "u")
+
+
+def promote_types(a, b):
+    """Binary type promotion (delegates to jnp; matches the reference's
+    eager type-promotion semantics for float x float and int x float)."""
+    return jnp.promote_types(convert_dtype(a), convert_dtype(b))

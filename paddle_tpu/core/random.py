@@ -1,0 +1,175 @@
+"""RNG: stateful Generator over JAX's functional PRNG.
+
+Capability parity with the reference's per-device Generator with
+(seed, offset) state pairs (reference: paddle/phi/core/generator.cc) and the
+model-parallel RNG state trackers (python/paddle/distributed/fleet/layers/mpu/
+random.py RNGStatesTracker). TPU-native design: the state is a threefry key +
+a monotonically increasing offset; every draw derives a fresh subkey with
+``jax.random.fold_in(key, offset)`` — deterministic, replayable (recompute
+with a recorded offset reproduces dropout masks, the contract activation
+recomputation relies on), and trace-safe when the offset is threaded
+functionally.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict
+
+import jax
+import numpy as np
+
+__all__ = [
+    "Generator", "default_generator", "seed", "get_rng_state", "set_rng_state",
+    "get_cuda_rng_state", "set_cuda_rng_state", "RNGStatesTracker",
+    "get_rng_state_tracker", "model_parallel_random_seed",
+]
+
+_DEFAULT_SEED = 0
+
+
+class Generator:
+    """Stateful PRNG handle: (seed, offset) like the reference's generator."""
+
+    def __init__(self, seed: int = _DEFAULT_SEED):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        self._offset = 0
+        return self
+
+    def seed(self):
+        return self._seed
+
+    def initial_seed(self):
+        return self._seed
+
+    def get_state(self):
+        return (self._seed, self._offset)
+
+    def set_state(self, state):
+        seed, offset = state
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        self._offset = int(offset)
+
+    def next_key(self):
+        """Return a fresh subkey; advances the offset (the (seed, offset)
+        pair is the replayable RNG state, mirroring the reference's
+        IncrementOffset contract used by dropout/flash-attn)."""
+        with self._lock:
+            sub = jax.random.fold_in(self._key, self._offset)
+            self._offset += 1
+            return sub
+
+    def peek_state(self):
+        return (self._seed, self._offset)
+
+
+default_generator = Generator()
+
+
+def seed(s: int):
+    """Set the global random seed (parity: paddle.seed)."""
+    default_generator.manual_seed(s)
+    np.random.seed(s % (2 ** 32))
+    return default_generator
+
+
+def get_rng_state():
+    return [default_generator.get_state()]
+
+
+def set_rng_state(state):
+    default_generator.set_state(state[0] if isinstance(state, (list, tuple))
+                                and isinstance(state[0], tuple) else state)
+
+
+# TPU "device" rng state == the same generator (no separate CUDA generator).
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+
+
+class RNGStatesTracker:
+    """Named RNG states for model-parallel determinism
+    (parity: fleet/layers/mpu/random.py RNGStatesTracker — e.g. a
+    'model_parallel_rng' state seeded differently per TP rank so dropout
+    masks differ across TP shards, while 'global_seed' states agree)."""
+
+    def __init__(self):
+        self.states_: Dict[str, Generator] = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return {name: g.get_state() for name, g in self.states_.items()}
+
+    def set_states_tracker(self, states):
+        for name, st in states.items():
+            self.states_.setdefault(name, Generator()).set_state(st)
+
+    @contextlib.contextmanager
+    def rng_state(self, name="model_parallel_rng"):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        global default_generator
+        orig = default_generator
+        try:
+            default_generator = self.states_[name]
+            yield
+        finally:
+            default_generator = orig
+
+
+@contextlib.contextmanager
+def key_context(key):
+    """Swap the default generator for one driven by ``key`` (possibly a
+    jit tracer). The functional/jit training path passes a per-step PRNG key
+    through this context so dropout masks differ per step yet stay inside
+    the single compiled XLA program — the TPU-native answer to the
+    reference's (seed, offset) dropout contract."""
+    global default_generator
+    orig = default_generator
+    g = Generator.__new__(Generator)
+    g._lock = threading.Lock()
+    g._seed = -1
+    g._key = key
+    g._offset = 0
+    default_generator = g
+    try:
+        yield g
+    finally:
+        default_generator = orig
+
+
+RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed: int = None, mp_rank: int = 0):
+    """Seed the tracker with distinct model-parallel seeds per TP rank
+    (parity: mpu/random.py model_parallel_random_seed)."""
+    import random as pyrandom
+    s = seed if seed is not None else pyrandom.randint(0, 2 ** 31 - 1)
+    global_seed = s
+    local_seed = s + 1024 + mp_rank
+    RNG_STATE_TRACKER.reset()
+    RNG_STATE_TRACKER.add("global_seed", global_seed)
+    RNG_STATE_TRACKER.add("model_parallel_rng", local_seed)

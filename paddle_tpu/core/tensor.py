@@ -1,0 +1,312 @@
+"""The imperative Tensor: a thin stateful wrapper over an immutable jax.Array.
+
+Capability parity with the reference's ``paddle.Tensor``
+(reference: paddle/phi/core/dense_tensor.h:37 DenseTensor +
+paddle/fluid/eager/autograd_meta.h:61 AutogradMeta + the pybind method
+surface). Autograd metadata (``stop_gradient``, ``grad``, tape node) lives on
+the wrapper; the payload is a device-resident jax.Array so every op lowers to
+XLA. Tensor is registered as a JAX pytree node, so Tensors flow through
+``jax.jit`` / ``jax.grad`` / ``shard_map`` transparently on the functional
+(performance) path.
+
+Most math/manipulation methods are patched on by ``paddle_tpu.tensor``
+(see tensor/__init__.py monkey-patching, mirroring how the reference patches
+generated methods onto Tensor in python/paddle/tensor/__init__.py).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dtype import convert_dtype, get_default_dtype
+
+__all__ = ["Tensor", "to_tensor"]
+
+
+class Tensor:
+    __slots__ = (
+        "_data", "stop_gradient", "_grad", "_node", "_out_idx", "_hooks",
+        "name", "persistable", "trainable", "__weakref__",
+    )
+
+    def __init__(self, data, dtype=None, stop_gradient: bool = True,
+                 name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        dtype = convert_dtype(dtype)
+        if not isinstance(data, jax.Array):  # tracers pass isinstance(jax.Array)
+            if dtype is None and isinstance(data, (bool, int, float, complex,
+                                                   list, tuple)):
+                # match the reference's to_tensor default-dtype behavior:
+                # python floats -> default dtype; ints -> int64; bools -> bool
+                probe = np.asarray(data)
+                if probe.dtype == np.float64:
+                    dtype = get_default_dtype()
+            data = jnp.asarray(data, dtype=dtype)
+        elif dtype is not None and data.dtype != np.dtype(dtype):
+            data = data.astype(dtype)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._node = None
+        self._out_idx = 0
+        self._hooks = None
+        self.name = name or ""
+        self.persistable = False
+        self.trainable = not stop_gradient
+
+    # -- basic metadata ----------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        try:
+            devs = self._data.devices()
+            return next(iter(devs))
+        except Exception:
+            return None
+
+    @property
+    def T(self):
+        from ..tensor.linalg import t
+        return t(self)
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return np.dtype(self._data.dtype).itemsize
+
+    def is_leaf(self):
+        return self._node is None
+
+    @property
+    def is_leaf_(self):
+        return self._node is None
+
+    # -- value access ------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype):
+        from ..tensor.manipulation import cast
+        return cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from .dispatch import run_op
+        return run_op("clone", lambda x: x + 0, (self,))
+
+    def copy_(self, other: "Tensor"):
+        self._data = other._data if isinstance(other, Tensor) else jnp.asarray(other)
+        return self
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def to(self, *args, **kwargs):
+        """Tensor.to(dtype) / to(device) — device moves are XLA-managed; only
+        dtype conversion is materialized (single-process TPU semantics)."""
+        dtype = kwargs.get("dtype")
+        for a in args:
+            try:
+                dtype = convert_dtype(a)
+            except (ValueError, TypeError):
+                continue
+        if dtype is not None:
+            return self.astype(dtype)
+        return self
+
+    def cpu(self):
+        return Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    # -- autograd ----------------------------------------------------------
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = value
+
+    def _accumulate_grad(self, g):
+        if self._hooks:
+            for hook in list(self._hooks.values()):
+                out = hook(Tensor(g, stop_gradient=True))
+                if out is not None:
+                    g = out._data if isinstance(out, Tensor) else jnp.asarray(out)
+        if self._grad is None:
+            self._grad = Tensor(g, stop_gradient=True)
+        else:
+            self._grad = Tensor(self._grad._data + g, stop_gradient=True)
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from . import autograd
+        autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        """Register a grad hook (parity: Tensor.register_hook,
+        reference paddle/fluid/eager/hooks.h)."""
+        if self._hooks is None:
+            self._hooks = {}
+        handle = RemovableHandle(self._hooks)
+        self._hooks[handle.id] = hook
+        return handle
+
+    # -- python protocol ---------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        return (f"Tensor(shape={self.shape}, dtype={np.dtype(self.dtype).name}, "
+                f"stop_gradient={self.stop_gradient},\n       {self._data})")
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __index__(self):
+        return int(self._data)
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __getitem__(self, idx):
+        from .dispatch import run_op
+        idx = _unwrap_index(idx)
+        return run_op("getitem", lambda x: x[idx], (self,))
+
+    def __setitem__(self, idx, value):
+        from .dispatch import run_op
+        idx = _unwrap_index(idx)
+        if isinstance(value, Tensor):
+            out = run_op("setitem", lambda x, v: x.at[idx].set(v), (self, value))
+        else:
+            out = run_op("setitem", lambda x: x.at[idx].set(value), (self,))
+        self._data = out._data
+        self._node = out._node
+        self._out_idx = out._out_idx
+        self.stop_gradient = out.stop_gradient if self.stop_gradient else False
+
+
+class RemovableHandle:
+    _next_id = 0
+
+    def __init__(self, hooks_dict):
+        self._hooks = hooks_dict
+        self.id = RemovableHandle._next_id
+        RemovableHandle._next_id += 1
+
+    def remove(self):
+        self._hooks.pop(self.id, None)
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return [_unwrap_index(i) for i in idx]
+    if isinstance(idx, slice):
+        return slice(_unwrap_index(idx.start), _unwrap_index(idx.stop),
+                     _unwrap_index(idx.step))
+    return idx
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """Create a Tensor from data (parity: paddle.to_tensor)."""
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+# -- pytree registration: Tensors flow through jit/grad/shard_map ----------
+def _tensor_flatten(t: Tensor):
+    return (t._data,), (t.stop_gradient, t.name)
+
+
+def _tensor_unflatten(aux, children):
+    t = Tensor.__new__(Tensor)
+    t._data = children[0]
+    t.stop_gradient = aux[0]
+    t._grad = None
+    t._node = None
+    t._out_idx = 0
+    t._hooks = None
+    t.name = aux[1]
+    t.persistable = False
+    t.trainable = not aux[0]
+    return t
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)

@@ -1,0 +1,91 @@
+"""Device API (parity: python/paddle/device/). On TPU the device set is
+fixed by the runtime (libtpu is the 'driver' — the reference's
+Place/DeviceManager, paddle/phi/backends/device_manager.h, collapses to
+jax.devices())."""
+from __future__ import annotations
+
+import jax
+
+_CURRENT_DEVICE = [None]
+
+
+def get_all_devices():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_device():
+    return get_all_devices()
+
+
+def get_device():
+    if _CURRENT_DEVICE[0] is not None:
+        return _CURRENT_DEVICE[0]
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def set_device(device: str):
+    _CURRENT_DEVICE[0] = device
+    return device
+
+
+def get_device_count():
+    return jax.device_count()
+
+
+def device_count():
+    return jax.device_count()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def synchronize(device=None):
+    """Block until all launched device work finishes (parity:
+    paddle.device.synchronize / cudaDeviceSynchronize)."""
+    try:
+        (jax.device_put(0) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+class cuda:
+    """Namespace parity shim: paddle.device.cuda.* memory statistics map to
+    jax memory_stats on the TPU device."""
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        d = jax.devices()[0]
+        stats = d.memory_stats() or {}
+        return stats.get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def memory_allocated(device=None):
+        d = jax.devices()[0]
+        stats = d.memory_stats() or {}
+        return stats.get("bytes_in_use", 0)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        d = jax.devices()[0]
+        stats = d.memory_stats() or {}
+        return stats.get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        d = jax.devices()[0]
+        stats = d.memory_stats() or {}
+        return stats.get("bytes_limit", 0)
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize(device)

@@ -1,0 +1,79 @@
+"""Framework-level utilities: save/load, mode queries.
+
+Parity: python/paddle/framework/io.py paddle.save/paddle.load (pickle-based
+state_dict serialization) — numpy payloads so checkpoints are portable.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._data),
+                "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saved(obj):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            t = Tensor(jnp.asarray(obj["data"]),
+                       stop_gradient=obj.get("stop_gradient", True))
+            t.name = obj.get("name", "")
+            return t
+        return {k: _from_saved(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_saved(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """Save a (nested) state_dict / object (parity: paddle.save)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    """Load an object saved by ``save`` (parity: paddle.load)."""
+    with open(path, "rb") as f:
+        return _from_saved(pickle.load(f))
+
+
+def in_dynamic_mode() -> bool:
+    return True
+
+
+def in_dynamic_or_pir_mode() -> bool:
+    return True
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str = "tpu") -> bool:
+    return device_type in ("tpu", "axon")

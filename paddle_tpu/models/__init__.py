@@ -1,0 +1,3 @@
+"""Flagship model families built on the public API (BASELINE.md configs)."""
+from .gpt import (GPTConfig, GPTModel, GPTForCausalLM, create_train_step,
+                  gpt2_small, gpt2_tiny, write_back)  # noqa: F401

@@ -1,0 +1,7 @@
+"""paddle_tpu.nn (parity: python/paddle/nn/, 42.2k LoC in the reference)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401
+from .layer import *  # noqa: F401,F403
+from .layer.layers import Layer, functional_state, functional_call  # noqa: F401
+from .parameter import Parameter, ParamAttr, create_parameter  # noqa: F401

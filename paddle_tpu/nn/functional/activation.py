@@ -1,0 +1,202 @@
+"""Activation functionals (parity: python/paddle/nn/functional/activation.py).
+All lower to single XLA elementwise graphs which fuse into neighboring
+matmuls — no custom kernels needed on TPU."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import run_op
+
+__all__ = [
+    "relu", "relu_", "relu6", "gelu", "silu", "swish", "sigmoid", "tanh",
+    "leaky_relu", "elu", "celu", "selu", "prelu", "rrelu", "hardshrink",
+    "hardsigmoid", "hardswish", "hardtanh", "log_sigmoid", "log_softmax",
+    "softmax", "softmax_", "softplus", "softshrink", "softsign", "mish",
+    "tanhshrink", "thresholded_relu", "glu", "gumbel_softmax", "maxout",
+]
+
+
+def relu(x, name=None):
+    return run_op("relu", jax.nn.relu, (x,))
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def relu6(x, name=None):
+    return run_op("relu6", jax.nn.relu6, (x,))
+
+
+def gelu(x, approximate=False, name=None):
+    return run_op("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), (x,))
+
+
+def silu(x, name=None):
+    return run_op("silu", jax.nn.silu, (x,))
+
+
+def swish(x, name=None):
+    return run_op("swish", jax.nn.silu, (x,))
+
+
+def sigmoid(x, name=None):
+    return run_op("sigmoid", jax.nn.sigmoid, (x,))
+
+
+def tanh(x, name=None):
+    return run_op("tanh", jnp.tanh, (x,))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return run_op("leaky_relu",
+                  lambda a: jax.nn.leaky_relu(a, negative_slope), (x,))
+
+
+def elu(x, alpha=1.0, name=None):
+    return run_op("elu", lambda a: jax.nn.elu(a, alpha), (x,))
+
+
+def celu(x, alpha=1.0, name=None):
+    return run_op("celu", lambda a: jax.nn.celu(a, alpha), (x,))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return run_op("selu",
+                  lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), (x,))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return run_op("prelu", fn, (x, weight))
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    from ...core import random as _random
+    if training:
+        k = _random.default_generator.next_key()
+
+        def fn(a):
+            slope = jax.random.uniform(k, a.shape, jnp.float32, lower, upper).astype(a.dtype)
+            return jnp.where(a >= 0, a, slope * a)
+        return run_op("rrelu", fn, (x,))
+    mid = (lower + upper) / 2.0
+    return run_op("rrelu", lambda a: jnp.where(a >= 0, a, mid * a), (x,))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return run_op("hardshrink",
+                  lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0).astype(a.dtype), (x,))
+
+
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5, name=None):
+    return run_op("hardsigmoid",
+                  lambda a: jnp.clip(slope * a + offset, 0.0, 1.0).astype(a.dtype), (x,))
+
+
+def hardswish(x, name=None):
+    return run_op("hardswish", jax.nn.hard_swish, (x,))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return run_op("hardtanh", lambda a: jnp.clip(a, min, max), (x,))
+
+
+def log_sigmoid(x, name=None):
+    return run_op("log_sigmoid", jax.nn.log_sigmoid, (x,))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+
+    def fn(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.log_softmax(a, axis=axis)
+    return run_op("log_softmax", fn, (x,))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+
+    def fn(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.softmax(a, axis=axis)
+    return run_op("softmax", fn, (x,))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis, dtype)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return run_op("softplus",
+                  lambda a: jnp.where(beta * a > threshold, a,
+                                      jnp.log1p(jnp.exp(beta * a)) / beta), (x,))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return run_op("softshrink",
+                  lambda a: jnp.where(a > threshold, a - threshold,
+                                      jnp.where(a < -threshold, a + threshold, 0.0)
+                                      ).astype(a.dtype), (x,))
+
+
+def softsign(x, name=None):
+    return run_op("softsign", jax.nn.soft_sign, (x,))
+
+
+def mish(x, name=None):
+    return run_op("mish", jax.nn.mish, (x,))
+
+
+def tanhshrink(x, name=None):
+    return run_op("tanhshrink", lambda a: a - jnp.tanh(a), (x,))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return run_op("thresholded_relu",
+                  lambda a: jnp.where(a > threshold, a, value).astype(a.dtype), (x,))
+
+
+def glu(x, axis=-1, name=None):
+    return run_op("glu", lambda a: jax.nn.glu(a, axis=axis), (x,))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as _random
+    k = _random.default_generator.next_key()
+
+    def fn(a):
+        g = jax.random.gumbel(k, a.shape, jnp.float32).astype(a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y)
+            onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis, inplace=False)
+            y = onehot + y - jax.lax.stop_gradient(y)
+        return y
+    return run_op("gumbel_softmax", fn, (x,))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(a):
+        shape = list(a.shape)
+        ch = shape[axis]
+        shape[axis:axis + 1] = [ch // groups, groups]
+        return jnp.max(a.reshape(shape), axis=axis + 1)
+    return run_op("maxout", fn, (x,))

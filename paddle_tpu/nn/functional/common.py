@@ -1,0 +1,288 @@
+"""Common functionals: linear, dropout, embedding, pad, one_hot, interpolate
+(parity: python/paddle/nn/functional/common.py + input.py). linear keeps the
+reference's [in, out] weight layout so state_dicts transfer; dropout draws a
+(seed, offset) subkey from the Generator for the replayable-mask contract the
+reference implements in its dropout kernels."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import random as _random
+from ...core.dispatch import run_op
+from ...core.dtype import convert_dtype
+from ...core.tensor import Tensor
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "embedding", "one_hot", "pad", "interpolate", "upsample", "unfold",
+    "fold", "cosine_similarity", "pixel_shuffle", "pixel_unshuffle",
+    "channel_shuffle", "label_smooth", "bilinear", "class_center_sample",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with W shaped [in_features, out_features]
+    (the reference's fc layout, kernels/impl/matmul)."""
+    if bias is not None:
+        return run_op("linear", lambda a, w, b: jnp.matmul(a, w) + b,
+                      (x, weight, bias))
+    return run_op("linear", jnp.matmul, (x, weight))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    if p == 1.0:
+        return run_op("dropout", lambda a: jnp.zeros_like(a), (x,))
+    k = _random.default_generator.next_key()
+
+    def fn(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(k, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return run_op("dropout", fn, (x,))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    k = _random.default_generator.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(a):
+        keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
+        aa = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
+        bb = -aa * alpha_p * p
+        return (aa * jnp.where(keep, a, alpha_p) + bb).astype(a.dtype)
+    return run_op("alpha_dropout", fn, (x,))
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Gather rows of ``weight`` (parity: F.embedding; the sparse flag is
+    accepted for API parity — XLA's scatter-add grad already matches the
+    reference's selected-rows gradient capability)."""
+    def fn(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out).astype(w.dtype)
+        return out
+    return run_op("embedding", fn, (x, weight))
+
+
+def one_hot(x, num_classes, name=None):
+    return run_op("one_hot",
+                  lambda i: jax.nn.one_hot(i.astype(jnp.int32), num_classes,
+                                           dtype=jnp.float32),
+                  (x,), out_stop_gradient=True)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+
+    def fn(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle convention: pad applies to last len(pad)//2 spatial dims,
+            # ordered from the last dim backwards within data_format
+            npairs = len(pad) // 2
+            cfg = [(0, 0)] * nd
+            if data_format.startswith("NC"):
+                dims = list(range(nd - npairs, nd))
+            else:
+                dims = list(range(1, 1 + npairs))
+            for j, d in enumerate(dims):
+                cfg[d] = (pad[2 * j], pad[2 * j + 1])
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, cfg, mode="constant", constant_values=value)
+        return jnp.pad(a, cfg, mode=jmode)
+    return run_op("pad", fn, (x,))
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    if isinstance(size, Tensor):
+        size = size.tolist()
+
+    def fn(a):
+        cf = data_format.startswith("NC")
+        spatial = a.shape[2:] if cf else a.shape[1:-1]
+        if size is not None:
+            out_sp = tuple(int(s) for s in (size if isinstance(size, (list, tuple)) else [size]))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+                [scale_factor] * len(spatial)
+            out_sp = tuple(int(s * f) for s, f in zip(spatial, sf))
+        if cf:
+            shape = (a.shape[0], a.shape[1], *out_sp)
+        else:
+            shape = (a.shape[0], *out_sp, a.shape[-1])
+        method = {"nearest": "nearest", "bilinear": "bilinear", "linear": "linear",
+                  "trilinear": "trilinear", "bicubic": "bicubic", "area": "linear"}[mode]
+        return jax.image.resize(a, shape, method=method).astype(a.dtype)
+    return run_op("interpolate", fn, (x,))
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a2 = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])))
+        oh = (a2.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (a2.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                patches.append(a2[:, :, di:di + oh * st[0]:st[0],
+                                  dj:dj + ow * st[1]:st[1]])
+        out = jnp.stack(patches, axis=2)  # [N, C, kh*kw, OH, OW]
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+    return run_op("unfold", fn, (x,))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def fn(a):
+        n, ckk, L = a.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = os_[0] + 2 * pd[0], os_[1] + 2 * pd[1]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        a2 = a.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                out = out.at[:, :, di:di + oh * st[0]:st[0],
+                             dj:dj + ow * st[1]:st[1]].add(a2[:, :, i, j])
+        return out[:, :, pd[0]:pd[0] + os_[0], pd[1]:pd[1] + os_[1]]
+    return run_op("fold", fn, (x,))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+    return run_op("cosine_similarity", fn, (x1, x2))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+    return run_op("pixel_shuffle", fn, (x,))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h // r, w // r, c * r * r)
+    return run_op("pixel_unshuffle", fn, (x,))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, groups, c // groups, h, w).transpose(
+                0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, groups, c // groups).transpose(
+            0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return run_op("channel_shuffle", fn, (x,))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    if prior_dist is not None:
+        return run_op("label_smooth",
+                      lambda l, p: (1 - epsilon) * l + epsilon * p,
+                      (label, prior_dist))
+    return run_op("label_smooth",
+                  lambda l: (1 - epsilon) * l + epsilon / l.shape[-1], (label,))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+    ops = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return run_op("bilinear", fn, ops)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    data = np.asarray(label._data if isinstance(label, Tensor) else label)
+    pos = np.unique(data)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        extra = np.random.choice(rest, num_samples - len(pos), replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones(num_classes, dtype=np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(jnp.asarray(remap[data])), Tensor(jnp.asarray(sampled)))

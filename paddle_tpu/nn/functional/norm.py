@@ -1,0 +1,209 @@
+"""Normalization functionals (parity: python/paddle/nn/functional/norm.py +
+the fused rms_norm capability from incubate). Written as single jnp graphs
+XLA fuses; a Pallas fused path registers over the same names in ops/pallas."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import run_op, select_impl, register_op_impl
+from ...core.tensor import Tensor
+
+__all__ = ["normalize", "layer_norm", "rms_norm", "batch_norm", "group_norm",
+           "instance_norm", "local_response_norm"]
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return run_op("normalize",
+                  lambda a: a / jnp.maximum(
+                      jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis,
+                                        keepdims=True), 1.0 / p), epsilon), (x,))
+
+
+@register_op_impl("layer_norm", "xla")
+def _layer_norm_xla(a, w, b, eps, begin_axis):
+    axes = tuple(range(begin_axis, a.ndim))
+    x32 = a.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=axes, keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    if w is not None:
+        out = out * w.astype(jnp.float32)
+    if b is not None:
+        out = out + b.astype(jnp.float32)
+    return out.astype(a.dtype)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) \
+        else [normalized_shape]
+    begin = -len(ns)
+    impl = select_impl("layer_norm")
+    ops = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ops.append(weight)
+    if has_b:
+        ops.append(bias)
+
+    def fn(a, *rest):
+        it = iter(rest)
+        w = next(it) if has_w else None
+        b = next(it) if has_b else None
+        return impl(a, w, b, epsilon, a.ndim + begin)
+    return run_op("layer_norm", fn, tuple(ops))
+
+
+@register_op_impl("rms_norm", "xla")
+def _rms_norm_xla(a, w, eps):
+    x32 = a.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    out = x32 * jax.lax.rsqrt(var + eps)
+    if w is not None:
+        out = out * w.astype(jnp.float32)
+    return out.astype(a.dtype)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (parity: fused_rms_norm capability,
+    reference paddle/phi/kernels/fusion/gpu/fused_rms_norm* — on TPU the
+    Pallas impl registers under the same op name)."""
+    impl = select_impl("rms_norm")
+    if weight is not None:
+        return run_op("rms_norm", lambda a, w: impl(a, w, epsilon), (x, weight))
+    return run_op("rms_norm", lambda a: impl(a, None, epsilon), (x,))
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    """BatchNorm with running-stat update-in-place on the wrapper (the
+    reference updates mean/variance tensors in its kernel; here the layer
+    owns the buffers and we assign the new values eagerly)."""
+    ch_axis = 1 if data_format.startswith("NC") else -1
+    use_batch_stats = training and not use_global_stats
+
+    def fn(a, *rest):
+        it = iter(rest)
+        w = next(it) if weight is not None else None
+        b = next(it) if bias is not None else None
+        axes = tuple(i for i in range(a.ndim) if i != (ch_axis % a.ndim))
+        x32 = a.astype(jnp.float32)
+        if use_batch_stats:
+            mean = jnp.mean(x32, axis=axes)
+            var = jnp.var(x32, axis=axes)
+        else:
+            mean = running_mean._data.astype(jnp.float32)
+            var = running_var._data.astype(jnp.float32)
+        shape = [1] * a.ndim
+        shape[ch_axis % a.ndim] = a.shape[ch_axis % a.ndim]
+        out = (x32 - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+        if w is not None:
+            out = out * w.astype(jnp.float32).reshape(shape)
+        if b is not None:
+            out = out + b.astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+
+    ops = [x]
+    if weight is not None:
+        ops.append(weight)
+    if bias is not None:
+        ops.append(bias)
+    out = run_op("batch_norm", fn, tuple(ops))
+
+    if use_batch_stats and running_mean is not None:
+        # eager running-stat update (outside autograd)
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        axes = tuple(i for i in range(arr.ndim) if i != (ch_axis % arr.ndim))
+        m = jnp.mean(arr.astype(jnp.float32), axis=axes)
+        n = 1
+        for i in axes:
+            n *= arr.shape[i]
+        v = jnp.var(arr.astype(jnp.float32), axis=axes)
+        unbiased = v * n / max(n - 1, 1)
+        running_mean._data = (momentum * running_mean._data.astype(jnp.float32)
+                              + (1 - momentum) * m).astype(running_mean._data.dtype)
+        running_var._data = (momentum * running_var._data.astype(jnp.float32)
+                             + (1 - momentum) * unbiased).astype(running_var._data.dtype)
+    return out
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def fn(a, *rest):
+        it = iter(rest)
+        w = next(it) if weight is not None else None
+        b = next(it) if bias is not None else None
+        cf = data_format.startswith("NC")
+        if not cf:
+            a = jnp.moveaxis(a, -1, 1)
+        n, c = a.shape[:2]
+        spatial = a.shape[2:]
+        x32 = a.astype(jnp.float32).reshape(n, num_groups, c // num_groups, *spatial)
+        axes = tuple(range(2, x32.ndim))
+        mean = jnp.mean(x32, axis=axes, keepdims=True)
+        var = jnp.var(x32, axis=axes, keepdims=True)
+        out = ((x32 - mean) * jax.lax.rsqrt(var + epsilon)).reshape(n, c, *spatial)
+        shape = [1, c] + [1] * len(spatial)
+        if w is not None:
+            out = out * w.astype(jnp.float32).reshape(shape)
+        if b is not None:
+            out = out + b.astype(jnp.float32).reshape(shape)
+        out = out.astype(a.dtype)
+        if not cf:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    ops = [x]
+    if weight is not None:
+        ops.append(weight)
+    if bias is not None:
+        ops.append(bias)
+    return run_op("group_norm", fn, tuple(ops))
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, epsilon=1e-5,
+                  data_format="NCHW", name=None):
+    def fn(a, *rest):
+        it = iter(rest)
+        w = next(it) if weight is not None else None
+        b = next(it) if bias is not None else None
+        axes = tuple(range(2, a.ndim))
+        x32 = a.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=axes, keepdims=True)
+        var = jnp.var(x32, axis=axes, keepdims=True)
+        out = (x32 - mean) * jax.lax.rsqrt(var + epsilon)
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        if w is not None:
+            out = out * w.astype(jnp.float32).reshape(shape)
+        if b is not None:
+            out = out + b.astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+    ops = [x]
+    if weight is not None:
+        ops.append(weight)
+    if bias is not None:
+        ops.append(bias)
+    return run_op("instance_norm", fn, tuple(ops))
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def fn(a):
+        cf = data_format.startswith("NC")
+        ch_axis = 1 if cf else a.ndim - 1
+        sq = jnp.square(a.astype(jnp.float32))
+        half = size // 2
+        pads = [(0, 0)] * a.ndim
+        pads[ch_axis] = (half, size - 1 - half)
+        padded = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(sq)
+        for i in range(size):
+            sl = [jnp.s_[:]] * a.ndim
+            sl[ch_axis] = jnp.s_[i:i + a.shape[ch_axis]]
+            acc = acc + padded[tuple(sl)]
+        div = jnp.power(k + alpha * acc / size, beta)
+        return (a.astype(jnp.float32) / div).astype(a.dtype)
+    return run_op("local_response_norm", fn, (x,))

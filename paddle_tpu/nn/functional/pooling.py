@@ -1,0 +1,154 @@
+"""Pooling functionals (parity: python/paddle/nn/functional/pooling.py) via
+lax.reduce_window."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import run_op
+
+__all__ = ["max_pool1d", "max_pool2d", "max_pool3d", "avg_pool1d",
+           "avg_pool2d", "avg_pool3d", "adaptive_avg_pool1d",
+           "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+           "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d"]
+
+
+def _tup(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in (list(v) * n)[:n]) if len(v) < n else \
+            tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _pool(name, ndim, x, kernel_size, stride, padding, reducer, init,
+          ceil_mode, data_format, count_include_pad=True, exclusive=True):
+    n = ndim
+    ks = _tup(kernel_size, n)
+    st = _tup(stride if stride is not None else kernel_size, n)
+    pd = _tup(padding, n)
+    cf = data_format.startswith("NC")
+
+    def fn(a):
+        if cf:
+            window = (1, 1) + ks
+            strides = (1, 1) + st
+            pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+        else:
+            window = (1,) + ks + (1,)
+            strides = (1,) + st + (1,)
+            pads = ((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),)
+        if reducer == "max":
+            out = jax.lax.reduce_window(
+                a, -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+                else jnp.iinfo(a.dtype).min,
+                jax.lax.max, window, strides, pads)
+            return out
+        s = jax.lax.reduce_window(a.astype(jnp.float32), 0.0, jax.lax.add,
+                                  window, strides, pads)
+        if exclusive and any(pd):
+            ones = jnp.ones_like(a, jnp.float32)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        strides, pads)
+            return (s / cnt).astype(a.dtype)
+        return (s / float(np.prod(ks))).astype(a.dtype)
+    return run_op(name, fn, (x,))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool("max_pool1d", 1, x, kernel_size, stride, padding, "max",
+                 None, ceil_mode, "NCW" if data_format in ("NCL", "NCW") else "NWC")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool("max_pool2d", 2, x, kernel_size, stride, padding, "max",
+                 None, ceil_mode, data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool("max_pool3d", 3, x, kernel_size, stride, padding, "max",
+                 None, ceil_mode, data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool("avg_pool1d", 1, x, kernel_size, stride, padding, "avg",
+                 0.0, ceil_mode, "NCW" if data_format in ("NCL", "NCW") else "NWC",
+                 exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool("avg_pool2d", 2, x, kernel_size, stride, padding, "avg",
+                 0.0, ceil_mode, data_format, exclusive=exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool("avg_pool3d", 3, x, kernel_size, stride, padding, "avg",
+                 0.0, ceil_mode, data_format, exclusive=exclusive)
+
+
+def _adaptive(name, ndim, x, output_size, reducer, data_format):
+    n = ndim
+    os_ = _tup(output_size, n)
+    cf = data_format.startswith("NC")
+
+    def fn(a):
+        spatial = a.shape[2:] if cf else a.shape[1:-1]
+        out = a
+        for d in range(n):
+            in_s, out_s = spatial[d], os_[d]
+            axis = (2 + d) if cf else (1 + d)
+            if in_s % out_s == 0:
+                k = in_s // out_s
+                shape = list(out.shape)
+                shape[axis:axis + 1] = [out_s, k]
+                r = out.reshape(shape)
+                out = jnp.max(r, axis=axis + 1) if reducer == "max" else \
+                    jnp.mean(r.astype(jnp.float32), axis=axis + 1).astype(a.dtype)
+            else:
+                # general adaptive: gather variable windows
+                starts = (np.arange(out_s) * in_s) // out_s
+                ends = ((np.arange(out_s) + 1) * in_s + out_s - 1) // out_s
+                pieces = []
+                for s_, e_ in zip(starts, ends):
+                    sl = [jnp.s_[:]] * out.ndim
+                    sl[axis] = jnp.s_[int(s_):int(e_)]
+                    seg = out[tuple(sl)]
+                    agg = jnp.max(seg, axis=axis, keepdims=True) if reducer == "max" \
+                        else jnp.mean(seg.astype(jnp.float32), axis=axis,
+                                      keepdims=True).astype(a.dtype)
+                    pieces.append(agg)
+                out = jnp.concatenate(pieces, axis=axis)
+        return out
+    return run_op(name, fn, (x,))
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive("adaptive_avg_pool1d", 1, x, output_size, "avg", "NCW")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive("adaptive_avg_pool2d", 2, x, output_size, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive("adaptive_avg_pool3d", 3, x, output_size, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive("adaptive_max_pool1d", 1, x, output_size, "max", "NCW")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive("adaptive_max_pool2d", 2, x, output_size, "max", "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive("adaptive_max_pool3d", 3, x, output_size, "max", "NCDHW")

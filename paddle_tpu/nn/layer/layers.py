@@ -1,0 +1,366 @@
+"""Layer: the stateful module base class.
+
+Capability parity with the reference's ``paddle.nn.Layer``
+(reference: python/paddle/nn/layer/layers.py, 2.5k LoC): parameter/buffer/
+sublayer registries via __setattr__ interception, hooks, state_dict round
+trips, train/eval modes, dtype moves.
+
+TPU-native addition: ``functional_state``/``functional_call`` expose the
+layer as a pure function of a flat {name: array} dict so the whole training
+step can be staged into ONE XLA program with ``jax.jit``/``jax.grad`` — the
+performance path that replaces the reference's generated C++ autograd.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ...core.dtype import convert_dtype
+from ...core.tensor import Tensor
+from ..parameter import Parameter, ParamAttr, create_parameter
+
+__all__ = ["Layer", "functional_state", "functional_call"]
+
+_LAYER_COUNTERS: Dict[str, int] = {}
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hook_id):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self.training = True
+        self._dtype = convert_dtype(dtype)
+        self._parameters: "OrderedDict[str, Optional[Parameter]]" = OrderedDict()
+        self._buffers: "OrderedDict[str, Optional[Tensor]]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Optional[Layer]]" = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._forward_post_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._hook_id = 0
+        base = name_scope or type(self).__name__.lower()
+        n = _LAYER_COUNTERS.get(base, 0)
+        _LAYER_COUNTERS[base] = n + 1
+        self._full_name = f"{base}_{n}"
+        self._name_scope = base
+        self._casted_by_pure_fp16 = False
+
+    # -- registry plumbing --------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() before assigning parameters")
+            params[name] = value
+            buffers.pop(name, None) if buffers else None
+            object.__setattr__(self, name, value)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() before assigning sublayers")
+            layers[name] = value
+            object.__setattr__(self, name, value)
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    params[name] = None
+                else:
+                    raise TypeError(f"cannot assign non-Parameter to parameter {name!r}")
+            if buffers is not None and name in buffers:
+                buffers[name] = value if isinstance(value, Tensor) or value is None \
+                    else Tensor(value)
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+        if name in self.__dict__:
+            object.__delattr__(self, name)
+
+    # -- construction helpers ----------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """Create a Parameter owned by this layer (parity:
+        Layer.create_parameter with ParamAttr resolution)."""
+        from ..initializer import global_initializer
+        dtype = dtype or self._dtype
+        if default_initializer is None:
+            default_initializer = global_initializer(is_bias)
+        return create_parameter(shape, dtype=dtype, attr=attr, is_bias=is_bias,
+                                default_initializer=default_initializer)
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        self._parameters[name] = parameter
+        object.__setattr__(self, name, parameter)
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        object.__setattr__(self, name, sublayer)
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        object.__setattr__(self, name, tensor)
+
+    # -- traversal ----------------------------------------------------------
+    def parameters(self, include_sublayers: bool = True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{name}.{pname}" if name else pname), p
+
+    def buffers(self, include_sublayers: bool = True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True):
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{name}.{bname}" if name else bname), b
+
+    def children(self):
+        return (l for _, l in self.named_children())
+
+    def named_children(self):
+        for name, layer in self._sub_layers.items():
+            if layer is not None:
+                yield name, layer
+
+    def sublayers(self, include_self: bool = False):
+        out = []
+        for _, l in self.named_sublayers(include_self=include_self):
+            out.append(l)
+        return out
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False):
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            p = f"{prefix}.{name}" if prefix else name
+            yield p, layer
+            yield from layer.named_sublayers(prefix=p)
+
+    def _traverse(self, prefix, include_sublayers):
+        yield prefix, self
+        if include_sublayers:
+            for name, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                p = f"{prefix}.{name}" if prefix else name
+                yield from layer._traverse(p, True)
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers: bool = True,
+                   structured_name_prefix: str = "", use_hook: bool = True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix,
+                                             include_sublayers=include_sublayers):
+            dest[name] = p
+        for prefix, layer in self._traverse(structured_name_prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                dest[f"{prefix}.{bname}" if prefix else bname] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        """Load state (parity: Layer.set_state_dict). Returns
+        (missing_keys, unexpected_keys)."""
+        own = self.state_dict()
+        missing, unexpected = [], []
+        matched = set()
+        for k, v in state_dict.items():
+            if k not in own:
+                unexpected.append(k)
+                continue
+            t = own[k]
+            data = v._data if isinstance(v, Tensor) else jax.numpy.asarray(np.asarray(v))
+            if tuple(data.shape) != tuple(t._data.shape):
+                raise ValueError(
+                    f"shape mismatch for {k}: loading {tuple(data.shape)} into "
+                    f"{tuple(t._data.shape)}")
+            t._data = data.astype(t._data.dtype)
+            matched.add(k)
+        missing = [k for k in own if k not in matched]
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # -- modes / moves ------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            dt = convert_dtype(dtype)
+            for _, p in self.named_parameters():
+                p._data = p._data.astype(dt)
+            for _, b in self.named_buffers():
+                if jax.numpy.issubdtype(b._data.dtype, jax.numpy.floating):
+                    b._data = b._data.astype(dt)
+            for _, l in self.named_sublayers(include_self=True):
+                l._dtype = dt
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def full_name(self):
+        return self._full_name
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    # -- hooks --------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # -- call ---------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = [extra] if extra else []
+        for name, layer in self._sub_layers.items():
+            rep = repr(layer).split("\n")
+            rep = [rep[0]] + ["  " + r for r in rep[1:]]
+            lines.append(f"({name}): " + "\n".join(rep))
+        main = type(self).__name__
+        if not lines:
+            return f"{main}()"
+        body = "\n".join("  " + l for l in lines)
+        return f"{main}(\n{body}\n)"
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + \
+            list(self._buffers) + list(self._sub_layers)
+
+
+# -- functional bridge (the jit/performance path) --------------------------
+
+def functional_state(layer: Layer, trainable_only: bool = False):
+    """Extract {name: jax array} for all params (+ buffers unless
+    trainable_only). The arrays are the leaves jit/grad differentiates."""
+    out = {}
+    for name, p in layer.named_parameters():
+        if not trainable_only or p.trainable:
+            out[name] = p._data
+    if not trainable_only:
+        for name, b in layer.named_buffers():
+            out[name] = b._data
+    return out
+
+
+@contextlib.contextmanager
+def _swapped_state(layer: Layer, arrays: Dict[str, "jax.Array"]):
+    entries = {}
+    for name, t in list(layer.named_parameters()) + list(layer.named_buffers()):
+        entries[name] = t
+    saved = {}
+    try:
+        for name, arr in arrays.items():
+            t = entries[name]
+            saved[name] = t._data
+            t._data = arr
+        yield
+    finally:
+        for name, arr in saved.items():
+            entries[name]._data = arr
+
+
+def functional_call(layer: Layer, arrays: Dict[str, "jax.Array"], *args, **kwargs):
+    """Run ``layer(*args)`` with parameters/buffers temporarily replaced by
+    ``arrays`` (typically jit/grad tracers), with the autograd tape paused —
+    JAX's tracer owns differentiation on this path. Mirrors
+    torch.func.functional_call semantics; the TPU-native answer to the
+    reference's dy2static program capture (python/paddle/jit/)."""
+    from ...core.autograd import tape_paused
+    with _swapped_state(layer, arrays):
+        with tape_paused():
+            return layer(*args, **kwargs)

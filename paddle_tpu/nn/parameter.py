@@ -1,0 +1,103 @@
+"""Parameter: a trainable Tensor (parity: paddle.base.framework.EagerParamBase
++ paddle.create_parameter). ParamAttr carries name/initializer/lr/regularizer
+configuration like the reference's paddle.ParamAttr."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor
+
+
+class Parameter(Tensor):
+    """Trainable tensor: stop_gradient defaults to False, optimizers collect
+    these, state_dict persists them."""
+
+    def __init__(self, data, dtype=None, name: Optional[str] = None,
+                 trainable: bool = True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+        self.is_firstly_shared = False
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+# Parameter must flatten like Tensor but reconstruct as Parameter so pytrees
+# round-trip through jit keep their class.
+import jax  # noqa: E402
+
+
+def _param_flatten(p: Parameter):
+    return (p._data,), (p.stop_gradient, p.name)
+
+
+def _param_unflatten(aux, children):
+    p = Parameter.__new__(Parameter)
+    Tensor.__init__(p, children[0], stop_gradient=aux[0], name=aux[1])
+    p.trainable = not aux[0]
+    p.persistable = True
+    p.optimize_attr = {"learning_rate": 1.0}
+    p.regularizer = None
+    p.need_clip = True
+    p.is_distributed = False
+    p.is_firstly_shared = False
+    return p
+
+
+jax.tree_util.register_pytree_node(Parameter, _param_flatten, _param_unflatten)
+
+
+class ParamAttr:
+    """Parameter configuration (parity: paddle.ParamAttr)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return False
+        if callable(attr):  # bare initializer
+            return ParamAttr(initializer=attr)
+        return ParamAttr()
+
+
+def create_parameter(shape, dtype=None, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Create an initialized Parameter (parity: paddle.create_parameter)."""
+    from .initializer import Constant, XavierUniform
+
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    init = attr.initializer or default_initializer or \
+        (Constant(0.0) if is_bias else XavierUniform())
+    data = init(tuple(int(s) for s in shape), dtype)
+    p = Parameter(data, name=attr.name or name, trainable=attr.trainable)
+    p.optimize_attr["learning_rate"] = attr.learning_rate
+    p.regularizer = attr.regularizer
+    p.need_clip = attr.need_clip
+    return p

@@ -1,0 +1,432 @@
+"""Optimizers (parity: python/paddle/optimizer/ — Optimizer base, SGD,
+Momentum, Adagrad, RMSProp, Adam, AdamW, Lamb + the fused multi-tensor adamw
+kernel capability, reference paddle/phi/kernels/gpu/adamw_kernel.cu).
+
+TPU-native design: each optimizer defines a pure ``_update(param, grad,
+state, lr) -> (new_param, new_state)`` rule. The eager ``step()`` applies it
+per-parameter (the reference's dygraph path); the functional
+``apply_gradients(params, grads, states, lr)`` maps the same rule over a
+pytree inside ONE jitted XLA program — that is the fused multi-tensor path:
+XLA fuses the whole update sweep into a handful of kernels, which is what
+the reference's multi_tensor_adam achieves by hand.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "RMSProp", "Adam",
+           "AdamW", "Adamax", "Lamb", "Adadelta"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        self._lr = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._states: Dict[int, Dict[str, jnp.ndarray]] = {}
+        self._master_weights: Dict[int, jnp.ndarray] = {}
+        self._step_count = 0
+        self._param_groups = None
+        if parameters and isinstance(parameters[0], dict):
+            self._param_groups = parameters
+            self._parameter_list = [p for g in parameters for p in g["params"]]
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        self._lr = value
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- state ---------------------------------------------------------------
+    def _state_for(self, p: Tensor) -> Dict[str, jnp.ndarray]:
+        key = id(p)
+        if key not in self._states:
+            self._states[key] = self._init_state(p)
+        return self._states[key]
+
+    def _init_state(self, p: Tensor) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def _update(self, param, grad, state, lr):
+        raise NotImplementedError
+
+    def _decoupled_weight_decay(self) -> bool:
+        return False
+
+    # -- the eager step (parity: optimizer.step() in dygraph) ----------------
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("optimizer created without parameters")
+        params_grads = [(p, p.grad) for p in params
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        elif isinstance(self._weight_decay, float) and not \
+                self._decoupled_weight_decay() and self._weight_decay:
+            pass
+        lr = self.get_lr()
+        self._step_count += 1
+        for p, g in params_grads:
+            garr = g._data.astype(jnp.float32)
+            parr = p._data
+            # L2 regularization (coupled) unless the rule decouples it
+            if self._weight_decay and not self._decoupled_weight_decay():
+                wd = self._weight_decay if isinstance(self._weight_decay, float) \
+                    else getattr(self._weight_decay, "_coeff", 0.0)
+                garr = garr + wd * parr.astype(jnp.float32)
+            state = self._state_for(p)
+            use_master = self._multi_precision and parr.dtype != jnp.float32
+            if use_master:
+                mw = self._master_weights.setdefault(
+                    id(p), parr.astype(jnp.float32))
+                new_mw, new_state = self._update(mw, garr, state, lr)
+                self._master_weights[id(p)] = new_mw
+                p._data = new_mw.astype(parr.dtype)
+            else:
+                new_p, new_state = self._update(parr.astype(jnp.float32), garr,
+                                                state, lr)
+                p._data = new_p.astype(parr.dtype)
+            self._states[id(p)] = new_state
+
+    def clear_grad(self, set_to_zero=True):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # -- functional path (jit): same rule, one XLA program -------------------
+    def init_state_tree(self, params: Dict[str, jnp.ndarray]):
+        """Build the optimizer state pytree for a {name: array} param dict."""
+        class _P:  # shim exposing ._data/.shape for _init_state
+            def __init__(self, a):
+                self._data = a
+        return {k: self._init_state(_P(v)) for k, v in params.items()}
+
+    def apply_gradients(self, params: Dict[str, jnp.ndarray],
+                        grads: Dict[str, jnp.ndarray], states, lr,
+                        wd_mask: Optional[Dict[str, bool]] = None):
+        """Pure functional update over {name: array} dicts — call inside
+        jax.jit. ``wd_mask[name]=False`` skips weight decay (bias/norm
+        params), mirroring AdamW.apply_decay_param_fun."""
+        new_params, new_states = {}, {}
+        wd = self._wd_coeff()
+        for k, p in params.items():
+            g = grads[k]
+            if g is None:
+                new_params[k], new_states[k] = p, states[k]
+                continue
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            decay = wd if (wd_mask is None or wd_mask.get(k, True)) else 0.0
+            if decay and not self._decoupled_weight_decay():
+                g = g + decay * p32
+            np_, ns_ = self._update(p32, g, states[k], lr,
+                                    wd=decay if self._decoupled_weight_decay() else 0.0)
+            new_params[k] = np_.astype(p.dtype)
+            new_states[k] = ns_
+        return new_params, new_states
+
+    def _wd_coeff(self) -> float:
+        if isinstance(self._weight_decay, float):
+            return self._weight_decay
+        return getattr(self._weight_decay, "_coeff", 0.0) if self._weight_decay else 0.0
+
+    # -- checkpoint ----------------------------------------------------------
+    def state_dict(self):
+        out = {"step": self._step_count}
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                st = self._states.get(id(p))
+                if st:
+                    for k, v in st.items():
+                        out[f"{p.name or i}.{k}"] = Tensor(v)
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._step_count = state_dict.get("step", 0)
+        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state_dict:
+            self._lr.set_state_dict(state_dict["LR_Scheduler"])
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                st = self._state_for(p)
+                for k in list(st.keys()):
+                    key = f"{p.name or i}.{k}"
+                    if key in state_dict:
+                        v = state_dict[key]
+                        st[k] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _update(self, param, grad, state, lr, wd=0.0):
+        return param - lr * grad, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros(p._data.shape, jnp.float32)}
+
+    def _update(self, param, grad, state, lr, wd=0.0):
+        v = self._momentum * state["velocity"] + grad
+        if self._nesterov:
+            upd = grad + self._momentum * v
+        else:
+            upd = v
+        return param - lr * upd, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full(p._data.shape, self._init_acc, jnp.float32)}
+
+    def _update(self, param, grad, state, lr, wd=0.0):
+        m = state["moment"] + jnp.square(grad)
+        return param - lr * grad / (jnp.sqrt(m) + self._epsilon), {"moment": m}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _init_state(self, p):
+        s = {"mean_square": jnp.zeros(p._data.shape, jnp.float32),
+             "momentum": jnp.zeros(p._data.shape, jnp.float32)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros(p._data.shape, jnp.float32)
+        return s
+
+    def _update(self, param, grad, state, lr, wd=0.0):
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(grad)
+        out_state = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * grad
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+            out_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"] + lr * grad / denom
+        out_state["momentum"] = mom
+        return param - mom, out_state
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+
+    def _init_state(self, p):
+        s = {"moment1": jnp.zeros(p._data.shape, jnp.float32),
+             "moment2": jnp.zeros(p._data.shape, jnp.float32),
+             "beta1_pow": jnp.ones((), jnp.float32),
+             "beta2_pow": jnp.ones((), jnp.float32)}
+        if self._amsgrad:
+            s["moment2_max"] = jnp.zeros(p._data.shape, jnp.float32)
+        return s
+
+    def _update(self, param, grad, state, lr, wd=0.0):
+        b1, b2 = self._beta1, self._beta2
+        m1 = b1 * state["moment1"] + (1 - b1) * grad
+        m2 = b2 * state["moment2"] + (1 - b2) * jnp.square(grad)
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        mhat = m1 / (1 - b1p)
+        if self._amsgrad:
+            m2max = jnp.maximum(state["moment2_max"], m2)
+            vhat = m2max / (1 - b2p)
+        else:
+            vhat = m2 / (1 - b2p)
+        if wd:
+            param = param * (1.0 - lr * wd)
+        new_param = param - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        out = {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+        if self._amsgrad:
+            out["moment2_max"] = m2max
+        return new_param, out
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (parity: paddle.optimizer.AdamW with
+    apply_decay_param_fun; kernel parity: phi adamw_kernel)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad, name=name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled_weight_decay(self):
+        return True
+
+    def step(self):
+        # route decay through _update(wd=...) honoring apply_decay_param_fun
+        params = self._parameter_list
+        params_grads = [(p, p.grad) for p in params
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        self._step_count += 1
+        wd = self._wd_coeff()
+        for p, g in params_grads:
+            decay = wd
+            if self._apply_decay_param_fun is not None and \
+                    not self._apply_decay_param_fun(p.name):
+                decay = 0.0
+            state = self._state_for(p)
+            parr = p._data
+            use_master = self._multi_precision and parr.dtype != jnp.float32
+            if use_master:
+                mw = self._master_weights.setdefault(id(p), parr.astype(jnp.float32))
+                new_p, new_state = self._update(mw, g._data.astype(jnp.float32),
+                                                state, lr, wd=decay)
+                self._master_weights[id(p)] = new_p
+                p._data = new_p.astype(parr.dtype)
+            else:
+                new_p, new_state = self._update(parr.astype(jnp.float32),
+                                                g._data.astype(jnp.float32),
+                                                state, lr, wd=decay)
+                p._data = new_p.astype(parr.dtype)
+            self._states[id(p)] = new_state
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros(p._data.shape, jnp.float32),
+                "inf_norm": jnp.zeros(p._data.shape, jnp.float32),
+                "beta1_pow": jnp.ones((), jnp.float32)}
+
+    def _update(self, param, grad, state, lr, wd=0.0):
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * grad
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(grad))
+        b1p = state["beta1_pow"] * self._beta1
+        new_param = param - lr / (1 - b1p) * m / (u + self._epsilon)
+        return new_param, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros(p._data.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(p._data.shape, jnp.float32)}
+
+    def _update(self, param, grad, state, lr, wd=0.0):
+        asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * jnp.square(grad)
+        upd = grad * jnp.sqrt(state["avg_squared_update"] + self._epsilon) / \
+            jnp.sqrt(asg + self._epsilon)
+        asu = self._rho * state["avg_squared_update"] + (1 - self._rho) * jnp.square(upd)
+        return param - lr * upd, {"avg_squared_grad": asg,
+                                  "avg_squared_update": asu}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _decoupled_weight_decay(self):
+        return True
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros(p._data.shape, jnp.float32),
+                "moment2": jnp.zeros(p._data.shape, jnp.float32),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32)}
+
+    def _update(self, param, grad, state, lr, wd=None):
+        if wd is None or wd == 0.0:
+            wd = self._wd_coeff()
+        b1, b2 = self._beta1, self._beta2
+        m1 = b1 * state["moment1"] + (1 - b1) * grad
+        m2 = b2 * state["moment2"] + (1 - b2) * jnp.square(grad)
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        r = (m1 / (1 - b1p)) / (jnp.sqrt(m2 / (1 - b2p)) + self._epsilon) + wd * param
+        w_norm = jnp.linalg.norm(param)
+        r_norm = jnp.linalg.norm(r)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return param - lr * ratio * r, \
+            {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}

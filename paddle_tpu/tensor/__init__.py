@@ -1,0 +1,92 @@
+"""Tensor op surface + Tensor method patching.
+
+Parity: python/paddle/tensor/__init__.py, which patches every generated op
+onto paddle.Tensor as methods. Here the op modules are plain Python over jnp
+and the same patching approach attaches them (and the operator dunders) to
+the Tensor wrapper class.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor, to_tensor  # noqa: F401
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+
+from . import creation, math, manipulation, linalg, logic, search, stat
+from . import random as _random_mod
+
+_METHOD_SOURCES = [math, manipulation, linalg, logic, search, stat, creation]
+
+# names that must not shadow core Tensor attributes/properties
+_SKIP = {"to_tensor", "Tensor", "t"}
+
+
+def _patch_tensor_methods():
+    for mod in _METHOD_SOURCES:
+        for name in getattr(mod, "__all__", []):
+            if name in _SKIP or hasattr(Tensor, name):
+                continue
+            fn = getattr(mod, name)
+            if callable(fn):
+                setattr(Tensor, name, fn)
+    # explicit method aliases
+    Tensor.t = linalg.t
+    Tensor.mm = linalg.mm
+    Tensor.dot = linalg.dot
+    Tensor.norm = linalg.norm
+    Tensor.matmul = linalg.matmul
+    Tensor.transpose = manipulation.transpose
+    Tensor.reshape = manipulation.reshape
+    Tensor.cast = manipulation.cast
+    Tensor.astype = manipulation.cast
+    Tensor.split = manipulation.split
+    Tensor.chunk = manipulation.chunk
+    Tensor.exponential_ = _random_mod.exponential_
+    Tensor.uniform_ = _random_mod.uniform_
+    Tensor.normal_ = _random_mod.normal_
+
+    import jax.numpy as jnp
+    from ..core.dispatch import run_op
+
+    def _coerce(other):
+        return other
+
+    Tensor.__add__ = lambda s, o: math.add(s, _coerce(o))
+    Tensor.__radd__ = lambda s, o: math.add(s, _coerce(o))
+    Tensor.__sub__ = lambda s, o: math.subtract(s, _coerce(o))
+    Tensor.__rsub__ = lambda s, o: run_op("subtract", lambda a: jnp.subtract(o, a), (s,))
+    Tensor.__mul__ = lambda s, o: math.multiply(s, _coerce(o))
+    Tensor.__rmul__ = lambda s, o: math.multiply(s, _coerce(o))
+    Tensor.__truediv__ = lambda s, o: math.divide(s, _coerce(o))
+    Tensor.__rtruediv__ = lambda s, o: run_op("divide", lambda a: jnp.divide(o, a), (s,))
+    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, _coerce(o))
+    Tensor.__mod__ = lambda s, o: math.mod(s, _coerce(o))
+    Tensor.__pow__ = lambda s, o: math.pow(s, o)
+    Tensor.__rpow__ = lambda s, o: run_op("pow", lambda a: jnp.power(o, a), (s,))
+    Tensor.__neg__ = lambda s: math.neg(s)
+    Tensor.__abs__ = lambda s: math.abs(s)
+    Tensor.__matmul__ = lambda s, o: linalg.matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: linalg.matmul(to_tensor(o), s)
+    Tensor.__eq__ = lambda s, o: logic.equal(s, o)
+    Tensor.__ne__ = lambda s, o: logic.not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: logic.less_than(s, o)
+    Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
+    Tensor.__and__ = lambda s, o: logic.logical_and(s, o) \
+        if s.dtype == jnp.bool_ else logic.bitwise_and(s, o)
+    Tensor.__or__ = lambda s, o: logic.logical_or(s, o) \
+        if s.dtype == jnp.bool_ else logic.bitwise_or(s, o)
+    Tensor.__xor__ = lambda s, o: logic.logical_xor(s, o) \
+        if s.dtype == jnp.bool_ else logic.bitwise_xor(s, o)
+    Tensor.__invert__ = lambda s: logic.logical_not(s) \
+        if s.dtype == jnp.bool_ else logic.bitwise_not(s)
+
+
+_patch_tensor_methods()

@@ -1,0 +1,174 @@
+"""Tensor creation ops (parity: python/paddle/tensor/creation.py, 2.9k LoC
+in the reference; here each op lowers directly to jnp/XLA)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+    "full_like", "empty", "empty_like", "arange", "linspace", "logspace",
+    "eye", "diag", "diagflat", "meshgrid", "tril", "triu", "assign",
+    "clone", "tril_indices", "triu_indices", "complex", "polar",
+]
+
+
+def _dt(dtype, default=None):
+    d = convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else get_default_dtype()
+    return d
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = jnp.bool_
+        elif isinstance(fill_value, int):
+            dtype = jnp.int64
+        else:
+            dtype = get_default_dtype()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    from ..core.dispatch import run_op
+    return run_op("zeros_like", lambda a: jnp.zeros_like(a, dtype=convert_dtype(dtype)), (x,))
+
+
+def ones_like(x, dtype=None, name=None):
+    from ..core.dispatch import run_op
+    return run_op("ones_like", lambda a: jnp.ones_like(a, dtype=convert_dtype(dtype)), (x,))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    from ..core.dispatch import run_op
+    return run_op("full_like",
+                  lambda a: jnp.full_like(a, fill_value, dtype=convert_dtype(dtype)), (x,))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange bounds must be python scalars")
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dtype = jnp.int64
+        else:
+            dtype = get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    from ..core.dispatch import run_op
+
+    def fn(a):
+        if a.ndim == 1 and padding_value != 0:
+            d = jnp.diag(a, k=offset)
+            mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+            return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+        return jnp.diag(a, k=offset)
+    return run_op("diag", fn, (x,))
+
+
+def diagflat(x, offset=0, name=None):
+    from ..core.dispatch import run_op
+    return run_op("diagflat", lambda a: jnp.diagflat(a, k=offset), (x,))
+
+
+def meshgrid(*args, **kwargs):
+    from ..core.dispatch import run_op
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return run_op("meshgrid", lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")), args)
+
+
+def tril(x, diagonal=0, name=None):
+    from ..core.dispatch import run_op
+    return run_op("tril", lambda a: jnp.tril(a, k=diagonal), (x,))
+
+
+def triu(x, diagonal=0, name=None):
+    from ..core.dispatch import run_op
+    return run_op("triu", lambda a: jnp.triu(a, k=diagonal), (x,))
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    if col is None:
+        col = row
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    if col is None:
+        col = row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def assign(x, output=None):
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output._data = data
+        return output
+    return Tensor(data)
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def complex(real, imag, name=None):
+    from ..core.dispatch import run_op
+    return run_op("complex", lambda r, i: jnp.asarray(r) + 1j * jnp.asarray(i),
+                  (real, imag))
+
+
+def polar(abs, angle, name=None):
+    from ..core.dispatch import run_op
+    return run_op("polar", lambda a, t: a * jnp.exp(1j * t.astype(jnp.result_type(t, jnp.float32))),
+                  (abs, angle))
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)

@@ -1,0 +1,14 @@
+"""Einstein summation (parity: python/paddle/tensor/einsum.py — the
+reference implements its own parser/planner; here XLA's native einsum is
+strictly better on TPU: it lowers straight to MXU dot_generals)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import run_op
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands):
+    return run_op("einsum", lambda *xs: jnp.einsum(equation, *xs), operands)

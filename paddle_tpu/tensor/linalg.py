@@ -1,0 +1,277 @@
+"""Linear algebra ops (parity: python/paddle/tensor/linalg.py, 4.6k LoC in
+the reference). matmul-class ops are the MXU hot path — kept as single jnp
+calls so XLA tiles them onto the systolic array in bf16."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "t", "transpose", "dist", "norm", "cond",
+    "cross", "cholesky", "cholesky_solve", "bincount", "histogram", "mv",
+    "matrix_power", "qr", "lu", "eig", "eigvals", "eigh", "eigvalsh",
+    "multi_dot", "svd", "pinv", "solve", "triangular_solve", "lstsq", "slogdet",
+    "det", "matrix_rank", "corrcoef", "cov", "householder_product", "vander",
+    "vecdot", "matrix_norm", "vector_norm", "inv",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return run_op("matmul", fn, (x, y))
+
+
+def mm(input, mat2, name=None):
+    return run_op("matmul", jnp.matmul, (input, mat2))
+
+
+def bmm(x, y, name=None):
+    return run_op("matmul", jnp.matmul, (x, y))
+
+
+def dot(x, y, name=None):
+    return run_op("dot", lambda a, b: jnp.sum(a * b, axis=-1), (x, y))
+
+
+def mv(x, vec, name=None):
+    return run_op("matmul", jnp.matmul, (x, vec))
+
+
+def t(input, name=None):
+    def fn(a):
+        if a.ndim <= 1:
+            return a
+        return a.T
+    return run_op("t", fn, (input,))
+
+
+def transpose(x, perm, name=None):
+    from .manipulation import transpose as _tr
+    return _tr(x, perm)
+
+
+def dist(x, y, p=2, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        if p == float("inf"):
+            return jnp.max(d)
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        return jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+    return run_op("dist", fn, (x, y))
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def fn(a):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            return jnp.linalg.norm(a, ord=None, axis=_ax(axis), keepdims=keepdim)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=_ax(axis), keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=_ax(axis), keepdims=keepdim)
+        if axis is None:
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(a), p)), 1.0 / p)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=_ax(axis),
+                                 keepdims=keepdim), 1.0 / p)
+    return run_op("norm", fn, (x,))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return run_op("matrix_norm",
+                  lambda a: jnp.linalg.norm(a, ord=None if p == "fro" else p,
+                                            axis=tuple(axis), keepdims=keepdim), (x,))
+
+
+def cond(x, p=None, name=None):
+    return run_op("cond", lambda a: jnp.linalg.cond(a, p=p), (x,))
+
+
+def cross(x, y, axis=9, name=None):
+    def fn(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return run_op("cross", fn, (x, y))
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2).conj() if upper else l
+    return run_op("cholesky", fn, (x,))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, l):
+        if upper:
+            l = jnp.swapaxes(l, -1, -2).conj()
+        z = jax.scipy.linalg.solve_triangular(l, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(l, -1, -2).conj(), z, lower=False)
+    return run_op("cholesky_solve", fn, (x, y))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    data = np.asarray(x._data if isinstance(x, Tensor) else x)
+    length = max(int(data.max()) + 1 if data.size else 0, minlength)
+    if weights is not None:
+        return run_op("bincount",
+                      lambda i, w: jnp.bincount(i.astype(jnp.int32), w, length=length),
+                      (x, weights))
+    return run_op("bincount",
+                  lambda i: jnp.bincount(i.astype(jnp.int32), length=length), (x,))
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    data = np.asarray(input._data if isinstance(input, Tensor) else input)
+    lo, hi = (float(data.min()), float(data.max())) if min == 0 and max == 0 else (min, max)
+    w = np.asarray(weight._data) if isinstance(weight, Tensor) else weight
+    h, _ = np.histogram(data, bins=bins, range=(lo, hi), weights=w, density=density)
+    return Tensor(jnp.asarray(h if density else h.astype(np.int64)))
+
+
+def matrix_power(x, n, name=None):
+    return run_op("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), (x,))
+
+
+def qr(x, mode="reduced", name=None):
+    return run_op("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), (x,))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def fn(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, (piv + 1).astype(jnp.int32)
+    lu_t, piv = run_op("lu", fn, (x,), num_nondiff_outputs=1)
+    if get_infos:
+        info = Tensor(jnp.zeros(x.shape[:-2], jnp.int32))
+        return lu_t, piv, info
+    return lu_t, piv
+
+
+def eig(x, name=None):
+    data = np.asarray(x._data if isinstance(x, Tensor) else x)
+    w, v = np.linalg.eig(data)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    data = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(data)))
+
+
+def eigh(x, UPLO="L", name=None):
+    return run_op("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), (x,))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return run_op("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), (x,))
+
+
+def multi_dot(x, name=None):
+    return run_op("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs), tuple(x))
+
+
+def svd(x, full_matrices=False, name=None):
+    return run_op("svd",
+                  lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), (x,))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return run_op("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), (x,))
+
+
+def inv(x, name=None):
+    return run_op("inv", jnp.linalg.inv, (x,))
+
+
+def solve(x, y, name=None):
+    return run_op("solve", jnp.linalg.solve, (x, y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return run_op("triangular_solve",
+                  lambda a, b: jax.scipy.linalg.solve_triangular(
+                      a, b, lower=not upper, trans=1 if transpose else 0,
+                      unit_diagonal=unitriangular), (x, y))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(jnp.int32), sv
+    s, r, rk, sv = run_op("lstsq", fn, (x, y), num_nondiff_outputs=2)
+    return s, r, rk, sv
+
+
+def slogdet(x, name=None):
+    def fn(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return run_op("slogdet", fn, (x,))
+
+
+def det(x, name=None):
+    return run_op("det", jnp.linalg.det, (x,))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return run_op("matrix_rank",
+                  lambda a: jnp.linalg.matrix_rank(a, rtol=tol).astype(jnp.int64),
+                  (x,), num_nondiff_outputs=1)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return run_op("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), (x,))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = fweights._data if isinstance(fweights, Tensor) else fweights
+    aw = aweights._data if isinstance(aweights, Tensor) else aweights
+    return run_op("cov", lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                                           fweights=fw, aweights=aw), (x,))
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t_):
+        *batch, m, n = a.shape
+        q = jnp.broadcast_to(jnp.eye(m, dtype=a.dtype), (*batch, m, m)).copy()
+        for i in range(n):
+            v = jnp.zeros((*batch, m), a.dtype).at[..., i].set(1.0)
+            v = v.at[..., i + 1:].set(a[..., i + 1:, i])
+            vv = jnp.einsum("...i,...j->...ij", v, v)
+            h = jnp.eye(m, dtype=a.dtype) - t_[..., i, None, None] * vv
+            q = q @ h
+        return q[..., :n]
+    return run_op("householder_product", fn, (x, tau))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return run_op("vander", lambda a: jnp.vander(a, N=n, increasing=increasing), (x,))
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return run_op("vecdot", lambda a, b: jnp.sum(a * b, axis=axis), (x, y))
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)

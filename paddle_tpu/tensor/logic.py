@@ -1,0 +1,93 @@
+"""Comparison / logical ops (parity: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "allclose", "isclose", "logical_and",
+    "logical_or", "logical_xor", "logical_not", "bitwise_and", "bitwise_or",
+    "bitwise_xor", "bitwise_not", "bitwise_left_shift", "bitwise_right_shift",
+    "is_tensor", "is_empty", "isreal", "iscomplex", "is_complex",
+    "is_floating_point", "is_integer",
+]
+
+
+def _b(name, fn):
+    def op(x, y, name=None, _f=fn, _n=name):
+        return run_op(_n, _f, (x, y), out_stop_gradient=True)
+    op.__name__ = name
+    return op
+
+
+equal = _b("equal", lambda a, b: a == b)
+not_equal = _b("not_equal", lambda a, b: a != b)
+greater_than = _b("greater_than", lambda a, b: a > b)
+greater_equal = _b("greater_equal", lambda a, b: a >= b)
+less_than = _b("less_than", lambda a, b: a < b)
+less_equal = _b("less_equal", lambda a, b: a <= b)
+logical_and = _b("logical_and", jnp.logical_and)
+logical_or = _b("logical_or", jnp.logical_or)
+logical_xor = _b("logical_xor", jnp.logical_xor)
+bitwise_and = _b("bitwise_and", jnp.bitwise_and)
+bitwise_or = _b("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _b("bitwise_xor", jnp.bitwise_xor)
+bitwise_left_shift = _b("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _b("bitwise_right_shift", jnp.right_shift)
+
+
+def logical_not(x, out=None, name=None):
+    return run_op("logical_not", jnp.logical_not, (x,), out_stop_gradient=True)
+
+
+def bitwise_not(x, out=None, name=None):
+    return run_op("bitwise_not", jnp.bitwise_not, (x,), out_stop_gradient=True)
+
+
+def equal_all(x, y, name=None):
+    return run_op("equal_all", lambda a, b: jnp.array_equal(a, b), (x, y),
+                  out_stop_gradient=True)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return run_op("allclose",
+                  lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                            equal_nan=equal_nan), (x, y),
+                  out_stop_gradient=True)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return run_op("isclose",
+                  lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan), (x, y),
+                  out_stop_gradient=True)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def isreal(x, name=None):
+    return run_op("isreal", jnp.isreal, (x,), out_stop_gradient=True)
+
+
+def iscomplex(x):
+    return jnp.issubdtype(x.dtype, jnp.complexfloating)
+
+
+is_complex = iscomplex
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(x.dtype, jnp.integer)

@@ -1,0 +1,528 @@
+"""Manipulation ops (parity: python/paddle/tensor/manipulation.py, 6.8k LoC
+in the reference). Static-shape ops lower to jnp; dynamic-output-shape ops
+(masked_select, unique, nonzero) execute eagerly on host values since XLA
+requires static shapes — the documented TPU-native tradeoff."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = [
+    "cast", "reshape", "reshape_", "flatten", "squeeze", "squeeze_",
+    "unsqueeze", "unsqueeze_", "concat", "stack", "split", "vsplit",
+    "hsplit", "dsplit", "tensor_split", "chunk", "gather", "gather_nd",
+    "scatter", "scatter_nd", "scatter_nd_add", "index_select", "index_sample",
+    "index_add", "index_put", "masked_select", "masked_fill", "tile",
+    "expand", "broadcast_to", "expand_as", "broadcast_tensors", "flip",
+    "rot90", "roll", "transpose", "moveaxis", "swapaxes", "unbind", "unique",
+    "unique_consecutive", "repeat_interleave", "take_along_axis",
+    "put_along_axis", "slice", "strided_slice", "crop", "unfold",
+    "as_complex", "as_real", "view", "view_as", "unstack", "numel",
+    "atleast_1d", "atleast_2d", "atleast_3d", "diagonal", "fill_diagonal_",
+    "shard_index", "tolist", "tensordot", "take", "select_scatter",
+    "diagonal_scatter", "flatten_", "pad_sequences",
+]
+
+
+def cast(x, dtype):
+    dt = convert_dtype(dtype)
+    return run_op("cast", lambda a: a.astype(dt), (x,))
+
+
+def reshape(x, shape, name=None):
+    shape = _static_shape(shape)
+    return run_op("reshape", lambda a: jnp.reshape(a, shape), (x,))
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new)
+    return run_op("flatten", fn, (x,))
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    out = flatten(x, start_axis, stop_axis)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return run_op("squeeze", fn, (x,))
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a._data) if isinstance(a, Tensor) else int(a) for a in axes]
+    return run_op("unsqueeze", lambda a: jnp.expand_dims(a, tuple(axes)), (x,))
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def concat(x, axis=0, name=None):
+    ax = int(axis._data) if isinstance(axis, Tensor) else int(axis)
+    return run_op("concat", lambda *xs: jnp.concatenate(xs, axis=ax), tuple(x))
+
+
+def stack(x, axis=0, name=None):
+    return run_op("stack", lambda *xs: jnp.stack(xs, axis=axis), tuple(x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis._data) if isinstance(axis, Tensor) else int(axis)
+
+    def fn(a):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=ax))
+        secs = [int(s) for s in num_or_sections]
+        total = a.shape[ax]
+        if any(s in (-1,) for s in secs):
+            known = builtins_sum(s for s in secs if s != -1)
+            secs = [total - known if s == -1 else s for s in secs]
+        points = np.cumsum(secs)[:-1].tolist()
+        return tuple(jnp.split(a, points, axis=ax))
+    return run_op("split", fn, (x,))
+
+
+builtins_sum = sum  # keep python sum before tensor.math shadows in callers
+
+
+def vsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=0)
+
+
+def hsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=1)
+
+
+def dsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=2)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def fn(a):
+        return tuple(jnp.array_split(a, num_or_indices, axis=axis)) \
+            if isinstance(num_or_indices, int) else \
+            tuple(jnp.split(a, list(num_or_indices), axis=axis))
+    return run_op("tensor_split", fn, (x,))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis._data) if isinstance(axis, Tensor) else int(axis)
+    return run_op("gather", lambda a, i: jnp.take(a, i.astype(jnp.int32).reshape(-1), axis=ax),
+                  (x, index))
+
+
+def gather_nd(x, index, name=None):
+    def fn(a, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        return a[tuple(jnp.moveaxis(idx, -1, 0))] if k == a.ndim else \
+            a[tuple(jnp.moveaxis(idx, -1, 0))]
+    return run_op("gather_nd", fn, (x, index))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(a, i, u):
+        i = i.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        z = a.at[i].set(jnp.zeros_like(u))
+        return z.at[i].add(u)
+    return run_op("scatter", fn, (x, index, updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    shp = _static_shape(shape)
+    return run_op("scatter_nd",
+                  lambda i, u: jnp.zeros(shp, u.dtype).at[tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))].add(u),
+                  (index, updates))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return run_op("scatter_nd_add",
+                  lambda a, i, u: a.at[tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))].add(u),
+                  (x, index, updates))
+
+
+def index_select(x, index, axis=0, name=None):
+    return run_op("index_select",
+                  lambda a, i: jnp.take(a, i.astype(jnp.int32).reshape(-1), axis=axis),
+                  (x, index))
+
+
+def index_sample(x, index):
+    return run_op("index_sample",
+                  lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=1),
+                  (x, index))
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(a, i, v):
+        i = i.astype(jnp.int32).reshape(-1)
+        moved = jnp.moveaxis(a, axis, 0)
+        vmoved = jnp.moveaxis(v, axis, 0)
+        out = moved.at[i].add(vmoved)
+        return jnp.moveaxis(out, 0, axis)
+    return run_op("index_add", fn, (x, index, value))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(i._data if isinstance(i, Tensor) else i for i in indices)
+
+    def fn(a, v):
+        return a.at[idx].add(v) if accumulate else a.at[idx].set(v)
+    return run_op("index_put", fn, (x, value))
+
+
+def masked_select(x, mask, name=None):
+    # Dynamic output shape: eager/host op (documented XLA constraint).
+    data = np.asarray(x._data if isinstance(x, Tensor) else x)
+    m = np.asarray(mask._data if isinstance(mask, Tensor) else mask)
+    return Tensor(jnp.asarray(data[np.broadcast_to(m, data.shape)]))
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value._data if isinstance(value, Tensor) else value
+    return run_op("masked_fill",
+                  lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a), (x, mask))
+
+
+def tile(x, repeat_times, name=None):
+    reps = _static_shape(repeat_times)
+    return run_op("tile", lambda a: jnp.tile(a, reps), (x,))
+
+
+def broadcast_to(x, shape, name=None):
+    shp = _static_shape(shape)
+    return run_op("broadcast_to", lambda a: jnp.broadcast_to(a, shp), (x,))
+
+
+def expand(x, shape, name=None):
+    shp = list(_static_shape(shape))
+
+    def fn(a):
+        full = list(shp)
+        off = len(full) - a.ndim
+        for i in range(a.ndim):
+            if full[off + i] == -1:
+                full[off + i] = a.shape[i]
+        return jnp.broadcast_to(a, tuple(full))
+    return run_op("expand", fn, (x,))
+
+
+def expand_as(x, y, name=None):
+    return run_op("expand_as", lambda a, b: jnp.broadcast_to(a, b.shape), (x, y))
+
+
+def broadcast_tensors(inputs, name=None):
+    return run_op("broadcast_tensors", lambda *xs: tuple(jnp.broadcast_arrays(*xs)),
+                  tuple(inputs))
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return run_op("flip", lambda a: jnp.flip(a, axis=tuple(axes)), (x,))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return run_op("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), (x,))
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = tuple(shifts) if isinstance(shifts, (list, tuple)) else shifts
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return run_op("roll", lambda a: jnp.roll(a, sh, axis=ax), (x,))
+
+
+def transpose(x, perm, name=None):
+    p = tuple(int(i) for i in perm)
+    return run_op("transpose", lambda a: jnp.transpose(a, p), (x,))
+
+
+def moveaxis(x, source, destination, name=None):
+    return run_op("moveaxis", lambda a: jnp.moveaxis(a, source, destination), (x,))
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return run_op("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), (x,))
+
+
+swapdims = swapaxes
+
+
+def unbind(x, axis=0, name=None):
+    def fn(a):
+        n = a.shape[axis]
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis))
+    return run_op("unbind", fn, (x,))
+
+
+unstack = unbind
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # Dynamic output shape: host op.
+    data = np.asarray(x._data if isinstance(x, Tensor) else x)
+    res = np.unique(data, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    data = np.asarray(x._data if isinstance(x, Tensor) else x)
+    if axis is None:
+        data = data.reshape(-1)
+        axis = 0
+    keep = np.ones(data.shape[axis], dtype=bool)
+    sl = [np.s_[:]] * data.ndim
+    sl_prev = list(sl)
+    sl[axis] = np.s_[1:]
+    sl_prev[axis] = np.s_[:-1]
+    diff = np.any(np.asarray(data[tuple(sl)]) != np.asarray(data[tuple(sl_prev)]),
+                  axis=tuple(i for i in range(data.ndim) if i != axis)) \
+        if data.ndim > 1 else data[1:] != data[:-1]
+    keep[1:] = diff
+    out = Tensor(jnp.asarray(np.compress(keep, data, axis=axis)))
+    extras = []
+    if return_inverse:
+        extras.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, data.shape[axis]))
+        extras.append(Tensor(jnp.asarray(counts)))
+    return (out, *extras) if extras else out
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats._data)
+        total = int(reps.sum())
+        return run_op("repeat_interleave",
+                      lambda a, r: jnp.repeat(a, r, axis=axis, total_repeat_length=total),
+                      (x, repeats))
+    return run_op("repeat_interleave", lambda a: jnp.repeat(a, repeats, axis=axis), (x,))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return run_op("take_along_axis",
+                  lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=axis),
+                  (arr, indices))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    def fn(a, i, v):
+        i = i.astype(jnp.int32)
+        v = jnp.broadcast_to(v, i.shape) if v.ndim else jnp.full(i.shape, v, a.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v.astype(a.dtype), axis=axis, inplace=False)
+        mode = {"add": "add", "multiply": "multiply", "mul": "multiply",
+                "amin": "min", "amax": "max", "mean": "add"}[reduce]
+        # scatter with accumulation via .at indexing
+        dims = [jnp.arange(s).reshape([-1 if k == d else 1 for k in range(a.ndim)])
+                for d, s in enumerate(i.shape)]
+        full_idx = tuple(i if d == axis else jnp.broadcast_to(dims[d], i.shape)
+                         for d in range(a.ndim))
+        at = a.at[full_idx]
+        return {"add": at.add, "multiply": at.multiply, "min": at.min,
+                "max": at.max}[mode](v.astype(a.dtype))
+    if isinstance(values, Tensor):
+        return run_op("put_along_axis", fn, (arr, indices, values))
+    return run_op("put_along_axis", lambda a, i: fn(a, i, jnp.asarray(values)),
+                  (arr, indices))
+
+
+def slice(input, axes, starts, ends):
+    axes = [int(a) for a in axes]
+    starts = [int(s._data) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e._data) if isinstance(e, Tensor) else int(e) for e in ends]
+
+    def fn(a):
+        sl = [np.s_[:]] * a.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            sl[ax] = np.s_[st:en]
+        return a[tuple(sl)]
+    return run_op("slice", fn, (input,))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def fn(a):
+        sl = [np.s_[:]] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            sl[int(ax)] = np.s_[int(st):int(en):int(sd)]
+        return a[tuple(sl)]
+    return run_op("strided_slice", fn, (x,))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shp = _static_shape(shape)
+    offs = [0] * len(shp) if offsets is None else \
+        [int(o._data) if isinstance(o, Tensor) else int(o) for o in offsets]
+
+    def fn(a):
+        sl = tuple(np.s_[o:o + (s if s != -1 else a.shape[d] - o)]
+                   for d, (o, s) in enumerate(zip(offs, shp)))
+        return a[sl]
+    return run_op("crop", fn, (x,))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from ..nn.functional.common import unfold as _unfold
+    return _unfold(x, kernel_sizes, strides, paddings, dilations)
+
+
+def as_complex(x, name=None):
+    return run_op("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), (x,))
+
+
+def as_real(x, name=None):
+    return run_op("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), (x,))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return run_op("view_dtype", lambda a: a.view(convert_dtype(shape_or_dtype)), (x,))
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [run_op("atleast_1d", jnp.atleast_1d, (t,)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [run_op("atleast_2d", jnp.atleast_2d, (t,)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [run_op("atleast_3d", jnp.atleast_3d, (t,)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return run_op("diagonal",
+                  lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), (x,))
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    def fn(a):
+        n = min(a.shape[-2], a.shape[-1])
+        i = jnp.arange(n - (offset if offset > 0 else 0))
+        return a.at[..., i - min(offset, 0), i + max(offset, 0)].set(value)
+    out = run_op("fill_diagonal_", fn, (x,))
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def fn(a, v):
+        sl = [np.s_[:]] * a.ndim
+        sl[axis] = index
+        return a.at[tuple(sl)].set(v)
+    return run_op("select_scatter", fn, (x, values))
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def fn(a, b):
+        n = min(a.shape[axis1], a.shape[axis2])
+        i = jnp.arange(n - abs(offset))
+        idx = [np.s_[:]] * a.ndim
+        idx[axis1] = i - min(offset, 0)
+        idx[axis2] = i + max(offset, 0)
+        return a.at[tuple(idx)].set(b)
+    return run_op("diagonal_scatter", fn, (x, y))
+
+
+def take(x, index, mode="raise", name=None):
+    return run_op("take",
+                  lambda a, i: jnp.take(a.reshape(-1), i.astype(jnp.int32),
+                                        mode="clip" if mode == "clip" else "wrap"),
+                  (x, index))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+
+    def fn(i):
+        shard = i // size
+        return jnp.where(shard == shard_id, i % size, ignore_value)
+    return run_op("shard_index", fn, (input,))
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.tolist()
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(a.tolist()) if isinstance(a, Tensor) else tuple(a)
+                   if isinstance(a, (list, tuple)) else a for a in ax)
+    return run_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), (x, y))
+
+
+def pad_sequences(seqs, pad_value=0):
+    maxlen = max(len(s) for s in seqs)
+    out = np.full((len(seqs), maxlen), pad_value)
+    for i, s in enumerate(seqs):
+        out[i, :len(s)] = np.asarray(s)
+    return Tensor(jnp.asarray(out))
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)

@@ -1,0 +1,153 @@
+"""Random sampling ops (parity: python/paddle/tensor/random.py). Draws pull
+fresh subkeys from the stateful Generator (core/random.py); under jit the
+functional path threads keys explicitly instead."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.random import default_generator
+from ..core import random as _core_random
+from ..core.tensor import Tensor
+
+__all__ = [
+    "rand", "randn", "standard_normal", "randint", "randint_like", "uniform",
+    "normal", "gaussian", "bernoulli", "multinomial", "randperm", "poisson",
+    "exponential_", "uniform_", "normal_", "binomial", "standard_gamma",
+    "log_normal",
+]
+
+
+def _key():
+    return _core_random.default_generator.next_key()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def rand(shape, dtype=None, name=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.uniform(_key(), _shape(shape), dtype=dt))
+
+
+def randn(shape, dtype=None, name=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.normal(_key(), _shape(shape), dtype=dt))
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    dt = convert_dtype(dtype)
+    return Tensor(jax.random.randint(_key(), _shape(shape), low, high, dtype=dt))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.randint(_key(), tuple(x.shape), low, high).astype(dt))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    k = jax.random.key(seed) if seed else _key()
+    return Tensor(jax.random.uniform(k, _shape(shape), dtype=dt,
+                                     minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(m + s * jax.random.normal(_key(), shp, dtype=get_default_dtype()))
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(mean + std * jax.random.normal(_key(), shp, dtype=get_default_dtype()))
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    k = jax.random.key(seed) if seed else _key()
+    return Tensor(mean + std * jax.random.normal(k, _shape(shape), dtype=dt))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    return Tensor(jnp.exp(normal(mean, std, shape)._data))
+
+
+def bernoulli(x, name=None):
+    k = _key()
+    return run_op("bernoulli",
+                  lambda p: jax.random.bernoulli(k, p).astype(p.dtype), (x,),
+                  out_stop_gradient=True)
+
+
+def binomial(count, prob, name=None):
+    k = _key()
+    return run_op("binomial",
+                  lambda n, p: jax.random.binomial(k, n, p).astype(jnp.int64),
+                  (count, prob), out_stop_gradient=True)
+
+
+def standard_gamma(x, name=None):
+    k = _key()
+    return run_op("standard_gamma", lambda a: jax.random.gamma(k, a), (x,))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    k = _key()
+
+    def fn(p):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        if replacement:
+            return jax.random.categorical(
+                k, logits, axis=-1,
+                shape=(*p.shape[:-1], num_samples) if p.ndim > 1 else (num_samples,)
+            ).astype(jnp.int64)
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(k, p.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx.astype(jnp.int64)
+    return run_op("multinomial", fn, (x,), out_stop_gradient=True)
+
+
+def randperm(n, dtype="int64", name=None):
+    dt = convert_dtype(dtype)
+    return Tensor(jax.random.permutation(_key(), n).astype(dt))
+
+
+def poisson(x, name=None):
+    k = _key()
+    return run_op("poisson",
+                  lambda lam: jax.random.poisson(k, lam).astype(lam.dtype), (x,),
+                  out_stop_gradient=True)
+
+
+def exponential_(x, lam=1.0, name=None):
+    k = _key()
+    x._data = (jax.random.exponential(k, tuple(x.shape), dtype=x.dtype) / lam)
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    k = jax.random.key(seed) if seed else _key()
+    x._data = jax.random.uniform(k, tuple(x.shape), dtype=x.dtype,
+                                 minval=min, maxval=max)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._data = mean + std * jax.random.normal(_key(), tuple(x.shape), dtype=x.dtype)
+    return x

@@ -1,0 +1,162 @@
+"""Search / sort ops (parity: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "searchsorted", "topk", "where",
+    "where_", "nonzero", "index_select", "masked_select", "kthvalue", "mode",
+    "median", "nanmedian", "bucketize",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = convert_dtype(dtype)
+
+    def fn(a):
+        r = jnp.argmax(a if axis is not None else a.reshape(-1),
+                       axis=axis, keepdims=keepdim and axis is not None)
+        return r.astype(dt)
+    return run_op("argmax", fn, (x,), num_nondiff_outputs=1)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = convert_dtype(dtype)
+
+    def fn(a):
+        r = jnp.argmin(a if axis is not None else a.reshape(-1),
+                       axis=axis, keepdims=keepdim and axis is not None)
+        return r.astype(dt)
+    return run_op("argmin", fn, (x,), num_nondiff_outputs=1)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(a):
+        i = jnp.argsort(a, axis=axis, stable=True, descending=descending)
+        return i.astype(jnp.int64)
+    return run_op("argsort", fn, (x,), num_nondiff_outputs=1)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(a):
+        return jnp.sort(a, axis=axis, stable=True, descending=descending)
+    return run_op("sort", fn, (x,))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int64
+
+    def fn(s, v):
+        if s.ndim == 1:
+            return jnp.searchsorted(s, v, side=side).astype(dt)
+        return jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(
+            s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1])
+        ).reshape(v.shape).astype(dt)
+    return run_op("searchsorted", fn, (sorted_sequence, values),
+                  num_nondiff_outputs=1)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    kk = int(k._data) if isinstance(k, Tensor) else int(k)
+
+    def fn(a):
+        ax = a.ndim - 1 if axis is None else axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        src = moved if largest else -moved
+        vals, idx = jax.lax.top_k(src, kk)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+    return run_op("topk", fn, (x,), num_nondiff_outputs=1)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return run_op("where", lambda c, a, b: jnp.where(c, a, b), (condition, x, y))
+
+
+def where_(condition, x=None, y=None, name=None):
+    out = where(condition, x, y)
+    x._data = out._data
+    return x
+
+
+def nonzero(x, as_tuple=False):
+    # Dynamic output shape: host op (XLA static-shape constraint).
+    data = np.asarray(x._data if isinstance(x, Tensor) else x)
+    idx = np.nonzero(data)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i[:, None].astype(np.int64))) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1).astype(np.int64)))
+
+
+def kthvalue(x, k, axis=None, keepdim=False, name=None):
+    def fn(a):
+        ax = a.ndim - 1 if axis is None else axis % a.ndim
+        vals = jnp.sort(a, axis=ax)
+        idxs = jnp.argsort(a, axis=ax, stable=True)
+        v = jnp.take(vals, k - 1, axis=ax)
+        i = jnp.take(idxs, k - 1, axis=ax)
+        if keepdim:
+            v, i = jnp.expand_dims(v, ax), jnp.expand_dims(i, ax)
+        return v, i.astype(jnp.int64)
+    return run_op("kthvalue", fn, (x,), num_nondiff_outputs=1)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    data = np.asarray(x._data if isinstance(x, Tensor) else x)
+    ax = axis % data.ndim
+    moved = np.moveaxis(data, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals, idxs = [], []
+    for row in flat:
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts[::-1])] if False else uniq[np.argmax(counts)]
+        cands = np.nonzero(row == best)[0]
+        idxs.append(cands[-1])
+        vals.append(best)
+    out_shape = moved.shape[:-1]
+    v = np.asarray(vals).reshape(out_shape)
+    i = np.asarray(idxs).reshape(out_shape)
+    if keepdim:
+        v, i = np.expand_dims(v, ax), np.expand_dims(i, ax)
+    else:
+        v, i = np.moveaxis(v[..., None], -1, ax).squeeze(ax), np.moveaxis(i[..., None], -1, ax).squeeze(ax)
+    return Tensor(jnp.asarray(v)), Tensor(jnp.asarray(i.astype(np.int64)))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def fn(a):
+        return jnp.median(a, axis=axis, keepdims=keepdim)
+    if mode == "avg":
+        return run_op("median", fn, (x,))
+    v, i = kthvalue(x, (x.shape[axis if axis is not None else -1] + 1) // 2,
+                    axis=axis, keepdim=keepdim)
+    return v, i
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return run_op("nanmedian",
+                  lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim), (x,))
+
+
+def index_select(x, index, axis=0, name=None):
+    from .manipulation import index_select as _is
+    return _is(x, index, axis)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask)

@@ -1,0 +1,54 @@
+"""Statistics ops (parity: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import run_op
+
+__all__ = ["std", "var", "numel", "quantile", "nanquantile", "histogramdd"]
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return run_op("std", lambda a: jnp.std(a, axis=_ax(axis),
+                                           ddof=1 if unbiased else 0,
+                                           keepdims=keepdim), (x,))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return run_op("var", lambda a: jnp.var(a, axis=_ax(axis),
+                                           ddof=1 if unbiased else 0,
+                                           keepdims=keepdim), (x,))
+
+
+def numel(x, name=None):
+    from .manipulation import numel as _numel
+    return _numel(x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return run_op("quantile",
+                  lambda a: jnp.quantile(a, jnp.asarray(q), axis=_ax(axis),
+                                         keepdims=keepdim, method=interpolation), (x,))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return run_op("nanquantile",
+                  lambda a: jnp.nanquantile(a, jnp.asarray(q), axis=_ax(axis),
+                                            keepdims=keepdim, method=interpolation), (x,))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    import numpy as np
+    from ..core.tensor import Tensor
+    data = np.asarray(x._data if hasattr(x, "_data") else x)
+    w = np.asarray(weights._data) if hasattr(weights, "_data") else weights
+    h, edges = np.histogramdd(data, bins=bins, range=ranges, density=density, weights=w)
+    return Tensor(jnp.asarray(h)), [Tensor(jnp.asarray(e)) for e in edges]

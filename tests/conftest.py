@@ -1,0 +1,25 @@
+"""Test env: force an 8-virtual-device CPU platform BEFORE jax initializes,
+so distributed/sharding tests run without TPU hardware (the 'Gloo analog' —
+SURVEY.md §4: all distributed tests run on one host)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# exact f32 matmuls for numeric checks (the default 'fastest' uses bf16-class
+# accumulation — the TPU-speed setting; tests want reference numerics)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+# tests are CPU-only: drop accelerator backend factories so no TPU-tunnel
+# connection is ever attempted from the test process
+try:
+    from jax._src import xla_bridge as _xb
+    for _name in ("axon", "tpu"):
+        _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass

@@ -1,0 +1,68 @@
+"""OpTest-style harness (parity: test/legacy_test/op_test.py:420 —
+check_output vs numpy reference at :2016, check_grad vs numeric
+finite-difference gradients at :2972)."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_output(op_fn, numpy_fn, inputs, rtol=1e-5, atol=1e-6, **kwargs):
+    """Run op_fn on Tensors and numpy_fn on arrays; compare."""
+    tensors = [paddle.to_tensor(i) for i in inputs]
+    out = op_fn(*tensors, **kwargs)
+    ref = numpy_fn(*inputs, **kwargs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    refs = ref if isinstance(ref, (list, tuple)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(np.asarray(o.numpy(), np.float64),
+                                   np.asarray(r, np.float64),
+                                   rtol=rtol, atol=atol)
+
+
+def numeric_grad(fn, inputs, idx, delta=1e-3):
+    """Central-difference gradient of sum(fn(inputs)) w.r.t. inputs[idx]."""
+    x = np.asarray(inputs[idx], np.float64)
+    grad = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+
+    def eval_sum(v):
+        args = list(inputs)
+        args[idx] = v.astype(inputs[idx].dtype)
+        t = [paddle.to_tensor(a) for a in args]
+        out = fn(*t)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return float(sum(np.asarray(o.numpy(), np.float64).sum()
+                         for o in outs if o is not None))
+
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        f_plus = eval_sum(x)
+        flat[i] = orig - delta
+        f_minus = eval_sum(x)
+        flat[i] = orig
+        gflat[i] = (f_plus - f_minus) / (2 * delta)
+    return grad
+
+
+def check_grad(op_fn, inputs, grad_idx=0, rtol=1e-2, atol=1e-3, delta=1e-3):
+    """Compare tape backward() grads against finite differences."""
+    tensors = [paddle.to_tensor(np.asarray(i, np.float64)) for i in inputs]
+    for t in tensors:
+        t.stop_gradient = False
+    out = op_fn(*tensors)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    total = None
+    for o in outs:
+        if o is None or o.stop_gradient:
+            continue
+        s = o.sum()
+        total = s if total is None else total + s
+    total.backward()
+    analytic = tensors[grad_idx].grad.numpy()
+    numeric = numeric_grad(op_fn, [np.asarray(i, np.float64) for i in inputs],
+                           grad_idx, delta)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)

@@ -1,0 +1,135 @@
+"""Autograd engine tests (model: reference test/legacy_test autograd suites +
+py_layer tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_and_fanout():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    a = x * 3
+    b = a + x      # x used twice
+    c = b * b
+    c.backward()
+    # c = (4x)^2, dc/dx = 32x = 64
+    np.testing.assert_allclose(x.grad.numpy(), 64.0)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x.detach() * 3
+    z = x * 2 + y
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_double_backward_raises_without_retain():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()  # ok with retained graph
+    y2 = x * x
+    y2.backward()
+    with pytest.raises(RuntimeError):
+        y2.backward()
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (gx,) = paddle.grad(y, [x])
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+    assert x.grad is None  # grad() must not touch .grad
+
+
+def test_grad_unused_input():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    z = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [z], retain_graph=True)
+    (gz,) = paddle.grad(y, [z], allow_unused=True)
+    assert gz is None
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.array([[3.0, 1.0], [2.0, 4.0]], np.float32),
+                         stop_gradient=False)
+    vals, idx = paddle.topk(x, k=1, axis=1)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 0], [0, 1]])
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    seen = {}
+
+    def hook(g):
+        seen["g"] = g.numpy().copy()
+        return g * 2
+
+    h = x.register_hook(hook)
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(seen["g"], [3.0, 3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+    h.remove()
+
+
+def test_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 1.0])
+
+
+def test_pylayer():
+    class Square(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor
+            return grad * 2 * x
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Square.apply(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_nan_inf_flag():
+    paddle.set_flags({"check_nan_inf": True})
+    try:
+        x = paddle.to_tensor([1.0, 0.0])
+        with pytest.raises(FloatingPointError):
+            paddle.divide(paddle.to_tensor([1.0, 1.0]), x)
+    finally:
+        paddle.set_flags({"check_nan_inf": False})

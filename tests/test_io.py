@@ -1,0 +1,61 @@
+"""io: Dataset/DataLoader/sampler tests."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset,
+                           DistributedBatchSampler, TensorDataset)
+
+
+class RangeDataset(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.array([i], np.float32), np.array(i % 3, np.int64)
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_batches():
+    dl = DataLoader(RangeDataset(10), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == [4, 1] and y.shape == [4]
+    assert batches[-1][0].shape[0] == 2
+
+
+def test_dataloader_drop_last_shuffle():
+    dl = DataLoader(RangeDataset(10), batch_size=4, drop_last=True,
+                    shuffle=True)
+    batches = list(dl)
+    assert len(batches) == 2
+    all_items = np.concatenate([b[0].numpy().ravel() for b in batches])
+    assert len(set(all_items.tolist())) == 8
+
+
+def test_dataloader_workers_prefetch():
+    dl = DataLoader(RangeDataset(20), batch_size=5, num_workers=2)
+    batches = list(dl)
+    assert len(batches) == 4
+    vals = sorted(np.concatenate([b[0].numpy().ravel() for b in batches]).tolist())
+    assert vals == [float(i) for i in range(20)]
+
+
+def test_tensor_dataset():
+    td = TensorDataset([paddle.ones([4, 2]), paddle.zeros([4])])
+    x, y = td[1]
+    assert x.shape == [2]
+
+
+def test_distributed_batch_sampler_shards():
+    ds = RangeDataset(16)
+    seen = []
+    for rank in range(4):
+        s = DistributedBatchSampler(ds, batch_size=2, num_replicas=4,
+                                    rank=rank)
+        idx = [i for b in s for i in b]
+        assert len(idx) == 4
+        seen.extend(idx)
+    assert sorted(seen) == list(range(16))

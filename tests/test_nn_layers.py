@@ -1,0 +1,207 @@
+"""nn.Layer mechanics + layer numerics (model: reference
+test/legacy_test layer tests + dygraph API tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.RandomState(3)
+
+
+def test_layer_registry_and_state_dict():
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = paddle.nn.Linear(4, 8)
+            self.fc2 = paddle.nn.Linear(8, 2)
+            self.register_buffer("step", paddle.to_tensor(0))
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert "fc1.weight" in names and "fc2.bias" in names
+    sd = net.state_dict()
+    assert "step" in sd and len(sd) == 5
+
+    net2 = Net()
+    net2.set_state_dict(sd)
+    for (n1, p1), (n2, p2) in zip(net.named_parameters(),
+                                  net2.named_parameters()):
+        np.testing.assert_array_equal(p1.numpy(), p2.numpy())
+
+
+def test_save_load_roundtrip(tmp_path):
+    net = paddle.nn.Linear(3, 3)
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(net.state_dict(), path)
+    loaded = paddle.load(path)
+    net2 = paddle.nn.Linear(3, 3)
+    net2.set_state_dict(loaded)
+    np.testing.assert_array_equal(net.weight.numpy(), net2.weight.numpy())
+
+
+def test_train_eval_dropout():
+    d = paddle.nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    d.train()
+    y = d(x)
+    assert float((y == 0).sum()) > 0
+    d.eval()
+    y = d(x)
+    np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+
+def test_layernorm_matches_numpy():
+    x = RNG.randn(4, 10).astype(np.float32)
+    ln = paddle.nn.LayerNorm(10)
+    out = ln(paddle.to_tensor(x)).numpy()
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_rmsnorm():
+    x = RNG.randn(4, 16).astype(np.float32)
+    rn = paddle.nn.RMSNorm(16)
+    out = rn(paddle.to_tensor(x)).numpy()
+    ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_running_stats():
+    bn = paddle.nn.BatchNorm2D(3)
+    x = paddle.to_tensor(RNG.randn(4, 3, 8, 8).astype(np.float32) + 5.0)
+    bn.train()
+    bn(x)
+    assert abs(float(bn._mean.numpy().mean())) > 0.1  # moved toward 5
+    bn.eval()
+    y = bn(x)
+    assert y.shape == [4, 3, 8, 8]
+
+
+def test_conv2d_matches_reference():
+    import jax.numpy as jnp
+    x = RNG.randn(2, 3, 8, 8).astype(np.float32)
+    conv = paddle.nn.Conv2D(3, 6, 3, padding=1)
+    out = conv(paddle.to_tensor(x))
+    assert out.shape == [2, 6, 8, 8]
+    # depthwise
+    dw = paddle.nn.Conv2D(4, 4, 3, groups=4, padding=1, bias_attr=False)
+    out = dw(paddle.to_tensor(RNG.randn(1, 4, 5, 5).astype(np.float32)))
+    assert out.shape == [1, 4, 5, 5]
+
+
+def test_conv_grad_flows():
+    conv = paddle.nn.Conv2D(2, 2, 3, padding=1)
+    x = paddle.to_tensor(RNG.randn(1, 2, 6, 6).astype(np.float32))
+    loss = conv(x).sum()
+    loss.backward()
+    assert conv.weight.grad is not None
+    assert conv.weight.grad.shape == [2, 2, 3, 3]
+
+
+def test_pooling():
+    x = paddle.to_tensor(RNG.randn(1, 2, 8, 8).astype(np.float32))
+    assert F.max_pool2d(x, 2).shape == [1, 2, 4, 4]
+    assert F.avg_pool2d(x, 2).shape == [1, 2, 4, 4]
+    assert F.adaptive_avg_pool2d(x, 1).shape == [1, 2, 1, 1]
+
+
+def test_embedding_padding_idx_grad():
+    emb = paddle.nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor(np.array([[0, 1, 2]]))
+    out = emb(ids)
+    np.testing.assert_allclose(out.numpy()[0, 0], np.zeros(4), atol=1e-7)
+    out.sum().backward()
+    assert emb.weight.grad is not None
+
+
+def test_mha_and_causal_mask():
+    mha = paddle.nn.MultiHeadAttention(16, 4, dropout=0.0)
+    x = paddle.to_tensor(RNG.randn(2, 5, 16).astype(np.float32))
+    out = mha(x)
+    assert out.shape == [2, 5, 16]
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(RNG.randn(2, 5, 4, 8).astype(np.float32)),
+        paddle.to_tensor(RNG.randn(2, 5, 4, 8).astype(np.float32)),
+        paddle.to_tensor(RNG.randn(2, 5, 4, 8).astype(np.float32)),
+        is_causal=True)
+    assert out.shape == [2, 5, 4, 8]
+
+
+def test_attention_causal_correctness():
+    # causal attention of position 0 only sees position 0
+    q = np.zeros((1, 3, 1, 4), np.float32)
+    k = np.zeros((1, 3, 1, 4), np.float32)
+    v = RNG.randn(1, 3, 1, 4).astype(np.float32)
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        is_causal=True).numpy()
+    np.testing.assert_allclose(out[0, 0, 0], v[0, 0, 0], rtol=1e-5)
+    np.testing.assert_allclose(out[0, 2, 0], v[0, :3, 0].mean(0), rtol=1e-5)
+
+
+def test_losses():
+    logits = paddle.to_tensor(RNG.randn(4, 5).astype(np.float32))
+    labels = paddle.to_tensor(np.array([0, 1, 2, 3]))
+    ce = F.cross_entropy(logits, labels)
+    # manual reference
+    lg = logits.numpy()
+    ref = -(lg[np.arange(4), [0, 1, 2, 3]] -
+            np.log(np.exp(lg).sum(-1))).mean()
+    np.testing.assert_allclose(float(ce), ref, rtol=1e-4)
+
+    # ignore_index
+    labels2 = paddle.to_tensor(np.array([0, -100, 2, -100]))
+    ce2 = F.cross_entropy(logits, labels2, ignore_index=-100)
+    ref2 = -(lg[[0, 2], [0, 2]] - np.log(np.exp(lg[[0, 2]]).sum(-1))).mean()
+    np.testing.assert_allclose(float(ce2), ref2, rtol=1e-4)
+
+    # soft label
+    soft = np.full((4, 5), 0.2, np.float32)
+    ce3 = F.cross_entropy(logits, paddle.to_tensor(soft), soft_label=True)
+    assert np.isfinite(float(ce3))
+
+    bce = F.binary_cross_entropy_with_logits(
+        paddle.to_tensor(RNG.randn(4).astype(np.float32)),
+        paddle.to_tensor(np.array([0., 1., 1., 0.], np.float32)))
+    assert np.isfinite(float(bce))
+
+
+def test_sequential_layerlist():
+    seq = paddle.nn.Sequential(paddle.nn.Linear(4, 4), paddle.nn.ReLU())
+    assert len(seq) == 2
+    ll = paddle.nn.LayerList([paddle.nn.Linear(2, 2) for _ in range(3)])
+    assert len(list(ll.parameters())) == 6
+
+
+def test_forward_hooks():
+    lin = paddle.nn.Linear(2, 2)
+    calls = []
+    lin.register_forward_pre_hook(lambda l, inp: calls.append("pre"))
+    lin.register_forward_post_hook(lambda l, inp, out: calls.append("post"))
+    lin(paddle.ones([1, 2]))
+    assert calls == ["pre", "post"]
+
+
+def test_grad_clip_global_norm():
+    p1 = paddle.nn.Parameter(np.array([3.0, 4.0], np.float32))
+    p1.grad = paddle.to_tensor([3.0, 4.0])
+    clip = paddle.nn.ClipGradByGlobalNorm(1.0)
+    [(_, g)] = clip([(p1, p1.grad)])
+    np.testing.assert_allclose(np.linalg.norm(g.numpy()), 1.0, rtol=1e-5)
+
+
+def test_initializers():
+    from paddle_tpu.nn.initializer import (Constant, Normal, XavierUniform,
+                                           KaimingNormal, Orthogonal)
+    assert float(Constant(3.0)((2, 2), "float32").sum()) == 12
+    w = XavierUniform()((100, 100), "float32")
+    limit = np.sqrt(6.0 / 200)
+    assert float(abs(np.asarray(w)).max()) <= limit + 1e-6
+    q = np.asarray(Orthogonal()((4, 4), "float32"))
+    np.testing.assert_allclose(q @ q.T, np.eye(4), atol=1e-5)

@@ -1,0 +1,241 @@
+"""Op numeric + gradient checks (model: reference test/legacy_test/
+test_*_op.py via the OpTest harness)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+RNG = np.random.RandomState(7)
+
+
+def _f(*shape):
+    return RNG.randn(*shape).astype(np.float32)
+
+
+class TestElementwise:
+    @pytest.mark.parametrize("op,ref", [
+        (paddle.add, np.add), (paddle.subtract, np.subtract),
+        (paddle.multiply, np.multiply), (paddle.divide, np.divide),
+        (paddle.maximum, np.maximum), (paddle.minimum, np.minimum),
+    ])
+    def test_binary(self, op, ref):
+        check_output(op, ref, [_f(3, 4), _f(3, 4) + 2.0])
+
+    def test_broadcast(self):
+        check_output(paddle.add, np.add, [_f(3, 4), _f(4)])
+        check_output(paddle.multiply, np.multiply, [_f(2, 1, 4), _f(3, 1)])
+
+    @pytest.mark.parametrize("op,ref", [
+        (paddle.exp, np.exp), (paddle.log, lambda x: np.log(np.abs(x) + 1)),
+        (paddle.tanh, np.tanh), (paddle.abs, np.abs),
+        (paddle.floor, np.floor), (paddle.ceil, np.ceil),
+    ])
+    def test_unary(self, op, ref):
+        x = np.abs(_f(3, 4)) + 1
+        if op is paddle.log:
+            check_output(paddle.log, np.log, [x])
+        else:
+            check_output(op, ref, [x])
+
+    def test_grads(self):
+        check_grad(paddle.multiply, [_f(3, 3), _f(3, 3)], 0)
+        check_grad(paddle.tanh, [_f(3, 3)], 0)
+        check_grad(lambda x: paddle.exp(x), [_f(2, 2)], 0)
+        check_grad(lambda x, y: paddle.divide(x, y),
+                   [_f(3, 3), np.abs(_f(3, 3)) + 1.0], 1)
+
+
+class TestReduce:
+    def test_sum_mean(self):
+        x = _f(3, 4, 5)
+        check_output(lambda t: paddle.sum(t, axis=1),
+                     lambda a: np.sum(a, axis=1), [x])
+        check_output(lambda t: paddle.mean(t, axis=[0, 2], keepdim=True),
+                     lambda a: np.mean(a, axis=(0, 2), keepdims=True), [x])
+
+    def test_max_min_prod(self):
+        x = _f(3, 4)
+        check_output(lambda t: paddle.max(t, axis=0), lambda a: a.max(0), [x])
+        check_output(lambda t: paddle.min(t), lambda a: a.min(), [x])
+        check_output(lambda t: paddle.prod(t, axis=1), lambda a: a.prod(1), [x])
+
+    def test_sum_grad(self):
+        check_grad(lambda x: paddle.sum(x, axis=1), [_f(3, 4)], 0)
+        check_grad(lambda x: paddle.mean(x), [_f(3, 4)], 0)
+
+    def test_cumsum_logsumexp(self):
+        x = _f(3, 4)
+        check_output(lambda t: paddle.cumsum(t, axis=1),
+                     lambda a: np.cumsum(a, axis=1), [x])
+        from scipy.special import logsumexp as sp_lse
+        np.testing.assert_allclose(
+            paddle.logsumexp(paddle.to_tensor(x), axis=1).numpy(),
+            sp_lse(x, axis=1), rtol=1e-5)
+
+
+class TestMatmul:
+    def test_matmul(self):
+        check_output(paddle.matmul, np.matmul, [_f(3, 4), _f(4, 5)])
+        check_output(lambda x, y: paddle.matmul(x, y),
+                     np.matmul, [_f(2, 3, 4), _f(2, 4, 5)])
+
+    def test_matmul_transpose(self):
+        x, y = _f(4, 3), _f(4, 5)
+        np.testing.assert_allclose(
+            paddle.matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                          transpose_x=True).numpy(),
+            x.T @ y, rtol=1e-5)
+
+    def test_matmul_grad(self):
+        check_grad(paddle.matmul, [_f(3, 4), _f(4, 2)], 0)
+        check_grad(paddle.matmul, [_f(3, 4), _f(4, 2)], 1)
+
+    def test_einsum(self):
+        x, y = _f(3, 4), _f(4, 5)
+        check_output(lambda a, b: paddle.einsum("ij,jk->ik", a, b),
+                     lambda a, b: np.einsum("ij,jk->ik", a, b), [x, y])
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = _f(2, 3, 4)
+        check_output(lambda t: paddle.reshape(t, [6, 4]),
+                     lambda a: a.reshape(6, 4), [x])
+        check_output(lambda t: paddle.transpose(t, [2, 0, 1]),
+                     lambda a: a.transpose(2, 0, 1), [x])
+
+    def test_concat_split_stack(self):
+        x, y = _f(2, 3), _f(2, 3)
+        check_output(lambda a, b: paddle.concat([a, b], axis=0),
+                     lambda a, b: np.concatenate([a, b], 0), [x, y])
+        check_output(lambda a, b: paddle.stack([a, b], axis=1),
+                     lambda a, b: np.stack([a, b], 1), [x, y])
+        parts = paddle.split(paddle.to_tensor(_f(6, 4)), 3, axis=0)
+        assert len(parts) == 3 and parts[0].shape == [2, 4]
+        parts = paddle.split(paddle.to_tensor(_f(7, 4)), [2, 2, 3], axis=0)
+        assert [p.shape[0] for p in parts] == [2, 2, 3]
+
+    def test_gather_scatter(self):
+        x = _f(5, 3)
+        idx = np.array([0, 2, 4])
+        check_output(lambda t, i: paddle.gather(t, i),
+                     lambda a, i: a[i], [x, idx])
+        out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                             paddle.to_tensor(np.zeros((3, 3), np.float32)))
+        assert np.allclose(out.numpy()[idx], 0)
+
+    def test_squeeze_tile_flip(self):
+        x = _f(1, 3, 1, 4)
+        assert paddle.squeeze(paddle.to_tensor(x)).shape == [3, 4]
+        assert paddle.squeeze(paddle.to_tensor(x), axis=0).shape == [3, 1, 4]
+        check_output(lambda t: paddle.tile(t, [2, 1]),
+                     lambda a: np.tile(a, [2, 1]), [_f(2, 3)])
+        check_output(lambda t: paddle.flip(t, axis=1),
+                     lambda a: np.flip(a, 1), [_f(2, 3)])
+
+    def test_getitem_setitem_grad(self):
+        x = paddle.to_tensor(_f(4, 4), stop_gradient=False)
+        y = x[1:3, :2]
+        y.sum().backward()
+        g = x.grad.numpy()
+        assert g[1:3, :2].sum() == 4 and g.sum() == 4
+
+    def test_take_along_put_along(self):
+        x = _f(3, 4)
+        idx = RNG.randint(0, 4, (3, 2))
+        check_output(lambda t, i: paddle.take_along_axis(t, i, axis=1),
+                     lambda a, i: np.take_along_axis(a, i, 1), [x, idx])
+
+
+class TestSearchSort:
+    def test_argmax_topk(self):
+        x = _f(3, 5)
+        check_output(lambda t: paddle.argmax(t, axis=1),
+                     lambda a: np.argmax(a, 1), [x])
+        vals, idx = paddle.topk(paddle.to_tensor(x), k=2, axis=1)
+        ref = np.sort(x, axis=1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+
+    def test_sort_where(self):
+        x = _f(4, 4)
+        check_output(lambda t: paddle.sort(t, axis=0),
+                     lambda a: np.sort(a, 0), [x])
+        c = x > 0
+        check_output(lambda t: paddle.where(paddle.to_tensor(c), t, t * 2),
+                     lambda a: np.where(c, a, a * 2), [x])
+
+    def test_nonzero_unique(self):
+        x = np.array([[1, 0], [0, 3]], np.float32)
+        nz = paddle.nonzero(paddle.to_tensor(x))
+        assert nz.numpy().tolist() == [[0, 0], [1, 1]]
+        u = paddle.unique(paddle.to_tensor(np.array([3, 1, 2, 1, 3])))
+        assert u.numpy().tolist() == [1, 2, 3]
+
+
+class TestLinalg:
+    def test_norms(self):
+        x = _f(3, 4)
+        np.testing.assert_allclose(paddle.norm(paddle.to_tensor(x)).numpy(),
+                                   np.linalg.norm(x), rtol=1e-5)
+
+    def test_solve_det(self):
+        a = _f(3, 3) + np.eye(3, dtype=np.float32) * 3
+        b = _f(3, 2)
+        check_output(paddle.linalg.solve, np.linalg.solve, [a, b], rtol=1e-4)
+        np.testing.assert_allclose(paddle.linalg.det(paddle.to_tensor(a)).numpy(),
+                                   np.linalg.det(a), rtol=1e-4)
+
+    def test_svd_qr(self):
+        a = _f(4, 3)
+        u, s, vt = paddle.linalg.svd(paddle.to_tensor(a))
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()) @ vt.numpy(), a, atol=1e-4)
+
+
+class TestLogic:
+    def test_compare(self):
+        x, y = _f(3, 3), _f(3, 3)
+        assert np.array_equal((paddle.to_tensor(x) > paddle.to_tensor(y)).numpy(),
+                              x > y)
+        assert bool(paddle.allclose(paddle.to_tensor(x), paddle.to_tensor(x)))
+
+    def test_logical(self):
+        a = np.array([True, False, True])
+        b = np.array([True, True, False])
+        assert np.array_equal(
+            paddle.logical_and(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            a & b)
+
+
+class TestCreation:
+    def test_basic(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([2], dtype="int64").dtype == paddle.int64
+        assert paddle.full([2, 2], 7.0).numpy().tolist() == [[7, 7], [7, 7]]
+        assert paddle.arange(0, 10, 2).numpy().tolist() == [0, 2, 4, 6, 8]
+        assert np.allclose(paddle.eye(3).numpy(), np.eye(3))
+        t = paddle.tril(paddle.to_tensor(np.ones((3, 3), np.float32)))
+        assert t.numpy()[0, 2] == 0 and t.numpy()[2, 0] == 1
+
+    def test_dtype_inference(self):
+        assert paddle.to_tensor(1).dtype == paddle.int64
+        assert paddle.to_tensor(1.5).dtype == paddle.float32
+        assert paddle.to_tensor(True).dtype == paddle.bool_
+
+
+class TestRandom:
+    def test_seed_determinism(self):
+        paddle.seed(123)
+        a = paddle.randn([4, 4]).numpy()
+        paddle.seed(123)
+        b = paddle.randn([4, 4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_shapes_ranges(self):
+        r = paddle.rand([100])
+        assert 0 <= float(r.min()) and float(r.max()) < 1
+        ri = paddle.randint(0, 5, [100])
+        assert int(ri.min()) >= 0 and int(ri.max()) < 5
+        p = paddle.randperm(10)
+        assert sorted(p.numpy().tolist()) == list(range(10))

@@ -1,0 +1,158 @@
+"""Optimizer + LR scheduler + AMP tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RNG = np.random.RandomState(11)
+
+
+def _quad_problem(opt_factory, steps=60):
+    w = paddle.nn.Parameter(np.array([5.0, -3.0], np.float32))
+    opt = opt_factory([w])
+    for _ in range(steps):
+        loss = (w * w).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return np.abs(w.numpy()).max()
+
+
+@pytest.mark.parametrize("factory", [
+    lambda ps: paddle.optimizer.SGD(0.1, parameters=ps),
+    lambda ps: paddle.optimizer.Momentum(0.05, 0.9, parameters=ps),
+    lambda ps: paddle.optimizer.Adam(0.3, parameters=ps),
+    lambda ps: paddle.optimizer.AdamW(0.3, parameters=ps),
+    lambda ps: paddle.optimizer.RMSProp(0.1, parameters=ps),
+    lambda ps: paddle.optimizer.Adagrad(0.5, parameters=ps),
+    lambda ps: paddle.optimizer.Lamb(0.1, parameters=ps),
+])
+def test_optimizers_converge(factory):
+    assert _quad_problem(factory) < 0.5
+
+
+def test_adam_matches_reference_formula():
+    w = paddle.nn.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.Adam(learning_rate=0.1, beta1=0.9, beta2=0.999,
+                                epsilon=1e-8, parameters=[w])
+    w.grad = paddle.to_tensor([0.5])
+    opt.step()
+    # manual: m=0.05, v=2.5e-4*... bias-corrected step
+    m = 0.1 * 0.5
+    v = 0.001 * 0.25
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    ref = 1.0 - 0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(w.numpy(), [ref], rtol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    w = paddle.nn.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.5,
+                                 parameters=[w])
+    w.grad = paddle.to_tensor([0.0])
+    opt.step()
+    # grad=0: only decay applies: w *= (1 - lr*wd)
+    np.testing.assert_allclose(w.numpy(), [1.0 * (1 - 0.05)], rtol=1e-5)
+
+
+def test_apply_decay_param_fun():
+    w = paddle.nn.Parameter(np.array([1.0], np.float32), name="layer.bias")
+    opt = paddle.optimizer.AdamW(
+        learning_rate=0.1, weight_decay=0.5, parameters=[w],
+        apply_decay_param_fun=lambda n: "bias" not in n)
+    w.grad = paddle.to_tensor([0.0])
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [1.0], rtol=1e-6)
+
+
+def test_grad_clip_in_optimizer():
+    w = paddle.nn.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.SGD(1.0, parameters=[w],
+                               grad_clip=paddle.nn.ClipGradByGlobalNorm(0.1))
+    w.grad = paddle.to_tensor([100.0])
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [0.9], rtol=1e-4)
+
+
+def test_lr_schedulers():
+    lr = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    vals = []
+    for _ in range(5):
+        vals.append(lr())
+        lr.step()
+    np.testing.assert_allclose(vals, [0.1, 0.1, 0.05, 0.05, 0.025], rtol=1e-6)
+
+    warm = paddle.optimizer.lr.LinearWarmup(0.1, warmup_steps=10,
+                                            start_lr=0.0, end_lr=0.1)
+    assert warm() < 0.02
+    for _ in range(12):
+        warm.step()
+    np.testing.assert_allclose(warm(), 0.1, rtol=1e-6)
+
+    cos = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+    for _ in range(10):
+        cos.step()
+    assert cos() < 0.01
+
+
+def test_scheduler_drives_optimizer():
+    sched = paddle.optimizer.lr.StepDecay(0.5, step_size=1, gamma=0.1)
+    w = paddle.nn.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.SGD(sched, parameters=[w])
+    w.grad = paddle.to_tensor([1.0])
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [0.5], rtol=1e-5)
+    sched.step()
+    w.grad = paddle.to_tensor([1.0])
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [0.45], rtol=1e-5)
+
+
+def test_optimizer_state_dict_roundtrip():
+    w = paddle.nn.Parameter(np.array([1.0, 2.0], np.float32), name="w")
+    opt = paddle.optimizer.Adam(0.1, parameters=[w])
+    w.grad = paddle.to_tensor([0.1, 0.1])
+    opt.step()
+    sd = opt.state_dict()
+    opt2 = paddle.optimizer.Adam(0.1, parameters=[w])
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+    np.testing.assert_allclose(
+        opt2._state_for(w)["moment1"], opt._state_for(w)["moment1"])
+
+
+def test_amp_autocast_bf16():
+    import jax.numpy as jnp
+    x = paddle.randn([4, 4])
+    y = paddle.randn([4, 4])
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        z = paddle.matmul(x, y)
+        assert z.dtype == jnp.bfloat16
+        s = paddle.exp(x)   # black list: stays f32
+        assert s.dtype == jnp.float32
+    z = paddle.matmul(x, y)
+    assert z.dtype == jnp.float32
+
+
+def test_amp_grad_scaler_bf16_passthrough():
+    scaler = paddle.amp.GradScaler()
+    w = paddle.nn.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.SGD(0.1, parameters=[w])
+    loss = (w * 2).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    np.testing.assert_allclose(w.numpy(), [0.8], rtol=1e-5)
+
+
+def test_multi_precision_master_weights():
+    import jax.numpy as jnp
+    w = paddle.nn.Parameter(np.array([1.0], np.float32))
+    w._data = w._data.astype(jnp.bfloat16)
+    opt = paddle.optimizer.AdamW(0.001, parameters=[w], multi_precision=True)
+    for _ in range(3):
+        w.grad = paddle.to_tensor(np.array([0.3], np.float32), dtype="bfloat16")
+        opt.step()
+    assert w.dtype == jnp.bfloat16
+    assert id(w) in opt._master_weights
