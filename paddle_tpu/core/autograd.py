@@ -231,7 +231,8 @@ def _run_backward(
             if t._node is not None:
                 key = (id(t._node), t._out_idx)
                 node_cts[key] = _accum(node_cts.get(key), g)
-            elif accumulate_leaf and not t.stop_gradient and target_ids is None:
+            elif accumulate_leaf and not t.stop_gradient and \
+                    (target_ids is None or id(t) not in target_ids):
                 t._accumulate_grad(g)
     return target_grads
 

@@ -29,7 +29,7 @@ __all__ = ["Tensor", "to_tensor"]
 class Tensor:
     __slots__ = (
         "_data", "stop_gradient", "_grad", "_node", "_out_idx", "_hooks",
-        "name", "persistable", "trainable", "__weakref__",
+        "name", "persistable", "trainable", "dist_attr", "__weakref__",
     )
 
     def __init__(self, data, dtype=None, stop_gradient: bool = True,
@@ -57,6 +57,7 @@ class Tensor:
         self.name = name or ""
         self.persistable = False
         self.trainable = not stop_gradient
+        self.dist_attr = None  # set by dist.shard_tensor / reshard
 
     # -- basic metadata ----------------------------------------------------
     @property
@@ -292,7 +293,7 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tenso
 
 # -- pytree registration: Tensors flow through jit/grad/shard_map ----------
 def _tensor_flatten(t: Tensor):
-    return (t._data,), (t.stop_gradient, t.name)
+    return (t._data,), (t.stop_gradient, t.name, t.dist_attr)
 
 
 def _tensor_unflatten(aux, children):
@@ -306,6 +307,7 @@ def _tensor_unflatten(aux, children):
     t.name = aux[1]
     t.persistable = False
     t.trainable = not aux[0]
+    t.dist_attr = aux[2]
     return t
 
 

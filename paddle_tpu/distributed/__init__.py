@@ -1,0 +1,20 @@
+"""paddle_tpu.distributed (parity: python/paddle/distributed/)."""
+from .process_mesh import (ProcessMesh, Shard, Replicate, Partial,  # noqa: F401
+                           Placement, get_mesh, set_mesh, init_mesh)
+from .auto_parallel.api import (shard_tensor, reshard, shard_layer,  # noqa: F401
+                                shard_optimizer, dtensor_from_fn,
+                                unshard_dtensor, local_value, DistAttr,
+                                ShardingStage0, ShardingStage1,
+                                ShardingStage2, ShardingStage3)
+from .communication import (Group, new_group, get_group, all_reduce,  # noqa: F401
+                            all_gather, all_gather_object, all_to_all,
+                            all_to_all_single, reduce_scatter, broadcast,
+                            reduce, scatter, gather, send, recv, isend,
+                            irecv, barrier, ReduceOp, stream, P2POp,
+                            batch_isend_irecv, wait, destroy_process_group)
+from .parallel import (init_parallel_env, get_rank, get_world_size,  # noqa: F401
+                       ParallelEnv, is_initialized, DataParallel)
+from . import fleet  # noqa: F401
+
+alltoall = all_to_all
+alltoall_single = all_to_all_single

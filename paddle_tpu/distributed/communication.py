@@ -1,0 +1,427 @@
+"""Collective communication API.
+
+Capability parity with the reference's communication stack
+(reference: python/paddle/distributed/communication/ over
+paddle/fluid/distributed/collective/process_group_nccl.cc and
+paddle/phi/core/distributed/nccl_comm_context.h). TPU-native design
+(SURVEY.md §5.8): there is no runtime comm library — collectives are XLA
+ops compiled into the program. The same Python API surface is kept:
+
+* Inside a ``shard_map`` region (rank-local code, the exact analog of the
+  reference's per-rank dygraph code), each function lowers to the
+  corresponding ``jax.lax`` collective over the group's mesh axis, and XLA
+  schedules it on ICI.
+* Outside, on dist tensors (global arrays), all_reduce/all_gather/... are
+  reshard transitions (auto_parallel/api.py).
+
+Groups are mesh-axis-aligned: a Group names one axis of the active device
+mesh (how the reference's ring ids map to topology axes; see
+fleet/base/topology.py). TCPStore/rendezvous has no in-program analog —
+host-side coordination lives in distributed/launch.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor
+
+__all__ = ["Group", "new_group", "get_group", "all_reduce", "all_gather",
+           "all_gather_object", "all_to_all", "all_to_all_single",
+           "reduce_scatter", "broadcast", "reduce", "scatter", "gather",
+           "send", "recv", "isend", "irecv", "barrier", "ReduceOp",
+           "stream", "P2POp", "batch_isend_irecv", "wait",
+           "destroy_process_group"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = one mesh axis (or the world axis)."""
+
+    _next_id = 0
+
+    def __init__(self, axis_name: Optional[str], ranks: Sequence[int],
+                 mesh=None):
+        self.axis_name = axis_name
+        self.ranks = list(ranks)
+        self.nranks = len(self.ranks)
+        self.mesh = mesh
+        self.id = Group._next_id
+        Group._next_id += 1
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self):
+        from .parallel import get_rank
+        r = get_rank()
+        return self.ranks.index(r) if r in self.ranks else -1
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis_name}, ranks={self.ranks})"
+
+
+_GROUPS = {}
+_DEFAULT_GROUP: List[Optional[Group]] = [None]
+
+
+def _world_group() -> Group:
+    if _DEFAULT_GROUP[0] is None:
+        from .parallel import init_parallel_env
+        init_parallel_env()
+    return _DEFAULT_GROUP[0]
+
+
+def _set_world_group(g: Group):
+    _DEFAULT_GROUP[0] = g
+    _GROUPS[g.id] = g
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None) -> Group:
+    """Create a group (parity: paddle.distributed.new_group). Groups must be
+    axis-aligned with the active mesh; ``axis_name`` binds one (the fleet
+    topology passes it; plain rank lists get a private axis over the world
+    mesh when they cover it)."""
+    world = _world_group()
+    if ranks is None:
+        ranks = list(world.ranks)
+    g = Group(axis_name or world.axis_name if list(ranks) == list(world.ranks)
+              else axis_name, list(ranks), mesh=world.mesh)
+    _GROUPS[g.id] = g
+    return g
+
+
+def get_group(gid: int) -> Optional[Group]:
+    return _GROUPS.get(gid)
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _GROUPS.clear()
+        _DEFAULT_GROUP[0] = None
+    else:
+        _GROUPS.pop(group.id, None)
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _axis(group: Optional[Group]) -> str:
+    g = group or _world_group()
+    if g.axis_name is None:
+        raise ValueError(
+            "group is not bound to a mesh axis; collectives inside shard_map "
+            "need an axis-aligned group")
+    return g.axis_name
+
+
+def _unwrap(t):
+    return t._data if isinstance(t, Tensor) else t
+
+
+def _rewrap(tensor, arr):
+    if isinstance(tensor, Tensor):
+        tensor._data = arr
+        return tensor
+    return Tensor(arr)
+
+
+def _reduce_impl(arr, op, axis_name):
+    if op in (ReduceOp.SUM, "sum"):
+        return jax.lax.psum(arr, axis_name)
+    if op in (ReduceOp.MAX, "max"):
+        return jax.lax.pmax(arr, axis_name)
+    if op in (ReduceOp.MIN, "min"):
+        return jax.lax.pmin(arr, axis_name)
+    if op in (ReduceOp.AVG, "avg"):
+        return jax.lax.pmean(arr, axis_name)
+    if op in (ReduceOp.PROD, "prod"):
+        # gather-then-prod: exact for negatives and zeros (PROD is rare
+        # enough that the extra bandwidth beats a sign/abs decomposition)
+        g = jax.lax.all_gather(arr, axis_name, axis=0)
+        return jnp.prod(g, axis=0)
+    raise ValueError(f"unknown reduce op {op}")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """All-reduce (parity: paddle.distributed.all_reduce; reference
+    process_group_nccl.cc:228 AllReduce). In-place on the Tensor wrapper."""
+    arr = _unwrap(tensor)
+    if _is_tracer(arr):
+        return _rewrap(tensor, _reduce_impl(arr, op, _axis(group)))
+    if isinstance(tensor, Tensor) and tensor.dist_attr is not None:
+        from .auto_parallel.api import reshard
+        from .process_mesh import Replicate
+        attr = tensor.dist_attr
+        out = reshard(tensor, attr.process_mesh,
+                      [Replicate()] * attr.process_mesh.ndim)
+        tensor._data = out._data
+        tensor.dist_attr = out.dist_attr
+        return tensor
+    return tensor  # replicated single-controller value: already reduced
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    """All-gather into ``tensor_list`` (parity: dist.all_gather)."""
+    arr = _unwrap(tensor)
+    g = group or _world_group()
+    if _is_tracer(arr):
+        gathered = jax.lax.all_gather(arr, _axis(group), axis=0)
+        if isinstance(tensor_list, list):
+            tensor_list.clear()
+            for i in range(gathered.shape[0]):
+                tensor_list.append(Tensor(gathered[i]))
+            return tensor_list
+        return Tensor(gathered)
+    # global-array mode: every "rank" holds the same value
+    if isinstance(tensor_list, list):
+        tensor_list.clear()
+        for _ in range(g.nranks):
+            tensor_list.append(Tensor(arr))
+        return tensor_list
+    return Tensor(jnp.stack([arr] * g.nranks))
+
+
+def all_gather_object(object_list, obj, group=None):
+    g = group or _world_group()
+    object_list.clear()
+    object_list.extend([obj] * g.nranks)
+    return object_list
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """Reduce-scatter (parity: dist.reduce_scatter)."""
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        arr = jnp.concatenate([_unwrap(t) for t in src], axis=0)
+    else:
+        arr = _unwrap(src)
+    if _is_tracer(arr):
+        out = jax.lax.psum_scatter(arr, _axis(group), scatter_dimension=0,
+                                   tiled=True)
+        return _rewrap(tensor, out)
+    g = group or _world_group()
+    n = g.nranks
+    chunk = arr.shape[0] // n
+    idx = g.rank if g.rank >= 0 else 0
+    return _rewrap(tensor, arr[idx * chunk:(idx + 1) * chunk] * 1)
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """All-to-all (parity: dist.alltoall; the MoE dispatch primitive,
+    reference global_scatter/global_gather ops)."""
+    arrs = [_unwrap(t) for t in in_tensor_list]
+    if arrs and _is_tracer(arrs[0]):
+        stacked = jnp.stack(arrs, axis=0)  # [n, ...]
+        out = jax.lax.all_to_all(stacked, _axis(group), split_axis=0,
+                                 concat_axis=0, tiled=False)
+        res = [Tensor(out[i]) for i in range(out.shape[0])]
+    else:
+        res = [Tensor(a) for a in arrs]
+    if isinstance(out_tensor_list, list):
+        out_tensor_list.clear()
+        out_tensor_list.extend(res)
+        return out_tensor_list
+    return res
+
+
+def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None,
+                      out_split_sizes=None, group=None, sync_op=True):
+    arr = _unwrap(in_tensor)
+    if _is_tracer(arr):
+        out = jax.lax.all_to_all(arr, _axis(group), split_axis=0,
+                                 concat_axis=0, tiled=True)
+        return _rewrap(out_tensor, out)
+    return _rewrap(out_tensor, arr)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Broadcast from src rank (parity: dist.broadcast). Inside shard_map:
+    every rank takes rank-src's value via an index-select all_gather."""
+    arr = _unwrap(tensor)
+    if _is_tracer(arr):
+        g = group or _world_group()
+        src_in_group = g.get_group_rank(src) if g.ranks else src
+        gathered = jax.lax.all_gather(arr, _axis(group), axis=0)
+        return _rewrap(tensor, gathered[src_in_group])
+    return tensor  # replicated global value: broadcast is identity
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    arr = _unwrap(tensor)
+    if _is_tracer(arr):
+        out = _reduce_impl(arr, op, _axis(group))
+        # non-dst ranks keep their input (reference Reduce semantics)
+        g = group or _world_group()
+        idx = jax.lax.axis_index(_axis(group))
+        dst_in_group = g.get_group_rank(dst) if g.ranks else dst
+        return _rewrap(tensor, jnp.where(idx == dst_in_group, out, arr))
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list is not None:
+        arrs = [_unwrap(t) for t in tensor_list]
+        if arrs and _is_tracer(_unwrap(tensor)):
+            stacked = jnp.stack(arrs, 0)
+            idx = jax.lax.axis_index(_axis(group))
+            return _rewrap(tensor, jnp.take(stacked, idx, axis=0))
+        g = group or _world_group()
+        idx = max(g.rank, 0)
+        return _rewrap(tensor, arrs[idx])
+    return tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    arr = _unwrap(tensor)
+    if _is_tracer(arr):
+        gathered = jax.lax.all_gather(arr, _axis(group), axis=0)
+        if gather_list is not None:
+            gather_list.clear()
+            for i in range(gathered.shape[0]):
+                gather_list.append(Tensor(gathered[i]))
+        return gather_list
+    g = group or _world_group()
+    if gather_list is not None:
+        gather_list.clear()
+        gather_list.extend([Tensor(arr)] * g.nranks)
+    return gather_list
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P send (parity: dist.send). Inside shard_map this is a ppermute
+    shift — the reference's batched isend/irecv pipeline pattern maps to a
+    single collective_permute on ICI (see fleet/meta_parallel p2p)."""
+    arr = _unwrap(tensor)
+    if _is_tracer(arr):
+        g = group or _world_group()
+        src = g.rank if g.rank >= 0 else 0
+        n = g.nranks
+        out = jax.lax.ppermute(arr, _axis(group),
+                               perm=[(i, (i + (dst - src)) % n)
+                                     for i in range(n)])
+        _P2P_BUF.append(out)
+        return tensor
+    return tensor
+
+
+# FIFO queue pairing in-trace send()s with the following recv()s; unmatched
+# entries from an aborted trace are discarded when a stale tracer is seen
+from collections import deque  # noqa: E402
+
+_P2P_BUF: "deque" = deque()
+
+
+def _pop_live_p2p(current):
+    """Pop the oldest buffered send from the SAME trace as ``current``;
+    discard leftovers from earlier (aborted) traces."""
+    cur_trace = getattr(current, "_trace", None)
+    while _P2P_BUF:
+        cand = _P2P_BUF.popleft()
+        if getattr(cand, "_trace", None) is cur_trace:
+            return cand
+    return None
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    arr = _unwrap(tensor)
+    if _is_tracer(arr):
+        buffered = _pop_live_p2p(arr)
+        if buffered is not None:
+            return _rewrap(tensor, buffered)
+        g = group or _world_group()
+        dstr = g.rank if g.rank >= 0 else 0
+        n = g.nranks
+        out = jax.lax.ppermute(arr, _axis(group),
+                               perm=[(i, (i - (src - dstr)) % n)
+                                     for i in range(n)])
+        return _rewrap(tensor, out)
+    return tensor
+
+
+def isend(tensor, dst=0, group=None):
+    send(tensor, dst, group)
+    return _Task()
+
+
+def irecv(tensor, src=0, group=None):
+    recv(tensor, src, group)
+    return _Task()
+
+
+class _Task:
+    """Async-task shim (parity: ProcessGroup::Task). XLA programs are
+    async by construction — wait() is dispatch-order sync."""
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    tasks = []
+    for op in p2p_op_list:
+        tasks.append(op.op(op.tensor, op.peer, op.group))
+    return [t if isinstance(t, _Task) else _Task() for t in tasks]
+
+
+def barrier(group=None):
+    """Host barrier (parity: dist.barrier). Single-controller: device sync."""
+    try:
+        (jnp.zeros(()) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    arr = _unwrap(tensor)
+    if not _is_tracer(arr):
+        jax.block_until_ready(arr)
+    return tensor
+
+
+class stream:
+    """paddle.distributed.stream.* parity namespace: the *_on_calc_stream
+    variants are identical under XLA's single ordered program."""
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    all_to_all = staticmethod(all_to_all)
+    alltoall = staticmethod(all_to_all)
+    reduce_scatter = staticmethod(reduce_scatter)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

@@ -1,0 +1,10 @@
+"""paddle_tpu.distributed.fleet (parity: python/paddle/distributed/fleet/)."""
+from .fleet import (DistributedStrategy, Fleet, fleet, init,  # noqa: F401
+                    distributed_model, distributed_optimizer,
+                    get_hybrid_communicate_group)
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from . import utils  # noqa: F401
+from .meta_parallel import (PipelineLayer, LayerDesc, SharedLayerDesc,  # noqa: F401
+                            get_rng_state_tracker)
+from .recompute import recompute, recompute_sequential  # noqa: F401

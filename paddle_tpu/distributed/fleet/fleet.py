@@ -1,0 +1,173 @@
+"""fleet: the manual hybrid-parallel front end.
+
+Capability parity with the reference (reference: python/paddle/distributed/
+fleet/fleet.py:167 init, :603 _init_hybrid_parallel_env; model.py:141-176
+distributed_model; DistributedStrategy at
+fleet/base/distributed_strategy.py:175).
+
+TPU-native: fleet.init builds the 5-axis hybrid device mesh
+[data, pipe, sharding, sep, model] as ONE jax Mesh; distributed_model wraps
+by parallel mode (TP layers already carry shardings; PP wraps with the
+pipeline engine); distributed_optimizer wraps with HybridParallelOptimizer.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..communication import Group, _set_world_group
+from ..parallel import DataParallel, init_parallel_env
+from .topology import CommunicateTopology, HybridCommunicateGroup
+
+__all__ = ["DistributedStrategy", "Fleet", "fleet", "init",
+           "distributed_model", "distributed_optimizer", "get_hybrid_communicate_group"]
+
+
+class DistributedStrategy:
+    """Hierarchical strategy config (parity: fleet.DistributedStrategy —
+    the protobuf-backed config; plain attrs here)."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+            "order": ["dp", "pp", "sharding", "sep", "mp"],
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1}
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.tensor_parallel_configs = {}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._topology: Optional[CommunicateTopology] = None
+        self._is_initialized = False
+
+    # -- init --------------------------------------------------------------
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        import jax
+        init_parallel_env()
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        dp, mp = hc.get("dp_degree", 1), hc.get("mp_degree", 1)
+        pp = hc.get("pp_degree", 1)
+        shd = hc.get("sharding_degree", 1)
+        sep = hc.get("sep_degree", 1)
+        total = dp * mp * pp * shd * sep
+        ndev = jax.device_count()
+        if total == 1:
+            dp = ndev  # pure DP over all devices by default
+            total = ndev
+        if total != ndev:
+            # allow smaller logical topologies on more devices by padding dp
+            if ndev % total == 0:
+                dp *= ndev // total
+                total = ndev
+            else:
+                raise ValueError(
+                    f"hybrid degrees product {total} != device count {ndev}")
+        self._topology = CommunicateTopology(
+            ["data", "pipe", "sharding", "sep", "model"],
+            [dp, pp, shd, sep, mp])
+        self._hcg = HybridCommunicateGroup(self._topology)
+        self._is_initialized = True
+        # seed the model-parallel RNG tracker (reference mpu/random.py)
+        from ...core.random import model_parallel_random_seed
+        model_parallel_random_seed(seed=int(os.environ.get("FLAGS_seed", "1024")))
+        return self
+
+    def is_first_worker(self):
+        return True
+
+    def worker_index(self):
+        from ..parallel import get_rank
+        return get_rank()
+
+    def worker_num(self):
+        from ..parallel import get_world_size
+        return get_world_size()
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    # -- wrapping ----------------------------------------------------------
+    def distributed_model(self, model):
+        """Wrap by parallel mode (parity: fleet/model.py:141-176)."""
+        if self._hcg is None:
+            self.init()
+        hc = self._strategy.hybrid_configs if self._strategy else {}
+        if self._hcg.get_pipe_parallel_world_size() > 1:
+            from .meta_parallel.pipeline_parallel import PipelineParallel
+            return PipelineParallel(model, self._hcg, self._strategy)
+        if self._hcg.get_model_parallel_world_size() > 1:
+            from .meta_parallel.tensor_parallel import TensorParallel
+            return TensorParallel(model, self._hcg, self._strategy)
+        return DataParallel(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from .meta_optimizers.hybrid_parallel_optimizer import \
+            HybridParallelOptimizer
+        if self._hcg is None:
+            self.init()
+        return HybridParallelOptimizer(optimizer, self._hcg,
+                                       self._strategy or DistributedStrategy())
+
+    # -- io passthroughs ---------------------------------------------------
+    def save_persistables(self, *args, **kwargs):
+        pass
+
+    def barrier_worker(self):
+        from ..communication import barrier
+        barrier()
+
+    def stop_worker(self):
+        pass
+
+
+fleet = Fleet()
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    return fleet.init(role_maker, is_collective, strategy, log_level)
+
+
+def distributed_model(model):
+    return fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def get_hybrid_communicate_group():
+    return fleet.get_hybrid_communicate_group()

@@ -1,0 +1,6 @@
+from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,  # noqa: F401
+                        RowParallelLinear, ParallelCrossEntropy)
+from . import mp_ops  # noqa: F401
+from ....parallel import get_rank  # noqa: F401
+from .....core.random import (RNGStatesTracker, get_rng_state_tracker,  # noqa: F401
+                              model_parallel_random_seed)

@@ -1,0 +1,158 @@
+"""Model-parallel comm primitives (reference: fleet/layers/mpu/mp_ops.py —
+_c_identity:83, _c_concat:126, _c_split:188, _mp_allreduce:285, split:700).
+
+Two faces, same semantics:
+* GSPMD face (global arrays): each primitive is a sharding-constraint
+  move whose vjp is the dual collective (identity fwd / allreduce bwd, etc.).
+* shard_map face (rank-local tracers): lax collectives directly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .....core.dispatch import run_op
+from .....core.tensor import Tensor
+
+__all__ = ["_c_identity", "_c_concat", "_c_split", "_mp_allreduce",
+           "_c_lookup_table", "_c_softmax_with_cross_entropy", "split"]
+
+
+def _axis_of(group):
+    return group.axis_name if group is not None and group.axis_name else "model"
+
+
+def _mesh():
+    from ...fleet import fleet
+    hcg = fleet.get_hybrid_communicate_group()
+    return hcg.topology.mesh.to_jax() if hcg else None
+
+
+def _c_identity(tensor, group=None, skip_c_identity_dynamic=False):
+    """Identity forward / all-reduce backward over the mp axis."""
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    ax = _axis_of(group)
+    if isinstance(arr, jax.core.Tracer) and not hasattr(arr, "sharding"):
+        # shard_map face: custom vjp
+        @jax.custom_vjp
+        def ident(a):
+            return a
+
+        def fwd(a):
+            return a, None
+
+        def bwd(_, g):
+            return (jax.lax.psum(g, ax),)
+        ident.defvjp(fwd, bwd)
+        return run_op("c_identity", ident, (tensor,))
+    # GSPMD face: replicated constraint (its grad is psum'd automatically)
+    m = _mesh()
+    if m is None:
+        return tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+
+    def fn(a):
+        sh = NamedSharding(m, P(*(None,) * a.ndim))
+        if isinstance(a, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(a, sh)
+        return jax.device_put(a, sh)
+    return run_op("c_identity", fn, (tensor,))
+
+
+def _mp_allreduce(tensor, op=None, group=None, use_calc_stream=True,
+                  use_model_parallel=True, skip_c_identity_dynamic=False):
+    """All-reduce forward / identity backward (dual of _c_identity)."""
+    ax = _axis_of(group)
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    if isinstance(arr, jax.core.Tracer) and not hasattr(arr, "sharding"):
+        @jax.custom_vjp
+        def ar(a):
+            return jax.lax.psum(a, ax)
+
+        def fwd(a):
+            return jax.lax.psum(a, ax), None
+
+        def bwd(_, g):
+            return (g,)
+        ar.defvjp(fwd, bwd)
+        return run_op("mp_allreduce", ar, (tensor,))
+    m = _mesh()
+    if m is None:
+        return tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+
+    def fn(a):
+        sh = NamedSharding(m, P(*(None,) * a.ndim))
+        if isinstance(a, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(a, sh)
+        return jax.device_put(a, sh)
+    return run_op("mp_allreduce", fn, (tensor,))
+
+
+def _c_concat(tensor, group=None):
+    """Gather last-dim shards and concat (reference _c_concat): replicate
+    the last dim via constraint."""
+    m = _mesh()
+    ax = _axis_of(group)
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    if isinstance(arr, jax.core.Tracer) and not hasattr(arr, "sharding"):
+        def fn(a):
+            g = jax.lax.all_gather(a, ax, axis=0)
+            return jnp.concatenate([g[i] for i in range(g.shape[0])], axis=-1)
+        return run_op("c_concat", fn, (tensor,))
+
+    def fn(a):
+        sh = NamedSharding(m, P(*(None,) * a.ndim))
+        if isinstance(a, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(a, sh)
+        return jax.device_put(a, sh)
+    return run_op("c_concat", fn, (tensor,))
+
+
+def _c_split(tensor, group=None):
+    """Split last dim across the mp axis (reference _c_split)."""
+    m = _mesh()
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+
+    def fn(a):
+        sh = NamedSharding(m, P(*((None,) * (a.ndim - 1) + ("model",))))
+        if isinstance(a, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(a, sh)
+        return jax.device_put(a, sh)
+    return run_op("c_split", fn, (tensor,))
+
+
+def _c_lookup_table(table, index, start_index=0, name=None):
+    from .....nn import functional as F
+    return F.embedding(index, table)
+
+
+def _c_softmax_with_cross_entropy(logits, label, group=None,
+                                  return_softmax=False):
+    from .....nn import functional as F
+    loss = F.softmax_with_cross_entropy(logits, label,
+                                        return_softmax=return_softmax)
+    return loss
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Static-graph style model-parallel split API (reference mp_ops.py:700):
+    builds the corresponding parallel layer on the fly."""
+    from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                            VocabParallelEmbedding)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    if operation == "linear":
+        if axis == 0:
+            layer = RowParallelLinear(size[0], size[1],
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    raise ValueError(f"unknown operation {operation}")

@@ -1,0 +1,5 @@
+from .hybrid_parallel_optimizer import (HybridParallelOptimizer,  # noqa: F401
+                                        HybridParallelClipGrad,
+                                        HybridParallelGradScaler,
+                                        DygraphShardingOptimizer,
+                                        DygraphShardingOptimizerV2)

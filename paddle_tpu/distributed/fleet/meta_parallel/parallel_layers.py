@@ -1,0 +1,163 @@
+"""Pipeline layer partitioning + MP RNG tracker.
+
+Capability parity with the reference (reference: fleet/meta_parallel/
+parallel_layers/pp_layers.py — LayerDesc:56, SharedLayerDesc:76,
+PipelineLayer:237; random.py RNGStatesTracker).
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Sequence, Union
+
+from ....core.random import RNGStatesTracker, get_rng_state_tracker  # noqa: F401
+from ....nn.layer.layers import Layer
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
+           "RNGStatesTracker", "get_rng_state_tracker"]
+
+
+class LayerDesc:
+    """Lazy layer spec so stages only build their own layers
+    (parity: pp_layers.py:56)."""
+
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Layer shared between stages — e.g. tied embeddings
+    (parity: pp_layers.py:76). Single-controller SPMD note: the shared
+    parameter is one global array, so the reference's
+    allreduce_shared_weight_gradients over the pp group is automatic."""
+
+    def __init__(self, key, layer_cls, *inputs, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Partition N layer descs into M stages (parity: pp_layers.py
+    SegmentLayers): uniform by count, or by named-layer boundaries
+    (seg_method='layer:DecoderLayer')."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.descs = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self) -> List[int]:
+        n = len(self.descs)
+        if self.method.startswith("layer:"):
+            name = self.method.split(":", 1)[1]
+            marks = [i for i, d in enumerate(self.descs)
+                     if self._name_of(d) == name]
+            if len(marks) >= self.num_parts:
+                # distribute marked layers evenly across stages
+                per = len(marks) // self.num_parts
+                rem = len(marks) % self.num_parts
+                bounds = [0]
+                idx = 0
+                for s in range(self.num_parts):
+                    take = per + (1 if s < rem else 0)
+                    idx += take
+                    bounds.append(marks[idx - 1] + 1 if idx > 0 else 0)
+                bounds[-1] = n
+                return bounds
+        per = n // self.num_parts
+        rem = n % self.num_parts
+        bounds = [0]
+        for s in range(self.num_parts):
+            bounds.append(bounds[-1] + per + (1 if s < rem else 0))
+        return bounds
+
+    @staticmethod
+    def _name_of(d):
+        if isinstance(d, LayerDesc):
+            return d.layer_cls.__name__
+        return type(d).__name__
+
+
+class PipelineLayer(Layer):
+    """A model defined as a flat layer list partitioned into pipeline stages
+    (parity: pp_layers.py:237). Single-controller SPMD holds every stage
+    (each on its own sub-mesh on a pod); ``forward`` runs them in order, and
+    the PipelineParallel engine drives the microbatch schedule."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 num_virtual_pipeline_stages=None, **kwargs):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or (
+            topology.get_dim("pipe") if topology else 1)
+        self._recompute_interval = recompute_interval
+        self.descs = list(layers)
+        bounds = SegmentLayers(self.descs, self._num_stages,
+                               seg_method).do_segment()
+        self.segment_parts = bounds
+        self._shared = {}
+        from ....nn.layer.container import LayerList
+        built = []
+        for d in self.descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared:
+                    self._shared[d.layer_name] = (d.build_layer(), d)
+                built.append(self._shared[d.layer_name][0])
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(_FnLayer(d))
+            else:
+                raise TypeError(f"bad pipeline item {d!r}")
+        self.run_function = LayerList(built)
+        self._stage_layer_ranges = [
+            (bounds[i], bounds[i + 1]) for i in range(self._num_stages)]
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def stage_layers(self, stage_id: int):
+        lo, hi = self._stage_layer_ranges[stage_id]
+        return [self.run_function[i] for i in range(lo, hi)]
+
+    def forward_stage(self, x, stage_id: int):
+        """Run one stage's chunk (used by the 1F1B engine). Items that are
+        SharedLayerDesc with a forward_func use it (tied-embedding heads)."""
+        lo, hi = self._stage_layer_ranges[stage_id]
+        for i in range(lo, hi):
+            layer = self.run_function[i]
+            desc = self.descs[i]
+            if isinstance(desc, SharedLayerDesc) and desc.forward_func is not None:
+                x = desc.forward_func(layer, x)
+            else:
+                x = layer(x)
+        return x
+
+    def forward(self, x):
+        for s in range(self._num_stages):
+            x = self.forward_stage(x, s)
+        return x
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, x):
+        return self._fn(x)

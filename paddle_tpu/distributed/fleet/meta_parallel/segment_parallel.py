@@ -1,0 +1,14 @@
+"""SegmentParallel (SEP) wrapper (parity: fleet/meta_parallel/
+segment_parallel.py). The sep axis splits activations along the sequence
+dim; under SPMD this is a Shard(seq) constraint on the activations — see
+sequence_parallel_utils for the op set."""
+from __future__ import annotations
+
+from ...parallel import DataParallel
+
+
+class SegmentParallel(DataParallel):
+    def __init__(self, layers, hcg=None, strategy=None, **kwargs):
+        super().__init__(layers)
+        self._hcg = hcg
+        self._strategy = strategy

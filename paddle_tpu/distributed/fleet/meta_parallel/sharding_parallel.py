@@ -1,0 +1,11 @@
+"""ShardingParallel wrapper (parity: fleet/meta_parallel/sharding_parallel.py)."""
+from __future__ import annotations
+
+from ...parallel import DataParallel
+
+
+class ShardingParallel(DataParallel):
+    def __init__(self, layers, hcg=None, strategy=None, **kwargs):
+        super().__init__(layers)
+        self._hcg = hcg
+        self._strategy = strategy

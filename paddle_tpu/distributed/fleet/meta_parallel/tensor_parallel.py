@@ -1,0 +1,16 @@
+"""TensorParallel model wrapper (parity: fleet/meta_parallel/
+tensor_parallel.py). The reference broadcasts non-distributed params over
+the mp group at construction and syncs their grads in the optimizer; under
+single-controller SPMD replication is the storage default, so construction
+is free — grad sync of replicated params is XLA's duty (identical values by
+construction)."""
+from __future__ import annotations
+
+from ...parallel import DataParallel
+
+
+class TensorParallel(DataParallel):
+    def __init__(self, layers, hcg=None, strategy=None, **kwargs):
+        super().__init__(layers)
+        self._hcg = hcg
+        self._strategy = strategy

@@ -1,0 +1,124 @@
+"""Activation recomputation (gradient checkpointing).
+
+Capability parity with the reference (reference: fleet/recompute/
+recompute.py — RecomputeFunction PyLayer with RNG-state replay :108,
+recompute() API :404, recompute_sequential :542, offload variant
+recompute_hybrid.py).
+
+TPU-native: on the functional/jit path this is ``jax.checkpoint`` — XLA
+rematerializes inside one program (strictly better than the reference's
+replay machinery). On the imperative tape path we implement true
+recompute-on-backward: forward runs under no_grad saving only inputs +
+RNG (seed, offset); backward replays the forward with the restored RNG
+state to rebuild the vjp — the reference's RNG-replay contract.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from ....core import random as _random
+from ....core.autograd import TapeNode, is_tape_active, no_grad, tape_paused
+from ....core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential", "checkpoint"]
+
+
+def recompute(function, *args, **kwargs):
+    """paddle.distributed.fleet.utils.recompute parity. ``use_reentrant``
+    accepted and ignored (single behavior)."""
+    kwargs.pop("use_reentrant", None)
+    preserve_rng = kwargs.pop("preserve_rng_state", True)
+
+    if not is_tape_active():
+        return function(*args, **kwargs)
+
+    # record RNG state so dropout masks replay identically (reference
+    # RecomputeFunction: CUDA seed/offset capture; here (seed, offset))
+    gen_state = _random.default_generator.peek_state() if preserve_rng else None
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    diff_inputs = [t for t in tensor_args if not t.stop_gradient]
+
+    with no_grad():
+        outputs = function(*args, **kwargs)
+    single = not isinstance(outputs, (tuple, list))
+    out_list = (outputs,) if single else tuple(outputs)
+
+    if not diff_inputs:
+        return outputs
+
+    def vjp_fn(cts):
+        # replay forward WITH grad tracking on detached inputs
+        if gen_state is not None:
+            saved = _random.default_generator.peek_state()
+            _random.default_generator.set_state(gen_state)
+        try:
+            detached = []
+            mapping = {}
+            for a in args:
+                if isinstance(a, Tensor) and not a.stop_gradient:
+                    d = Tensor(a._data, stop_gradient=False)
+                    mapping[id(a)] = d
+                    detached.append(d)
+                elif isinstance(a, Tensor):
+                    detached.append(a.detach())
+                else:
+                    detached.append(a)
+            replay = function(*detached, **kwargs)
+            rlist = (replay,) if not isinstance(replay, (tuple, list)) \
+                else tuple(replay)
+            from ....core.autograd import _run_backward
+            targets = [mapping[id(t)] for t in diff_inputs]
+            # accumulate_leaf=True: parameters captured by the function get
+            # their grads accumulated here (reference RecomputeFunction's
+            # backward does the same via its replayed graph)
+            tg = _run_backward(list(rlist),
+                               [Tensor(c, stop_gradient=True) for c in cts],
+                               retain_graph=False, targets=targets,
+                               accumulate_leaf=True)
+            return tuple(tg.get(id(t), None) if tg.get(id(t)) is None
+                         else tg[id(t)]._data
+                         if isinstance(tg.get(id(t)), Tensor) else tg[id(t)]
+                         for t in targets)
+        finally:
+            if gen_state is not None:
+                _random.default_generator.set_state(saved)
+
+    node = TapeNode("recompute", diff_inputs, vjp_fn,
+                    [jax.ShapeDtypeStruct(o._data.shape, o._data.dtype)
+                     for o in out_list])
+    wrapped = []
+    for i, o in enumerate(out_list):
+        t = Tensor(o._data, stop_gradient=False)
+        t._node = node
+        t._out_idx = i
+        wrapped.append(t)
+    return wrapped[0] if single else tuple(wrapped)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Recompute a Sequential in segments (reference :542)."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    per = max(n // segments, 1)
+    out = args[0]
+    i = 0
+    while i < n:
+        chunk = layers[i:i + per]
+
+        def seg(x, _chunk=chunk):
+            for l in _chunk:
+                x = l(x)
+            return x
+        out = recompute(seg, out)
+        i += per
+    return out
+
+
+def checkpoint(function):
+    """Functional-path decorator: jax.checkpoint for jitted training
+    (XLA remat — the TPU answer to recompute_hybrid offload)."""
+    return jax.checkpoint(function)

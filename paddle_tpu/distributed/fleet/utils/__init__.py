@@ -1,0 +1,4 @@
+from ..recompute import recompute, recompute_sequential  # noqa: F401
+from .hybrid_parallel_util import fused_allreduce_gradients  # noqa: F401
+from . import sequence_parallel_utils  # noqa: F401
+from . import hybrid_parallel_util  # noqa: F401

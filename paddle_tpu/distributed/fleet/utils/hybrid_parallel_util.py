@@ -1,0 +1,43 @@
+"""Hybrid-parallel gradient utilities (reference: fleet/utils/
+hybrid_parallel_util.py — fused_allreduce_gradients, param broadcast
+helpers).
+
+Single-controller SPMD: grads of replicated params are computed from the
+full (mesh-wide) batch, so the DP all-reduce is already folded into the
+backward reduction; these helpers normalize Partial-represented grads and
+keep the reference API for training loops that call them.
+"""
+from __future__ import annotations
+
+from ....core.tensor import Tensor
+
+__all__ = ["fused_allreduce_gradients", "broadcast_mp_parameters",
+           "broadcast_dp_parameters", "broadcast_sharding_parameters",
+           "sharding_reduce_gradients"]
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """Reduce any Partial grads to full values (reference: bucketed
+    allreduce over the dp(+sep) group)."""
+    for p in parameter_list:
+        g = p.grad if isinstance(p, Tensor) else None
+        if g is not None and g.dist_attr is not None and \
+                g.dist_attr.partial_axes:
+            from ...auto_parallel.api import unshard_dtensor
+            p.grad = unshard_dtensor(g)
+
+
+def broadcast_mp_parameters(model, hcg=None):
+    """No-op under SPMD: replicated params are one global array."""
+
+
+def broadcast_dp_parameters(model, hcg=None):
+    """No-op under SPMD."""
+
+
+def broadcast_sharding_parameters(model, hcg=None):
+    """No-op under SPMD."""
+
+
+def sharding_reduce_gradients(parameter_list, hcg=None):
+    fused_allreduce_gradients(parameter_list, hcg)

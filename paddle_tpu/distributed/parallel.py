@@ -1,0 +1,177 @@
+"""Parallel environment bootstrap.
+
+Capability parity with the reference's env layer (reference:
+python/paddle/distributed/parallel.py init_parallel_env:395-443 + TCPStore
+rendezvous). TPU-native: jax.distributed owns multi-host rendezvous
+(coordinator address from the launch env contract); within a host,
+single-controller SPMD over jax.devices(). rank/world_size are
+PROCESS-level (per host), matching how data loading shards; device-level
+parallelism lives in mesh axes/groups.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+           "is_initialized", "DataParallel"]
+
+_INITIALIZED = [False]
+
+
+def _maybe_init_jax_distributed():
+    """Multi-host init from the launch env contract (PADDLE_TRAINER_* /
+    MASTER_ADDR, parity with the reference's env contract at
+    launch/controllers/collective.py)."""
+    import jax
+    n_procs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if n_procs <= 1:
+        return
+    # must not touch any backend-initializing API before initialize();
+    # check the distributed client state directly
+    try:
+        from jax._src import distributed as _jd
+        already = _jd.global_state.client is not None
+    except Exception:
+        already = False
+    if already:
+        return
+    addr = os.environ.get("MASTER_ADDR")
+    port = os.environ.get("MASTER_PORT")
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if addr and port:
+        jax.distributed.initialize(f"{addr}:{port}", num_processes=n_procs,
+                                   process_id=pid)
+
+
+def init_parallel_env():
+    """Initialize the parallel env and the world group (parity:
+    paddle.distributed.init_parallel_env)."""
+    import jax
+
+    from .communication import Group, _set_world_group
+    from .process_mesh import ProcessMesh
+
+    _maybe_init_jax_distributed()
+    if not _INITIALIZED[0]:
+        n = jax.device_count()
+        world_mesh = ProcessMesh(np.arange(n), ["world"])
+        _set_world_group(Group("world", list(range(n)), mesh=world_mesh))
+        _INITIALIZED[0] = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _INITIALIZED[0]
+
+
+def get_rank(group=None) -> int:
+    import jax
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    import jax
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+class ParallelEnv:
+    """Parity: paddle.distributed.ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else ["127.0.0.1:0"]
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+
+class DataParallel:
+    """paddle.DataParallel parity wrapper.
+
+    The reference implements DP with a C++ EagerReducer doing bucketed
+    grad all-reduce on a comm stream (reducer.cc). TPU-native: under SPMD
+    compilation the data axis IS the reduction — jax.grad of a batch-sharded
+    loss produces grads that XLA all-reduces automatically (or the fleet
+    train loop calls fused_allreduce_gradients). This wrapper keeps the API
+    (forward delegation, no_sync, state_dict passthrough) and marks the
+    layer for gradient synchronization in the eager path.
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+        self._grad_sync_enabled = True
+        init_parallel_env()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    __call__ = forward
+
+    def no_sync(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            prev = self._grad_sync_enabled
+            self._grad_sync_enabled = False
+            try:
+                yield
+            finally:
+                self._grad_sync_enabled = prev
+        return ctx()
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def parameters(self, *args, **kwargs):
+        return self._layers.parameters(*args, **kwargs)
+
+    def named_parameters(self, *args, **kwargs):
+        return self._layers.named_parameters(*args, **kwargs)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)

@@ -1,0 +1,1 @@
+"""Kernel implementations (XLA + Pallas) behind the op registry."""

@@ -204,7 +204,7 @@ class Momentum(Optimizer):
         self._nesterov = use_nesterov
 
     def _init_state(self, p):
-        return {"velocity": jnp.zeros(p._data.shape, jnp.float32)}
+        return {"velocity": jnp.zeros_like(p._data, dtype=jnp.float32)}
 
     def _update(self, param, grad, state, lr, wd=0.0):
         v = self._momentum * state["velocity"] + grad
@@ -225,7 +225,7 @@ class Adagrad(Optimizer):
         self._init_acc = initial_accumulator_value
 
     def _init_state(self, p):
-        return {"moment": jnp.full(p._data.shape, self._init_acc, jnp.float32)}
+        return {"moment": jnp.full_like(p._data, self._init_acc, dtype=jnp.float32)}
 
     def _update(self, param, grad, state, lr, wd=0.0):
         m = state["moment"] + jnp.square(grad)
@@ -244,10 +244,10 @@ class RMSProp(Optimizer):
         self._centered = centered
 
     def _init_state(self, p):
-        s = {"mean_square": jnp.zeros(p._data.shape, jnp.float32),
-             "momentum": jnp.zeros(p._data.shape, jnp.float32)}
+        s = {"mean_square": jnp.zeros_like(p._data, dtype=jnp.float32),
+             "momentum": jnp.zeros_like(p._data, dtype=jnp.float32)}
         if self._centered:
-            s["mean_grad"] = jnp.zeros(p._data.shape, jnp.float32)
+            s["mean_grad"] = jnp.zeros_like(p._data, dtype=jnp.float32)
         return s
 
     def _update(self, param, grad, state, lr, wd=0.0):
@@ -277,12 +277,12 @@ class Adam(Optimizer):
         self._amsgrad = amsgrad
 
     def _init_state(self, p):
-        s = {"moment1": jnp.zeros(p._data.shape, jnp.float32),
-             "moment2": jnp.zeros(p._data.shape, jnp.float32),
+        s = {"moment1": jnp.zeros_like(p._data, dtype=jnp.float32),
+             "moment2": jnp.zeros_like(p._data, dtype=jnp.float32),
              "beta1_pow": jnp.ones((), jnp.float32),
              "beta2_pow": jnp.ones((), jnp.float32)}
         if self._amsgrad:
-            s["moment2_max"] = jnp.zeros(p._data.shape, jnp.float32)
+            s["moment2_max"] = jnp.zeros_like(p._data, dtype=jnp.float32)
         return s
 
     def _update(self, param, grad, state, lr, wd=0.0):
@@ -364,8 +364,8 @@ class Adamax(Optimizer):
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
     def _init_state(self, p):
-        return {"moment": jnp.zeros(p._data.shape, jnp.float32),
-                "inf_norm": jnp.zeros(p._data.shape, jnp.float32),
+        return {"moment": jnp.zeros_like(p._data, dtype=jnp.float32),
+                "inf_norm": jnp.zeros_like(p._data, dtype=jnp.float32),
                 "beta1_pow": jnp.ones((), jnp.float32)}
 
     def _update(self, param, grad, state, lr, wd=0.0):
@@ -385,8 +385,8 @@ class Adadelta(Optimizer):
         self._epsilon, self._rho = epsilon, rho
 
     def _init_state(self, p):
-        return {"avg_squared_grad": jnp.zeros(p._data.shape, jnp.float32),
-                "avg_squared_update": jnp.zeros(p._data.shape, jnp.float32)}
+        return {"avg_squared_grad": jnp.zeros_like(p._data, dtype=jnp.float32),
+                "avg_squared_update": jnp.zeros_like(p._data, dtype=jnp.float32)}
 
     def _update(self, param, grad, state, lr, wd=0.0):
         asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * jnp.square(grad)
@@ -411,8 +411,8 @@ class Lamb(Optimizer):
         return True
 
     def _init_state(self, p):
-        return {"moment1": jnp.zeros(p._data.shape, jnp.float32),
-                "moment2": jnp.zeros(p._data.shape, jnp.float32),
+        return {"moment1": jnp.zeros_like(p._data, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(p._data, dtype=jnp.float32),
                 "beta1_pow": jnp.ones((), jnp.float32),
                 "beta2_pow": jnp.ones((), jnp.float32)}
 

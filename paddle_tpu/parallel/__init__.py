@@ -1,0 +1,3 @@
+"""Alias package: paddle_tpu.parallel -> paddle_tpu.distributed."""
+from ..distributed import *  # noqa: F401,F403
+from ..distributed import fleet  # noqa: F401
