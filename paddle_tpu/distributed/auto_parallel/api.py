@@ -275,6 +275,9 @@ def shard_optimizer(optimizer, shard_fn=None):
         stage = shard_fn
         params = optimizer._parameter_list or []
         axis = stage.mesh_axis
+        meshes = [p.dist_attr.process_mesh for p in params if _is_dist(p)]
+        if not meshes:
+            return optimizer
         for p in params:
             if not _is_dist(p):
                 continue
@@ -298,7 +301,31 @@ def shard_optimizer(optimizer, shard_fn=None):
                     new = reshard(p, mesh, pl)
                     p._data = new._data
                     p.dist_attr = new.dist_attr
-            # stage 1/2: states inherit (possibly sharded) param placement
+
+        # stage 1/2: optimizer STATES shard over the axis even though the
+        # params stay replicated (the ZeRO-1/2 memory saving; reference
+        # DygraphShardingOptimizer). Stage 3 states inherit the now-sharded
+        # param layout via zeros_like. Wrap _init_state so states created
+        # later are placed, and re-place any that already exist.
+        if not isinstance(shard_fn, ShardingStage3):
+            m0 = meshes[0]
+            if axis in m0.dim_names:
+                jmesh = m0.to_jax()
+                n = m0.get_dim_size(axis)
+
+                def _place_state(st):
+                    for k, v in st.items():
+                        if v.ndim >= 1 and v.shape[0] % n == 0:
+                            st[k] = jax.device_put(
+                                v, NamedSharding(
+                                    jmesh,
+                                    PartitionSpec(axis, *(None,) * (v.ndim - 1))))
+                    return st
+
+                orig_init = optimizer._init_state
+                optimizer._init_state = lambda p: _place_state(orig_init(p))
+                for st in optimizer._states.values():
+                    _place_state(st)
         return optimizer
     # custom callable: fn(param) -> placements
     for p in optimizer._parameter_list or []:

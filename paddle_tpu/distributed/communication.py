@@ -264,6 +264,9 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     if _is_tracer(arr):
         g = group or _world_group()
         src_in_group = g.get_group_rank(src) if g.ranks else src
+        if src_in_group < 0:
+            raise ValueError(f"broadcast src rank {src} is not a member of "
+                             f"group ranks {g.ranks}")
         gathered = jax.lax.all_gather(arr, _axis(group), axis=0)
         return _rewrap(tensor, gathered[src_in_group])
     return tensor  # replicated global value: broadcast is identity
@@ -277,6 +280,9 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
         g = group or _world_group()
         idx = jax.lax.axis_index(_axis(group))
         dst_in_group = g.get_group_rank(dst) if g.ranks else dst
+        if dst_in_group < 0:
+            raise ValueError(f"reduce dst rank {dst} is not a member of "
+                             f"group ranks {g.ranks}")
         return _rewrap(tensor, jnp.where(idx == dst_in_group, out, arr))
     return tensor
 
@@ -284,7 +290,8 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     if tensor_list is not None:
         arrs = [_unwrap(t) for t in tensor_list]
-        if arrs and _is_tracer(_unwrap(tensor)):
+        if arrs and (any(_is_tracer(a) for a in arrs)
+                     or _is_tracer(_unwrap(tensor))):
             stacked = jnp.stack(arrs, 0)
             idx = jax.lax.axis_index(_axis(group))
             return _rewrap(tensor, jnp.take(stacked, idx, axis=0))
@@ -348,6 +355,10 @@ def _pop_live_p2p(current):
 def recv(tensor, src=0, group=None, sync_op=True):
     arr = _unwrap(tensor)
     if _is_tracer(arr):
+        if not isinstance(tensor, Tensor):
+            raise TypeError(
+                "recv/irecv write in place and require a Tensor wrapper; "
+                "got a raw array whose received value would be dropped")
         buffered = _pop_live_p2p(arr)
         if buffered is not None:
             return _rewrap(tensor, buffered)

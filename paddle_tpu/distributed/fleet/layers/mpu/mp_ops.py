@@ -99,6 +99,8 @@ def _c_concat(tensor, group=None):
             g = jax.lax.all_gather(a, ax, axis=0)
             return jnp.concatenate([g[i] for i in range(g.shape[0])], axis=-1)
         return run_op("c_concat", fn, (tensor,))
+    if m is None:
+        return tensor if isinstance(tensor, Tensor) else Tensor(tensor)
 
     def fn(a):
         sh = NamedSharding(m, P(*(None,) * a.ndim))
@@ -112,6 +114,8 @@ def _c_split(tensor, group=None):
     """Split last dim across the mp axis (reference _c_split)."""
     m = _mesh()
     arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    if m is None:
+        return tensor if isinstance(tensor, Tensor) else Tensor(tensor)
 
     def fn(a):
         sh = NamedSharding(m, P(*((None,) * (a.ndim - 1) + ("model",))))

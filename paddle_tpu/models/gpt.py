@@ -105,43 +105,5 @@ class GPTForCausalLM(nn.Layer):
                                labels.reshape([b * s]))
 
 
-def create_train_step(model: GPTForCausalLM, optimizer, donate: bool = True):
-    """Build the jitted functional train step: (params, opt_state, key,
-    batch) -> (loss, params, opt_state). One XLA program per step — forward,
-    backward, and the optimizer sweep all fuse (the reference needs its C++
-    executor + fused adamw kernel for the same effect)."""
-    trainable0 = functional_state(model, trainable_only=True)
-    all0 = functional_state(model)
-    frozen = {k: v for k, v in all0.items() if k not in trainable0}
-    opt_state0 = optimizer.init_state_tree(trainable0)
-    wd_mask = {name: ("bias" not in name and "norm" not in name.lower()
-                      and "ln_" not in name)
-               for name in trainable0}
-
-    def _loss_call(params, ids, labels, key):
-        with _random.key_context(key):
-            merged = {**params, **frozen}
-            from ..nn.layer.layers import _swapped_state
-            from ..core.autograd import tape_paused
-            with _swapped_state(model, merged):
-                with tape_paused():
-                    out = model.loss(Tensor(ids), Tensor(labels))
-            return out._data
-
-    @jax.jit
-    def train_step(params, opt_state, key, ids, labels, lr):
-        loss, grads = jax.value_and_grad(
-            lambda p: _loss_call(p, ids, labels, key))(params)
-        new_params, new_opt_state = optimizer.apply_gradients(
-            params, grads, opt_state, lr, wd_mask=wd_mask)
-        return loss, new_params, new_opt_state
-
-    return train_step, trainable0, opt_state0
-
-
-def write_back(model: nn.Layer, params):
-    """Write functional params back into the stateful layer."""
-    entries = dict(model.named_parameters())
-    for k, v in params.items():
-        if k in entries:
-            entries[k]._data = v
+# the jitted train-step factory is shared by all model families
+from .trainer import create_train_step, write_back  # noqa: E402,F401

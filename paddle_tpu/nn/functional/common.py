@@ -82,7 +82,9 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     accepted for API parity — XLA's scatter-add grad already matches the
     reference's selected-rows gradient capability)."""
     def fn(ids, w):
-        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        # mode="clip": out-of-range ids must not NaN-fill (jnp default) —
+        # matches XLA-friendly static behavior; range checks are eager-only
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0, mode="clip")
         if padding_idx is not None:
             mask = (ids == padding_idx)[..., None]
             out = jnp.where(mask, 0.0, out).astype(w.dtype)
