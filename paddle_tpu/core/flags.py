@@ -55,6 +55,8 @@ def get_flag(name: str):
 
 # Core flags (parity with the reference's most commonly used FLAGS_*).
 define_flag("check_nan_inf", False, "check every op output for NaN/Inf")
+define_flag("check_index_bounds", False,
+            "eager range-check of gather/embedding indices (host sync)")
 define_flag("use_pallas_kernels", True, "prefer Pallas fused kernels over XLA lowering")
 define_flag("embedding_deterministic", False, "deterministic embedding grad accumulation")
 define_flag("cudnn_deterministic", False, "accepted for API parity; no-op on TPU")

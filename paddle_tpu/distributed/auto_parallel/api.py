@@ -310,19 +310,46 @@ def shard_optimizer(optimizer, shard_fn=None):
         if not isinstance(shard_fn, ShardingStage3):
             m0 = meshes[0]
             if axis in m0.dim_names:
-                jmesh = m0.to_jax()
+                jmesh0 = m0.to_jax()
                 n = m0.get_dim_size(axis)
 
                 def _place_state(st):
+                    # Compose the ZeRO axis with whatever sharding each
+                    # state already inherited from its param (zeros_like
+                    # preserves TP placements): shard the first free,
+                    # evenly-divisible dim over `axis`; keep existing mp
+                    # dims intact. States living on a mesh without `axis`
+                    # (e.g. another pipeline stage's mesh) are skipped.
                     for k, v in st.items():
-                        if v.ndim >= 1 and v.shape[0] % n == 0:
-                            st[k] = jax.device_put(
-                                v, NamedSharding(
-                                    jmesh,
-                                    PartitionSpec(axis, *(None,) * (v.ndim - 1))))
+                        if v.ndim < 1:
+                            continue
+                        sh = getattr(v, "sharding", None)
+                        if isinstance(sh, NamedSharding):
+                            jmesh, spec = sh.mesh, tuple(sh.spec)
+                        else:
+                            jmesh, spec = jmesh0, ()
+                        if axis not in jmesh.axis_names:
+                            continue
+                        spec = spec + (None,) * (v.ndim - len(spec))
+                        used = {s for d in spec if d is not None
+                                for s in (d if isinstance(d, tuple) else (d,))}
+                        if axis in used:
+                            continue
+                        for d in range(v.ndim):
+                            if spec[d] is None and v.shape[d] % n == 0:
+                                spec = spec[:d] + (axis,) + spec[d + 1:]
+                                st[k] = jax.device_put(
+                                    v, NamedSharding(jmesh,
+                                                     PartitionSpec(*spec)))
+                                break
                     return st
 
-                orig_init = optimizer._init_state
+                # idempotent wrap: re-applying a strategy replaces, not
+                # stacks, the placement hook
+                orig_init = getattr(optimizer, "_orig_init_state", None)
+                if orig_init is None:
+                    orig_init = optimizer._init_state
+                    optimizer._orig_init_state = orig_init
                 optimizer._init_state = lambda p: _place_state(orig_init(p))
                 for st in optimizer._states.values():
                     _place_state(st)

@@ -19,17 +19,16 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .. import nn
 from ..core.dispatch import run_op
-from ..core.tensor import Tensor
 from ..nn import functional as F
 
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "llama_7b", "llama_13b",
-           "llama_tiny", "llama_param_spec", "apply_rotary_pos_emb"]
+           "llama_tiny", "llama_param_spec", "llama_fsdp_spec",
+           "apply_rotary_pos_emb"]
 
 
 @dataclass

@@ -7,8 +7,6 @@ reducers, and fused optimizer kernels.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...core import flags
 from ...core import random as _random
 from ...core.dispatch import run_op
 from ...core.dtype import convert_dtype
@@ -82,8 +83,18 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     accepted for API parity — XLA's scatter-add grad already matches the
     reference's selected-rows gradient capability)."""
     def fn(ids, w):
-        # mode="clip": out-of-range ids must not NaN-fill (jnp default) —
-        # matches XLA-friendly static behavior; range checks are eager-only
+        # mode="clip": XLA-friendly static behavior (no NaN fill, no
+        # data-dependent branch inside jit). OOB ids clamp silently, so a
+        # flag-gated eager check below catches dataset bugs when enabled.
+        if (flags.get_flag("check_index_bounds")
+                and not isinstance(ids, jax.core.Tracer)):
+            import numpy as _np
+            idn = _np.asarray(ids)
+            if idn.size and (int(idn.min()) < 0
+                             or int(idn.max()) >= w.shape[0]):
+                raise ValueError(
+                    f"embedding ids out of range [0, {w.shape[0]}): "
+                    f"min={idn.min()}, max={idn.max()}")
         out = jnp.take(w, ids.astype(jnp.int32), axis=0, mode="clip")
         if padding_idx is not None:
             mask = (ids == padding_idx)[..., None]

@@ -11,6 +11,11 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# sitecustomize may have imported jax at interpreter start with
+# JAX_PLATFORMS=axon already captured into jax.config — override the live
+# config, not just the env var.
+jax.config.update("jax_platforms", "cpu")
+
 # exact f32 matmuls for numeric checks (the default 'fastest' uses bf16-class
 # accumulation — the TPU-speed setting; tests want reference numerics)
 jax.config.update("jax_default_matmul_precision", "highest")
