@@ -137,10 +137,25 @@ def run_op(
     return wrapped[0] if single else tuple(wrapped)
 
 
+_pallas_loaded = False
+
+
+def _load_pallas_impls():
+    """Import the Pallas kernel package on first fused-op lookup so that
+    plain `import paddle_tpu` never pays the pallas/mosaic import cost."""
+    global _pallas_loaded
+    if not _pallas_loaded:
+        _pallas_loaded = True
+        from .. import ops as _ops  # noqa: F401
+        from ..ops import pallas as _pk  # noqa: F401
+
+
 def select_impl(name: str):
     """Pick the Pallas implementation when registered and enabled, else XLA.
     (Thin analog of the reference KernelFactory::SelectKernelOrThrowError,
     paddle/phi/core/kernel_factory.h:326 — XLA subsumes backend/dtype keys.)"""
+    if _flags.get_flag("use_pallas_kernels"):
+        _load_pallas_impls()
     impls = OP_REGISTRY.get(name, {})
     if _flags.get_flag("use_pallas_kernels") and "pallas" in impls:
         return impls["pallas"]

@@ -58,6 +58,9 @@ define_flag("check_nan_inf", False, "check every op output for NaN/Inf")
 define_flag("check_index_bounds", False,
             "eager range-check of gather/embedding indices (host sync)")
 define_flag("use_pallas_kernels", True, "prefer Pallas fused kernels over XLA lowering")
+define_flag("pallas_force_interpret", False,
+            "run Pallas kernels in interpret mode on non-TPU backends "
+            "(kernel tests); default falls back to the XLA impl off-TPU")
 define_flag("embedding_deterministic", False, "deterministic embedding grad accumulation")
 define_flag("cudnn_deterministic", False, "accepted for API parity; no-op on TPU")
 define_flag("low_precision_op_list", 0, "collect amp op stats level")

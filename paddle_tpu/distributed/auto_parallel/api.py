@@ -335,8 +335,12 @@ def shard_optimizer(optimizer, shard_fn=None):
                                 for s in (d if isinstance(d, tuple) else (d,))}
                         if axis in used:
                             continue
+                        # divisibility must be checked against THIS state's
+                        # mesh extent of `axis`, which can differ from the
+                        # param mesh's (e.g. another pipeline stage's mesh)
+                        n_ax = int(jmesh.shape[axis])
                         for d in range(v.ndim):
-                            if spec[d] is None and v.shape[d] % n == 0:
+                            if spec[d] is None and v.shape[d] % n_ax == 0:
                                 spec = spec[:d] + (axis,) + spec[d + 1:]
                                 st[k] = jax.device_put(
                                     v, NamedSharding(jmesh,

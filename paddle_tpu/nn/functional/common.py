@@ -88,8 +88,7 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
         # flag-gated eager check below catches dataset bugs when enabled.
         if (flags.get_flag("check_index_bounds")
                 and not isinstance(ids, jax.core.Tracer)):
-            import numpy as _np
-            idn = _np.asarray(ids)
+            idn = np.asarray(ids)
             if idn.size and (int(idn.min()) < 0
                              or int(idn.max()) >= w.shape[0]):
                 raise ValueError(

@@ -1,0 +1,10 @@
+"""Pallas TPU kernels for the fused-op set.
+
+TPU-native replacement for the reference's fused CUDA kernels
+(paddle/phi/kernels/fusion/gpu/ and the dynloaded flash-attn library,
+paddle/phi/backends/dynload/flashattn.h). Each kernel registers itself as
+the "pallas" implementation in the op registry (core/dispatch.py); the
+XLA reference implementation stays available as the fallback and the
+numeric oracle in tests.
+"""
+from . import flash_attention  # noqa: F401

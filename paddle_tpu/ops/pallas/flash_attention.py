@@ -1,0 +1,414 @@
+"""Blockwise flash attention as a Pallas TPU kernel.
+
+TPU-native equivalent of the reference's dynloaded flash-attn CUDA library
+(paddle/phi/backends/dynload/flashattn.h; call sites
+paddle/phi/kernels/gpu/flash_attn_kernel.cu:91,199). Contract matches the
+reference op (paddle/phi/api/yaml/ops.yaml flash_attn entry): q/k/v are
+[batch, seqlen, num_heads, head_dim]; GQA (kv heads < q heads); causal
+masking uses the (Sk - Sq)-offset diagonal; softmax statistics (lse) are
+produced by the forward pass and consumed by the backward kernels.
+
+Design (online-softmax, Dao et al. 2022, re-derived for the MXU):
+- forward: grid (batch*heads, q_blocks, k_blocks) with the k dimension
+  innermost/sequential ("arbitrary"); VMEM scratch carries the running
+  (acc, m, l) across k blocks; causal blocks above the diagonal are skipped
+  with pl.when.
+- backward: one kernel for dq (grid like forward), one for dk/dv (grid
+  (batch*heads, k_blocks, q_blocks)); recomputes p from q,k and the saved
+  lse instead of storing the S×S probability matrix.
+- GQA is expressed in the BlockSpec index maps (kv block index derived from
+  the q head index), so kv tensors are never materialised per-q-head in the
+  forward; backward produces per-q-head dk/dv then sums the head groups.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from jax.experimental.pallas import tpu as pltpu
+
+from ...core import flags as _flags
+from ...core.dispatch import register_op_impl
+
+__all__ = ["flash_attention_pallas"]
+
+_NEG_INF = float("-inf")
+_LANES = 128
+
+
+def _kv_index(bh, hq, hk):
+    """Flattened (b*Hq) program index -> flattened (b*Hk) kv index (GQA)."""
+    rep = hq // hk
+    return (bh // hq) * hk + (bh % hq) // rep
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                scale, causal, offset, bq, bk, nk, sk_real):
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+    q_start = qi * bq
+    k_start = ki * bk
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # causal: the whole block is masked iff its first key column is beyond
+    # the last query row's horizon
+    run = True
+    if causal:
+        run = k_start <= q_start + bq - 1 + offset
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32) * scale                 # (bq, d)
+        k = k_ref[0].astype(jnp.float32)                         # (bk, d)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        kidx = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = kidx < sk_real                                    # pad keys off
+        if causal:
+            qidx = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            mask = mask & (kidx <= qidx + offset)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[...]                                      # (bq, LANES)
+        s_max = jnp.max(s, axis=1, keepdims=True)                # (bq, 1)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(s_max, m_prev.shape))
+        # fully-masked-so-far rows keep m = -inf; use a safe exponent base so
+        # exp() never sees (-inf) - (-inf)
+        m_safe = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+        alpha = jnp.exp(m_prev - m_safe)                         # (bq, LANES)
+        p = jnp.exp(s - m_safe[:, :1])                           # (bq, bk)
+        l_ref[...] = alpha * l_ref[...] + jnp.broadcast_to(
+            jnp.sum(p, axis=1, keepdims=True), m_prev.shape)
+        v = v_ref[0].astype(jnp.float32)                         # (bk, d)
+        acc_ref[...] = acc_ref[...] * alpha[:, :1] + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _fin():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = jnp.where(l > 0.0, acc_ref[...] / safe_l, 0.0
+                             ).astype(o_ref.dtype)
+        m = m_ref[:, 0]
+        lse_ref[0] = jnp.where(l[:, 0] > 0.0,
+                               m + jnp.log(jnp.maximum(l[:, 0], 1e-38)),
+                               _NEG_INF)
+
+
+def _fwd(q3, k3, v3, hq, hk, causal, scale, offset, sk_real, bq, bk,
+         interpret):
+    """q3: (B*Hq, Sq, D) padded; k3/v3: (B*Hk, Sk, D) padded."""
+    bhq, sq, d = q3.shape
+    sk = k3.shape[1]
+    nq, nk = sq // bq, sk // bk
+    grid = (bhq, nq, nk)
+    kv_map = functools.partial(_kv_index, hq=hq, hk=hk)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, offset=offset,
+        bq=bq, bk=bk, nk=nk, sk_real=sk_real)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (kv_map(bh), ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (kv_map(bh), ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bh, qi, ki: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bhq, sq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bhq, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, scale, causal, offset, bq, bk, nk, sk_real):
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+    q_start, k_start = qi * bq, ki * bk
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = k_start <= q_start + bq - 1 + offset
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                                        # (bq,)
+        lse_safe = jnp.where(lse == _NEG_INF, 0.0, lse)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        kidx = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = kidx < sk_real
+        if causal:
+            qidx = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            mask = mask & (kidx <= qidx + offset)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse_safe[:, None])                      # (bq, bk)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])                   # (bq, bk)
+        dq_acc[...] += jax.lax.dot(ds, k,
+                                   preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ki == nk - 1)
+    def _fin():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, dk_acc, dv_acc, *, scale, causal, offset, bq, bk, nq,
+                sk_real):
+    qi = pl.program_id(2)
+    ki = pl.program_id(1)
+    q_start, k_start = qi * bq, ki * bk
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        # block contributes iff some query row sees some key col
+        run = k_start <= q_start + bq - 1 + offset
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        lse_safe = jnp.where(lse == _NEG_INF, 0.0, lse)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        kidx = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = kidx < sk_real
+        if causal:
+            qidx = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            mask = mask & (kidx <= qidx + offset)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse_safe[:, None])                      # (bq, bk)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # (bk, d)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])
+        # q was pre-scaled on load, so dk = ds^T @ (scale*q) needs no extra
+        # scale factor
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # (bk, d)
+
+    @pl.when(qi == nq - 1)
+    def _fin():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_impl(q3, kx, vx, do3, lse, delta, causal, scale, offset, sk_real,
+              bq, bk, interpret):
+    """All inputs per-q-head flattened: q3/do3 (BHq, Sq, D); kx/vx already
+    expanded to (BHq, Sk, D). Returns (dq, dk, dv) per q head."""
+    bhq, sq, d = q3.shape
+    sk = kx.shape[1]
+    nq, nk = sq // bq, sk // bk
+
+    scratch = [pltpu.VMEM((bq, d), jnp.float32)]
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          offset=offset, bq=bq, bk=bk, nk=nk, sk_real=sk_real),
+        grid=(bhq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, bq), lambda bh, qi, ki: (bh, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bhq, sq, d), q3.dtype),
+        scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q3, kx, vx, do3, lse, delta)
+
+    scratch2 = [pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32)]
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          offset=offset, bq=bq, bk=bk, nq=nq, sk_real=sk_real),
+        grid=(bhq, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bh, ki, qi: (bh, qi)),
+            pl.BlockSpec((1, bq), lambda bh, ki, qi: (bh, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bhq, sk, d), q3.dtype),
+            jax.ShapeDtypeStruct((bhq, sk, d), q3.dtype),
+        ],
+        scratch_shapes=scratch2,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q3, kx, vx, do3, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper in the reference layout [B, S, H, D]
+# ---------------------------------------------------------------------------
+
+def _pick_block(s, target=128):
+    b = min(target, s)
+    return b
+
+
+def _pad_seq(x3, block):
+    s = x3.shape[1]
+    pad = (-s) % block
+    if pad:
+        x3 = jnp.pad(x3, ((0, 0), (0, pad), (0, 0)))
+    return x3
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_pallas(q, k, v, causal, scale, interpret):
+    """q [B,Sq,Hq,D], k/v [B,Sk,Hk,D] -> out [B,Sq,Hq,D]."""
+    out, _ = _fa_fwd(q, k, v, causal, scale, interpret)
+    return out
+
+
+def _fa_fwd(q, k, v, causal, scale, interpret):
+    B, Sq, Hq, D = q.shape
+    Sk, Hk = k.shape[1], k.shape[2]
+    bq, bk = _pick_block(Sq), _pick_block(Sk)
+    offset = Sk - Sq
+
+    q3 = _pad_seq(q.transpose(0, 2, 1, 3).reshape(B * Hq, Sq, D), bq)
+    k3 = _pad_seq(k.transpose(0, 2, 1, 3).reshape(B * Hk, Sk, D), bk)
+    v3 = _pad_seq(v.transpose(0, 2, 1, 3).reshape(B * Hk, Sk, D), bk)
+
+    out3, lse = _fwd(q3, k3, v3, Hq, Hk, causal, scale, offset, Sk, bq, bk,
+                     interpret)
+    out = out3[:, :Sq].reshape(B, Hq, Sq, D).transpose(0, 2, 1, 3)
+    return out, (q, k, v, out, lse)
+
+
+def _fa_bwd(causal, scale, interpret, res, dout):
+    q, k, v, out, lse = res
+    B, Sq, Hq, D = q.shape
+    Sk, Hk = k.shape[1], k.shape[2]
+    rep = Hq // Hk
+    bq, bk = _pick_block(Sq), _pick_block(Sk)
+    offset = Sk - Sq
+
+    q3 = _pad_seq(q.transpose(0, 2, 1, 3).reshape(B * Hq, Sq, D), bq)
+    do3 = _pad_seq(dout.transpose(0, 2, 1, 3).reshape(B * Hq, Sq, D), bq)
+    # expand kv to per-q-head for the backward kernels (GQA)
+    k4 = jnp.repeat(k.transpose(0, 2, 1, 3), rep, axis=1) if rep > 1 else \
+        k.transpose(0, 2, 1, 3)
+    v4 = jnp.repeat(v.transpose(0, 2, 1, 3), rep, axis=1) if rep > 1 else \
+        v.transpose(0, 2, 1, 3)
+    kx = _pad_seq(k4.reshape(B * Hq, Sk, D), bk)
+    vx = _pad_seq(v4.reshape(B * Hq, Sk, D), bk)
+
+    # delta_i = rowsum(dO_i * O_i) — cheap elementwise, leave to XLA
+    out3 = out.transpose(0, 2, 1, 3).reshape(B * Hq, Sq, D)
+    delta = jnp.sum(do3[:, :Sq].astype(jnp.float32) *
+                    out3.astype(jnp.float32), axis=-1)
+    pad_q = (-Sq) % bq
+    if pad_q:
+        delta = jnp.pad(delta, ((0, 0), (0, pad_q)))
+        # padded query rows get lse = +inf => p = exp(s - inf) = 0, so they
+        # contribute nothing to dk/dv sums
+        lse_p = jnp.pad(lse[:, :Sq], ((0, 0), (0, pad_q)),
+                        constant_values=float("inf"))
+    else:
+        lse_p = lse[:, :Sq]
+
+    dq3, dk3, dv3 = _bwd_impl(q3, kx, vx, do3, lse_p, delta, causal, scale,
+                              offset, Sk, bq, bk, interpret)
+    dq = dq3[:, :Sq].reshape(B, Hq, Sq, D).transpose(0, 2, 1, 3)
+    dk4 = dk3[:, :Sk].reshape(B, Hq, Sk, D)
+    dv4 = dv3[:, :Sk].reshape(B, Hq, Sk, D)
+    if rep > 1:  # sum q-head groups back onto their kv head
+        dk4 = dk4.reshape(B, Hk, rep, Sk, D).sum(axis=2)
+        dv4 = dv4.reshape(B, Hk, rep, Sk, D).sum(axis=2)
+    dk = dk4.transpose(0, 2, 1, 3).astype(k.dtype)
+    dv = dv4.transpose(0, 2, 1, 3).astype(v.dtype)
+    return dq.astype(q.dtype), dk, dv
+
+
+flash_attention_pallas.defvjp(_fa_fwd, _fa_bwd)
+
+
+# ---------------------------------------------------------------------------
+# registry wiring
+# ---------------------------------------------------------------------------
+
+@register_op_impl("flash_attention", "pallas")
+def _attention_pallas(q, k, v, bias, causal, scale, dropout_p, dropout_key):
+    """Pallas path for the bias-free, dropout-free case (the training hot
+    path); everything else falls back to the XLA reference impl."""
+    from ...nn.functional.flash_attention import _attention_xla
+    on_tpu = jax.default_backend() == "tpu"
+    interpret = not on_tpu
+    if (bias is not None or (dropout_p and dropout_p > 0.0)
+            or q.shape[-1] > 256
+            or (interpret and not _flags.get_flag("pallas_force_interpret"))):
+        return _attention_xla(q, k, v, bias, causal, scale, dropout_p,
+                              dropout_key)
+    return flash_attention_pallas(q, k, v, bool(causal), float(scale),
+                                  interpret)

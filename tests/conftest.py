@@ -24,7 +24,8 @@ jax.config.update("jax_default_matmul_precision", "highest")
 # connection is ever attempted from the test process
 try:
     from jax._src import xla_bridge as _xb
-    for _name in ("axon", "tpu"):
-        _xb._backend_factories.pop(_name, None)
+    # keep "tpu" registered — pallas/mosaic need the platform known for
+    # lowering-rule registration; JAX_PLATFORMS=cpu stops initialization
+    _xb._backend_factories.pop("axon", None)
 except Exception:
     pass

@@ -1,0 +1,105 @@
+"""Pallas flash-attention kernel vs the XLA reference implementation
+(interpret mode on CPU — SURVEY.md §4: kernels testable without hardware).
+
+Mirrors the reference's flash-attn op tests
+(test/legacy_test/test_flash_attention.py): forward parity with a plain
+softmax-attention oracle and gradient parity, across causal, GQA,
+cross-attention (Sq != Sk), and non-block-aligned sequence lengths.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.nn.functional.flash_attention import _attention_xla
+from paddle_tpu.ops.pallas.flash_attention import flash_attention_pallas
+
+
+def _mk(b, sq, sk, hq, hk, d, dtype=jnp.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.standard_normal((b, sq, hq, d)), dtype)
+    k = jnp.asarray(rng.standard_normal((b, sk, hk, d)), dtype)
+    v = jnp.asarray(rng.standard_normal((b, sk, hk, d)), dtype)
+    return q, k, v
+
+
+CASES = [
+    # b, sq, sk, hq, hk, d, causal
+    (2, 128, 128, 2, 2, 32, False),
+    (2, 128, 128, 2, 2, 32, True),
+    (1, 256, 256, 4, 1, 16, True),      # GQA + multi k-block
+    (1, 192, 192, 2, 2, 32, True),      # non-aligned seq (padding)
+    (1, 64, 256, 2, 2, 32, True),       # cross: Sq < Sk, offset diagonal
+    (1, 128, 96, 2, 2, 16, False),      # Sk not aligned
+]
+
+
+@pytest.mark.parametrize("b,sq,sk,hq,hk,d,causal", CASES)
+def test_forward_matches_xla(b, sq, sk, hq, hk, d, causal):
+    q, k, v = _mk(b, sq, sk, hq, hk, d)
+    scale = 1.0 / math.sqrt(d)
+    ref = _attention_xla(q, k, v, None, causal, scale, 0.0, None)
+    out = flash_attention_pallas(q, k, v, causal, scale, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("b,sq,sk,hq,hk,d,causal", [
+    (1, 128, 128, 2, 2, 32, True),
+    (1, 256, 256, 2, 1, 16, True),      # GQA grad: dk/dv head-group sum
+    (1, 192, 192, 2, 2, 32, False),     # padding in bwd
+    (1, 64, 128, 2, 2, 16, True),       # offset diagonal bwd
+])
+def test_grad_matches_xla(b, sq, sk, hq, hk, d, causal):
+    q, k, v = _mk(b, sq, sk, hq, hk, d, seed=1)
+    scale = 1.0 / math.sqrt(d)
+    rng = np.random.RandomState(2)
+    ct = jnp.asarray(rng.standard_normal((b, sq, hq, d)), jnp.float32)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_attention_xla(q, k, v, None, causal, scale, 0.0,
+                                      None) * ct)
+
+    def loss_pl(q, k, v):
+        return jnp.sum(flash_attention_pallas(q, k, v, causal, scale, True)
+                       * ct)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gp = jax.grad(loss_pl, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gp, gr, "q k v".split()):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"grad mismatch for {name}")
+
+
+def test_bf16_forward_close():
+    q, k, v = _mk(1, 128, 128, 2, 2, 32, dtype=jnp.bfloat16)
+    scale = 1.0 / math.sqrt(32)
+    ref = _attention_xla(q, k, v, None, True, scale, 0.0, None)
+    out = flash_attention_pallas(q, k, v, True, scale, True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_dispatch_uses_pallas_under_flag():
+    """F.scaled_dot_product_attention routes to the Pallas kernel when the
+    interpret flag is forced (CPU), and output still matches the oracle."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    q, k, v = _mk(1, 128, 128, 2, 2, 32)
+    scale = 1.0 / math.sqrt(32)
+    ref = _attention_xla(q, k, v, None, True, scale, 0.0, None)
+    _flags.set_flags({"pallas_force_interpret": True})
+    try:
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            is_causal=True)
+    finally:
+        _flags.set_flags({"pallas_force_interpret": False})
+    np.testing.assert_allclose(np.asarray(out.numpy()), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
