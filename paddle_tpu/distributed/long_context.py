@@ -1,0 +1,198 @@
+"""Long-context attention strategies: ring attention and Ulysses.
+
+The reference's long-context story (SURVEY.md §5.7) stops at sharding the
+sequence axis and gathering before a local attention kernel
+(fleet/meta_parallel/segment_parallel.py, sequence_parallel_utils.py, and
+the sep axis in base/topology.py:64); it has no ring-attention or
+all-to-all attention in-tree. Here both are first-class TPU-native
+strategies, designed for the ICI torus:
+
+- ``ring_attention``: q/k/v stay sharded on the sequence axis; k/v chunks
+  rotate around the ring via ``ppermute`` while each device accumulates its
+  queries' attention with the online-softmax (m, l) recurrence — the
+  flash-attention math at the inter-chip level. Communication is
+  neighbor-to-neighbor, exactly what ICI is best at, and overlaps with the
+  per-chunk compute.
+- ``ulysses_attention``: one ``all_to_all`` re-shards activations from
+  sequence-sharded to head-sharded, runs the full-sequence local kernel
+  (the Pallas flash kernel on TPU), and swaps back. Cheaper for moderate
+  sequence lengths; requires num_heads % axis_size == 0.
+
+Both are pure-jnp + lax collectives, so jax.vjp differentiates through
+them (the scan body is rematerialized instead of storing per-step score
+matrices).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8 top-level shard_map
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs)
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _old_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs)
+
+from ..core.dispatch import run_op
+
+__all__ = ["ring_attention", "ulysses_attention", "ring_attention_local",
+           "ulysses_attention_local"]
+
+_NEG_INF = float("-inf")
+
+
+def _online_update(qf, kc, vc, acc, m, l, q_off, k_off, causal):
+    """One blockwise softmax-accumulation step.
+
+    qf: (B, Sq, H, D) f32 (pre-scaled by the caller); kc/vc: (B, Sc, Hk, D)
+    with Hk == H or a GQA divisor of it (expanded here, after the ring
+    transfer, so only Hk heads ride the ICI);
+    acc: (B, H, Sq, D); m, l: (B, H, Sq, 1). Offsets are global sequence
+    positions of the q and k chunks (traced scalars are fine).
+    """
+    kc = _repeat_kv(kc, qf.shape[2])
+    vc = _repeat_kv(vc, qf.shape[2])
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32))
+    if causal:
+        sq, sk = qf.shape[1], kc.shape[1]
+        qidx = q_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        kidx = k_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where((kidx <= qidx)[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    m_safe = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+    alpha = jnp.exp(m - m_safe)
+    p = jnp.exp(s - m_safe)
+    l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum("bhqk,bkhd->bhqd", p,
+                                       vc.astype(jnp.float32))
+    return acc_new, m_new, l_new
+
+
+def _repeat_kv(k, hq):
+    hk = k.shape[2]
+    if hk != hq:
+        k = jnp.repeat(k, hq // hk, axis=2)
+    return k
+
+
+def ring_attention_local(q, k, v, axis_name, axis_size, causal=True,
+                         scale=None):
+    """Per-shard body: call inside shard_map with q/k/v sequence-sharded
+    [B, S/N, H, D]. Returns the local output chunk [B, S/N, H, D]."""
+    B, sc, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    # GQA kv chunks rotate un-expanded (Hk heads of ICI traffic, not H)
+    idx = jax.lax.axis_index(axis_name)
+    qf = q.astype(jnp.float32) * scale
+    acc = jnp.zeros((B, H, sc, D), jnp.float32)
+    m = jnp.full((B, H, sc, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((B, H, sc, 1), jnp.float32)
+    # the scan carry must be device-varying over the mesh axis from step 0
+    if hasattr(jax.lax, "pcast"):
+        acc, m, l = (jax.lax.pcast(x, (axis_name,), to="varying")
+                     for x in (acc, m, l))
+    elif hasattr(jax.lax, "pvary"):  # older jax
+        acc, m, l = (jax.lax.pvary(x, (axis_name,)) for x in (acc, m, l))
+    # neighbor ring: each step every device hands its current k/v chunk to
+    # the previous rank, so device i sees chunk (i + t) mod N at step t
+    perm = [((r + 1) % axis_size, r) for r in range(axis_size)]
+
+    def body(carry, t):
+        kc, vc, acc, m, l = carry
+        j = (idx + t) % axis_size
+        # remat: recompute the per-step score matrix in backward instead of
+        # storing N of them (the flash-attention memory property, at the
+        # inter-chip granularity)
+        acc, m, l = jax.checkpoint(
+            lambda kc_, vc_, a, mm, ll: _online_update(
+                qf, kc_, vc_, a, mm, ll, q_off=idx * sc, k_off=j * sc,
+                causal=causal))(kc, vc, acc, m, l)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (kc, vc, acc, m, l), None
+
+    (kc, vc, acc, m, l), _ = jax.lax.scan(
+        body, (k, v, acc, m, l), jnp.arange(axis_size))
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = jnp.where(l > 0.0, acc / safe_l, 0.0)                # (B,H,Sq,D)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ulysses_attention_local(q, k, v, axis_name, axis_size, causal=True,
+                            scale=None):
+    """Per-shard body: all_to_all seq-shard -> head-shard, local full-seq
+    attention, swap back. q/k/v [B, S/N, H, D]; needs H % N == 0 (kv heads
+    too: GQA is expanded before the swap when Hk < N)."""
+    B, sc, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    k = _repeat_kv(k, H)
+    v = _repeat_kv(v, H)
+
+    def swap_in(x):   # [B, S/N, H, D] -> [B, S, H/N, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def swap_out(x):  # [B, S, H/N, D] -> [B, S/N, H, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qg, kg, vg = swap_in(q), swap_in(k), swap_in(v)
+    from ..core.dispatch import select_impl
+    impl = select_impl("flash_attention")
+    out = impl(qg, kg, vg, None, causal, scale, 0.0, None)
+    return swap_out(out)
+
+
+def _as_mesh(mesh):
+    if isinstance(mesh, Mesh):
+        return mesh
+    if mesh is None:
+        from .process_mesh import get_mesh
+        mesh = get_mesh()
+        if mesh is None:
+            raise RuntimeError("ring/ulysses attention needs a mesh: pass "
+                               "one or call dist.set_mesh/init_mesh first")
+    return mesh.to_jax()  # ProcessMesh
+
+
+def ring_attention(q, k, v, mesh=None, seq_axis="sep", causal=True,
+                   scale=None):
+    """User API: q/k/v Tensors/arrays [B, S, H, D]; runs ring attention with
+    the sequence dim sharded over ``seq_axis`` of ``mesh``. Differentiable
+    through the tape (run_op -> jax.vjp through shard_map)."""
+    jmesh = _as_mesh(mesh)
+    n = int(jmesh.shape[seq_axis])
+    spec = P(None, seq_axis, None, None)
+    body = functools.partial(ring_attention_local, axis_name=seq_axis,
+                             axis_size=n, causal=causal, scale=scale)
+    fn = shard_map(lambda a, b, c: body(a, b, c), jmesh,
+                   in_specs=(spec, spec, spec), out_specs=spec)
+    return run_op("ring_attention", fn, (q, k, v))
+
+
+def ulysses_attention(q, k, v, mesh=None, seq_axis="sep", causal=True,
+                      scale=None):
+    """User API: Ulysses all-to-all attention over ``seq_axis``."""
+    jmesh = _as_mesh(mesh)
+    n = int(jmesh.shape[seq_axis])
+    spec = P(None, seq_axis, None, None)
+    body = functools.partial(ulysses_attention_local, axis_name=seq_axis,
+                             axis_size=n, causal=causal, scale=scale)
+    fn = shard_map(lambda a, b, c: body(a, b, c), jmesh,
+                   in_specs=(spec, spec, spec), out_specs=spec)
+    return run_op("ulysses_attention", fn, (q, k, v))

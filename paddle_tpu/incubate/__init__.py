@@ -1,0 +1,2 @@
+"""Incubating features (parity: python/paddle/incubate/)."""
+from . import moe  # noqa: F401

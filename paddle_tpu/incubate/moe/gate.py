@@ -1,0 +1,149 @@
+"""MoE gates: naive top-k, Switch (top-1), GShard (top-2).
+
+Capability parity with the reference's gate set
+(python/paddle/incubate/distributed/models/moe/gate/: naive_gate.py,
+switch_gate.py, gshard_gate.py). The reference gates emit integer routing
+tables consumed by the global_scatter/global_gather CUDA all-to-all ops;
+here each gate emits dense (tokens, experts, capacity) dispatch/combine
+tensors — the GShard formulation — which XLA lowers to one-hot matmuls on
+the MXU and which shard cleanly over an expert mesh axis.
+
+All gate math is pure jnp on arrays (traced under jit); capacity is a
+static python int so shapes stay static.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["NaiveGate", "SwitchGate", "GShardGate", "compute_capacity"]
+
+
+def compute_capacity(num_tokens: int, num_experts: int, top_k: int,
+                     capacity_factor: float) -> int:
+    return max(4, int(math.ceil(num_tokens * top_k / num_experts
+                                * capacity_factor)))
+
+
+def _one_hot(idx, n):
+    return jax.nn.one_hot(idx, n, dtype=jnp.float32)
+
+
+def _positions_in_expert(mask):
+    """mask: (T, E) 0/1 — position of each kept token within its expert's
+    buffer = exclusive cumsum along tokens."""
+    return jnp.cumsum(mask, axis=0) - mask
+
+
+def _aux_loss(probs, mask):
+    """GShard load-balance loss: E * sum_e mean_t(probs_e) * mean_t(mask_e).
+    (reference: gshard_gate.py / switch router loss)"""
+    e = probs.shape[1]
+    density = jnp.mean(mask, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    return jnp.sum(density * density_proxy) * e
+
+
+class _GateBase:
+    """Gates are lightweight strategy objects: __call__(logits, capacity) ->
+    (dispatch (T,E,C), combine (T,E,C), aux_loss scalar)."""
+
+    top_k = 1
+
+    def __call__(self, logits, capacity):
+        raise NotImplementedError
+
+
+class SwitchGate(_GateBase):
+    """Top-1 routing with capacity dropping (Switch Transformer;
+    reference switch_gate.py)."""
+
+    top_k = 1
+
+    def __call__(self, logits, capacity):
+        t, e = logits.shape
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        idx1 = jnp.argmax(probs, axis=-1)
+        mask1 = _one_hot(idx1, e)
+        aux = _aux_loss(probs, mask1)
+        pos1 = _positions_in_expert(mask1) * mask1
+        keep1 = (jnp.sum(pos1, axis=1) < capacity).astype(jnp.float32)
+        mask1 = mask1 * keep1[:, None]
+        gate1 = jnp.sum(probs * mask1, axis=1)
+        disp = mask1[:, :, None] * _one_hot(
+            jnp.sum(pos1, axis=1).astype(jnp.int32), capacity)[:, None, :]
+        comb = disp * gate1[:, None, None]
+        return disp, comb, aux
+
+
+class GShardGate(_GateBase):
+    """Top-2 routing with capacity (GShard; reference gshard_gate.py)."""
+
+    top_k = 2
+
+    def __call__(self, logits, capacity):
+        t, e = logits.shape
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        idx1 = jnp.argmax(probs, axis=-1)
+        mask1 = _one_hot(idx1, e)
+        probs_wo1 = probs * (1.0 - mask1)
+        idx2 = jnp.argmax(probs_wo1, axis=-1)
+        mask2 = _one_hot(idx2, e)
+
+        aux = _aux_loss(probs, mask1)
+
+        pos1 = jnp.sum(_positions_in_expert(mask1) * mask1, axis=1)
+        count1 = jnp.sum(mask1, axis=0, keepdims=True)          # (1, E)
+        pos2 = jnp.sum((_positions_in_expert(mask2) + count1) * mask2, axis=1)
+        keep1 = (pos1 < capacity).astype(jnp.float32)
+        keep2 = (pos2 < capacity).astype(jnp.float32)
+        mask1 = mask1 * keep1[:, None]
+        mask2 = mask2 * keep2[:, None]
+
+        g1 = jnp.sum(probs * mask1, axis=1)
+        g2 = jnp.sum(probs * mask2, axis=1)
+        denom = jnp.maximum(g1 + g2, 1e-9)
+        g1, g2 = g1 / denom, g2 / denom
+
+        disp1 = mask1[:, :, None] * _one_hot(pos1.astype(jnp.int32),
+                                             capacity)[:, None, :]
+        disp2 = mask2[:, :, None] * _one_hot(pos2.astype(jnp.int32),
+                                             capacity)[:, None, :]
+        disp = jnp.maximum(disp1, disp2)
+        comb = disp1 * g1[:, None, None] + disp2 * g2[:, None, None]
+        return disp, comb, aux
+
+
+class NaiveGate(_GateBase):
+    """Top-k softmax routing without dropping (reference naive_gate.py);
+    capacity is still honored to keep shapes static, but the default
+    MoELayer sizes it so nothing drops (capacity_factor >= num_experts /
+    top_k covers the worst case)."""
+
+    def __init__(self, top_k=2):
+        self.top_k = top_k
+
+    def __call__(self, logits, capacity):
+        t, e = logits.shape
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        disp = jnp.zeros((t, e, capacity), jnp.float32)
+        comb = jnp.zeros((t, e, capacity), jnp.float32)
+        remaining = probs
+        count = jnp.zeros((1, e), jnp.float32)
+        aux = _aux_loss(probs, _one_hot(jnp.argmax(probs, axis=-1), e))
+        for _ in range(self.top_k):
+            idx = jnp.argmax(remaining, axis=-1)
+            mask = _one_hot(idx, e)
+            pos = jnp.sum((_positions_in_expert(mask) + count) * mask, axis=1)
+            keep = (pos < capacity).astype(jnp.float32)
+            mask_k = mask * keep[:, None]
+            g = jnp.sum(probs * mask_k, axis=1)
+            d = mask_k[:, :, None] * _one_hot(pos.astype(jnp.int32),
+                                              capacity)[:, None, :]
+            disp = jnp.maximum(disp, d)
+            comb = comb + d * g[:, None, None]
+            count = count + jnp.sum(mask, axis=0, keepdims=True)
+            remaining = remaining * (1.0 - mask)
+        return disp, comb, aux

@@ -8,3 +8,4 @@ XLA reference implementation stays available as the fallback and the
 numeric oracle in tests.
 """
 from . import flash_attention  # noqa: F401
+from . import norms  # noqa: F401

@@ -1,0 +1,205 @@
+"""Fused RMSNorm / LayerNorm as Pallas TPU kernels.
+
+TPU-native equivalent of the reference's fused norm CUDA kernels
+(paddle/phi/kernels/fusion/gpu/fused_rms_norm*, fused_layernorm*). The
+forward pass is a single VMEM-resident kernel per row block (one HBM read
+of x instead of the multi-pass lowering); the backward uses the saved
+per-row statistics with plain XLA ops — the reductions there are
+matmul-shaped and XLA schedules them well.
+
+RoPE (reference fused_rope*) intentionally stays an XLA composite
+(models/llama.py apply_rotary_pos_emb): it is purely elementwise, so XLA
+fuses it into the adjacent matmuls for free — a hand kernel would only
+duplicate that.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...core import flags as _flags
+from ...core.dispatch import register_op_impl
+
+__all__ = ["rms_norm_pallas", "layer_norm_pallas"]
+
+_ROW_BLOCK = 256
+
+
+def _use_pallas(x):
+    on_tpu = jax.default_backend() == "tpu"
+    return on_tpu or _flags.get_flag("pallas_force_interpret")
+
+
+def _flatten_rows(x):
+    n = x.shape[-1]
+    r = 1
+    for d in x.shape[:-1]:
+        r *= d
+    return x.reshape(r, n), r, n
+
+
+def _pad_rows(x2, br):
+    r = x2.shape[0]
+    pad = (-r) % br
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    return x2
+
+
+# ---------------------------------------------------------------------------
+# RMSNorm
+# ---------------------------------------------------------------------------
+
+def _rms_fwd_kernel(x_ref, w_ref, y_ref, inv_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)                 # (br, N)
+    ms = jnp.mean(x * x, axis=1, keepdims=True)
+    inv = jax.lax.rsqrt(ms + eps)                      # (br, 1)
+    y_ref[...] = (x * inv * w_ref[...].astype(jnp.float32)).astype(y_ref.dtype)
+    inv_ref[...] = inv[:, 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def rms_norm_pallas(x, w, eps, interpret):
+    out, _ = _rms_fwd(x, w, eps, interpret)
+    return out
+
+
+def _rms_fwd(x, w, eps, interpret):
+    x2, r, n = _flatten_rows(x)
+    br = min(_ROW_BLOCK, max(8, r))
+    x2p = _pad_rows(x2, br)
+    rp = x2p.shape[0]
+    y, inv = pl.pallas_call(
+        functools.partial(_rms_fwd_kernel, eps=eps),
+        grid=(rp // br,),
+        in_specs=[
+            pl.BlockSpec((br, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, n), lambda i: (i, 0)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rp, n), x.dtype),
+            jax.ShapeDtypeStruct((rp,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2p, w.reshape(1, n))
+    out = y[:r].reshape(x.shape)
+    return out, (x, w, inv[:r])
+
+
+def _rms_bwd(eps, interpret, res, dy):
+    x, w, inv = res
+    x2, r, n = _flatten_rows(x)
+    dy2 = dy.reshape(r, n).astype(jnp.float32)
+    x32 = x2.astype(jnp.float32)
+    inv = inv[:, None]                                  # (r, 1)
+    g = dy2 * w.astype(jnp.float32)[None, :]
+    # dx = inv*g - x * inv^3 * mean(g*x)
+    m = jnp.mean(g * x32, axis=1, keepdims=True)
+    dx = inv * g - x32 * (inv ** 3) * m
+    dw = jnp.sum(dy2 * x32 * inv, axis=0)
+    return dx.reshape(x.shape).astype(x.dtype), dw.astype(w.dtype)
+
+
+rms_norm_pallas.defvjp(_rms_fwd, _rms_bwd)
+
+
+@register_op_impl("rms_norm", "pallas")
+def _rms_norm_pallas_impl(a, w, eps):
+    if w is None or not _use_pallas(a) or a.shape[-1] % 128 != 0:
+        from ...nn.functional.norm import _rms_norm_xla
+        return _rms_norm_xla(a, w, eps)
+    interpret = jax.default_backend() != "tpu"
+    return rms_norm_pallas(a, w, float(eps), interpret)
+
+
+# ---------------------------------------------------------------------------
+# LayerNorm
+# ---------------------------------------------------------------------------
+
+def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, mu_ref, rstd_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)                 # (br, N)
+    mu = jnp.mean(x, axis=1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = xc * rstd * w_ref[...].astype(jnp.float32) + b_ref[...].astype(
+        jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+    mu_ref[...] = mu[:, 0]
+    rstd_ref[...] = rstd[:, 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def layer_norm_pallas(x, w, b, eps, interpret):
+    out, _ = _ln_fwd(x, w, b, eps, interpret)
+    return out
+
+
+def _ln_fwd(x, w, b, eps, interpret):
+    x2, r, n = _flatten_rows(x)
+    br = min(_ROW_BLOCK, max(8, r))
+    x2p = _pad_rows(x2, br)
+    rp = x2p.shape[0]
+    y, mu, rstd = pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps),
+        grid=(rp // br,),
+        in_specs=[
+            pl.BlockSpec((br, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, n), lambda i: (i, 0)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rp, n), x.dtype),
+            jax.ShapeDtypeStruct((rp,), jnp.float32),
+            jax.ShapeDtypeStruct((rp,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2p, w.reshape(1, n), b.reshape(1, n))
+    out = y[:r].reshape(x.shape)
+    return out, (x, w, b, mu[:r], rstd[:r])
+
+
+def _ln_bwd(eps, interpret, res, dy):
+    x, w, b, mu, rstd = res
+    x2, r, n = _flatten_rows(x)
+    dy2 = dy.reshape(r, n).astype(jnp.float32)
+    x32 = x2.astype(jnp.float32)
+    mu = mu[:, None]
+    rstd = rstd[:, None]
+    xhat = (x32 - mu) * rstd
+    g = dy2 * w.astype(jnp.float32)[None, :]
+    mg = jnp.mean(g, axis=1, keepdims=True)
+    mgx = jnp.mean(g * xhat, axis=1, keepdims=True)
+    dx = rstd * (g - mg - xhat * mgx)
+    dw = jnp.sum(dy2 * xhat, axis=0)
+    db = jnp.sum(dy2, axis=0)
+    return (dx.reshape(x.shape).astype(x.dtype), dw.astype(w.dtype),
+            db.astype(b.dtype))
+
+
+layer_norm_pallas.defvjp(_ln_fwd, _ln_bwd)
+
+
+@register_op_impl("layer_norm", "pallas")
+def _layer_norm_pallas_impl(a, w, b, eps, begin_axis):
+    # fused path: last-axis normalization with both affine params (the
+    # transformer hot path); anything else -> XLA composite
+    if (w is None or b is None or begin_axis != a.ndim - 1
+            or not _use_pallas(a) or a.shape[-1] % 128 != 0):
+        from ...nn.functional.norm import _layer_norm_xla
+        return _layer_norm_xla(a, w, b, eps, begin_axis)
+    interpret = jax.default_backend() != "tpu"
+    return layer_norm_pallas(a, w, b, float(eps), interpret)

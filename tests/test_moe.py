@@ -1,0 +1,111 @@
+"""MoE gates + MoELayer tests (mirrors the reference's moe tests:
+test/collective/collective_global_scatter/gather + gate unit behavior),
+with expert-parallel parity on the 8-device CPU mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.moe import GShardGate, MoELayer, NaiveGate, SwitchGate
+from paddle_tpu.incubate.moe.gate import compute_capacity
+
+
+def test_switch_gate_dispatch_shapes_and_capacity():
+    rng = np.random.RandomState(0)
+    t, e, c = 16, 4, 3
+    logits = jnp.asarray(rng.standard_normal((t, e)), jnp.float32)
+    disp, comb, aux = SwitchGate()(logits, c)
+    assert disp.shape == (t, e, c) and comb.shape == (t, e, c)
+    # every (e, c) slot holds at most one token
+    assert float(jnp.max(jnp.sum(disp, axis=0))) <= 1.0
+    # each token goes to at most one slot
+    assert float(jnp.max(jnp.sum(disp, axis=(1, 2)))) <= 1.0
+    # capacity respected: per-expert token count <= c
+    assert float(jnp.max(jnp.sum(disp, axis=(0, 2)))) <= c
+    assert np.isfinite(float(aux))
+
+
+def test_gshard_gate_top2_combines_two_experts():
+    rng = np.random.RandomState(1)
+    t, e = 8, 4
+    c = compute_capacity(t, e, 2, 2.0)
+    logits = jnp.asarray(rng.standard_normal((t, e)), jnp.float32)
+    disp, comb, aux = GShardGate()(logits, c)
+    # with generous capacity every token hits exactly two experts
+    routed = jnp.sum(disp, axis=(1, 2))
+    np.testing.assert_allclose(np.asarray(routed), 2.0, atol=1e-6)
+    # combine weights per token sum to 1 (normalized top-2)
+    np.testing.assert_allclose(np.asarray(jnp.sum(comb, axis=(1, 2))), 1.0,
+                               atol=1e-5)
+
+
+def test_naive_gate_no_drop_matches_dense_topk():
+    rng = np.random.RandomState(2)
+    t, e = 6, 4
+    c = t  # no drops possible
+    logits = jnp.asarray(rng.standard_normal((t, e)), jnp.float32)
+    disp, comb, aux = NaiveGate(top_k=2)(logits, c)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top2 = jnp.sort(probs, axis=-1)[:, -2:].sum(-1)
+    np.testing.assert_allclose(np.asarray(jnp.sum(comb, axis=(1, 2))),
+                               np.asarray(top2), rtol=1e-5)
+
+
+def test_moe_layer_forward_backward():
+    paddle.seed(0)
+    layer = MoELayer(d_model=16, d_hidden=32, num_experts=4, gate="gshard",
+                     capacity_factor=2.0)
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(
+        np.asarray(rng.standard_normal((2, 8, 16)), np.float32),
+        stop_gradient=False)
+    out = layer(x)
+    assert out.shape == [2, 8, 16]
+    loss = out.sum() + layer.aux_loss
+    loss.backward()
+    assert layer.w1.grad is not None
+    assert layer.gate_weight.grad is not None
+    assert np.isfinite(np.asarray(layer.gate_weight.grad.numpy())).all()
+
+
+def test_moe_single_expert_equals_mlp():
+    """E=1 degenerates to a plain MLP with combine weight 1."""
+    paddle.seed(1)
+    layer = MoELayer(d_model=8, d_hidden=16, num_experts=1, gate="switch",
+                     capacity_factor=4.0)
+    rng = np.random.RandomState(4)
+    xn = np.asarray(rng.standard_normal((1, 4, 8)), np.float32)
+    out = layer(paddle.to_tensor(xn))
+    w1 = np.asarray(layer.w1._data)[0]
+    b1 = np.asarray(layer.b1._data)[0, 0]
+    w2 = np.asarray(layer.w2._data)[0]
+    b2 = np.asarray(layer.b2._data)[0, 0]
+    h = xn.reshape(4, 8) @ w1 + b1
+    h = np.asarray(jax.nn.gelu(jnp.asarray(h)))
+    ref = (h @ w2 + b2).reshape(1, 4, 8)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_moe_expert_parallel_parity():
+    """EP-sharded layer (8-way expert axis) reproduces the unsharded
+    output — the loss-parity oracle for parallelism (SURVEY.md §4)."""
+    paddle.seed(2)
+    layer = MoELayer(d_model=16, d_hidden=32, num_experts=8, gate="gshard",
+                     capacity_factor=2.0)
+    rng = np.random.RandomState(5)
+    xn = np.asarray(rng.standard_normal((2, 16, 16)), np.float32)
+    ref = layer(paddle.to_tensor(xn)).numpy()
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("ep",))
+    paddle.seed(2)
+    layer_ep = MoELayer(d_model=16, d_hidden=32, num_experts=8,
+                        gate="gshard", capacity_factor=2.0, mesh=mesh,
+                        expert_axis="ep")
+    # same seed -> same init; confirm weights actually sharded
+    shard_shape = layer_ep.w1._data.addressable_shards[0].data.shape
+    assert shard_shape[0] == 1, shard_shape
+    out = layer_ep(paddle.to_tensor(xn)).numpy()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
