@@ -1,0 +1,12 @@
+"""Distributed checkpoint with reshard-on-load.
+
+Parity: reference ``python/paddle/distributed/checkpoint/`` — per-process
+shard files + global metadata, overlap-based partial reads so a checkpoint
+saved under one mesh/parallelism loads under any other (SURVEY.md §5.4).
+"""
+from .load_state_dict import (compute_overlap, get_read_items,  # noqa: F401
+                              load_state_dict)
+from .metadata import (LocalTensorIndex, LocalTensorMetadata,  # noqa: F401
+                       Metadata, TensorMetadata)
+from .save_state_dict import save_state_dict  # noqa: F401
+from .utils import flatten_state_dict, unflatten_state_dict  # noqa: F401

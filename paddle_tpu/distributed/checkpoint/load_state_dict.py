@@ -1,0 +1,170 @@
+"""Distributed checkpoint load with reshard-on-load.
+
+Parity: reference ``python/paddle/distributed/checkpoint/load_state_dict.py``
+(``load_state_dict:377``, ``compute_overlap:247``, ``get_read_items:297``):
+the target state_dict may be sharded over a *different* mesh/placements than
+the checkpoint was saved with; for every target shard we compute the overlap
+with each stored chunk and read only the intersecting slices.
+
+TPU-native twist: the target layout is read straight off each
+``jax.Array``'s ``NamedSharding`` (addressable shards), and the resharded
+result is rebuilt with ``jax.make_array_from_single_device_arrays`` so no
+collective or host round-trip of non-owned data ever happens.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from ...core.tensor import Tensor
+from .metadata import Metadata
+from .utils import flatten_state_dict, to_jax_array
+
+
+def compute_overlap(a_offset, a_shape, b_offset, b_shape):
+    """Intersection of two boxes. Returns (offset, shape) in global coords,
+    or None if disjoint. Mirrors reference compute_overlap (:247)."""
+    off, shp = [], []
+    for ao, al, bo, bl in zip(a_offset, a_shape, b_offset, b_shape):
+        lo, hi = max(ao, bo), min(ao + al, bo + bl)
+        if hi <= lo:
+            return None
+        off.append(lo)
+        shp.append(hi - lo)
+    return tuple(off), tuple(shp)
+
+
+def get_read_items(meta: Metadata, name: str, target_offset, target_shape
+                   ) -> List[Tuple[tuple, tuple, object, object]]:
+    """All (global_offset, shape, chunk_meta, chunk_index) intersecting the
+    target box. Mirrors reference get_read_items (:297)."""
+    tm = meta.state_dict_metadata.get(name)
+    if tm is None:
+        return []
+    out = []
+    for cm, ci in tm.chunks:
+        ov = compute_overlap(target_offset, target_shape,
+                             cm.global_offset, cm.local_shape)
+        if ov is not None:
+            out.append((ov[0], ov[1], cm, ci))
+    return out
+
+
+class _ChunkReader:
+    """Lazy npz access: one open NpzFile per shard file, per-key reads."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._files: Dict[str, object] = {}
+
+    def read(self, index) -> np.ndarray:
+        f = self._files.get(index.file_name)
+        if f is None:
+            f = np.load(os.path.join(self._path, index.file_name))
+            self._files[index.file_name] = f
+        return f[index.npz_key]
+
+
+def _assemble(reader: _ChunkReader, meta: Metadata, name: str,
+              offset, shape, dtype) -> np.ndarray:
+    """Fill one target box by copying every intersecting stored slice."""
+    buf = np.zeros(shape, dtype=dtype)
+    covered = 0
+    for ov_off, ov_shape, cm, ci in get_read_items(meta, name, offset, shape):
+        chunk = reader.read(ci)
+        src = tuple(slice(o - co, o - co + l)
+                    for o, l, co in zip(ov_off, ov_shape, cm.global_offset))
+        dst = tuple(slice(o - to, o - to + l)
+                    for o, l, to in zip(ov_off, ov_shape, offset))
+        buf[dst] = chunk[src]
+        covered += int(np.prod(ov_shape))
+    if covered < int(np.prod(shape)):
+        raise ValueError(
+            f"checkpoint '{name}': stored chunks cover only {covered} of "
+            f"{int(np.prod(shape))} elements of target shard at {offset}")
+    return buf
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank: int = 0, unique_id=None) -> None:
+    """In-place load into ``state_dict`` (the reference contract): each leaf
+    keeps its current sharding; data is resharded from the checkpoint
+    layout to the leaf's layout via overlap reads."""
+    del process_group, coordinator_rank, unique_id
+    meta_path = os.path.join(path, "metadata.json")
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(f"no checkpoint metadata at {meta_path}")
+    with open(meta_path) as f:
+        meta = Metadata.from_json(json.load(f))
+    extras_path = os.path.join(path, "extras.pkl")
+    extras = {}
+    if os.path.exists(extras_path):
+        with open(extras_path, "rb") as f:
+            extras = pickle.load(f)
+
+    reader = _ChunkReader(path)
+    flat, mapping = flatten_state_dict(state_dict)
+    for name, leaf in flat.items():
+        arr = to_jax_array(leaf)
+        if arr is None:
+            # non-tensor leaf of any type (step counters, lists, None
+            # placeholders): restore verbatim from the extras sidecar
+            if name in extras and isinstance(state_dict, dict):
+                _set_nested(state_dict, mapping[name], extras[name])
+            continue
+        if name not in meta.state_dict_metadata:
+            continue  # missing keys tolerated, reference behavior
+        tm = meta.state_dict_metadata[name]
+        if tuple(tm.global_shape) != tuple(arr.shape):
+            raise ValueError(
+                f"checkpoint '{name}': saved global shape {tm.global_shape} "
+                f"!= target global shape {tuple(arr.shape)}")
+        new_arr = _load_into_like(reader, meta, name, arr)
+        if isinstance(leaf, Tensor):
+            leaf._data = new_arr
+        elif isinstance(state_dict, dict):
+            _set_nested(state_dict, mapping[name], Tensor(new_arr))
+
+
+def _load_into_like(reader, meta, name, arr):
+    """Build a jax.Array with ``arr``'s sharding filled from the checkpoint."""
+    dtype = np.dtype(arr.dtype) if not isinstance(arr, np.ndarray) \
+        else arr.dtype
+    if isinstance(arr, np.ndarray):
+        full = _assemble(reader, meta, name, (0,) * arr.ndim, arr.shape, dtype)
+        return jax.numpy.asarray(full)
+    sharding = getattr(arr, "sharding", None)
+    shards = getattr(arr, "addressable_shards", None)
+    if sharding is None or not shards:
+        full = _assemble(reader, meta, name, (0,) * arr.ndim,
+                         tuple(arr.shape), dtype)
+        return jax.numpy.asarray(full)
+    per_device = []
+    cache = {}  # replicas share the same (offset, shape): assemble once
+    for sh in shards:
+        idx = sh.index
+        offset = tuple((s.start or 0) for s in idx)
+        shape = tuple((s.stop if s.stop is not None else dim) - (s.start or 0)
+                      for s, dim in zip(idx, arr.shape))
+        local = cache.get((offset, shape))
+        if local is None:
+            local = _assemble(reader, meta, name, offset, shape, dtype)
+            cache[(offset, shape)] = local
+        per_device.append(jax.device_put(local, sh.device))
+    return jax.make_array_from_single_device_arrays(
+        tuple(arr.shape), sharding, per_device)
+
+
+def _set_nested(d: dict, path_parts, value) -> None:
+    cur = d
+    for p in path_parts[:-1]:
+        if not isinstance(cur, dict) or p not in cur:
+            return
+        cur = cur[p]
+    if isinstance(cur, dict):
+        cur[path_parts[-1]] = value

@@ -1,0 +1,81 @@
+"""Distributed-checkpoint metadata model.
+
+Parity with the reference's ``python/paddle/distributed/checkpoint/metadata.py``:
+the saved checkpoint is a set of per-process shard files plus one global
+metadata table recording, for every (flattened) tensor name, which global
+slice each stored chunk covers. Load-time resharding works purely off this
+table (see ``load_state_dict.compute_overlap``).
+
+TPU-native difference: a "chunk" is an addressable shard of a
+``jax.Array`` (one device's local view under a ``NamedSharding``) rather
+than a rank-local DenseTensor; dedup across replicas uses jax's
+``Shard.replica_id`` instead of rank bookkeeping.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalTensorMetadata:
+    """One stored chunk: where it sits in the global tensor."""
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalTensorIndex:
+    """Where a chunk's bytes live on disk."""
+    file_name: str      # npz file (relative to checkpoint dir)
+    npz_key: str        # key inside the npz
+
+
+@dataclasses.dataclass
+class TensorMetadata:
+    global_shape: Tuple[int, ...]
+    dtype: str
+    chunks: List[Tuple[LocalTensorMetadata, LocalTensorIndex]] = \
+        dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Metadata:
+    """The global checkpoint table (one per checkpoint directory)."""
+    state_dict_metadata: Dict[str, TensorMetadata] = \
+        dataclasses.field(default_factory=dict)
+    flat_mapping: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "state_dict_metadata": {
+                k: {
+                    "global_shape": list(v.global_shape),
+                    "dtype": v.dtype,
+                    "chunks": [
+                        {"global_offset": list(m.global_offset),
+                         "local_shape": list(m.local_shape),
+                         "dtype": m.dtype,
+                         "file_name": i.file_name,
+                         "npz_key": i.npz_key}
+                        for m, i in v.chunks
+                    ],
+                } for k, v in self.state_dict_metadata.items()
+            },
+            "flat_mapping": self.flat_mapping,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Metadata":
+        out = cls()
+        for k, v in d.get("state_dict_metadata", {}).items():
+            tm = TensorMetadata(tuple(v["global_shape"]), v["dtype"])
+            for c in v["chunks"]:
+                tm.chunks.append((
+                    LocalTensorMetadata(tuple(c["global_offset"]),
+                                        tuple(c["local_shape"]), c["dtype"]),
+                    LocalTensorIndex(c["file_name"], c["npz_key"])))
+            out.state_dict_metadata[k] = tm
+        out.flat_mapping = dict(d.get("flat_mapping", {}))
+        return out

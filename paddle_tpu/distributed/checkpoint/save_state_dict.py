@@ -1,0 +1,142 @@
+"""Distributed checkpoint save.
+
+Parity: reference ``python/paddle/distributed/checkpoint/save_state_dict.py``
+(``save_state_dict`` at :104): every process writes its local shards to its
+own file; the coordinator merges per-process chunk tables into one global
+``metadata.json``. Non-tensor leaves (step counters, LR-scheduler state) go
+to a pickle sidecar written by the coordinator.
+
+Layout of a checkpoint directory::
+
+    <path>/
+      shard_r{rank}.npz     one per process: its unique local chunks
+      meta_r{rank}.json     per-process chunk table (merged then kept)
+      metadata.json         global table (coordinator)
+      extras.pkl            non-tensor leaves (coordinator)
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+
+import numpy as np
+
+from ..parallel import get_rank, get_world_size
+from .metadata import (LocalTensorIndex, LocalTensorMetadata, Metadata,
+                       TensorMetadata)
+from .utils import array_chunks, flatten_state_dict, to_jax_array
+
+
+def _npz_key(name: str, offset) -> str:
+    return f"{name}|{','.join(map(str, offset))}"
+
+
+def save_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank: int = 0, unique_id=None,
+                    async_save: bool = False) -> None:
+    """Save a (possibly nested, possibly sharded) state_dict to ``path``.
+
+    Every leaf may be a Tensor/jax.Array with any NamedSharding — only the
+    locally-addressable, replica-0 shards are written by this process, so
+    the aggregate over processes is exactly one copy of the global data.
+
+    ``unique_id`` distinguishes successive saves into the same directory
+    (the reference's contract): when re-saving to a fixed path, pass a
+    value all processes agree on (e.g. the global step) so the coordinator
+    never merges a stale table from a previous save.
+    """
+    del async_save
+    uid = 0 if unique_id is None else int(unique_id)
+    if process_group is not None:
+        ranks = list(process_group.ranks)
+        rank = get_rank()
+        if rank not in ranks:
+            return  # not a participant
+        coordinator = ranks[coordinator_rank]
+    else:
+        ranks = list(range(get_world_size()))
+        rank = get_rank()
+        coordinator = coordinator_rank
+    os.makedirs(path, exist_ok=True)
+
+    flat, mapping = flatten_state_dict(state_dict)
+    meta = Metadata(flat_mapping=mapping)
+    extras = {}
+    chunks_out = {}
+    shard_file = f"shard_r{rank}.npz"
+
+    for name, leaf in flat.items():
+        arr = to_jax_array(leaf)
+        if arr is None:
+            extras[name] = leaf
+            continue
+        tm = TensorMetadata(tuple(arr.shape), str(np.dtype(arr.dtype)))
+        for offset, data in array_chunks(arr):
+            key = _npz_key(name, offset)
+            chunks_out[key] = data
+            tm.chunks.append((
+                LocalTensorMetadata(offset, tuple(data.shape),
+                                    str(data.dtype)),
+                LocalTensorIndex(shard_file, key)))
+        meta.state_dict_metadata[name] = tm
+
+    np.savez(os.path.join(path, shard_file), **chunks_out)
+    # npz first, then the table atomically: a merged table never references
+    # bytes that are not yet on disk
+    meta_json = meta.to_json()
+    meta_json["uid"] = uid
+    tmp = os.path.join(path, f".meta_r{rank}.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta_json, f)
+    os.replace(tmp, os.path.join(path, f"meta_r{rank}.json"))
+
+    if rank == coordinator:
+        with open(os.path.join(path, "extras.pkl"), "wb") as f:
+            pickle.dump(extras, f)
+        _merge_metadata(path, ranks, uid)
+
+
+def _merge_metadata(path: str, ranks, uid: int,
+                    timeout_s: float = 300.0) -> None:
+    """Coordinator: wait for every participant's table (matching this save's
+    uid — stale tables from a previous save into the same dir are ignored),
+    merge, write the global table."""
+    deadline = time.time() + timeout_s
+    metas = {}
+    while len(metas) < len(ranks):
+        for r in ranks:
+            if r in metas:
+                continue
+            p = os.path.join(path, f"meta_r{r}.json")
+            if os.path.exists(p):
+                try:
+                    with open(p) as f:
+                        d = json.load(f)
+                    if d.get("uid", 0) == uid:
+                        metas[r] = Metadata.from_json(d)
+                except (json.JSONDecodeError, OSError):
+                    pass  # still being written
+        if len(metas) < len(ranks):
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"save_state_dict: only {len(metas)}/{len(ranks)} "
+                    f"process metadata files (uid={uid}) appeared in "
+                    f"{timeout_s}s")
+            time.sleep(0.05)
+
+    merged = Metadata()
+    for r in sorted(metas):
+        m = metas[r]
+        merged.flat_mapping.update(m.flat_mapping)
+        for name, tm in m.state_dict_metadata.items():
+            dst = merged.state_dict_metadata.setdefault(
+                name, TensorMetadata(tm.global_shape, tm.dtype))
+            seen = {c[0].global_offset for c in dst.chunks}
+            for c in tm.chunks:
+                if c[0].global_offset not in seen:
+                    dst.chunks.append(c)
+                    seen.add(c[0].global_offset)
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(merged.to_json(), f)

@@ -1,0 +1,95 @@
+"""Checkpoint helpers: state-dict flattening and jax-array chunk extraction.
+
+Parity: reference ``python/paddle/distributed/checkpoint/utils.py``
+(``flatten_state_dict``/``unflatten_state_dict``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from ...core.tensor import Tensor
+
+SEP = "."
+
+
+def flatten_state_dict(state_dict) -> Tuple[Dict[str, Any], Dict[str, List[str]]]:
+    """Flatten nested dicts into {joined_key: leaf}. Returns (flat, mapping)
+    where mapping records the original key path for unflatten."""
+    flat: Dict[str, Any] = {}
+    mapping: Dict[str, List[str]] = {}
+
+    def walk(prefix: List[str], obj):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                walk(prefix + [str(k)], v)
+        else:
+            key = SEP.join(prefix)
+            if key in flat:
+                raise ValueError(
+                    f"state_dict flattening collision on '{key}': a dotted "
+                    f"key and a nested path produce the same flat name")
+            flat[key] = obj
+            mapping[key] = list(prefix)
+
+    walk([], state_dict)
+    return flat, mapping
+
+
+def unflatten_state_dict(flat: Dict[str, Any],
+                         mapping: Dict[str, List[str]]) -> dict:
+    out: dict = {}
+    for key, path in mapping.items():
+        cur = out
+        for p in path[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[path[-1]] = flat[key]
+    return out
+
+
+def to_jax_array(value):
+    """Unwrap a state-dict leaf to a jax.Array (or None for non-tensors)."""
+    if isinstance(value, Tensor):
+        return value._data
+    if isinstance(value, jax.Array):
+        return value
+    if isinstance(value, np.ndarray):
+        return value
+    return None
+
+
+def array_chunks(arr) -> List[Tuple[Tuple[int, ...], np.ndarray]]:
+    """Unique (global_offset, host_data) chunks of a possibly-sharded array.
+
+    For a sharded jax.Array we save every addressable shard once
+    (replica_id == 0 dedupes replicas); on multi-host each process only
+    sees — and therefore only saves — its own shards, which is exactly the
+    reference's per-rank shard file layout.
+    """
+    if isinstance(arr, np.ndarray):
+        return [((0,) * arr.ndim, arr)]
+    try:
+        shards = arr.addressable_shards
+    except Exception:
+        shards = None
+    if not shards:
+        return [((0,) * arr.ndim, np.asarray(arr))]
+    out = []
+    seen = set()
+    for sh in shards:
+        if getattr(sh, "replica_id", 0) != 0:
+            continue
+        idx = sh.index  # tuple of slices into the global array
+        offset = tuple((s.start or 0) for s in idx)
+        if offset in seen:
+            continue
+        seen.add(offset)
+        out.append((offset, np.asarray(sh.data)))
+    if not out:  # every addressable shard is a replica (e.g. fully replicated
+        # on a remote-primary host): still persist one copy
+        sh = shards[0]
+        offset = tuple((s.start or 0) for s in sh.index)
+        out.append((offset, np.asarray(sh.data)))
+    return out
