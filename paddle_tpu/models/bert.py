@@ -1,0 +1,280 @@
+"""BERT model family (BASELINE.md config #4: BERT-large 1F1B pipeline).
+
+TPU-first: one plain-layer definition; the pipeline variant re-expresses it
+as a flat LayerDesc list for PipelineLayer so the 1F1B engine partitions it
+into stage sub-meshes, with the MLM decoder tied to the word embedding via
+SharedLayerDesc (the reference's tied-embedding pattern,
+fleet/meta_parallel/parallel_layers/pp_layers.py:76).
+
+Reference parity anchors: encoder structure = post-LN transformer
+(python/paddle/nn/layer/transformer.py TransformerEncoderLayer with
+normalize_before=False); pretraining heads mirror the usual
+BertPretrainingHeads (MLM transform + tied decoder, NSP) shape contract.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.dispatch import run_op
+from ..nn import functional as F
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining",
+           "BertForSequenceClassification", "bert_base", "bert_large",
+           "bert_tiny", "bert_pipeline_model", "bert_param_spec"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dropout: float = 0.1
+    num_labels: int = 2
+
+
+def bert_base():
+    return BertConfig()
+
+
+def bert_large():
+    return BertConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                      intermediate_size=4096)
+
+
+def bert_tiny():
+    """CI-sized config for CPU tests."""
+    return BertConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                      num_heads=4, intermediate_size=128,
+                      max_position_embeddings=64, dropout=0.0)
+
+
+class BertEmbeddings(nn.Layer):
+    """word + position + token-type embeddings, LN, dropout."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        from ..nn.initializer import Normal
+        init = nn.ParamAttr(initializer=Normal(0.0, 0.02))
+        self.word_embeddings = nn.Embedding(config.vocab_size,
+                                            config.hidden_size,
+                                            weight_attr=init)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size,
+            weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(
+            config.type_vocab_size, config.hidden_size, weight_attr=init)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, input_ids, token_type_ids=None):
+        from ..tensor.creation import arange, zeros_like
+        b, s = input_ids.shape
+        max_pos = self.position_embeddings.weight.shape[0]
+        if s > max_pos:
+            raise ValueError(
+                f"sequence length {s} exceeds max_position_embeddings "
+                f"{max_pos}")
+        pos = arange(0, s, dtype="int64")
+        if token_type_ids is None:
+            token_type_ids = zeros_like(input_ids)
+        h = (self.word_embeddings(input_ids)
+             + self.position_embeddings(pos)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(h))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, hidden_size):
+        super().__init__()
+        self.dense = nn.Linear(hidden_size, hidden_size)
+
+    def forward(self, h):
+        return F.tanh(self.dense(h[:, 0]))
+
+
+def _encoder_layer(config: BertConfig):
+    return nn.TransformerEncoderLayer(
+        d_model=config.hidden_size, nhead=config.num_heads,
+        dim_feedforward=config.intermediate_size, dropout=config.dropout,
+        activation="gelu", normalize_before=False,  # post-LN, BERT-style
+        layer_norm_eps=config.layer_norm_eps)
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig, with_pool: bool = True):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = nn.TransformerEncoder(_encoder_layer(config),
+                                             config.num_layers)
+        self.pooler = BertPooler(config.hidden_size) if with_pool else None
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h = self.embeddings(input_ids, token_type_ids)
+        if attention_mask is not None:
+            # [B, S] 0/1 padding mask -> additive [B, 1, 1, S]
+            attention_mask = run_op(
+                "bert_attn_mask",
+                lambda a: ((1.0 - a.astype(jnp.float32))
+                           * -1e9)[:, None, None, :],
+                (attention_mask,))
+        h = self.encoder(h, src_mask=attention_mask)
+        if self.pooler is None:
+            return h
+        return h, self.pooler(h)
+
+
+class BertMLMTransform(nn.Layer):
+    """dense + gelu + LN — the pre-decoder half of the MLM head; shared
+    between the plain and pipeline model constructions."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = nn.Linear(config.hidden_size, config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+
+    def forward(self, h):
+        return self.layer_norm(F.gelu(self.dense(h)))
+
+
+def _mlm_logits(h, embedding_weight, bias):
+    """Tied decoder: logits = h @ W_embed.T + b (single definition so the
+    plain and pipeline paths cannot diverge)."""
+    return run_op("mlm_logits",
+                  lambda a, w, b: jnp.matmul(a, w.T) + b,
+                  (h, embedding_weight, bias))
+
+
+class BertMLMHead(nn.Layer):
+    """Transform + tied decoder (weight passed in at call time)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.transform = BertMLMTransform(config)
+        from ..nn.initializer import Constant
+        self.decoder_bias = self.create_parameter(
+            [config.vocab_size], is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, h, embedding_weight):
+        return _mlm_logits(self.transform(h), embedding_weight,
+                           self.decoder_bias)
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads over BertModel."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config, with_pool=True)
+        self.mlm_head = BertMLMHead(config)
+        self.nsp_head = nn.Linear(config.hidden_size, 2)
+        self.config = config
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        mlm_logits = self.mlm_head(
+            h, self.bert.embeddings.word_embeddings.weight)
+        return mlm_logits, self.nsp_head(pooled)
+
+    def loss(self, input_ids, mlm_labels, nsp_labels=None,
+             token_type_ids=None, attention_mask=None):
+        """MLM loss over positions with label != -100 (+ optional NSP)."""
+        mlm_logits, nsp_logits = self(input_ids, token_type_ids,
+                                      attention_mask)
+        b, s, v = mlm_logits.shape
+        loss = F.cross_entropy(mlm_logits.reshape([b * s, v]),
+                               mlm_labels.reshape([b * s]),
+                               ignore_index=-100)
+        if nsp_labels is not None:
+            loss = loss + F.cross_entropy(nsp_logits, nsp_labels)
+        return loss
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config, with_pool=True)
+        self.dropout = nn.Dropout(config.dropout)
+        self.classifier = nn.Linear(config.hidden_size, config.num_labels)
+        self.config = config
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+# -- pipeline construction (BASELINE config #4: BERT-large 1F1B) ------------
+
+class _EmbeddingPipe(BertEmbeddings):
+    """First pipeline stage: ids -> hidden states. As the SharedLayerDesc
+    instance it also owns the tied MLM decoder weight (its word embedding)
+    and the decoder bias, so the whole tied head lives on one shared
+    layer — the reference's tied-embedding pattern."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        from ..nn.initializer import Constant
+        self.mlm_bias = self.create_parameter(
+            [config.vocab_size], is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, input_ids):  # pipeline items are x -> x
+        return super().forward(input_ids, None)
+
+
+def _tied_decoder_forward(shared_embed: _EmbeddingPipe, h):
+    return _mlm_logits(h, shared_embed.word_embeddings.weight,
+                       shared_embed.mlm_bias)
+
+
+def bert_pipeline_model(config: BertConfig, num_stages: int,
+                        loss_fn=None):
+    """Build BERT-for-MLM as a PipelineLayer (flat LayerDesc list with the
+    embedding shared between stage 0 and the LM head on the last stage)."""
+    from ..distributed.fleet.meta_parallel.parallel_layers import (
+        LayerDesc, PipelineLayer, SharedLayerDesc)
+
+    if loss_fn is None:
+        def loss_fn(logits, labels):
+            b, s, v = logits.shape
+            return F.cross_entropy(logits.reshape([b * s, v]),
+                                   labels.reshape([b * s]),
+                                   ignore_index=-100)
+
+    descs = [SharedLayerDesc("embed", _EmbeddingPipe, config)]
+    for _ in range(config.num_layers):
+        descs.append(LayerDesc(
+            nn.TransformerEncoderLayer, d_model=config.hidden_size,
+            nhead=config.num_heads, dim_feedforward=config.intermediate_size,
+            dropout=config.dropout, activation="gelu",
+            normalize_before=False, layer_norm_eps=config.layer_norm_eps))
+    descs.append(LayerDesc(BertMLMTransform, config))
+    descs.append(SharedLayerDesc("embed", _EmbeddingPipe, config,
+                                 forward_func=_tied_decoder_forward))
+    return PipelineLayer(descs, num_stages=num_stages, loss_fn=loss_fn,
+                         seg_method="layer:TransformerEncoderLayer")
+
+
+def bert_param_spec(name: str):
+    """Megatron TP placements over a ('dp','tp') mesh for BERT params:
+    column-parallel qkv/fc1, row-parallel out/fc2, vocab-parallel word
+    embedding (same scheme the reference's mp_layers apply)."""
+    from jax.sharding import PartitionSpec as P
+    if "word_embeddings" in name:
+        return P("tp", None)
+    if any(k in name for k in ("q_proj", "k_proj", "v_proj", "linear1")):
+        return P(None, "tp") if name.endswith("weight") else P("tp")
+    if any(k in name for k in ("out_proj", "linear2")):
+        return P("tp", None) if name.endswith("weight") else P()
+    return P()
