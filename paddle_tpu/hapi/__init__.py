@@ -1,0 +1,5 @@
+"""High-level Model API (parity: python/paddle/hapi/)."""
+from . import callbacks  # noqa: F401
+from .callbacks import (Callback, EarlyStopping, LRScheduler,  # noqa: F401
+                        ModelCheckpoint, ProgBarLogger)
+from .model import Model  # noqa: F401

@@ -1,0 +1,198 @@
+"""hapi callbacks (parity: python/paddle/hapi/callbacks.py — Callback
+base, CallbackList dispatch, ProgBarLogger, ModelCheckpoint, EarlyStopping,
+LRScheduler)."""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+__all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "LRScheduler"]
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = dict(params or {})
+
+    def on_train_begin(self, logs=None): ...
+    def on_train_end(self, logs=None): ...
+    def on_eval_begin(self, logs=None): ...
+    def on_eval_end(self, logs=None): ...
+    def on_predict_begin(self, logs=None): ...
+    def on_predict_end(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_epoch_end(self, epoch, logs=None): ...
+    def on_train_batch_begin(self, step, logs=None): ...
+    def on_train_batch_end(self, step, logs=None): ...
+    def on_eval_batch_begin(self, step, logs=None): ...
+    def on_eval_batch_end(self, step, logs=None): ...
+    def on_predict_batch_begin(self, step, logs=None): ...
+    def on_predict_batch_end(self, step, logs=None): ...
+
+
+class CallbackList:
+    def __init__(self, callbacks=None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cb):
+        self.callbacks.append(cb)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            def dispatch(*args, **kwargs):
+                for c in self.callbacks:
+                    getattr(c, name)(*args, **kwargs)
+            return dispatch
+        raise AttributeError(name)
+
+
+class ProgBarLogger(Callback):
+    """Per-epoch stdout logging (parity: hapi ProgBarLogger, text mode)."""
+
+    def __init__(self, log_freq: int = 1, verbose: int = 2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+        if self.verbose:
+            print(f"Epoch {epoch + 1}/{self.params.get('epochs', '?')}")
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose > 1 and step % self.log_freq == 0:
+            msg = " - ".join(f"{k}: {_fmt(v)}"
+                             for k, v in (logs or {}).items())
+            print(f"  step {step}: {msg}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            msg = " - ".join(f"{k}: {_fmt(v)}"
+                             for k, v in (logs or {}).items())
+            print(f"  epoch {epoch + 1} done in "
+                  f"{time.time() - self._t0:.1f}s - {msg}")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            msg = " - ".join(f"{k}: {_fmt(v)}"
+                             for k, v in (logs or {}).items())
+            print(f"  eval - {msg}")
+
+
+def _fmt(v):
+    if isinstance(v, (list, tuple)):
+        return "[" + ", ".join(_fmt(x) for x in v) + "]"
+    if isinstance(v, float):
+        return f"{v:.4f}"
+    return str(v)
+
+
+class ModelCheckpoint(Callback):
+    """Save model+optimizer every ``save_freq`` epochs (parity: hapi
+    ModelCheckpoint)."""
+
+    def __init__(self, save_freq: int = 1, save_dir: str = "checkpoint"):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model is not None and epoch % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.model is not None:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    """Stop when a monitored metric stops improving (parity: hapi
+    EarlyStopping)."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0,
+                 verbose=1, min_delta=0, baseline=None,
+                 save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        if mode == "auto":
+            mode = "min" if ("loss" in monitor or "err" in monitor) else "max"
+        self.mode = mode
+        self.stopped_epoch = 0
+        self.stop_training = False
+
+    def on_train_begin(self, logs=None):
+        self.wait_epoch = 0
+        self.best_value = self.baseline if self.baseline is not None else (
+            np.inf if self.mode == "min" else -np.inf)
+
+    def on_eval_end(self, logs=None):
+        value = (logs or {}).get(self.monitor)
+        if value is None:
+            return
+        if isinstance(value, (list, tuple, np.ndarray)):
+            value = float(np.asarray(value).reshape(-1)[0])
+        improved = (value < self.best_value - self.min_delta
+                    if self.mode == "min"
+                    else value > self.best_value + self.min_delta)
+        if improved:
+            self.best_value = value
+            self.wait_epoch = 0
+            save_dir = self.params.get("save_dir")
+            if self.save_best_model and save_dir and self.model is not None:
+                self.model.save(os.path.join(save_dir, "best_model"))
+        else:
+            self.wait_epoch += 1
+        if self.wait_epoch > self.patience:
+            self.stop_training = True
+            if self.model is not None:
+                self.model.stop_training = True
+            if self.verbose:
+                print(f"EarlyStopping: no {self.monitor} improvement "
+                      f"for {self.wait_epoch} evals, stopping")
+
+
+class LRScheduler(Callback):
+    """Step the optimizer's LR scheduler (parity: hapi LRScheduler)."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        assert by_step != by_epoch, "exactly one of by_step/by_epoch"
+        self.by_step = by_step
+
+    def _step(self):
+        opt = getattr(self.model, "_optimizer", None)
+        sched = getattr(opt, "_lr", None) if opt else None
+        if sched is not None and hasattr(sched, "step"):
+            sched.step()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            self._step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not self.by_step:
+            self._step()

@@ -1,0 +1,280 @@
+"""hapi high-level Model API (parity: python/paddle/hapi/model.py —
+Model.fit:1054, evaluate:294-ish, predict:780, train_batch, save/load).
+
+TPU-native: there is one execution mode — eager ops trace into XLA per op;
+the reference's Dynamic/Static adapter split is unnecessary. The training
+loop is plain Python over DataLoader batches.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework import load as _load
+from ..framework import save as _save
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from .callbacks import Callback, CallbackList, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+def _as_tuple(x):
+    if isinstance(x, (list, tuple)):
+        return tuple(x)
+    return (x,)
+
+
+def _to_tensors(xs):
+    return tuple(x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+                 for x in _as_tuple(xs))
+
+
+def _update_metric(m, outputs, labels):
+    """compute() may return a tuple (base passthrough) or a single
+    pre-processed array (e.g. Accuracy's correct matrix) — only a tuple is
+    star-unpacked into update()."""
+    res = m.compute(*(_as_tuple(outputs) + _as_tuple(labels)))
+    if isinstance(res, tuple):
+        m.update(*res)
+    else:
+        m.update(res)
+    return m.accumulate()
+
+
+class Model:
+    """High-level train/eval/predict wrapper over an ``nn.Layer``."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    # -- setup -------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        del amp_configs  # bf16-first: no loss scaling needed on TPU
+        self._optimizer = optimizer
+        if loss is not None and not callable(loss):
+            raise TypeError("loss should be callable (a loss Layer or fn)")
+        self._loss = loss
+        metrics = metrics or []
+        for m in _as_tuple(metrics) if metrics else ():
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m!r} is not a paddle.metric.Metric")
+        self._metrics = list(_as_tuple(metrics)) if metrics else []
+        return self
+
+    # -- single-batch ops (parity: train_batch/eval_batch/predict_batch) ---
+    def train_batch(self, inputs, labels=None, update=True):
+        assert self._optimizer is not None, "call prepare() first"
+        self.network.train()
+        inputs = _to_tensors(inputs)
+        outputs = self.network(*inputs)
+        metrics_out = []
+        if self._loss is not None and labels is not None:
+            labels = _to_tensors(labels)
+            loss = self._loss(*(_as_tuple(outputs) + labels))
+        else:
+            loss = outputs if isinstance(outputs, Tensor) else outputs[0]
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        for m in self._metrics:
+            if labels is not None:
+                metrics_out.append(_update_metric(m, outputs, labels))
+        out = [float(loss)]
+        return (out + metrics_out) if metrics_out else out
+
+    def eval_batch(self, inputs, labels=None):
+        from ..core.autograd import no_grad
+        self.network.eval()
+        inputs = _to_tensors(inputs)
+        with no_grad():
+            outputs = self.network(*inputs)
+            metrics_out = []
+            if self._loss is not None and labels is not None:
+                labels = _to_tensors(labels)
+                loss = float(self._loss(*(_as_tuple(outputs) + labels)))
+            else:
+                loss = None
+            for m in self._metrics:
+                if labels is not None:
+                    metrics_out.append(
+                        _update_metric(m, outputs, _to_tensors(labels)))
+        out = [loss] if loss is not None else []
+        return out + metrics_out
+
+    def predict_batch(self, inputs):
+        from ..core.autograd import no_grad
+        self.network.eval()
+        with no_grad():
+            out = self.network(*_to_tensors(inputs))
+        return out
+
+    # -- loops -------------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        raise TypeError(f"expected Dataset or DataLoader, got {type(data)}")
+
+    @staticmethod
+    def _split_batch(batch):
+        """DataLoader yields (input..., label): split on the loss arity
+        convention — last element is the label when a loss is prepared."""
+        batch = _as_tuple(batch)
+        if len(batch) == 1:
+            return batch[0], None
+        return batch[:-1], batch[-1]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, shuffle=True, callbacks=None, accumulate_grad_batches=1):
+        """Train over epochs (parity: hapi Model.fit:1054)."""
+        loader = self._loader(train_data, batch_size, shuffle)
+        eval_loader = self._loader(eval_data, batch_size, False)
+        cbks = CallbackList([ProgBarLogger(log_freq, verbose)]
+                            + list(callbacks or []))
+        if save_dir:
+            from .callbacks import ModelCheckpoint
+            cbks.append(ModelCheckpoint(save_freq, save_dir))
+        cbks.set_model(self)
+        cbks.set_params({"epochs": epochs, "verbose": verbose,
+                         "save_dir": save_dir,
+                         "metrics": ["loss"] + [m.name()
+                                                for m in self._metrics]})
+        self.stop_training = False
+        cbks.on_train_begin()
+        history = {"loss": []}
+        epoch_logs = {}
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            epoch_logs = {}
+            batch_losses = []
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                x, y = self._split_batch(batch)
+                update = (step + 1) % accumulate_grad_batches == 0
+                res = self.train_batch(x, y, update=update)
+                batch_losses.append(res[0])
+                epoch_logs = {"loss": res[0]}
+                for m, v in zip(self._metrics, res[1:]):
+                    epoch_logs[m.name() if isinstance(m.name(), str)
+                               else m.name()[0]] = v
+                cbks.on_train_batch_end(step, epoch_logs)
+            if batch_losses:  # epoch summary: mean loss, not last batch
+                epoch_logs["loss"] = float(np.mean(batch_losses))
+            history["loss"].append(epoch_logs.get("loss"))
+            cbks.on_epoch_end(epoch, epoch_logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cbks)
+                for k, v in eval_logs.items():
+                    history.setdefault(f"eval_{k}", []).append(v)
+            if self.stop_training:
+                break
+        cbks.on_train_end(epoch_logs)
+        return history
+
+    def _run_eval(self, loader, cbks):
+        cbks.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            x, y = self._split_batch(batch)
+            res = self.eval_batch(x, y)
+            if res and res[0] is not None:
+                losses.append(res[0])
+            cbks.on_eval_batch_end(step, logs)
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            name = m.name() if isinstance(m.name(), str) else m.name()[0]
+            logs[name] = m.accumulate()
+        cbks.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 callbacks=None):
+        loader = self._loader(eval_data, batch_size, False)
+        cbks = CallbackList([ProgBarLogger(log_freq, verbose)]
+                            + list(callbacks or []))
+        cbks.set_model(self)
+        return self._run_eval(loader, cbks)
+
+    def predict(self, test_data, batch_size=1, stack_outputs=True,
+                verbose=1, callbacks=None):
+        loader = self._loader(test_data, batch_size, False)
+        cbks = CallbackList(list(callbacks or []))
+        cbks.set_model(self)
+        cbks.on_predict_begin()
+        outs = []
+        for step, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step)
+            batch = _as_tuple(batch)
+            if self._loss is not None and len(batch) > 1:
+                batch, _ = self._split_batch(batch)  # drop labels
+            out = self.predict_batch(batch)
+            outs.append([o.numpy() for o in _as_tuple(out)])
+            cbks.on_predict_batch_end(step)
+        cbks.on_predict_end()
+        n_out = len(outs[0]) if outs else 0
+        grouped = [[b[i] for b in outs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g, axis=0) for g in grouped]
+        return grouped
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path, training=True):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = _load(path + ".pdparams")
+        missing, unexpected = self.network.set_state_dict(state)
+        if not skip_mismatch:
+            if unexpected:
+                raise ValueError(
+                    f"unexpected keys in checkpoint: {unexpected}")
+            if missing:
+                raise ValueError(
+                    f"keys missing from checkpoint: {missing}")
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(int(np.prod(p.shape))
+                       for p in self.network.parameters())
+        lines = [f"{type(self.network).__name__}: "
+                 f"{n_params:,} parameters"]
+        for name, sub in self.network.named_sublayers():
+            sub_n = sum(int(np.prod(p.shape))
+                        for p in sub.parameters(include_sublayers=False))
+            if sub_n:
+                lines.append(f"  {name} ({type(sub).__name__}): {sub_n:,}")
+        text = "\n".join(lines)
+        print(text)
+        return {"total_params": n_params, "text": text}

@@ -1,0 +1,204 @@
+"""Metrics (parity: python/paddle/metric/metrics.py — Metric base,
+Accuracy, Precision, Recall, Auc).
+
+TPU-native note: metric accumulation is host-side numpy over already-
+computed device outputs (tiny data), so nothing here enters the jitted
+step; distributed aggregation composes with dist.all_reduce on the final
+scalar states (fleet/metrics pattern).
+"""
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+
+
+def _to_np(x):
+    if hasattr(x, "numpy"):
+        return x.numpy()
+    return np.asarray(x)
+
+
+class Metric(abc.ABC):
+    """Base class (parity: paddle.metric.Metric)."""
+
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def reset(self):
+        ...
+
+    @abc.abstractmethod
+    def update(self, *args):
+        ...
+
+    @abc.abstractmethod
+    def accumulate(self):
+        ...
+
+    @abc.abstractmethod
+    def name(self):
+        ...
+
+    def compute(self, *args):
+        """Optional pre-processing on device outputs; default passthrough."""
+        return args
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (parity: paddle.metric.Accuracy)."""
+
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred = _to_np(pred)
+        label = _to_np(label)
+        idx = np.argsort(-pred, axis=-1)[..., :self.maxk]
+        if label.ndim == pred.ndim:
+            if label.shape[-1] == pred.shape[-1]:  # one-hot / soft label
+                label = np.argmax(label, axis=-1)
+            elif label.shape[-1] == 1:  # [N, 1] index labels
+                label = label[..., 0]
+            else:
+                raise ValueError(
+                    f"label shape {label.shape} incompatible with pred "
+                    f"shape {pred.shape}")
+        correct = (idx == label[..., None]).astype(np.float32)
+        return correct
+
+    def update(self, correct, *args):
+        correct = _to_np(correct)
+        num_samples = int(np.prod(correct.shape[:-1]))
+        accs = []
+        for i, k in enumerate(self.topk):
+            c = float(correct[..., :k].sum())
+            self.total[i] += c
+            accs.append(c / max(num_samples, 1))
+        self.count += num_samples
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = 0
+
+    def accumulate(self):
+        res = [t / self.count if self.count else 0.0 for t in self.total]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision = tp / (tp + fp) (parity: paddle.metric.Precision).
+    Predictions are probabilities of the positive class; threshold 0.5."""
+
+    def __init__(self, name="precision"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_np(preds).reshape(-1)
+        labels = _to_np(labels).reshape(-1)
+        pred_pos = preds > 0.5
+        self.tp += int(np.sum(pred_pos & (labels == 1)))
+        self.fp += int(np.sum(pred_pos & (labels != 1)))
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        d = self.tp + self.fp
+        return self.tp / d if d else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall = tp / (tp + fn) (parity: paddle.metric.Recall)."""
+
+    def __init__(self, name="recall"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_np(preds).reshape(-1)
+        labels = _to_np(labels).reshape(-1)
+        actual_pos = labels == 1
+        self.tp += int(np.sum((preds > 0.5) & actual_pos))
+        self.fn += int(np.sum((preds <= 0.5) & actual_pos))
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        d = self.tp + self.fn
+        return self.tp / d if d else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC-AUC via threshold bucketing (parity: paddle.metric.Auc with
+    curve='ROC', num_thresholds buckets)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        super().__init__()
+        if curve != "ROC":
+            raise ValueError("only ROC is supported")
+        self.num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_np(preds)
+        labels = _to_np(labels).reshape(-1)
+        if preds.ndim == 2:  # [N, 2] class probs: take positive column
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        buckets = np.minimum((preds * self.num_thresholds).astype(np.int64),
+                             self.num_thresholds)
+        pos = np.bincount(buckets[labels == 1],
+                          minlength=self.num_thresholds + 1)
+        neg = np.bincount(buckets[labels != 1],
+                          minlength=self.num_thresholds + 1)
+        self._stat_pos += pos
+        self._stat_neg += neg
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds + 1, np.int64)
+
+    def accumulate(self):
+        # integrate TPR over FPR, descending threshold (trapezoid rule)
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        pos_cum = np.cumsum(self._stat_pos[::-1])
+        neg_cum = np.cumsum(self._stat_neg[::-1])
+        tpr = pos_cum / tot_pos
+        fpr = neg_cum / tot_neg
+        tpr = np.concatenate([[0.0], tpr])
+        fpr = np.concatenate([[0.0], fpr])
+        trapezoid = getattr(np, "trapezoid", np.trapz)
+        return float(trapezoid(tpr, fpr))
+
+    def name(self):
+        return self._name

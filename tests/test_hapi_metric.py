@@ -1,0 +1,124 @@
+"""hapi Model.fit/evaluate/predict + paddle.metric tests (reference test
+model: test/legacy_test/test_metrics.py, hapi model tests)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+
+
+class TestMetrics:
+    def test_accuracy_top1(self):
+        m = Accuracy()
+        pred = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+        label = np.array([1, 0, 0])
+        m.update(m.compute(pred, label))
+        np.testing.assert_allclose(m.accumulate(), 2 / 3)
+        m.reset()
+        assert m.accumulate() == 0.0
+
+    def test_accuracy_topk(self):
+        m = Accuracy(topk=(1, 2))
+        pred = np.array([[0.5, 0.3, 0.2], [0.1, 0.4, 0.5]])
+        label = np.array([1, 1])
+        m.update(m.compute(pred, label))
+        top1, top2 = m.accumulate()
+        assert (top1, top2) == (0.0, 1.0)
+        assert m.name() == ["acc_top1", "acc_top2"]
+
+    def test_precision_recall(self):
+        p, r = Precision(), Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.6])
+        labels = np.array([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert p.accumulate() == pytest.approx(2 / 3)  # tp=2 fp=1
+        assert r.accumulate() == pytest.approx(2 / 3)  # tp=2 fn=1
+
+    def test_auc_perfect_and_random(self):
+        auc = Auc()
+        preds = np.array([0.9, 0.8, 0.1, 0.2])
+        labels = np.array([1, 1, 0, 0])
+        auc.update(preds, labels)
+        assert auc.accumulate() == pytest.approx(1.0, abs=1e-3)
+        auc.reset()
+        auc.update(np.array([[0.5, 0.5]] * 4),
+                   np.array([1, 0, 1, 0]))
+        assert 0.0 <= auc.accumulate() <= 1.0
+
+
+def _toy_dataset(n=64, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d, 2).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int64)
+    return paddle.io.TensorDataset([paddle.to_tensor(x),
+                                    paddle.to_tensor(y)])
+
+
+class TestHapiModel:
+    def _model(self):
+        paddle.seed(0)
+        net = paddle.nn.Sequential(
+            paddle.nn.Linear(8, 32), paddle.nn.ReLU(),
+            paddle.nn.Linear(32, 2))
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.Adam(
+                0.01, parameters=net.parameters()),
+            loss=paddle.nn.CrossEntropyLoss(),
+            metrics=Accuracy())
+        return model
+
+    def test_fit_loss_drops_and_acc_rises(self, capsys):
+        model = self._model()
+        ds = _toy_dataset()
+        hist = model.fit(ds, epochs=5, batch_size=16, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0]
+        logs = model.evaluate(ds, batch_size=16, verbose=0)
+        assert logs["acc"] > 0.8
+        assert "loss" in logs
+
+    def test_predict_shapes(self):
+        model = self._model()
+        ds = _toy_dataset(n=20)
+        out = model.predict(ds, batch_size=8)
+        assert len(out) == 1
+        assert out[0].shape == (20, 2)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        model = self._model()
+        ds = _toy_dataset()
+        model.fit(ds, epochs=1, batch_size=16, verbose=0)
+        path = str(tmp_path / "ckpt" / "m")
+        model.save(path)
+        assert os.path.exists(path + ".pdparams")
+        assert os.path.exists(path + ".pdopt")
+        ref = model.evaluate(ds, batch_size=16, verbose=0)
+        model2 = self._model()
+        model2.load(path)
+        got = model2.evaluate(ds, batch_size=16, verbose=0)
+        np.testing.assert_allclose(got["loss"], ref["loss"], rtol=1e-5)
+
+    def test_early_stopping(self):
+        model = self._model()
+        ds = _toy_dataset()
+        es = paddle.callbacks.EarlyStopping(monitor="loss", patience=0,
+                                            baseline=0.0, verbose=0)
+        model.fit(ds, eval_data=ds, epochs=10, batch_size=16, verbose=0,
+                  callbacks=[es])
+        assert model.stop_training
+
+    def test_model_checkpoint_callback(self, tmp_path):
+        model = self._model()
+        ds = _toy_dataset(n=16)
+        model.fit(ds, epochs=2, batch_size=8, verbose=0,
+                  save_dir=str(tmp_path / "ck"))
+        assert os.path.exists(str(tmp_path / "ck" / "final.pdparams"))
+
+    def test_summary(self, capsys):
+        model = self._model()
+        info = model.summary()
+        assert info["total_params"] == 8 * 32 + 32 + 32 * 2 + 2
