@@ -1,0 +1,57 @@
+"""Native-extension loader: compiles C++ sources from ``paddle_tpu/csrc``
+into cached shared libraries and loads them via ctypes.
+
+Role parity: the reference ships its runtime (store, allocator, executors)
+as C++ linked into the wheel; here native components are JIT-compiled once
+per source-hash with g++ (the image has no pybind11, so the C ABI + ctypes
+is the binding layer — reference's capi approach, paddle/phi/capi/).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc")
+_lock = threading.Lock()
+_loaded: dict = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _cache_dir() -> str:
+    d = os.environ.get("PADDLE_TPU_NATIVE_CACHE",
+                       os.path.join(tempfile.gettempdir(),
+                                    f"paddle_tpu_native_{os.getuid()}"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load_native(name: str, extra_flags=()) -> ctypes.CDLL:
+    """Compile (once per content hash) and dlopen ``csrc/<name>.cpp``."""
+    with _lock:
+        if name in _loaded:
+            return _loaded[name]
+        src = os.path.join(_CSRC, f"{name}.cpp")
+        with open(src, "rb") as f:
+            content = f.read()
+        tag = hashlib.sha256(content + b"\0".join(
+            str(f).encode() for f in extra_flags)).hexdigest()[:16]
+        so = os.path.join(_cache_dir(), f"lib{name}_{tag}.so")
+        if not os.path.exists(so):
+            tmp = so + f".build{os.getpid()}"
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   "-pthread", *extra_flags, "-o", tmp, src]
+            r = subprocess.run(cmd, capture_output=True, text=True)
+            if r.returncode != 0:
+                raise NativeBuildError(
+                    f"g++ failed for {name}: {r.stderr[-2000:]}")
+            os.replace(tmp, so)  # atomic under concurrent builders
+        lib = ctypes.CDLL(so)
+        _loaded[name] = lib
+        return lib

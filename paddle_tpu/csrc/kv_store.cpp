@@ -1,0 +1,388 @@
+// Native host-coordination KV store (TCPStore equivalent).
+//
+// Capability parity with the reference's rendezvous store
+// (paddle/phi/core/distributed/store/tcp_store.h:121, socket.cpp): a rank-0
+// TCP server holding a byte-value map with SET/GET/ADD/WAIT/DEL/NUMKEYS,
+// blocking WAIT via condition variables, used for launch rendezvous,
+// elastic heartbeats and checkpoint barriers. On TPU the data-plane
+// collectives are compiled into XLA programs, so this store is host-side
+// control-plane only — exactly the role the reference's TCPStore plays.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in the image).
+//
+// Wire protocol (little-endian):
+//   request : u8 cmd | u32 klen | key | i64 arg | u32 vlen | value
+//   response: i64 ret | u32 vlen | value
+// cmds: 1=SET 2=GET 3=ADD 4=WAIT 5=DEL 6=NUMKEYS 7=PING
+// ret < 0: -1 key missing, -2 timeout, -3 protocol error.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <list>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Storage {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> data;
+};
+
+bool read_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_reply(int fd, int64_t ret, const std::string& val) {
+  uint32_t vlen = static_cast<uint32_t>(val.size());
+  std::string out;
+  out.resize(12 + val.size());
+  std::memcpy(&out[0], &ret, 8);
+  std::memcpy(&out[8], &vlen, 4);
+  if (!val.empty()) std::memcpy(&out[12], val.data(), val.size());
+  return write_exact(fd, out.data(), out.size());
+}
+
+struct Worker {
+  int fd = -1;
+  std::thread thread;
+  std::atomic<bool> done{false};
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stopping{false};
+  std::thread accept_thread;
+  std::list<std::unique_ptr<Worker>> workers;
+  std::mutex workers_mu;
+  Storage store;
+
+  void handle_conn(int fd, Worker* self) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    while (!stopping.load()) {
+      uint8_t cmd;
+      uint32_t klen;
+      if (!read_exact(fd, &cmd, 1) || !read_exact(fd, &klen, 4)) break;
+      if (klen > (1u << 20)) break;
+      std::string key(klen, '\0');
+      if (klen && !read_exact(fd, &key[0], klen)) break;
+      int64_t arg;
+      uint32_t vlen;
+      if (!read_exact(fd, &arg, 8) || !read_exact(fd, &vlen, 4)) break;
+      if (vlen > (1u << 26)) break;  // 64 MB value cap
+      std::string val(vlen, '\0');
+      if (vlen && !read_exact(fd, &val[0], vlen)) break;
+
+      bool ok = true;
+      switch (cmd) {
+        case 1: {  // SET
+          std::lock_guard<std::mutex> lk(store.mu);
+          store.data[key] = val;
+          store.cv.notify_all();
+          ok = send_reply(fd, 0, "");
+          break;
+        }
+        case 2: {  // GET
+          std::lock_guard<std::mutex> lk(store.mu);
+          auto it = store.data.find(key);
+          if (it == store.data.end()) {
+            ok = send_reply(fd, -1, "");
+          } else {
+            ok = send_reply(fd, 0, it->second);
+          }
+          break;
+        }
+        case 3: {  // ADD(arg) -> new value; value stored as decimal string
+          std::lock_guard<std::mutex> lk(store.mu);
+          int64_t cur = 0;
+          auto it = store.data.find(key);
+          if (it != store.data.end() && !it->second.empty()) {
+            cur = std::strtoll(it->second.c_str(), nullptr, 10);
+          }
+          cur += arg;
+          store.data[key] = std::to_string(cur);
+          store.cv.notify_all();
+          // counter travels in the value field: the i64 ret stays a pure
+          // status code even for negative counters
+          ok = send_reply(fd, 0, store.data[key]);
+          break;
+        }
+        case 4: {  // WAIT(timeout_ms in arg; arg<=0 -> wait forever)
+          std::unique_lock<std::mutex> lk(store.mu);
+          auto pred = [&] {
+            return stopping.load() || store.data.count(key) > 0;
+          };
+          bool found;
+          if (arg > 0) {
+            found = store.cv.wait_for(lk, std::chrono::milliseconds(arg),
+                                      pred);
+          } else {
+            store.cv.wait(lk, pred);
+            found = true;
+          }
+          if (stopping.load()) {
+            ok = false;
+          } else {
+            ok = send_reply(fd, (found && store.data.count(key)) ? 0 : -2,
+                            "");
+          }
+          break;
+        }
+        case 5: {  // DEL
+          std::lock_guard<std::mutex> lk(store.mu);
+          int64_t n = static_cast<int64_t>(store.data.erase(key));
+          ok = send_reply(fd, n, "");
+          break;
+        }
+        case 6: {  // NUMKEYS
+          std::lock_guard<std::mutex> lk(store.mu);
+          ok = send_reply(fd, static_cast<int64_t>(store.data.size()), "");
+          break;
+        }
+        case 7:  // PING
+          ok = send_reply(fd, 0, "");
+          break;
+        default:
+          ok = send_reply(fd, -3, "");
+          break;
+      }
+      if (!ok) break;
+    }
+    ::close(fd);
+    self->done.store(true);
+  }
+
+  void reap_finished() {  // caller holds workers_mu
+    for (auto it = workers.begin(); it != workers.end();) {
+      if ((*it)->done.load()) {
+        if ((*it)->thread.joinable()) (*it)->thread.join();
+        it = workers.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  void accept_loop() {
+    while (!stopping.load()) {
+      sockaddr_in peer{};
+      socklen_t plen = sizeof(peer);
+      int fd = ::accept(listen_fd, reinterpret_cast<sockaddr*>(&peer), &plen);
+      if (fd < 0) {
+        if (stopping.load()) break;
+        continue;
+      }
+      std::lock_guard<std::mutex> lk(workers_mu);
+      reap_finished();
+      auto w = std::make_unique<Worker>();
+      w->fd = fd;
+      Worker* wp = w.get();
+      w->thread = std::thread(&Server::handle_conn, this, fd, wp);
+      workers.push_back(std::move(w));
+    }
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one request in flight per client handle
+};
+
+int64_t roundtrip(Client* c, uint8_t cmd, const char* key, int64_t arg,
+                  const void* val, uint32_t vlen, std::string* out) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  std::string req;
+  req.resize(1 + 4 + klen + 8 + 4 + vlen);
+  size_t off = 0;
+  std::memcpy(&req[off], &cmd, 1); off += 1;
+  std::memcpy(&req[off], &klen, 4); off += 4;
+  std::memcpy(&req[off], key, klen); off += klen;
+  std::memcpy(&req[off], &arg, 8); off += 8;
+  std::memcpy(&req[off], &vlen, 4); off += 4;
+  if (vlen) std::memcpy(&req[off], val, vlen);
+  if (!write_exact(c->fd, req.data(), req.size())) return -100;
+  int64_t ret;
+  uint32_t rlen;
+  if (!read_exact(c->fd, &ret, 8) || !read_exact(c->fd, &rlen, 4))
+    return -100;
+  if (rlen > (1u << 26)) return -100;
+  std::string v(rlen, '\0');
+  if (rlen && !read_exact(c->fd, &v[0], rlen)) return -100;
+  if (out) *out = std::move(v);
+  return ret;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- server ----
+void* kv_server_start(int port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) { delete s; return nullptr; }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) < 0 ||
+      ::listen(s->listen_fd, 128) < 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread(&Server::accept_loop, s);
+  return s;
+}
+
+int kv_server_port(void* h) {
+  return h ? static_cast<Server*>(h)->port : -1;
+}
+
+void kv_server_stop(void* h) {
+  if (!h) return;
+  auto* s = static_cast<Server*>(h);
+  s->stopping.store(true);
+  {
+    std::lock_guard<std::mutex> lk(s->store.mu);
+    s->store.cv.notify_all();
+  }
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    // unblock every worker stuck in recv() by shutting its conn down,
+    // then join all — no thread can outlive the Server it references
+    std::lock_guard<std::mutex> lk(s->workers_mu);
+    for (auto& w : s->workers) ::shutdown(w->fd, SHUT_RDWR);
+    for (auto& w : s->workers) {
+      if (w->thread.joinable()) w->thread.join();
+    }
+    s->workers.clear();
+  }
+  delete s;
+}
+
+// ---- client ----
+void* kv_client_connect(const char* host, int port, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (true) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return nullptr;  // caller resolves hostnames to IPs in Python
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                  sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto* c = new Client();
+      c->fd = fd;
+      return c;
+    }
+    ::close(fd);
+    if (std::chrono::steady_clock::now() >= deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+void kv_client_close(void* h) {
+  if (!h) return;
+  auto* c = static_cast<Client*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+int64_t kv_client_set(void* h, const char* key, const void* val,
+                      uint32_t vlen) {
+  return roundtrip(static_cast<Client*>(h), 1, key, 0, val, vlen, nullptr);
+}
+
+// returns value length, or <0 on error; writes at most buf_len bytes
+int64_t kv_client_get(void* h, const char* key, void* buf,
+                      uint32_t buf_len) {
+  std::string out;
+  int64_t ret = roundtrip(static_cast<Client*>(h), 2, key, 0, nullptr, 0,
+                          &out);
+  if (ret < 0) return ret;
+  uint32_t n = static_cast<uint32_t>(out.size());
+  if (buf && buf_len) std::memcpy(buf, out.data(), std::min(n, buf_len));
+  return static_cast<int64_t>(n);
+}
+
+// counter value goes to *out (it may legitimately be negative); the return
+// is a pure status code: 0 ok, <0 transport/protocol error
+int64_t kv_client_add(void* h, const char* key, int64_t amount,
+                      int64_t* out) {
+  std::string v;
+  int64_t ret = roundtrip(static_cast<Client*>(h), 3, key, amount, nullptr,
+                          0, &v);
+  if (ret < 0) return ret;
+  if (out) *out = std::strtoll(v.c_str(), nullptr, 10);
+  return 0;
+}
+
+int64_t kv_client_wait(void* h, const char* key, int64_t timeout_ms) {
+  return roundtrip(static_cast<Client*>(h), 4, key, timeout_ms, nullptr, 0,
+                   nullptr);
+}
+
+int64_t kv_client_del(void* h, const char* key) {
+  return roundtrip(static_cast<Client*>(h), 5, key, 0, nullptr, 0, nullptr);
+}
+
+int64_t kv_client_numkeys(void* h) {
+  return roundtrip(static_cast<Client*>(h), 6, "", 0, nullptr, 0, nullptr);
+}
+
+int64_t kv_client_ping(void* h) {
+  return roundtrip(static_cast<Client*>(h), 7, "", 0, nullptr, 0, nullptr);
+}
+
+}  // extern "C"

@@ -8,3 +8,4 @@ from . import utils  # noqa: F401
 from .meta_parallel import (PipelineLayer, LayerDesc, SharedLayerDesc,  # noqa: F401
                             get_rng_state_tracker)
 from .recompute import recompute, recompute_sequential  # noqa: F401
+from . import elastic  # noqa: F401

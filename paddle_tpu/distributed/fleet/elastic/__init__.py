@@ -1,0 +1,3 @@
+"""Elastic training manager (parity: python/paddle/distributed/fleet/
+elastic/manager.py:126)."""
+from .manager import ElasticLevel, ElasticManager, ElasticStatus  # noqa: F401

@@ -1,0 +1,127 @@
+"""Elastic manager: node heartbeats + membership watch over the native KV
+store (parity: python/paddle/distributed/fleet/elastic/manager.py:126 —
+ElasticManager with etcd leases/heartbeats, scale detection, relaunch).
+
+TPU-native difference: the reference heartbeats into etcd; here nodes
+heartbeat timestamped keys into the job's TCPStore (the launcher master).
+TPU slices have fixed shape, so ELASTIC-level scale-up/down maps to
+slice-level reprovisioning — FAULT_TOLERANCE (dead-node detection +
+re-rendezvous signal) is the primary mode.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from ...store import TCPStore
+
+__all__ = ["ElasticLevel", "ElasticStatus", "ElasticManager"]
+
+
+class ElasticLevel:
+    """Parity: manager.py:43."""
+    FAULT_TOLERANCE = 1   # fixed np; survive restarts of members
+    ELASTIC = 2           # np range; membership may grow/shrink
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Heartbeat this node; watch peers; report membership health."""
+
+    def __init__(self, store: TCPStore, node_id: str,
+                 np_target: int, heartbeat_interval: float = 1.0,
+                 heartbeat_timeout: float = 5.0,
+                 level: int = ElasticLevel.FAULT_TOLERANCE,
+                 job_id: str = "default"):
+        self.store = store
+        self.node_id = node_id
+        self.np_target = np_target
+        self.interval = heartbeat_interval
+        self.timeout = heartbeat_timeout
+        self.level = level
+        self.prefix = f"__elastic/{job_id}"
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._epoch_key = f"{self.prefix}/epoch"
+
+    # -- heartbeats --------------------------------------------------------
+    def start(self):
+        self.store.set(f"{self.prefix}/node/{self.node_id}",
+                       str(time.time()))
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._beat, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(self.interval * 3)
+            self._thread = None
+        self.store.set(f"{self.prefix}/node/{self.node_id}", "")
+
+    def _beat(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.store.set(f"{self.prefix}/node/{self.node_id}",
+                               str(time.time()))
+            except Exception:
+                return  # store gone: the watcher will see us dead
+
+    # -- membership --------------------------------------------------------
+    def register_nodes(self, node_ids: List[str]):
+        """The launcher registers the full expected membership."""
+        self.store.set(f"{self.prefix}/members", ",".join(node_ids))
+
+    def _snapshot(self):
+        """One consistent poll: (alive, dead) from a single read pass."""
+        members = self.store.get(f"{self.prefix}/members").decode()
+        now = time.time()
+        alive, dead = [], []
+        for n in members.split(","):
+            if not n:
+                continue
+            try:
+                ts = self.store.get(f"{self.prefix}/node/{n}",
+                                    wait=False).decode()
+            except KeyError:
+                dead.append(n)
+                continue
+            if ts and now - float(ts) < self.timeout:
+                alive.append(n)
+            else:
+                dead.append(n)
+        return alive, dead
+
+    def alive_nodes(self) -> List[str]:
+        return self._snapshot()[0]
+
+    def dead_nodes(self) -> List[str]:
+        return self._snapshot()[1]
+
+    # -- health decision (parity: manager's watch loop outcome) -----------
+    def watch(self) -> str:
+        """One poll: HOLD if healthy, RESTART if a member died (fault
+        tolerance), EXIT if membership can never reach np_target."""
+        alive, dead = self._snapshot()
+        if len(alive) >= self.np_target and not dead:
+            return ElasticStatus.HOLD
+        if self.level == ElasticLevel.FAULT_TOLERANCE:
+            return ElasticStatus.RESTART
+        # ELASTIC: shrink is acceptable down to 1 node
+        return ElasticStatus.RESTART if alive else ElasticStatus.EXIT
+
+    def signal_restart(self):
+        """Bump the job epoch — every node's training loop polls this and
+        re-enters rendezvous (the reference's relaunch signal)."""
+        self.store.add(self._epoch_key, 1)
+
+    def current_epoch(self) -> int:
+        return self.store.add(self._epoch_key, 0)

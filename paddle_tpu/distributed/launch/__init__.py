@@ -1,0 +1,51 @@
+"""``python -m paddle_tpu.distributed.launch`` — cluster launcher
+(parity: python/paddle/distributed/launch/main.py:20).
+
+Examples::
+
+    # single node, 4 processes (CPU-mesh testing or 4 local hosts)
+    python -m paddle_tpu.distributed.launch --nproc_per_node 4 train.py
+
+    # two nodes sharing a master
+    python -m paddle_tpu.distributed.launch --nnodes 2 \
+        --master 10.0.0.1:6070 train.py --my-arg 1
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .controller import CollectiveController
+
+__all__ = ["main", "parse_args", "CollectiveController"]
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="TPU-native distributed launcher")
+    p.add_argument("--master", default=None,
+                   help="host:port of the rendezvous KV master")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--rank", type=int, default=-1,
+                   help="node rank; -1 = assign via rendezvous")
+    p.add_argument("--nproc_per_node", "--nprocs", type=int, default=1)
+    p.add_argument("--devices", "--gpus", default=None,
+                   help="device ids visible to each process")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--max_restart", type=int, default=3,
+                   help="fault-tolerance: restarts before giving up")
+    p.add_argument("--rendezvous_timeout", type=float, default=300.0)
+    p.add_argument("script", help="training script (.py) or executable")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    return CollectiveController(args).run()
+
+
+def launch():  # reference entry-point name
+    sys.exit(main())

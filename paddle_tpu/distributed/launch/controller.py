@@ -1,0 +1,162 @@
+"""Collective launch controller (parity:
+python/paddle/distributed/launch/controllers/collective.py + master.py +
+watcher.py): KV rendezvous across nodes, PADDLE_TRAINER_* env contract,
+process watch with fault-tolerant restart.
+
+TPU-native notes: one process per host is the normal TPU topology (all
+local chips belong to one jax process), but ``--nproc_per_node`` > 1 is
+supported for CPU-mesh testing. The master KV server is the native C++
+store (csrc/kv_store.cpp). Child processes get the JAX distributed env
+(coordinator address/process id) derived from the same rendezvous.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import time
+from typing import List, Optional
+
+from ..store import TCPStore
+from .job import Container, Job, Pod, python_entrypoint
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _host_ip() -> str:
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+class CollectiveController:
+    def __init__(self, args):
+        self.args = args
+        self.pod = Pod()
+        self.store: Optional[TCPStore] = None
+        self._stop = False
+
+    # -- rendezvous --------------------------------------------------------
+    def build_job(self) -> Job:
+        a = self.args
+        nnodes = a.nnodes
+        nproc = a.nproc_per_node
+        if nnodes > 1 or a.master:
+            master = a.master or f"{_host_ip()}:{_free_port()}"
+            host, port = master.rsplit(":", 1)
+            is_master = a.rank == 0 or (a.rank < 0 and self._is_local(host))
+            self.store = TCPStore(host, int(port), is_master=is_master,
+                                  world_size=nnodes,
+                                  timeout=a.rendezvous_timeout)
+            node_rank = (a.rank if a.rank >= 0
+                         else self.store.add("__launch/next_rank", 1) - 1)
+            my_eps = ",".join(f"{_host_ip()}:{_free_port()}"
+                              for _ in range(nproc))
+            self.store.set(f"__launch/pod/{node_rank}", my_eps)
+            self.store.barrier("launch", a.rendezvous_timeout)
+            all_eps: List[str] = []
+            for r in range(nnodes):
+                eps = self.store.get(f"__launch/pod/{r}").decode()
+                all_eps.extend(eps.split(","))
+            rank_base = sum(
+                len(self.store.get(f"__launch/pod/{r}").decode().split(","))
+                for r in range(node_rank))
+            master_ep = master
+        else:
+            node_rank, rank_base = 0, 0
+            all_eps = [f"127.0.0.1:{_free_port()}" for _ in range(nproc)]
+            master_ep = all_eps[0]
+
+        world = len(all_eps)
+        for local_rank in range(nproc):
+            rank = rank_base + local_rank
+            env = {
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(all_eps),
+                "PADDLE_CURRENT_ENDPOINT": all_eps[rank],
+                "PADDLE_LOCAL_RANK": str(local_rank),
+                "PADDLE_LOCAL_SIZE": str(nproc),
+                "PADDLE_NNODES": str(nnodes),
+                "PADDLE_NODE_RANK": str(node_rank),
+                "PADDLE_MASTER": master_ep,
+                # the launcher's own KV server serves the job's global
+                # store: workers must connect as clients, not re-bind
+                "PADDLE_MASTER_HOSTED": "1" if self.store else "0",
+                "PADDLE_JOB_ID": self.args.job_id,
+                # jax.distributed.initialize reads these directly
+                "JAX_COORDINATOR_ADDRESS": master_ep,
+                "JAX_NUM_PROCESSES": str(world),
+                "JAX_PROCESS_ID": str(rank),
+            }
+            if self.args.devices:
+                env["FLAGS_selected_devices"] = self.args.devices
+            log = (os.path.join(self.args.log_dir,
+                                f"workerlog.{local_rank}")
+                   if self.args.log_dir else None)
+            self.pod.containers.append(Container(
+                python_entrypoint(self.args.script, self.args.script_args),
+                env, log))
+        return Job(self.args.job_id, self.pod)
+
+    @staticmethod
+    def _is_local(host: str) -> bool:
+        try:
+            return socket.gethostbyname(host) in (
+                "127.0.0.1", _host_ip())
+        except OSError:
+            return False
+
+    # -- run & watch -------------------------------------------------------
+    def run(self) -> int:
+        self.build_job()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                signal.signal(sig, self._on_signal)
+            except ValueError:
+                pass  # not the main thread (tests)
+        restarts = 0
+        while True:
+            self.pod.deploy()
+            status = self._watch()
+            if status == "completed":
+                return 0
+            if self._stop:
+                return 1
+            restarts += 1
+            if restarts > self.args.max_restart:
+                print(f"launch: pod failed and exceeded max_restart="
+                      f"{self.args.max_restart}, giving up")
+                self.pod.stop(force=True)
+                return 1
+            print(f"launch: pod failed, restart {restarts}/"
+                  f"{self.args.max_restart}")
+            self.pod.stop(force=True)
+            fresh = Pod()
+            fresh.containers = [Container(c.entrypoint, c.env, c.log_path)
+                                for c in self.pod.containers]
+            fresh.restart_count = restarts
+            self.pod = fresh
+
+    def _watch(self) -> str:
+        while not self._stop:
+            status = self.pod.poll()
+            if status != "running":
+                if status == "failed":
+                    self.pod.stop(force=True)
+                return status
+            time.sleep(0.2)
+        self.pod.stop(force=True)
+        return "stopped"
+
+    def _on_signal(self, signum, frame):
+        del frame
+        print(f"launch: got signal {signum}, stopping pod")
+        self._stop = True

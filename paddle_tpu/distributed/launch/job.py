@@ -1,0 +1,107 @@
+"""Job/Pod/Container process model (parity:
+python/paddle/distributed/launch/job/ — Job, Pod, Container with per-
+container env + log files, status polling)."""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+class Container:
+    """One training process with its env contract and log file."""
+
+    def __init__(self, entrypoint: List[str], env: Dict[str, str],
+                 log_path: Optional[str] = None):
+        self.entrypoint = entrypoint
+        self.env = dict(env)
+        self.log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+        self._log_f = None
+
+    def start(self):
+        env = dict(os.environ)
+        env.update(self.env)
+        if self.log_path:
+            os.makedirs(os.path.dirname(self.log_path), exist_ok=True)
+            self._log_f = open(self.log_path, "ab")
+            out = self._log_f
+        else:
+            out = None
+        self.proc = subprocess.Popen(self.entrypoint, env=env, stdout=out,
+                                     stderr=subprocess.STDOUT
+                                     if out else None)
+
+    @property
+    def status(self) -> str:
+        if self.proc is None:
+            return "init"
+        rc = self.proc.poll()
+        if rc is None:
+            return "running"
+        return "completed" if rc == 0 else "failed"
+
+    @property
+    def exit_code(self):
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self, force: bool = False):
+        if self.proc and self.proc.poll() is None:
+            self.proc.kill() if force else self.proc.terminate()
+
+    def wait(self, timeout=None):
+        if self.proc:
+            self.proc.wait(timeout)
+        if self._log_f:
+            self._log_f.close()
+            self._log_f = None
+
+
+class Pod:
+    """All containers of this node."""
+
+    def __init__(self):
+        self.containers: List[Container] = []
+        self.restart_count = 0
+
+    def deploy(self):
+        for c in self.containers:
+            c.start()
+
+    def poll(self) -> str:
+        """'running' | 'completed' | 'failed'."""
+        states = [c.status for c in self.containers]
+        if any(s == "failed" for s in states):
+            return "failed"
+        if all(s == "completed" for s in states):
+            return "completed"
+        return "running"
+
+    def stop(self, force: bool = False):
+        for c in self.containers:
+            c.terminate(force=force)
+        deadline = time.time() + 10
+        for c in self.containers:
+            try:
+                c.wait(max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                c.terminate(force=True)
+                c.wait()
+
+    def join(self):
+        for c in self.containers:
+            c.wait()
+
+
+class Job:
+    def __init__(self, job_id: str, pod: Pod):
+        self.job_id = job_id
+        self.pod = pod
+
+
+def python_entrypoint(script: str, script_args: List[str]) -> List[str]:
+    if script.endswith(".py"):
+        return [sys.executable, "-u", script] + list(script_args)
+    return [script] + list(script_args)

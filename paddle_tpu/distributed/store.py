@@ -1,0 +1,206 @@
+"""Host-coordination store (parity: TCPStore,
+paddle/phi/core/distributed/store/tcp_store.h:121 + Python
+``core.create_or_get_global_tcp_store``).
+
+The server and client are native C++ (``csrc/kv_store.cpp``) loaded via
+ctypes; this module adds the rank-0-hosts-the-server convention, barrier(),
+and a process-global singleton — the control-plane rendezvous used by the
+launcher, elastic manager, and checkpoint coordinator. Data-plane
+collectives never touch this store (they are XLA programs over ICI/DCN).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import threading
+import time
+from typing import Optional
+
+from ..core.native import load_native
+
+__all__ = ["TCPStore", "KVServer", "create_or_get_global_tcp_store"]
+
+_MAXVAL = 1 << 26
+
+
+def _lib():
+    lib = load_native("kv_store")
+    lib.kv_server_start.restype = ctypes.c_void_p
+    lib.kv_server_start.argtypes = [ctypes.c_int]
+    lib.kv_server_port.restype = ctypes.c_int
+    lib.kv_server_port.argtypes = [ctypes.c_void_p]
+    lib.kv_server_stop.argtypes = [ctypes.c_void_p]
+    lib.kv_client_connect.restype = ctypes.c_void_p
+    lib.kv_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                      ctypes.c_int]
+    lib.kv_client_close.argtypes = [ctypes.c_void_p]
+    for fn, extra in [("kv_client_set", [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_char_p, ctypes.c_uint32]),
+                      ("kv_client_get", [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_char_p, ctypes.c_uint32]),
+                      ("kv_client_add", [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_int64,
+                                         ctypes.POINTER(ctypes.c_int64)]),
+                      ("kv_client_wait", [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_int64]),
+                      ("kv_client_del", [ctypes.c_void_p, ctypes.c_char_p]),
+                      ("kv_client_numkeys", [ctypes.c_void_p]),
+                      ("kv_client_ping", [ctypes.c_void_p])]:
+        getattr(lib, fn).restype = ctypes.c_int64
+        getattr(lib, fn).argtypes = extra
+    return lib
+
+
+class KVServer:
+    """Standalone native KV server (the launcher master runs one)."""
+
+    def __init__(self, port: int = 0):
+        self._lib = _lib()
+        self._h = self._lib.kv_server_start(port)
+        if not self._h:
+            raise RuntimeError(f"KVServer: cannot bind port {port}")
+        self.port = self._lib.kv_server_port(self._h)
+
+    def stop(self):
+        if self._h:
+            self._lib.kv_server_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class TCPStore:
+    """Client (plus embedded server on the master) with the reference
+    TCPStore API: set/get/add/wait/delete_key/num_keys + barrier."""
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 300.0):
+        self._lib = _lib()
+        self._server: Optional[KVServer] = None
+        self.world_size = world_size
+        self.timeout = timeout
+        if is_master:
+            self._server = KVServer(port)
+            port = self._server.port
+        self.host, self.port = host, port
+        self._local = threading.local()
+        self._all_conns: list = []
+        self._conns_lock = threading.Lock()
+        self._closed = False
+        # fail fast if the master is unreachable
+        self._lib.kv_client_ping(self._conn())
+
+    # one native client handle serializes requests; blocking wait() from
+    # one thread must not block another thread's set() — per-thread conns,
+    # all tracked for close()
+    def _conn(self):
+        if self._closed:
+            raise RuntimeError("TCPStore is closed")
+        c = getattr(self._local, "c", None)
+        if c is None:
+            ip = socket.gethostbyname(self.host)
+            c = self._lib.kv_client_connect(ip.encode(), self.port,
+                                            int(self.timeout * 1000))
+            if not c:
+                raise TimeoutError(
+                    f"TCPStore: cannot reach master at {self.host}:"
+                    f"{self.port} within {self.timeout}s")
+            self._local.c = c
+            with self._conns_lock:
+                self._all_conns.append(c)
+        return c
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        r = self._lib.kv_client_set(self._conn(), key.encode(), value,
+                                    len(value))
+        if r < 0:
+            raise RuntimeError(f"TCPStore.set({key}) failed: {r}")
+
+    def get(self, key: str, wait: bool = True) -> bytes:
+        if wait:
+            self.wait(key)
+        buf = ctypes.create_string_buffer(_MAXVAL)
+        n = self._lib.kv_client_get(self._conn(), key.encode(), buf,
+                                    _MAXVAL)
+        if n == -1:
+            raise KeyError(key)
+        if n < 0:
+            raise RuntimeError(f"TCPStore.get({key}) failed: {n}")
+        return buf.raw[:n]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        out = ctypes.c_int64(0)
+        r = self._lib.kv_client_add(self._conn(), key.encode(), amount,
+                                    ctypes.byref(out))
+        if r < 0:
+            raise RuntimeError(f"TCPStore.add({key}) failed: {r}")
+        return int(out.value)
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> None:
+        t = self.timeout if timeout is None else timeout
+        r = self._lib.kv_client_wait(self._conn(), key.encode(),
+                                     int(t * 1000))
+        if r == -2:
+            raise TimeoutError(f"TCPStore.wait({key}): timed out after {t}s")
+        if r < 0:
+            raise RuntimeError(f"TCPStore.wait({key}) failed: {r}")
+
+    def delete_key(self, key: str) -> bool:
+        return self._lib.kv_client_del(self._conn(), key.encode()) > 0
+
+    def num_keys(self) -> int:
+        return int(self._lib.kv_client_numkeys(self._conn()))
+
+    def barrier(self, name: str = "default", timeout: Optional[float] = None
+                ) -> None:
+        """All world_size participants rendezvous (add + wait pattern).
+        Reusable: arrival number n maps to generation (n-1)//world_size,
+        each generation gets its own done-key."""
+        n = self.add(f"__barrier/{name}/count", 1)
+        gen = (n - 1) // self.world_size
+        if n % self.world_size == 0:
+            self.set(f"__barrier/{name}/done/{gen}", b"1")
+        self.wait(f"__barrier/{name}/done/{gen}", timeout)
+
+    def close(self):
+        self._closed = True
+        with self._conns_lock:
+            for c in self._all_conns:
+                self._lib.kv_client_close(c)
+            self._all_conns.clear()
+        self._local = threading.local()
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+
+_global_store: Optional[TCPStore] = None
+_global_lock = threading.Lock()
+
+
+def create_or_get_global_tcp_store() -> TCPStore:
+    """Parity: python/paddle/distributed/parallel.py:1099 — the process
+    global store from the PADDLE_MASTER / PADDLE_TRAINER_* env contract."""
+    global _global_store
+    with _global_lock:
+        if _global_store is None:
+            ep = os.environ.get("PADDLE_MASTER", "")
+            if not ep:
+                eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+                ep = eps.split(",")[0] if eps else "127.0.0.1:0"
+            host, port = ep.rsplit(":", 1)
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+            world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+            hosted = os.environ.get("PADDLE_MASTER_HOSTED", "0") == "1"
+            _global_store = TCPStore(
+                host, int(port),
+                is_master=(rank == 0 and not hosted),
+                world_size=world)
+        return _global_store

@@ -73,7 +73,8 @@ class Model:
         return self
 
     # -- single-batch ops (parity: train_batch/eval_batch/predict_batch) ---
-    def train_batch(self, inputs, labels=None, update=True):
+    def train_batch(self, inputs, labels=None, update=True,
+                    loss_scale=1.0):
         assert self._optimizer is not None, "call prepare() first"
         self.network.train()
         inputs = _to_tensors(inputs)
@@ -84,7 +85,7 @@ class Model:
             loss = self._loss(*(_as_tuple(outputs) + labels))
         else:
             loss = outputs if isinstance(outputs, Tensor) else outputs[0]
-        loss.backward()
+        (loss * loss_scale if loss_scale != 1.0 else loss).backward()
         if update:
             self._optimizer.step()
             self._optimizer.clear_grad()
@@ -163,17 +164,24 @@ class Model:
                 m.reset()
             epoch_logs = {}
             batch_losses = []
+            pending_accum = False
+            scale = 1.0 / accumulate_grad_batches
             for step, batch in enumerate(loader):
                 cbks.on_train_batch_begin(step)
                 x, y = self._split_batch(batch)
                 update = (step + 1) % accumulate_grad_batches == 0
-                res = self.train_batch(x, y, update=update)
+                res = self.train_batch(x, y, update=update,
+                                       loss_scale=scale)
+                pending_accum = not update
                 batch_losses.append(res[0])
                 epoch_logs = {"loss": res[0]}
                 for m, v in zip(self._metrics, res[1:]):
                     epoch_logs[m.name() if isinstance(m.name(), str)
                                else m.name()[0]] = v
                 cbks.on_train_batch_end(step, epoch_logs)
+            if pending_accum:  # flush the tail accumulation window
+                self._optimizer.step()
+                self._optimizer.clear_grad()
             if batch_losses:  # epoch summary: mean loss, not last batch
                 epoch_logs["loss"] = float(np.mean(batch_losses))
             history["loss"].append(epoch_logs.get("loss"))
@@ -226,7 +234,7 @@ class Model:
         for step, batch in enumerate(loader):
             cbks.on_predict_batch_begin(step)
             batch = _as_tuple(batch)
-            if self._loss is not None and len(batch) > 1:
+            if (self._loss is not None or self._metrics) and len(batch) > 1:
                 batch, _ = self._split_batch(batch)  # drop labels
             out = self.predict_batch(batch)
             outs.append([o.numpy() for o in _as_tuple(out)])

@@ -1,0 +1,199 @@
+"""Native KV store, launcher env contract, elastic manager tests
+(reference test models: test/cpp/... tcp_store tests, launch tests via
+subprocess with PADDLE_TRAINER_* assertions — SURVEY.md §4 pattern (2):
+all distributed tests run on one host via subprocess + env contract)."""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                  ElasticStatus)
+from paddle_tpu.distributed.store import TCPStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def master():
+    s = TCPStore("127.0.0.1", 0, is_master=True, world_size=2, timeout=15)
+    yield s
+    s.close()
+
+
+@pytest.fixture()
+def client(master):
+    c = TCPStore("127.0.0.1", master.port, world_size=2, timeout=15)
+    yield c
+    c.close()
+
+
+class TestTCPStore:
+    def test_set_get_bytes_and_str(self, master, client):
+        master.set("k1", "v1")
+        assert client.get("k1") == b"v1"
+        client.set("k2", b"\x00\x01binary")
+        assert master.get("k2") == b"\x00\x01binary"
+
+    def test_get_missing_raises(self, client):
+        with pytest.raises(KeyError):
+            client.get("missing-key", wait=False)
+
+    def test_add_atomic(self, master, client):
+        def bump(s):
+            for _ in range(100):
+                s.add("cnt", 1)
+        ts = [threading.Thread(target=bump, args=(s,))
+              for s in (master, client) for _ in range(3)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert master.add("cnt", 0) == 600
+
+    def test_wait_blocks_then_returns(self, master, client):
+        def setter():
+            time.sleep(0.2)
+            master.set("late-key", "1")
+        threading.Thread(target=setter).start()
+        t0 = time.time()
+        client.wait("late-key", timeout=5)
+        assert time.time() - t0 >= 0.15
+
+    def test_wait_timeout(self, client):
+        with pytest.raises(TimeoutError):
+            client.wait("never-set", timeout=0.2)
+
+    def test_barrier(self, master, client):
+        errs = []
+
+        def b(s):
+            try:
+                s.barrier("t", timeout=5)
+            except Exception as e:
+                errs.append(e)
+        ts = [threading.Thread(target=b, args=(s,))
+              for s in (master, client)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not errs
+
+    def test_barrier_reusable(self, master, client):
+        for _ in range(3):  # same name, successive generations
+            errs = []
+
+            def b(s):
+                try:
+                    s.barrier("reuse", timeout=5)
+                except Exception as e:
+                    errs.append(e)
+            ts = [threading.Thread(target=b, args=(s,))
+                  for s in (master, client)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            assert not errs
+
+    def test_add_negative_counter(self, master):
+        assert master.add("neg", -5) == -5
+        assert master.add("neg", -95) == -100  # would collide with the
+        # transport error code if value and status shared the i64
+        assert master.add("neg", 0) == -100
+
+    def test_delete_and_numkeys(self, master):
+        master.set("delme", "x")
+        n0 = master.num_keys()
+        assert master.delete_key("delme")
+        assert master.num_keys() == n0 - 1
+        assert not master.delete_key("delme")
+
+
+PROBE = """
+import os, sys
+keys = ["PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
+        "PADDLE_TRAINER_ENDPOINTS", "PADDLE_CURRENT_ENDPOINT",
+        "PADDLE_LOCAL_RANK", "PADDLE_MASTER", "JAX_PROCESS_ID"]
+print("|".join(f"{k}={os.environ.get(k, 'MISSING')}" for k in keys))
+"""
+
+FAIL_ONCE = """
+import os, sys
+marker = os.environ["MARKER_DIR"] + "/ran_" + os.environ["PADDLE_TRAINER_ID"]
+if not os.path.exists(marker):
+    open(marker, "w").close()
+    sys.exit(1)
+"""
+
+
+class TestLauncher:
+    def _run(self, script_body, tmp_path, extra_args=(), env=None):
+        script = tmp_path / "train.py"
+        script.write_text(script_body)
+        full_env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+                        MARKER_DIR=str(tmp_path))
+        if env:
+            full_env.update(env)
+        return subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             *extra_args, str(script)],
+            capture_output=True, text=True, timeout=120, env=full_env,
+            cwd=REPO)
+
+    def test_env_contract_two_procs(self, tmp_path):
+        r = self._run(PROBE, tmp_path,
+                      ["--nproc_per_node", "2", "--log_dir",
+                       str(tmp_path / "logs")])
+        assert r.returncode == 0, r.stderr
+        logs = sorted((tmp_path / "logs").glob("workerlog.*"))
+        assert len(logs) == 2
+        seen = {}
+        for lg in logs:
+            line = lg.read_text().strip().splitlines()[-1]
+            kv = dict(p.split("=", 1) for p in line.split("|"))
+            assert kv["PADDLE_TRAINERS_NUM"] == "2"
+            assert kv["PADDLE_MASTER"] != "MISSING"
+            assert kv["PADDLE_TRAINER_ID"] == kv["JAX_PROCESS_ID"]
+            eps = kv["PADDLE_TRAINER_ENDPOINTS"].split(",")
+            assert len(eps) == 2
+            assert kv["PADDLE_CURRENT_ENDPOINT"] == \
+                eps[int(kv["PADDLE_TRAINER_ID"])]
+            seen[kv["PADDLE_TRAINER_ID"]] = True
+        assert set(seen) == {"0", "1"}
+
+    def test_restart_on_failure_then_success(self, tmp_path):
+        r = self._run(FAIL_ONCE, tmp_path,
+                      ["--nproc_per_node", "2", "--max_restart", "2"])
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "restart 1/2" in r.stdout
+
+    def test_gives_up_after_max_restart(self, tmp_path):
+        r = self._run("import sys; sys.exit(3)", tmp_path,
+                      ["--nproc_per_node", "1", "--max_restart", "1"])
+        assert r.returncode == 1
+        assert "giving up" in r.stdout
+
+
+class TestElasticManager:
+    def test_heartbeat_and_death_detection(self, master, client):
+        m1 = ElasticManager(master, "node0", np_target=2,
+                            heartbeat_interval=0.1, heartbeat_timeout=0.6,
+                            job_id="j1")
+        m2 = ElasticManager(client, "node1", np_target=2,
+                            heartbeat_interval=0.1, heartbeat_timeout=0.6,
+                            job_id="j1")
+        m1.register_nodes(["node0", "node1"])
+        m1.start()
+        m2.start()
+        time.sleep(0.3)
+        assert sorted(m1.alive_nodes()) == ["node0", "node1"]
+        assert m1.watch() == ElasticStatus.HOLD
+        # node1 dies
+        m2.stop()
+        time.sleep(0.8)
+        assert m1.dead_nodes() == ["node1"]
+        assert m1.watch() == ElasticStatus.RESTART
+        # restart epoch signal propagates
+        e0 = m1.current_epoch()
+        m1.signal_restart()
+        assert m1.current_epoch() == e0 + 1
+        m1.stop()
