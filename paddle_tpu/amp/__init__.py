@@ -180,3 +180,32 @@ class debugging:
             raise FloatingPointError(
                 f"NaN/Inf detected in {op_type}:{var_name}")
         return tensor
+
+    class TensorCheckerConfig:
+        """Op-level numeric-check config (parity: amp/debugging.py:157 —
+        enable_check, debug modes CHECK_NAN_INF_AND_ABORT/CHECK_NAN_INF)."""
+
+        def __init__(self, enable: bool, debug_mode=None,
+                     output_dir=None, checked_op_list=None,
+                     skipped_op_list=None, debug_step=None,
+                     stack_height_limit=None):
+            self.enable = enable
+            self.debug_mode = debug_mode
+            self.output_dir = output_dir
+            self.checked_op_list = checked_op_list
+            self.skipped_op_list = skipped_op_list
+            self.debug_step = debug_step
+            self.stack_height_limit = stack_height_limit
+
+    @staticmethod
+    def enable_tensor_checker(config):
+        """Turn on the per-op NaN/Inf funnel check
+        (FLAGS_check_nan_inf in the dispatch funnel, dispatch.py)."""
+        from ..core import flags
+        if config.enable:
+            flags.set_flags({"check_nan_inf": 1})
+
+    @staticmethod
+    def disable_tensor_checker():
+        from ..core import flags
+        flags.set_flags({"check_nan_inf": 0})

@@ -22,7 +22,18 @@ from . import autograd as _ag
 from . import flags as _flags
 from .tensor import Tensor
 
-__all__ = ["run_op", "OP_REGISTRY", "register_op_impl"]
+__all__ = ["run_op", "OP_REGISTRY", "register_op_impl",
+           "set_op_profile_hook"]
+
+# host-tracer hook (parity: the RecordEvent emitted by every generated op
+# fn, eager_gen.py:1802). None when no profiler is recording — one global
+# read of cost on the hot path.
+_op_profile_hook = None
+
+
+def set_op_profile_hook(fn) -> None:
+    global _op_profile_hook
+    _op_profile_hook = fn
 
 # name -> {"xla": fn, "pallas": fn}; selection by FLAGS_use_pallas_kernels.
 OP_REGISTRY: Dict[str, Dict[str, Callable]] = {}
@@ -70,6 +81,20 @@ def run_op(
     indices, softmax_lse) get zero cotangents routed automatically by the
     tape and are marked stop_gradient.
     """
+    if _op_profile_hook is not None:
+        import time as _time
+        _t0 = _time.perf_counter()
+        try:
+            return _run_op_impl(name, jax_fn, operands, num_nondiff_outputs,
+                                out_stop_gradient)
+        finally:
+            _op_profile_hook(name, _t0, _time.perf_counter())
+    return _run_op_impl(name, jax_fn, operands, num_nondiff_outputs,
+                        out_stop_gradient)
+
+
+def _run_op_impl(name, jax_fn, operands, num_nondiff_outputs,
+                 out_stop_gradient):
     arrays = [_unwrap(o) for o in operands]
 
     cast_to = amp_state.amp_cast_dtype(name)

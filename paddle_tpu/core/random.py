@@ -33,13 +33,22 @@ class Generator:
 
     def __init__(self, seed: int = _DEFAULT_SEED):
         self._lock = threading.Lock()
-        self.manual_seed(seed)
+        # key creation is lazy: building a jax PRNG key initializes the
+        # backend, and importing paddle_tpu (e.g. in the launcher process)
+        # must NOT claim the TPU before worker processes start
+        self._seed = int(seed)
+        self._key = None
+        self._offset = 0
 
     def manual_seed(self, seed: int):
         self._seed = int(seed)
         self._key = jax.random.key(self._seed)
         self._offset = 0
         return self
+
+    def _ensure_key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
 
     def seed(self):
         return self._seed
@@ -61,6 +70,7 @@ class Generator:
         pair is the replayable RNG state, mirroring the reference's
         IncrementOffset contract used by dropout/flash-attn)."""
         with self._lock:
+            self._ensure_key()
             sub = jax.random.fold_in(self._key, self._offset)
             self._offset += 1
             return sub

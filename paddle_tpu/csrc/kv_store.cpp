@@ -107,22 +107,28 @@ struct Server {
       std::string val(vlen, '\0');
       if (vlen && !read_exact(fd, &val[0], vlen)) break;
 
-      bool ok = true;
+      // compute (ret, reply) under the lock, send AFTER unlocking — a
+      // stalled client's full TCP window must never block other ranks'
+      // requests behind store.mu
+      int64_t ret = -3;
+      std::string reply;
+      bool alive = true;
       switch (cmd) {
         case 1: {  // SET
           std::lock_guard<std::mutex> lk(store.mu);
           store.data[key] = val;
           store.cv.notify_all();
-          ok = send_reply(fd, 0, "");
+          ret = 0;
           break;
         }
         case 2: {  // GET
           std::lock_guard<std::mutex> lk(store.mu);
           auto it = store.data.find(key);
           if (it == store.data.end()) {
-            ok = send_reply(fd, -1, "");
+            ret = -1;
           } else {
-            ok = send_reply(fd, 0, it->second);
+            ret = 0;
+            reply = it->second;
           }
           break;
         }
@@ -138,7 +144,8 @@ struct Server {
           store.cv.notify_all();
           // counter travels in the value field: the i64 ret stays a pure
           // status code even for negative counters
-          ok = send_reply(fd, 0, store.data[key]);
+          ret = 0;
+          reply = store.data[key];
           break;
         }
         case 4: {  // WAIT(timeout_ms in arg; arg<=0 -> wait forever)
@@ -155,32 +162,30 @@ struct Server {
             found = true;
           }
           if (stopping.load()) {
-            ok = false;
+            alive = false;
           } else {
-            ok = send_reply(fd, (found && store.data.count(key)) ? 0 : -2,
-                            "");
+            ret = (found && store.data.count(key)) ? 0 : -2;
           }
           break;
         }
         case 5: {  // DEL
           std::lock_guard<std::mutex> lk(store.mu);
-          int64_t n = static_cast<int64_t>(store.data.erase(key));
-          ok = send_reply(fd, n, "");
+          ret = static_cast<int64_t>(store.data.erase(key));
           break;
         }
         case 6: {  // NUMKEYS
           std::lock_guard<std::mutex> lk(store.mu);
-          ok = send_reply(fd, static_cast<int64_t>(store.data.size()), "");
+          ret = static_cast<int64_t>(store.data.size());
           break;
         }
         case 7:  // PING
-          ok = send_reply(fd, 0, "");
+          ret = 0;
           break;
         default:
-          ok = send_reply(fd, -3, "");
+          ret = -3;
           break;
       }
-      if (!ok) break;
+      if (!alive || !send_reply(fd, ret, reply)) break;
     }
     ::close(fd);
     self->done.store(true);
@@ -292,10 +297,14 @@ void kv_server_stop(void* h) {
   ::close(s->listen_fd);
   if (s->accept_thread.joinable()) s->accept_thread.join();
   {
-    // unblock every worker stuck in recv() by shutting its conn down,
-    // then join all — no thread can outlive the Server it references
+    // unblock every live worker stuck in recv() by shutting its conn
+    // down, then join all — no thread can outlive the Server it
+    // references. done workers already closed their fd (the number may
+    // have been reused by an unrelated descriptor): never touch those.
     std::lock_guard<std::mutex> lk(s->workers_mu);
-    for (auto& w : s->workers) ::shutdown(w->fd, SHUT_RDWR);
+    for (auto& w : s->workers) {
+      if (!w->done.load()) ::shutdown(w->fd, SHUT_RDWR);
+    }
     for (auto& w : s->workers) {
       if (w->thread.joinable()) w->thread.join();
     }
@@ -337,6 +346,15 @@ void kv_client_close(void* h) {
   auto* c = static_cast<Client*>(h);
   ::close(c->fd);
   delete c;
+}
+
+// shutdown-only variant: unblocks any thread inside roundtrip() (its recv
+// returns 0 -> -100 error) WITHOUT freeing the Client, so concurrent users
+// see a clean error instead of use-after-free. The small Client struct is
+// reclaimed at process exit.
+void kv_client_shutdown(void* h) {
+  if (!h) return;
+  ::shutdown(static_cast<Client*>(h)->fd, SHUT_RDWR);
 }
 
 int64_t kv_client_set(void* h, const char* key, const void* val,

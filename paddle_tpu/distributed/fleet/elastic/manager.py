@@ -51,11 +51,17 @@ class ElasticManager:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._epoch_key = f"{self.prefix}/epoch"
+        # node -> (last counter, monotonic time it was first observed)
+        self._seen: dict = {}
 
     # -- heartbeats --------------------------------------------------------
+    # heartbeats are monotonic counters bumped via store.add, and liveness
+    # is "counter changed within timeout BY THE WATCHER'S OWN CLOCK" —
+    # cross-host wall-clock skew can neither kill a healthy node nor mask
+    # a dead one (the reference leans on etcd lease TTLs for the same
+    # property).
     def start(self):
-        self.store.set(f"{self.prefix}/node/{self.node_id}",
-                       str(time.time()))
+        self.store.add(f"{self.prefix}/hb/{self.node_id}", 1)
         self._stop.clear()
         self._thread = threading.Thread(target=self._beat, daemon=True)
         self._thread.start()
@@ -65,13 +71,12 @@ class ElasticManager:
         if self._thread:
             self._thread.join(self.interval * 3)
             self._thread = None
-        self.store.set(f"{self.prefix}/node/{self.node_id}", "")
+        self.store.set(f"{self.prefix}/hb/{self.node_id}", "")
 
     def _beat(self):
         while not self._stop.wait(self.interval):
             try:
-                self.store.set(f"{self.prefix}/node/{self.node_id}",
-                               str(time.time()))
+                self.store.add(f"{self.prefix}/hb/{self.node_id}", 1)
             except Exception:
                 return  # store gone: the watcher will see us dead
 
@@ -81,20 +86,30 @@ class ElasticManager:
         self.store.set(f"{self.prefix}/members", ",".join(node_ids))
 
     def _snapshot(self):
-        """One consistent poll: (alive, dead) from a single read pass."""
+        """One consistent poll: (alive, dead) from a single read pass.
+        A node is alive while its heartbeat counter keeps advancing within
+        ``timeout`` seconds of this watcher's monotonic clock."""
         members = self.store.get(f"{self.prefix}/members").decode()
-        now = time.time()
+        now = time.monotonic()
         alive, dead = [], []
         for n in members.split(","):
             if not n:
                 continue
             try:
-                ts = self.store.get(f"{self.prefix}/node/{n}",
-                                    wait=False).decode()
+                raw = self.store.get(f"{self.prefix}/hb/{n}",
+                                     wait=False).decode()
             except KeyError:
+                raw = ""
+            if not raw:  # never started, or stopped cleanly
+                self._seen.pop(n, None)
                 dead.append(n)
                 continue
-            if ts and now - float(ts) < self.timeout:
+            counter = int(raw)
+            last = self._seen.get(n)
+            if last is None or last[0] != counter:
+                self._seen[n] = (counter, now)
+                alive.append(n)
+            elif now - last[1] < self.timeout:
                 alive.append(n)
             else:
                 dead.append(n)

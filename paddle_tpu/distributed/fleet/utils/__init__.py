@@ -2,3 +2,5 @@ from ..recompute import recompute, recompute_sequential  # noqa: F401
 from .hybrid_parallel_util import fused_allreduce_gradients  # noqa: F401
 from . import sequence_parallel_utils  # noqa: F401
 from . import hybrid_parallel_util  # noqa: F401
+from . import timer_helper  # noqa: F401
+from .timer_helper import get_timers, set_timers  # noqa: F401

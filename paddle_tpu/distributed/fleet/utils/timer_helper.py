@@ -1,0 +1,85 @@
+"""Distributed timers (parity: python/paddle/distributed/fleet/utils/
+timer_helper.py — get_timers/set_timers, _Timer start/stop/elapsed,
+log with cross-rank min/max via collectives)."""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+__all__ = ["get_timers", "set_timers", "Timers"]
+
+_GLOBAL_TIMERS: Optional["Timers"] = None
+
+
+def get_timers() -> "Timers":
+    global _GLOBAL_TIMERS
+    if _GLOBAL_TIMERS is None:
+        _GLOBAL_TIMERS = Timers()
+    return _GLOBAL_TIMERS
+
+
+def set_timers(timers: Optional["Timers"] = None):
+    global _GLOBAL_TIMERS
+    _GLOBAL_TIMERS = timers if timers is not None else Timers()
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self._elapsed = 0.0
+        self._started = False
+        self._start_time = 0.0
+
+    def start(self):
+        assert not self._started, f"timer {self.name} already started"
+        self._start_time = time.perf_counter()
+        self._started = True
+
+    def stop(self):
+        assert self._started, f"timer {self.name} not started"
+        self._elapsed += time.perf_counter() - self._start_time
+        self._started = False
+
+    def reset(self):
+        self._elapsed = 0.0
+        self._started = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        was_started = self._started
+        if was_started:
+            self.stop()
+        e = self._elapsed
+        if reset:
+            self.reset()
+        if was_started:
+            self.start()
+        return e
+
+
+class Timers:
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.timers
+
+    def log(self, names=None, normalizer: float = 1.0, reset: bool = True
+            ) -> str:
+        """Per-name elapsed ms (divided by ``normalizer``, e.g. number of
+        microbatches), printed and returned."""
+        assert normalizer > 0.0
+        names = names if names is not None else list(self.timers)
+        parts = []
+        for name in names:
+            if name not in self.timers:
+                continue
+            ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+            parts.append(f"{name}: {ms:.2f}")
+        text = "time (ms) | " + " | ".join(parts)
+        print(text, flush=True)
+        return text

@@ -48,8 +48,12 @@ class CollectiveController:
         a = self.args
         nnodes = a.nnodes
         nproc = a.nproc_per_node
+        if nnodes > 1 and not a.master:
+            raise SystemExit(
+                "launch: --nnodes > 1 requires --master host:port (every "
+                "node must rendezvous at the same KV endpoint)")
         if nnodes > 1 or a.master:
-            master = a.master or f"{_host_ip()}:{_free_port()}"
+            master = a.master
             host, port = master.rsplit(":", 1)
             is_master = a.rank == 0 or (a.rank < 0 and self._is_local(host))
             self.store = TCPStore(host, int(port), is_master=is_master,
@@ -61,13 +65,10 @@ class CollectiveController:
                               for _ in range(nproc))
             self.store.set(f"__launch/pod/{node_rank}", my_eps)
             self.store.barrier("launch", a.rendezvous_timeout)
-            all_eps: List[str] = []
-            for r in range(nnodes):
-                eps = self.store.get(f"__launch/pod/{r}").decode()
-                all_eps.extend(eps.split(","))
-            rank_base = sum(
-                len(self.store.get(f"__launch/pod/{r}").decode().split(","))
-                for r in range(node_rank))
+            per_node = [self.store.get(f"__launch/pod/{r}").decode()
+                        .split(",") for r in range(nnodes)]
+            all_eps: List[str] = [ep for eps in per_node for ep in eps]
+            rank_base = sum(len(per_node[r]) for r in range(node_rank))
             master_ep = master
         else:
             node_rank, rank_base = 0, 0

@@ -35,6 +35,7 @@ def _lib():
     lib.kv_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                       ctypes.c_int]
     lib.kv_client_close.argtypes = [ctypes.c_void_p]
+    lib.kv_client_shutdown.argtypes = [ctypes.c_void_p]
     for fn, extra in [("kv_client_set", [ctypes.c_void_p, ctypes.c_char_p,
                                          ctypes.c_char_p, ctypes.c_uint32]),
                       ("kv_client_get", [ctypes.c_void_p, ctypes.c_char_p,
@@ -126,14 +127,19 @@ class TCPStore:
     def get(self, key: str, wait: bool = True) -> bytes:
         if wait:
             self.wait(key)
-        buf = ctypes.create_string_buffer(_MAXVAL)
-        n = self._lib.kv_client_get(self._conn(), key.encode(), buf,
-                                    _MAXVAL)
-        if n == -1:
-            raise KeyError(key)
-        if n < 0:
-            raise RuntimeError(f"TCPStore.get({key}) failed: {n}")
-        return buf.raw[:n]
+        # two-phase: small buffer first (rendezvous values are bytes-sized),
+        # exact retry only for large values
+        for size in (4096, _MAXVAL):
+            buf = ctypes.create_string_buffer(size)
+            n = self._lib.kv_client_get(self._conn(), key.encode(), buf,
+                                        size)
+            if n == -1:
+                raise KeyError(key)
+            if n < 0:
+                raise RuntimeError(f"TCPStore.get({key}) failed: {n}")
+            if n <= size:
+                return buf.raw[:n]
+        raise RuntimeError(f"TCPStore.get({key}): value exceeds {_MAXVAL}B")
 
     def add(self, key: str, amount: int = 1) -> int:
         out = ctypes.c_int64(0)
@@ -170,10 +176,13 @@ class TCPStore:
         self.wait(f"__barrier/{name}/done/{gen}", timeout)
 
     def close(self):
+        """Shut down every connection (unblocking any thread mid-request
+        with a clean error) without freeing native handles other threads
+        may still be touching; the server, if hosted here, stops fully."""
         self._closed = True
         with self._conns_lock:
             for c in self._all_conns:
-                self._lib.kv_client_close(c)
+                self._lib.kv_client_shutdown(c)
             self._all_conns.clear()
         self._local = threading.local()
         if self._server is not None:

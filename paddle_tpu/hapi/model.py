@@ -231,10 +231,13 @@ class Model:
         cbks.set_model(self)
         cbks.on_predict_begin()
         outs = []
+        n_inputs = len(_as_tuple(self._inputs)) if self._inputs else None
         for step, batch in enumerate(loader):
             cbks.on_predict_batch_begin(step)
             batch = _as_tuple(batch)
-            if (self._loss is not None or self._metrics) and len(batch) > 1:
+            if n_inputs is not None:
+                batch = batch[:n_inputs]  # declared input arity wins
+            elif self._loss is not None and len(batch) > 1:
                 batch, _ = self._split_batch(batch)  # drop labels
             out = self.predict_batch(batch)
             outs.append([o.numpy() for o in _as_tuple(out)])

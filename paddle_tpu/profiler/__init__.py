@@ -1,0 +1,330 @@
+"""Unified profiler (parity: python/paddle/profiler/profiler.py —
+Profiler:346, make_scheduler:117, export_chrome_tracing:215, RecordEvent;
+statistics tables in profiler_statistic.py).
+
+TPU-native design: the device side delegates to jax.profiler (XPlane —
+TensorBoard-consumable traces of XLA executions); the host side is a
+RecordEvent tracer fed by (a) user-annotated scopes and (b) every
+``run_op`` dispatch via the core hook (the reference emits RecordEvent
+from every generated op function). The schedule(wait/warmup/active) state
+machine and chrome-trace export keep the reference API.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, Iterable, List, Optional
+
+__all__ = ["ProfilerState", "ProfilerTarget", "make_scheduler",
+           "export_chrome_tracing", "RecordEvent", "Profiler",
+           "load_profiler_result", "SummaryView"]
+
+
+class ProfilerState(Enum):
+    """Parity: profiler.ProfilerState."""
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1      # accepted for API parity; maps to the device target
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Step-number -> state schedule (parity: make_scheduler:117):
+    skip_first CLOSED steps, then cycles of closed/ready/record, the last
+    record step of each cycle returning RECORD_AND_RETURN."""
+    num_steps = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        cycle = step // num_steps
+        if repeat > 0 and cycle >= repeat:
+            return ProfilerState.CLOSED
+        pos = step % num_steps
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == num_steps - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def _default_state_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+class _HostEvent:
+    __slots__ = ("name", "start", "end", "tid", "category")
+
+    def __init__(self, name, start, end, tid, category="op"):
+        self.name = name
+        self.start = start
+        self.end = end
+        self.tid = tid
+        self.category = category
+
+
+class _HostTracer:
+    """Collects host events; enabled only while a Profiler is RECORD-ing."""
+
+    def __init__(self):
+        self.events: List[_HostEvent] = []
+        self._lock = threading.Lock()
+
+    def add(self, name, t0, t1, category="op"):
+        ev = _HostEvent(name, t0, t1, threading.get_ident(), category)
+        with self._lock:
+            self.events.append(ev)
+
+
+_current: Optional["Profiler"] = None
+
+
+class RecordEvent:
+    """User scope annotation (parity: paddle.profiler.RecordEvent):
+
+        with profiler.RecordEvent("data_loading"):
+            ...
+    """
+
+    def __init__(self, name: str, event_type: str = "UserDefined"):
+        self.name = name
+        self.event_type = event_type
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+
+    def end(self):
+        if self._t0 is None:
+            return
+        prof = _current
+        if prof is not None and prof._tracer is not None:
+            prof._tracer.add(self.name, self._t0, time.perf_counter(),
+                             "user")
+        self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None
+                          ) -> Callable:
+    """on_trace_ready handler writing chrome://tracing JSON
+    (parity: export_chrome_tracing:215)."""
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        worker = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(
+            dir_name, f"{worker}_time_{int(time.time() * 1000)}"
+                      f".paddle_trace.json")
+        prof._export_chrome(path)
+        prof.last_export_path = path
+    return handler
+
+
+class Profiler:
+    """Parity: paddle.profiler.Profiler (profiler.py:346).
+
+    with Profiler(scheduler=(2, 5), on_trace_ready=...) as p:
+        for batch in loader:
+            train_step(batch)
+            p.step()
+    """
+
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready: Optional[Callable] = None,
+                 timer_only: bool = False, record_shapes: bool = False,
+                 profile_memory: bool = False, with_flops: bool = False,
+                 emit_nvtx: bool = False, custom_device_types=None):
+        del record_shapes, profile_memory, with_flops, emit_nvtx
+        del custom_device_types
+        self.targets = list(targets) if targets else [ProfilerTarget.CPU]
+        if isinstance(scheduler, tuple):
+            start, end = scheduler
+            self.scheduler = make_scheduler(closed=max(start, 0), ready=0,
+                                            record=end - start, repeat=1)
+        elif scheduler is None:
+            self.scheduler = _default_state_scheduler
+        else:
+            self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._tracer: Optional[_HostTracer] = None
+        self._all_events: List[_HostEvent] = []
+        self._device_tracing = False
+        self._step_t0 = None
+        self._step_durations: List[float] = []
+        self.last_export_path = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        global _current
+        _current = self
+        self.current_state = self.scheduler(self.step_num)
+        self._transition(ProfilerState.CLOSED, self.current_state)
+        self._step_t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        global _current
+        self._transition(self.current_state, ProfilerState.CLOSED,
+                         final=True)
+        self.current_state = ProfilerState.CLOSED
+        if _current is self:
+            _current = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def step(self, num_samples: Optional[int] = None):
+        del num_samples
+        now = time.perf_counter()
+        if self._step_t0 is not None:
+            self._step_durations.append(now - self._step_t0)
+        self._step_t0 = now
+        prev = self.current_state
+        self.step_num += 1
+        self.current_state = self.scheduler(self.step_num)
+        self._transition(prev, self.current_state)
+
+    # -- state machine -----------------------------------------------------
+    def _recording(self, state) -> bool:
+        return state in (ProfilerState.RECORD,
+                         ProfilerState.RECORD_AND_RETURN)
+
+    def _transition(self, prev, new, final=False):
+        was, now = self._recording(prev), self._recording(new) and not final
+        if not was and now:
+            self._begin_record()
+        elif was and (not now or prev == ProfilerState.RECORD_AND_RETURN):
+            self._end_record()
+            if now and prev == ProfilerState.RECORD_AND_RETURN:
+                self._begin_record()
+
+    def _begin_record(self):
+        from ..core import dispatch as _dispatch
+        self._tracer = _HostTracer()
+        if not self.timer_only:
+            _dispatch.set_op_profile_hook(self._tracer.add)
+            self._maybe_device_trace(True)
+
+    def _end_record(self):
+        from ..core import dispatch as _dispatch
+        if self._tracer is None:
+            return
+        _dispatch.set_op_profile_hook(None)
+        self._maybe_device_trace(False)
+        self._all_events.extend(self._tracer.events)
+        tracer, self._tracer = self._tracer, None
+        del tracer
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def _maybe_device_trace(self, start: bool):
+        """Device side = jax.profiler XPlane trace (TensorBoard format)."""
+        want_device = any(t != ProfilerTarget.CPU for t in self.targets)
+        if not want_device:
+            return
+        import jax
+        try:
+            if start and not self._device_tracing:
+                d = os.environ.get("PADDLE_PROFILER_TRACE_DIR",
+                                   "/tmp/paddle_tpu_xplane")
+                jax.profiler.start_trace(d)
+                self._device_tracing = True
+            elif not start and self._device_tracing:
+                jax.profiler.stop_trace()
+                self._device_tracing = False
+        except Exception:
+            self._device_tracing = False  # device tracer unavailable (CPU CI)
+
+    # -- results -----------------------------------------------------------
+    def _export_chrome(self, path: str):
+        events = []
+        for ev in self._all_events or (self._tracer.events
+                                       if self._tracer else []):
+            events.append({
+                "name": ev.name, "ph": "X", "pid": os.getpid(),
+                "tid": ev.tid, "ts": ev.start * 1e6,
+                "dur": (ev.end - ev.start) * 1e6,
+                "cat": ev.category,
+            })
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+
+    def export(self, path: str, format: str = "json"):
+        del format
+        self._export_chrome(path)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms") -> str:
+        """Op statistic table (parity: profiler_statistic summary)."""
+        del sorted_by, op_detail, thread_sep
+        unit = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
+        stats = {}
+        for ev in self._all_events:
+            tot, cnt, mx = stats.get(ev.name, (0.0, 0, 0.0))
+            d = ev.end - ev.start
+            stats[ev.name] = (tot + d, cnt + 1, max(mx, d))
+        rows = sorted(stats.items(), key=lambda kv: -kv[1][0])
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
+                 f"{'Avg(' + time_unit + ')':>12}{'Max(' + time_unit + ')':>12}"]
+        for name, (tot, cnt, mx) in rows:
+            lines.append(f"{name[:39]:<40}{cnt:>8}{tot * unit:>14.3f}"
+                         f"{tot / cnt * unit:>12.3f}{mx * unit:>12.3f}")
+        if self._step_durations:
+            import numpy as np
+            sd = np.asarray(self._step_durations)
+            lines.append(f"steps: {len(sd)}  avg "
+                         f"{sd.mean() * unit:.3f}{time_unit}  p50 "
+                         f"{np.percentile(sd, 50) * unit:.3f}{time_unit}")
+        text = "\n".join(lines)
+        print(text)
+        return text
+
+    @property
+    def events(self):
+        return list(self._all_events)
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def load_profiler_result(filename: str) -> dict:
+    with open(filename) as f:
+        return json.load(f)

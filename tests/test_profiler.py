@@ -1,0 +1,125 @@
+"""Profiler, timers, amp tensor-checker tests (reference test models:
+test/legacy_test/test_profiler.py, test_newprofiler.py)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.distributed.fleet.utils import get_timers, set_timers
+from paddle_tpu.profiler import (Profiler, ProfilerState, RecordEvent,
+                                 export_chrome_tracing, make_scheduler)
+
+
+class TestScheduler:
+    def test_make_scheduler_cycle(self):
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+        states = [sched(i) for i in range(6)]
+        assert states == [ProfilerState.CLOSED, ProfilerState.READY,
+                          ProfilerState.RECORD,
+                          ProfilerState.RECORD_AND_RETURN,
+                          ProfilerState.CLOSED, ProfilerState.CLOSED]
+
+    def test_skip_first(self):
+        sched = make_scheduler(closed=0, ready=0, record=1, repeat=2,
+                               skip_first=3)
+        assert sched(0) == ProfilerState.CLOSED
+        assert sched(2) == ProfilerState.CLOSED
+        assert sched(3) == ProfilerState.RECORD_AND_RETURN
+        assert sched(4) == ProfilerState.RECORD_AND_RETURN
+        assert sched(5) == ProfilerState.CLOSED
+
+
+class TestProfiler:
+    def _work(self):
+        x = paddle.to_tensor(np.random.RandomState(0).randn(8, 8)
+                             .astype(np.float32))
+        y = paddle.matmul(x, x)
+        return (y * 2).sum()
+
+    def test_records_op_events(self):
+        with Profiler() as p:
+            with RecordEvent("user_scope"):
+                self._work()
+        names = {e.name for e in p.events}
+        assert "matmul" in names
+        assert "user_scope" in names
+
+    def test_hook_cleared_after_stop(self):
+        from paddle_tpu.core import dispatch
+        with Profiler():
+            self._work()
+        assert dispatch._op_profile_hook is None
+        self._work()  # ops run after stop() must not crash or record
+
+    def test_chrome_export(self, tmp_path):
+        handler = export_chrome_tracing(str(tmp_path))
+        with Profiler(scheduler=make_scheduler(closed=0, ready=0, record=1,
+                                               repeat=1),
+                      on_trace_ready=handler) as p:
+            self._work()
+            p.step()
+        assert p.last_export_path and os.path.exists(p.last_export_path)
+        trace = json.load(open(p.last_export_path))
+        assert any(ev["name"] == "matmul" for ev in trace["traceEvents"])
+        assert all({"ph", "ts", "dur", "pid", "tid"} <= set(ev)
+                   for ev in trace["traceEvents"])
+
+    def test_summary_table(self):
+        with Profiler() as p:
+            for _ in range(3):
+                self._work()
+                p.step()
+        text = p.summary(time_unit="us")
+        assert "matmul" in text
+        assert "steps: 3" in text
+
+    def test_scheduled_window_only(self):
+        # record only step 1 (0-indexed): events from step 0 are dropped
+        sched = make_scheduler(closed=1, ready=0, record=1, repeat=1)
+        with Profiler(scheduler=sched) as p:
+            self._work()   # step 0: CLOSED
+            p.step()       # -> RECORD_AND_RETURN window opens
+            self._work()
+            p.step()
+        assert any(e.name == "matmul" for e in p.events)
+        # exactly one window's worth: fewer events than two full steps
+        matmuls = [e for e in p.events if e.name == "matmul"]
+        assert len(matmuls) == 1
+
+
+class TestTimers:
+    def test_start_stop_elapsed(self):
+        set_timers()
+        t = get_timers()("fwd")
+        t.start()
+        t.stop()
+        e = t.elapsed(reset=False)
+        assert e >= 0.0
+        t.reset()
+        assert t.elapsed() == 0.0
+
+    def test_log_format(self, capsys):
+        set_timers()
+        tm = get_timers()
+        tm("a").start(); tm("a").stop()  # noqa: E702
+        tm("b").start(); tm("b").stop()  # noqa: E702
+        text = tm.log(["a", "b"], normalizer=2.0)
+        assert text.startswith("time (ms) |")
+        assert "a:" in text and "b:" in text
+
+
+class TestTensorChecker:
+    def test_checker_catches_nan(self):
+        cfg = paddle.amp.debugging.TensorCheckerConfig(enable=True)
+        paddle.amp.debugging.enable_tensor_checker(cfg)
+        try:
+            x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+            with pytest.raises(FloatingPointError):
+                _ = x / 0.0
+        finally:
+            paddle.amp.debugging.disable_tensor_checker()
+        # disabled again: no raise
+        _ = paddle.to_tensor(np.array([1.0], np.float32)) / 0.0
