@@ -237,7 +237,8 @@ class Model:
             batch = _as_tuple(batch)
             if n_inputs is not None:
                 batch = batch[:n_inputs]  # declared input arity wins
-            elif self._loss is not None and len(batch) > 1:
+            elif (self._loss is not None or self._metrics) \
+                    and len(batch) > 1:
                 batch, _ = self._split_batch(batch)  # drop labels
             out = self.predict_batch(batch)
             outs.append([o.numpy() for o in _as_tuple(out)])

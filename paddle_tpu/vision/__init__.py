@@ -1,0 +1,7 @@
+"""paddle_tpu.vision (parity: python/paddle/vision/)."""
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from .models import (ResNet, resnet18, resnet34, resnet50,  # noqa: F401
+                     resnet101, resnet152, LeNet, VGG, vgg16,
+                     MobileNetV2, mobilenet_v2)

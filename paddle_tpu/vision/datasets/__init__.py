@@ -1,0 +1,172 @@
+"""Vision datasets (parity: python/paddle/vision/datasets/ — MNIST,
+Cifar10/100, plus a FakeData generator for hardware-free pipelines).
+
+Zero-egress environment: ``download=True`` is rejected with instructions;
+the loaders read the standard local file formats (IDX for MNIST, the
+python-pickle batches for CIFAR) from a user-supplied path.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data (for tests and
+    input-pipeline benchmarks; the reference uses datasets.FakeData-style
+    stand-ins in CI for the same purpose)."""
+
+    def __init__(self, size=100, image_shape=(3, 32, 32), num_classes=10,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self._rng = np.random.RandomState(seed)
+        self._images = self._rng.randint(
+            0, 256, (size,) + self.image_shape).astype(np.uint8)
+        self._labels = self._rng.randint(
+            0, num_classes, (size,)).astype(np.int64)
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        img = self._images[idx]
+        if self.transform is not None:
+            # transforms consume HWC (like the file-backed datasets)
+            img = self.transform(np.transpose(img, (1, 2, 0)))
+        else:
+            img = img.astype(np.float32) / 255.0
+        return img, self._labels[idx]
+
+
+def _no_download(cls_name: str):
+    raise ValueError(
+        f"{cls_name}: download=True is unsupported in this environment "
+        f"(no network egress). Place the standard dataset files locally "
+        f"and pass their path.")
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST loader (parity: paddle.vision.datasets.MNIST;
+    image_path/label_path point at the (optionally .gz) IDX files)."""
+
+    NAME = "MNIST"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        del backend
+        if download and (image_path is None or label_path is None):
+            _no_download(self.NAME)
+        if image_path is None or label_path is None:
+            raise ValueError(
+                f"{self.NAME} requires image_path and label_path")
+        self.mode = mode
+        self.transform = transform
+        self.images = self._read_idx(image_path, expect_dims=3)
+        self.labels = self._read_idx(label_path, expect_dims=1)
+        if len(self.images) != len(self.labels):
+            raise ValueError("image/label count mismatch")
+
+    @staticmethod
+    def _read_idx(path, expect_dims):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            data = f.read()
+        if data[:2] != b"\x00\x00":
+            raise ValueError(f"{path}: not an IDX file (bad magic prefix)")
+        dtype_code = data[2]
+        if dtype_code != 0x08:  # MNIST files are uint8
+            raise ValueError(
+                f"{path}: unsupported IDX dtype code 0x{dtype_code:02x} "
+                f"(expected 0x08 = uint8)")
+        ndim = data[3]
+        if ndim != expect_dims:
+            raise ValueError(f"{path}: IDX ndim {ndim} != {expect_dims}")
+        dims = [int.from_bytes(data[4 + i * 4:8 + i * 4], "big")
+                for i in range(ndim)]
+        arr = np.frombuffer(data, np.uint8, offset=4 + 4 * ndim)
+        return arr.reshape(dims)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]  # [28, 28] uint8
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = (img.astype(np.float32) / 255.0)[None]
+        return img, np.int64(self.labels[idx])
+
+
+class FashionMNIST(MNIST):
+    NAME = "FashionMNIST"
+
+
+class _CifarBase(Dataset):
+    _TRAIN_FILES: list = []
+    _TEST_FILES: list = []
+    _LABEL_KEY = b"labels"
+    NAME = "Cifar"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        del backend
+        if download and data_file is None:
+            _no_download(self.NAME)
+        if data_file is None:
+            raise ValueError(f"{self.NAME} requires data_file (the "
+                             f"python-version tar.gz archive)")
+        self.mode = mode
+        self.transform = transform
+        names = self._TRAIN_FILES if mode == "train" else self._TEST_FILES
+        images, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                base = os.path.basename(member.name)
+                if base not in names:
+                    continue
+                batch = pickle.load(tf.extractfile(member),
+                                    encoding="bytes")
+                images.append(np.asarray(batch[b"data"], np.uint8))
+                labels.extend(batch[self._LABEL_KEY])
+        if not images:
+            raise ValueError(f"{self.NAME}: no {mode} batches found in "
+                             f"{data_file}")
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]  # CHW uint8
+        if self.transform is not None:
+            img = self.transform(np.transpose(img, (1, 2, 0)))  # HWC in
+        else:
+            img = img.astype(np.float32) / 255.0
+        return img, self.labels[idx]
+
+
+class Cifar10(_CifarBase):
+    NAME = "Cifar10"
+    _TRAIN_FILES = [f"data_batch_{i}" for i in range(1, 6)]
+    _TEST_FILES = ["test_batch"]
+    _LABEL_KEY = b"labels"
+
+
+class Cifar100(_CifarBase):
+    NAME = "Cifar100"
+    _TRAIN_FILES = ["train"]
+    _TEST_FILES = ["test"]
+    _LABEL_KEY = b"fine_labels"

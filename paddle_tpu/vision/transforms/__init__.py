@@ -1,0 +1,292 @@
+"""Vision transforms (parity: python/paddle/vision/transforms/ —
+Compose + the common transform classes and their functional forms).
+
+TPU-native: transforms run host-side on numpy HWC uint8/float arrays (the
+data-loading path), producing CHW float arrays for the device; no PIL
+dependency (arrays in, arrays out — PIL images are accepted via
+np.asarray)."""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import Sequence
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Resize", "CenterCrop", "RandomCrop",
+           "RandomHorizontalFlip", "RandomVerticalFlip", "Normalize",
+           "Transpose", "BrightnessTransform", "Pad",
+           "to_tensor", "resize", "center_crop", "crop", "hflip", "vflip",
+           "normalize", "pad"]
+
+
+def _as_hwc(img) -> np.ndarray:
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+# -- functional ---------------------------------------------------------
+
+def to_tensor(img, data_format="CHW") -> np.ndarray:
+    """uint8 HWC -> float32 [0,1] CHW (parity: F.to_tensor)."""
+    arr = _as_hwc(img)
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    else:
+        arr = arr.astype(np.float32)
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return arr
+
+
+def resize(img, size, interpolation="bilinear") -> np.ndarray:
+    """Resize HWC array (parity: F.resize). size: int (short side) or
+    (h, w). Pure numpy: the input pipeline stays host-side — no per-shape
+    XLA compilation and no contention with the training program."""
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    if isinstance(size, int):
+        if h <= w:
+            oh, ow = size, max(1, int(round(w * size / h)))
+        else:
+            oh, ow = max(1, int(round(h * size / w))), size
+    else:
+        oh, ow = size
+    if (oh, ow) == (h, w):
+        return arr
+    src = arr.astype(np.float32)
+    if interpolation == "nearest":
+        ri = np.minimum((np.arange(oh) * h / oh).astype(np.int64), h - 1)
+        ci = np.minimum((np.arange(ow) * w / ow).astype(np.int64), w - 1)
+        out = src[ri[:, None], ci[None, :]]
+    else:  # bilinear (half-pixel centers, matches jax/PIL convention)
+        ry = np.clip((np.arange(oh) + 0.5) * h / oh - 0.5, 0, h - 1)
+        rx = np.clip((np.arange(ow) + 0.5) * w / ow - 0.5, 0, w - 1)
+        y0 = np.floor(ry).astype(np.int64)
+        x0 = np.floor(rx).astype(np.int64)
+        y1 = np.minimum(y0 + 1, h - 1)
+        x1 = np.minimum(x0 + 1, w - 1)
+        wy = (ry - y0)[:, None, None]
+        wx = (rx - x0)[None, :, None]
+        out = (src[y0[:, None], x0[None, :]] * (1 - wy) * (1 - wx)
+               + src[y1[:, None], x0[None, :]] * wy * (1 - wx)
+               + src[y0[:, None], x1[None, :]] * (1 - wy) * wx
+               + src[y1[:, None], x1[None, :]] * wy * wx)
+    if arr.dtype == np.uint8:
+        return np.clip(np.round(out), 0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)
+
+
+def crop(img, top, left, height, width) -> np.ndarray:
+    arr = _as_hwc(img)
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size) -> np.ndarray:
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    if th > h or tw > w:
+        raise ValueError(
+            f"center_crop: crop size ({th}, {tw}) larger than image "
+            f"({h}, {w}); pad first")
+    top = int(round((h - th) / 2.0))
+    left = int(round((w - tw) / 2.0))
+    return crop(arr, top, left, th, tw)
+
+
+def hflip(img) -> np.ndarray:
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img) -> np.ndarray:
+    return _as_hwc(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant") -> np.ndarray:
+    arr = _as_hwc(img)
+    if isinstance(padding, int):
+        pl = pr = pt = pb = padding
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    kwargs = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, ((pt, pb), (pl, pr), (0, 0)), mode=mode, **kwargs)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False) -> np.ndarray:
+    del to_rgb
+    arr = np.asarray(img, np.float32)
+    ch = arr.shape[0] if data_format == "CHW" else arr.shape[-1]
+    mean = np.asarray(mean, np.float32).reshape(-1)
+    std = np.asarray(std, np.float32).reshape(-1)
+    if mean.size == 1:
+        mean = np.broadcast_to(mean, (ch,))
+    if std.size == 1:
+        std = np.broadcast_to(std, (ch,))
+    if mean.size != ch or std.size != ch:
+        raise ValueError(
+            f"normalize: mean/std of size {mean.size}/{std.size} do not "
+            f"match {ch} channels ({data_format})")
+    if data_format == "CHW":
+        return (arr - mean[:, None, None]) / std[:, None, None]
+    return (arr - mean) / std
+
+
+# -- transform classes --------------------------------------------------
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        del keys
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        del keys
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        del keys
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        del keys
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        arr = _as_hwc(img)
+        if self.padding is not None:
+            arr = pad(arr, self.padding, self.fill, self.padding_mode)
+        th, tw = self.size
+        h, w = arr.shape[:2]
+        if self.pad_if_needed and h < th:
+            arr = pad(arr, (0, th - h, 0, th - h), self.fill,
+                      self.padding_mode)
+            h = arr.shape[0]
+        if self.pad_if_needed and w < tw:
+            arr = pad(arr, (tw - w, 0, tw - w, 0), self.fill,
+                      self.padding_mode)
+            w = arr.shape[1]
+        if h < th or w < tw:
+            raise ValueError(
+                f"RandomCrop: image ({h}, {w}) smaller than crop "
+                f"({th}, {tw}); use padding or pad_if_needed=True")
+        top = random.randint(0, h - th)
+        left = random.randint(0, w - tw)
+        return crop(arr, top, left, th, tw)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        del keys
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else _as_hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        del keys
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else _as_hwc(img)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        del keys
+        # scalars stay scalar: normalize() broadcasts to however many
+        # channels the image actually has (1-channel MNIST included)
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+        self.to_rgb = to_rgb
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format,
+                         self.to_rgb)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        del keys
+        self.order = order
+
+    def _apply_image(self, img):
+        return np.transpose(_as_hwc(img), self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        del keys
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _as_hwc(img)
+        arr = _as_hwc(img)
+        factor = random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        dtype = arr.dtype
+        out = arr.astype(np.float32) * factor
+        if dtype == np.uint8:
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out.astype(dtype)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        del keys
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)

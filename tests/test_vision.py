@@ -1,0 +1,159 @@
+"""Vision models/transforms/datasets tests (reference test models:
+test/legacy_test/test_vision_models.py, test_transforms.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision.datasets import FakeData, MNIST
+from paddle_tpu.vision.models import (LeNet, MobileNetV2, resnet18,
+                                      resnet50, vgg16)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(0)
+
+
+def _img(b=1, c=3, s=64):
+    return paddle.to_tensor(
+        np.random.RandomState(0).randn(b, c, s, s).astype(np.float32))
+
+
+class TestModels:
+    def test_resnet18_forward(self):
+        m = resnet18(num_classes=10)
+        m.eval()
+        out = m(_img(2))
+        assert out.shape == [2, 10]
+
+    def test_resnet50_bottleneck_channels(self):
+        m = resnet50(num_classes=7)
+        m.eval()
+        out = m(_img(1))
+        assert out.shape == [1, 7]
+        # bottleneck expansion: layer4 output has 2048 channels
+        assert m.fc.weight.shape[0] == 2048
+
+    def test_resnet_without_head(self):
+        m = resnet18(num_classes=0, with_pool=False)
+        m.eval()
+        out = m(_img(1, s=64))
+        assert out.shape == [1, 512, 2, 2]
+
+    def test_lenet(self):
+        m = LeNet()
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 1, 28, 28).astype(np.float32))
+        assert m(x).shape == [2, 10]
+
+    def test_vgg16(self):
+        m = vgg16(num_classes=5)
+        m.eval()
+        assert m(_img(1, s=32)).shape == [1, 5]
+
+    def test_mobilenet_v2(self):
+        m = MobileNetV2(num_classes=4)
+        m.eval()
+        assert m(_img(1, s=32)).shape == [1, 4]
+
+    def test_resnet_trains(self):
+        m = resnet18(num_classes=4)
+        m.train()
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        x = _img(4, s=32)
+        y = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
+        loss_fn = paddle.nn.CrossEntropyLoss()
+        losses = []
+        for _ in range(4):
+            loss = loss_fn(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_pretrained_raises(self):
+        with pytest.raises(NotImplementedError, match="network access"):
+            resnet18(pretrained=True)
+
+
+class TestTransforms:
+    def test_to_tensor_chw_scaling(self):
+        img = np.full((4, 6, 3), 255, np.uint8)
+        out = T.ToTensor()(img)
+        assert out.shape == (3, 4, 6)
+        np.testing.assert_allclose(out, 1.0)
+
+    def test_resize_short_side_and_exact(self):
+        img = np.zeros((10, 20, 3), np.uint8)
+        assert T.resize(img, 5).shape == (5, 10, 3)
+        assert T.resize(img, (7, 9)).shape == (7, 9, 3)
+
+    def test_center_crop(self):
+        img = np.arange(5 * 5).reshape(5, 5, 1).astype(np.uint8)
+        out = T.center_crop(img, 3)
+        assert out.shape == (3, 3, 1)
+        assert out[1, 1, 0] == img[2, 2, 0]
+
+    def test_flip_and_pad(self):
+        img = np.arange(6).reshape(1, 6, 1).astype(np.uint8)
+        np.testing.assert_array_equal(T.hflip(img)[0, :, 0], img[0, ::-1, 0])
+        padded = T.pad(img, 2)
+        assert padded.shape == (5, 10, 1)
+
+    def test_normalize(self):
+        img = np.ones((3, 2, 2), np.float32)
+        out = T.normalize(img, mean=[1, 1, 1], std=[2, 2, 2])
+        np.testing.assert_allclose(out, 0.0)
+
+    def test_compose_pipeline(self):
+        tf = T.Compose([T.Resize(8), T.CenterCrop(8), T.ToTensor(),
+                        T.Normalize(mean=0.5, std=0.5)])
+        img = np.random.RandomState(0).randint(
+            0, 256, (16, 20, 3)).astype(np.uint8)
+        out = tf(img)
+        assert out.shape == (3, 8, 8)
+        assert out.min() >= -1.0 and out.max() <= 1.0
+
+    def test_random_crop_shape(self):
+        img = np.zeros((10, 10, 3), np.uint8)
+        assert T.RandomCrop(6)(img).shape == (6, 6, 3)
+
+
+class TestDatasets:
+    def test_fake_data_pipeline(self):
+        ds = FakeData(size=10, image_shape=(3, 16, 16), num_classes=3)
+        img, label = ds[0]
+        assert img.shape == (3, 16, 16)
+        assert 0 <= int(label) < 3
+        loader = paddle.io.DataLoader(ds, batch_size=5)
+        xb, yb = next(iter(loader))
+        assert list(xb.shape) == [5, 3, 16, 16]
+
+    def test_mnist_idx_loader(self, tmp_path):
+        # write tiny IDX files in the real format
+        imgs = np.random.RandomState(0).randint(
+            0, 256, (4, 28, 28)).astype(np.uint8)
+        labels = np.array([1, 2, 3, 4], np.uint8)
+        ip = tmp_path / "images.idx3-ubyte"
+        lp = tmp_path / "labels.idx1-ubyte"
+        with open(ip, "wb") as f:
+            f.write(b"\x00\x00\x08\x03")
+            for d in imgs.shape:
+                f.write(d.to_bytes(4, "big"))
+            f.write(imgs.tobytes())
+        with open(lp, "wb") as f:
+            f.write(b"\x00\x00\x08\x01")
+            f.write(len(labels).to_bytes(4, "big"))
+            f.write(labels.tobytes())
+        ds = MNIST(image_path=str(ip), label_path=str(lp))
+        assert len(ds) == 4
+        img, label = ds[2]
+        assert img.shape == (1, 28, 28)
+        assert int(label) == 3
+
+    def test_download_rejected(self):
+        with pytest.raises(ValueError, match="egress"):
+            MNIST(download=True)
