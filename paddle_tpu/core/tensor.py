@@ -139,6 +139,17 @@ class Tensor:
         self._data = other._data if isinstance(other, Tensor) else jnp.asarray(other)
         return self
 
+    def set_value(self, value):
+        """In-place assign keeping shape/dtype (parity: Tensor.set_value)."""
+        data = value._data if isinstance(value, Tensor) \
+            else jnp.asarray(value)
+        if tuple(data.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value: shape {tuple(data.shape)} != "
+                f"{tuple(self._data.shape)}")
+        self._data = data.astype(self._data.dtype)
+        return self
+
     def fill_(self, value):
         self._data = jnp.full_like(self._data, value)
         return self

@@ -1,6 +1,7 @@
 """paddle_tpu.distributed (parity: python/paddle/distributed/)."""
 from .process_mesh import (ProcessMesh, Shard, Replicate, Partial,  # noqa: F401
                            Placement, get_mesh, set_mesh, init_mesh)
+from .auto_parallel.static_mode import DistModel, to_static  # noqa: F401
 from .auto_parallel.api import (shard_tensor, reshard, shard_layer,  # noqa: F401
                                 shard_optimizer, dtensor_from_fn,
                                 unshard_dtensor, local_value, DistAttr,

@@ -90,10 +90,12 @@ def create_sharded_train_step(model, optimizer, mesh, param_spec_fn,
             for n, v in st.items()}
     opt_state = new_state
 
-    data_sharding = NamedSharding(mesh, PartitionSpec(data_axis, None))
-
     def shard_batch(arr):
-        return jax.device_put(jnp.asarray(arr), data_sharding)
+        arr = jnp.asarray(arr)
+        # leading (batch) dim over the data axis, rest replicated — spec
+        # trimmed to the array's rank (labels are often rank-1)
+        spec = PartitionSpec(data_axis, *([None] * (arr.ndim - 1)))
+        return jax.device_put(arr, NamedSharding(mesh, spec))
 
     def sharded_step(params, opt_state, key, ids, labels, lr):
         with mesh:
