@@ -36,6 +36,9 @@ from . import hapi  # noqa: F401
 from . import profiler  # noqa: F401
 from . import vision  # noqa: F401
 from . import jit  # noqa: F401
+from . import distribution  # noqa: F401
+from . import sparse  # noqa: F401
+from . import quantization  # noqa: F401
 from .hapi import Model, callbacks  # noqa: F401
 from .framework import save, load, in_dynamic_mode, is_compiled_with_cuda, is_compiled_with_xpu, is_compiled_with_rocm, is_compiled_with_custom_device  # noqa: F401
 from .nn.layer.layers import Layer  # noqa: F401

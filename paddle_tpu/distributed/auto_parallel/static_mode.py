@@ -70,10 +70,15 @@ class DistModel:
         if self._optimizer is None:
             raise ValueError("to_static without optimizer: train() invalid")
         self._mode = "train"
+        if not self._layer.training:
+            self._layer.train()
         return self
 
     def eval(self):
         self._mode = "eval"
+        if self._layer.training:
+            self._layer.eval()
+            self._eval_fn = None  # mode is baked at trace time: retrace
         return self
 
     def _ensure_train(self):
@@ -90,16 +95,20 @@ class DistModel:
 
     def _ensure_eval(self):
         if self._eval_fn is None:
+            from ...core import random as _random
             from ...core.autograd import tape_paused
             from ...nn.layer.layers import _swapped_state
             layer = self._layer
 
-            def fn(state, x, y):
-                with _swapped_state(layer, state):
-                    with tape_paused():
-                        out = layer(Tensor(x))
-                        if self._loss is not None and y is not None:
-                            out = self._loss(out, Tensor(y))
+            def fn(state, key, x, y):
+                # key is a traced argument: any dropout left in train mode
+                # draws fresh per call instead of a constant-folded mask
+                with _random.key_context(key):
+                    with _swapped_state(layer, state):
+                        with tape_paused():
+                            out = layer(Tensor(x))
+                            if self._loss is not None and y is not None:
+                                out = self._loss(out, Tensor(y))
                 return out._data
             self._eval_fn = jax.jit(fn)
 
@@ -120,9 +129,12 @@ class DistModel:
         x = args[0]._data if isinstance(args[0], Tensor) else args[0]
         y = args[1] if len(args) > 1 else None
         y = y._data if isinstance(y, Tensor) else y
+        from ...core import random as _random
         with self._jmesh:
-            return Tensor(self._eval_fn(self._current_state(), x, y),
-                          stop_gradient=True)
+            return Tensor(
+                self._eval_fn(self._current_state(),
+                              _random.default_generator.next_key(), x, y),
+                stop_gradient=True)
 
     def train_batch(self, x, y, lr: Optional[float] = None):
         self._ensure_train()
@@ -131,7 +143,10 @@ class DistModel:
                 if hasattr(self._optimizer, "get_lr") else 1e-3
         x = x._data if isinstance(x, Tensor) else np.asarray(x)
         y = y._data if isinstance(y, Tensor) else np.asarray(y)
-        key = jax.random.key(np.random.randint(0, 2 ** 31 - 1))
+        # draw from the global generator so get/set_rng_state replays the
+        # exact dropout key sequence (the (seed, offset) contract)
+        from ...core import random as _random
+        key = _random.default_generator.next_key()
         loss, self._params, self._opt_state = self._train_step(
             self._params, self._opt_state, key,
             self._shard_batch(x), self._shard_batch(y), lr)

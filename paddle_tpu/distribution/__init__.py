@@ -1,0 +1,302 @@
+"""Probability distributions (parity: python/paddle/distribution/ —
+Distribution base, Normal/Uniform/Bernoulli/Categorical/Exponential,
+kl_divergence registry).
+
+TPU-native: sampling draws typed PRNG keys from the global generator (so
+samples inside jitted code stay functional); densities and KL keep their
+parameters as tape-tracked Tensor operands of run_op, so distribution
+parameters are trainable (variational losses, policy gradients).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _random
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+           "Exponential", "kl_divergence", "register_kl"]
+
+
+def _tensor(x) -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(np.asarray(x, dtype=np.float32)),
+                  stop_gradient=True)
+
+
+class Distribution:
+    """Base (parity: paddle.distribution.Distribution)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return run_op("dist_prob", jnp.exp, (self.log_prob(value),))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def _key(self):
+        return _random.default_generator.next_key()
+
+    def kl_divergence(self, other: "Distribution"):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        del name
+        self.loc = _tensor(loc)
+        self.scale = _tensor(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._data.shape,
+                                              self.scale._data.shape))
+
+    @property
+    def mean(self):
+        return run_op("normal_mean",
+                      lambda m: jnp.broadcast_to(m, self.batch_shape),
+                      (self.loc,))
+
+    @property
+    def variance(self):
+        return run_op("normal_variance",
+                      lambda s: jnp.broadcast_to(s ** 2, self.batch_shape),
+                      (self.scale,))
+
+    def rsample(self, shape=()):
+        """Reparameterized: gradients flow to loc/scale."""
+        shape = tuple(shape) + self.batch_shape
+        eps = jax.random.normal(self._key(), shape)
+        return run_op("normal_rsample",
+                      lambda m, s: m + s * eps, (self.loc, self.scale))
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        return out.detach()
+
+    def log_prob(self, value):
+        def fn(v, m, s):
+            var = s ** 2
+            return (-((v - m) ** 2) / (2 * var) - jnp.log(s)
+                    - 0.5 * jnp.log(2 * jnp.pi))
+        return run_op("normal_log_prob", fn,
+                      (value, self.loc, self.scale))
+
+    def entropy(self):
+        def fn(s):
+            out = 0.5 + 0.5 * np.log(2 * np.pi) + jnp.log(s)
+            return jnp.broadcast_to(out, self.batch_shape)
+        return run_op("normal_entropy", fn, (self.scale,))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        del name
+        self.low = _tensor(low)
+        self.high = _tensor(high)
+        super().__init__(jnp.broadcast_shapes(self.low._data.shape,
+                                              self.high._data.shape))
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(self._key(), shape)
+        return run_op("uniform_rsample",
+                      lambda lo, hi: lo + (hi - lo) * u,
+                      (self.low, self.high))
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        def fn(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return run_op("uniform_log_prob", fn,
+                      (value, self.low, self.high))
+
+    def entropy(self):
+        return run_op(
+            "uniform_entropy",
+            lambda lo, hi: jnp.broadcast_to(jnp.log(hi - lo),
+                                            self.batch_shape),
+            (self.low, self.high))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        del name
+        self.probs = _tensor(probs)
+        super().__init__(self.probs._data.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(self._key(), shape)
+        return Tensor((u < self.probs._data).astype(jnp.float32),
+                      stop_gradient=True)
+
+    def log_prob(self, value):
+        def fn(v, p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return run_op("bernoulli_log_prob", fn, (value, self.probs))
+
+    def entropy(self):
+        def fn(p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+        return run_op("bernoulli_entropy", fn, (self.probs,))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return run_op("bernoulli_variance", lambda p: p * (1 - p),
+                      (self.probs,))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        del name
+        self.logits = _tensor(logits)
+        super().__init__(self.logits._data.shape[:-1])
+
+    @property
+    def probs(self):
+        return run_op("categorical_probs",
+                      lambda lg: jax.nn.softmax(lg, axis=-1),
+                      (self.logits,))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        out = jax.random.categorical(self._key(), self.logits._data,
+                                     shape=shape)
+        return Tensor(out, stop_gradient=True)
+
+    def log_prob(self, value):
+        def fn(v, lg):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            # a batch of values against unbatched logits: broadcast the
+            # category axis under the value batch dims
+            logp = jnp.broadcast_to(logp, v.shape + logp.shape[-1:])
+            return jnp.take_along_axis(
+                logp, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+        return run_op("categorical_log_prob", fn, (value, self.logits))
+
+    def entropy(self):
+        def fn(lg):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        return run_op("categorical_entropy", fn, (self.logits,))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        del name
+        self.rate = _tensor(rate)
+        super().__init__(self.rate._data.shape)
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        e = jax.random.exponential(self._key(), shape)
+        return run_op("exponential_rsample", lambda r: e / r, (self.rate,))
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        def fn(v, r):
+            return jnp.where(v >= 0, jnp.log(r) - r * v, -jnp.inf)
+        return run_op("exponential_log_prob", fn, (value, self.rate))
+
+    def entropy(self):
+        return run_op("exponential_entropy", lambda r: 1.0 - jnp.log(r),
+                      (self.rate,))
+
+
+# -- KL registry (parity: distribution/kl.py) -------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    def fn(pl, ps, ql, qs):
+        var_p, var_q = ps ** 2, qs ** 2
+        return (jnp.log(qs / ps)
+                + (var_p + (pl - ql) ** 2) / (2 * var_q) - 0.5)
+    return run_op("kl_normal_normal", fn,
+                  (p.loc, p.scale, q.loc, q.scale))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    def fn(pl, ph, ql, qh):
+        return jnp.where((ql <= pl) & (ph <= qh),
+                         jnp.log((qh - ql) / (ph - pl)), jnp.inf)
+    return run_op("kl_uniform_uniform", fn,
+                  (p.low, p.high, q.low, q.high))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def fn(pp, qq):
+        pp = jnp.clip(pp, 1e-7, 1 - 1e-7)
+        qq = jnp.clip(qq, 1e-7, 1 - 1e-7)
+        return (pp * jnp.log(pp / qq)
+                + (1 - pp) * jnp.log((1 - pp) / (1 - qq)))
+    return run_op("kl_bernoulli_bernoulli", fn, (p.probs, q.probs))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    def fn(pl, ql):
+        logp = jax.nn.log_softmax(pl, axis=-1)
+        logq = jax.nn.log_softmax(ql, axis=-1)
+        return jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1)
+    return run_op("kl_categorical_categorical", fn, (p.logits, q.logits))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    def fn(pr, qr):
+        return jnp.log(pr / qr) + qr / pr - 1.0
+    return run_op("kl_exponential_exponential", fn, (p.rate, q.rate))

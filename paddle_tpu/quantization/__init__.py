@@ -1,0 +1,200 @@
+"""Quantization (capability parity: python/paddle/quantization/ — QAT
+fake-quant + PTQ observers + weight-only quantized linear; reference
+kernels under paddle/phi/kernels/ quantize_linear etc.).
+
+TPU-native: int8 weight-only is the practical TPU quantization mode
+(int8 matmuls run on the MXU); fake-quant (QAT) is a straight-through
+estimator implemented with a custom vjp.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn as _nn
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor
+
+__all__ = ["quantize_linear", "dequantize_linear", "abs_max_scale",
+           "FakeQuanterWithAbsMax", "QuantConfig", "QAT",
+           "WeightOnlyLinear", "weight_quantize", "weight_dequantize"]
+
+
+def abs_max_scale(x, bit_length: int = 8):
+    """Per-tensor abs-max scale (parity: the AbsmaxObserver)."""
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    qmax = float(2 ** (bit_length - 1) - 1)
+    return jnp.maximum(jnp.max(jnp.abs(arr)), 1e-8) / qmax
+
+
+def quantize_linear(x, scale, zero_point=0, bit_length: int = 8):
+    """Symmetric linear quantize to int8 (parity: quantize_linear op)."""
+    qmax = 2 ** (bit_length - 1) - 1
+
+    def fn(a, s):
+        q = jnp.clip(jnp.round(a / s) + zero_point, -qmax - 1, qmax)
+        return q.astype(jnp.int8)
+    return run_op("quantize_linear", fn,
+                  (x, scale), out_stop_gradient=True)
+
+
+def dequantize_linear(q, scale, zero_point=0):
+    def fn(a, s):
+        return (a.astype(jnp.float32) - zero_point) * s
+    return run_op("dequantize_linear", fn, (q, scale))
+
+
+@jax.custom_vjp
+def _fake_quant(x, scale, qmax):
+    q = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax)
+    return q * scale
+
+
+def _fq_fwd(x, scale, qmax):
+    return _fake_quant(x, scale, qmax), None
+
+
+def _fq_bwd(_, g):
+    return (g, None, None)  # straight-through estimator
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+class FakeQuanterWithAbsMax(_nn.Layer):
+    """QAT fake-quant layer (parity: FakeQuanterWithAbsMaxObserver):
+    forward quantize-dequantizes with a running abs-max scale; backward is
+    straight-through."""
+
+    def __init__(self, bit_length: int = 8, moving_rate: float = 0.9,
+                 name=None):
+        super().__init__()
+        del name
+        self.bit_length = bit_length
+        self.moving_rate = moving_rate
+        self.register_buffer("scale",
+                             Tensor(jnp.asarray(1.0, jnp.float32)))
+        self._initialized = False
+
+    def forward(self, x):
+        qmax = float(2 ** (self.bit_length - 1) - 1)
+        if self.training:
+            cur = abs_max_scale(x, self.bit_length)
+            if not self._initialized:
+                new = cur
+                self._initialized = True
+            else:
+                new = (self.moving_rate * self.scale._data
+                       + (1 - self.moving_rate) * cur)
+            self.scale._data = jnp.asarray(new, jnp.float32)
+        return run_op("fake_quant",
+                      lambda a, s: _fake_quant(a, s, qmax),
+                      (x, Tensor(self.scale._data)))
+
+
+class QuantConfig:
+    """Parity: paddle.quantization.QuantConfig — maps layer types to
+    quanters."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._type_configs = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, (list, tuple))
+                  else [layer_type]):
+            self._type_configs[t] = (activation, weight)
+        return self
+
+    def config_for(self, layer):
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        return (self.activation, self.weight)
+
+
+class _QuantedLinear(_nn.Layer):
+    def __init__(self, linear, a_quanter, w_quanter):
+        super().__init__()
+        self.linear = linear
+        self.a_quanter = a_quanter() if callable(a_quanter) else a_quanter
+        self.w_quanter = w_quanter() if callable(w_quanter) else w_quanter
+
+    def forward(self, x):
+        if self.a_quanter is not None:
+            x = self.a_quanter(x)
+        w = self.linear.weight
+        if self.w_quanter is not None:
+            w = self.w_quanter(w)
+        out = run_op("quant_linear",
+                     lambda a, ww: jnp.matmul(a, ww), (x, w))
+        if self.linear.bias is not None:
+            out = out + self.linear.bias
+        return out
+
+
+class QAT:
+    """Quantization-aware-training converter (parity:
+    paddle.quantization.QAT.quantize)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        self._convert(model)
+        return model
+
+    def _convert(self, layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, _nn.Linear):
+                a_q, w_q = self.config.config_for(sub)
+                layer.add_sublayer(name, _QuantedLinear(sub, a_q, w_q))
+            else:
+                self._convert(sub)
+
+
+# -- weight-only int8 (the TPU serving mode) --------------------------------
+
+def weight_quantize(weight, algo: str = "weight_only_int8"):
+    """-> (int8 weight, per-out-channel scales) (parity:
+    paddle.nn.quant.weight_quantize)."""
+    if algo != "weight_only_int8":
+        raise NotImplementedError(f"algo {algo}")
+    arr = weight._data if isinstance(weight, Tensor) else jnp.asarray(weight)
+    scales = jnp.maximum(jnp.max(jnp.abs(arr), axis=0), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(arr / scales[None, :]), -128, 127)
+    return Tensor(q.astype(jnp.int8)), Tensor(scales)
+
+
+def weight_dequantize(qweight, scales):
+    q = qweight._data if isinstance(qweight, Tensor) else qweight
+    s = scales._data if isinstance(scales, Tensor) else scales
+    return Tensor(q.astype(jnp.float32) * s[None, :])
+
+
+class WeightOnlyLinear(_nn.Layer):
+    """int8-weight linear (parity: paddle.nn.quant.llm_int8_linear /
+    weight_only_linear): weights stored int8 + f32 scales, dequantized
+    into the matmul (XLA fuses the scale multiply into the MXU op)."""
+
+    def __init__(self, linear: _nn.Linear):
+        super().__init__()
+        qw, scales = weight_quantize(linear.weight)
+        self.register_buffer("qweight", qw)
+        self.register_buffer("scales", scales)
+        self.bias = linear.bias
+
+    def forward(self, x):
+        def fn(a, q, s):
+            return jnp.matmul(a, q.astype(a.dtype) * s[None, :])
+        out = run_op("weight_only_linear", fn,
+                     (x, Tensor(self.qweight._data),
+                      Tensor(self.scales._data)))
+        if self.bias is not None:
+            out = out + self.bias
+        return out

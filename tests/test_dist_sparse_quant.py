@@ -1,0 +1,200 @@
+"""distribution / sparse / quantization tests (reference test models:
+test/distribution/, test/legacy_test/test_sparse_*.py,
+test/quantization/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+from paddle_tpu.distribution import (Bernoulli, Categorical, Exponential,
+                                     Normal, Uniform, kl_divergence)
+from paddle_tpu.quantization import (QAT, FakeQuanterWithAbsMax,
+                                     QuantConfig, WeightOnlyLinear,
+                                     dequantize_linear, quantize_linear,
+                                     abs_max_scale, weight_quantize)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(0)
+
+
+class TestDistributions:
+    def test_normal_sample_moments(self):
+        d = Normal(loc=2.0, scale=3.0)
+        s = d.sample([20000]).numpy()
+        assert abs(s.mean() - 2.0) < 0.1
+        assert abs(s.std() - 3.0) < 0.1
+
+    def test_normal_log_prob_matches_closed_form(self):
+        d = Normal(0.0, 1.0)
+        x = paddle.to_tensor(np.array([0.0, 1.0, -2.0], np.float32))
+        lp = d.log_prob(x).numpy()
+        ref = -0.5 * np.array([0.0, 1.0, 4.0]) - 0.5 * np.log(2 * np.pi)
+        np.testing.assert_allclose(lp, ref, rtol=1e-5)
+
+    def test_normal_kl_zero_for_same(self):
+        p = Normal(1.0, 2.0)
+        np.testing.assert_allclose(float(kl_divergence(p, Normal(1.0, 2.0))),
+                                   0.0, atol=1e-7)
+        assert float(kl_divergence(p, Normal(3.0, 1.0))) > 0
+
+    def test_uniform(self):
+        d = Uniform(1.0, 3.0)
+        s = d.sample([5000]).numpy()
+        assert s.min() >= 1.0 and s.max() < 3.0
+        np.testing.assert_allclose(float(d.entropy()), np.log(2.0),
+                                   rtol=1e-6)
+        lp = d.log_prob(paddle.to_tensor(np.array([2.0, 5.0], np.float32)))
+        assert np.isneginf(lp.numpy()[1])
+
+    def test_bernoulli(self):
+        d = Bernoulli(0.7)
+        s = d.sample([10000]).numpy()
+        assert abs(s.mean() - 0.7) < 0.05
+        assert float(d.variance) == pytest.approx(0.21, abs=1e-6)
+
+    def test_categorical(self):
+        logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+        d = Categorical(logits)
+        s = d.sample([20000]).numpy()
+        freq = np.bincount(s, minlength=3) / len(s)
+        np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+        lp = d.log_prob(paddle.to_tensor(np.array([2], np.int64)))
+        np.testing.assert_allclose(lp.numpy(), [np.log(0.5)], rtol=1e-5)
+
+    def test_exponential_and_kl(self):
+        d = Exponential(2.0)
+        s = d.sample([20000]).numpy()
+        assert abs(s.mean() - 0.5) < 0.05
+        assert float(kl_divergence(d, Exponential(2.0))) == \
+            pytest.approx(0.0, abs=1e-7)
+
+    def test_kl_unregistered_raises(self):
+        with pytest.raises(NotImplementedError):
+            kl_divergence(Normal(0, 1), Uniform(0, 1))
+
+    def test_log_prob_differentiable(self):
+        d = Normal(0.0, 1.0)
+        x = paddle.to_tensor(np.array([0.5], np.float32))
+        x.stop_gradient = False
+        lp = d.log_prob(x).sum()
+        lp.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [-0.5], rtol=1e-5)
+
+
+class TestSparse:
+    def _coo(self):
+        idx = [[0, 1, 2], [1, 0, 2]]
+        vals = [1.0, 2.0, 3.0]
+        return sparse.sparse_coo_tensor(idx, vals, [3, 3])
+
+    def test_to_dense(self):
+        dense = self._coo().to_dense().numpy()
+        ref = np.zeros((3, 3), np.float32)
+        ref[0, 1], ref[1, 0], ref[2, 2] = 1, 2, 3
+        np.testing.assert_array_equal(dense, ref)
+
+    def test_duplicate_indices_coalesce(self):
+        t = sparse.sparse_coo_tensor([[0, 0], [1, 1]], [1.0, 5.0], [2, 2])
+        c = t.coalesce()
+        assert c.nnz() == 1
+        np.testing.assert_allclose(np.asarray(c.values), [6.0])
+        np.testing.assert_array_equal(t.to_dense().numpy(),
+                                      [[0, 6], [0, 0]])
+
+    def test_add(self):
+        a = self._coo()
+        b = sparse.sparse_coo_tensor([[0], [1]], [10.0], [3, 3])
+        out = sparse.add(a, b)
+        np.testing.assert_array_equal(
+            out.to_dense().numpy(),
+            a.to_dense().numpy() + b.to_dense().numpy())
+
+    def test_matmul_matches_dense(self):
+        a = self._coo()
+        y = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        out = sparse.matmul(a, paddle.to_tensor(y)).numpy()
+        np.testing.assert_allclose(out, a.to_dense().numpy() @ y,
+                                   rtol=1e-5)
+
+    def test_matmul_grad_flows_to_dense(self):
+        a = self._coo()
+        y = paddle.to_tensor(np.ones((3, 2), np.float32))
+        y.stop_gradient = False
+        out = sparse.matmul(a, y).sum()
+        out.backward()
+        # d(sum)/dy[k, n] = sum of column k of the sparse matrix
+        col_sums = a.to_dense().numpy().sum(axis=0)
+        np.testing.assert_allclose(y.grad.numpy(),
+                                   np.stack([col_sums] * 2, 1), rtol=1e-5)
+
+    def test_csr_roundtrip(self):
+        csr = self._coo().to_sparse_csr()
+        np.testing.assert_array_equal(np.asarray(csr.crows), [0, 1, 2, 3])
+        back = csr.to_sparse_coo()
+        np.testing.assert_array_equal(back.to_dense().numpy(),
+                                      self._coo().to_dense().numpy())
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(3, 4).astype(np.float32)
+        y = rng.randn(4, 3).astype(np.float32)
+        mask = self._coo()
+        out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                                   mask)
+        full = x @ y
+        for k in range(mask.nnz()):
+            i, j = int(mask.indices[0][k]), int(mask.indices[1][k])
+            np.testing.assert_allclose(float(out.values[k]), full[i, j],
+                                       rtol=1e-5)
+
+    def test_relu(self):
+        t = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [-1.0, 2.0], [2, 2])
+        np.testing.assert_array_equal(
+            sparse.relu(t).to_dense().numpy(), [[0, 0], [0, 2]])
+
+
+class TestQuantization:
+    def test_quantize_dequantize_roundtrip(self):
+        x = paddle.to_tensor(np.linspace(-1, 1, 11).astype(np.float32))
+        scale = abs_max_scale(x)
+        q = quantize_linear(x, scale)
+        assert str(q.dtype) == "int8"
+        back = dequantize_linear(q, scale).numpy()
+        np.testing.assert_allclose(back, x.numpy(), atol=float(scale))
+
+    def test_fake_quant_straight_through_grad(self):
+        fq = FakeQuanterWithAbsMax()
+        fq.train()
+        x = paddle.to_tensor(np.array([0.5, -0.3], np.float32))
+        x.stop_gradient = False
+        out = fq(x).sum()
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])
+
+    def test_qat_converts_linears(self):
+        net = paddle.nn.Sequential(paddle.nn.Linear(4, 8),
+                                   paddle.nn.ReLU(),
+                                   paddle.nn.Linear(8, 2))
+        cfg = QuantConfig(activation=FakeQuanterWithAbsMax,
+                          weight=FakeQuanterWithAbsMax)
+        qnet = QAT(cfg).quantize(net)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 4).astype(np.float32))
+        out = qnet(x)
+        assert out.shape == [2, 2]
+        # original float net untouched (inplace=False)
+        assert isinstance(net[0], paddle.nn.Linear)
+
+    def test_weight_only_linear_close_to_float(self):
+        lin = paddle.nn.Linear(16, 8)
+        wo = WeightOnlyLinear(lin)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 16).astype(np.float32))
+        ref = lin(x).numpy()
+        got = wo(x).numpy()
+        assert np.abs(got - ref).max() < 0.05
+        qw, scales = weight_quantize(lin.weight)
+        assert str(qw.dtype) == "int8"
+        assert scales.shape == [8]
