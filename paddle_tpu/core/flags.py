@@ -58,6 +58,8 @@ define_flag("check_nan_inf", False, "check every op output for NaN/Inf")
 define_flag("check_index_bounds", False,
             "eager range-check of gather/embedding indices (host sync)")
 define_flag("use_pallas_kernels", True, "prefer Pallas fused kernels over XLA lowering")
+define_flag("use_fused_optimizer", True,
+            "eager optimizer.step as one jitted multi-tensor XLA program")
 define_flag("pallas_force_interpret", False,
             "run Pallas kernels in interpret mode on non-TPU backends "
             "(kernel tests); default falls back to the XLA impl off-TPU")

@@ -1,2 +1,3 @@
 """Incubating features (parity: python/paddle/incubate/)."""
 from . import moe  # noqa: F401
+from . import nn  # noqa: F401

@@ -1,0 +1,154 @@
+"""incubate.nn.functional fused ops (parity:
+python/paddle/incubate/nn/functional/ — fused_rotary_position_embedding,
+fused_rms_norm, fused_layer_norm, fused_dropout_add, swiglu).
+
+TPU-native note: "fused" here means fused-in-the-compiled-program. The
+norms route through the Pallas kernels (ops/pallas/norms.py); RoPE,
+dropout+add, and swiglu are XLA composites that the compiler fuses into
+neighboring ops — hand kernels would only re-derive what XLA already
+does for elementwise chains (see ops/pallas/norms.py docstring).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.dispatch import run_op
+from ....nn import functional as F
+
+__all__ = ["fused_rotary_position_embedding", "fused_rms_norm",
+           "fused_layer_norm", "fused_dropout_add", "swiglu",
+           "fused_linear", "fused_bias_act"]
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    """Parity: incubate fused_rope (fusion/gpu/fused_rope). q/k/v are
+    [B, S, H, D]; sin/cos are [S, D/2] (or [1, S, 1, D] squeezed)."""
+    if sin is None or cos is None:
+        raise ValueError("sin/cos tables are required")
+
+    def rope(x_arr, cos_arr, sin_arr):
+        d = x_arr.shape[-1]
+
+        def table(t):
+            # accept [S, D/2], [S, D], or paddle's [1, S, 1, D]
+            t2 = jnp.reshape(t, (t.shape[-3] if t.ndim == 4 else t.shape[0],
+                                 t.shape[-1]))
+            if t2.shape[-1] == d:  # full-width table: one entry per freq
+                return t2[..., : d // 2] if use_neox_rotary_style \
+                    else t2[..., ::2]
+            return t2
+        c, s = table(cos_arr), table(sin_arr)
+        if position_ids is not None:
+            pid = position_ids._data if hasattr(position_ids, "_data") \
+                else jnp.asarray(position_ids)
+            c = c[pid]  # [B, S, D/2]
+            s = s[pid]
+            c = c[:, :, None, :]
+            s = s[:, :, None, :]
+        else:
+            c = c[None, :, None, :]
+            s = s[None, :, None, :]
+        if use_neox_rotary_style:
+            half = x_arr.shape[-1] // 2
+            x1, x2 = x_arr[..., :half], x_arr[..., half:]
+            return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                                   axis=-1)
+        x1, x2 = x_arr[..., ::2], x_arr[..., 1::2]
+        o1 = x1 * c - x2 * s
+        o2 = x2 * c + x1 * s
+        return jnp.stack([o1, o2], axis=-1).reshape(x_arr.shape)
+
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        outs.append(run_op("fused_rope",
+                           lambda a, c, s: rope(a, c, s), (t, cos, sin)))
+    return tuple(outs)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kwargs):
+    """Parity: incubate fused_rms_norm -> (out, invvar).
+    Routes to the Pallas rms_norm kernel."""
+    del begin_norm_axis, kwargs
+    out = F.rms_norm(x, weight=norm_weight, epsilon=epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    invvar = run_op(
+        "rms_invvar",
+        lambda a: jax.lax.rsqrt(
+            jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1) + epsilon),
+        (x,))
+    return out, invvar
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, **kwargs):
+    del kwargs
+    shape = x.shape[begin_norm_axis:] if begin_norm_axis != -1 \
+        else x.shape[-1:]
+    return F.layer_norm(x, shape, weight=norm_weight, bias=norm_bias,
+                        epsilon=epsilon)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """Parity: incubate fused_dropout_add — dropout(x) + y in one program."""
+    del name
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def swiglu(x, y=None, name=None):
+    """Parity: incubate swiglu: silu(x) * y (y defaults to the second half
+    of x split on the last axis)."""
+    del name
+    if y is not None:
+        return run_op("swiglu", lambda a, b: _silu(a) * b, (x, y))
+
+    def fn(a):
+        h = a.shape[-1] // 2
+        return _silu(a[..., :h]) * a[..., h:]
+    return run_op("swiglu", fn, (x,))
+
+
+def _silu(a):
+    import jax
+    return a * jax.nn.sigmoid(a)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """Parity: incubate fused_linear (fused_gemm_epilogue): XLA fuses the
+    bias epilogue into the MXU matmul."""
+    del name
+
+    def fn(a, w, *rest):
+        ww = w.T if transpose_weight else w
+        out = jnp.matmul(a, ww)
+        if rest:
+            out = out + rest[0]
+        return out
+    ops = (x, weight) if bias is None else (x, weight, bias)
+    return run_op("fused_linear", fn, ops)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", name=None):
+    """Parity: fused_bias_act (fusion/gpu/fused_bias_act)."""
+    del name
+    import jax
+
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": _silu,
+            "swiglu": lambda a: _silu(a[..., :a.shape[-1] // 2])
+            * a[..., a.shape[-1] // 2:]}
+    if act_method not in acts:
+        raise ValueError(f"unsupported act_method {act_method}")
+
+    def fn(a, *rest):
+        if rest:
+            a = a + rest[0]
+        return acts[act_method](a)
+    ops = (x,) if bias is None else (x, bias)
+    return run_op("fused_bias_act", fn, ops)

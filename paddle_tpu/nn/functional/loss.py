@@ -7,7 +7,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ...core.dispatch import run_op
+from ...core.dispatch import register_op_impl, run_op, select_impl
 from ...core.tensor import Tensor
 
 __all__ = [
@@ -29,9 +29,54 @@ def _reduce(val, reduction):
     return val
 
 
+def _softmax_xent_core_xla(logits, labels):
+    """Per-row hard-label softmax CE (the fused-kernel contract: invalid
+    labels -> 0 loss/grad). XLA fallback for the Pallas kernel."""
+    logits32 = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits32, axis=-1)
+    li = labels.astype(jnp.int32)
+    valid = (li >= 0) & (li < logits.shape[-1])
+    safe = jnp.where(valid, li, 0)
+    picked = jnp.take_along_axis(logits32, safe[:, None], axis=-1)[:, 0]
+    return jnp.where(valid, lse - picked, 0.0)
+
+
+register_op_impl("softmax_xent_core", "xla")(_softmax_xent_core_xla)
+
+
+def _ce_fast_path_ok(weight, soft_label, axis, use_softmax,
+                     label_smoothing, input, label):
+    return (weight is None and not soft_label and axis in (-1, input.ndim - 1)
+            and use_softmax and label_smoothing == 0.0
+            and label.ndim in (input.ndim - 1, input.ndim))
+
+
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
                   soft_label=False, axis=-1, use_softmax=True,
                   label_smoothing=0.0, name=None):
+    if _ce_fast_path_ok(weight, soft_label, axis, use_softmax,
+                        label_smoothing, input, label):
+        # fused kernel path (Pallas on TPU): one HBM pass over the logits
+        core = select_impl("softmax_xent_core")
+
+        def fast(logits, lab):
+            li = lab.astype(jnp.int32)
+            if li.ndim == logits.ndim and li.shape[-1] == 1:
+                li = jnp.squeeze(li, axis=-1)
+            v = logits.shape[-1]
+            flat = logits.reshape(-1, v)
+            lif = li.reshape(-1)
+            if ignore_index is not None:
+                lif = jnp.where(lif == ignore_index, -1, lif)
+            per = core(flat, lif).reshape(li.shape)
+            if ignore_index is not None:
+                mask = (li != ignore_index)
+                if reduction == "mean":
+                    denom = jnp.maximum(
+                        jnp.sum(mask.astype(jnp.float32)), 1.0)
+                    return jnp.sum(per) / denom
+            return _reduce(per, reduction)
+        return run_op("cross_entropy", fast, (input, label))
     w_arr = weight._data if isinstance(weight, Tensor) else weight
 
     def fn(logits, lab):

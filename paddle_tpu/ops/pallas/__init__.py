@@ -9,3 +9,4 @@ numeric oracle in tests.
 """
 from . import flash_attention  # noqa: F401
 from . import norms  # noqa: F401
+from . import cross_entropy  # noqa: F401

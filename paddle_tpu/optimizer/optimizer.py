@@ -84,6 +84,8 @@ class Optimizer:
             pass
         lr = self.get_lr()
         self._step_count += 1
+        if self._try_fused_step(params_grads, lr):
+            return
         for p, g in params_grads:
             garr = g._data.astype(jnp.float32)
             parr = p._data
@@ -105,6 +107,57 @@ class Optimizer:
                                                 state, lr)
                 p._data = new_p.astype(parr.dtype)
             self._states[id(p)] = new_state
+
+    # -- fused eager step ---------------------------------------------------
+    def _fused_decays(self, params_grads):
+        """Per-param (coupled_wd, decoupled_wd) pairs for the fused path."""
+        if not self._weight_decay:
+            return tuple((0.0, 0.0) for _ in params_grads)
+        wd = self._wd_coeff()
+        if self._decoupled_weight_decay():
+            return tuple((0.0, wd) for _ in params_grads)
+        return tuple((wd, 0.0) for _ in params_grads)
+
+    def _try_fused_step(self, params_grads, lr) -> bool:
+        """One jitted XLA program updating EVERY parameter — the TPU-native
+        analog of the reference's fused multi-tensor optimizer kernels
+        (_append_optimize_multi_tensor_op / fused adamw). Falls back to the
+        per-param loop for master-weight (multi-precision) training."""
+        from ..core import flags as _flags
+        if (not _flags.get_flag("use_fused_optimizer") or not params_grads
+                or self._multi_precision):
+            return False
+        decays = self._fused_decays(params_grads)
+        key = (tuple(id(p) for p, _ in params_grads), decays,
+               tuple(str(p._data.dtype) for p, _ in params_grads))
+        states = [self._state_for(p) for p, _ in params_grads]
+        if getattr(self, "_fused_key", None) != key:
+            n = len(params_grads)
+
+            def fused(parrs, garrs, sts, lr_arr):
+                new_p, new_s = [], []
+                for i in range(n):
+                    parr = parrs[i].astype(jnp.float32)
+                    garr = garrs[i].astype(jnp.float32)
+                    cwd, dwd = decays[i]
+                    if cwd:
+                        garr = garr + cwd * parr
+                    np_, ns_ = self._update(parr, garr, sts[i], lr_arr,
+                                            wd=dwd)
+                    new_p.append(np_.astype(parrs[i].dtype))
+                    new_s.append(ns_)
+                return new_p, new_s
+
+            self._fused_fn = jax.jit(fused)
+            self._fused_key = key
+        new_p, new_s = self._fused_fn(
+            [p._data for p, _ in params_grads],
+            [g._data for _, g in params_grads],
+            states, jnp.asarray(lr, jnp.float32))
+        for (p, _), np_, ns_ in zip(params_grads, new_p, new_s):
+            p._data = np_
+            self._states[id(p)] = ns_
+        return True
 
     def clear_grad(self, set_to_zero=True):
         if self._parameter_list:
@@ -323,6 +376,15 @@ class AdamW(Adam):
     def _decoupled_weight_decay(self):
         return True
 
+    def _decay_of(self, p):
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            return 0.0
+        return self._wd_coeff()
+
+    def _fused_decays(self, params_grads):
+        return tuple((0.0, self._decay_of(p)) for p, _ in params_grads)
+
     def step(self):
         # route decay through _update(wd=...) honoring apply_decay_param_fun
         params = self._parameter_list
@@ -332,12 +394,10 @@ class AdamW(Adam):
             params_grads = self._grad_clip(params_grads)
         lr = self.get_lr()
         self._step_count += 1
-        wd = self._wd_coeff()
+        if self._try_fused_step(params_grads, lr):
+            return
         for p, g in params_grads:
-            decay = wd
-            if self._apply_decay_param_fun is not None and \
-                    not self._apply_decay_param_fun(p.name):
-                decay = 0.0
+            decay = self._decay_of(p)
             state = self._state_for(p)
             parr = p._data
             use_master = self._multi_precision and parr.dtype != jnp.float32

@@ -1,0 +1,208 @@
+"""Fused kernels: Pallas cross-entropy, fused optimizer step, incubate
+fused functional ops (reference test models: test/legacy_test/
+test_softmax_with_cross_entropy_op.py, fused-op tests)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.incubate.nn import functional as IF
+from paddle_tpu.ops.pallas.cross_entropy import softmax_xent_pallas
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(0)
+
+
+class TestPallasCrossEntropy:
+    def _ref(self, logits, labels):
+        lse = jax.nn.logsumexp(jnp.asarray(logits, jnp.float32), axis=-1)
+        picked = logits[np.arange(len(labels)), labels]
+        return np.asarray(lse) - picked
+
+    def test_forward_matches_reference(self):
+        rng = np.random.RandomState(0)
+        logits = rng.randn(13, 257).astype(np.float32)  # odd sizes: padding
+        labels = rng.randint(0, 257, 13)
+        out = softmax_xent_pallas(jnp.asarray(logits), jnp.asarray(labels),
+                                  interpret=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   self._ref(logits, labels), rtol=1e-5)
+
+    def test_invalid_label_zero_loss(self):
+        logits = jnp.asarray(np.random.RandomState(0).randn(3, 8),
+                             jnp.float32)
+        labels = jnp.asarray(np.array([2, -1, 5]))
+        out = np.asarray(softmax_xent_pallas(logits, labels,
+                                             interpret=True))
+        assert out[1] == 0.0 and out[0] > 0 and out[2] > 0
+
+    def test_gradient_matches_softmax_minus_onehot(self):
+        rng = np.random.RandomState(1)
+        logits = jnp.asarray(rng.randn(5, 33), jnp.float32)
+        labels = jnp.asarray(rng.randint(0, 33, 5))
+
+        g = jax.grad(lambda x: softmax_xent_pallas(
+            x, labels, interpret=True).sum())(logits)
+        p = jax.nn.softmax(logits, axis=-1)
+        onehot = jax.nn.one_hot(labels, 33)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(p - onehot),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_cross_entropy_api_uses_core_and_matches_general(self):
+        rng = np.random.RandomState(2)
+        logits = paddle.to_tensor(rng.randn(4, 7, 50).astype(np.float32))
+        labels_np = rng.randint(0, 50, (4, 7)).astype(np.int64)
+        labels_np[0, 0] = -100  # ignore_index
+        labels = paddle.to_tensor(labels_np)
+        fast = F.cross_entropy(logits, labels)
+        # general path: force by passing label_smoothing tiny? use weight=None
+        # comparison against a hand-rolled reference instead
+        mask = labels_np != -100
+        lg = logits.numpy().reshape(-1, 50)
+        lb = labels_np.reshape(-1)
+        lse = np.log(np.exp(lg - lg.max(-1, keepdims=True)).sum(-1)) + \
+            lg.max(-1)
+        per = np.where(lb != -100, lse - lg[np.arange(len(lb)),
+                                            np.where(lb == -100, 0, lb)], 0)
+        ref = per.sum() / mask.sum()
+        np.testing.assert_allclose(float(fast), ref, rtol=1e-5)
+
+    def test_ce_grad_through_tape(self):
+        logits = paddle.to_tensor(
+            np.random.RandomState(0).randn(6, 11).astype(np.float32))
+        logits.stop_gradient = False
+        labels = paddle.to_tensor(
+            np.random.RandomState(1).randint(0, 11, 6).astype(np.int64))
+        loss = F.cross_entropy(logits, labels)
+        loss.backward()
+        g = logits.grad.numpy()
+        p = np.asarray(jax.nn.softmax(logits._data, axis=-1))
+        onehot = np.eye(11)[labels.numpy()]
+        np.testing.assert_allclose(g, (p - onehot) / 6, rtol=1e-4,
+                                   atol=1e-6)
+
+
+class TestFusedOptimizerStep:
+    def _train(self, fused: bool, opt_cls, **kw):
+        paddle.seed(0)
+        paddle.set_flags({"use_fused_optimizer": fused})
+        try:
+            net = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                                       paddle.nn.ReLU(),
+                                       paddle.nn.Linear(16, 4))
+            opt = opt_cls(0.01, parameters=net.parameters(), **kw)
+            rng = np.random.RandomState(0)
+            x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+            y = paddle.to_tensor(rng.randint(0, 4, 4).astype(np.int64))
+            lf = paddle.nn.CrossEntropyLoss()
+            for _ in range(5):
+                loss = lf(net(x), y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            return [p.numpy() for p in net.parameters()], float(loss)
+        finally:
+            paddle.set_flags({"use_fused_optimizer": True})
+
+    @pytest.mark.parametrize("opt_cls,kw", [
+        (paddle.optimizer.AdamW, {"weight_decay": 0.1}),
+        (paddle.optimizer.Adam, {}),
+        (paddle.optimizer.SGD, {}),
+        (paddle.optimizer.Momentum, {"momentum": 0.9}),
+    ])
+    def test_fused_matches_loop(self, opt_cls, kw):
+        fused_params, fused_loss = self._train(True, opt_cls, **kw)
+        loop_params, loop_loss = self._train(False, opt_cls, **kw)
+        assert fused_loss == pytest.approx(loop_loss, rel=1e-5)
+        for a, b in zip(fused_params, loop_params):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    def test_adamw_decay_param_fun_respected(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 4)
+        opt = paddle.optimizer.AdamW(
+            0.1, parameters=lin.parameters(), weight_decay=0.5,
+            apply_decay_param_fun=lambda n: "w_0" in (n or ""))
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        (lin(x).sum()).backward()
+        b0 = lin.bias.numpy().copy()
+        opt.step()
+        # bias excluded from decay: pure adam step, |delta| <= lr bound
+        assert np.all(np.abs(lin.bias.numpy() - b0) < 0.11)
+
+
+class TestIncubateFused:
+    def test_fused_rope_matches_model_impl(self):
+        from paddle_tpu.models.llama import _rope_tables, apply_rotary_pos_emb
+        rng = np.random.RandomState(0)
+        q = rng.randn(2, 8, 4, 16).astype(np.float32)
+        k = rng.randn(2, 8, 2, 16).astype(np.float32)
+        cos, sin = _rope_tables(8, 16, 10000.0)
+        qr, kr = apply_rotary_pos_emb(jnp.asarray(q), jnp.asarray(k),
+                                      cos, sin)
+        q2, k2, _ = IF.fused_rotary_position_embedding(
+            paddle.to_tensor(q), paddle.to_tensor(k), sin=sin, cos=cos,
+            use_neox_rotary_style=False)
+        np.testing.assert_allclose(q2.numpy(), np.asarray(qr), rtol=1e-5)
+        np.testing.assert_allclose(k2.numpy(), np.asarray(kr), rtol=1e-5)
+
+    def test_fused_rope_paddle_table_shapes(self):
+        # paddle-parity [1, S, 1, D] full-width tables (interleaved dup)
+        from paddle_tpu.models.llama import _rope_tables, apply_rotary_pos_emb
+        rng = np.random.RandomState(0)
+        q = rng.randn(1, 8, 2, 16).astype(np.float32)
+        cos, sin = _rope_tables(8, 16, 10000.0)  # [S, D/2]
+        full_cos = np.repeat(np.asarray(cos), 2, axis=-1)[None, :, None, :]
+        full_sin = np.repeat(np.asarray(sin), 2, axis=-1)[None, :, None, :]
+        ref, _ = apply_rotary_pos_emb(jnp.asarray(q), jnp.asarray(q),
+                                      cos, sin)
+        out, _, _ = IF.fused_rotary_position_embedding(
+            paddle.to_tensor(q), sin=full_sin, cos=full_cos,
+            use_neox_rotary_style=False)
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=1e-5)
+
+    def test_fused_norms(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 32).astype(np.float32))
+        w = paddle.to_tensor(np.ones(32, np.float32))
+        b = paddle.to_tensor(np.zeros(32, np.float32))
+        out, invvar = IF.fused_rms_norm(x, w)
+        ref = F.rms_norm(x, weight=w)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+        ref_inv = 1.0 / np.sqrt((x.numpy() ** 2).mean(-1) + 1e-6)
+        np.testing.assert_allclose(invvar.numpy(), ref_inv, rtol=1e-5)
+        out2 = IF.fused_layer_norm(x, w, b)
+        ref2 = F.layer_norm(x, [32], weight=w, bias=b)
+        np.testing.assert_allclose(out2.numpy(), ref2.numpy(), rtol=1e-5)
+
+    def test_swiglu_and_bias_act(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(3, 8).astype(np.float32))
+        out = IF.swiglu(x)
+        a = x.numpy()[:, :4]
+        ref = a / (1 + np.exp(-a)) * x.numpy()[:, 4:]
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+        bias = paddle.to_tensor(np.ones(8, np.float32))
+        out2 = IF.fused_bias_act(x, bias, act_method="relu")
+        np.testing.assert_allclose(out2.numpy(),
+                                   np.maximum(x.numpy() + 1, 0), rtol=1e-6)
+
+    def test_fused_dropout_add_eval(self):
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        y = paddle.to_tensor(np.full((2, 4), 2.0, np.float32))
+        out = IF.fused_dropout_add(x, y, p=0.5, training=False)
+        np.testing.assert_allclose(out.numpy(), 3.0)
+
+    def test_fused_linear(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(3, 4).astype(np.float32))
+        w = paddle.to_tensor(
+            np.random.RandomState(1).randn(4, 5).astype(np.float32))
+        b = paddle.to_tensor(np.ones(5, np.float32))
+        out = IF.fused_linear(x, w, b)
+        np.testing.assert_allclose(out.numpy(),
+                                   x.numpy() @ w.numpy() + 1, rtol=1e-5)
