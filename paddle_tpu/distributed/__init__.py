@@ -18,6 +18,7 @@ from .parallel import (init_parallel_env, get_rank, get_world_size,  # noqa: F40
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import launch  # noqa: F401
+from . import auto_tuner  # noqa: F401
 from .store import TCPStore, create_or_get_global_tcp_store  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from .long_context import (ring_attention, ulysses_attention,  # noqa: F401

@@ -1,0 +1,8 @@
+"""Parallel-strategy auto-tuner (parity: python/paddle/distributed/
+auto_tuner/ — tuner.py:21 AutoTuner, grid search over
+{dp, mp, pp, sharding, micro_batch_size, recompute} with rule-based
+pruning and history-based pruning)."""
+from .prune import register_prune, prune_by_memory, prune_by_history  # noqa: F401
+from .recorder import HistoryRecorder  # noqa: F401
+from .search import GridSearch  # noqa: F401
+from .tuner import AutoTuner  # noqa: F401

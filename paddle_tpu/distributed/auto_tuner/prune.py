@@ -1,0 +1,152 @@
+"""Pruning rules (parity: auto_tuner/prune.py — registered rule functions
+returning True when a candidate config should be dropped).
+
+A config is a dict with keys: dp_degree, mp_degree, pp_degree,
+sharding_degree, micro_batch_size, use_recompute (+ anything else the
+search space carries). The tuner_cfg provides the model/hardware facts
+(num_devices, global_batch_size, model dims, memory per chip).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+_PRUNE_RULES: List[Callable] = []
+
+
+def register_prune(fn: Callable) -> Callable:
+    _PRUNE_RULES.append(fn)
+    return fn
+
+
+def prune_rules() -> List[Callable]:
+    return list(_PRUNE_RULES)
+
+
+@register_prune
+def prune_by_num_devices(tuner_cfg: Dict, cfg: Dict, history=None) -> bool:
+    """Product of parallel degrees must cover exactly the device count."""
+    n = tuner_cfg.get("num_devices") or tuner_cfg.get("num_gpus", 1)
+    prod = (cfg.get("dp_degree", 1) * cfg.get("mp_degree", 1)
+            * cfg.get("pp_degree", 1) * cfg.get("sharding_degree", 1))
+    return prod != n
+
+
+@register_prune
+def prune_by_batch(tuner_cfg: Dict, cfg: Dict, history=None) -> bool:
+    """global batch must be divisible by dp*sharding*micro_batch_size."""
+    gbs = tuner_cfg.get("global_batch_size")
+    if not gbs:
+        return False
+    dp = cfg.get("dp_degree", 1) * cfg.get("sharding_degree", 1)
+    mbs = cfg.get("micro_batch_size", 1)
+    if gbs % dp:
+        return True
+    return (gbs // dp) % mbs != 0
+
+
+@register_prune
+def prune_by_mp(tuner_cfg: Dict, cfg: Dict, history=None) -> bool:
+    """mp must divide heads and hidden; mp should stay within one host's
+    chips (ICI domain) when hosts are declared."""
+    mp = cfg.get("mp_degree", 1)
+    model = tuner_cfg.get("model_cfg", {})
+    heads = model.get("num_heads")
+    hidden = model.get("hidden_size")
+    if heads and heads % mp:
+        return True
+    if hidden and hidden % mp:
+        return True
+    per_host = tuner_cfg.get("devices_per_host")
+    if per_host and mp > per_host:
+        return True
+    return False
+
+
+@register_prune
+def prune_by_pp(tuner_cfg: Dict, cfg: Dict, history=None) -> bool:
+    """pp must divide the layer count, and microbatch count must cover
+    the pipeline (accumulate_steps >= pp for 1F1B to fill)."""
+    pp = cfg.get("pp_degree", 1)
+    model = tuner_cfg.get("model_cfg", {})
+    layers = model.get("num_layers")
+    if layers and layers % pp:
+        return True
+    gbs = tuner_cfg.get("global_batch_size")
+    if gbs and pp > 1:
+        dp = cfg.get("dp_degree", 1) * cfg.get("sharding_degree", 1)
+        acc = gbs // dp // max(cfg.get("micro_batch_size", 1), 1)
+        if acc < pp:
+            return True
+    return False
+
+
+def estimate_memory_bytes(tuner_cfg: Dict, cfg: Dict) -> float:
+    """Per-chip memory model for a transformer LM (the standard
+    params + grads + Adam states + activations accounting; activations
+    follow the Megatron formula, /sqrt under full recompute)."""
+    model = tuner_cfg.get("model_cfg", {})
+    h = model.get("hidden_size", 0)
+    layers = model.get("num_layers", 0)
+    vocab = model.get("vocab_size", 0)
+    seq = model.get("seq_length", model.get("max_position_embeddings", 2048))
+    inter = model.get("intermediate_size", 4 * h)
+    if not h or not layers:
+        return 0.0
+    mp = cfg.get("mp_degree", 1)
+    pp = cfg.get("pp_degree", 1)
+    shard = cfg.get("sharding_degree", 1) * (
+        cfg.get("dp_degree", 1)
+        if tuner_cfg.get("sharding_stage", 1) >= 3 else 1)
+    mbs = cfg.get("micro_batch_size", 1)
+
+    per_layer = 4 * h * h + 3 * h * inter  # qkv/o + gated mlp
+    n_params = layers * per_layer + vocab * h
+    local_params = n_params / (mp * pp)
+    # bf16 params + f32 grads-accum + 2x f32 adam moments + f32 master
+    state_bytes = local_params * (2 + 4 / max(shard, 1) * 3 + 4)
+    # activations per microbatch per layer (bf16): ~s*b*h*(34 + 5*heads*s/h)
+    act = seq * mbs * h * 34 * 2
+    if cfg.get("use_recompute"):
+        act = act / 8  # checkpoint boundaries only
+    act_bytes = act * layers / pp / mp
+    # 1F1B keeps up to pp in-flight microbatches on stage 0
+    act_bytes *= min(pp, max(tuner_cfg.get("num_model_chunks", 1), 1)) \
+        if pp > 1 else 1
+    return state_bytes + act_bytes
+
+
+@register_prune
+def prune_by_memory(tuner_cfg: Dict, cfg: Dict, history=None) -> bool:
+    cap = tuner_cfg.get("max_mem_usage")  # bytes per chip
+    if not cap:
+        return False
+    return estimate_memory_bytes(tuner_cfg, cfg) > cap
+
+
+def prune_by_history(tuner_cfg: Dict, cfg: Dict, history) -> bool:
+    """Drop configs dominated by a recorded OOM: same (mp, pp, sharding)
+    with micro_batch_size >= one that already OOM'd, or <= one that
+    already ran slower than the current best at a smaller batch.
+    (parity: auto_tuner/utils.py history pruning)."""
+    if history is None:
+        return False
+    for rec in history.records:
+        if rec.get("error") != "oom":
+            continue
+        same_shape = all(
+            rec["cfg"].get(k, 1) == cfg.get(k, 1)
+            for k in ("mp_degree", "pp_degree", "sharding_degree",
+                      "dp_degree"))
+        if same_shape and cfg.get("micro_batch_size", 1) >= \
+                rec["cfg"].get("micro_batch_size", 1):
+            return True
+        # larger model-parallel shrink of the same oom config cannot help
+        # if every degree is <= the oom'd one
+        dominated = all(
+            cfg.get(k, 1) <= rec["cfg"].get(k, 1)
+            for k in ("mp_degree", "pp_degree", "sharding_degree")) and \
+            cfg.get("micro_batch_size", 1) >= \
+            rec["cfg"].get("micro_batch_size", 1)
+        if dominated:
+            return True
+    return False

@@ -1,0 +1,46 @@
+"""Trial history (parity: auto_tuner/recorder.py — add/sort/store)."""
+from __future__ import annotations
+
+import csv
+import json
+from typing import Dict, List, Optional
+
+
+class HistoryRecorder:
+    def __init__(self, metric_name: str = "throughput",
+                 higher_is_better: bool = True):
+        self.metric_name = metric_name
+        self.higher_is_better = higher_is_better
+        self.records: List[Dict] = []
+
+    def add_cfg(self, cfg: Dict, metric: Optional[float] = None,
+                error: Optional[str] = None, **extra) -> None:
+        self.records.append({"cfg": dict(cfg), "metric": metric,
+                             "error": error, **extra})
+
+    def sorted_records(self) -> List[Dict]:
+        ok = [r for r in self.records
+              if r.get("metric") is not None and not r.get("error")]
+        return sorted(ok, key=lambda r: r["metric"],
+                      reverse=self.higher_is_better)
+
+    def get_best(self) -> Optional[Dict]:
+        s = self.sorted_records()
+        return s[0] if s else None
+
+    def store_history(self, path: str) -> None:
+        if path.endswith(".json"):
+            with open(path, "w") as f:
+                json.dump(self.records, f, indent=2)
+            return
+        keys = sorted({k for r in self.records for k in r["cfg"]})
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(keys + [self.metric_name, "error"])
+            for r in self.records:
+                w.writerow([r["cfg"].get(k) for k in keys]
+                           + [r.get("metric"), r.get("error")])
+
+    def load_history(self, path: str) -> None:
+        with open(path) as f:
+            self.records = json.load(f)

@@ -1,0 +1,68 @@
+"""Search algorithms (parity: auto_tuner/search.py — GridSearch over the
+candidate space built from the tune config)."""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List
+
+_DEGREE_KEYS = ("dp_degree", "mp_degree", "pp_degree", "sharding_degree")
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def candidate_space(tuner_cfg: Dict) -> List[Dict]:
+    """Expand the tune config into the full cartesian candidate list.
+    Each degree key may be a list, a single int, or "auto" (divisors of
+    num_devices); micro_batch_size/use_recompute likewise."""
+    n = tuner_cfg.get("num_devices") or tuner_cfg.get("num_gpus", 1)
+    axes = {}
+    for k in _DEGREE_KEYS:
+        v = tuner_cfg.get(k, "auto")
+        if v == "auto":
+            axes[k] = _divisors(n)
+        elif isinstance(v, (list, tuple)):
+            axes[k] = list(v)
+        else:
+            axes[k] = [int(v)]
+    mbs = tuner_cfg.get("micro_batch_size", "auto")
+    if mbs == "auto":
+        gbs = tuner_cfg.get("global_batch_size", 32)
+        axes["micro_batch_size"] = [m for m in (1, 2, 4, 8, 16, 32, 64)
+                                    if m <= gbs]
+    elif isinstance(mbs, (list, tuple)):
+        axes["micro_batch_size"] = list(mbs)
+    else:
+        axes["micro_batch_size"] = [int(mbs)]
+    rc = tuner_cfg.get("use_recompute", "auto")
+    if rc == "auto":
+        axes["use_recompute"] = [False, True]
+    elif isinstance(rc, (list, tuple)):
+        axes["use_recompute"] = list(rc)
+    else:
+        axes["use_recompute"] = [bool(rc)]
+
+    keys = list(axes)
+    return [dict(zip(keys, combo))
+            for combo in itertools.product(*[axes[k] for k in keys])]
+
+
+class GridSearch:
+    """Iterate pruned candidates (parity: auto_tuner GridSearch)."""
+
+    def __init__(self, tuner_cfg: Dict, prune_fns, history=None):
+        self.tuner_cfg = tuner_cfg
+        self.all_cfgs = candidate_space(tuner_cfg)
+        self.prune_fns = list(prune_fns)
+        self.history = history
+        self.idx = 0
+
+    def search_once(self):
+        while self.idx < len(self.all_cfgs):
+            cfg = self.all_cfgs[self.idx]
+            self.idx += 1
+            if not any(fn(self.tuner_cfg, cfg, self.history)
+                       for fn in self.prune_fns):
+                return cfg
+        return None

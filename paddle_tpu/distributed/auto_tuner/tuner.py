@@ -1,0 +1,77 @@
+"""AutoTuner driver (parity: auto_tuner/tuner.py:21).
+
+TPU-native trial modes:
+- ``run_trial`` callback: the caller measures a candidate in-process
+  (e.g. a jitted train step over a virtual CPU mesh, or a real slice) and
+  returns throughput — no subprocess relaunch needed because mesh shape
+  is a jit argument, not a process topology.
+- cost-model mode (no callback): candidates are ranked by the analytic
+  memory/compute model in prune.estimate_memory_bytes — the reference's
+  rule-based pre-ranking.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from .prune import estimate_memory_bytes, prune_by_history, prune_rules
+from .recorder import HistoryRecorder
+from .search import GridSearch
+
+
+class AutoTuner:
+    def __init__(self, tuner_cfg: Dict,
+                 run_trial: Optional[Callable[[Dict], float]] = None):
+        self.tuner_cfg = dict(tuner_cfg)
+        self.run_trial = run_trial
+        self.recorder = HistoryRecorder(
+            metric_name=self.tuner_cfg.get("metric_cfg", {})
+            .get("name", "throughput"))
+        fns = prune_rules() + [prune_by_history]
+        self.searcher = GridSearch(self.tuner_cfg, fns, self.recorder)
+        self.cur_cfg: Optional[Dict] = None
+
+    def search_once(self) -> Optional[Dict]:
+        """Next un-pruned candidate, or None when exhausted."""
+        self.cur_cfg = self.searcher.search_once()
+        return self.cur_cfg
+
+    def update(self, cfg: Dict, metric: Optional[float] = None,
+               error: Optional[str] = None) -> None:
+        """Record a trial result ('oom' errors feed history pruning)."""
+        self.recorder.add_cfg(cfg, metric=metric, error=error)
+
+    def tune(self, max_trials: Optional[int] = None) -> Optional[Dict]:
+        """Run the full loop. With a run_trial callback: measure every
+        surviving candidate. Without: rank by the memory model (lowest
+        projected footprint that fits wins ties toward larger mbs)."""
+        trials = 0
+        while True:
+            cfg = self.search_once()
+            if cfg is None:
+                break
+            trials += 1
+            if self.run_trial is not None:
+                try:
+                    metric = self.run_trial(cfg)
+                    self.update(cfg, metric=metric)
+                except MemoryError:
+                    self.update(cfg, error="oom")
+                except Exception as e:  # noqa: BLE001 — trials may fail
+                    self.update(cfg, error=repr(e))
+            else:
+                mem = estimate_memory_bytes(self.tuner_cfg, cfg)
+                # analytic score: prefer less model-parallel fragmentation
+                # and bigger microbatches (better MXU utilization)
+                score = (cfg.get("micro_batch_size", 1)
+                         / (cfg.get("mp_degree", 1)
+                            * cfg.get("pp_degree", 1)))
+                self.update(cfg, metric=score)
+                del mem
+            if max_trials and trials >= max_trials:
+                break
+        best = self.recorder.get_best()
+        return best["cfg"] if best else None
+
+    def get_best(self) -> Optional[Dict]:
+        best = self.recorder.get_best()
+        return best["cfg"] if best else None

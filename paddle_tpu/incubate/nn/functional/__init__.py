@@ -22,11 +22,30 @@ __all__ = ["fused_rotary_position_embedding", "fused_rms_norm",
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
-                                    position_ids=None, use_neox_rotary_style=True):
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    time_major=False,
+                                    rotary_emb_base=10000.0):
     """Parity: incubate fused_rope (fusion/gpu/fused_rope). q/k/v are
-    [B, S, H, D]; sin/cos are [S, D/2] (or [1, S, 1, D] squeezed)."""
+    [B, S, H, D] ([S, B, H, D] when time_major); sin/cos accept [S, D/2],
+    [S, D], or paddle's [1, S, 1, D]; omitted tables are computed from
+    ``rotary_emb_base``."""
+    if time_major:
+        def _tm(t):
+            return None if t is None else t.transpose([1, 0, 2, 3])
+        q, k, v = _tm(q), _tm(k), _tm(v)
+        out = fused_rotary_position_embedding(
+            q, k, v, sin=sin, cos=cos, position_ids=position_ids,
+            use_neox_rotary_style=use_neox_rotary_style, time_major=False,
+            rotary_emb_base=rotary_emb_base)
+        return tuple(_tm(o) for o in out)
     if sin is None or cos is None:
-        raise ValueError("sin/cos tables are required")
+        import numpy as np
+        seq, d = q.shape[1], q.shape[-1]
+        inv = 1.0 / (rotary_emb_base ** (np.arange(0, d, 2) / d))
+        freqs = np.outer(np.arange(seq), inv)  # [S, D/2]
+        cos = jnp.asarray(np.cos(freqs), jnp.float32)
+        sin = jnp.asarray(np.sin(freqs), jnp.float32)
 
     def rope(x_arr, cos_arr, sin_arr):
         d = x_arr.shape[-1]
@@ -73,11 +92,25 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
 def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
                    begin_norm_axis=-1, **kwargs):
     """Parity: incubate fused_rms_norm -> (out, invvar).
-    Routes to the Pallas rms_norm kernel."""
-    del begin_norm_axis, kwargs
+    Routes to the Pallas rms_norm kernel. Multi-axis normalization
+    (begin_norm_axis < ndim-1) flattens the trailing axes first."""
+    del kwargs
+    ndim = x.ndim
+    axis = begin_norm_axis % ndim if begin_norm_axis != -1 else ndim - 1
+    if axis != ndim - 1:
+        shape = list(x.shape)
+        flat = x.reshape(shape[:axis] + [-1])
+        w_flat = norm_weight.reshape([-1])
+        out_flat, invvar = fused_rms_norm(flat, w_flat, None, epsilon)
+        out = out_flat.reshape(shape)
+        if norm_bias is not None:
+            out = out + norm_bias
+        return out, invvar
     out = F.rms_norm(x, weight=norm_weight, epsilon=epsilon)
     if norm_bias is not None:
         out = out + norm_bias
+    # under jit XLA CSEs this with the kernel's internal mean-of-squares;
+    # eager callers needing only `out` can use F.rms_norm directly
     invvar = run_op(
         "rms_invvar",
         lambda a: jax.lax.rsqrt(
@@ -115,9 +148,7 @@ def swiglu(x, y=None, name=None):
     return run_op("swiglu", fn, (x,))
 
 
-def _silu(a):
-    import jax
-    return a * jax.nn.sigmoid(a)
+_silu = jax.nn.silu
 
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
@@ -138,8 +169,6 @@ def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
 def fused_bias_act(x, bias=None, act_method="gelu", name=None):
     """Parity: fused_bias_act (fusion/gpu/fused_bias_act)."""
     del name
-    import jax
-
     acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": _silu,
             "swiglu": lambda a: _silu(a[..., :a.shape[-1] // 2])
             * a[..., a.shape[-1] // 2:]}

@@ -71,6 +71,12 @@ class Optimizer:
         return False
 
     # -- the eager step (parity: optimizer.step() in dygraph) ----------------
+    def _decay_of(self, p) -> float:
+        """Per-param weight-decay coefficient (AdamW overrides to honor
+        apply_decay_param_fun)."""
+        del p
+        return self._wd_coeff() if self._weight_decay else 0.0
+
     def step(self):
         params = self._parameter_list
         if params is None:
@@ -79,44 +85,40 @@ class Optimizer:
                         if not p.stop_gradient and p.grad is not None]
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
-        elif isinstance(self._weight_decay, float) and not \
-                self._decoupled_weight_decay() and self._weight_decay:
-            pass
         lr = self.get_lr()
         self._step_count += 1
         if self._try_fused_step(params_grads, lr):
             return
+        decoupled = self._decoupled_weight_decay()
         for p, g in params_grads:
             garr = g._data.astype(jnp.float32)
             parr = p._data
+            decay = self._decay_of(p)
             # L2 regularization (coupled) unless the rule decouples it
-            if self._weight_decay and not self._decoupled_weight_decay():
-                wd = self._weight_decay if isinstance(self._weight_decay, float) \
-                    else getattr(self._weight_decay, "_coeff", 0.0)
-                garr = garr + wd * parr.astype(jnp.float32)
+            if decay and not decoupled:
+                garr = garr + decay * parr.astype(jnp.float32)
             state = self._state_for(p)
+            wd = decay if decoupled else 0.0
             use_master = self._multi_precision and parr.dtype != jnp.float32
             if use_master:
                 mw = self._master_weights.setdefault(
                     id(p), parr.astype(jnp.float32))
-                new_mw, new_state = self._update(mw, garr, state, lr)
+                new_mw, new_state = self._update(mw, garr, state, lr, wd=wd)
                 self._master_weights[id(p)] = new_mw
                 p._data = new_mw.astype(parr.dtype)
             else:
-                new_p, new_state = self._update(parr.astype(jnp.float32), garr,
-                                                state, lr)
+                new_p, new_state = self._update(parr.astype(jnp.float32),
+                                                garr, state, lr, wd=wd)
                 p._data = new_p.astype(parr.dtype)
             self._states[id(p)] = new_state
 
     # -- fused eager step ---------------------------------------------------
     def _fused_decays(self, params_grads):
         """Per-param (coupled_wd, decoupled_wd) pairs for the fused path."""
-        if not self._weight_decay:
-            return tuple((0.0, 0.0) for _ in params_grads)
-        wd = self._wd_coeff()
-        if self._decoupled_weight_decay():
-            return tuple((0.0, wd) for _ in params_grads)
-        return tuple((wd, 0.0) for _ in params_grads)
+        decoupled = self._decoupled_weight_decay()
+        return tuple(
+            ((0.0, self._decay_of(p)) if decoupled
+             else (self._decay_of(p), 0.0)) for p, _ in params_grads)
 
     def _try_fused_step(self, params_grads, lr) -> bool:
         """One jitted XLA program updating EVERY parameter — the TPU-native
@@ -148,7 +150,11 @@ class Optimizer:
                     new_s.append(ns_)
                 return new_p, new_s
 
-            self._fused_fn = jax.jit(fused)
+            # donate the old optimizer-state buffers: XLA aliases them into
+            # the outputs (moments dominate Adam-state memory). Params are
+            # NOT donated — user-held detach()/state_dict views share those
+            # buffers and must stay readable after the step.
+            self._fused_fn = jax.jit(fused, donate_argnums=(2,))
             self._fused_key = key
         new_p, new_s = self._fused_fn(
             [p._data for p, _ in params_grads],
@@ -385,34 +391,8 @@ class AdamW(Adam):
     def _fused_decays(self, params_grads):
         return tuple((0.0, self._decay_of(p)) for p, _ in params_grads)
 
-    def step(self):
-        # route decay through _update(wd=...) honoring apply_decay_param_fun
-        params = self._parameter_list
-        params_grads = [(p, p.grad) for p in params
-                        if not p.stop_gradient and p.grad is not None]
-        if self._grad_clip is not None:
-            params_grads = self._grad_clip(params_grads)
-        lr = self.get_lr()
-        self._step_count += 1
-        if self._try_fused_step(params_grads, lr):
-            return
-        for p, g in params_grads:
-            decay = self._decay_of(p)
-            state = self._state_for(p)
-            parr = p._data
-            use_master = self._multi_precision and parr.dtype != jnp.float32
-            if use_master:
-                mw = self._master_weights.setdefault(id(p), parr.astype(jnp.float32))
-                new_p, new_state = self._update(mw, g._data.astype(jnp.float32),
-                                                state, lr, wd=decay)
-                self._master_weights[id(p)] = new_p
-                p._data = new_p.astype(parr.dtype)
-            else:
-                new_p, new_state = self._update(parr.astype(jnp.float32),
-                                                g._data.astype(jnp.float32),
-                                                state, lr, wd=decay)
-                p._data = new_p.astype(parr.dtype)
-            self._states[id(p)] = new_state
+    # step() is the base implementation: _decay_of + decoupled wd plumbing
+    # cover the AdamW differences
 
 
 class Adamax(Optimizer):

@@ -1,0 +1,141 @@
+"""Auto-tuner tests (reference test model: test/auto_parallel/ auto_tuner
+unittests — prune rules without devices, grid search, history pruning)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.auto_tuner import (AutoTuner, GridSearch,
+                                               HistoryRecorder)
+from paddle_tpu.distributed.auto_tuner.prune import (estimate_memory_bytes,
+                                                     prune_by_history,
+                                                     prune_rules)
+from paddle_tpu.distributed.auto_tuner.search import candidate_space
+
+MODEL = {"hidden_size": 1024, "num_layers": 8, "num_heads": 16,
+         "vocab_size": 32000, "seq_length": 2048,
+         "intermediate_size": 4096}
+
+
+def _cfg(**over):
+    base = {"num_devices": 8, "global_batch_size": 32, "model_cfg": MODEL}
+    base.update(over)
+    return base
+
+
+class TestCandidateSpace:
+    def test_auto_expands_divisors(self):
+        space = candidate_space(_cfg())
+        degrees = {(c["dp_degree"], c["mp_degree"], c["pp_degree"],
+                    c["sharding_degree"]) for c in space}
+        assert (8, 1, 1, 1) in degrees
+        assert (2, 4, 2, 1) in degrees  # all divisor combos exist
+
+    def test_fixed_values_respected(self):
+        space = candidate_space(_cfg(mp_degree=2, pp_degree=[1, 2],
+                                     micro_batch_size=4,
+                                     use_recompute=False))
+        assert all(c["mp_degree"] == 2 for c in space)
+        assert {c["pp_degree"] for c in space} == {1, 2}
+        assert all(c["micro_batch_size"] == 4 for c in space)
+
+
+class TestPruneRules:
+    def test_device_product_prune(self):
+        gs = GridSearch(_cfg(), prune_rules())
+        seen = []
+        while True:
+            c = gs.search_once()
+            if c is None:
+                break
+            seen.append(c)
+        assert seen, "some configs must survive"
+        for c in seen:
+            assert (c["dp_degree"] * c["mp_degree"] * c["pp_degree"]
+                    * c["sharding_degree"]) == 8
+            assert MODEL["num_heads"] % c["mp_degree"] == 0
+            assert MODEL["num_layers"] % c["pp_degree"] == 0
+
+    def test_memory_prune(self):
+        # tiny memory cap: only recompute + heavily sharded configs fit
+        cap = 2e9
+        tc = _cfg(max_mem_usage=cap)
+        gs = GridSearch(tc, prune_rules())
+        survivors = []
+        while True:
+            c = gs.search_once()
+            if c is None:
+                break
+            survivors.append(c)
+        for c in survivors:
+            assert estimate_memory_bytes(tc, c) <= cap
+
+    def test_memory_model_monotonic(self):
+        tc = _cfg()
+        base = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                "sharding_degree": 1, "micro_batch_size": 4,
+                "use_recompute": False}
+        m1 = estimate_memory_bytes(tc, base)
+        mp2 = dict(base, mp_degree=2)
+        assert estimate_memory_bytes(tc, mp2) < m1
+        rc = dict(base, use_recompute=True)
+        assert estimate_memory_bytes(tc, rc) < m1
+
+    def test_history_oom_prune(self):
+        rec = HistoryRecorder()
+        oom = {"dp_degree": 1, "mp_degree": 2, "pp_degree": 1,
+               "sharding_degree": 4, "micro_batch_size": 8}
+        rec.add_cfg(oom, error="oom")
+        bigger = dict(oom, micro_batch_size=16)
+        smaller = dict(oom, micro_batch_size=4)
+        assert prune_by_history(_cfg(), bigger, rec)
+        assert prune_by_history(_cfg(), oom, rec)
+        assert not prune_by_history(_cfg(), smaller, rec)
+
+
+class TestAutoTuner:
+    def test_callback_mode_picks_measured_best(self):
+        def fake_trial(cfg):
+            # pretend pure-DP with biggest microbatch is fastest
+            return (cfg["dp_degree"] * 10 + cfg["micro_batch_size"]
+                    - 100 * cfg["use_recompute"])
+
+        t = AutoTuner(_cfg(use_recompute=[False], micro_batch_size=[1, 4]),
+                      run_trial=fake_trial)
+        best = t.tune()
+        assert best["dp_degree"] == 8
+        assert best["micro_batch_size"] == 4
+
+    def test_oom_trials_recorded_and_pruned(self):
+        calls = []
+
+        def trial(cfg):
+            calls.append(dict(cfg))
+            if cfg["micro_batch_size"] >= 4 and cfg["mp_degree"] == 1:
+                raise MemoryError("oom")
+            return 1.0 / cfg["mp_degree"]
+
+        t = AutoTuner(_cfg(pp_degree=1, sharding_degree=1,
+                           micro_batch_size=[2, 4, 8],
+                           use_recompute=[False]), run_trial=trial)
+        best = t.tune()
+        assert best is not None
+        # mbs=8 after mbs=4 OOM'd at same shape must have been pruned
+        mp1 = [c for c in calls if c["mp_degree"] == 1
+               and c["micro_batch_size"] == 8]
+        assert not mp1
+
+    def test_cost_model_mode(self):
+        t = AutoTuner(_cfg(max_mem_usage=64e9, use_recompute=[False]))
+        best = t.tune()
+        assert best is not None
+        assert (best["dp_degree"] * best["mp_degree"] * best["pp_degree"]
+                * best["sharding_degree"]) == 8
+
+    def test_store_history(self, tmp_path):
+        t = AutoTuner(_cfg(use_recompute=[False], micro_batch_size=[2]),
+                      run_trial=lambda c: 1.0)
+        t.tune(max_trials=3)
+        p = str(tmp_path / "hist.json")
+        t.recorder.store_history(p)
+        rec2 = HistoryRecorder()
+        rec2.load_history(p)
+        assert len(rec2.records) == len(t.recorder.records)
