@@ -1,0 +1,276 @@
+// Shared-memory byte-ring queue for DataLoader worker transport.
+//
+// Capability parity with the reference's data pipeline plumbing: worker
+// processes hand completed batches to the trainer through shared memory
+// (python/paddle/io/dataloader/dataloader_iter.py:429-463 uses
+// _share_memory tensors + a LoDTensorBlockingQueue; the C++ side lives in
+// paddle/fluid/operators/reader/). Here the transport is a single
+// variable-length record ring per worker: u32 length-prefixed payloads,
+// process-shared mutex + condvars for blocking push/pop, a closed flag
+// for clean shutdown. The payload format (numpy header + raw bytes) is
+// defined by the Python wrapper (io/shm_queue.py).
+//
+// C ABI for ctypes (no pybind11 in the image).
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <new>
+
+namespace {
+
+struct Header {
+  pthread_mutex_t mu;
+  pthread_cond_t nonempty;
+  pthread_cond_t nonfull;
+  uint64_t capacity;   // payload area size in bytes
+  uint64_t head;       // consumer offset (monotonic)
+  uint64_t tail;       // producer offset (monotonic)
+  int32_t closed;
+  int32_t magic;
+};
+
+constexpr int32_t kMagic = 0x53514d51;  // 'SQMQ'
+constexpr uint32_t kWrapMark = 0xffffffffu;
+
+struct Handle {
+  Header* h = nullptr;
+  uint8_t* data = nullptr;
+  size_t map_size = 0;
+  char name[256];
+  bool owner = false;
+};
+
+uint64_t used(const Header* h) { return h->tail - h->head; }
+
+void deadline_from_ms(timespec* ts, int64_t ms) {
+  clock_gettime(CLOCK_REALTIME, ts);
+  ts->tv_sec += ms / 1000;
+  ts->tv_nsec += (ms % 1000) * 1000000L;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+// copy into the ring at logical offset (mod capacity)
+void ring_write(Handle* q, uint64_t off, const void* src, uint64_t n) {
+  uint64_t cap = q->h->capacity;
+  uint64_t pos = off % cap;
+  uint64_t first = (pos + n <= cap) ? n : cap - pos;
+  memcpy(q->data + pos, src, first);
+  if (n > first) memcpy(q->data, static_cast<const uint8_t*>(src) + first,
+                        n - first);
+}
+
+void ring_read(Handle* q, uint64_t off, void* dst, uint64_t n) {
+  uint64_t cap = q->h->capacity;
+  uint64_t pos = off % cap;
+  uint64_t first = (pos + n <= cap) ? n : cap - pos;
+  memcpy(dst, q->data + pos, first);
+  if (n > first) memcpy(static_cast<uint8_t*>(dst) + first, q->data,
+                        n - first);
+}
+
+}  // namespace
+
+extern "C" {
+
+// create (owner) or open an existing queue. capacity only used on create.
+void* shmq_create(const char* name, uint64_t capacity) {
+  auto* q = new (std::nothrow) Handle();
+  if (!q) return nullptr;
+  snprintf(q->name, sizeof(q->name), "%s", name);
+  q->owner = true;
+  size_t total = sizeof(Header) + capacity;
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) { delete q; return nullptr; }
+  if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    delete q;
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) { shm_unlink(name); delete q; return nullptr; }
+  q->h = static_cast<Header*>(mem);
+  q->data = static_cast<uint8_t*>(mem) + sizeof(Header);
+  q->map_size = total;
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutex_init(&q->h->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&q->h->nonempty, &ca);
+  pthread_cond_init(&q->h->nonfull, &ca);
+  q->h->capacity = capacity;
+  q->h->head = q->h->tail = 0;
+  q->h->closed = 0;
+  q->h->magic = kMagic;
+  return q;
+}
+
+void* shmq_open(const char* name) {
+  auto* q = new (std::nothrow) Handle();
+  if (!q) return nullptr;
+  snprintf(q->name, sizeof(q->name), "%s", name);
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) { delete q; return nullptr; }
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); delete q; return nullptr; }
+  void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
+                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) { delete q; return nullptr; }
+  q->h = static_cast<Header*>(mem);
+  if (q->h->magic != kMagic) {
+    munmap(mem, static_cast<size_t>(st.st_size));
+    delete q;
+    return nullptr;
+  }
+  q->data = static_cast<uint8_t*>(mem) + sizeof(Header);
+  q->map_size = static_cast<size_t>(st.st_size);
+  return q;
+}
+
+// push one record. 0 ok, -1 timeout, -2 closed, -3 record too large.
+int64_t shmq_push(void* vh, const void* buf, uint64_t len,
+                  int64_t timeout_ms) {
+  auto* q = static_cast<Handle*>(vh);
+  uint64_t need = 4 + len;
+  if (need + 4 > q->h->capacity) return -3;  // +4: room for a wrap mark
+  timespec ts;
+  if (timeout_ms > 0) deadline_from_ms(&ts, timeout_ms);
+  pthread_mutex_lock(&q->h->mu);
+  while (!q->h->closed && q->h->capacity - used(q->h) < need + 4) {
+    if (timeout_ms > 0) {
+      if (pthread_cond_timedwait(&q->h->nonfull, &q->h->mu, &ts) ==
+          ETIMEDOUT) {
+        pthread_mutex_unlock(&q->h->mu);
+        return -1;
+      }
+    } else {
+      pthread_cond_wait(&q->h->nonfull, &q->h->mu);
+    }
+  }
+  if (q->h->closed) {
+    pthread_mutex_unlock(&q->h->mu);
+    return -2;
+  }
+  uint32_t len32 = static_cast<uint32_t>(len);
+  ring_write(q, q->h->tail, &len32, 4);
+  ring_write(q, q->h->tail + 4, buf, len);
+  q->h->tail += need;
+  pthread_cond_signal(&q->h->nonempty);
+  pthread_mutex_unlock(&q->h->mu);
+  return 0;
+}
+
+// next record's length without consuming. >=0 length, -1 timeout,
+// -2 closed-and-drained.
+int64_t shmq_peek_size(void* vh, int64_t timeout_ms) {
+  auto* q = static_cast<Handle*>(vh);
+  timespec ts;
+  if (timeout_ms > 0) deadline_from_ms(&ts, timeout_ms);
+  pthread_mutex_lock(&q->h->mu);
+  while (used(q->h) == 0) {
+    if (q->h->closed) {
+      pthread_mutex_unlock(&q->h->mu);
+      return -2;
+    }
+    if (timeout_ms > 0) {
+      if (pthread_cond_timedwait(&q->h->nonempty, &q->h->mu, &ts) ==
+          ETIMEDOUT) {
+        pthread_mutex_unlock(&q->h->mu);
+        return -1;
+      }
+    } else {
+      pthread_cond_wait(&q->h->nonempty, &q->h->mu);
+    }
+  }
+  uint32_t len32;
+  ring_read(q, q->h->head, &len32, 4);
+  pthread_mutex_unlock(&q->h->mu);
+  return static_cast<int64_t>(len32);
+}
+
+// pop one record into buf. >=0: record length, -1 timeout,
+// -2 closed-and-drained, -4 buffer too small (record NOT consumed —
+// call shmq_peek_size, grow, retry).
+int64_t shmq_pop(void* vh, void* buf, uint64_t buflen, int64_t timeout_ms) {
+  auto* q = static_cast<Handle*>(vh);
+  timespec ts;
+  if (timeout_ms > 0) deadline_from_ms(&ts, timeout_ms);
+  pthread_mutex_lock(&q->h->mu);
+  while (used(q->h) == 0) {
+    if (q->h->closed) {
+      pthread_mutex_unlock(&q->h->mu);
+      return -2;
+    }
+    if (timeout_ms > 0) {
+      if (pthread_cond_timedwait(&q->h->nonempty, &q->h->mu, &ts) ==
+          ETIMEDOUT) {
+        pthread_mutex_unlock(&q->h->mu);
+        return -1;
+      }
+    } else {
+      pthread_cond_wait(&q->h->nonempty, &q->h->mu);
+    }
+  }
+  uint32_t len32;
+  ring_read(q, q->h->head, &len32, 4);
+  uint64_t n = len32;
+  if (n > buflen) {
+    pthread_mutex_unlock(&q->h->mu);
+    return -4;
+  }
+  ring_read(q, q->h->head + 4, buf, n);
+  q->h->head += 4 + n;
+  pthread_cond_signal(&q->h->nonfull);
+  pthread_mutex_unlock(&q->h->mu);
+  return static_cast<int64_t>(n);
+}
+
+void shmq_mark_closed(void* vh) {
+  auto* q = static_cast<Handle*>(vh);
+  if (!q || !q->h) return;
+  pthread_mutex_lock(&q->h->mu);
+  q->h->closed = 1;
+  pthread_cond_broadcast(&q->h->nonempty);
+  pthread_cond_broadcast(&q->h->nonfull);
+  pthread_mutex_unlock(&q->h->mu);
+}
+
+uint64_t shmq_size(void* vh) {
+  auto* q = static_cast<Handle*>(vh);
+  if (!q || !q->h) return 0;
+  pthread_mutex_lock(&q->h->mu);
+  uint64_t n = used(q->h);
+  pthread_mutex_unlock(&q->h->mu);
+  return n;
+}
+
+void shmq_close(void* vh) {
+  auto* q = static_cast<Handle*>(vh);
+  if (!q) return;
+  bool owner = q->owner;
+  char name[256];
+  snprintf(name, sizeof(name), "%s", q->name);
+  if (q->h) munmap(q->h, q->map_size);
+  if (owner) shm_unlink(name);
+  delete q;
+}
+
+}  // extern "C"

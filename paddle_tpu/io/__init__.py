@@ -270,6 +270,9 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         self._iterable_ds = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -305,6 +308,23 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._make_batches()
             return
+        if self.use_shared_memory:
+            # true multi-process workers over the native shared-memory
+            # rings (csrc/shm_queue.cpp) — the reference's worker +
+            # shared-memory transport design. Falls back to the thread
+            # prefetcher if the native path can't start (e.g. no g++).
+            try:
+                from .worker import MultiprocessLoaderIter
+                it = MultiprocessLoaderIter(self, timeout=self.timeout
+                                            or 300.0)
+            except Exception:
+                it = None
+            if it is not None:
+                try:
+                    yield from it
+                finally:
+                    it.shutdown()
+                return
         # prefetch thread: overlaps host batch assembly with device compute
         q: "queue.Queue" = queue.Queue(maxsize=self.num_workers *
                                        self.prefetch_factor)

@@ -1,0 +1,145 @@
+"""Python wrapper over the native shared-memory record ring
+(csrc/shm_queue.cpp) + the numpy batch wire format.
+
+Batch format: u32 n_arrays, then per array:
+u8 dtype_len | dtype ascii | u8 ndim | u64 dims... | u64 nbytes | raw bytes.
+A zero-array batch (n_arrays == 0xffffffff) is the end-of-data sentinel.
+"""
+from __future__ import annotations
+
+import ctypes
+import struct
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.native import load_native
+
+__all__ = ["ShmQueue", "encode_batch", "decode_batch", "SENTINEL"]
+
+SENTINEL = struct.pack("<I", 0xFFFFFFFF)
+
+
+def _lib():
+    lib = load_native("shm_queue")
+    lib.shmq_create.restype = ctypes.c_void_p
+    lib.shmq_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.shmq_open.restype = ctypes.c_void_p
+    lib.shmq_open.argtypes = [ctypes.c_char_p]
+    lib.shmq_push.restype = ctypes.c_int64
+    lib.shmq_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.c_uint64, ctypes.c_int64]
+    lib.shmq_pop.restype = ctypes.c_int64
+    lib.shmq_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                             ctypes.c_uint64, ctypes.c_int64]
+    lib.shmq_peek_size.restype = ctypes.c_int64
+    lib.shmq_peek_size.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.shmq_mark_closed.argtypes = [ctypes.c_void_p]
+    lib.shmq_size.restype = ctypes.c_uint64
+    lib.shmq_size.argtypes = [ctypes.c_void_p]
+    lib.shmq_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def encode_batch(arrays: Sequence[np.ndarray]) -> bytes:
+    parts: List[bytes] = [struct.pack("<I", len(arrays))]
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        dt = a.dtype.str.encode()
+        parts.append(struct.pack("<B", len(dt)))
+        parts.append(dt)
+        parts.append(struct.pack("<B", a.ndim))
+        parts.append(struct.pack(f"<{a.ndim}Q", *a.shape)
+                     if a.ndim else b"")
+        parts.append(struct.pack("<Q", a.nbytes))
+        parts.append(a.tobytes())
+    return b"".join(parts)
+
+
+def decode_batch(buf: memoryview) -> Optional[List[np.ndarray]]:
+    (n,) = struct.unpack_from("<I", buf, 0)
+    if n == 0xFFFFFFFF:
+        return None  # sentinel
+    off = 4
+    out: List[np.ndarray] = []
+    for _ in range(n):
+        (dtl,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        dt = bytes(buf[off:off + dtl]).decode()
+        off += dtl
+        (ndim,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        shape = struct.unpack_from(f"<{ndim}Q", buf, off) if ndim else ()
+        off += 8 * ndim
+        (nbytes,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        arr = np.frombuffer(buf, dtype=np.dtype(dt), count=nbytes
+                            // np.dtype(dt).itemsize, offset=off)
+        out.append(arr.reshape(shape).copy())  # own the memory: the pop
+        off += nbytes                          # buffer is reused
+    return out
+
+
+class ShmQueue:
+    """One producer-side or consumer-side handle on a named ring."""
+
+    def __init__(self, name: str, capacity: int = 0, create: bool = False):
+        self._lib = _lib()
+        self.name = name
+        if create:
+            self._h = self._lib.shmq_create(name.encode(), capacity)
+        else:
+            self._h = self._lib.shmq_open(name.encode())
+        if not self._h:
+            raise RuntimeError(
+                f"ShmQueue: cannot {'create' if create else 'open'} {name}")
+        self._buf = ctypes.create_string_buffer(1 << 20)
+
+    def push(self, payload: bytes, timeout_s: float = 0) -> None:
+        r = self._lib.shmq_push(self._h, payload, len(payload),
+                                int(timeout_s * 1000))
+        if r == -1:
+            raise TimeoutError(f"ShmQueue.push timed out after {timeout_s}s")
+        if r == -2:
+            raise BrokenPipeError("ShmQueue closed")
+        if r == -3:
+            raise ValueError(
+                f"batch of {len(payload)} bytes exceeds the shared-memory "
+                f"ring capacity; raise DataLoader's shm_capacity")
+
+    def pop(self, timeout_s: float = 0) -> Optional[bytes]:
+        """Returns the record, or None when closed and drained. The pop
+        buffer grows to fit (a too-small buffer never loses the record:
+        the native side returns -4 without consuming)."""
+        while True:
+            n = self._lib.shmq_pop(self._h, self._buf, len(self._buf),
+                                   int(timeout_s * 1000))
+            if n == -1:
+                raise TimeoutError(
+                    f"ShmQueue.pop timed out after {timeout_s}s")
+            if n == -2:
+                return None
+            if n == -4:
+                need = self._lib.shmq_peek_size(self._h, 1000)
+                if need > 0:
+                    self._buf = ctypes.create_string_buffer(int(need))
+                continue
+            return self._buf.raw[:n]
+
+    def size(self) -> int:
+        return int(self._lib.shmq_size(self._h)) if self._h else 0
+
+    def mark_closed(self) -> None:
+        if self._h:
+            self._lib.shmq_mark_closed(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.shmq_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

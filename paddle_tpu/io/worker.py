@@ -1,0 +1,193 @@
+"""Multiprocess DataLoader workers over the native shared-memory rings.
+
+Parity: python/paddle/io/dataloader/dataloader_iter.py:358
+(_DataLoaderIterMultiProcess) + worker.py — N forked worker processes,
+each assembling its round-robin share of batches and pushing them through
+shared memory; the trainer consumes worker rings in round-robin order,
+which restores the global batch order without an explicit reorder buffer
+(worker i emits its batches in order).
+
+TPU caveat handled here: workers are forked and must never touch the
+accelerator — batches are converted to numpy inside the worker, and the
+fork happens lazily at iterator start (the launcher-style import path
+keeps jax uninitialized, but a trainer process will already own the TPU,
+so workers touch only numpy + the native ring).
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import struct
+from typing import List
+
+import numpy as np
+
+from .shm_queue import SENTINEL, ShmQueue, encode_batch
+
+
+class WorkerInfo:
+    def __init__(self, id: int, num_workers: int, dataset, seed: int):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Inside a worker: its WorkerInfo; None in the main process
+    (parity: paddle.io.get_worker_info)."""
+    return _worker_info
+
+
+def _to_numpy_batch(batch) -> List[np.ndarray]:
+    out = []
+    for item in batch if isinstance(batch, (list, tuple)) else [batch]:
+        if hasattr(item, "numpy"):
+            out.append(np.asarray(item.numpy()))
+        else:
+            out.append(np.asarray(item))
+    return out
+
+
+def _worker_loop(dataset, index_batches, collate_fn, qname, worker_id,
+                 num_workers, init_fn, seed):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset, seed)
+    np.random.seed((seed + worker_id) % (2 ** 32))
+    if init_fn is not None:
+        init_fn(worker_id)
+    q = ShmQueue(qname)
+    try:
+        if index_batches is None:  # IterableDataset: shard by item index
+            batch = []
+            bs = collate_fn.batch_size
+            for i, item in enumerate(dataset):
+                if i % num_workers != worker_id:
+                    continue
+                batch.append(item)
+                if len(batch) == bs:
+                    q.push(encode_batch(_to_numpy_batch(
+                        collate_fn(batch))), timeout_s=300)
+                    batch = []
+            if batch and not collate_fn.drop_last:
+                q.push(encode_batch(_to_numpy_batch(collate_fn(batch))),
+                       timeout_s=300)
+        else:
+            for idx_batch in index_batches:
+                samples = [dataset[i] for i in idx_batch]
+                q.push(encode_batch(_to_numpy_batch(collate_fn(samples))),
+                       timeout_s=300)
+        q.push(SENTINEL, timeout_s=300)
+    except (BrokenPipeError, TimeoutError):
+        pass  # consumer gone: exit quietly
+    finally:
+        q.close()
+    os._exit(0)  # skip atexit/jax teardown inherited from the parent
+
+
+class _CollateWrap:
+    """Picklable-by-fork collate carrier for the iterable path."""
+
+    def __init__(self, fn, batch_size, drop_last):
+        self.fn = fn
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __call__(self, batch):
+        return self.fn(batch)
+
+
+class MultiprocessLoaderIter:
+    """Consumer side: fork workers, round-robin the rings in order."""
+
+    def __init__(self, loader, shm_capacity: int = 64 << 20,
+                 timeout: float = 300.0):
+        self.loader = loader
+        self.num_workers = loader.num_workers
+        self.timeout = timeout if timeout > 0 else 300.0
+        ctx = mp.get_context("fork")
+        seed = int.from_bytes(os.urandom(4), "little")
+        uid = f"{os.getpid()}_{id(self)}"
+        self.queues = [
+            ShmQueue(f"/ptpu_dl_{uid}_{w}",
+                     capacity=shm_capacity // self.num_workers, create=True)
+            for w in range(self.num_workers)]
+        collate = _CollateWrap(loader.collate_fn, loader.batch_size,
+                               loader.drop_last)
+        if loader.batch_sampler is not None:
+            all_batches = list(loader.batch_sampler)
+            shares = [all_batches[w::self.num_workers]
+                      for w in range(self.num_workers)]
+        else:
+            shares = [None] * self.num_workers
+        self.procs = []
+        for w in range(self.num_workers):
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, shares[w], collate,
+                      self.queues[w].name, w, self.num_workers,
+                      loader.worker_init_fn, seed),
+                daemon=True)
+            p.start()
+            self.procs.append(p)
+        self._done = [False] * self.num_workers
+        self._next = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from .shm_queue import decode_batch
+        while not all(self._done):
+            w = self._next
+            self._next = (self._next + 1) % self.num_workers
+            if self._done[w]:
+                continue
+            try:
+                rec = self.queues[w].pop(timeout_s=self.timeout)
+            except TimeoutError:
+                proc = self.procs[w]
+                if not proc.is_alive():
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker {w} died (exit code "
+                        f"{proc.exitcode})") from None
+                raise
+            if rec is None:
+                self._done[w] = True
+                continue
+            batch = decode_batch(memoryview(rec))
+            if batch is None:  # sentinel
+                self._done[w] = True
+                continue
+            from ..core.tensor import Tensor
+            return tuple(Tensor(a) for a in batch) if len(batch) > 1 \
+                else (Tensor(batch[0]),)
+        self.shutdown()
+        raise StopIteration
+
+    def shutdown(self):
+        if not self.queues:
+            return  # idempotent: StopIteration and finally both call this
+        queues, self.queues = self.queues, []
+        procs, self.procs = self.procs, []
+        for q in queues:
+            try:
+                q.mark_closed()
+            except Exception:
+                pass
+        for p in procs:
+            p.join(timeout=2)
+            if p.is_alive():
+                p.terminate()
+        for q in queues:
+            q.close()
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass

@@ -1,0 +1,148 @@
+"""Native shared-memory queue + multiprocess DataLoader tests
+(reference test model: test/legacy_test/test_multiprocess_dataloader_*)."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset, TensorDataset
+from paddle_tpu.io.shm_queue import (SENTINEL, ShmQueue, decode_batch,
+                                     encode_batch)
+
+
+class TestShmQueue:
+    def _pair(self, capacity=1 << 16):
+        name = f"/ptpu_test_{os.getpid()}_{time.monotonic_ns()}"
+        prod = ShmQueue(name, capacity=capacity, create=True)
+        cons = ShmQueue(name)
+        return prod, cons
+
+    def test_push_pop_roundtrip(self):
+        prod, cons = self._pair()
+        prod.push(b"hello", timeout_s=5)
+        prod.push(b"\x00" * 1000, timeout_s=5)
+        assert cons.pop(timeout_s=5) == b"hello"
+        assert cons.pop(timeout_s=5) == b"\x00" * 1000
+        prod.close()
+        cons.close()
+
+    def test_wraparound(self):
+        prod, cons = self._pair(capacity=256)
+        for i in range(50):  # records cycle the ring many times
+            payload = bytes([i]) * (i % 60 + 1)
+            prod.push(payload, timeout_s=5)
+            assert cons.pop(timeout_s=5) == payload
+        prod.close()
+        cons.close()
+
+    def test_blocking_push_waits_for_space(self):
+        prod, cons = self._pair(capacity=128)
+        prod.push(b"x" * 80, timeout_s=5)
+
+        def slow_pop():
+            time.sleep(0.2)
+            cons.pop(timeout_s=5)
+        t = threading.Thread(target=slow_pop)
+        t.start()
+        t0 = time.time()
+        prod.push(b"y" * 80, timeout_s=5)  # must wait for the pop
+        assert time.time() - t0 > 0.1
+        t.join()
+        prod.close()
+        cons.close()
+
+    def test_pop_grows_buffer_without_losing_record(self):
+        prod, cons = self._pair(capacity=8 << 20)
+        big = os.urandom(4 << 20)  # larger than the 1MB initial buffer
+        prod.push(big, timeout_s=5)
+        assert cons.pop(timeout_s=5) == big
+        prod.close()
+        cons.close()
+
+    def test_closed_drains_then_none(self):
+        prod, cons = self._pair()
+        prod.push(b"last", timeout_s=5)
+        prod.mark_closed()
+        assert cons.pop(timeout_s=5) == b"last"
+        assert cons.pop(timeout_s=5) is None
+        prod.close()
+        cons.close()
+
+    def test_record_too_large_raises(self):
+        prod, cons = self._pair(capacity=64)
+        with pytest.raises(ValueError, match="capacity"):
+            prod.push(b"z" * 100, timeout_s=1)
+        prod.close()
+        cons.close()
+
+    def test_encode_decode_batch(self):
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        b = np.array([1, 2, 3], dtype=np.int64)
+        out = decode_batch(memoryview(encode_batch([a, b])))
+        np.testing.assert_array_equal(out[0], a)
+        np.testing.assert_array_equal(out[1], b)
+        assert decode_batch(memoryview(SENTINEL)) is None
+
+
+class _SquareDataset(Dataset):
+    def __init__(self, n=32):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return (np.full((4,), i, np.float32), np.int64(i * i))
+
+
+class TestMultiprocessDataLoader:
+    def test_batches_complete_and_ordered(self):
+        ds = _SquareDataset(32)
+        loader = DataLoader(ds, batch_size=4, num_workers=2, shuffle=False)
+        xs, ys = [], []
+        for xb, yb in loader:
+            xs.append(xb.numpy())
+            ys.append(yb.numpy())
+        x = np.concatenate(xs)
+        y = np.concatenate(ys)
+        np.testing.assert_array_equal(x[:, 0], np.arange(32))
+        np.testing.assert_array_equal(y, np.arange(32) ** 2)
+
+    def test_reiterable(self):
+        ds = _SquareDataset(8)
+        loader = DataLoader(ds, batch_size=2, num_workers=2)
+        n1 = sum(1 for _ in loader)
+        n2 = sum(1 for _ in loader)
+        assert n1 == n2 == 4
+
+    def test_matches_single_process(self):
+        ds = TensorDataset([
+            paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(12, 2)),
+            paddle.to_tensor(np.arange(12, dtype=np.int64))])
+        got = [tuple(t.numpy() for t in b)
+               for b in DataLoader(ds, batch_size=3, num_workers=2)]
+        ref = [tuple(t.numpy() for t in b)
+               for b in DataLoader(ds, batch_size=3, num_workers=0)]
+        assert len(got) == len(ref)
+        for g, r in zip(got, ref):
+            np.testing.assert_array_equal(g[0], r[0])
+            np.testing.assert_array_equal(g[1], r[1])
+
+    def test_worker_crash_raises(self):
+        class Bad(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                if i >= 4:
+                    os._exit(13)  # simulate hard worker death
+                return np.float32(i)
+
+        loader = DataLoader(Bad(), batch_size=2, num_workers=2)
+        loader.timeout = 3
+        with pytest.raises(RuntimeError, match="worker"):
+            for _ in loader:
+                pass
