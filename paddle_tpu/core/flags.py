@@ -64,5 +64,8 @@ define_flag("pallas_force_interpret", False,
             "run Pallas kernels in interpret mode on non-TPU backends "
             "(kernel tests); default falls back to the XLA impl off-TPU")
 define_flag("embedding_deterministic", False, "deterministic embedding grad accumulation")
+define_flag("dataloader_start_method", "forkserver",
+            "multiprocessing start method for DataLoader workers; fork is "
+            "unsafe once the JAX runtime threads exist")
 define_flag("cudnn_deterministic", False, "accepted for API parity; no-op on TPU")
 define_flag("low_precision_op_list", 0, "collect amp op stats level")

@@ -308,16 +308,29 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._make_batches()
             return
-        if self.use_shared_memory:
+        if self.use_shared_memory and not getattr(self, "_mp_failed", False):
             # true multi-process workers over the native shared-memory
             # rings (csrc/shm_queue.cpp) — the reference's worker +
             # shared-memory transport design. Falls back to the thread
             # prefetcher if the native path can't start (e.g. no g++).
             try:
-                from .worker import MultiprocessLoaderIter
+                from .worker import MultiprocessLoaderIter, WorkerStartupError
                 it = MultiprocessLoaderIter(self, timeout=self.timeout
                                             or 300.0)
+            except WorkerStartupError as e:
+                # unpicklable local dataset/collate under forkserver: stay
+                # usable via the in-process prefetch thread, but say so —
+                # a silent fallback hides real pickling bugs. Outcome is
+                # deterministic per loader; don't re-pay the failed start
+                # every epoch.
+                import warnings
+                warnings.warn(
+                    f"multi-process DataLoader fell back to the thread "
+                    f"prefetcher: {e}", RuntimeWarning)
+                self._mp_failed = True
+                it = None
             except Exception:
+                self._mp_failed = True
                 it = None
             if it is not None:
                 try:

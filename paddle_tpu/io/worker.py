@@ -88,6 +88,11 @@ def _worker_loop(dataset, index_batches, collate_fn, qname, worker_id,
     os._exit(0)  # skip atexit/jax teardown inherited from the parent
 
 
+class WorkerStartupError(RuntimeError):
+    """Worker processes could not start (most commonly: the dataset or
+    collate_fn is not picklable under the spawn/forkserver start method)."""
+
+
 class _CollateWrap:
     """Picklable-by-fork collate carrier for the iterable path."""
 
@@ -108,7 +113,14 @@ class MultiprocessLoaderIter:
         self.loader = loader
         self.num_workers = loader.num_workers
         self.timeout = timeout if timeout > 0 else 300.0
-        ctx = mp.get_context("fork")
+        # fork after JAX has spun up its runtime threads deadlocks (the child
+        # inherits locked mutexes); forkserver forks from a clean helper
+        # process instead. Parity: the reference defaults to fork but its
+        # dataloader documents the same hazard
+        # (python/paddle/io/dataloader/dataloader_iter.py:358).
+        from ..core import flags as _flags
+        method = _flags.get_flag("dataloader_start_method") or "forkserver"
+        ctx = mp.get_context(method)
         seed = int.from_bytes(os.urandom(4), "little")
         uid = f"{os.getpid()}_{id(self)}"
         self.queues = [
@@ -131,7 +143,13 @@ class MultiprocessLoaderIter:
                       self.queues[w].name, w, self.num_workers,
                       loader.worker_init_fn, seed),
                 daemon=True)
-            p.start()
+            try:
+                p.start()
+            except Exception as e:
+                self.shutdown()
+                raise WorkerStartupError(
+                    f"could not start DataLoader worker {w} under the "
+                    f"'{method}' start method: {e}") from e
             self.procs.append(p)
         self._done = [False] * self.num_workers
         self._next = 0
@@ -140,22 +158,33 @@ class MultiprocessLoaderIter:
         return self
 
     def __next__(self):
+        import time
+
         from .shm_queue import decode_batch
         while not all(self._done):
             w = self._next
             self._next = (self._next + 1) % self.num_workers
             if self._done[w]:
                 continue
-            try:
-                rec = self.queues[w].pop(timeout_s=self.timeout)
-            except TimeoutError:
-                proc = self.procs[w]
-                if not proc.is_alive():
-                    self.shutdown()
-                    raise RuntimeError(
-                        f"DataLoader worker {w} died (exit code "
-                        f"{proc.exitcode})") from None
-                raise
+            # poll in short slices so a dead worker is detected promptly
+            # instead of only after the full user-facing timeout
+            deadline = time.monotonic() + self.timeout
+            rec = None
+            while True:
+                remaining = deadline - time.monotonic()
+                try:
+                    rec = self.queues[w].pop(
+                        timeout_s=max(0.05, min(1.0, remaining)))
+                    break
+                except TimeoutError:
+                    proc = self.procs[w]
+                    if not proc.is_alive():
+                        self.shutdown()
+                        raise RuntimeError(
+                            f"DataLoader worker {w} died (exit code "
+                            f"{proc.exitcode})") from None
+                    if remaining <= 0:
+                        raise
             if rec is None:
                 self._done[w] = True
                 continue

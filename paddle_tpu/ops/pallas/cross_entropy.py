@@ -17,9 +17,12 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 from ...core.dispatch import register_op_impl
+from .common import _Z, pad_rows
+
 
 __all__ = ["softmax_xent_pallas"]
 
@@ -27,12 +30,15 @@ _ROW_BLOCK = 8
 
 
 def _fwd_kernel(x_ref, lab_ref, loss_ref, lse_ref):
+    # per-row scalars ride as (br, 1) trailing-unit refs: Mosaic requires the
+    # last block dim to be a 128-multiple or the full array dim, so rank-1
+    # (br,) blocks are illegal on hardware
     x = x_ref[...].astype(jnp.float32)                    # (br, V)
-    lab = lab_ref[...]                                    # (br,)
-    m = jnp.max(x, axis=1, keepdims=True)
-    lse = (m[:, 0] + jnp.log(jnp.sum(jnp.exp(x - m), axis=1)))
+    lab = lab_ref[...]                                    # (br, 1)
+    m = jnp.max(x, axis=1, keepdims=True)                 # (br, 1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(x - m), axis=1, keepdims=True))
     cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
-    picked = jnp.sum(jnp.where(cols == lab[:, None], x, 0.0), axis=1)
+    picked = jnp.sum(jnp.where(cols == lab, x, 0.0), axis=1, keepdims=True)
     # out-of-range label (e.g. ignore_index rows): loss 0 via picked=lse
     valid = (lab >= 0) & (lab < x.shape[1])
     loss_ref[...] = jnp.where(valid, lse - picked, 0.0)
@@ -41,22 +47,16 @@ def _fwd_kernel(x_ref, lab_ref, loss_ref, lse_ref):
 
 def _bwd_kernel(x_ref, lab_ref, lse_ref, g_ref, dx_ref):
     x = x_ref[...].astype(jnp.float32)
-    lab = lab_ref[...]
-    lse = lse_ref[...]
-    g = g_ref[...]
-    p = jnp.exp(x - lse[:, None])                         # softmax row
+    lab = lab_ref[...]                                    # (br, 1)
+    lse = lse_ref[...]                                    # (br, 1)
+    g = g_ref[...]                                        # (br, 1)
+    p = jnp.exp(x - lse)                                  # softmax row
     cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
-    onehot = (cols == lab[:, None]).astype(jnp.float32)
+    onehot = (cols == lab).astype(jnp.float32)
     valid = ((lab >= 0) & (lab < x.shape[1])).astype(jnp.float32)
-    dx_ref[...] = ((p - onehot) * (g * valid)[:, None]).astype(dx_ref.dtype)
+    dx_ref[...] = ((p - onehot) * (g * valid)).astype(dx_ref.dtype)
 
 
-def _pad_rows(a, br):
-    pad = (-a.shape[0]) % br
-    if pad:
-        cfg = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
-        a = jnp.pad(a, cfg)
-    return a
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -71,21 +71,21 @@ def softmax_xent_pallas(logits, labels, interpret=False):
 def _fwd(logits, labels, interpret):
     r, v = logits.shape
     br = min(_ROW_BLOCK, max(r, 1))
-    xp = _pad_rows(logits, br)
-    lp = _pad_rows(labels.astype(jnp.int32), br)
+    xp = pad_rows(logits, br)
+    lp = pad_rows(labels.astype(jnp.int32).reshape(r, 1), br)
     rp = xp.shape[0]
     loss, lse = pl.pallas_call(
         _fwd_kernel,
         grid=(rp // br,),
-        in_specs=[pl.BlockSpec((br, v), lambda i: (i, 0)),
-                  pl.BlockSpec((br,), lambda i: (i,))],
-        out_specs=[pl.BlockSpec((br,), lambda i: (i,)),
-                   pl.BlockSpec((br,), lambda i: (i,))],
-        out_shape=[jax.ShapeDtypeStruct((rp,), jnp.float32),
-                   jax.ShapeDtypeStruct((rp,), jnp.float32)],
+        in_specs=[pl.BlockSpec((br, v), lambda i: (i, _Z)),
+                  pl.BlockSpec((br, 1), lambda i: (i, _Z))],
+        out_specs=[pl.BlockSpec((br, 1), lambda i: (i, _Z)),
+                   pl.BlockSpec((br, 1), lambda i: (i, _Z))],
+        out_shape=[jax.ShapeDtypeStruct((rp, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((rp, 1), jnp.float32)],
         interpret=interpret,
     )(xp, lp)
-    return loss[:r], (logits, labels, lse[:r])
+    return loss[:r, 0], (logits, labels, lse[:r, 0])
 
 
 def _fwd_rule(logits, labels, interpret):
@@ -97,19 +97,19 @@ def _bwd_rule(interpret, res, g):
     logits, labels, lse = res
     r, v = logits.shape
     br = min(_ROW_BLOCK, max(r, 1))
-    xp = _pad_rows(logits, br)
-    lp = _pad_rows(labels.astype(jnp.int32), br)
-    lsep = _pad_rows(lse, br)
-    gp = _pad_rows(g.astype(jnp.float32), br)
+    xp = pad_rows(logits, br)
+    lp = pad_rows(labels.astype(jnp.int32).reshape(r, 1), br)
+    lsep = pad_rows(lse.reshape(r, 1), br)
+    gp = pad_rows(g.astype(jnp.float32).reshape(r, 1), br)
     rp = xp.shape[0]
     dx = pl.pallas_call(
         _bwd_kernel,
         grid=(rp // br,),
-        in_specs=[pl.BlockSpec((br, v), lambda i: (i, 0)),
-                  pl.BlockSpec((br,), lambda i: (i,)),
-                  pl.BlockSpec((br,), lambda i: (i,)),
-                  pl.BlockSpec((br,), lambda i: (i,))],
-        out_specs=pl.BlockSpec((br, v), lambda i: (i, 0)),
+        in_specs=[pl.BlockSpec((br, v), lambda i: (i, _Z)),
+                  pl.BlockSpec((br, 1), lambda i: (i, _Z)),
+                  pl.BlockSpec((br, 1), lambda i: (i, _Z)),
+                  pl.BlockSpec((br, 1), lambda i: (i, _Z))],
+        out_specs=pl.BlockSpec((br, v), lambda i: (i, _Z)),
         out_shape=jax.ShapeDtypeStruct((rp, v), logits.dtype),
         interpret=interpret,
     )(xp, lp, lsep, gp)

@@ -27,12 +27,15 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 from jax.experimental.pallas import tpu as pltpu
 
 from ...core import flags as _flags
 from ...core.dispatch import register_op_impl
+from .common import _Z
+
 
 __all__ = ["flash_attention_pallas"]
 
@@ -41,9 +44,13 @@ _LANES = 128
 
 
 def _kv_index(bh, hq, hk):
-    """Flattened (b*Hq) program index -> flattened (b*Hk) kv index (GQA)."""
-    rep = hq // hk
-    return (bh // hq) * hk + (bh % hq) // rep
+    """Flattened (b*Hq) program index -> flattened (b*Hk) kv index (GQA).
+
+    All constants forced to i32: index maps lower through Mosaic, which
+    rejects the i64 values the x64-enabled tracer would otherwise produce.
+    """
+    rep = np.int32(hq // hk)
+    return (bh // np.int32(hq)) * np.int32(hk) + (bh % np.int32(hq)) // rep
 
 
 # ---------------------------------------------------------------------------
@@ -52,6 +59,7 @@ def _kv_index(bh, hq, hk):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
                 scale, causal, offset, bq, bk, nk, sk_real):
+    scale = np.float32(scale)  # strong f64 scalars poison Mosaic under x64
     ki = pl.program_id(2)
     qi = pl.program_id(1)
     q_start = qi * bq
@@ -103,9 +111,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = jnp.where(l > 0.0, acc_ref[...] / safe_l, 0.0
                              ).astype(o_ref.dtype)
-        m = m_ref[:, 0]
-        lse_ref[0] = jnp.where(l[:, 0] > 0.0,
-                               m + jnp.log(jnp.maximum(l[:, 0], 1e-38)),
+        # lse rides as a (bq, 1) trailing-unit ref (Mosaic rejects (1, bq)
+        # blocks whose sublane dim is neither full nor a multiple of 8)
+        m = m_ref[:, :1]
+        lse_ref[0] = jnp.where(l > 0.0,
+                               m + jnp.log(jnp.maximum(l, 1e-38)),
                                _NEG_INF)
 
 
@@ -125,17 +135,17 @@ def _fwd(q3, k3, v3, hq, hk, causal, scale, offset, sk_real, bq, bk,
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (kv_map(bh), ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (kv_map(bh), ki, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _Z)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (kv_map(bh), ki, _Z)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (kv_map(bh), ki, _Z)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bq), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _Z)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, _Z)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bhq, sq, d), q3.dtype),
-            jax.ShapeDtypeStruct((bhq, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bhq, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -146,7 +156,7 @@ def _fwd(q3, k3, v3, hq, hk, causal, scale, offset, sk_real, bq, bk,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q3, k3, v3)
-    return out, lse
+    return out, lse[..., 0]
 
 
 # ---------------------------------------------------------------------------
@@ -155,6 +165,7 @@ def _fwd(q3, k3, v3, hq, hk, causal, scale, offset, sk_real, bq, bk,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                dq_acc, *, scale, causal, offset, bq, bk, nk, sk_real):
+    scale = np.float32(scale)  # strong f64 scalars poison Mosaic under x64
     ki = pl.program_id(2)
     qi = pl.program_id(1)
     q_start, k_start = qi * bq, ki * bk
@@ -173,7 +184,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]                                        # (bq,)
+        lse = lse_ref[0]                                        # (bq, 1)
         lse_safe = jnp.where(lse == _NEG_INF, 0.0, lse)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
@@ -183,10 +194,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             qidx = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             mask = mask & (kidx <= qidx + offset)
         s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.exp(s - lse_safe[:, None])                      # (bq, bk)
+        p = jnp.exp(s - lse_safe)                               # (bq, bk)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None])                   # (bq, bk)
+        ds = p * (dp - delta_ref[0])                            # (bq, bk)
         dq_acc[...] += jax.lax.dot(ds, k,
                                    preferred_element_type=jnp.float32) * scale
 
@@ -198,6 +209,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                 dv_ref, dk_acc, dv_acc, *, scale, causal, offset, bq, bk, nq,
                 sk_real):
+    scale = np.float32(scale)  # strong f64 scalars poison Mosaic under x64
     qi = pl.program_id(2)
     ki = pl.program_id(1)
     q_start, k_start = qi * bq, ki * bk
@@ -218,7 +230,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
+        lse = lse_ref[0]                                        # (bq, 1)
         lse_safe = jnp.where(lse == _NEG_INF, 0.0, lse)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
@@ -228,13 +240,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
             qidx = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             mask = mask & (kidx <= qidx + offset)
         s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.exp(s - lse_safe[:, None])                      # (bq, bk)
+        p = jnp.exp(s - lse_safe)                               # (bq, bk)
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                  # (bk, d)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None])
+        ds = p * (dp - delta_ref[0])
         # q was pre-scaled on load, so dk = ds^T @ (scale*q) needs no extra
         # scale factor
         dk_acc[...] += jax.lax.dot_general(
@@ -254,6 +266,8 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, causal, scale, offset, sk_real,
     bhq, sq, d = q3.shape
     sk = kx.shape[1]
     nq, nk = sq // bq, sk // bk
+    lse3 = lse[..., None]                                   # (bhq, sq, 1)
+    delta3 = delta[..., None]
 
     scratch = [pltpu.VMEM((bq, d), jnp.float32)]
     dq = pl.pallas_call(
@@ -261,20 +275,20 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, causal, scale, offset, sk_real,
                           offset=offset, bq=bq, bk=bk, nk=nk, sk_real=sk_real),
         grid=(bhq, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bq), lambda bh, qi, ki: (bh, qi)),
-            pl.BlockSpec((1, bq), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _Z)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, _Z)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, _Z)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _Z)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, _Z)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, _Z)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _Z)),
         out_shape=jax.ShapeDtypeStruct((bhq, sq, d), q3.dtype),
         scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q3, kx, vx, do3, lse, delta)
+    )(q3, kx, vx, do3, lse3, delta3)
 
     scratch2 = [pltpu.VMEM((bk, d), jnp.float32),
                 pltpu.VMEM((bk, d), jnp.float32)]
@@ -283,16 +297,16 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, causal, scale, offset, sk_real,
                           offset=offset, bq=bq, bk=bk, nq=nq, sk_real=sk_real),
         grid=(bhq, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
-            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, bq), lambda bh, ki, qi: (bh, qi)),
-            pl.BlockSpec((1, bq), lambda bh, ki, qi: (bh, qi)),
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, _Z)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, _Z)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, _Z)),
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, _Z)),
+            pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, _Z)),
+            pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, _Z)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, _Z)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, _Z)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bhq, sk, d), q3.dtype),
@@ -302,7 +316,7 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, causal, scale, offset, sk_real,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q3, kx, vx, do3, lse, delta)
+    )(q3, kx, vx, do3, lse3, delta3)
     return dq, dk, dv
 
 

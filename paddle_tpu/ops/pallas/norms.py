@@ -18,11 +18,14 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ...core import flags as _flags
 from ...core.dispatch import register_op_impl
+from .common import _Z, pad_rows
+
 
 __all__ = ["rms_norm_pallas", "layer_norm_pallas"]
 
@@ -42,12 +45,6 @@ def _flatten_rows(x):
     return x.reshape(r, n), r, n
 
 
-def _pad_rows(x2, br):
-    r = x2.shape[0]
-    pad = (-r) % br
-    if pad:
-        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
-    return x2
 
 
 # ---------------------------------------------------------------------------
@@ -55,11 +52,13 @@ def _pad_rows(x2, br):
 # ---------------------------------------------------------------------------
 
 def _rms_fwd_kernel(x_ref, w_ref, y_ref, inv_ref, *, eps):
+    # per-row stats ride as (br, 1) trailing-unit refs — Mosaic rejects
+    # rank-1 blocks that are neither full-dim nor a 128-multiple
     x = x_ref[...].astype(jnp.float32)                 # (br, N)
     ms = jnp.mean(x * x, axis=1, keepdims=True)
     inv = jax.lax.rsqrt(ms + eps)                      # (br, 1)
     y_ref[...] = (x * inv * w_ref[...].astype(jnp.float32)).astype(y_ref.dtype)
-    inv_ref[...] = inv[:, 0]
+    inv_ref[...] = inv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
@@ -71,27 +70,27 @@ def rms_norm_pallas(x, w, eps, interpret):
 def _rms_fwd(x, w, eps, interpret):
     x2, r, n = _flatten_rows(x)
     br = min(_ROW_BLOCK, max(8, r))
-    x2p = _pad_rows(x2, br)
+    x2p = pad_rows(x2, br)
     rp = x2p.shape[0]
     y, inv = pl.pallas_call(
         functools.partial(_rms_fwd_kernel, eps=eps),
         grid=(rp // br,),
         in_specs=[
-            pl.BlockSpec((br, n), lambda i: (i, 0)),
-            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((br, n), lambda i: (i, _Z)),
+            pl.BlockSpec((1, n), lambda i: (_Z, _Z)),
         ],
         out_specs=[
-            pl.BlockSpec((br, n), lambda i: (i, 0)),
-            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br, n), lambda i: (i, _Z)),
+            pl.BlockSpec((br, 1), lambda i: (i, _Z)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rp, n), x.dtype),
-            jax.ShapeDtypeStruct((rp,), jnp.float32),
+            jax.ShapeDtypeStruct((rp, 1), jnp.float32),
         ],
         interpret=interpret,
     )(x2p, w.reshape(1, n))
     out = y[:r].reshape(x.shape)
-    return out, (x, w, inv[:r])
+    return out, (x, w, inv[:r, 0])
 
 
 def _rms_bwd(eps, interpret, res, dy):
@@ -133,8 +132,8 @@ def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, mu_ref, rstd_ref, *, eps):
     y = xc * rstd * w_ref[...].astype(jnp.float32) + b_ref[...].astype(
         jnp.float32)
     y_ref[...] = y.astype(y_ref.dtype)
-    mu_ref[...] = mu[:, 0]
-    rstd_ref[...] = rstd[:, 0]
+    mu_ref[...] = mu
+    rstd_ref[...] = rstd
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -146,30 +145,30 @@ def layer_norm_pallas(x, w, b, eps, interpret):
 def _ln_fwd(x, w, b, eps, interpret):
     x2, r, n = _flatten_rows(x)
     br = min(_ROW_BLOCK, max(8, r))
-    x2p = _pad_rows(x2, br)
+    x2p = pad_rows(x2, br)
     rp = x2p.shape[0]
     y, mu, rstd = pl.pallas_call(
         functools.partial(_ln_fwd_kernel, eps=eps),
         grid=(rp // br,),
         in_specs=[
-            pl.BlockSpec((br, n), lambda i: (i, 0)),
-            pl.BlockSpec((1, n), lambda i: (0, 0)),
-            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((br, n), lambda i: (i, _Z)),
+            pl.BlockSpec((1, n), lambda i: (_Z, _Z)),
+            pl.BlockSpec((1, n), lambda i: (_Z, _Z)),
         ],
         out_specs=[
-            pl.BlockSpec((br, n), lambda i: (i, 0)),
-            pl.BlockSpec((br,), lambda i: (i,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br, n), lambda i: (i, _Z)),
+            pl.BlockSpec((br, 1), lambda i: (i, _Z)),
+            pl.BlockSpec((br, 1), lambda i: (i, _Z)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rp, n), x.dtype),
-            jax.ShapeDtypeStruct((rp,), jnp.float32),
-            jax.ShapeDtypeStruct((rp,), jnp.float32),
+            jax.ShapeDtypeStruct((rp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rp, 1), jnp.float32),
         ],
         interpret=interpret,
     )(x2p, w.reshape(1, n), b.reshape(1, n))
     out = y[:r].reshape(x.shape)
-    return out, (x, w, b, mu[:r], rstd[:r])
+    return out, (x, w, b, mu[:r, 0], rstd[:r, 0])
 
 
 def _ln_bwd(eps, interpret, res, dy):

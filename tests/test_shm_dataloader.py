@@ -13,6 +13,25 @@ from paddle_tpu.io.shm_queue import (SENTINEL, ShmQueue, decode_batch,
                                      encode_batch)
 
 
+class _CrashingDataset(Dataset):
+    """Module-level so it pickles under the forkserver start method."""
+
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i >= 4:
+            os._exit(13)  # simulate hard worker death
+        return np.float32(i)
+
+
+class _LocalOnly:
+    """Unpicklable payload: forces the worker-startup failure path."""
+
+    def __reduce__(self):
+        raise TypeError("deliberately unpicklable")
+
+
 class TestShmQueue:
     def _pair(self, capacity=1 << 16):
         name = f"/ptpu_test_{os.getpid()}_{time.monotonic_ns()}"
@@ -132,17 +151,24 @@ class TestMultiprocessDataLoader:
             np.testing.assert_array_equal(g[1], r[1])
 
     def test_worker_crash_raises(self):
-        class Bad(Dataset):
-            def __len__(self):
-                return 8
-
-            def __getitem__(self, i):
-                if i >= 4:
-                    os._exit(13)  # simulate hard worker death
-                return np.float32(i)
-
-        loader = DataLoader(Bad(), batch_size=2, num_workers=2)
+        loader = DataLoader(_CrashingDataset(), batch_size=2, num_workers=2)
         loader.timeout = 3
         with pytest.raises(RuntimeError, match="worker"):
             for _ in loader:
                 pass
+
+    def test_unpicklable_dataset_warns_and_falls_back(self):
+        class Local(Dataset):
+            def __init__(self):
+                self.blocker = _LocalOnly()
+
+            def __len__(self):
+                return 6
+
+            def __getitem__(self, i):
+                return np.float32(i)
+
+        loader = DataLoader(Local(), batch_size=2, num_workers=2)
+        with pytest.warns(RuntimeWarning, match="thread prefetcher"):
+            got = [np.asarray(b) for b in loader]
+        assert sum(int(np.size(g)) for g in got) == 6
