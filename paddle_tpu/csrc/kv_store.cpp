@@ -187,8 +187,11 @@ struct Server {
       }
       if (!alive || !send_reply(fd, ret, reply)) break;
     }
-    ::close(fd);
+    // Mark done BEFORE closing: kv_server_stop shutdown()s fds of workers
+    // with done==false, and close-then-mark leaves a window where it could
+    // hit a closed (or recycled) descriptor.
     self->done.store(true);
+    ::close(fd);
   }
 
   void reap_finished() {  // caller holds workers_mu

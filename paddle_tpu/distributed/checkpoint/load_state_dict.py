@@ -74,7 +74,10 @@ def _assemble(reader: _ChunkReader, meta: Metadata, name: str,
               offset, shape, dtype) -> np.ndarray:
     """Fill one target box by copying every intersecting stored slice."""
     buf = np.zeros(shape, dtype=dtype)
-    covered = 0
+    # boolean mask, not an overlap-volume sum: stored chunks may overlap
+    # each other (replicated saves), and summing volumes would double-count
+    # and mask a genuine gap elsewhere in the target box
+    covered = np.zeros(shape, dtype=bool)
     for ov_off, ov_shape, cm, ci in get_read_items(meta, name, offset, shape):
         chunk = reader.read(ci)
         src = tuple(slice(o - co, o - co + l)
@@ -82,11 +85,12 @@ def _assemble(reader: _ChunkReader, meta: Metadata, name: str,
         dst = tuple(slice(o - to, o - to + l)
                     for o, l, to in zip(ov_off, ov_shape, offset))
         buf[dst] = chunk[src]
-        covered += int(np.prod(ov_shape))
-    if covered < int(np.prod(shape)):
+        covered[dst] = True
+    if not covered.all():
         raise ValueError(
-            f"checkpoint '{name}': stored chunks cover only {covered} of "
-            f"{int(np.prod(shape))} elements of target shard at {offset}")
+            f"checkpoint '{name}': stored chunks cover only "
+            f"{int(covered.sum())} of {int(np.prod(shape))} elements of "
+            f"target shard at {offset}")
     return buf
 
 

@@ -226,7 +226,10 @@ class Optimizer:
                 st = self._states.get(id(p))
                 if st:
                     for k, v in st.items():
-                        out[f"{p.name or i}.{k}"] = Tensor(v)
+                        # snapshot: the fused step donates state buffers to
+                        # XLA, so returning aliases would leave the captured
+                        # state_dict unreadable after the next step()
+                        out[f"{p.name or i}.{k}"] = Tensor(jnp.copy(v))
         return out
 
     def set_state_dict(self, state_dict):
@@ -456,8 +459,15 @@ class Lamb(Optimizer):
                 "beta1_pow": jnp.ones((), jnp.float32),
                 "beta2_pow": jnp.ones((), jnp.float32)}
 
+    def _decay_of(self, p) -> float:
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return 0.0
+        return self._wd_coeff()
+
     def _update(self, param, grad, state, lr, wd=None):
-        if wd is None or wd == 0.0:
+        # wd=0.0 is a valid "no decay" (excluded param); only None means
+        # "unset, use the constructor coefficient".
+        if wd is None:
             wd = self._wd_coeff()
         b1, b2 = self._beta1, self._beta2
         m1 = b1 * state["moment1"] + (1 - b1) * grad

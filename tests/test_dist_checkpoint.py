@@ -132,3 +132,33 @@ class TestSaveLoadReshard:
         dist.load_state_dict(tgt, ckpt_dir)
         np.testing.assert_allclose(np.asarray(w2._data),
                                    layer.weight.numpy(), rtol=1e-6)
+
+
+class TestCoverageMask:
+    def test_overlapping_chunks_cannot_mask_gap(self, tmp_path):
+        """Two stored chunks overlapping the same region must not mask a
+        genuine gap: volume-summing would count 8+8=16 >= 16 elements even
+        though rows 2-3 of a (4,4) target were never written."""
+        import json
+        import os
+        from paddle_tpu.distributed.checkpoint.load_state_dict import (
+            _assemble, _ChunkReader)
+        from paddle_tpu.distributed.checkpoint.metadata import (
+            LocalTensorIndex, LocalTensorMetadata, Metadata, TensorMetadata)
+
+        d = str(tmp_path / "ckpt_gap")
+        os.makedirs(d)
+        chunk = np.ones((2, 4), np.float32)
+        np.savez(os.path.join(d, "shard_0.npz"), a=chunk, b=chunk)
+        tm = TensorMetadata(global_shape=(4, 4), dtype="float32", chunks=[
+            (LocalTensorMetadata((0, 0), (2, 4), "float32"),
+             LocalTensorIndex("shard_0.npz", "a")),
+            (LocalTensorMetadata((0, 0), (2, 4), "float32"),
+             LocalTensorIndex("shard_0.npz", "b")),  # exact duplicate
+        ])
+        meta = Metadata(state_dict_metadata={"w": tm})
+        with open(os.path.join(d, "metadata.json"), "w") as f:
+            json.dump(meta.to_json(), f)
+        reader = _ChunkReader(d)
+        with pytest.raises(ValueError, match="cover only"):
+            _assemble(reader, meta, "w", (0, 0), (4, 4), np.float32)

@@ -66,6 +66,35 @@ def test_apply_decay_param_fun():
     np.testing.assert_allclose(w.numpy(), [1.0], rtol=1e-6)
 
 
+def test_lamb_exclude_from_weight_decay():
+    # excluded param with zero grad must stay exactly put (no decay)
+    w = paddle.nn.Parameter(np.array([1.0], np.float32), name="norm.bias")
+    v = paddle.nn.Parameter(np.array([1.0], np.float32), name="linear.weight")
+    opt = paddle.optimizer.Lamb(
+        0.1, lamb_weight_decay=0.5, parameters=[w, v],
+        exclude_from_weight_decay_fn=lambda p: "bias" in (p.name or ""))
+    w.grad = paddle.to_tensor([0.0])
+    v.grad = paddle.to_tensor([0.0])
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [1.0], rtol=1e-6)
+    assert v.numpy()[0] < 1.0  # non-excluded param does decay
+
+
+def test_state_dict_survives_fused_step():
+    # fused step donates state buffers; a state_dict captured before the
+    # next step must remain readable (snapshot, not alias)
+    w = paddle.nn.Parameter(np.array([1.0, 2.0], np.float32), name="w")
+    opt = paddle.optimizer.Adam(0.1, parameters=[w])
+    w.grad = paddle.to_tensor([0.1, 0.1])
+    opt.step()
+    sd = opt.state_dict()
+    w.grad = paddle.to_tensor([0.1, 0.1])
+    opt.step()  # donation would delete aliased buffers here
+    for k, val in sd.items():
+        if hasattr(val, "numpy"):
+            np.asarray(val.numpy())  # must not raise "Array has been deleted"
+
+
 def test_grad_clip_in_optimizer():
     w = paddle.nn.Parameter(np.array([1.0], np.float32))
     opt = paddle.optimizer.SGD(1.0, parameters=[w],
