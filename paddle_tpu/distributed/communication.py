@@ -119,8 +119,11 @@ def destroy_process_group(group=None):
     if group is None:
         _GROUPS.clear()
         _DEFAULT_GROUP[0] = None
+        _P2P_CHANNELS.clear()
     else:
         _GROUPS.pop(group.id, None)
+        for key in [k for k in _P2P_CHANNELS if k[0] == group.id]:
+            del _P2P_CHANNELS[key]
 
 
 def _is_tracer(x) -> bool:
@@ -317,68 +320,90 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     return gather_list
 
 
-def send(tensor, dst=0, group=None, sync_op=True):
+def send(tensor, dst=0, group=None, sync_op=True, tag=0):
     """P2P send (parity: dist.send). Inside shard_map this is a ppermute
     shift — the reference's batched isend/irecv pipeline pattern maps to a
-    single collective_permute on ICI (see fleet/meta_parallel p2p)."""
+    single collective_permute on ICI (see fleet/meta_parallel p2p).
+
+    SPMD semantics: (src=this group rank, dst) define a uniform ring shift
+    delta = dst - src; the shifted value is buffered on the channel keyed by
+    (group, delta, tag) and handed to the matching ``recv(src=..., tag=...)``
+    of the same trace. Explicit channel keys — NOT arrival order — pair the
+    two sides, so interleaved sends from several peers cannot mispair
+    (reference pairs by (peer, tag) in ProcessGroup::Send/Recv)."""
     arr = _unwrap(tensor)
     if _is_tracer(arr):
         g = group or _world_group()
         src = g.rank if g.rank >= 0 else 0
         n = g.nranks
+        delta = (dst - src) % n
         out = jax.lax.ppermute(arr, _axis(group),
-                               perm=[(i, (i + (dst - src)) % n)
+                               perm=[(i, (i + delta) % n)
                                      for i in range(n)])
-        _P2P_BUF.append(out)
+        chan = _P2P_CHANNELS.setdefault((g.id, delta, tag), deque())
+        # evict leftovers from earlier (aborted) traces so unmatched sends
+        # can't pin dead jaxprs for the process lifetime
+        cur_trace = getattr(out, "_trace", None)
+        while chan and getattr(chan[0], "_trace", None) is not cur_trace:
+            chan.popleft()
+        chan.append(out)
         return tensor
     return tensor
 
 
-# FIFO queue pairing in-trace send()s with the following recv()s; unmatched
-# entries from an aborted trace are discarded when a stale tracer is seen
+# per-channel FIFOs pairing in-trace send()s with recv()s: key is
+# (group id, ring shift, tag); unmatched entries from an aborted trace are
+# discarded when a stale tracer is seen
 from collections import deque  # noqa: E402
 
-_P2P_BUF: "deque" = deque()
+_P2P_CHANNELS: dict = {}
 
 
-def _pop_live_p2p(current):
-    """Pop the oldest buffered send from the SAME trace as ``current``;
-    discard leftovers from earlier (aborted) traces."""
+def _pop_live_p2p(chan: "deque", current):
+    """Pop the oldest buffered send on ``chan`` from the SAME trace as
+    ``current``; discard leftovers from earlier (aborted) traces."""
     cur_trace = getattr(current, "_trace", None)
-    while _P2P_BUF:
-        cand = _P2P_BUF.popleft()
+    while chan:
+        cand = chan.popleft()
         if getattr(cand, "_trace", None) is cur_trace:
             return cand
     return None
 
 
-def recv(tensor, src=0, group=None, sync_op=True):
+def recv(tensor, src=0, group=None, sync_op=True, tag=0):
     arr = _unwrap(tensor)
     if _is_tracer(arr):
         if not isinstance(tensor, Tensor):
             raise TypeError(
                 "recv/irecv write in place and require a Tensor wrapper; "
                 "got a raw array whose received value would be dropped")
-        buffered = _pop_live_p2p(arr)
-        if buffered is not None:
-            return _rewrap(tensor, buffered)
         g = group or _world_group()
         dstr = g.rank if g.rank >= 0 else 0
         n = g.nranks
+        delta = (dstr - src) % n
+        key = (g.id, delta, tag)
+        chan = _P2P_CHANNELS.get(key)  # read-only: don't allocate on the
+        buffered = None                # common pure-ppermute recv path
+        if chan is not None:
+            buffered = _pop_live_p2p(chan, arr)
+            if not chan:
+                _P2P_CHANNELS.pop(key, None)
+        if buffered is not None:
+            return _rewrap(tensor, buffered)
         out = jax.lax.ppermute(arr, _axis(group),
-                               perm=[(i, (i - (src - dstr)) % n)
+                               perm=[(i, (i + delta) % n)
                                      for i in range(n)])
         return _rewrap(tensor, out)
     return tensor
 
 
-def isend(tensor, dst=0, group=None):
-    send(tensor, dst, group)
+def isend(tensor, dst=0, group=None, tag=0):
+    send(tensor, dst, group, tag=tag)
     return _Task()
 
 
-def irecv(tensor, src=0, group=None):
-    recv(tensor, src, group)
+def irecv(tensor, src=0, group=None, tag=0):
+    recv(tensor, src, group, tag=tag)
     return _Task()
 
 
@@ -394,17 +419,19 @@ class _Task:
 
 
 class P2POp:
-    def __init__(self, op, tensor, peer, group=None):
+    def __init__(self, op, tensor, peer, group=None, tag=0):
         self.op = op
         self.tensor = tensor
         self.peer = peer
         self.group = group
+        self.tag = tag
 
 
 def batch_isend_irecv(p2p_op_list):
     tasks = []
     for op in p2p_op_list:
-        tasks.append(op.op(op.tensor, op.peer, op.group))
+        tasks.append(op.op(op.tensor, op.peer, op.group,
+                           tag=getattr(op, "tag", 0)))
     return [t if isinstance(t, _Task) else _Task() for t in tasks]
 
 
