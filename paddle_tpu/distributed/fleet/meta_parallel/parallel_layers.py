@@ -103,9 +103,15 @@ class PipelineLayer(Layer):
         self._loss_fn = loss_fn
         self._num_stages = num_stages or (
             topology.get_dim("pipe") if topology else 1)
+        self._num_virtual = num_virtual_pipeline_stages or 1
         self._recompute_interval = recompute_interval
         self.descs = list(layers)
-        bounds = SegmentLayers(self.descs, self._num_stages,
+        # with virtual pipeline stages the layer list is cut into
+        # num_stages*v chunks; chunk g runs on physical stage g % num_stages
+        # as its (g // num_stages)-th model chunk (reference pp_layers.py:237
+        # _construct_shared_comm / virtual partition)
+        bounds = SegmentLayers(self.descs,
+                               self._num_stages * self._num_virtual,
                                seg_method).do_segment()
         self.segment_parts = bounds
         self._shared = {}
@@ -125,18 +131,26 @@ class PipelineLayer(Layer):
             else:
                 raise TypeError(f"bad pipeline item {d!r}")
         self.run_function = LayerList(built)
+        n_parts = self._num_stages * self._num_virtual
         self._stage_layer_ranges = [
-            (bounds[i], bounds[i + 1]) for i in range(self._num_stages)]
+            (bounds[i], bounds[i + 1]) for i in range(n_parts)]
+        # set by the PipelineParallel engine: per-chunk NamedSharding so the
+        # stateful forward() can hop activations between stage sub-meshes
+        self._stage_shardings = None
 
     def get_num_stages(self):
         return self._num_stages
+
+    def get_num_chunks(self):
+        """Total virtual chunks (= num_stages when not interleaved)."""
+        return self._num_stages * self._num_virtual
 
     def stage_layers(self, stage_id: int):
         lo, hi = self._stage_layer_ranges[stage_id]
         return [self.run_function[i] for i in range(lo, hi)]
 
     def forward_stage(self, x, stage_id: int):
-        """Run one stage's chunk (used by the 1F1B engine). Items that are
+        """Run one chunk (used by the 1F1B engine). Items that are
         SharedLayerDesc with a forward_func use it (tied-embedding heads)."""
         lo, hi = self._stage_layer_ranges[stage_id]
         for i in range(lo, hi):
@@ -149,9 +163,36 @@ class PipelineLayer(Layer):
         return x
 
     def forward(self, x):
-        for s in range(self._num_stages):
-            x = self.forward_stage(x, s)
+        fetch = getattr(self, "_engine_fetch", None)
+        for s in range(self.get_num_chunks()):
+            x = self._hop(x, s)
+            if fetch is None:
+                x = self.forward_stage(x, s)
+            else:
+                # engine attached: chunk params (incl. shared/tied weights
+                # whose canonical copy lives on another sub-mesh) are
+                # fetched onto this chunk's sub-mesh before running
+                from ....nn.layer.layers import _swapped_state
+                with _swapped_state(self, fetch(s)):
+                    x = self.forward_stage(x, s)
         return x
+
+    def _hop(self, x, chunk: int):
+        """Eager cross-sub-mesh activation transfer for the stateful
+        ``forward`` path once the engine has placed chunk params on
+        disjoint sub-meshes (committed arrays on different devices cannot
+        meet in one eager op)."""
+        if not self._stage_shardings:
+            return x
+        import jax
+
+        from ....core.tensor import Tensor
+        sh = self._stage_shardings[chunk]
+        arr = x._data if isinstance(x, Tensor) else x
+        if getattr(arr, "sharding", None) == sh:
+            return x
+        moved = jax.device_put(arr, sh)
+        return Tensor(moved) if isinstance(x, Tensor) else moved
 
 
 class _FnLayer(Layer):

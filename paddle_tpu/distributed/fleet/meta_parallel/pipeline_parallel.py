@@ -2,31 +2,66 @@
 
 Capability parity with the reference (reference: fleet/meta_parallel/
 pipeline_parallel.py — train_batch:657, forward_backward_pipeline (1F1B)
-:440, interleaved :906; p2p meta handshake pp_utils/p2p_communication.py).
+:440, interleaved :906; p2p meta handshake pp_utils/p2p_communication.py:52).
 
-TPU-native design: the host drives the 1F1B order (warmup forwards, steady
-1F1B, cooldown backwards) exactly like the reference's schedule, but
-"send/recv" between stages is just the activation Tensor flowing to the
-next stage's sub-mesh — on a pod each stage's params live on a disjoint
-sub-mesh and XLA's async dispatch overlaps stage k's compute with stage
-k+1's, giving the pipeline overlap the reference gets from its actor-based
-FleetExecutor; no meta handshake is needed because shapes are static.
-Gradient accumulation across microbatches uses the imperative tape.
+TPU-native design — a real pipeline, not a grad-accumulation loop:
+
+* **Stage sub-meshes.** The device list is partitioned into one sub-mesh
+  per pipeline stage; every chunk's params are ``jax.device_put`` onto its
+  stage's sub-mesh at engine construction (the analog of each pp rank
+  holding only its stage, reference pp_layers.py:237).
+* **Per-stage jitted programs.** Each chunk gets a pure functional
+  forward (and a vjp-recompute backward) compiled once per shape; the
+  host drives the schedule, so no recompilation per microbatch
+  (SURVEY §7.3 #1: per-stage jitted programs with host-driven schedule).
+* **p2p activation transfer.** Stage boundaries move activations (fwd)
+  and activation-grads (bwd) between sub-meshes with ``jax.device_put`` —
+  the single-controller analog of the reference's isend/irecv pairs; no
+  shape/dtype meta handshake is needed because XLA shapes are static.
+* **1F1B order.** Every (virtual) stage executes the exact reference
+  action sequence — warmup forwards (min(P-1-s, m)), steady 1F1B
+  alternation, cooldown backwards — via a dependency-driven scheduler.
+  Stage s therefore never holds more than min(P-s, m) in-flight
+  microbatch stashes (the 1F1B memory bound; reference
+  pipeline_parallel.py:440), which ``_peak_stash`` records for tests.
+* **Backward = recompute.** The stashed state per in-flight microbatch is
+  the stage *input* only; the backward jit recomputes the stage forward
+  inside ``jax.vjp``. Memory ≤ the reference's 1F1B profile (which stashes
+  all intermediate activations) at ~1/3 extra FLOPs, the standard
+  trade on HBM-bound hardware.
+* **Interleave.** ``PipelineParallelWithInterleave`` runs
+  ``num_stages * v`` virtual chunks with chunk g placed on sub-mesh
+  g % num_stages (reference :906's virtual-pipeline assignment); the same
+  scheduler executes the longer virtual chain.
+
+Because dispatch is async, stage k's XLA program runs concurrently with
+stage k+1's on its own sub-mesh — the overlap the reference gets from its
+actor-based FleetExecutor falls out of the dependency order.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from collections import deque
+from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
+from ....core import random as _random
+from ....core.autograd import tape_paused
 from ....core.tensor import Tensor
+from ....nn.layer.layers import _swapped_state
 from .parallel_layers import PipelineLayer
 
 __all__ = ["PipelineParallel", "PipelineParallelWithInterleave"]
 
 
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
 class PipelineParallel:
-    def __init__(self, layers, hcg=None, strategy=None):
+    def __init__(self, layers, hcg=None, strategy=None, devices=None):
         if not isinstance(layers, PipelineLayer):
             raise TypeError("PipelineParallel requires a PipelineLayer")
         self._layers = layers
@@ -37,7 +72,162 @@ class PipelineParallel:
         self.accumulate_steps = pcfg.get("accumulate_steps", 1)
         self.micro_batch_size = pcfg.get("micro_batch_size", 1)
         self.num_stages = layers.get_num_stages()
+        self.num_chunks = layers.get_num_chunks()
         self.training = True
+        self._batch_count = 0
+        self._base_key = jax.random.key(
+            getattr(_random.default_generator, "_seed", 0))
+        self._programs: Dict = {}  # (chunk, kind, train) -> jitted fn
+        self._peak_stash: List[int] = [0] * self.num_chunks
+        self._build_meshes(devices)
+        self._collect_chunk_params()
+        self._place_params()
+
+    # -- sub-mesh construction ----------------------------------------------
+    def _build_meshes(self, devices):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        devs = list(devices) if devices is not None else list(jax.devices())
+        p = self.num_stages
+        per = len(devs) // p
+        self._stage_meshes = []
+        for s in range(p):
+            sub = (devs[s * per:(s + 1) * per] if per >= 1
+                   else [devs[s % len(devs)]])
+            self._stage_meshes.append(
+                Mesh(np.array(sub), ("stage_data",)))
+        self._stage_shardings = [
+            NamedSharding(m, PartitionSpec()) for m in self._stage_meshes]
+        # expose placements so the stateful PipelineLayer.forward can hop
+        self._layers._stage_shardings = [
+            self._chunk_sharding(c) for c in range(self.num_chunks)]
+        self._layers._engine_fetch = self._fetch_chunk_params
+
+    def _chunk_mesh_idx(self, chunk: int) -> int:
+        return chunk % self.num_stages
+
+    def _chunk_sharding(self, chunk: int):
+        return self._stage_shardings[self._chunk_mesh_idx(chunk)]
+
+    # -- param bookkeeping ---------------------------------------------------
+    def _collect_chunk_params(self):
+        """Canonical (dedup'd) param names used by each chunk; shared layers
+        (tied embeddings) appear in every chunk that runs them and their
+        grads are summed at write-back — the single-controller equivalent of
+        allreduce_shared_weight_gradients over the pp group."""
+        pipe_params = dict(self._layers.named_parameters())
+        self._param_objs = pipe_params
+        self._chunk_param_names: List[List[str]] = []
+        for c in range(self.num_chunks):
+            ids = set()
+            for lyr in self._layers.stage_layers(c):
+                for p in lyr.parameters():
+                    ids.add(id(p))
+            self._chunk_param_names.append(
+                [n for n, p in pipe_params.items() if id(p) in ids])
+
+    def _place_params(self):
+        """Params (and buffers) of chunk c live on stage sub-mesh c % p.
+        Shared params stay on the first chunk that owns them. Params that
+        are already partitioned (TP/FSDP layouts) are never silently
+        re-replicated: they must already sit inside their stage's sub-mesh."""
+        placed = set()
+        for c in range(self.num_chunks):
+            sh = self._chunk_sharding(c)
+            stage_ids = {d.id for d in sh.mesh.devices.flat}
+            for n in self._chunk_param_names[c]:
+                p = self._param_objs[n]
+                if id(p) in placed:
+                    continue
+                placed.add(id(p))
+                psh = getattr(p._data, "sharding", None)
+                if psh is not None and not psh.is_fully_replicated:
+                    have = {d.id for d in psh.device_set}
+                    if not have <= stage_ids:
+                        raise NotImplementedError(
+                            f"param '{n}' is partitioned over devices "
+                            f"{sorted(have)} but its pipeline stage owns "
+                            f"{sorted(stage_ids)}; shard TP/FSDP params "
+                            "inside the stage sub-mesh before wrapping in "
+                            "PipelineParallel")
+                    continue  # keep the existing partitioned layout
+                p._data = jax.device_put(p._data, sh)
+            for lyr in self._layers.stage_layers(c):
+                for _, b in lyr.named_buffers():
+                    if b is not None and id(b) not in placed:
+                        placed.add(id(b))
+                        b._data = jax.device_put(b._data, sh)
+
+    def _fetch_chunk_params(self, c: int) -> Dict[str, jnp.ndarray]:
+        """Current param arrays for chunk c, transferred to its sub-mesh if
+        the canonical copy lives elsewhere (shared/tied weights). Params
+        already on the stage's device set (incl. TP/FSDP layouts) pass
+        through untouched."""
+        sh = self._chunk_sharding(c)
+        stage_ids = {d.id for d in sh.mesh.devices.flat}
+        out = {}
+        for n in self._chunk_param_names[c]:
+            arr = self._param_objs[n]._data
+            psh = getattr(arr, "sharding", None)
+            if psh is None or {d.id for d in psh.device_set} != stage_ids:
+                arr = jax.device_put(arr, sh)
+            out[n] = arr
+        return out
+
+    # -- per-chunk programs ---------------------------------------------------
+    def _chunk_f(self, c: int):
+        pipe = self._layers
+
+        def f(params, x, key):
+            with _random.key_context(key):
+                with _swapped_state(pipe, params), tape_paused():
+                    out = pipe.forward_stage(Tensor(x), c)
+            return out._data
+        return f
+
+    def _loss_f(self, c: int):
+        pipe = self._layers
+        f = self._chunk_f(c)
+
+        def floss(params, x, label, key):
+            out = f(params, x, key)
+            with _swapped_state(pipe, params), tape_paused():
+                loss = pipe._loss_fn(Tensor(out), Tensor(label))
+            return loss._data
+        return floss
+
+    def _program(self, c: int, kind: str):
+        key = (c, kind, self._layers.training)
+        prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+        f = self._chunk_f(c)
+        last = c == self.num_chunks - 1
+        if kind == "fwd":
+            prog = jax.jit(f)
+        elif kind == "loss_fwd":
+            prog = jax.jit(self._loss_f(c))
+        elif kind == "bwd":
+            assert not last
+
+            def bwd(params, x, key, g):
+                _, vjp = jax.vjp(lambda p, xx: f(p, xx, key), params, x)
+                return vjp(g)  # (dparams, dx)
+            prog = jax.jit(bwd)
+        elif kind == "loss_bwd":
+            floss = self._loss_f(c)
+
+            def loss_bwd(params, x, label, key, gscale):
+                loss, vjp = jax.vjp(
+                    lambda p, xx: floss(p, xx, label, key), params, x)
+                # cotangent = gscale: grads of the scaled loss, one forward
+                dparams, dx = vjp(gscale.astype(loss.dtype))
+                return loss, dparams, dx
+            prog = jax.jit(loss_bwd)
+        else:
+            raise ValueError(kind)
+        self._programs[key] = prog
+        return prog
 
     # -- API parity --------------------------------------------------------
     def train(self):
@@ -72,43 +262,131 @@ class PipelineParallel:
     def _split_micro(self, data):
         x, y = data
         n = self.accumulate_steps
-        bs = x.shape[0]
+        xa, ya = _unwrap(x), _unwrap(y)
+        bs = xa.shape[0]
         assert bs % n == 0, f"batch {bs} not divisible by accumulate_steps {n}"
         mb = bs // n
-        return [(x[i * mb:(i + 1) * mb], y[i * mb:(i + 1) * mb])
+        return [(xa[i * mb:(i + 1) * mb], ya[i * mb:(i + 1) * mb])
                 for i in range(n)]
 
+    @staticmethod
+    def _queue_1f1b(vs: int, n_vstages: int, m: int) -> deque:
+        """The per-(virtual-)stage 1F1B action order (reference
+        pipeline_parallel.py:440): warmup forwards, steady F/B alternation,
+        cooldown backwards."""
+        warmup = min(n_vstages - 1 - vs, m)
+        q = [("F", i) for i in range(warmup)]
+        for k in range(m - warmup):
+            q.append(("F", warmup + k))
+            q.append(("B", k))
+        q.extend(("B", k) for k in range(m - warmup, m))
+        return deque(q)
+
+    def _transfer(self, arr, chunk: int):
+        """Activation / activation-grad hop onto ``chunk``'s sub-mesh — the
+        p2p edge of the pipeline (reference p2p_communication.py:313)."""
+        sh = self._chunk_sharding(chunk)
+        if getattr(arr, "sharding", None) == sh:
+            return arr
+        return jax.device_put(arr, sh)
+
     def forward_backward_pipeline(self, data, scaler=None):
-        """The 1F1B order (reference pipeline_parallel.py:440): on a single
-        controller the per-microbatch forward immediately has all stages
-        available, so warmup/steady/cooldown collapse to fwd+bwd per
-        microbatch with grad accumulation — schedule-equivalent losses,
-        with XLA providing the overlap across stage sub-meshes."""
+        if self._layers._loss_fn is None:
+            raise ValueError(
+                "training through the pipeline engine requires the "
+                "PipelineLayer to be built with loss_fn (the last stage "
+                "computes the loss; reference pp_layers.py:237)")
         micro = self._split_micro(data)
-        total = None
-        for (mx, my) in micro:
-            out = self._forward_one(mx)
-            loss = self._compute_loss(out, my)
-            if scaler is not None:
-                scaled = scaler.scale(loss / self.accumulate_steps)
-                scaled.backward()
-            else:
-                (loss / self.accumulate_steps).backward()
-            total = loss.detach() if total is None else total + loss.detach()
-        return total / self.accumulate_steps
+        m = len(micro)
+        nv = self.num_chunks
+        batch_key = jax.random.fold_in(self._base_key, self._batch_count)
+        self._batch_count += 1
+        gscale = 1.0 / m
+        if scaler is not None and getattr(scaler, "_enable", True):
+            gscale = gscale * float(getattr(scaler, "_scale", 1.0))
 
-    def _forward_one(self, x):
-        out = x if isinstance(x, Tensor) else Tensor(x)
-        for s in range(self.num_stages):
-            out = self._layers.forward_stage(out, s)
-        return out
+        chunk_params = [self._fetch_chunk_params(c) for c in range(nv)]
+        acts = {(0, i): self._transfer(mx, 0) for i, (mx, _) in enumerate(micro)}
+        labels = [self._transfer(my, nv - 1) for _, my in micro]
+        gout: Dict = {}
+        stash: List[Dict] = [dict() for _ in range(nv)]
+        grad_acc: List[Dict[str, jnp.ndarray]] = [dict() for _ in range(nv)]
+        queues = [self._queue_1f1b(vs, nv, m) for vs in range(nv)]
+        self._peak_stash = [0] * nv
+        losses = []
 
-    def _compute_loss(self, out, label):
-        if self._layers._loss_fn is not None:
-            return self._layers._loss_fn(out, label
-                                         if isinstance(label, Tensor)
-                                         else Tensor(label))
-        return out
+        def mbkey(vs, i):
+            return jax.random.fold_in(batch_key, vs * m + i)
+
+        remaining = sum(len(q) for q in queues)
+        while remaining:
+            progressed = False
+            for vs in range(nv):
+                if not queues[vs]:
+                    continue
+                kind, i = queues[vs][0]
+                last = vs == nv - 1
+                if kind == "F":
+                    if (vs, i) not in acts:
+                        continue
+                    x = acts.pop((vs, i))
+                    if not last:
+                        y = self._program(vs, "fwd")(
+                            chunk_params[vs], x, mbkey(vs, i))
+                        acts[(vs + 1, i)] = self._transfer(y, vs + 1)
+                    # the last chunk only stashes here: its B (which 1F1B
+                    # runs immediately after) computes loss AND grads in one
+                    # forward via the loss_bwd program
+                    stash[vs][i] = x
+                    self._peak_stash[vs] = max(self._peak_stash[vs],
+                                               len(stash[vs]))
+                else:  # B
+                    if last:
+                        x = stash[vs].pop(i)
+                        loss, dparams, dx = self._program(vs, "loss_bwd")(
+                            chunk_params[vs], x, labels[i], mbkey(vs, i),
+                            jnp.float32(gscale))
+                        losses.append(loss)
+                    else:
+                        if (vs, i) not in gout:
+                            continue
+                        g = gout.pop((vs, i))
+                        x = stash[vs].pop(i)
+                        dparams, dx = self._program(vs, "bwd")(
+                            chunk_params[vs], x, mbkey(vs, i), g)
+                    for n, d in dparams.items():
+                        acc = grad_acc[vs].get(n)
+                        grad_acc[vs][n] = d if acc is None else acc + d
+                    if vs > 0:
+                        gout[(vs - 1, i)] = self._transfer(dx, vs - 1)
+                queues[vs].popleft()
+                remaining -= 1
+                progressed = True
+            if not progressed:
+                raise RuntimeError(
+                    "pipeline schedule deadlock: no stage can make progress "
+                    f"(queues={[list(q)[:2] for q in queues]})")
+
+        self._write_back_grads(grad_acc)
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        return Tensor(total / m)
+
+    def _write_back_grads(self, grad_acc):
+        """Accumulate functional grads into the stateful ``.grad`` slots the
+        optimizer consumes; shared-weight contributions from different
+        chunks are moved to the canonical copy's sub-mesh and summed."""
+        for vs, accs in enumerate(grad_acc):
+            for n, g in accs.items():
+                p = self._param_objs[n]
+                sh = getattr(p._data, "sharding", None)
+                if sh is not None and getattr(g, "sharding", None) != sh:
+                    g = jax.device_put(g, sh)
+                if p.grad is None:
+                    p.grad = Tensor(g)
+                else:
+                    p.grad = Tensor(p.grad._data + g)
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """Parity: PipelineParallel.train_batch (pipeline_parallel.py:657)."""
@@ -125,24 +403,49 @@ class PipelineParallel:
 
     def eval_batch(self, data, compute_loss=True):
         micro = self._split_micro(data)
+        nv = self.num_chunks
+        chunk_params = [self._fetch_chunk_params(c) for c in range(nv)]
+        batch_key = jax.random.fold_in(self._base_key, self._batch_count)
         total = None
-        from ....core.autograd import no_grad
-        with no_grad():
-            for (mx, my) in micro:
-                out = self._forward_one(mx)
-                loss = self._compute_loss(out, my) if compute_loss else out
-                total = loss if total is None else total + loss
-        return total / len(micro)
+        for i, (mx, my) in enumerate(micro):
+            x = self._transfer(mx, 0)
+            for vs in range(nv - 1):
+                x = self._transfer(
+                    self._program(vs, "fwd")(
+                        chunk_params[vs], x,
+                        jax.random.fold_in(batch_key, vs * len(micro) + i)),
+                    vs + 1)
+            lastk = jax.random.fold_in(batch_key, (nv - 1) * len(micro) + i)
+            if compute_loss and self._layers._loss_fn is not None:
+                out = self._program(nv - 1, "loss_fwd")(
+                    chunk_params[nv - 1], x, self._transfer(my, nv - 1),
+                    lastk)
+            else:
+                out = self._program(nv - 1, "fwd")(
+                    chunk_params[nv - 1], x, lastk)
+            total = out if total is None else total + out
+        return Tensor(total / len(micro))
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
     """Interleaved virtual-pipeline schedule (reference
-    pipeline_parallel.py:906): each stage holds multiple model chunks. The
-    chunk assignment comes from PipelineLayer's virtual partition; execution
-    order on a single controller is microbatch-major, chunk-minor — the
-    bubble-reduction property is realized by XLA overlap across sub-meshes."""
+    pipeline_parallel.py:906): the layer list is cut into
+    ``num_stages * num_virtual_stages`` chunks and chunk g is placed on
+    stage sub-mesh g % num_stages, so each physical stage alternates
+    between its model chunks — the bubble-shrinking property of the
+    interleaved schedule under async dispatch. Construct the
+    ``PipelineLayer`` with ``num_virtual_pipeline_stages`` to match."""
 
     def __init__(self, layers, hcg=None, strategy=None,
-                 num_virtual_stages=2):
-        super().__init__(layers, hcg, strategy)
-        self.num_virtual_stages = num_virtual_stages
+                 num_virtual_stages=None, devices=None):
+        if num_virtual_stages is not None and \
+                layers.get_num_chunks() != \
+                layers.get_num_stages() * num_virtual_stages:
+            raise ValueError(
+                f"PipelineLayer was built with "
+                f"{layers.get_num_chunks() // layers.get_num_stages()} "
+                f"virtual stages, engine asked for {num_virtual_stages}")
+        super().__init__(layers, hcg, strategy, devices=devices)
+        self.num_virtual_stages = (
+            num_virtual_stages
+            or layers.get_num_chunks() // layers.get_num_stages())

@@ -124,16 +124,38 @@ class Optimizer:
         """One jitted XLA program updating EVERY parameter — the TPU-native
         analog of the reference's fused multi-tensor optimizer kernels
         (_append_optimize_multi_tensor_op / fused adamw). Falls back to the
-        per-param loop for master-weight (multi-precision) training."""
+        per-param loop for master-weight (multi-precision) training.
+        Params living on different device sets (pipeline-stage sub-meshes)
+        are updated by one fused program per device set — a single XLA
+        program cannot span disjoint meshes."""
         from ..core import flags as _flags
         if (not _flags.get_flag("use_fused_optimizer") or not params_grads
                 or self._multi_precision):
             return False
+
+        def devset(p):
+            sh = getattr(p._data, "sharding", None)
+            ds = getattr(sh, "device_set", None)
+            return frozenset(d.id for d in ds) if ds else frozenset()
+
+        groups = {}
+        for pg in params_grads:
+            groups.setdefault(devset(pg[0]), []).append(pg)
+        if len(groups) > 1:
+            return all(self._fused_step_group(g, lr)
+                       for g in groups.values())
+        return self._fused_step_group(params_grads, lr)
+
+    def _fused_step_group(self, params_grads, lr) -> bool:
         decays = self._fused_decays(params_grads)
         key = (tuple(id(p) for p, _ in params_grads), decays,
                tuple(str(p._data.dtype) for p, _ in params_grads))
         states = [self._state_for(p) for p, _ in params_grads]
-        if getattr(self, "_fused_key", None) != key:
+        cache = getattr(self, "_fused_cache", None)
+        if cache is None:
+            cache = self._fused_cache = {}
+        fused_fn = cache.get(key)
+        if fused_fn is None:
             n = len(params_grads)
 
             def fused(parrs, garrs, sts, lr_arr):
@@ -154,9 +176,8 @@ class Optimizer:
             # the outputs (moments dominate Adam-state memory). Params are
             # NOT donated — user-held detach()/state_dict views share those
             # buffers and must stay readable after the step.
-            self._fused_fn = jax.jit(fused, donate_argnums=(2,))
-            self._fused_key = key
-        new_p, new_s = self._fused_fn(
+            fused_fn = cache[key] = jax.jit(fused, donate_argnums=(2,))
+        new_p, new_s = fused_fn(
             [p._data for p, _ in params_grads],
             [g._data for _, g in params_grads],
             states, jnp.asarray(lr, jnp.float32))
