@@ -35,13 +35,18 @@ def set_op_profile_hook(fn) -> None:
     global _op_profile_hook
     _op_profile_hook = fn
 
-# name -> {"xla": fn, "pallas": fn}; selection by FLAGS_use_pallas_kernels.
+# Back-compat view over the single-source op table (core/op_registry.py):
+# OP_REGISTRY[name] is the SAME dict object as OPS[name].impls.
+from .op_registry import OPS, get_op_def  # noqa: E402
+
 OP_REGISTRY: Dict[str, Dict[str, Callable]] = {}
 
 
 def register_op_impl(name: str, impl: str = "xla"):
     def deco(fn):
-        OP_REGISTRY.setdefault(name, {})[impl] = fn
+        d = get_op_def(name)
+        d.impls[impl] = fn
+        OP_REGISTRY[name] = d.impls
         return fn
     return deco
 
@@ -71,6 +76,7 @@ def run_op(
     operands: Sequence[Any],
     num_nondiff_outputs: int = 0,
     out_stop_gradient: Optional[bool] = None,
+    attrs: Optional[dict] = None,
 ):
     """Execute one op.
 
@@ -79,22 +85,23 @@ def run_op(
     arrays, numpy values, or python scalars; non-Tensor operands are treated
     as constants. The trailing ``num_nondiff_outputs`` outputs (e.g. argmax
     indices, softmax_lse) get zero cotangents routed automatically by the
-    tape and are marked stop_gradient.
+    tape and are marked stop_gradient. ``attrs`` are the op's static
+    attributes, forwarded to its SPMD rule (the ops.yaml attr pack analog).
     """
     if _op_profile_hook is not None:
         import time as _time
         _t0 = _time.perf_counter()
         try:
             return _run_op_impl(name, jax_fn, operands, num_nondiff_outputs,
-                                out_stop_gradient)
+                                out_stop_gradient, attrs)
         finally:
             _op_profile_hook(name, _t0, _time.perf_counter())
     return _run_op_impl(name, jax_fn, operands, num_nondiff_outputs,
-                        out_stop_gradient)
+                        out_stop_gradient, attrs)
 
 
 def _run_op_impl(name, jax_fn, operands, num_nondiff_outputs,
-                 out_stop_gradient):
+                 out_stop_gradient, attrs=None):
     arrays = [_unwrap(o) for o in operands]
 
     cast_to = amp_state.amp_cast_dtype(name)
@@ -144,6 +151,18 @@ def _run_op_impl(name, jax_fn, operands, num_nondiff_outputs,
     single = not isinstance(outs, tuple)
     out_list = (outs,) if single else outs
 
+    # explicit SPMD rule (the dist branch of the generated op fn,
+    # dist_api_gen.py:46): when an operand carries a dist_attr and the op
+    # has a registered rule, infer output placements, steer XLA with a
+    # sharding constraint on traced values, and propagate dist_attr.
+    out_attrs = None
+    if _flags.get_flag("use_spmd_rules"):
+        prop = _spmd_propagate(name, operands, arrays, out_list, attrs)
+        if prop is not None:
+            out_list, out_attrs = prop
+            if single:
+                outs = out_list[0]
+
     if _flags.get_flag("check_nan_inf"):
         _check_finite(name, out_list)
 
@@ -158,8 +177,84 @@ def _run_op_impl(name, jax_fn, operands, num_nondiff_outputs,
         if node is not None and not nondiff:
             t._node = node
             t._out_idx = i
+        if out_attrs is not None and i < len(out_attrs):
+            t.dist_attr = out_attrs[i]
         wrapped.append(t)
     return wrapped[0] if single else tuple(wrapped)
+
+
+def _spmd_propagate(name, operands, arrays, out_list, attrs):
+    """Apply the op's explicit SPMD rule. Returns (new_out_list, per-output
+    DistAttrs) or None when no dist input / no rule / rule bails."""
+    first_da = None
+    for o in operands:
+        da = getattr(o, "dist_attr", None)
+        if da is not None:
+            if any(p.is_partial() for p in da.placements):
+                return None  # stacked-partial tensors go through reshard
+            if first_da is None:
+                first_da = da
+    if first_da is None:
+        return None
+    opdef = OPS.get(name)
+    rule_name = getattr(opdef, "spmd_rule", None)
+    if rule_name is None:
+        return None
+    from ..distributed.auto_parallel.spmd_rules import (DistTensorSpec,
+                                                        replicated)
+    from ..distributed.auto_parallel.spmd_rules import SPMD_RULES
+    rule = SPMD_RULES.get(rule_name)
+    if rule is None:
+        return None
+    mesh = first_da.process_mesh
+    specs = []
+    for o, a in zip(operands, arrays):
+        shape = tuple(getattr(a, "shape", ()))
+        da = getattr(o, "dist_attr", None)
+        if da is not None and da.process_mesh == mesh:
+            specs.append(DistTensorSpec(
+                shape, _placements_to_dims_mapping(da.placements, len(shape))))
+        else:
+            specs.append(replicated(shape))
+    try:
+        _, out_specs = rule.infer_forward(*specs, **(attrs or {}))
+    except Exception:
+        return None  # rule doesn't fit this call shape: let GSPMD decide
+    from ..distributed.auto_parallel.api import DistAttr
+    from ..distributed.process_mesh import Replicate, Shard
+    new_outs, out_attrs = [], []
+    tracing = any(isinstance(o, jax.core.Tracer) for o in out_list)
+    for o, spec in zip(out_list, list(out_specs) + [None] * len(out_list)):
+        if spec is None or tuple(getattr(o, "shape", ())) != spec.shape:
+            new_outs.append(o)
+            out_attrs.append(None)
+            continue
+        placements = [Replicate()] * mesh.ndim
+        for tdim, ax in enumerate(spec.dims_mapping):
+            if ax != -1:
+                placements[ax] = Shard(tdim)
+        # Partial never surfaces on the global-array substrate: XLA inserts
+        # the reduction; the metadata records Replicate for those axes.
+        if tracing and isinstance(o, jax.core.Tracer):
+            from jax.sharding import NamedSharding
+            from ..distributed.process_mesh import placements_to_spec
+            pspec = placements_to_spec(placements, mesh.dim_names)
+            try:
+                o = jax.lax.with_sharding_constraint(
+                    o, NamedSharding(mesh.to_jax(), pspec))
+            except Exception:
+                pass  # e.g. mesh devices unavailable under this trace
+        new_outs.append(o)
+        out_attrs.append(DistAttr(mesh, placements))
+    return tuple(new_outs), out_attrs
+
+
+def _placements_to_dims_mapping(placements, ndim):
+    m = [-1] * ndim
+    for ax, p in enumerate(placements):
+        if p.is_shard() and 0 <= p.get_dim() < ndim:
+            m[p.get_dim()] = ax
+    return tuple(m)
 
 
 _pallas_loaded = False
@@ -181,7 +276,8 @@ def select_impl(name: str):
     paddle/phi/core/kernel_factory.h:326 — XLA subsumes backend/dtype keys.)"""
     if _flags.get_flag("use_pallas_kernels"):
         _load_pallas_impls()
-    impls = OP_REGISTRY.get(name, {})
+    d = OPS.get(name)
+    impls = d.impls if d is not None else {}
     if _flags.get_flag("use_pallas_kernels") and "pallas" in impls:
         return impls["pallas"]
     if "xla" in impls:

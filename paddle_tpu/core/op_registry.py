@@ -1,0 +1,95 @@
+"""Single-source op registry: one table per op.
+
+Capability parity with the reference's YAML op schema
+(reference: paddle/phi/api/yaml/ops.yaml — each op declares args,
+``infer_meta``, ``kernel``, ``backward`` and optionally ``spmd_rule``; five
+code generators fan it out into the C++ API / autograd / pybind / PIR
+dialect, §2.3 of SURVEY.md). The TPU-native build needs no codegen: the
+table itself is the registry, and the dispatch funnel (core/dispatch.py)
+reads it at call time.
+
+Per op:
+  impls       {"xla": fn, "pallas": fn} — implementation selection
+              (KernelFactory analog; XLA subsumes backend/dtype keys)
+  shape_rule  optional (*jax.ShapeDtypeStruct, **attrs) -> output shapes
+              (infer_meta analog; ``jax.eval_shape`` is the fallback)
+  vjp         "auto" (jax.vjp of the impl), "custom" (impl carries a
+              custom_vjp), or a callable vjp rule
+  spmd_rule   name in the SPMD-rule registry
+              (distributed/auto_parallel/spmd_rules.py), the ops.yaml
+              ``spmd_rule:`` field analog
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Union
+
+__all__ = ["OpDef", "OPS", "register_op", "get_op_def", "infer_shape"]
+
+
+@dataclass
+class OpDef:
+    name: str
+    impls: Dict[str, Callable] = field(default_factory=dict)
+    shape_rule: Optional[Callable] = None
+    vjp: Union[str, Callable] = "auto"
+    spmd_rule: Optional[str] = None
+
+
+OPS: Dict[str, OpDef] = {}
+
+
+def get_op_def(name: str) -> OpDef:
+    d = OPS.get(name)
+    if d is None:
+        d = OPS[name] = OpDef(name)
+    return d
+
+
+def register_op(name: str, impl: Optional[Callable] = None,
+                impl_kind: str = "xla", shape_rule: Optional[Callable] = None,
+                vjp: Union[str, Callable, None] = None,
+                spmd_rule: Optional[str] = None) -> OpDef:
+    """Create/extend the op's table row (fields merge, never clobber with
+    None)."""
+    d = get_op_def(name)
+    if impl is not None:
+        d.impls[impl_kind] = impl
+    if shape_rule is not None:
+        d.shape_rule = shape_rule
+    if vjp is not None:
+        d.vjp = vjp
+    if spmd_rule is not None:
+        d.spmd_rule = spmd_rule
+    return d
+
+
+def infer_shape(name: str, *args, **attrs):
+    """Run the op's shape rule; fall back to jax.eval_shape of the xla impl
+    (the generated-infer-meta analog: one shared shape path for eager and
+    traced execution)."""
+    import jax
+
+    d = OPS.get(name)
+    if d is not None and d.shape_rule is not None:
+        return d.shape_rule(*args, **attrs)
+    if d is not None and "xla" in d.impls:
+        return jax.eval_shape(lambda *a: d.impls["xla"](*a), *args)
+    raise KeyError(f"no shape rule or xla impl registered for op '{name}'")
+
+
+# -- spmd_rule bindings for ops whose call sites predate the table --------
+# (the rules themselves live in distributed/auto_parallel/spmd_rules.py;
+# rule names match dispatch names, so binding is 1:1 unless stated)
+_DEFAULT_SPMD_BINDINGS = [
+    "matmul", "linear", "fused_linear", "add", "subtract", "multiply",
+    "divide", "maximum", "minimum", "pow", "where", "clip", "lerp", "scale",
+    "cast", "gelu", "relu", "silu", "tanh", "sigmoid", "dropout", "swiglu",
+    "sum", "mean", "max", "min", "prod", "logsumexp", "transpose", "reshape",
+    "flatten", "squeeze", "unsqueeze", "softmax", "log_softmax", "concat",
+    "split", "embedding", "cross_entropy", "flash_attention", "layer_norm",
+    "rms_norm", "group_norm", "fused_rope", "moe_dispatch", "moe_combine",
+]
+for _n in _DEFAULT_SPMD_BINDINGS:
+    get_op_def(_n).spmd_rule = _n
+del _n

@@ -1,0 +1,458 @@
+"""Explicit per-op SPMD (sharding-propagation) rules.
+
+Capability parity with the reference's rule registry
+(reference: paddle/phi/infermeta/spmd_rules/ — ~34 rules registered in
+rules.cc, invoked from the YAML ``spmd_rule:`` field by the generated dist
+branch, dist_api_gen.py:46). Each rule maps input ``DistTensorSpec``s (+ op
+attrs) to the layouts the op wants for its inputs and the layouts it
+produces for its outputs, in the reference's dims_mapping notation:
+``dims_mapping[tensor_dim] = mesh axis index or -1``.
+
+TPU-native role (SURVEY §7.1): GSPMD does propagation for the long tail of
+ops; these explicit rules cover the cases where GSPMD is suboptimal or
+where the decision is semantic (vocab-parallel cross-entropy, flash
+attention, norms, MoE dispatch, TP matmul) — the dispatch funnel turns
+them into ``with_sharding_constraint`` on traced values so XLA follows the
+rule instead of guessing, and into ``dist_attr`` metadata on eager
+tensors. Rules are pure functions over metadata: unit-testable with no
+devices, mirroring test/auto_parallel/spmd_rules/test_matmul_rule.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["DistTensorSpec", "SpmdRule", "register_spmd_rule",
+           "get_spmd_rule", "has_spmd_rule", "SPMD_RULES"]
+
+
+@dataclass(frozen=True)
+class DistTensorSpec:
+    """Shape + dims_mapping (+ partial mesh axes) of one dist tensor —
+    the metadata half of the reference's DistTensorSpec
+    (paddle/phi/core/distributed/auto_parallel/dist_meta_tensor.h)."""
+    shape: Tuple[int, ...]
+    dims_mapping: Tuple[int, ...]
+    partial_dims: frozenset = field(default_factory=frozenset)
+
+    def __post_init__(self):
+        object.__setattr__(self, "shape", tuple(self.shape))
+        object.__setattr__(self, "dims_mapping", tuple(self.dims_mapping))
+        object.__setattr__(self, "partial_dims", frozenset(self.partial_dims))
+        if len(self.shape) != len(self.dims_mapping):
+            raise ValueError(
+                f"dims_mapping rank {len(self.dims_mapping)} != tensor rank "
+                f"{len(self.shape)}")
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def is_replicated(self):
+        return all(m == -1 for m in self.dims_mapping) and not self.partial_dims
+
+
+def replicated(shape) -> DistTensorSpec:
+    return DistTensorSpec(tuple(shape), (-1,) * len(tuple(shape)))
+
+
+class SpmdRule:
+    def __init__(self, name: str, infer_forward: Callable):
+        self.name = name
+        self._fwd = infer_forward
+
+    def infer_forward(self, *specs, **attrs
+                      ) -> Tuple[List[DistTensorSpec], List[DistTensorSpec]]:
+        """-> (input specs the op wants, output specs it produces)."""
+        return self._fwd(*specs, **attrs)
+
+
+SPMD_RULES: Dict[str, SpmdRule] = {}
+
+
+def register_spmd_rule(*names):
+    def deco(fn):
+        for n in names:
+            SPMD_RULES[n] = SpmdRule(n, fn)
+        return fn
+    return deco
+
+
+def get_spmd_rule(name: str) -> SpmdRule:
+    return SPMD_RULES[name]
+
+
+def has_spmd_rule(name: str) -> bool:
+    return name in SPMD_RULES
+
+
+# -- helpers -----------------------------------------------------------------
+
+def _dedup(mapping: Sequence[int]) -> Tuple[int, ...]:
+    """A mesh axis may shard at most one tensor dim: first use wins."""
+    seen, out = set(), []
+    for m in mapping:
+        if m != -1 and m in seen:
+            out.append(-1)
+        else:
+            out.append(m)
+            if m != -1:
+                seen.add(m)
+    return tuple(out)
+
+
+def _merge_dim(*ms: int) -> int:
+    """Merge per-dim proposals: agreeing non-(-1) wins; conflict -> -1."""
+    cand = {m for m in ms if m != -1}
+    return cand.pop() if len(cand) == 1 else -1
+
+
+def _broadcast_merge(specs: Sequence[DistTensorSpec]
+                     ) -> Tuple[List[Tuple[int, ...]], Tuple[int, ...], Tuple[int, ...]]:
+    """Right-aligned broadcast of inputs; returns (aligned input mappings,
+    output shape, output mapping)."""
+    nd = max(s.ndim for s in specs)
+    out_shape = []
+    out_map = []
+    for d in range(nd):
+        dims, maps = [], []
+        for s in specs:
+            sd = d - (nd - s.ndim)
+            if sd >= 0:
+                dims.append(s.shape[sd])
+                # a broadcast (size-1) dim can't impose sharding
+                maps.append(s.dims_mapping[sd] if s.shape[sd] != 1 else -1)
+        out_shape.append(max(dims))
+        out_map.append(_merge_dim(*maps))
+    out_map = _dedup(out_map)
+    aligned = []
+    for s in specs:
+        off = nd - s.ndim
+        aligned.append(tuple(
+            out_map[off + i] if s.shape[i] != 1 else -1
+            for i in range(s.ndim)))
+    return aligned, tuple(out_shape), out_map
+
+
+# -- rules -------------------------------------------------------------------
+
+@register_spmd_rule("matmul", "linear", "fused_linear")
+def _matmul_rule(x: DistTensorSpec, y: DistTensorSpec, *rest,
+                 transpose_x=False, transpose_y=False, **_):
+    """Parity: spmd_rules/matmul.cc MatmulInferSpmd. x [..., m, k],
+    y [..., k, n] -> out [..., m, n]; shared contracted-axis sharding makes
+    the output Partial over that mesh axis (TP row-parallel)."""
+    xm = list(x.dims_mapping)
+    ym = list(y.dims_mapping)
+    if transpose_x and x.ndim >= 2:
+        xm[-1], xm[-2] = xm[-2], xm[-1]
+    if transpose_y and y.ndim >= 2:
+        ym[-1], ym[-2] = ym[-2], ym[-1]
+    xshape = list(x.shape)
+    yshape = list(y.shape)
+    if transpose_x and x.ndim >= 2:
+        xshape[-1], xshape[-2] = xshape[-2], xshape[-1]
+    if transpose_y and y.ndim >= 2:
+        yshape[-1], yshape[-2] = yshape[-2], yshape[-1]
+
+    if x.ndim == 1 or y.ndim == 1:  # vec cases: fall back to replication
+        out_nd = max(x.ndim + y.ndim - 2, 0)
+        return ([replicated(x.shape), replicated(y.shape)] +
+                [replicated(r.shape) for r in rest],
+                [DistTensorSpec((1,) * out_nd if out_nd else (),
+                                (-1,) * out_nd)])
+
+    m, k, n = xshape[-2], xshape[-1], yshape[-1]
+    # contracted axis: align (prefer x's non-replicated proposal)
+    kmap = _merge_dim(xm[-1], ym[-2])
+    if xm[-1] != -1 and ym[-2] != -1 and xm[-1] != ym[-2]:
+        kmap = xm[-1]
+    xm[-1] = ym[-2] = kmap
+    # batch dims broadcast-merge
+    bx = DistTensorSpec(xshape[:-2], xm[:-2])
+    by = DistTensorSpec(yshape[:-2], ym[:-2])
+    aligned, bshape, bmap = _broadcast_merge([bx, by])
+    out_map = _dedup(list(bmap) + [xm[-2], ym[-1]])
+    # the already-used batch axes must not re-shard m/n
+    partial = frozenset({kmap} if kmap != -1 else set())
+    out = DistTensorSpec(tuple(bshape) + (m, n), out_map, partial)
+    in_x = DistTensorSpec(x.shape, _dedup(
+        (list(aligned[0]) + [xm[-2], xm[-1]]) if not transpose_x
+        else (list(aligned[0]) + [xm[-1], xm[-2]])))
+    in_y = DistTensorSpec(y.shape, _dedup(
+        (list(aligned[1]) + [ym[-2], ym[-1]]) if not transpose_y
+        else (list(aligned[1]) + [ym[-1], ym[-2]])))
+    ins = [in_x, in_y]
+    for r in rest:  # bias: follows out's trailing dims
+        ins.append(DistTensorSpec(
+            r.shape, _dedup(out.dims_mapping[-r.ndim:]) if r.ndim else ()))
+    return ins, [out]
+
+
+@register_spmd_rule("add", "subtract", "multiply", "divide", "maximum",
+                    "minimum", "pow", "where", "clip", "lerp", "scale",
+                    "cast", "gelu", "relu", "silu", "tanh", "sigmoid",
+                    "dropout", "swiglu")
+def _elementwise_rule(*specs: DistTensorSpec, **_):
+    """Parity: spmd_rules/elementwise.cc — right-aligned broadcast merge."""
+    aligned, out_shape, out_map = _broadcast_merge(list(specs))
+    ins = [DistTensorSpec(s.shape, a) for s, a in zip(specs, aligned)]
+    return ins, [DistTensorSpec(out_shape, out_map)]
+
+
+@register_spmd_rule("sum", "mean", "max", "min", "prod", "logsumexp")
+def _reduction_rule(x: DistTensorSpec, *, axis=None, keepdim=False, **_):
+    """Parity: spmd_rules/reduction.cc — reduced sharded axes become
+    Partial on the output."""
+    nd = x.ndim
+    if axis is None:
+        axes = set(range(nd))
+    else:
+        axes = {a % nd for a in
+                (axis if isinstance(axis, (list, tuple)) else [axis])}
+    out_map, out_shape = [], []
+    partial = set()
+    for d in range(nd):
+        if d in axes:
+            if x.dims_mapping[d] != -1:
+                partial.add(x.dims_mapping[d])
+            if keepdim:
+                out_map.append(-1)
+                out_shape.append(1)
+        else:
+            out_map.append(x.dims_mapping[d])
+            out_shape.append(x.shape[d])
+    return [x], [DistTensorSpec(tuple(out_shape), tuple(out_map),
+                                frozenset(partial))]
+
+
+@register_spmd_rule("transpose")
+def _transpose_rule(x: DistTensorSpec, *, perm=None, **_):
+    if perm is None:
+        perm = list(range(x.ndim))[::-1]
+    perm = [p % x.ndim for p in perm]
+    return [x], [DistTensorSpec(tuple(x.shape[p] for p in perm),
+                                tuple(x.dims_mapping[p] for p in perm),
+                                x.partial_dims)]
+
+
+@register_spmd_rule("reshape", "flatten", "squeeze", "unsqueeze")
+def _reshape_rule(x: DistTensorSpec, *, shape=None, **_):
+    """Parity: spmd_rules/reshape.cc (dim_trans-lite): a dim keeps its
+    sharding iff it survives with the same size and all dims to its left
+    map 1:1; anything merged/split falls back to -1."""
+    if shape is None:
+        # call site didn't thread the target shape: bail rather than answer
+        # "replicated" — a wrong Replicate on a still-sharded tensor would
+        # corrupt downstream decisions and force an all-gather under jit
+        raise ValueError("reshape rule needs the target shape attr")
+    out_shape = list(shape)
+    # resolve a single -1
+    known = 1
+    for v in out_shape:
+        if v != -1:
+            known *= v
+    total = 1
+    for v in x.shape:
+        total *= v
+    out_shape = [total // known if v == -1 else v for v in out_shape]
+    out_map = [-1] * len(out_shape)
+    i = j = 0
+    while i < x.ndim and j < len(out_shape):
+        if x.shape[i] == out_shape[j]:
+            out_map[j] = x.dims_mapping[i]
+            i += 1
+            j += 1
+        else:
+            break
+    # trailing alignment
+    i, j = x.ndim - 1, len(out_shape) - 1
+    while i >= 0 and j >= 0 and out_map[j] == -1:
+        if x.shape[i] == out_shape[j]:
+            out_map[j] = x.dims_mapping[i]
+            i -= 1
+            j -= 1
+        else:
+            break
+    return [x], [DistTensorSpec(tuple(out_shape), _dedup(out_map),
+                                x.partial_dims)]
+
+
+@register_spmd_rule("softmax", "log_softmax")
+def _softmax_rule(x: DistTensorSpec, *, axis=-1, **_):
+    """Parity: spmd_rules/softmax.cc — the softmax axis must be whole."""
+    a = axis % x.ndim
+    m = list(x.dims_mapping)
+    m[a] = -1
+    spec = DistTensorSpec(x.shape, tuple(m))
+    return [spec], [spec]
+
+
+@register_spmd_rule("concat")
+def _concat_rule(*specs: DistTensorSpec, axis=0, **_):
+    nd = specs[0].ndim
+    a = axis % nd
+    maps = []
+    for d in range(nd):
+        maps.append(-1 if d == a else _merge_dim(
+            *[s.dims_mapping[d] for s in specs]))
+    maps = _dedup(maps)
+    ins = [DistTensorSpec(s.shape, maps) for s in specs]
+    out_shape = list(specs[0].shape)
+    out_shape[a] = sum(s.shape[a] for s in specs)
+    return ins, [DistTensorSpec(tuple(out_shape), maps)]
+
+
+@register_spmd_rule("split")
+def _split_rule(x: DistTensorSpec, *, axis=0, sections=None,
+                num_outputs=1, **_):
+    a = axis % x.ndim
+    m = list(x.dims_mapping)
+    m[a] = -1
+    in_spec = DistTensorSpec(x.shape, tuple(m))
+    if sections is None:
+        sections = [x.shape[a] // num_outputs] * num_outputs
+    outs = []
+    for sec in sections:
+        shp = list(x.shape)
+        shp[a] = sec
+        outs.append(DistTensorSpec(tuple(shp), tuple(m)))
+    return [in_spec], outs
+
+
+@register_spmd_rule("embedding")
+def _embedding_rule(x: DistTensorSpec, w: DistTensorSpec, **_):
+    """Parity: spmd_rules/embedding.cc — row(vocab)-sharded table makes the
+    output Partial over that axis (VocabParallelEmbedding: each shard
+    contributes only the rows it owns, summed over the mp group,
+    mp_layers.py:47); column-sharded table shards the hidden dim."""
+    vocab_axis, hidden_axis = w.dims_mapping
+    out_map = tuple(x.dims_mapping) + (hidden_axis,)
+    partial = frozenset({vocab_axis} if vocab_axis != -1 else set())
+    out = DistTensorSpec(tuple(x.shape) + (w.shape[1],), _dedup(out_map),
+                         partial)
+    return [x, w], [out]
+
+
+@register_spmd_rule("cross_entropy_with_softmax", "cross_entropy")
+def _cross_entropy_rule(logits: DistTensorSpec, label: DistTensorSpec, **_):
+    """Parity: spmd_rules/cross_entropy_with_softmax.cc — vocab(class)-dim
+    sharding is legal (ParallelCrossEntropy): the loss becomes Partial over
+    the vocab mesh axis (local max/sum-exp + target-gather contributions,
+    reference c_softmax_with_cross_entropy_op.cu); other dims pass through."""
+    vocab_axis = logits.dims_mapping[-1]
+    lead = logits.dims_mapping[:-1]
+    loss = DistTensorSpec(logits.shape[:-1], lead,
+                          frozenset({vocab_axis} if vocab_axis != -1
+                                    else set()))
+    label_map = _dedup(lead[:label.ndim])
+    return ([logits, DistTensorSpec(label.shape, label_map)], [loss])
+
+
+@register_spmd_rule("flash_attention")
+def _flash_attention_rule(q: DistTensorSpec, k: DistTensorSpec,
+                          v: DistTensorSpec, *rest, causal=False, **_):
+    """Parity: spmd_rules/flash_attention.cc. q [b, sq, h, d],
+    k/v [b, sk, h_kv, d]: batch and head shardings ride through (TP shards
+    heads); q's seq dim may stay sharded (rows are independent); k/v seq
+    and head_dim must be whole — sequence-parallel attention goes through
+    ring/Ulysses (distributed/long_context.py), not this local kernel."""
+    b_ax = _merge_dim(q.dims_mapping[0], k.dims_mapping[0],
+                      v.dims_mapping[0])
+    h_ax = _merge_dim(q.dims_mapping[2], k.dims_mapping[2],
+                      v.dims_mapping[2])
+    qs = DistTensorSpec(q.shape,
+                        _dedup((b_ax, q.dims_mapping[1], h_ax, -1)))
+    ks = DistTensorSpec(k.shape, _dedup((b_ax, -1, h_ax, -1)))
+    vs = DistTensorSpec(v.shape, _dedup((b_ax, -1, h_ax, -1)))
+    out = DistTensorSpec(q.shape, qs.dims_mapping)
+    # lse [b, h, sq] follows (b, h, sq)
+    lse = DistTensorSpec((q.shape[0], q.shape[2], q.shape[1]),
+                         _dedup((b_ax, h_ax, q.dims_mapping[1])))
+    ins = [qs, ks, vs] + [replicated(r.shape) for r in rest]
+    return ins, [out, lse]
+
+
+@register_spmd_rule("layer_norm", "rms_norm", "group_norm")
+def _norm_rule(x: DistTensorSpec, *ws: DistTensorSpec, **_):
+    """Parity: spmd_rules/layer_norm.cc / rms_norm.cc — the normalized
+    (last) dim must be whole; leading dims (batch, seq) ride through; the
+    per-row stats follow the leading dims."""
+    m = list(x.dims_mapping)
+    m[-1] = -1
+    xs = DistTensorSpec(x.shape, tuple(m))
+    ins = [xs] + [replicated(w.shape) for w in ws]
+    stats = DistTensorSpec(x.shape[:-1], tuple(m[:-1]))
+    return ins, [xs, stats, stats]
+
+
+@register_spmd_rule("fused_rope")
+def _fused_rope_rule(q: DistTensorSpec, *rest, **_):
+    """Parity: spmd_rules/fused_rope.cc — rotation is elementwise over
+    (b, s, h): all pass through except the rotated head_dim; cos/sin
+    tables replicated."""
+    m = list(q.dims_mapping)
+    m[-1] = -1
+    qs = DistTensorSpec(q.shape, tuple(m))
+    ins = [qs]
+    outs = [qs]
+    for r in rest:
+        if r.ndim == q.ndim:  # k rides like q
+            rm = list(r.dims_mapping)
+            rm[-1] = -1
+            rs = DistTensorSpec(r.shape, tuple(rm))
+            ins.append(rs)
+            outs.append(rs)
+        else:  # cos/sin tables
+            ins.append(replicated(r.shape))
+    return ins, outs
+
+
+@register_spmd_rule("moe_dispatch", "global_scatter")
+def _moe_dispatch_rule(x: DistTensorSpec, *rest, expert_axis=0, **_):
+    """MoE all-to-all dispatch (reference global_scatter_op.cu.cc +
+    moe_layer.py:263): tokens [E, C, H] leave sharded over the expert mesh
+    axis on dim 0 — each rank keeps only its experts' capacity slots."""
+    m = [-1] * x.ndim
+    m[0] = expert_axis
+    out = DistTensorSpec(x.shape, _dedup(m))
+    return [x] + [replicated(r.shape) for r in rest], [out]
+
+
+@register_spmd_rule("moe_combine", "global_gather")
+def _moe_combine_rule(x: DistTensorSpec, *rest, **_):
+    """Inverse all-to-all: expert-sharded slots return to token order
+    (replicated / data-sharded downstream)."""
+    m = [-1] * x.ndim
+    return [x] + [replicated(r.shape) for r in rest], \
+        [DistTensorSpec(x.shape, tuple(m))]
+
+
+@register_spmd_rule("default_data_parallel")
+def _default_dp_rule(*specs: DistTensorSpec, mesh_axis=0, **_):
+    """Parity: spmd_rules/default_data_parallel.cc — batch dim sharded over
+    the data axis, everything else replicated."""
+    outs = []
+    for s in specs:
+        m = [-1] * s.ndim
+        if s.ndim:
+            m[0] = mesh_axis
+        outs.append(DistTensorSpec(s.shape, tuple(m)))
+    return outs, outs
+
+
+@register_spmd_rule("replicated")
+def _replicated_rule(*specs: DistTensorSpec, **_):
+    """Parity: spmd_rules/replicated.cc — the universal fallback."""
+    outs = [replicated(s.shape) for s in specs]
+    return outs, outs
+
+
+@register_spmd_rule("adamw", "optimizer")
+def _optimizer_rule(param: DistTensorSpec, *rest, **_):
+    """Parity: spmd_rules/optimizer.cc — grad and every moment follow the
+    parameter's layout (ZeRO keeps states aligned with their shard)."""
+    ins = [param] + [DistTensorSpec(r.shape, param.dims_mapping
+                                    if r.ndim == param.ndim
+                                    else (-1,) * r.ndim) for r in rest]
+    return ins, [ins[0]] + ins[1:]

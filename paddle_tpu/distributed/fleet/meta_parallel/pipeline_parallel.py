@@ -75,8 +75,6 @@ class PipelineParallel:
         self.num_chunks = layers.get_num_chunks()
         self.training = True
         self._batch_count = 0
-        self._base_key = jax.random.key(
-            getattr(_random.default_generator, "_seed", 0))
         self._programs: Dict = {}  # (chunk, kind, train) -> jitted fn
         self._peak_stash: List[int] = [0] * self.num_chunks
         self._build_meshes(devices)
@@ -269,6 +267,15 @@ class PipelineParallel:
         return [(xa[i * mb:(i + 1) * mb], ya[i * mb:(i + 1) * mb])
                 for i in range(n)]
 
+    def _next_batch_key(self):
+        """Per-batch dropout key derived from the CURRENT global seed (so
+        paddle.seed() after engine construction takes effect, like the
+        non-pipeline path) and a per-batch counter (eval advances it too)."""
+        seed = getattr(_random.default_generator, "_seed", 0)
+        k = jax.random.fold_in(jax.random.key(seed), self._batch_count)
+        self._batch_count += 1
+        return k
+
     @staticmethod
     def _queue_1f1b(vs: int, n_vstages: int, m: int) -> deque:
         """The per-(virtual-)stage 1F1B action order (reference
@@ -299,11 +306,12 @@ class PipelineParallel:
         micro = self._split_micro(data)
         m = len(micro)
         nv = self.num_chunks
-        batch_key = jax.random.fold_in(self._base_key, self._batch_count)
-        self._batch_count += 1
+        batch_key = self._next_batch_key()
         gscale = 1.0 / m
-        if scaler is not None and getattr(scaler, "_enable", True):
-            gscale = gscale * float(getattr(scaler, "_scale", 1.0))
+        # only pre-scale grads when the scaler will actually unscale them in
+        # step(); bf16/amp-off is a passthrough (GradScaler._passthrough)
+        if scaler is not None and not scaler._passthrough():
+            gscale = gscale * float(scaler._scale)
 
         chunk_params = [self._fetch_chunk_params(c) for c in range(nv)]
         acts = {(0, i): self._transfer(mx, 0) for i, (mx, _) in enumerate(micro)}
@@ -405,7 +413,7 @@ class PipelineParallel:
         micro = self._split_micro(data)
         nv = self.num_chunks
         chunk_params = [self._fetch_chunk_params(c) for c in range(nv)]
-        batch_key = jax.random.fold_in(self._base_key, self._batch_count)
+        batch_key = self._next_batch_key()
         total = None
         for i, (mx, my) in enumerate(micro):
             x = self._transfer(mx, 0)

@@ -123,7 +123,7 @@ def log_softmax(x, axis=-1, dtype=None, name=None):
         if dt is not None:
             a = a.astype(dt)
         return jax.nn.log_softmax(a, axis=axis)
-    return run_op("log_softmax", fn, (x,))
+    return run_op("log_softmax", fn, (x,), attrs={"axis": axis})
 
 
 def softmax(x, axis=-1, dtype=None, name=None):
@@ -134,7 +134,7 @@ def softmax(x, axis=-1, dtype=None, name=None):
         if dt is not None:
             a = a.astype(dt)
         return jax.nn.softmax(a, axis=axis)
-    return run_op("softmax", fn, (x,))
+    return run_op("softmax", fn, (x,), attrs={"axis": axis})
 
 
 def softmax_(x, axis=-1, dtype=None, name=None):

@@ -27,7 +27,9 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
         if transpose_y:
             b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
         return jnp.matmul(a, b)
-    return run_op("matmul", fn, (x, y))
+    return run_op("matmul", fn, (x, y),
+                  attrs={"transpose_x": transpose_x,
+                         "transpose_y": transpose_y})
 
 
 def mm(input, mat2, name=None):

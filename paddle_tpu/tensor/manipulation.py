@@ -36,7 +36,8 @@ def cast(x, dtype):
 
 def reshape(x, shape, name=None):
     shape = _static_shape(shape)
-    return run_op("reshape", lambda a: jnp.reshape(a, shape), (x,))
+    return run_op("reshape", lambda a: jnp.reshape(a, shape), (x,),
+                  attrs={"shape": tuple(shape)})
 
 
 def reshape_(x, shape, name=None):
@@ -91,7 +92,8 @@ def unsqueeze_(x, axis, name=None):
 
 def concat(x, axis=0, name=None):
     ax = int(axis._data) if isinstance(axis, Tensor) else int(axis)
-    return run_op("concat", lambda *xs: jnp.concatenate(xs, axis=ax), tuple(x))
+    return run_op("concat", lambda *xs: jnp.concatenate(xs, axis=ax),
+                  tuple(x), attrs={"axis": ax})
 
 
 def stack(x, axis=0, name=None):
@@ -271,7 +273,8 @@ def roll(x, shifts, axis=None, name=None):
 
 def transpose(x, perm, name=None):
     p = tuple(int(i) for i in perm)
-    return run_op("transpose", lambda a: jnp.transpose(a, p), (x,))
+    return run_op("transpose", lambda a: jnp.transpose(a, p), (x,),
+                  attrs={"perm": tuple(p)})
 
 
 def moveaxis(x, source, destination, name=None):

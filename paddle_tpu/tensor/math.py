@@ -164,12 +164,14 @@ def _axis(axis):
 def sum(x, axis=None, dtype=None, keepdim=False, name=None):
     dt = convert_dtype(dtype)
     return run_op("sum", lambda a: jnp.sum(a, axis=_axis(axis), dtype=dt,
-                                           keepdims=keepdim), (x,))
+                                           keepdims=keepdim), (x,),
+                  attrs={"axis": _axis(axis), "keepdim": keepdim})
 
 
 def mean(x, axis=None, keepdim=False, name=None):
     return run_op("mean", lambda a: jnp.mean(a, axis=_axis(axis),
-                                             keepdims=keepdim), (x,))
+                                             keepdims=keepdim), (x,),
+                  attrs={"axis": _axis(axis), "keepdim": keepdim})
 
 
 def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
@@ -185,12 +187,14 @@ def nanmean(x, axis=None, keepdim=False, name=None):
 
 def max(x, axis=None, keepdim=False, name=None):
     return run_op("max", lambda a: jnp.max(a, axis=_axis(axis),
-                                           keepdims=keepdim), (x,))
+                                           keepdims=keepdim), (x,),
+                  attrs={"axis": _axis(axis), "keepdim": keepdim})
 
 
 def min(x, axis=None, keepdim=False, name=None):
     return run_op("min", lambda a: jnp.min(a, axis=_axis(axis),
-                                           keepdims=keepdim), (x,))
+                                           keepdims=keepdim), (x,),
+                  attrs={"axis": _axis(axis), "keepdim": keepdim})
 
 
 amax = max

@@ -1,0 +1,341 @@
+"""SPMD rule unit tests — single-process, NO devices needed: feed
+DistTensorSpecs into each rule and assert inferred dims_mapping / partial
+axes, mirroring the reference suite
+(test/auto_parallel/spmd_rules/test_matmul_rule.py and siblings).
+The final class checks the rules are actually USED: a TP-sharded model's
+jaxpr must contain the rule-driven sharding constraints."""
+import numpy as np
+import pytest
+
+from paddle_tpu.core.op_registry import OPS, get_op_def, infer_shape
+from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+    DistTensorSpec, get_spmd_rule, has_spmd_rule, replicated)
+
+
+def spec(shape, mapping, partial=()):
+    return DistTensorSpec(tuple(shape), tuple(mapping), frozenset(partial))
+
+
+class TestMatmulRule:
+    # mesh axes: 0, 1 (names irrelevant at rule level — pure metadata)
+    def test_column_parallel(self):
+        ins, outs = get_spmd_rule("matmul").infer_forward(
+            spec((8, 16), (-1, -1)), spec((16, 32), (-1, 1)))
+        assert outs[0].dims_mapping == (-1, 1)
+        assert not outs[0].partial_dims
+
+    def test_row_parallel_contracted_makes_partial(self):
+        ins, outs = get_spmd_rule("matmul").infer_forward(
+            spec((8, 16), (-1, 1)), spec((16, 32), (1, -1)))
+        assert outs[0].dims_mapping == (-1, -1)
+        assert outs[0].partial_dims == {1}
+
+    def test_mk_kn_mixed(self):
+        # the reference's canonical case: x[1,0] @ y[0,-1] -> out[1,-1] P{0}
+        ins, outs = get_spmd_rule("matmul").infer_forward(
+            spec((64, 32), (1, 0)), spec((32, 48), (0, -1)))
+        assert ins[0].dims_mapping == (1, 0)
+        assert ins[1].dims_mapping == (0, -1)
+        assert outs[0].dims_mapping == (1, -1)
+        assert outs[0].partial_dims == {0}
+
+    def test_batched_dp(self):
+        ins, outs = get_spmd_rule("matmul").infer_forward(
+            spec((4, 8, 16), (0, -1, -1)), spec((16, 32), (-1, 1)))
+        assert outs[0].dims_mapping == (0, -1, 1)
+
+    def test_transpose_y(self):
+        ins, outs = get_spmd_rule("matmul").infer_forward(
+            spec((8, 16), (-1, -1)), spec((32, 16), (1, -1)),
+            transpose_y=True)
+        assert outs[0].dims_mapping == (-1, 1)
+
+    def test_conflicting_contraction_prefers_x(self):
+        ins, outs = get_spmd_rule("matmul").infer_forward(
+            spec((8, 16), (-1, 0)), spec((16, 32), (1, -1)))
+        # x's proposal (axis 0) wins; y must be resharded to k->0
+        assert ins[1].dims_mapping[0] == 0
+        assert outs[0].partial_dims == {0}
+
+
+class TestElementwiseRule:
+    def test_aligned(self):
+        ins, outs = get_spmd_rule("add").infer_forward(
+            spec((8, 16), (0, -1)), spec((8, 16), (0, -1)))
+        assert outs[0].dims_mapping == (0, -1)
+
+    def test_conflict_drops(self):
+        ins, outs = get_spmd_rule("add").infer_forward(
+            spec((8, 16), (0, -1)), spec((8, 16), (1, -1)))
+        assert outs[0].dims_mapping == (-1, -1)
+
+    def test_broadcast_bias(self):
+        ins, outs = get_spmd_rule("add").infer_forward(
+            spec((8, 32), (-1, 1)), spec((32,), (1,)))
+        assert outs[0].dims_mapping == (-1, 1)
+        assert ins[1].dims_mapping == (1,)
+
+    def test_size1_dim_cannot_impose(self):
+        ins, outs = get_spmd_rule("multiply").infer_forward(
+            spec((8, 16), (0, 1)), spec((1, 16), (1, -1)))
+        assert outs[0].dims_mapping == (0, 1)
+
+
+class TestReductionRule:
+    def test_sum_sharded_axis_is_partial(self):
+        ins, outs = get_spmd_rule("sum").infer_forward(
+            spec((8, 16), (0, 1)), axis=1)
+        assert outs[0].dims_mapping == (0,)
+        assert outs[0].partial_dims == {1}
+
+    def test_keepdim(self):
+        _, outs = get_spmd_rule("mean").infer_forward(
+            spec((8, 16), (0, 1)), axis=1, keepdim=True)
+        assert outs[0].shape == (8, 1)
+        assert outs[0].dims_mapping == (0, -1)
+
+    def test_full_reduce(self):
+        _, outs = get_spmd_rule("sum").infer_forward(
+            spec((8, 16), (0, 1)), axis=None)
+        assert outs[0].shape == ()
+        assert outs[0].partial_dims == {0, 1}
+
+
+class TestShapeOpsRules:
+    def test_transpose(self):
+        _, outs = get_spmd_rule("transpose").infer_forward(
+            spec((8, 16, 32), (0, -1, 1)), perm=(2, 0, 1))
+        assert outs[0].shape == (32, 8, 16)
+        assert outs[0].dims_mapping == (1, 0, -1)
+
+    def test_reshape_keeps_leading(self):
+        _, outs = get_spmd_rule("reshape").infer_forward(
+            spec((8, 16, 32), (0, -1, 1)), shape=(8, 512))
+        assert outs[0].dims_mapping[0] == 0
+
+    def test_reshape_merge_drops(self):
+        _, outs = get_spmd_rule("reshape").infer_forward(
+            spec((8, 16, 32), (-1, 1, -1)), shape=(128, 32))
+        assert outs[0].dims_mapping == (-1, 1) or \
+            outs[0].dims_mapping == (-1, -1)
+
+    def test_softmax_axis_forced_whole(self):
+        ins, outs = get_spmd_rule("softmax").infer_forward(
+            spec((4, 8, 16), (0, -1, 1)), axis=-1)
+        assert ins[0].dims_mapping == (0, -1, -1)
+        assert outs[0].dims_mapping == (0, -1, -1)
+
+    def test_concat_axis_whole(self):
+        ins, outs = get_spmd_rule("concat").infer_forward(
+            spec((4, 8), (0, 1)), spec((4, 8), (0, 1)), axis=0)
+        assert outs[0].shape == (8, 8)
+        assert outs[0].dims_mapping == (-1, 1)
+
+    def test_split(self):
+        ins, outs = get_spmd_rule("split").infer_forward(
+            spec((8, 16), (0, 1)), axis=1, num_outputs=2)
+        assert len(outs) == 2
+        assert outs[0].shape == (8, 8)
+        assert outs[0].dims_mapping == (0, -1)
+
+
+class TestEmbeddingRule:
+    def test_vocab_parallel_partial(self):
+        """VocabParallelEmbedding (mp_layers.py:47): row-sharded table ->
+        Partial output over the mp axis."""
+        _, outs = get_spmd_rule("embedding").infer_forward(
+            spec((4, 128), (0, -1)), spec((50304, 256), (1, -1)))
+        assert outs[0].shape == (4, 128, 256)
+        assert outs[0].dims_mapping == (0, -1, -1)
+        assert outs[0].partial_dims == {1}
+
+    def test_hidden_sharded(self):
+        _, outs = get_spmd_rule("embedding").infer_forward(
+            spec((4, 128), (-1, -1)), spec((1024, 256), (-1, 1)))
+        assert outs[0].dims_mapping == (-1, -1, 1)
+        assert not outs[0].partial_dims
+
+
+class TestCrossEntropyRule:
+    def test_vocab_sharded_loss_partial(self):
+        """ParallelCrossEntropy (mp_layers.py:741 /
+        c_softmax_with_cross_entropy): vocab-sharded logits -> loss Partial
+        over the vocab mesh axis."""
+        ins, outs = get_spmd_rule("cross_entropy").infer_forward(
+            spec((512, 50304), (0, 1)), spec((512,), (0,)))
+        assert outs[0].shape == (512,)
+        assert outs[0].dims_mapping == (0,)
+        assert outs[0].partial_dims == {1}
+
+    def test_replicated_vocab_no_partial(self):
+        _, outs = get_spmd_rule("cross_entropy").infer_forward(
+            spec((512, 1024), (0, -1)), spec((512,), (0,)))
+        assert not outs[0].partial_dims
+
+
+class TestFlashAttentionRule:
+    def test_tp_heads(self):
+        """TP shards heads; batch rides dp; kv seq must be whole."""
+        q = spec((2, 128, 16, 64), (0, -1, 1, -1))
+        k = spec((2, 128, 16, 64), (0, -1, 1, -1))
+        v = spec((2, 128, 16, 64), (0, -1, 1, -1))
+        ins, outs = get_spmd_rule("flash_attention").infer_forward(q, k, v)
+        assert outs[0].dims_mapping == (0, -1, 1, -1)
+        assert ins[1].dims_mapping == (0, -1, 1, -1)
+        assert outs[1].dims_mapping == (0, 1, -1)  # lse [b, h, sq]
+
+    def test_seq_sharded_q_rows_independent(self):
+        q = spec((2, 128, 16, 64), (-1, 0, 1, -1))
+        k = spec((2, 128, 16, 64), (-1, 0, 1, -1))  # kv seq must be gathered
+        v = spec((2, 128, 16, 64), (-1, -1, 1, -1))
+        ins, outs = get_spmd_rule("flash_attention").infer_forward(q, k, v)
+        assert outs[0].dims_mapping == (-1, 0, 1, -1)
+        assert ins[1].dims_mapping[1] == -1  # k seq replicated
+
+
+class TestNormRules:
+    def test_layer_norm(self):
+        ins, outs = get_spmd_rule("layer_norm").infer_forward(
+            spec((8, 128, 256), (0, 1, -1)), spec((256,), (-1,)),
+            spec((256,), (-1,)))
+        assert outs[0].dims_mapping == (0, 1, -1)
+        assert outs[1].dims_mapping == (0, 1)  # stats
+
+    def test_rms_norm_forces_whole_last(self):
+        ins, outs = get_spmd_rule("rms_norm").infer_forward(
+            spec((8, 256), (0, 1)), spec((256,), (-1,)))
+        assert ins[0].dims_mapping == (0, -1)
+        assert outs[0].dims_mapping == (0, -1)
+
+
+class TestMoERules:
+    def test_dispatch_shards_expert_dim(self):
+        _, outs = get_spmd_rule("moe_dispatch").infer_forward(
+            spec((8, 64, 256), (-1, -1, -1)), expert_axis=1)
+        assert outs[0].dims_mapping == (1, -1, -1)
+
+    def test_combine_returns_whole(self):
+        _, outs = get_spmd_rule("moe_combine").infer_forward(
+            spec((8, 64, 256), (1, -1, -1)))
+        assert outs[0].dims_mapping == (-1, -1, -1)
+
+
+class TestGenericRules:
+    def test_default_data_parallel(self):
+        _, outs = get_spmd_rule("default_data_parallel").infer_forward(
+            spec((32, 128), (-1, -1)), mesh_axis=0)
+        assert outs[0].dims_mapping == (0, -1)
+
+    def test_replicated_fallback(self):
+        _, outs = get_spmd_rule("replicated").infer_forward(
+            spec((32, 128), (0, 1)))
+        assert outs[0].is_replicated()
+
+    def test_optimizer_states_follow_param(self):
+        ins, outs = get_spmd_rule("adamw").infer_forward(
+            spec((128, 256), (-1, 1)), spec((128, 256), (-1, -1)),
+            spec((128, 256), (-1, -1)))
+        assert ins[1].dims_mapping == (-1, 1)
+        assert ins[2].dims_mapping == (-1, 1)
+
+
+class TestOpTable:
+    """The §7.1 single-source table: {impl, shape_rule, vjp, spmd_rule}."""
+
+    def test_fused_ops_have_both_impls_and_rules(self):
+        import paddle_tpu  # noqa: F401 — registers xla impls
+        from paddle_tpu.core.dispatch import _load_pallas_impls
+        _load_pallas_impls()
+        for name in ("flash_attention", "layer_norm", "rms_norm"):
+            d = OPS[name]
+            assert "xla" in d.impls, name
+            assert "pallas" in d.impls, name
+            assert d.spmd_rule is not None and has_spmd_rule(d.spmd_rule)
+
+    def test_infer_shape_falls_back_to_eval_shape(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.core.op_registry import register_op
+        register_op("test_shape_op", impl=lambda x: x.sum(axis=-1))
+        out = infer_shape("test_shape_op",
+                          jax.ShapeDtypeStruct((4, 8), jnp.float32))
+        assert out.shape == (4,)
+        register_op("test_shape_op",
+                    shape_rule=lambda x: jax.ShapeDtypeStruct(
+                        x.shape[:-1], x.dtype))
+        out2 = infer_shape("test_shape_op",
+                           jax.ShapeDtypeStruct((4, 8), jnp.float32))
+        assert out2.shape == (4,)
+        del OPS["test_shape_op"]
+
+    def test_register_op_merges(self):
+        from paddle_tpu.core.op_registry import register_op
+        d = register_op("test_dummy_op", impl=lambda x: x,
+                        spmd_rule="replicated")
+        assert d.impls["xla"] is not None
+        d2 = register_op("test_dummy_op", vjp="custom")
+        assert d2 is d and d2.spmd_rule == "replicated"
+        del OPS["test_dummy_op"]
+
+
+class TestRulesAreUsed:
+    """VERDICT r1 #5 'Done' criterion: a TP-sharded model goes through the
+    explicit rules — assert via jaxpr inspection, no GSPMD guessing."""
+
+    def test_tp_mlp_jaxpr_has_rule_constraints(self):
+        import jax
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.process_mesh import (ProcessMesh,
+                                                         Replicate, Shard)
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4),
+                           dim_names=["dp", "tp"])
+        rng = np.random.RandomState(0)
+        w1 = dist.shard_tensor(
+            paddle.to_tensor(rng.randn(16, 64).astype(np.float32)),
+            mesh, [Replicate(), Shard(1)])     # column parallel
+        w2 = dist.shard_tensor(
+            paddle.to_tensor(rng.randn(64, 16).astype(np.float32)),
+            mesh, [Replicate(), Shard(0)])     # row parallel
+
+        def f(xa):
+            h = paddle.matmul(paddle.Tensor(xa), w1)
+            h = paddle.nn.functional.gelu(h)
+            out = paddle.matmul(h, w2)
+            return out._data
+
+        x = rng.randn(8, 16).astype(np.float32)
+        txt = str(jax.make_jaxpr(f)(x))
+        assert txt.count("sharding_constraint") >= 2
+        # column-parallel out is tp-sharded on the hidden dim
+        assert "'tp'" in txt or "tp" in txt
+
+    def test_llama_tp_attention_uses_flash_rule(self):
+        """The Llama decoder's sharded attention forward must carry the
+        flash-attention rule's constraint (heads sharded over tp)."""
+        import jax
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.process_mesh import (ProcessMesh,
+                                                         Replicate, Shard)
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4),
+                           dim_names=["dp", "tp"])
+        rng = np.random.RandomState(1)
+        q = dist.shard_tensor(
+            paddle.to_tensor(rng.randn(2, 32, 8, 64).astype(np.float32)),
+            mesh, [Shard(0), Shard(2)])  # batch over dp, heads over tp
+        k = dist.shard_tensor(
+            paddle.to_tensor(rng.randn(2, 32, 8, 64).astype(np.float32)),
+            mesh, [Shard(0), Shard(2)])
+        v = dist.shard_tensor(
+            paddle.to_tensor(rng.randn(2, 32, 8, 64).astype(np.float32)),
+            mesh, [Shard(0), Shard(2)])
+
+        from paddle_tpu.nn.functional import flash_attention as fa
+
+        def f(qa):
+            out, _ = fa(paddle.Tensor(qa), k, v, causal=True)
+            return out._data
+
+        txt = str(jax.make_jaxpr(f)(q._data))
+        assert "sharding_constraint" in txt
