@@ -126,12 +126,20 @@ class Fleet:
         if self._hcg is None:
             self.init()
         hc = self._strategy.hybrid_configs if self._strategy else {}
+        # mode order mirrors reference fleet/model.py:141-176:
+        # pp > mp > sep > sharding > dp
         if self._hcg.get_pipe_parallel_world_size() > 1:
             from .meta_parallel.pipeline_parallel import PipelineParallel
             return PipelineParallel(model, self._hcg, self._strategy)
         if self._hcg.get_model_parallel_world_size() > 1:
             from .meta_parallel.tensor_parallel import TensorParallel
             return TensorParallel(model, self._hcg, self._strategy)
+        if self._hcg.get_sep_parallel_world_size() > 1:
+            from .meta_parallel.segment_parallel import SegmentParallel
+            return SegmentParallel(model, self._hcg, self._strategy)
+        if self._hcg.get_sharding_parallel_world_size() > 1:
+            from .meta_parallel.sharding_parallel import ShardingParallel
+            return ShardingParallel(model, self._hcg, self._strategy)
         return DataParallel(model)
 
     def distributed_optimizer(self, optimizer, strategy=None):

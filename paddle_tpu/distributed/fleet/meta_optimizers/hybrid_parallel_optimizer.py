@@ -50,17 +50,51 @@ class HybridParallelOptimizer:
     """Wraps the user optimizer for hybrid parallel (reference :254)."""
 
     def __init__(self, optimizer, hcg=None, strategy=None):
-        self._inner_opt = optimizer
         self._hcg = hcg
         self._strategy = strategy
         # only global-norm clip needs the hybrid cross-axis treatment
-        # (reference also swaps only ClipGradByGlobalNorm and warns otherwise)
+        # (reference also swaps only ClipGradByGlobalNorm and warns
+        # otherwise). Swap BEFORE any wrapping: the sharding wrapper
+        # delegates reads via __getattr__ but a write would land on the
+        # wrapper's __dict__ and the real optimizer would keep its plain
+        # clip.
         if isinstance(optimizer._grad_clip, ClipGradByGlobalNorm) and \
                 not isinstance(optimizer._grad_clip, HybridParallelClipGrad):
             optimizer._grad_clip = HybridParallelClipGrad(
                 optimizer._grad_clip, hcg)
+        # sharding axis active: the inner optimizer becomes the ZeRO-1
+        # sharded one (reference :254 picks DygraphShardingOptimizer)
+        if hcg is not None and hcg.get_sharding_parallel_world_size() > 1 \
+                and not isinstance(optimizer, DygraphShardingOptimizer):
+            optimizer = DygraphShardingOptimizer(optimizer, hcg)
+        self._inner_opt = optimizer
+
+    def _insert_sync(self):
+        """TP-grad sync of non-distributed params (reference :333-421): a
+        param replicated over the mp group can be left with a Partial or
+        mp-sharded grad when activations are mp/sequence-sharded; reduce it
+        to the whole value before stepping (the reference broadcasts or
+        allreduces over the mp group, per sync_mode). Distributed
+        (is_distributed) params own per-rank shards and are skipped."""
+        from ...auto_parallel.api import reshard, unshard_dtensor
+        from ...process_mesh import Replicate, Shard
+        for p in (self._inner_opt._parameter_list or []):
+            if getattr(p, "is_distributed", False):
+                continue
+            g = getattr(p, "grad", None)
+            da = getattr(g, "dist_attr", None)
+            if g is None or da is None:
+                continue
+            if da.partial_axes:
+                p.grad = unshard_dtensor(g)  # p_to_r allreduce
+            elif any(isinstance(pl, Shard) for pl in da.placements):
+                p.grad = reshard(g, da.process_mesh,
+                                 [Replicate()] * da.process_mesh.ndim)
 
     def step(self):
+        if self._hcg is not None and \
+                self._hcg.get_model_parallel_world_size() > 1:
+            self._insert_sync()
         self._inner_opt.step()
 
     def clear_grad(self, set_to_zero=True):
@@ -134,19 +168,49 @@ class DygraphShardingOptimizer:
         from jax.sharding import NamedSharding, PartitionSpec as P
         axis = self._shard_axis()
         self._inner_opt.step()
-        if axis is None or not self._shard_states_lazily:
+        if axis is None:
             return
-        # after the first step the states exist: lay them over the axis
         mesh = self._hcg.topology.mesh.to_jax()
-        n = self._hcg.topology.get_dim(
-            "sharding" if axis == "sharding" else "data")
-        for key, state in self._inner_opt._states.items():
-            for name, arr in state.items():
-                if arr.ndim >= 1 and arr.shape[0] % n == 0:
-                    spec = P(axis, *(None,) * (arr.ndim - 1))
-                    state[name] = jax.device_put(
-                        arr, NamedSharding(mesh, spec))
-        self._shard_states_lazily = False
+        if self._shard_states_lazily:
+            # after the first step the states exist: lay them over the axis
+            # (ZeRO-1 state partition, reference
+            # dygraph_sharding_optimizer.py:48 — each rank stores 1/N)
+            n = self._hcg.topology.get_dim(axis)
+            for key, state in self._inner_opt._states.items():
+                for name, arr in state.items():
+                    if arr.ndim >= 1 and arr.shape[0] % n == 0:
+                        spec = P(axis, *(None,) * (arr.ndim - 1))
+                        state[name] = jax.device_put(
+                            arr, NamedSharding(mesh, spec))
+            self._shard_states_lazily = False
+        # post-step broadcast of updated shards (reference
+        # _sharding_sync_parameters): the eager update mixes sharded states
+        # into the param math, so updated params can come out sharded over
+        # the sharding axis — drop ONLY that axis from the spec (XLA
+        # all-gather over the sharding group) so every sharding rank holds
+        # the full updated weights. TP (is_distributed) params keep their
+        # per-rank shards untouched, as does any other mesh axis in the
+        # spec.
+        for p in (self._inner_opt._parameter_list or []):
+            if getattr(p, "is_distributed", False):
+                continue
+            arr = p._data
+            sh = getattr(arr, "sharding", None)
+            spec = getattr(sh, "spec", None)
+            if sh is None or spec is None or sh.is_fully_replicated:
+                continue
+
+            def _drop(entry):
+                if entry == axis:
+                    return None
+                if isinstance(entry, tuple):
+                    kept = tuple(a for a in entry if a != axis)
+                    return kept if kept else None
+                return entry
+            new_entries = [_drop(e) for e in tuple(spec)]
+            if new_entries != list(tuple(spec)):
+                p._data = jax.device_put(
+                    arr, NamedSharding(mesh, P(*new_entries)))
 
     def clear_grad(self, set_to_zero=True):
         self._inner_opt.clear_grad()

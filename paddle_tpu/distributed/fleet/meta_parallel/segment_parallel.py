@@ -1,14 +1,83 @@
-"""SegmentParallel (SEP) wrapper (parity: fleet/meta_parallel/
-segment_parallel.py). The sep axis splits activations along the sequence
-dim; under SPMD this is a Shard(seq) constraint on the activations — see
-sequence_parallel_utils for the op set."""
+"""SegmentParallel (SEP): the dedicated long-context sequence axis.
+
+Capability parity with the reference (reference: fleet/meta_parallel/
+segment_parallel.py wrapper; sequence split via Split.apply(x, axis=1,
+group=sep_group) in test/collective/fleet/hybrid_parallel_sep_model.py:143;
+param-grad allreduce over the sep and fused dp×sep groups,
+fleet/utils/hybrid_parallel_util.py:246-259).
+
+TPU-native design: the sep axis is one named axis of the hybrid mesh.
+``split_sequence`` shards the sequence dim of an activation over it (a
+NamedSharding placement — XLA scatters over ICI); because activations are
+then sep-sharded global arrays, the backward of any replicated param is a
+global reduction and XLA inserts the psum over sep — the explicit
+allreduce the reference does by hand. ``sync_gradients`` remains for grads
+that surface as Partial metadata. Ring/Ulysses attention over the same
+axis lives in distributed/long_context.py.
+"""
 from __future__ import annotations
 
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ....core.dispatch import run_op
+from ....core.tensor import Tensor
 from ...parallel import DataParallel
+
+__all__ = ["SegmentParallel", "split_sequence", "gather_sequence"]
+
+
+def _sep_sharding(hcg, ndim: int, axis: int) -> NamedSharding:
+    mesh = hcg.topology.mesh.to_jax()
+    entries = [None] * ndim
+    entries[axis] = "sep"
+    return NamedSharding(mesh, PartitionSpec(*entries))
+
+
+def split_sequence(x, hcg, axis: int = 1):
+    """Shard the sequence dim over the sep axis (the reference's
+    Split.apply over the sep group; backward = the gather, handled by the
+    device_put vjp)."""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    n = hcg.get_sep_parallel_world_size()
+    if n <= 1:
+        return t
+    if t.shape[axis] % n:
+        raise ValueError(
+            f"sequence dim {t.shape[axis]} not divisible by sep degree {n}")
+    sh = _sep_sharding(hcg, len(t.shape), axis)
+    return run_op("sep_split",
+                  lambda a: jax.device_put(a, sh), (t,))
+
+
+def gather_sequence(x, hcg, axis: int = 1):
+    """Re-replicate the sequence dim (the reference's Concat over sep)."""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    if hcg.get_sep_parallel_world_size() <= 1:
+        return t
+    mesh = hcg.topology.mesh.to_jax()
+    sh = NamedSharding(mesh, PartitionSpec())
+    return run_op("sep_gather", lambda a: jax.device_put(a, sh), (t,))
 
 
 class SegmentParallel(DataParallel):
-    def __init__(self, layers, hcg=None, strategy=None, **kwargs):
+    """Model wrapper for the sep axis (reference segment_parallel.py): the
+    input's sequence dim is split across the sep group before the wrapped
+    forward, and param grads are synchronized over sep(+dp) after
+    backward."""
+
+    def __init__(self, layers, hcg=None, strategy=None, seq_axis: int = 1,
+                 **kwargs):
         super().__init__(layers)
         self._hcg = hcg
         self._strategy = strategy
+        self._seq_axis = seq_axis
+
+    def forward(self, *inputs, **kwargs):
+        if inputs and self._hcg is not None and \
+                self._hcg.get_sep_parallel_world_size() > 1:
+            inputs = (split_sequence(inputs[0], self._hcg, self._seq_axis),
+                      ) + inputs[1:]
+        return self._layers(*inputs, **kwargs)
+
+    __call__ = forward

@@ -1,7 +1,28 @@
-"""ShardingParallel wrapper (parity: fleet/meta_parallel/sharding_parallel.py)."""
+"""ShardingParallel: the model-side half of ZeRO-1.
+
+Capability parity with the reference (reference: fleet/meta_parallel/
+sharding_parallel.py + dygraph_optimizer/dygraph_sharding_optimizer.py:48):
+grads are reduced over the sharding group, each rank updates only its
+optimizer-state shard, and updated weight shards are broadcast back.
+
+TPU-native split of responsibilities: state partition + post-step
+broadcast live in ``DygraphShardingOptimizer`` (meta_optimizers/
+hybrid_parallel_optimizer.py) — picked automatically by
+``fleet.distributed_optimizer`` when sharding_degree > 1. This wrapper
+supplies the model-side contract: batch sharded over the fused
+data×sharding axes (the reference reduces grads over exactly that fused
+group, hybrid_parallel_util.py) and grad normalization after backward.
+"""
 from __future__ import annotations
 
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ....core.dispatch import run_op
+from ....core.tensor import Tensor
 from ...parallel import DataParallel
+
+__all__ = ["ShardingParallel"]
 
 
 class ShardingParallel(DataParallel):
@@ -9,3 +30,31 @@ class ShardingParallel(DataParallel):
         super().__init__(layers)
         self._hcg = hcg
         self._strategy = strategy
+
+    def shard_batch(self, x, axis: int = 0):
+        """Shard the batch dim over the fused data×sharding axes — the
+        sharding group consumes distinct microbatches like dp (reference
+        topology order pp->mp->sep->sharding->dp)."""
+        t = x if isinstance(x, Tensor) else Tensor(x)
+        if self._hcg is None:
+            return t
+        axes = []
+        if self._hcg.get_data_parallel_world_size() > 1:
+            axes.append("data")
+        if self._hcg.get_sharding_parallel_world_size() > 1:
+            axes.append("sharding")
+        if not axes:
+            return t
+        n = 1
+        for a in axes:
+            n *= self._hcg.topology.get_dim(a)
+        if t.shape[axis] % n:
+            raise ValueError(
+                f"batch dim {t.shape[axis]} not divisible by "
+                f"data*sharding degree {n}")
+        entries = [None] * len(t.shape)
+        entries[axis] = tuple(axes) if len(axes) > 1 else axes[0]
+        sh = NamedSharding(self._hcg.topology.mesh.to_jax(),
+                           PartitionSpec(*entries))
+        return run_op("sharding_batch_split",
+                      lambda a: jax.device_put(a, sh), (t,))

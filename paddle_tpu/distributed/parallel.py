@@ -173,5 +173,15 @@ class DataParallel:
         self._layers.eval()
         return self
 
+    def sync_gradients(self):
+        """Grad reduce over the wrapper's comm group(s) (reference:
+        fused_allreduce_gradients over dp / sharding / sep per wrapper,
+        hybrid_parallel_util.py:246-259). Under SPMD most grads are already
+        whole global arrays; this normalizes any Partial-represented ones."""
+        from .fleet.utils.hybrid_parallel_util import \
+            fused_allreduce_gradients
+        fused_allreduce_gradients(list(self._layers.parameters()),
+                                  getattr(self, "_hcg", None))
+
     def __getattr__(self, name):
         return getattr(self.__dict__["_layers"], name)
