@@ -118,15 +118,23 @@ class TapeNode:
 
     ``vjp_fn(cotangents_tuple) -> tuple`` returns input cotangents aligned
     with ``inputs`` (the Tensors this op differentiates with respect to).
+    ``fn`` (when available) is the pure primal function of the diff inputs —
+    double-backward (create_graph) re-runs ``jax.vjp(fn, ...)`` through the
+    dispatch funnel so the backward is itself taped (the reference generates
+    higher-order GradNodes per op; here one generic rule covers every op).
     """
 
-    __slots__ = ("name", "inputs", "vjp_fn", "out_avals", "__weakref__")
+    __slots__ = ("name", "inputs", "vjp_fn", "out_avals", "fn",
+                 "single_out", "__weakref__")
 
-    def __init__(self, name: str, inputs: Sequence[Any], vjp_fn, out_avals):
+    def __init__(self, name: str, inputs: Sequence[Any], vjp_fn, out_avals,
+                 fn=None, single_out=True):
         self.name = name
         self.inputs = list(inputs)
         self.vjp_fn = vjp_fn
         self.out_avals = list(out_avals)  # jax.ShapeDtypeStruct per output
+        self.fn = fn
+        self.single_out = single_out
 
 
 def _toposort(roots: Sequence[TapeNode]) -> List[TapeNode]:
@@ -165,12 +173,37 @@ def _accum(a, b):
     return b if a is None else a + b
 
 
+def _vjp_through_tape(node: "TapeNode", cts):
+    """Run one node's vjp THROUGH the dispatch funnel so the backward op is
+    itself recorded on the tape (create_graph=True): grads of the returned
+    grads differentiate jax.vjp(fn, ...) — covering both the cotangent and
+    the primal (saved-forward-value) dependencies."""
+    from .dispatch import run_op
+    from .tensor import Tensor
+
+    n_in = len(node.inputs)
+    fn, single = node.fn, node.single_out
+    ct_tensors = tuple(c if isinstance(c, Tensor) else Tensor(c)
+                       for c in cts)
+
+    def vjp_op(*args):
+        primals, cots = args[:n_in], args[n_in:]
+        _, vjp = jax.vjp(fn, *primals)
+        gs = vjp(cots[0] if single else tuple(cots))
+        return tuple(gs)
+
+    outs = run_op(f"{node.name}_grad", vjp_op,
+                  tuple(node.inputs) + ct_tensors)
+    return outs if isinstance(outs, tuple) else (outs,)
+
+
 def _run_backward(
     root_tensors: Sequence[Any],
     root_grads: Sequence[Optional[Any]],
     retain_graph: bool,
     targets: Optional[Sequence[Any]] = None,
     accumulate_leaf: bool = True,
+    create_graph: bool = False,
 ):
     """Shared engine for ``backward()`` (accumulate into ``.grad``) and
     ``grad()`` (return grads for explicit targets).
@@ -214,16 +247,33 @@ def _run_backward(
             c if c is not None else _zeros(node.out_avals[i])
             for i, c in enumerate(cts)
         )
-        if node.vjp_fn is None:
+        if create_graph and node.fn is not None:
+            in_grads = _vjp_through_tape(node, cts)
+        elif create_graph:
+            # PyLayer/recompute nodes carry an opaque vjp closure: its
+            # output cannot be re-taped, so second-order grads through this
+            # branch would be silently missing — fail loudly instead
             raise RuntimeError(
-                f"backward through op '{node.name}' a second time: the graph "
-                "was freed. Call backward(retain_graph=True) the first time."
-            )
-        in_grads = node.vjp_fn(cts)
-        if not retain_graph:
-            node.vjp_fn = None
+                f"create_graph=True cannot differentiate through op "
+                f"'{node.name}' (opaque vjp, e.g. PyLayer/recompute): "
+                "its backward is not re-taped. Compute this branch without "
+                "recompute/PyLayer, or take the second derivative with "
+                "jax.grad on a functional form.")
+        else:
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    f"backward through op '{node.name}' a second time: the "
+                    "graph was freed. Call backward(retain_graph=True) the "
+                    "first time.")
+            raw_cts = tuple(c._data if isinstance(c, Tensor) else c
+                            for c in cts)
+            in_grads = node.vjp_fn(raw_cts)
+            if not retain_graph:
+                node.vjp_fn = None
         for t, g in zip(node.inputs, in_grads):
-            if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
+            garr = g._data if isinstance(g, Tensor) else g
+            if garr is None or (hasattr(garr, "dtype")
+                                and garr.dtype == jax.dtypes.float0):
                 continue
             if target_ids is not None and id(t) in target_ids:
                 target_grads[id(t)] = _accum(target_grads.get(id(t)), g)
@@ -233,7 +283,7 @@ def _run_backward(
                 node_cts[key] = _accum(node_cts.get(key), g)
             elif accumulate_leaf and not t.stop_gradient and \
                     (target_ids is None or id(t) not in target_ids):
-                t._accumulate_grad(g)
+                t._accumulate_grad(garr)
     return target_grads
 
 
@@ -267,7 +317,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     if retain_graph is None:
         retain_graph = create_graph
     tg = _run_backward(outputs, grad_outputs, retain_graph, targets=inputs,
-                       accumulate_leaf=False)
+                       accumulate_leaf=False, create_graph=create_graph)
     results = []
     for t in inputs:
         g = tg.get(id(t))
@@ -277,7 +327,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     "one of the inputs receives no gradient; pass "
                     "allow_unused=True to return None for it")
             results.append(None)
+        elif isinstance(g, Tensor):
+            # create_graph path: the grad carries its tape node so it can be
+            # differentiated again
+            g.stop_gradient = not create_graph
+            results.append(g)
         else:
-            out = Tensor(g, stop_gradient=not create_graph)
-            results.append(out)
+            results.append(Tensor(g, stop_gradient=not create_graph))
     return results

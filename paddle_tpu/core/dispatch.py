@@ -146,7 +146,8 @@ def _run_op_impl(name, jax_fn, operands, num_nondiff_outputs,
         out_list = outs if isinstance(outs, tuple) else (outs,)
         node = _ag.TapeNode(
             name, node_inputs, vjp_fn,
-            [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_list])
+            [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_list],
+            fn=f, single_out=not isinstance(outs, tuple))
 
     single = not isinstance(outs, tuple)
     out_list = (outs,) if single else outs

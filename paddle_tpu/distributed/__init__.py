@@ -23,6 +23,9 @@ from .store import TCPStore, create_or_get_global_tcp_store  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from .long_context import (ring_attention, ulysses_attention,  # noqa: F401
                            ring_attention_local, ulysses_attention_local)
+from . import passes  # noqa: F401
+from .comm_watchdog import (CommTaskManager, CommTimeoutError,  # noqa: F401
+                            get_comm_task_manager, set_comm_task_manager)
 
 alltoall = all_to_all
 alltoall_single = all_to_all_single

@@ -1,0 +1,129 @@
+"""Host-side communication watchdog.
+
+Capability parity with the reference's CommTaskManager
+(reference: paddle/phi/core/distributed/comm_task_manager.cc:67 +
+nccl_comm_task.cc): background threads poll in-flight collectives for
+timeout and abort the job with a diagnosable error instead of hanging.
+
+TPU-native design: collectives are compiled into XLA programs, so there is
+no per-collective task object to poll — the observable hang surface is a
+device sync (``block_until_ready`` / host barrier) that never returns
+(e.g. a peer host died mid all-reduce on a pod, or the TPU tunnel
+dropped). The watchdog runs the sync on a worker thread with a deadline;
+on expiry it fires the hang callback (elastic integration: mark the node
+unhealthy so the launcher relaunches) and raises ``CommTimeoutError``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+
+from ..core import flags as _flags
+
+__all__ = ["CommTimeoutError", "CommTaskManager",
+           "get_comm_task_manager", "set_comm_task_manager"]
+
+_flags.define_flag("comm_timeout_s", 0.0,
+                   "watchdog deadline (seconds) for device syncs/barriers; "
+                   "0 disables")
+
+
+class CommTimeoutError(RuntimeError):
+    """A device sync did not complete within the watchdog deadline
+    (the reference aborts via the comm task's error state)."""
+
+
+class CommTaskManager:
+    def __init__(self, timeout_s: Optional[float] = None,
+                 on_hang: Optional[Callable[[str, float], None]] = None):
+        self._timeout = timeout_s
+        self._on_hang = on_hang
+        self._hang_count = 0
+
+    @property
+    def hang_count(self) -> int:
+        return self._hang_count
+
+    def _deadline(self, timeout_s):
+        if timeout_s is not None:
+            return timeout_s
+        if self._timeout is not None:
+            return self._timeout
+        return float(_flags.get_flag("comm_timeout_s") or 0.0)
+
+    def wait(self, value, desc: str = "collective",
+             timeout_s: Optional[float] = None, waiter=None):
+        """Block until ``value``'s device work completes, bounded by the
+        deadline. ``waiter`` overrides the sync callable (tests / custom
+        transports). Deadline <= 0 degrades to an unbounded sync."""
+        deadline = self._deadline(timeout_s)
+        sync = waiter if waiter is not None \
+            else (lambda: jax.block_until_ready(value))
+        if deadline <= 0:
+            return sync()
+
+        done = threading.Event()
+        box = {}
+
+        def work():
+            try:
+                box["out"] = sync()
+            except Exception as e:  # propagate device errors to the caller
+                box["err"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=work, daemon=True,
+                             name=f"comm-watchdog:{desc}")
+        start = time.monotonic()
+        t.start()
+        if not done.wait(deadline):
+            self._hang_count += 1
+            elapsed = time.monotonic() - start
+            if self._on_hang is not None:
+                try:
+                    self._on_hang(desc, elapsed)
+                except Exception:
+                    pass
+            self._notify_elastic(desc)
+            raise CommTimeoutError(
+                f"'{desc}' did not complete within {deadline:.1f}s "
+                f"(waited {elapsed:.1f}s) — a peer may be down or the "
+                "device link hung (reference: CommTaskManager watchdog)")
+        if "err" in box:
+            raise box["err"]
+        return box.get("out")
+
+    def barrier(self, desc: str = "barrier",
+                timeout_s: Optional[float] = None):
+        """Deadline-bounded host barrier: a trivial device round-trip."""
+        import jax.numpy as jnp
+        return self.wait(jnp.zeros(()) + 0, desc=desc, timeout_s=timeout_s)
+
+    def _notify_elastic(self, desc: str) -> None:
+        """Elastic integration (reference: watchdog error propagation aborts
+        training so the elastic manager relaunches): flag the local agent
+        unhealthy if one is running."""
+        try:
+            from .fleet.elastic.manager import notify_comm_hang
+        except Exception:
+            return
+        try:
+            notify_comm_hang(desc)
+        except Exception:
+            pass
+
+
+_GLOBAL = CommTaskManager()
+
+
+def get_comm_task_manager() -> CommTaskManager:
+    return _GLOBAL
+
+
+def set_comm_task_manager(m: CommTaskManager) -> None:
+    global _GLOBAL
+    _GLOBAL = m

@@ -436,9 +436,14 @@ def batch_isend_irecv(p2p_op_list):
 
 
 def barrier(group=None):
-    """Host barrier (parity: dist.barrier). Single-controller: device sync."""
+    """Host barrier (parity: dist.barrier). Single-controller: device sync,
+    watchdog-bounded when FLAGS_comm_timeout_s > 0 (reference:
+    CommTaskManager hang detection)."""
+    from .comm_watchdog import CommTimeoutError, get_comm_task_manager
     try:
-        (jnp.zeros(()) + 0).block_until_ready()
+        get_comm_task_manager().barrier()
+    except CommTimeoutError:
+        raise
     except Exception:
         pass
 
@@ -446,7 +451,8 @@ def barrier(group=None):
 def wait(tensor, group=None, use_calc_stream=True):
     arr = _unwrap(tensor)
     if not _is_tracer(arr):
-        jax.block_until_ready(arr)
+        from .comm_watchdog import get_comm_task_manager
+        get_comm_task_manager().wait(arr, desc="wait")
     return tensor
 
 

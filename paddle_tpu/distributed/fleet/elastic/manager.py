@@ -65,8 +65,10 @@ class ElasticManager:
         self._stop.clear()
         self._thread = threading.Thread(target=self._beat, daemon=True)
         self._thread.start()
+        _ACTIVE_MANAGERS[id(self)] = self
 
     def stop(self):
+        _ACTIVE_MANAGERS.pop(id(self), None)
         self._stop.set()
         if self._thread:
             self._thread.join(self.interval * 3)
@@ -140,3 +142,22 @@ class ElasticManager:
 
     def current_epoch(self) -> int:
         return self.store.add(self._epoch_key, 0)
+
+
+# comm-watchdog integration (reference: the NCCL watchdog aborts training
+# so the elastic layer relaunches rather than letting the job hang).
+# Weak values: a manager abandoned without stop() must not be kept alive
+# (pinning its store/threads) nor have its stale job epoch bumped later.
+import weakref  # noqa: E402
+
+_ACTIVE_MANAGERS: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+
+
+def notify_comm_hang(desc: str) -> None:
+    """Called by CommTaskManager when a device sync times out: signal a
+    restart on every active elastic manager so the cluster re-rendezvous."""
+    for m in list(_ACTIVE_MANAGERS.values()):
+        try:
+            m.signal_restart()
+        except Exception:
+            pass

@@ -1,0 +1,239 @@
+"""Composable distributed optimization passes.
+
+Capability parity with the reference's pass library
+(reference: python/paddle/distributed/passes/ — 13.8k LoC: pass_base.py
+registry + amp / gradient-merge / master-grad / recompute / comm-overlap
+passes applied by the auto-parallel Parallelizer).
+
+TPU-native design: the reference's passes rewrite static programs; here a
+pass transforms the live training objects (optimizer wrapper, model
+wrapper, amp policy) — XLA owns the graph-level rewrites the reference
+does by hand (fusion, comm overlap, inplace), so only the passes with
+training-semantic content survive the translation.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["PassBase", "register_pass", "new_pass", "PassContext",
+           "apply_passes"]
+
+_PASS_REGISTRY: Dict[str, type] = {}
+
+
+def register_pass(name: str):
+    def deco(cls):
+        cls.name = name
+        _PASS_REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+class PassContext:
+    """Mutable bag the passes read/write (parity: PassContext)."""
+
+    def __init__(self, model=None, optimizer=None, strategy=None):
+        self.model = model
+        self.optimizer = optimizer
+        self.strategy = strategy
+
+
+class PassBase:
+    """A pass checks applicability then transforms the context
+    (parity: pass_base.py PassBase._check_self/_apply_impl)."""
+
+    name = "base"
+
+    def __init__(self, attrs: Optional[dict] = None):
+        self.attrs = dict(attrs or {})
+
+    def check(self, ctx: PassContext) -> bool:
+        return True
+
+    def apply(self, ctx: PassContext) -> PassContext:
+        raise NotImplementedError
+
+
+def new_pass(name: str, attrs: Optional[dict] = None) -> PassBase:
+    if name not in _PASS_REGISTRY:
+        raise KeyError(
+            f"unknown pass '{name}'; registered: {sorted(_PASS_REGISTRY)}")
+    return _PASS_REGISTRY[name](attrs)
+
+
+def apply_passes(names, model=None, optimizer=None, strategy=None):
+    """Apply passes in order; returns the transformed PassContext."""
+    ctx = PassContext(model, optimizer, strategy)
+    for item in names:
+        name, attrs = item if isinstance(item, tuple) else (item, None)
+        p = new_pass(name, attrs)
+        if p.check(ctx):
+            ctx = p.apply(ctx)
+    return ctx
+
+
+# -- gradient merge ----------------------------------------------------------
+
+class _GradientMergeOptimizer:
+    """Accumulate grads for k steps, apply on the k-th (reference
+    auto_parallel_gradient_merge.py): step()/clear_grad() on non-boundary
+    steps leave ``.grad`` accumulating; the boundary step optionally
+    averages and runs the real optimizer."""
+
+    def __init__(self, inner, k_steps: int, avg: bool = True):
+        self._inner_opt = inner
+        self._k = max(1, int(k_steps))
+        self._avg = avg
+        self._acc = 0
+
+    @property
+    def is_boundary(self) -> bool:
+        return self._acc == 0
+
+    def step(self):
+        self._acc += 1
+        if self._acc < self._k:
+            return
+        self._acc = 0
+        if self._avg and self._k > 1:
+            for p in (self._inner_opt._parameter_list or []):
+                if p.grad is not None:
+                    p.grad = Tensor(p.grad._data / self._k)
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        # grads must survive across the merge window; only the boundary
+        # step really clears
+        if self._acc == 0:
+            self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner_opt"], name)
+
+
+@register_pass("gradient_merge")
+@register_pass("auto_parallel_gradient_merge_pass")
+class GradientMergePass(PassBase):
+    """attrs: k_steps (int), avg (bool)."""
+
+    def check(self, ctx):
+        return ctx.optimizer is not None and \
+            self.attrs.get("k_steps", 1) > 1
+
+    def apply(self, ctx):
+        ctx.optimizer = _GradientMergeOptimizer(
+            ctx.optimizer, self.attrs.get("k_steps", 1),
+            self.attrs.get("avg", True))
+        return ctx
+
+
+# -- master grad -------------------------------------------------------------
+
+class _MasterGradOptimizer:
+    """fp32 master gradients for low-precision params (reference
+    auto_parallel_master_grad.py): grads are upcast to fp32 at every
+    ``step()`` call. Composed OUTSIDE gradient_merge (the apply_passes
+    order ``[gradient_merge, master_grad]`` produces exactly that), the
+    upcast runs on every micro-step, so after the first micro-batch the
+    accumulator is fp32 and later bf16/fp16 contributions are added in
+    fp32 — micro-contributions cannot round away."""
+
+    _LOW_PRECISION = (jnp.bfloat16, jnp.float16)
+
+    def __init__(self, inner):
+        self._inner_opt = inner
+
+    def _upcast(self):
+        for p in (self._inner_opt._parameter_list or []):
+            g = p.grad
+            if g is not None and g._data.dtype in self._LOW_PRECISION:
+                p.grad = Tensor(g._data.astype(jnp.float32))
+
+    def step(self):
+        self._upcast()
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner_opt"], name)
+
+
+@register_pass("master_grad")
+@register_pass("auto_parallel_master_grad_pass")
+class MasterGradPass(PassBase):
+    def check(self, ctx):
+        return ctx.optimizer is not None
+
+    def apply(self, ctx):
+        ctx.optimizer = _MasterGradOptimizer(ctx.optimizer)
+        return ctx
+
+
+# -- recompute ---------------------------------------------------------------
+
+@register_pass("recompute")
+@register_pass("auto_parallel_recompute_pass")
+class RecomputePass(PassBase):
+    """attrs: sublayers (list of Layer) — wraps each listed sublayer's
+    forward in activation recompute (reference auto_parallel_recompute.py
+    rewrites the program; here the dygraph recompute API does the same
+    trade)."""
+
+    def check(self, ctx):
+        return ctx.model is not None
+
+    def apply(self, ctx):
+        from ..fleet.recompute import recompute
+        targets = self.attrs.get("sublayers")
+        if targets is None:
+            targets = [lyr for lyr in ctx.model.sublayers()
+                       if type(lyr).__name__ in
+                       self.attrs.get("layer_types",
+                                      ("TransformerEncoderLayer",
+                                       "LlamaDecoderLayer"))]
+        for lyr in targets:
+            if getattr(lyr, "_recompute_wrapped", False):
+                continue
+            orig = lyr.forward
+
+            def wrapped(*a, _orig=orig, **k):
+                return recompute(_orig, *a, **k)
+            lyr.forward = wrapped
+            lyr._recompute_wrapped = True
+        return ctx
+
+
+# -- amp ---------------------------------------------------------------------
+
+@register_pass("amp")
+@register_pass("auto_parallel_amp_pass")
+class AMPPass(PassBase):
+    """attrs: dtype ('bfloat16'|'float16'), level ('O1'|'O2') — wraps the
+    model's forward in auto_cast (reference auto_parallel_amp.py inserts
+    cast ops; the amp_state policy does it per-op here)."""
+
+    def check(self, ctx):
+        return ctx.model is not None
+
+    def apply(self, ctx):
+        from ...amp import auto_cast
+        dtype = self.attrs.get("dtype", "bfloat16")
+        level = self.attrs.get("level", "O1")
+        model = ctx.model
+        orig = model.forward
+
+        def wrapped(*a, **k):
+            with auto_cast(level=level, dtype=dtype):
+                return orig(*a, **k)
+        model.forward = wrapped
+        return ctx

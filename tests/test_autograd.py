@@ -133,3 +133,53 @@ def test_nan_inf_flag():
             paddle.divide(paddle.to_tensor([1.0, 1.0]), x)
     finally:
         paddle.set_flags({"check_nan_inf": False})
+
+
+class TestDoubleBackward:
+    """create_graph=True re-tapes the vjp of every node (the reference
+    generates higher-order GradNodes per op; SURVEY §2.4)."""
+
+    def test_second_derivative_of_cube(self):
+        from paddle_tpu.autograd import grad
+        x = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+        x.stop_gradient = False
+        y = (x ** 3).sum()
+        (g1,) = grad(y, x, create_graph=True)
+        np.testing.assert_allclose(g1.numpy(), 3 * np.array([4.0, 9.0]),
+                                   rtol=1e-5)
+        (g2,) = grad(g1.sum(), x)
+        np.testing.assert_allclose(g2.numpy(), 6 * np.array([2.0, 3.0]),
+                                   rtol=1e-5)
+
+    def test_gradient_penalty_reaches_params(self):
+        """d/dw of ||dL/dx||^2 — the second backward must differentiate the
+        vjp w.r.t. its saved primals, not only the cotangents."""
+        from paddle_tpu.autograd import grad
+        w = paddle.to_tensor(np.array([1.5], np.float32))
+        w.stop_gradient = False
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        x.stop_gradient = False
+        L = ((w * x).sum()) ** 2
+        (gx,) = grad(L, x, create_graph=True)
+        (gw,) = grad((gx ** 2).sum(), w)
+        # gx = 2w^2 x; pen = 4w^4x^2; d pen/dw = 16 w^3 x^2
+        np.testing.assert_allclose(gw.numpy(), [16 * 1.5 ** 3 * 4.0],
+                                   rtol=1e-5)
+
+    def test_matmul_tanh_grad_of_grad_finite(self):
+        from paddle_tpu.autograd import grad
+        a = paddle.to_tensor(
+            np.random.RandomState(0).randn(3, 3).astype(np.float32))
+        a.stop_gradient = False
+        out = paddle.tanh(paddle.matmul(a, a)).sum()
+        (g,) = grad(out, a, create_graph=True)
+        (gg,) = grad((g * g).sum(), a)
+        assert gg.shape == [3, 3]
+        assert np.isfinite(gg.numpy()).all()
+
+    def test_create_graph_false_grads_are_detached(self):
+        from paddle_tpu.autograd import grad
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        x.stop_gradient = False
+        (g,) = grad((x ** 2).sum(), x)
+        assert g.stop_gradient

@@ -1,0 +1,201 @@
+"""Comm watchdog (reference comm_task_manager.cc:67) and distributed
+optimization passes (reference python/paddle/distributed/passes/)."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+class TestCommWatchdog:
+    def test_completed_sync_passes_through(self):
+        m = dist.CommTaskManager(timeout_s=30.0)
+        import jax.numpy as jnp
+        m.wait(jnp.ones(4) * 2, desc="ok-collective")
+
+    def test_hang_raises_and_fires_callback(self):
+        hangs = []
+        m = dist.CommTaskManager(timeout_s=0.2,
+                                 on_hang=lambda d, t: hangs.append(d))
+        with pytest.raises(dist.CommTimeoutError, match="hung-collective"):
+            m.wait(None, desc="hung-collective",
+                   waiter=lambda: time.sleep(10))
+        assert hangs == ["hung-collective"]
+        assert m.hang_count == 1
+
+    def test_device_error_propagates(self):
+        m = dist.CommTaskManager(timeout_s=5.0)
+
+        def boom():
+            raise RuntimeError("device exploded")
+        with pytest.raises(RuntimeError, match="device exploded"):
+            m.wait(None, waiter=boom)
+
+    def test_disabled_deadline_runs_unbounded(self):
+        m = dist.CommTaskManager(timeout_s=0)
+        out = m.wait(None, waiter=lambda: "done")
+        assert out == "done"
+
+    def test_hang_signals_elastic_restart(self):
+        """Watchdog -> elastic integration: a hang bumps the job epoch so
+        every node re-enters rendezvous (the reference aborts training for
+        the elastic layer to relaunch)."""
+        from paddle_tpu.distributed.fleet.elastic.manager import ElasticManager
+        from paddle_tpu.distributed.store import TCPStore
+        store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+        em = ElasticManager(store, node_id="n0", np_target=1,
+                            heartbeat_interval=0.1, heartbeat_timeout=1.0)
+        em.start()
+        try:
+            epoch0 = em.current_epoch()
+            m = dist.CommTaskManager(timeout_s=0.2)
+            with pytest.raises(dist.CommTimeoutError):
+                m.wait(None, desc="allreduce",
+                       waiter=lambda: time.sleep(5))
+            assert em.current_epoch() == epoch0 + 1
+        finally:
+            em.stop()
+            store.close()
+
+
+class TestGradientMergePass:
+    def test_merge_matches_full_batch(self):
+        paddle.seed(5)
+        m1 = paddle.nn.Linear(8, 8)
+        m2 = paddle.nn.Linear(8, 8)
+        m2.set_state_dict(m1.state_dict())
+        k = 4
+        opt1 = dist.passes.apply_passes(
+            [("gradient_merge", {"k_steps": k, "avg": True})],
+            optimizer=paddle.optimizer.SGD(
+                0.1, parameters=m1.parameters())).optimizer
+        opt2 = paddle.optimizer.SGD(0.1, parameters=m2.parameters())
+
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(8, 8).astype(np.float32))
+        # merged: k micro-steps of 2 rows each
+        for i in range(k):
+            loss = (m1(x[2 * i:2 * i + 2]) ** 2).sum()
+            loss.backward()
+            opt1.step()
+            opt1.clear_grad()
+        # plain: one step on the summed-then-averaged grads
+        total = None
+        for i in range(k):
+            l = (m2(x[2 * i:2 * i + 2]) ** 2).sum()
+            total = l if total is None else total + l
+        (total / k).backward()
+        opt2.step()
+        opt2.clear_grad()
+        np.testing.assert_allclose(np.asarray(m1.weight._data),
+                                   np.asarray(m2.weight._data),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_non_boundary_steps_do_not_update(self):
+        w = paddle.nn.Parameter(np.ones(4, np.float32))
+        opt = dist.passes.new_pass(
+            "gradient_merge", {"k_steps": 3}).apply(
+            dist.passes.PassContext(
+                optimizer=paddle.optimizer.SGD(
+                    1.0, parameters=[w]))).optimizer
+        def accumulate_grad():  # what backward() does: +=
+            one = paddle.to_tensor(np.ones(4, np.float32))
+            w.grad = one if w.grad is None else w.grad + one
+
+        for i in range(2):
+            accumulate_grad()
+            opt.step()
+            opt.clear_grad()  # non-boundary: must NOT clear
+            assert w.grad is not None
+            np.testing.assert_allclose(np.asarray(w._data), np.ones(4))
+        accumulate_grad()
+        opt.step()  # boundary: applies avg grad 3/3 = 1.0
+        np.testing.assert_allclose(np.asarray(w._data), np.zeros(4))
+
+
+class TestMasterGradPass:
+    def test_bf16_grads_upcast_before_step(self):
+        import jax.numpy as jnp
+        w = paddle.nn.Parameter(np.ones(4, np.float32))
+        opt = dist.passes.apply_passes(
+            ["master_grad"],
+            optimizer=paddle.optimizer.SGD(1.0, parameters=[w])).optimizer
+        w.grad = paddle.Tensor(jnp.full(4, 0.5, jnp.bfloat16))
+        opt.step()
+        assert w.grad._data.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(w._data), np.full(4, 0.5))
+
+
+class TestAMPAndRecomputePasses:
+    def test_amp_pass_wraps_forward(self):
+        import jax.numpy as jnp
+        m = paddle.nn.Linear(8, 8)
+        dist.passes.apply_passes([("amp", {"dtype": "bfloat16"})], model=m)
+        out = m(paddle.to_tensor(np.random.randn(2, 8).astype(np.float32)))
+        assert out.dtype == jnp.bfloat16
+
+    def test_recompute_pass_wraps_named_layers(self):
+        m = paddle.nn.Sequential(
+            paddle.nn.TransformerEncoderLayer(
+                d_model=16, nhead=2, dim_feedforward=32, dropout=0.0),
+            paddle.nn.Linear(16, 16))
+        dist.passes.apply_passes(["recompute"], model=m)
+        enc = m[0]
+        assert getattr(enc, "_recompute_wrapped", False)
+        x = paddle.to_tensor(np.random.randn(2, 4, 16).astype(np.float32))
+        x.stop_gradient = False
+        out = m(x)
+        out.sum().backward()
+        assert x.grad is not None
+
+    def test_unknown_pass_raises(self):
+        with pytest.raises(KeyError, match="unknown pass"):
+            dist.passes.new_pass("does_not_exist")
+
+
+class TestPassComposition:
+    def test_master_grad_keeps_merge_accumulation_fp32(self):
+        """[gradient_merge, master_grad] order: upcast runs every
+        micro-step, so accumulation across the merge window is fp32."""
+        import jax.numpy as jnp
+        w = paddle.nn.Parameter(np.ones(4, np.float32))
+        opt = dist.passes.apply_passes(
+            [("gradient_merge", {"k_steps": 3, "avg": False}),
+             "master_grad"],
+            optimizer=paddle.optimizer.SGD(1.0, parameters=[w])).optimizer
+        for i in range(3):
+            g = paddle.Tensor(jnp.full(4, 2.0 ** -9, jnp.bfloat16))
+            w.grad = g if w.grad is None else w.grad + g
+            opt.step()
+            opt.clear_grad()
+            if i < 2:
+                assert w.grad._data.dtype == jnp.float32
+        # 3 * 2^-9 accumulated exactly in fp32
+        np.testing.assert_allclose(np.asarray(w._data),
+                                   np.full(4, 1.0 - 3 * 2.0 ** -9),
+                                   rtol=1e-6)
+
+    def test_float16_grads_also_upcast(self):
+        import jax.numpy as jnp
+        w = paddle.nn.Parameter(np.ones(4, np.float32))
+        opt = dist.passes.apply_passes(
+            ["master_grad"],
+            optimizer=paddle.optimizer.SGD(1.0, parameters=[w])).optimizer
+        w.grad = paddle.Tensor(jnp.full(4, 0.25, jnp.float16))
+        opt.step()
+        assert w.grad._data.dtype == jnp.float32
+
+
+class TestCreateGraphOpaqueVjp:
+    def test_recompute_under_create_graph_raises(self):
+        """Second-order grads through an opaque (recompute/PyLayer) vjp
+        would be silently wrong — must fail loudly instead."""
+        from paddle_tpu.autograd import grad
+        from paddle_tpu.distributed.fleet import recompute
+        w = paddle.to_tensor(np.array([1.5], np.float32))
+        w.stop_gradient = False
+        L = recompute(lambda t: (t ** 3).sum(), w)
+        with pytest.raises(RuntimeError, match="create_graph"):
+            grad(L, w, create_graph=True)
