@@ -50,9 +50,21 @@ def synchronize(device=None):
         pass
 
 
+def memory_stats(device=None) -> dict:
+    """Raw allocator statistics of the accelerator (parity:
+    paddle/fluid/memory/stats.h surface): the XLA allocator's
+    bytes_in_use / peak_bytes_in_use / bytes_limit / num_allocs counters.
+    Empty dict on platforms whose client doesn't report (CPU)."""
+    del device
+    try:
+        return jax.devices()[0].memory_stats() or {}
+    except Exception:
+        return {}
+
+
 class cuda:
     """Namespace parity shim: paddle.device.cuda.* memory statistics map to
-    jax memory_stats on the TPU device."""
+    the XLA allocator's memory_stats on the TPU device."""
 
     @staticmethod
     def device_count():
@@ -60,27 +72,19 @@ class cuda:
 
     @staticmethod
     def max_memory_allocated(device=None):
-        d = jax.devices()[0]
-        stats = d.memory_stats() or {}
-        return stats.get("peak_bytes_in_use", 0)
+        return memory_stats().get("peak_bytes_in_use", 0)
 
     @staticmethod
     def memory_allocated(device=None):
-        d = jax.devices()[0]
-        stats = d.memory_stats() or {}
-        return stats.get("bytes_in_use", 0)
+        return memory_stats().get("bytes_in_use", 0)
 
     @staticmethod
     def max_memory_reserved(device=None):
-        d = jax.devices()[0]
-        stats = d.memory_stats() or {}
-        return stats.get("peak_bytes_in_use", 0)
+        return memory_stats().get("peak_bytes_in_use", 0)
 
     @staticmethod
     def memory_reserved(device=None):
-        d = jax.devices()[0]
-        stats = d.memory_stats() or {}
-        return stats.get("bytes_limit", 0)
+        return memory_stats().get("bytes_limit", 0)
 
     @staticmethod
     def empty_cache():

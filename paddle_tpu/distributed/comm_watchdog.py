@@ -15,7 +15,6 @@ unhealthy so the launcher relaunches) and raises ``CommTimeoutError``.
 """
 from __future__ import annotations
 
-import threading
 import time
 from typing import Callable, Optional
 
@@ -42,6 +41,14 @@ class CommTaskManager:
         self._timeout = timeout_s
         self._on_hang = on_hang
         self._hang_count = 0
+        self._pool = None  # one persistent watchdog worker, not per-call
+
+    def _submit(self, fn):
+        from concurrent.futures import ThreadPoolExecutor
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="comm-watchdog")
+        return self._pool.submit(fn)
 
     @property
     def hang_count(self) -> int:
@@ -65,24 +72,18 @@ class CommTaskManager:
         if deadline <= 0:
             return sync()
 
-        done = threading.Event()
-        box = {}
-
-        def work():
-            try:
-                box["out"] = sync()
-            except Exception as e:  # propagate device errors to the caller
-                box["err"] = e
-            finally:
-                done.set()
-
-        t = threading.Thread(target=work, daemon=True,
-                             name=f"comm-watchdog:{desc}")
+        from concurrent.futures import TimeoutError as FuturesTimeout
         start = time.monotonic()
-        t.start()
-        if not done.wait(deadline):
+        fut = self._submit(sync)
+        try:
+            return fut.result(deadline)  # device errors re-raise here
+        except FuturesTimeout:
             self._hang_count += 1
             elapsed = time.monotonic() - start
+            # the worker is stuck inside the sync: abandon this pool so the
+            # next wait gets a fresh worker instead of queueing behind it
+            pool, self._pool = self._pool, None
+            pool.shutdown(wait=False)
             if self._on_hang is not None:
                 try:
                     self._on_hang(desc, elapsed)
@@ -92,10 +93,8 @@ class CommTaskManager:
             raise CommTimeoutError(
                 f"'{desc}' did not complete within {deadline:.1f}s "
                 f"(waited {elapsed:.1f}s) — a peer may be down or the "
-                "device link hung (reference: CommTaskManager watchdog)")
-        if "err" in box:
-            raise box["err"]
-        return box.get("out")
+                "device link hung (reference: CommTaskManager watchdog)"
+            ) from None
 
     def barrier(self, desc: str = "barrier",
                 timeout_s: Optional[float] = None):

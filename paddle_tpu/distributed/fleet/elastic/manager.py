@@ -61,9 +61,16 @@ class ElasticManager:
     # a dead one (the reference leans on etcd lease TTLs for the same
     # property).
     def start(self):
+        import weakref as _weakref
         self.store.add(f"{self.prefix}/hb/{self.node_id}", 1)
         self._stop.clear()
-        self._thread = threading.Thread(target=self._beat, daemon=True)
+        # the beat thread holds only a WEAK ref to self: an abandoned
+        # manager (no stop() call) must stay collectible so the
+        # _ACTIVE_MANAGERS weak registry can drop it
+        self._thread = threading.Thread(
+            target=_beat_loop,
+            args=(_weakref.ref(self), self._stop, self.interval),
+            daemon=True)
         self._thread.start()
         _ACTIVE_MANAGERS[id(self)] = self
 
@@ -75,12 +82,8 @@ class ElasticManager:
             self._thread = None
         self.store.set(f"{self.prefix}/hb/{self.node_id}", "")
 
-    def _beat(self):
-        while not self._stop.wait(self.interval):
-            try:
-                self.store.add(f"{self.prefix}/hb/{self.node_id}", 1)
-            except Exception:
-                return  # store gone: the watcher will see us dead
+    def _beat(self):  # kept for API compatibility; start() uses _beat_loop
+        _beat_loop(lambda: self, self._stop, self.interval)
 
     # -- membership --------------------------------------------------------
     def register_nodes(self, node_ids: List[str]):
@@ -142,6 +145,21 @@ class ElasticManager:
 
     def current_epoch(self) -> int:
         return self.store.add(self._epoch_key, 0)
+
+
+def _beat_loop(ref, stop_event, interval):
+    """Heartbeat loop resolving the manager through a weak ref each tick:
+    when the manager is garbage (abandoned without stop()), the thread
+    exits instead of pinning it alive forever."""
+    while not stop_event.wait(interval):
+        m = ref()
+        if m is None:
+            return
+        try:
+            m.store.add(f"{m.prefix}/hb/{m.node_id}", 1)
+        except Exception:
+            return  # store gone: the watcher will see us dead
+        del m  # don't hold the strong ref across the sleep
 
 
 # comm-watchdog integration (reference: the NCCL watchdog aborts training

@@ -18,7 +18,15 @@ from ..core.dispatch import run_op
 from ..core.tensor import Tensor
 
 __all__ = ["Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
-           "Exponential", "kl_divergence", "register_kl"]
+           "Exponential", "kl_divergence", "register_kl",
+           # continuous.py
+           "Beta", "Gamma", "Dirichlet", "Laplace", "Multinomial",
+           "LogNormal", "Gumbel", "Geometric", "Cauchy", "StudentT",
+           "Poisson", "Binomial", "Chi2", "Independent",
+           # transform.py
+           "Transform", "AffineTransform", "ExpTransform",
+           "SigmoidTransform", "TanhTransform", "PowerTransform",
+           "ChainTransform", "TransformedDistribution"]
 
 
 def _tensor(x) -> Tensor:
@@ -300,3 +308,14 @@ def _kl_exponential(p, q):
     def fn(pr, qr):
         return jnp.log(pr / qr) + qr / pr - 1.0
     return run_op("kl_exponential_exponential", fn, (p.rate, q.rate))
+
+
+# second wave (import at the end: continuous.py/transform.py need the base
+# classes and the KL registry defined above)
+from .continuous import (Beta, Gamma, Dirichlet, Laplace,  # noqa: E402,F401
+                         Multinomial, LogNormal, Gumbel, Geometric, Cauchy,
+                         StudentT, Poisson, Binomial, Chi2, Independent)
+from .transform import (Transform, AffineTransform,  # noqa: E402,F401
+                        ExpTransform, SigmoidTransform, TanhTransform,
+                        PowerTransform, ChainTransform,
+                        TransformedDistribution)

@@ -1,0 +1,584 @@
+"""Second wave of distributions (parity: python/paddle/distribution/ —
+beta.py, gamma.py, dirichlet.py, laplace.py, multinomial.py, lognormal.py,
+gumbel.py, geometric.py, cauchy.py, student_t.py, poisson.py, binomial.py,
+chi2.py, independent.py).
+
+TPU-native: samplers use jax.random's reparameterized primitives (gamma's
+implicit gradients give differentiable rsample for Gamma/Beta/Dirichlet —
+the reference's CPU/GPU kernels don't differentiate through gamma
+sampling); densities go through the dispatch funnel so parameters train.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.scipy.special import (betaln, digamma, gammaincc, gammaln, xlog1py,
+                               xlogy)
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor
+from . import Distribution, _tensor, register_kl
+
+__all__ = ["Beta", "Gamma", "Dirichlet", "Laplace", "Multinomial",
+           "LogNormal", "Gumbel", "Geometric", "Cauchy", "StudentT",
+           "Poisson", "Binomial", "Chi2", "Independent"]
+
+_EULER = float(np.euler_gamma)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        del name
+        self.concentration = _tensor(concentration)
+        self.rate = _tensor(rate)
+        super().__init__(jnp.broadcast_shapes(
+            self.concentration._data.shape, self.rate._data.shape))
+
+    @property
+    def mean(self):
+        return run_op("gamma_mean", lambda a, r: a / r,
+                      (self.concentration, self.rate))
+
+    @property
+    def variance(self):
+        return run_op("gamma_var", lambda a, r: a / r ** 2,
+                      (self.concentration, self.rate))
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        key = self._key()
+
+        def fn(a, r):
+            g = jax.random.gamma(key, jnp.broadcast_to(a, shape))
+            return g / r
+        return run_op("gamma_rsample", fn, (self.concentration, self.rate))
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        def fn(v, a, r):
+            return (xlogy(a, r) + xlogy(a - 1, v) - r * v - gammaln(a))
+        return run_op("gamma_log_prob", fn,
+                      (value, self.concentration, self.rate))
+
+    def entropy(self):
+        def fn(a, r):
+            return a - jnp.log(r) + gammaln(a) + (1 - a) * digamma(a)
+        return run_op("gamma_entropy", fn, (self.concentration, self.rate))
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        df_t = _tensor(df)
+        self.df = df_t
+        super().__init__(
+            Tensor(df_t._data / 2.0, stop_gradient=df_t.stop_gradient),
+            Tensor(jnp.full_like(df_t._data, 0.5)))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        del name
+        self.alpha = _tensor(alpha)
+        self.beta = _tensor(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha._data.shape,
+                                              self.beta._data.shape))
+
+    @property
+    def mean(self):
+        return run_op("beta_mean", lambda a, b: a / (a + b),
+                      (self.alpha, self.beta))
+
+    @property
+    def variance(self):
+        return run_op(
+            "beta_var",
+            lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+            (self.alpha, self.beta))
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        k1, k2 = jax.random.split(self._key())
+
+        def fn(a, b):
+            ga = jax.random.gamma(k1, jnp.broadcast_to(a, shape))
+            gb = jax.random.gamma(k2, jnp.broadcast_to(b, shape))
+            return ga / (ga + gb)
+        return run_op("beta_rsample", fn, (self.alpha, self.beta))
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        def fn(v, a, b):
+            return xlogy(a - 1, v) + xlog1py(b - 1, -v) - betaln(a, b)
+        return run_op("beta_log_prob", fn, (value, self.alpha, self.beta))
+
+    def entropy(self):
+        def fn(a, b):
+            return (betaln(a, b) - (a - 1) * digamma(a)
+                    - (b - 1) * digamma(b)
+                    + (a + b - 2) * digamma(a + b))
+        return run_op("beta_entropy", fn, (self.alpha, self.beta))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        del name
+        self.concentration = _tensor(concentration)
+        shp = self.concentration._data.shape
+        super().__init__(shp[:-1], shp[-1:])
+
+    @property
+    def mean(self):
+        return run_op("dirichlet_mean",
+                      lambda c: c / jnp.sum(c, -1, keepdims=True),
+                      (self.concentration,))
+
+    @property
+    def variance(self):
+        def fn(c):
+            c0 = jnp.sum(c, -1, keepdims=True)
+            m = c / c0
+            return m * (1 - m) / (c0 + 1)
+        return run_op("dirichlet_var", fn, (self.concentration,))
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape + self.event_shape
+        key = self._key()
+
+        def fn(c):
+            g = jax.random.gamma(key, jnp.broadcast_to(c, shape))
+            return g / jnp.sum(g, -1, keepdims=True)
+        return run_op("dirichlet_rsample", fn, (self.concentration,))
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        def fn(v, c):
+            return (jnp.sum(xlogy(c - 1, v), -1)
+                    + gammaln(jnp.sum(c, -1)) - jnp.sum(gammaln(c), -1))
+        return run_op("dirichlet_log_prob", fn,
+                      (value, self.concentration))
+
+    def entropy(self):
+        def fn(c):
+            c0 = jnp.sum(c, -1)
+            k = c.shape[-1]
+            return (jnp.sum(gammaln(c), -1) - gammaln(c0)
+                    + (c0 - k) * digamma(c0)
+                    - jnp.sum((c - 1) * digamma(c), -1))
+        return run_op("dirichlet_entropy", fn, (self.concentration,))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        del name
+        self.loc = _tensor(loc)
+        self.scale = _tensor(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._data.shape,
+                                              self.scale._data.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return run_op("laplace_var", lambda s: 2 * s ** 2, (self.scale,))
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        eps = jax.random.laplace(self._key(), shape)
+        return run_op("laplace_rsample", lambda l, s: l + s * eps,
+                      (self.loc, self.scale))
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        def fn(v, l, s):
+            return -jnp.abs(v - l) / s - jnp.log(2 * s)
+        return run_op("laplace_log_prob", fn,
+                      (value, self.loc, self.scale))
+
+    def entropy(self):
+        return run_op("laplace_entropy",
+                      lambda s: 1.0 + jnp.log(2 * s), (self.scale,))
+
+    def cdf(self, value):
+        def fn(v, l, s):
+            z = (v - l) / s
+            return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+        return run_op("laplace_cdf", fn, (value, self.loc, self.scale))
+
+    def icdf(self, q):
+        def fn(p, l, s):
+            z = p - 0.5
+            return l - s * jnp.sign(z) * jnp.log1p(-2 * jnp.abs(z))
+        return run_op("laplace_icdf", fn, (q, self.loc, self.scale))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        del name
+        self.loc = _tensor(loc)
+        self.scale = _tensor(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._data.shape,
+                                              self.scale._data.shape))
+
+    @property
+    def mean(self):
+        return run_op("lognormal_mean",
+                      lambda l, s: jnp.exp(l + s ** 2 / 2),
+                      (self.loc, self.scale))
+
+    @property
+    def variance(self):
+        return run_op(
+            "lognormal_var",
+            lambda l, s: jnp.expm1(s ** 2) * jnp.exp(2 * l + s ** 2),
+            (self.loc, self.scale))
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        eps = jax.random.normal(self._key(), shape)
+        return run_op("lognormal_rsample",
+                      lambda l, s: jnp.exp(l + s * eps),
+                      (self.loc, self.scale))
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        def fn(v, l, s):
+            lv = jnp.log(v)
+            return (-((lv - l) ** 2) / (2 * s ** 2) - lv - jnp.log(s)
+                    - 0.5 * jnp.log(2 * jnp.pi))
+        return run_op("lognormal_log_prob", fn,
+                      (value, self.loc, self.scale))
+
+    def entropy(self):
+        return run_op(
+            "lognormal_entropy",
+            lambda l, s: l + 0.5 + 0.5 * jnp.log(2 * jnp.pi) + jnp.log(s),
+            (self.loc, self.scale))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        del name
+        self.loc = _tensor(loc)
+        self.scale = _tensor(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._data.shape,
+                                              self.scale._data.shape))
+
+    @property
+    def mean(self):
+        return run_op("gumbel_mean", lambda l, s: l + _EULER * s,
+                      (self.loc, self.scale))
+
+    @property
+    def variance(self):
+        return run_op("gumbel_var",
+                      lambda s: (jnp.pi ** 2 / 6) * s ** 2, (self.scale,))
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        g = jax.random.gumbel(self._key(), shape)
+        return run_op("gumbel_rsample", lambda l, s: l + s * g,
+                      (self.loc, self.scale))
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        def fn(v, l, s):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return run_op("gumbel_log_prob", fn, (value, self.loc, self.scale))
+
+    def entropy(self):
+        return run_op("gumbel_entropy",
+                      lambda s: jnp.log(s) + 1.0 + _EULER, (self.scale,))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        del name
+        self.loc = _tensor(loc)
+        self.scale = _tensor(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._data.shape,
+                                              self.scale._data.shape))
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        c = jax.random.cauchy(self._key(), shape)
+        return run_op("cauchy_rsample", lambda l, s: l + s * c,
+                      (self.loc, self.scale))
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        def fn(v, l, s):
+            return (-jnp.log(jnp.pi) - jnp.log(s)
+                    - jnp.log1p(((v - l) / s) ** 2))
+        return run_op("cauchy_log_prob", fn, (value, self.loc, self.scale))
+
+    def entropy(self):
+        return run_op("cauchy_entropy",
+                      lambda s: jnp.log(4 * jnp.pi * s), (self.scale,))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        del name
+        self.df = _tensor(df)
+        self.loc = _tensor(loc)
+        self.scale = _tensor(scale)
+        super().__init__(jnp.broadcast_shapes(
+            self.df._data.shape, self.loc._data.shape,
+            self.scale._data.shape))
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        key = self._key()
+
+        def fn(df, l, s):
+            t = jax.random.t(key, jnp.broadcast_to(df, shape))
+            return l + s * t
+        return run_op("studentt_rsample", fn,
+                      (self.df, self.loc, self.scale))
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        def fn(v, df, l, s):
+            z = (v - l) / s
+            return (gammaln((df + 1) / 2) - gammaln(df / 2)
+                    - 0.5 * jnp.log(df * jnp.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+        return run_op("studentt_log_prob", fn,
+                      (value, self.df, self.loc, self.scale))
+
+    def entropy(self):
+        def fn(df, s):
+            return ((df + 1) / 2 * (digamma((df + 1) / 2) - digamma(df / 2))
+                    + 0.5 * jnp.log(df) + betaln(df / 2, 0.5) + jnp.log(s))
+        return run_op("studentt_entropy", fn, (self.df, self.scale))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, 2, ... (failures before first
+    success)."""
+
+    def __init__(self, probs, name=None):
+        del name
+        self.probs = _tensor(probs)
+        super().__init__(self.probs._data.shape)
+
+    @property
+    def mean(self):
+        return run_op("geometric_mean", lambda p: (1 - p) / p,
+                      (self.probs,))
+
+    @property
+    def variance(self):
+        return run_op("geometric_var", lambda p: (1 - p) / p ** 2,
+                      (self.probs,))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(self._key(), shape, minval=1e-12)
+        out = jnp.floor(jnp.log(u) / jnp.log1p(-self.probs._data))
+        return Tensor(out, stop_gradient=True)
+
+    def log_prob(self, value):
+        def fn(v, p):
+            return xlog1py(v, -p) + jnp.log(p)
+        return run_op("geometric_log_prob", fn, (value, self.probs))
+
+    def entropy(self):
+        def fn(p):
+            q = 1 - p
+            return -(q * jnp.log(q) + p * jnp.log(p)) / p
+        return run_op("geometric_entropy", fn, (self.probs,))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        del name
+        self.rate = _tensor(rate)
+        super().__init__(self.rate._data.shape)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        out = jax.random.poisson(self._key(), self.rate._data, shape=shape)
+        return Tensor(out.astype(jnp.float32), stop_gradient=True)
+
+    def log_prob(self, value):
+        def fn(v, r):
+            return xlogy(v, r) - r - gammaln(v + 1)
+        return run_op("poisson_log_prob", fn, (value, self.rate))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        del name
+        self.total_count = _tensor(total_count)
+        self.probs = _tensor(probs)
+        super().__init__(jnp.broadcast_shapes(
+            self.total_count._data.shape, self.probs._data.shape))
+
+    @property
+    def mean(self):
+        return run_op("binomial_mean", lambda n, p: n * p,
+                      (self.total_count, self.probs))
+
+    @property
+    def variance(self):
+        return run_op("binomial_var", lambda n, p: n * p * (1 - p),
+                      (self.total_count, self.probs))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        n = jnp.broadcast_to(self.total_count._data, shape)
+        p = jnp.broadcast_to(self.probs._data, shape)
+        out = jax.random.binomial(self._key(), n, p)
+        return Tensor(out.astype(jnp.float32), stop_gradient=True)
+
+    def log_prob(self, value):
+        def fn(v, n, p):
+            logc = (gammaln(n + 1) - gammaln(v + 1) - gammaln(n - v + 1))
+            return logc + xlogy(v, p) + xlog1py(n - v, -p)
+        return run_op("binomial_log_prob", fn,
+                      (value, self.total_count, self.probs))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        del name
+        self.total_count = int(total_count)
+        self.probs = _tensor(probs)
+        shp = self.probs._data.shape
+        super().__init__(shp[:-1], shp[-1:])
+
+    @property
+    def mean(self):
+        return run_op("multinomial_mean",
+                      lambda p: self.total_count * p, (self.probs,))
+
+    @property
+    def variance(self):
+        return run_op("multinomial_var",
+                      lambda p: self.total_count * p * (1 - p),
+                      (self.probs,))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        k = self.probs._data.shape[-1]
+        logits = jnp.log(jnp.clip(self.probs._data, 1e-12))
+        draws = jax.random.categorical(
+            self._key(), logits, shape=(self.total_count,) + shape)
+        counts = jax.nn.one_hot(draws, k).sum(0)
+        return Tensor(counts, stop_gradient=True)
+
+    def log_prob(self, value):
+        def fn(v, p):
+            return (gammaln(jnp.sum(v, -1) + 1)
+                    - jnp.sum(gammaln(v + 1), -1)
+                    + jnp.sum(xlogy(v, p), -1))
+        return run_op("multinomial_log_prob", fn, (value, self.probs))
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost batch dims as event dims
+    (parity: independent.py)."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_rank: int):
+        self.base = base
+        self._n = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        super().__init__(bs[:len(bs) - self._n],
+                         bs[len(bs) - self._n:] + tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        axes = tuple(range(-self._n, 0))
+        return run_op("independent_log_prob",
+                      lambda a: jnp.sum(a, axis=axes), (lp,))
+
+    def entropy(self):
+        ent = self.base.entropy()
+        axes = tuple(range(-self._n, 0))
+        return run_op("independent_entropy",
+                      lambda a: jnp.sum(a, axis=axes), (ent,))
+
+
+# -- KL divergences ----------------------------------------------------------
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    def fn(pa, pr, qa, qr):
+        return ((pa - qa) * digamma(pa) - gammaln(pa) + gammaln(qa)
+                + qa * (jnp.log(pr) - jnp.log(qr)) + pa * (qr - pr) / pr)
+    return run_op("kl_gamma_gamma", fn,
+                  (p.concentration, p.rate, q.concentration, q.rate))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    def fn(pa, pb, qa, qb):
+        return (betaln(qa, qb) - betaln(pa, pb)
+                + (pa - qa) * digamma(pa) + (pb - qb) * digamma(pb)
+                + (qa - pa + qb - pb) * digamma(pa + pb))
+    return run_op("kl_beta_beta", fn, (p.alpha, p.beta, q.alpha, q.beta))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    def fn(pc, qc):
+        p0 = jnp.sum(pc, -1)
+        return (gammaln(p0) - jnp.sum(gammaln(pc), -1)
+                - gammaln(jnp.sum(qc, -1)) + jnp.sum(gammaln(qc), -1)
+                + jnp.sum((pc - qc) * (digamma(pc)
+                                       - digamma(p0[..., None])), -1))
+    return run_op("kl_dirichlet_dirichlet", fn,
+                  (p.concentration, q.concentration))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    def fn(pl, ps, ql, qs):
+        d = jnp.abs(pl - ql)
+        return (jnp.log(qs / ps)
+                + (ps * jnp.exp(-d / ps) + d) / qs - 1.0)
+    return run_op("kl_laplace_laplace", fn,
+                  (p.loc, p.scale, q.loc, q.scale))
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    def fn(pp, qp):
+        return ((1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qp))
+                + jnp.log(pp) - jnp.log(qp))
+    return run_op("kl_geometric_geometric", fn, (p.probs, q.probs))

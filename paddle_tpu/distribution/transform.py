@@ -1,0 +1,182 @@
+"""Distribution transforms (parity: python/paddle/distribution/transform.py —
+Transform base + Affine/Exp/Sigmoid/Tanh/Power/Chain, and
+transformed_distribution.py TransformedDistribution).
+
+Each transform is a differentiable bijection with a log|det J|; densities
+push through via the change-of-variables rule. All math runs through the
+dispatch funnel so transform parameters stay trainable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor
+
+__all__ = ["Transform", "AffineTransform", "ExpTransform",
+           "SigmoidTransform", "TanhTransform", "PowerTransform",
+           "ChainTransform", "TransformedDistribution"]
+
+
+class Transform:
+    """Bijection with log-det-Jacobian (parity: Transform)."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return run_op("t_ildj", lambda a: -a,
+                      (self.forward_log_det_jacobian(self.inverse(y)),))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = loc if isinstance(loc, Tensor) else Tensor(jnp.asarray(loc))
+        self.scale = scale if isinstance(scale, Tensor) \
+            else Tensor(jnp.asarray(scale))
+
+    def forward(self, x):
+        return run_op("affine_fwd", lambda a, l, s: l + s * a,
+                      (x, self.loc, self.scale))
+
+    def inverse(self, y):
+        return run_op("affine_inv", lambda a, l, s: (a - l) / s,
+                      (y, self.loc, self.scale))
+
+    def forward_log_det_jacobian(self, x):
+        return run_op("affine_fldj",
+                      lambda a, s: jnp.broadcast_to(jnp.log(jnp.abs(s)),
+                                                    a.shape),
+                      (x, self.scale))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return run_op("exp_fwd", jnp.exp, (x,))
+
+    def inverse(self, y):
+        return run_op("exp_inv", jnp.log, (y,))
+
+    def forward_log_det_jacobian(self, x):
+        return run_op("exp_fldj", lambda a: a, (x,))
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return run_op("sigmoid_fwd", jax.nn.sigmoid, (x,))
+
+    def inverse(self, y):
+        return run_op("sigmoid_inv",
+                      lambda a: jnp.log(a) - jnp.log1p(-a), (y,))
+
+    def forward_log_det_jacobian(self, x):
+        return run_op("sigmoid_fldj",
+                      lambda a: -jax.nn.softplus(-a) - jax.nn.softplus(a),
+                      (x,))
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return run_op("tanh_fwd", jnp.tanh, (x,))
+
+    def inverse(self, y):
+        return run_op("tanh_inv", jnp.arctanh, (y,))
+
+    def forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2(log 2 - x - softplus(-2x))
+        return run_op(
+            "tanh_fldj",
+            lambda a: 2.0 * (jnp.log(2.0) - a - jax.nn.softplus(-2.0 * a)),
+            (x,))
+
+
+class PowerTransform(Transform):
+    """y = x ** power on the positive half-line."""
+
+    def __init__(self, power):
+        self.power = power if isinstance(power, Tensor) \
+            else Tensor(jnp.asarray(power))
+
+    def forward(self, x):
+        return run_op("power_fwd", lambda a, p: a ** p, (x, self.power))
+
+    def inverse(self, y):
+        return run_op("power_inv", lambda a, p: a ** (1.0 / p),
+                      (y, self.power))
+
+    def forward_log_det_jacobian(self, x):
+        return run_op("power_fldj",
+                      lambda a, p: jnp.log(jnp.abs(p)) + (p - 1) * jnp.log(a),
+                      (x, self.power))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t.forward_log_det_jacobian(x)
+            total = ld if total is None else total + ld
+            x = t.forward(x)
+        return total
+
+
+class TransformedDistribution:
+    """base distribution pushed through transforms
+    (parity: transformed_distribution.py)."""
+
+    def __init__(self, base, transforms):
+        from . import Distribution
+        assert isinstance(base, Distribution)
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transform = ChainTransform(list(transforms))
+        self._batch_shape = base.batch_shape
+        self._event_shape = base.event_shape
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        return self.transform.forward(self.base.sample(shape)).detach()
+
+    def rsample(self, shape=()):
+        return self.transform.forward(self.base.rsample(shape))
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        base_lp = self.base.log_prob(x)
+        return base_lp - self.transform.forward_log_det_jacobian(x)
+
+    def prob(self, value):
+        return run_op("tdist_prob", jnp.exp, (self.log_prob(value),))

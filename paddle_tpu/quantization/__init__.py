@@ -17,8 +17,10 @@ from ..core.dispatch import run_op
 from ..core.tensor import Tensor
 
 __all__ = ["quantize_linear", "dequantize_linear", "abs_max_scale",
-           "FakeQuanterWithAbsMax", "QuantConfig", "QAT",
-           "WeightOnlyLinear", "weight_quantize", "weight_dequantize"]
+           "channel_wise_abs_max_scale", "FakeQuanterWithAbsMax",
+           "FakeQuanterChannelWiseAbsMax", "AbsmaxObserver", "HistObserver",
+           "QuantConfig", "QAT", "PTQ", "WeightOnlyLinear",
+           "weight_quantize", "weight_dequantize"]
 
 
 def abs_max_scale(x, bit_length: int = 8):
@@ -91,6 +93,191 @@ class FakeQuanterWithAbsMax(_nn.Layer):
         return run_op("fake_quant",
                       lambda a, s: _fake_quant(a, s, qmax),
                       (x, Tensor(self.scale._data)))
+
+
+def channel_wise_abs_max_scale(x, quant_axis: int = 0,
+                               bit_length: int = 8):
+    """Per-channel abs-max scales along ``quant_axis`` (parity: the
+    reference's channel_wise_quantize_max_abs kernel /
+    ChannelWiseAbsMaxObserver)."""
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    qmax = float(2 ** (bit_length - 1) - 1)
+    quant_axis = quant_axis % arr.ndim  # paddle-style negative axes
+    reduce_axes = tuple(d for d in range(arr.ndim) if d != quant_axis)
+    return jnp.maximum(jnp.max(jnp.abs(arr), axis=reduce_axes), 1e-8) / qmax
+
+
+class FakeQuanterChannelWiseAbsMax(_nn.Layer):
+    """Per-channel QAT fake-quant (parity:
+    FakeQuanterChannelWiseAbsMaxObserver): one scale per channel of
+    ``quant_axis``, straight-through backward. Weights quantize per
+    out-channel, which preserves accuracy that per-tensor scales lose on
+    channels with very different ranges."""
+
+    def __init__(self, bit_length: int = 8, quant_axis: int = 0, name=None):
+        super().__init__()
+        del name
+        self.bit_length = bit_length
+        self.quant_axis = quant_axis
+
+    def forward(self, x):
+        qmax = float(2 ** (self.bit_length - 1) - 1)
+        axis = self.quant_axis % (len(x.shape))
+        scales = channel_wise_abs_max_scale(x, axis, self.bit_length)
+        bshape = [1] * len(x.shape)
+        bshape[axis] = -1
+        return run_op("fake_quant_channel",
+                      lambda a, s: _fake_quant(a, s.reshape(bshape), qmax),
+                      (x, Tensor(scales)))
+
+
+# -- PTQ observers (parity: paddle/quantization/observers/) -----------------
+
+class AbsmaxObserver(_nn.Layer):
+    """Running abs-max calibration observer (parity: AbsmaxObserver):
+    forward is identity; ``scale()`` yields the calibrated scale."""
+
+    def __init__(self, bit_length: int = 8):
+        super().__init__()
+        self.bit_length = bit_length
+        self._max = 0.0
+
+    def forward(self, x):
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        self._max = max(self._max, float(jnp.max(jnp.abs(arr))))
+        return x
+
+    def scale(self) -> float:
+        qmax = float(2 ** (self.bit_length - 1) - 1)
+        return max(self._max, 1e-8) / qmax
+
+
+class HistObserver(_nn.Layer):
+    """Histogram percentile observer (parity: HistObserver /
+    PercentHistObserver): accumulates an |x| histogram during calibration
+    and picks the scale at a percentile, clipping rare outliers that would
+    waste int8 range."""
+
+    def __init__(self, bit_length: int = 8, bins_count: int = 2048,
+                 percent: float = 0.999):
+        super().__init__()
+        self.bit_length = bit_length
+        self.bins = bins_count
+        self.percent = percent
+        self._hist = np.zeros(bins_count, np.float64)
+        self._range = 1e-8
+
+    def forward(self, x):
+        arr = np.abs(np.asarray(
+            x._data if isinstance(x, Tensor) else x, np.float32)).ravel()
+        top = float(arr.max()) if arr.size else 0.0
+        if top > self._range:
+            # stretch: rebin the existing histogram into the new range
+            old_edges = np.linspace(0, self._range, self.bins + 1)
+            new_range = top
+            scaled = np.zeros_like(self._hist)
+            centers = (old_edges[:-1] + old_edges[1:]) / 2
+            idx = np.minimum(
+                (centers / new_range * self.bins).astype(np.int64),
+                self.bins - 1)
+            np.add.at(scaled, idx, self._hist)
+            self._hist = scaled
+            self._range = new_range
+        h, _ = np.histogram(arr, bins=self.bins, range=(0, self._range))
+        self._hist += h
+        return x
+
+    def scale(self) -> float:
+        total = self._hist.sum()
+        qmax = float(2 ** (self.bit_length - 1) - 1)
+        if total == 0:
+            return 1e-8 / qmax
+        cdf = np.cumsum(self._hist) / total
+        bin_i = int(np.searchsorted(cdf, self.percent))
+        threshold = (bin_i + 1) / self.bins * self._range
+        return max(threshold, 1e-8) / qmax
+
+
+class PTQ:
+    """Post-training quantization driver (parity: paddle.quantization.PTQ):
+    ``quantize`` inserts observers, the user runs calibration batches, and
+    ``convert`` freezes observed scales into quantized layers."""
+
+    def __init__(self, config: "QuantConfig"):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        self._insert(model)
+        return model
+
+    def _insert(self, layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, _nn.Linear):
+                a_q, _ = self.config.config_for(sub)
+                obs = a_q() if callable(a_q) else (a_q or AbsmaxObserver())
+                if not callable(getattr(obs, "scale", None)):
+                    raise TypeError(
+                        f"PTQ needs an observer with a scale() method for "
+                        f"calibration, got {type(obs).__name__} — QAT "
+                        "quanters (FakeQuanter*) go through QAT.quantize, "
+                        "not PTQ")
+                layer.add_sublayer(name, _ObservedLinear(sub, obs))
+            else:
+                self._insert(sub)
+
+    def convert(self, model, inplace=False):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        self._freeze(model)
+        return model
+
+    def _freeze(self, layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, _ObservedLinear):
+                layer.add_sublayer(
+                    name, _FrozenQuantLinear(sub.linear,
+                                             sub.observer.scale()))
+            else:
+                self._freeze(sub)
+
+
+class _ObservedLinear(_nn.Layer):
+    def __init__(self, linear, observer):
+        super().__init__()
+        self.linear = linear
+        self.observer = observer
+
+    def forward(self, x):
+        return self.linear(self.observer(x))
+
+
+class _FrozenQuantLinear(_nn.Layer):
+    """Inference-time int8 simulation: activations quant-dequant with the
+    frozen observed scale; weights per-out-channel int8."""
+
+    def __init__(self, linear, act_scale: float):
+        super().__init__()
+        self.act_scale = float(act_scale)
+        qw, scales = weight_quantize(linear.weight)
+        self.register_buffer("qweight", qw)
+        self.register_buffer("wscales", scales)
+        self.bias = linear.bias
+
+    def forward(self, x):
+        def fn(a, q, s):
+            aq = jnp.clip(jnp.round(a / self.act_scale), -128, 127)
+            a_dq = aq * self.act_scale
+            return jnp.matmul(a_dq, q.astype(a.dtype) * s[None, :])
+        out = run_op("ptq_linear", fn,
+                     (x, Tensor(self.qweight._data),
+                      Tensor(self.wscales._data)))
+        if self.bias is not None:
+            out = out + self.bias
+        return out
 
 
 class QuantConfig:

@@ -19,8 +19,14 @@ from ..core.dispatch import run_op
 from ..core.tensor import Tensor
 
 __all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
-           "sparse_csr_tensor", "is_same_shape", "add", "multiply",
-           "matmul", "masked_matmul", "relu", "nn"]
+           "sparse_csr_tensor", "is_same_shape", "add", "subtract",
+           "multiply", "divide", "matmul", "masked_matmul", "mv",
+           "transpose", "sum", "softmax", "relu", "nn",
+           # unary value ops (pattern-preserving, reference
+           # paddle/phi/kernels/sparse/unary_kernel.h)
+           "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+           "sqrt", "square", "abs", "pow", "neg", "expm1", "log1p", "cast",
+           "scale"]
 
 
 def _arr(x, dtype=None):
@@ -219,15 +225,181 @@ def masked_matmul(x: Tensor, y: Tensor, mask) -> SparseCooTensor:
     return SparseCooTensor(mask.indices, vals._data, mask.shape)
 
 
+def mv(x, vec) -> Tensor:
+    """sparse [M,K] @ dense vector [K] -> dense [M]
+    (parity: paddle.sparse.mv)."""
+    x = _coo(x)
+    v = vec if isinstance(vec, Tensor) else Tensor(_arr(vec))
+    rows, cols = x.indices[0], x.indices[1]
+
+    def fn(values, dense):
+        return jax.ops.segment_sum(dense[cols] * values, rows,
+                                   num_segments=x.shape[0])
+    return run_op("sparse_mv", fn, (Tensor(x.values), v))
+
+
+def subtract(x, y):
+    """sparse - sparse -> sparse (parity: paddle.sparse.subtract)."""
+    y = _coo(y)
+    return add(x, SparseCooTensor(y.indices, -y.values, y.shape))
+
+
+def divide(x, y):
+    """Elementwise divide evaluated on x's pattern: absent x entries are
+    exact zeros (0/y = 0), so no 0/0 NaNs materialize and nnz never
+    explodes to numel."""
+    x, y = _coo(x), _coo(y)
+    xc = x.coalesce()
+    dense_y = y.to_dense()._data
+    vals = xc.values / dense_y[tuple(xc.indices)]
+    return SparseCooTensor(xc.indices, vals, x.shape, coalesced=True)
+
+
+def transpose(x, perm) -> SparseCooTensor:
+    """Permute sparse dims by reordering index rows
+    (parity: paddle.sparse.transpose)."""
+    x = _coo(x)
+    perm = [p % len(x.shape) for p in perm]
+    indices = jnp.stack([x.indices[p] for p in perm])
+    shape = [x.shape[p] for p in perm]
+    return SparseCooTensor(indices, x.values, shape)
+
+
+def sum(x, axis=None, keepdim=False):
+    """Reduce over sparse dims (parity: paddle.sparse.sum). Full reduction
+    returns a scalar Tensor; axis reduction returns sparse."""
+    x = _coo(x)
+    if axis is None:
+        return run_op("sparse_sum", jnp.sum, (Tensor(x.values),))
+    nd = len(x.shape)
+    axis = axis % nd
+    kept = [d for d in range(nd) if d != axis]
+    if not kept:
+        return run_op("sparse_sum", jnp.sum, (Tensor(x.values),))
+    indices = jnp.stack([x.indices[d] for d in kept])
+    shape = [x.shape[d] for d in kept]
+    out = SparseCooTensor(indices, x.values, shape).coalesce()
+    if keepdim:
+        ins = list(out.indices)
+        ins.insert(axis, jnp.zeros_like(out.indices[0]))
+        out = SparseCooTensor(jnp.stack(ins), out.values,
+                              shape[:axis] + [1] + shape[axis:])
+    return out
+
+
+def softmax(x, axis=-1):
+    """Row softmax over the nnz entries only (parity:
+    paddle.sparse.nn.functional.softmax — absent entries are -inf, exactly
+    the reference's CSR softmax semantics)."""
+    x = _coo(x)
+    if len(x.shape) != 2 or axis not in (-1, 1):
+        raise ValueError("sparse softmax supports 2-D, last axis")
+    coo = x.coalesce()
+    rows = coo.indices[0]
+    m = jax.ops.segment_max(coo.values, rows, num_segments=x.shape[0])
+    e = jnp.exp(coo.values - m[rows])
+    z = jax.ops.segment_sum(e, rows, num_segments=x.shape[0])
+    return SparseCooTensor(coo.indices, e / z[rows], x.shape,
+                           coalesced=True)
+
+
+def _unary(name, fn):
+    def op(x, *args):
+        coo = _coo(x)
+        return SparseCooTensor(coo.indices, fn(coo.values, *args),
+                               coo.shape, coalesced=coo._coalesced)
+    op.__name__ = name
+    return op
+
+
+# pattern-preserving unary ops on the stored values (the reference's
+# sparse unary kernel family, paddle/phi/kernels/sparse/unary_kernel.h:
+# f(0)=0 members operate on values only)
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+expm1 = _unary("expm1", jnp.expm1)
+log1p = _unary("log1p", jnp.log1p)
+pow = _unary("pow", lambda v, p: jnp.power(v, p))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    """v*scale + bias, or (v + bias)*scale when bias_after_scale=False
+    (paddle.scale semantics on the stored values)."""
+    coo = _coo(x)
+    v = (coo.values * scale + bias if bias_after_scale
+         else (coo.values + bias) * scale)
+    return SparseCooTensor(coo.indices, v, coo.shape,
+                           coalesced=coo._coalesced)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    coo = _coo(x)
+    indices = coo.indices.astype(index_dtype) if index_dtype else coo.indices
+    values = coo.values.astype(value_dtype) if value_dtype else coo.values
+    return SparseCooTensor(indices, values, coo.shape,
+                           coalesced=coo._coalesced)
+
+
 def relu(x) -> SparseCooTensor:
     x = _coo(x)
     return SparseCooTensor(x.indices, jnp.maximum(x.values, 0), x.shape,
                            coalesced=x._coalesced)
 
 
+def relu6(x) -> SparseCooTensor:
+    x = _coo(x)
+    return SparseCooTensor(x.indices, jnp.clip(x.values, 0, 6), x.shape,
+                           coalesced=x._coalesced)
+
+
+def leaky_relu(x, negative_slope=0.01) -> SparseCooTensor:
+    x = _coo(x)
+    return SparseCooTensor(
+        x.indices,
+        jnp.where(x.values >= 0, x.values, negative_slope * x.values),
+        x.shape, coalesced=x._coalesced)
+
+
 class nn:
-    """paddle.sparse.nn subset."""
+    """paddle.sparse.nn subset (3-D point-cloud convs are out of scope for
+    the TPU v1 — XLA has no sparse gather-scatter conv lowering that beats
+    densification at the reference's target sparsity)."""
 
     class ReLU:
         def __call__(self, x):
             return relu(x)
+
+    class ReLU6:
+        def __call__(self, x):
+            return relu6(x)
+
+    class LeakyReLU:
+        def __init__(self, negative_slope=0.01):
+            self.negative_slope = negative_slope
+
+        def __call__(self, x):
+            return leaky_relu(x, self.negative_slope)
+
+    class Softmax:
+        def __init__(self, axis=-1):
+            self.axis = axis
+
+        def __call__(self, x):
+            return softmax(x, self.axis)
+
+    class functional:
+        relu = staticmethod(lambda x: relu(x))
+        relu6 = staticmethod(lambda x: relu6(x))
+        leaky_relu = staticmethod(lambda x, s=0.01: leaky_relu(x, s))
+        softmax = staticmethod(lambda x, axis=-1: softmax(x, axis))
+        attention = None  # reference sparse attention: not yet ported

@@ -198,3 +198,221 @@ class TestQuantization:
         qw, scales = weight_quantize(lin.weight)
         assert str(qw.dtype) == "int8"
         assert scales.shape == [8]
+
+
+class TestSparseWave2:
+    """Deepened sparse surface (VERDICT r1 #10): grads through
+    matmul/sddmm, unary value ops, transpose/sum/softmax/mv."""
+
+    def _coo(self, seed=0):
+        rng = np.random.RandomState(seed)
+        idx = np.array([[0, 0, 1, 3], [1, 3, 2, 0]])
+        vals = rng.randn(4).astype(np.float32)
+        return paddle.sparse.sparse_coo_tensor(idx, vals, [4, 4]), idx, vals
+
+    def test_spmm_grads_flow_to_dense(self):
+        sp, idx, vals = self._coo()
+        y = paddle.to_tensor(np.random.RandomState(1)
+                             .randn(4, 3).astype(np.float32))
+        y.stop_gradient = False
+        out = paddle.sparse.matmul(sp, y)
+        out.sum().backward()
+        assert y.grad is not None
+        # oracle: dense matmul grad
+        dense = sp.to_dense().numpy()
+        np.testing.assert_allclose(y.grad.numpy(),
+                                   dense.T @ np.ones((4, 3), np.float32),
+                                   rtol=1e-5)
+
+    def test_sddmm_values_and_grads(self):
+        """SDDMM: values match dense a@b at the mask, and grads flow to
+        both dense operands through the taped op."""
+        import jax.numpy as jnp
+        from paddle_tpu.core.dispatch import run_op
+        sp, idx, vals = self._coo()
+        rng = np.random.RandomState(2)
+        a = paddle.to_tensor(rng.randn(4, 5).astype(np.float32))
+        b = paddle.to_tensor(rng.randn(5, 4).astype(np.float32))
+        a.stop_gradient = False
+        b.stop_gradient = False
+        out = paddle.sparse.masked_matmul(a, b, sp)
+        ref = (a.numpy() @ b.numpy())[tuple(idx)]
+        np.testing.assert_allclose(np.asarray(out.values), ref, rtol=1e-5)
+        # grads: rerun the op keeping the Tensor head (masked_matmul stores
+        # raw values; the taped intermediate drives backward)
+        rows, cols = idx[0], idx[1]
+        vals_t = run_op(
+            "sparse_sddmm",
+            lambda x, y: jnp.einsum("nk,nk->n", x[rows], y[:, cols].T),
+            (a, b))
+        vals_t.sum().backward()
+        assert a.grad is not None and b.grad is not None
+        # oracle: d(sum of masked products)/da = sum_j mask_ij * b.T
+        mask = np.zeros((4, 4), np.float32)
+        mask[tuple(idx)] = 1.0
+        np.testing.assert_allclose(a.grad.numpy(), mask @ b.numpy().T,
+                                   rtol=1e-5)
+
+    def test_unary_ops_match_dense_oracle(self):
+        sp, idx, vals = self._coo(5)
+        for name in ("sin", "tanh", "square", "abs", "neg", "expm1",
+                     "log1p"):
+            if name in ("log1p",):
+                sp_pos = paddle.sparse.sparse_coo_tensor(
+                    idx, np.abs(vals), [4, 4])
+                out = getattr(paddle.sparse, name)(sp_pos)
+                ref = getattr(np, name)(np.abs(vals))
+            else:
+                out = getattr(paddle.sparse, name)(sp)
+                ref = {"neg": lambda v: -v}.get(
+                    name, getattr(np, name, None))
+                ref = ref(vals) if callable(ref) else None
+            if ref is not None:
+                np.testing.assert_allclose(np.asarray(out.values), ref,
+                                           rtol=1e-5)
+            assert np.array_equal(np.asarray(out.indices), idx)
+
+    def test_transpose(self):
+        sp, idx, vals = self._coo(6)
+        tr = paddle.sparse.transpose(sp, [1, 0])
+        np.testing.assert_allclose(np.asarray(tr.to_dense()._data),
+                                   sp.to_dense().numpy().T, rtol=1e-6)
+
+    def test_sum(self):
+        sp, idx, vals = self._coo(7)
+        total = paddle.sparse.sum(sp)
+        np.testing.assert_allclose(float(total), vals.sum(), rtol=1e-5)
+        by_row = paddle.sparse.sum(sp, axis=1)
+        np.testing.assert_allclose(np.asarray(by_row.to_dense()._data),
+                                   sp.to_dense().numpy().sum(1), rtol=1e-5)
+
+    def test_softmax_matches_masked_dense(self):
+        sp, idx, vals = self._coo(8)
+        out = paddle.sparse.softmax(sp)
+        dense = sp.to_dense().numpy()
+        mask = np.zeros_like(dense, bool)
+        mask[tuple(idx)] = True
+        masked = np.where(mask, dense, -np.inf)
+        ref = np.exp(masked - masked.max(1, keepdims=True))
+        ref = np.nan_to_num(ref / np.maximum(ref.sum(1, keepdims=True),
+                                             1e-30))
+        np.testing.assert_allclose(np.asarray(out.to_dense()._data)[mask],
+                                   ref[mask], rtol=1e-5)
+
+    def test_mv(self):
+        sp, idx, vals = self._coo(9)
+        v = paddle.to_tensor(np.random.RandomState(4)
+                             .randn(4).astype(np.float32))
+        out = paddle.sparse.mv(sp, v)
+        np.testing.assert_allclose(out.numpy(),
+                                   sp.to_dense().numpy() @ v.numpy(),
+                                   rtol=1e-5)
+
+    def test_subtract_divide(self):
+        sp1, idx, vals = self._coo(10)
+        sp2 = paddle.sparse.sparse_coo_tensor(idx, np.ones(4, np.float32),
+                                              [4, 4])
+        sub = paddle.sparse.subtract(sp1, sp2)
+        np.testing.assert_allclose(np.asarray(sub.to_dense()._data),
+                                   sp1.to_dense().numpy()
+                                   - sp2.to_dense().numpy(), rtol=1e-5)
+
+
+class TestQuantWave2:
+    def test_per_channel_beats_per_tensor_on_skewed_channels(self):
+        from paddle_tpu.quantization import (FakeQuanterChannelWiseAbsMax,
+                                             FakeQuanterWithAbsMax)
+        rng = np.random.RandomState(0)
+        w = np.concatenate([rng.randn(16, 8) * 0.01,
+                            rng.randn(16, 8) * 10.0], axis=1
+                           ).astype(np.float32)
+        wt = paddle.to_tensor(w)
+        pc = FakeQuanterChannelWiseAbsMax(quant_axis=1)(wt)
+        pt_q = FakeQuanterWithAbsMax()
+        pt_q.train()
+        pt = pt_q(wt)
+        # the small-range channels are where per-tensor scales destroy
+        # precision: per-channel must recover them
+        err_pc_small = np.abs(pc.numpy()[:, :8] - w[:, :8]).mean()
+        err_pt_small = np.abs(pt.numpy()[:, :8] - w[:, :8]).mean()
+        assert err_pc_small < err_pt_small / 50
+        assert np.abs(pc.numpy() - w).mean() < np.abs(pt.numpy() - w).mean()
+
+    def test_hist_observer_clips_outliers(self):
+        from paddle_tpu.quantization import AbsmaxObserver, HistObserver
+        rng = np.random.RandomState(1)
+        data = rng.randn(10000).astype(np.float32)
+        data[0] = 1000.0  # one absurd outlier
+        h = HistObserver(percent=0.999)
+        a = AbsmaxObserver()
+        h(paddle.to_tensor(data))
+        a(paddle.to_tensor(data))
+        assert h.scale() < a.scale() / 10  # percentile ignores the outlier
+        assert h.scale() * 127 > 2.0      # but keeps the gaussian body
+
+    def test_ptq_calibrate_convert_close_to_fp32(self):
+        from paddle_tpu.quantization import PTQ, QuantConfig
+        paddle.seed(3)
+        model = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                                     paddle.nn.ReLU(),
+                                     paddle.nn.Linear(16, 4))
+        ptq = PTQ(QuantConfig())
+        observed = ptq.quantize(model)
+        rng = np.random.RandomState(2)
+        for _ in range(4):
+            observed(paddle.to_tensor(rng.randn(16, 8).astype(np.float32)))
+        frozen = ptq.convert(observed)
+        x = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+        ref = model(x).numpy()
+        got = frozen(x).numpy()
+        assert np.abs(got - ref).mean() < 0.05 * np.abs(ref).mean() + 0.05
+
+
+class TestMemoryStats:
+    def test_memory_stats_surface(self):
+        import paddle_tpu.device as device
+        stats = device.memory_stats()
+        assert isinstance(stats, dict)
+        # the numeric shims never raise regardless of platform support
+        assert device.cuda.memory_allocated() >= 0
+        assert device.cuda.max_memory_allocated() >= 0
+
+
+class TestReviewRegressionsWave2:
+    def test_divide_no_nan_fill(self):
+        idx = np.array([[0, 1], [1, 2]])
+        x = paddle.sparse.sparse_coo_tensor(idx, np.array([2.0, 4.0],
+                                                          np.float32), [4, 4])
+        y = paddle.sparse.sparse_coo_tensor(idx, np.array([1.0, 2.0],
+                                                          np.float32), [4, 4])
+        out = paddle.sparse.divide(x, y)
+        assert out.nnz() == 2  # pattern preserved, no numel explosion
+        vals = np.asarray(out.to_dense()._data)
+        assert np.isfinite(vals).all()
+        np.testing.assert_allclose(vals[0, 1], 2.0)
+        np.testing.assert_allclose(vals[1, 2], 2.0)
+
+    def test_scale_bias_order(self):
+        idx = np.array([[0], [0]])
+        x = paddle.sparse.sparse_coo_tensor(idx, np.array([3.0], np.float32),
+                                            [2, 2])
+        after = paddle.sparse.scale(x, 2.0, 1.0, bias_after_scale=True)
+        before = paddle.sparse.scale(x, 2.0, 1.0, bias_after_scale=False)
+        assert float(np.asarray(after.values)[0]) == 7.0   # 3*2+1
+        assert float(np.asarray(before.values)[0]) == 8.0  # (3+1)*2
+
+    def test_channel_scale_negative_axis(self):
+        from paddle_tpu.quantization import channel_wise_abs_max_scale
+        w = paddle.to_tensor(np.array([[0.01, 1.0], [0.02, 2.0]],
+                                      np.float32))
+        neg = np.asarray(channel_wise_abs_max_scale(w, -1))
+        pos_ = np.asarray(channel_wise_abs_max_scale(w, 1))
+        np.testing.assert_allclose(neg, pos_)
+        assert neg.shape == (2,)
+
+    def test_ptq_rejects_qat_quanter(self):
+        from paddle_tpu.quantization import PTQ, QuantConfig
+        m = paddle.nn.Sequential(paddle.nn.Linear(4, 4))
+        ptq = PTQ(QuantConfig(activation=FakeQuanterWithAbsMax))
+        with pytest.raises(TypeError, match="observer with a scale"):
+            ptq.quantize(m)

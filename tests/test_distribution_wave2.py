@@ -1,0 +1,242 @@
+"""Second-wave distributions vs torch.distributions as the numeric oracle
+(reference: python/paddle/distribution/ per-distribution modules; the
+reference's own tests compare against scipy — torch-cpu is the in-image
+equivalent)."""
+import numpy as np
+import pytest
+import torch
+import torch.distributions as TD
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+RNG = np.random.RandomState(0)
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+def assert_close(ours, theirs, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(ours.numpy(), np.float64),
+                               theirs.numpy().astype(np.float64),
+                               rtol=rtol, atol=atol)
+
+
+CASES = [
+    ("gamma",
+     lambda: D.Gamma(t([2.0, 0.5]), t([3.0, 1.5])),
+     lambda: TD.Gamma(torch.tensor([2.0, 0.5]), torch.tensor([3.0, 1.5])),
+     [0.5, 2.0]),
+    ("beta",
+     lambda: D.Beta(t([2.0, 5.0]), t([3.0, 1.0])),
+     lambda: TD.Beta(torch.tensor([2.0, 5.0]), torch.tensor([3.0, 1.0])),
+     [0.3, 0.8]),
+    ("laplace",
+     lambda: D.Laplace(t([0.0, 1.0]), t([1.0, 2.0])),
+     lambda: TD.Laplace(torch.tensor([0.0, 1.0]), torch.tensor([1.0, 2.0])),
+     [0.5, -1.0]),
+    ("lognormal",
+     lambda: D.LogNormal(t([0.0, 0.5]), t([1.0, 0.7])),
+     lambda: TD.LogNormal(torch.tensor([0.0, 0.5]),
+                          torch.tensor([1.0, 0.7])),
+     [0.5, 2.0]),
+    ("gumbel",
+     lambda: D.Gumbel(t([0.0, 1.0]), t([1.0, 2.0])),
+     lambda: TD.Gumbel(torch.tensor([0.0, 1.0]), torch.tensor([1.0, 2.0])),
+     [0.5, 3.0]),
+    ("cauchy",
+     lambda: D.Cauchy(t([0.0, 1.0]), t([1.0, 0.5])),
+     lambda: TD.Cauchy(torch.tensor([0.0, 1.0]), torch.tensor([1.0, 0.5])),
+     [0.5, -2.0]),
+    ("studentt",
+     lambda: D.StudentT(t([3.0, 7.0]), t([0.0, 1.0]), t([1.0, 2.0])),
+     lambda: TD.StudentT(torch.tensor([3.0, 7.0]), torch.tensor([0.0, 1.0]),
+                         torch.tensor([1.0, 2.0])),
+     [0.5, -1.0]),
+    ("geometric",
+     lambda: D.Geometric(t([0.3, 0.7])),
+     lambda: TD.Geometric(torch.tensor([0.3, 0.7])),
+     [2.0, 0.0]),
+    ("poisson",
+     lambda: D.Poisson(t([2.0, 5.5])),
+     lambda: TD.Poisson(torch.tensor([2.0, 5.5])),
+     [1.0, 4.0]),
+    ("chi2",
+     lambda: D.Chi2(t([3.0, 7.0])),
+     lambda: TD.Chi2(torch.tensor([3.0, 7.0])),
+     [1.5, 6.0]),
+]
+
+
+class TestLogProbParity:
+    @pytest.mark.parametrize("name,ours,theirs,vals",
+                             CASES, ids=[c[0] for c in CASES])
+    def test_log_prob(self, name, ours, theirs, vals):
+        assert_close(ours().log_prob(t(vals)),
+                     theirs().log_prob(torch.tensor(vals)))
+
+    def test_binomial(self):
+        ours = D.Binomial(t([10.0, 10.0]), t([0.3, 0.7]))
+        theirs = TD.Binomial(torch.tensor([10.0, 10.0]),
+                             torch.tensor([0.3, 0.7]))
+        assert_close(ours.log_prob(t([3.0, 8.0])),
+                     theirs.log_prob(torch.tensor([3.0, 8.0])))
+
+    def test_dirichlet(self):
+        c = [2.0, 3.0, 5.0]
+        v = [0.2, 0.3, 0.5]
+        assert_close(D.Dirichlet(t(c)).log_prob(t(v)),
+                     TD.Dirichlet(torch.tensor(c)).log_prob(torch.tensor(v)))
+
+    def test_multinomial(self):
+        ours = D.Multinomial(10, t([0.2, 0.3, 0.5]))
+        theirs = TD.Multinomial(10, torch.tensor([0.2, 0.3, 0.5]))
+        v = [2.0, 3.0, 5.0]
+        assert_close(ours.log_prob(t(v)),
+                     theirs.log_prob(torch.tensor(v)))
+
+
+class TestEntropyParity:
+    @pytest.mark.parametrize("name,ours,theirs,_",
+                             [c for c in CASES
+                              if c[0] not in ("poisson",)],
+                             ids=[c[0] for c in CASES if c[0] != "poisson"])
+    def test_entropy(self, name, ours, theirs, _):
+        if name == "geometric":
+            pytest.skip("torch Geometric.entropy uses a different convention")
+        assert_close(ours().entropy(), theirs().entropy())
+
+    def test_dirichlet_entropy(self):
+        c = [2.0, 3.0, 5.0]
+        assert_close(D.Dirichlet(t(c)).entropy(),
+                     TD.Dirichlet(torch.tensor(c)).entropy())
+
+
+class TestKLParity:
+    @pytest.mark.parametrize("ours_p,ours_q,t_p,t_q", [
+        (lambda: D.Gamma(t(2.0), t(3.0)), lambda: D.Gamma(t(1.5), t(1.0)),
+         lambda: TD.Gamma(torch.tensor(2.0), torch.tensor(3.0)),
+         lambda: TD.Gamma(torch.tensor(1.5), torch.tensor(1.0))),
+        (lambda: D.Beta(t(2.0), t(3.0)), lambda: D.Beta(t(4.0), t(1.0)),
+         lambda: TD.Beta(torch.tensor(2.0), torch.tensor(3.0)),
+         lambda: TD.Beta(torch.tensor(4.0), torch.tensor(1.0))),
+        (lambda: D.Laplace(t(0.0), t(1.0)), lambda: D.Laplace(t(1.0), t(2.0)),
+         lambda: TD.Laplace(torch.tensor(0.0), torch.tensor(1.0)),
+         lambda: TD.Laplace(torch.tensor(1.0), torch.tensor(2.0))),
+        (lambda: D.Dirichlet(t([2.0, 3.0])),
+         lambda: D.Dirichlet(t([1.0, 1.5])),
+         lambda: TD.Dirichlet(torch.tensor([2.0, 3.0])),
+         lambda: TD.Dirichlet(torch.tensor([1.0, 1.5]))),
+    ], ids=["gamma", "beta", "laplace", "dirichlet"])
+    def test_kl(self, ours_p, ours_q, t_p, t_q):
+        assert_close(D.kl_divergence(ours_p(), ours_q()),
+                     TD.kl_divergence(t_p(), t_q()))
+
+
+class TestSampling:
+    def test_gamma_rsample_is_differentiable(self):
+        a = t([2.0])
+        a.stop_gradient = False
+        paddle.seed(0)
+        g = D.Gamma(a, t([1.0]))
+        s = g.rsample((256,))
+        s.mean().backward()
+        assert a.grad is not None
+        assert np.isfinite(a.grad.numpy()).all()
+
+    @pytest.mark.parametrize("dist,mean,var", [
+        (lambda: D.Gamma(t(4.0), t(2.0)), 2.0, 1.0),
+        (lambda: D.Beta(t(2.0), t(2.0)), 0.5, 0.05),
+        (lambda: D.Laplace(t(1.0), t(0.5)), 1.0, 0.5),
+        (lambda: D.Gumbel(t(0.0), t(1.0)), 0.5772, np.pi ** 2 / 6),
+        (lambda: D.Geometric(t(0.5)), 1.0, 2.0),
+        (lambda: D.Poisson(t(4.0)), 4.0, 4.0),
+    ], ids=["gamma", "beta", "laplace", "gumbel", "geometric", "poisson"])
+    def test_sample_moments(self, dist, mean, var):
+        paddle.seed(7)
+        s = dist().sample((20000,)).numpy()
+        assert abs(s.mean() - mean) < 0.1 + 0.05 * abs(mean)
+        assert abs(s.var() - var) < 0.15 + 0.1 * var
+
+    def test_dirichlet_samples_on_simplex(self):
+        paddle.seed(1)
+        s = D.Dirichlet(t([2.0, 3.0, 4.0])).sample((100,)).numpy()
+        np.testing.assert_allclose(s.sum(-1), np.ones(100), rtol=1e-5)
+        assert (s >= 0).all()
+
+    def test_multinomial_counts(self):
+        paddle.seed(2)
+        s = D.Multinomial(20, t([0.5, 0.5])).sample((50,)).numpy()
+        np.testing.assert_allclose(s.sum(-1), np.full(50, 20.0))
+
+
+class TestTransforms:
+    def test_exp_transform_matches_lognormal(self):
+        base = D.Normal(t(0.3), t(0.8))
+        td = D.TransformedDistribution(base, D.ExpTransform())
+        ln = D.LogNormal(t(0.3), t(0.8))
+        v = t([0.5, 1.5, 3.0])
+        np.testing.assert_allclose(td.log_prob(v).numpy(),
+                                   ln.log_prob(v).numpy(), rtol=1e-5)
+
+    def test_affine_roundtrip_and_ldj(self):
+        tr = D.AffineTransform(t(2.0), t(3.0))
+        x = t([1.0, -2.0])
+        y = tr.forward(x)
+        np.testing.assert_allclose(y.numpy(), [5.0, -4.0], rtol=1e-6)
+        np.testing.assert_allclose(tr.inverse(y).numpy(), x.numpy(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(tr.forward_log_det_jacobian(x).numpy(),
+                                   np.log(3.0) * np.ones(2), rtol=1e-6)
+
+    def test_tanh_ldj_matches_torch(self):
+        tr = D.TanhTransform()
+        x = np.array([0.1, -1.5, 2.0], np.float32)
+        theirs = TD.transforms.TanhTransform().log_abs_det_jacobian(
+            torch.tensor(x), torch.tanh(torch.tensor(x)))
+        np.testing.assert_allclose(
+            tr.forward_log_det_jacobian(t(x)).numpy(), theirs.numpy(),
+            rtol=1e-5, atol=2e-6)
+
+    def test_chain_sigmoid_affine(self):
+        tr = D.ChainTransform([D.AffineTransform(t(0.0), t(2.0)),
+                               D.SigmoidTransform()])
+        x = t([0.3, -0.7])
+        y = tr.forward(x)
+        np.testing.assert_allclose(tr.inverse(y).numpy(), x.numpy(),
+                                   rtol=1e-5)
+        expect = (np.log(2.0)
+                  + TD.SigmoidTransform().log_abs_det_jacobian(
+                      torch.tensor([0.6, -1.4]),
+                      torch.sigmoid(torch.tensor([0.6, -1.4]))).numpy())
+        np.testing.assert_allclose(
+            tr.forward_log_det_jacobian(x).numpy(), expect, rtol=1e-5)
+
+    def test_transformed_rsample_grads_flow(self):
+        loc = t(0.5)
+        loc.stop_gradient = False
+        td = D.TransformedDistribution(D.Normal(loc, t(1.0)),
+                                       D.ExpTransform())
+        s = td.rsample((64,))
+        s.mean().backward()
+        assert loc.grad is not None
+
+
+class TestIndependent:
+    def test_log_prob_sums_event_dims(self):
+        base = D.Normal(t(np.zeros((3, 4))), t(np.ones((3, 4))))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == (3,)
+        assert ind.event_shape == (4,)
+        v = t(RNG.randn(3, 4))
+        np.testing.assert_allclose(
+            ind.log_prob(v).numpy(),
+            base.log_prob(v).numpy().sum(-1), rtol=1e-5)
+
+    def test_entropy_sums(self):
+        base = D.Normal(t(np.zeros((3, 4))), t(np.ones((3, 4))))
+        ind = D.Independent(base, 1)
+        np.testing.assert_allclose(ind.entropy().numpy(),
+                                   base.entropy().numpy().sum(-1),
+                                   rtol=1e-5)
