@@ -1,0 +1,352 @@
+"""Wide op sweep through the OpTest harness (VERDICT r1 #7; model:
+reference test/legacy_test/test_*_op.py — thousands of per-op cases with
+finite-difference grad checks, op_test.py:2972).
+
+Table-driven: each row drives check_grad (tape backward vs central
+differences) and/or a shape-robustness pass (odd shapes, scalars,
+0-size). numpy/torch serve as output oracles where the lowering isn't a
+1:1 jnp call.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_grad, check_output
+
+RNG = np.random.RandomState(123)
+
+
+def f32(*shape):
+    return RNG.randn(*shape).astype(np.float32)
+
+
+def pos(*shape):
+    return (np.abs(RNG.randn(*shape)) + 0.5).astype(np.float32)
+
+
+def unit(*shape):
+    return RNG.uniform(-0.9, 0.9, shape).astype(np.float32)
+
+
+def prob(*shape):
+    return RNG.uniform(0.05, 0.95, shape).astype(np.float32)
+
+
+# (id, fn, inputs, grad indices to check)
+GRAD_CASES = [
+    # -- unary math ---------------------------------------------------------
+    ("exp", paddle.exp, [f32(2, 3)], [0]),
+    ("expm1", paddle.expm1, [f32(2, 3)], [0]),
+    ("log", paddle.log, [pos(2, 3)], [0]),
+    ("log2", paddle.log2, [pos(2, 3)], [0]),
+    ("log10", paddle.log10, [pos(2, 3)], [0]),
+    ("log1p", paddle.log1p, [pos(2, 3)], [0]),
+    ("sqrt", paddle.sqrt, [pos(2, 3)], [0]),
+    ("rsqrt", paddle.rsqrt, [pos(2, 3)], [0]),
+    ("square", paddle.square, [f32(2, 3)], [0]),
+    ("sin", paddle.sin, [f32(2, 3)], [0]),
+    ("cos", paddle.cos, [f32(2, 3)], [0]),
+    ("tan", paddle.tan, [unit(2, 3)], [0]),
+    ("asin", paddle.asin, [unit(2, 3)], [0]),
+    ("acos", paddle.acos, [unit(2, 3)], [0]),
+    ("atan", paddle.atan, [f32(2, 3)], [0]),
+    ("sinh", paddle.sinh, [f32(2, 3)], [0]),
+    ("cosh", paddle.cosh, [f32(2, 3)], [0]),
+    ("tanh", paddle.tanh, [f32(2, 3)], [0]),
+    ("asinh", paddle.asinh, [f32(2, 3)], [0]),
+    ("acosh", paddle.acosh, [pos(2, 3) + 1.0], [0]),
+    ("atanh", paddle.atanh, [unit(2, 3) * 0.8], [0]),
+    ("erf", paddle.erf, [f32(2, 3)], [0]),
+    ("erfinv", paddle.erfinv, [unit(2, 3) * 0.8], [0]),
+    ("sigmoid", paddle.nn.functional.sigmoid, [f32(2, 3)], [0]),
+    ("logit", paddle.logit, [prob(2, 3)], [0]),
+    ("reciprocal", paddle.reciprocal, [pos(2, 3)], [0]),
+    ("abs", paddle.abs, [pos(2, 3)], [0]),
+    ("neg", paddle.neg, [f32(2, 3)], [0]),
+    ("digamma", paddle.digamma, [pos(2, 3) + 1], [0]),
+    ("lgamma", paddle.lgamma, [pos(2, 3) + 1], [0]),
+    ("stanh", paddle.stanh, [f32(2, 3)], [0]),
+    ("softsign_t", paddle.nn.functional.softsign, [f32(2, 3)], [0]),
+    # -- binary -------------------------------------------------------------
+    ("add", paddle.add, [f32(2, 3), f32(2, 3)], [0, 1]),
+    ("subtract", paddle.subtract, [f32(2, 3), f32(2, 3)], [0, 1]),
+    ("multiply", paddle.multiply, [f32(2, 3), f32(2, 3)], [0, 1]),
+    ("divide", paddle.divide, [f32(2, 3), pos(2, 3)], [0, 1]),
+    ("pow", lambda x: paddle.pow(x, 3.0), [pos(2, 3)], [0]),
+    ("maximum", paddle.maximum, [f32(2, 3), f32(2, 3) + 0.1], [0, 1]),
+    ("minimum", paddle.minimum, [f32(2, 3), f32(2, 3) + 0.1], [0, 1]),
+    ("atan2", paddle.atan2, [pos(2, 3), pos(2, 3)], [0, 1]),
+    ("logaddexp", paddle.logaddexp, [f32(2, 3), f32(2, 3)], [0, 1]),
+    ("hypot", paddle.hypot, [pos(2, 3), pos(2, 3)], [0, 1]),
+    ("fmax", paddle.fmax, [f32(2, 3), f32(2, 3) + 0.1], [0]),
+    ("fmin", paddle.fmin, [f32(2, 3), f32(2, 3) + 0.1], [0]),
+    ("lerp", lambda x, y: paddle.lerp(x, y, 0.3),
+     [f32(2, 3), f32(2, 3)], [0, 1]),
+    ("broadcast_add", paddle.add, [f32(2, 3), f32(3)], [0, 1]),
+    # -- reductions ---------------------------------------------------------
+    ("sum", lambda x: paddle.sum(x, axis=1), [f32(3, 4)], [0]),
+    ("sum_all", paddle.sum, [f32(3, 4)], [0]),
+    ("mean", lambda x: paddle.mean(x, axis=0), [f32(3, 4)], [0]),
+    ("max_r", lambda x: paddle.max(x, axis=1), [f32(3, 4)], [0]),
+    ("min_r", lambda x: paddle.min(x, axis=1), [f32(3, 4)], [0]),
+    ("prod", lambda x: paddle.prod(x, axis=1), [pos(3, 4)], [0]),
+    ("logsumexp", lambda x: paddle.logsumexp(x, axis=1), [f32(3, 4)], [0]),
+    ("std", lambda x: paddle.std(x, axis=1), [f32(3, 4)], [0]),
+    ("var", lambda x: paddle.var(x, axis=1), [f32(3, 4)], [0]),
+    ("norm_l2", lambda x: paddle.norm(x, p=2), [f32(3, 4)], [0]),
+    ("cumsum", lambda x: paddle.cumsum(x, axis=1), [f32(3, 4)], [0]),
+    ("cumprod", lambda x: paddle.cumprod(x, dim=1), [pos(2, 3)], [0]),
+    ("logcumsumexp", lambda x: paddle.logcumsumexp(x, axis=1),
+     [f32(2, 3)], [0]),
+    ("nansum", lambda x: paddle.nansum(x, axis=1), [f32(3, 4)], [0]),
+    ("amax", lambda x: paddle.amax(x, axis=1), [f32(3, 4)], [0]),
+    ("amin", lambda x: paddle.amin(x, axis=1), [f32(3, 4)], [0]),
+    # -- shape/manipulation -------------------------------------------------
+    ("reshape", lambda x: paddle.reshape(x, [4, 3]), [f32(3, 4)], [0]),
+    ("transpose", lambda x: paddle.transpose(x, [1, 0]), [f32(3, 4)], [0]),
+    ("squeeze", lambda x: paddle.squeeze(x, 1), [f32(3, 1, 4)], [0]),
+    ("unsqueeze", lambda x: paddle.unsqueeze(x, 1), [f32(3, 4)], [0]),
+    ("flatten", paddle.flatten, [f32(2, 3, 4)], [0]),
+    ("flip", lambda x: paddle.flip(x, [0]), [f32(3, 4)], [0]),
+    ("roll", lambda x: paddle.roll(x, 1, 0), [f32(3, 4)], [0]),
+    ("concat", lambda x, y: paddle.concat([x, y], axis=0),
+     [f32(2, 3), f32(2, 3)], [0, 1]),
+    ("stack", lambda x, y: paddle.stack([x, y], axis=0),
+     [f32(2, 3), f32(2, 3)], [0, 1]),
+    ("split", lambda x: paddle.split(x, 2, axis=1)[0], [f32(3, 4)], [0]),
+    ("tile", lambda x: paddle.tile(x, [2, 1]), [f32(2, 3)], [0]),
+    ("expand", lambda x: paddle.expand(x, [3, 2, 3]), [f32(2, 3)], [0]),
+    ("broadcast_to", lambda x: paddle.broadcast_to(x, [2, 2, 3]),
+     [f32(2, 3)], [0]),
+    ("pad", lambda x: paddle.nn.functional.pad(x, [1, 1], value=0.0),
+     [f32(2, 3)], [0]),
+    ("tril", paddle.tril, [f32(3, 3)], [0]),
+    ("triu", paddle.triu, [f32(3, 3)], [0]),
+    ("diag", paddle.diag, [f32(3)], [0]),
+    ("diagonal", paddle.diagonal, [f32(3, 3)], [0]),
+    ("gather", lambda x: paddle.gather(
+        x, paddle.to_tensor(np.array([0, 2], np.int64))), [f32(3, 4)], [0]),
+    ("index_select", lambda x: paddle.index_select(
+        x, paddle.to_tensor(np.array([0, 2], np.int64))), [f32(3, 4)], [0]),
+    ("slice_t", lambda x: x[1:3, :2], [f32(4, 4)], [0]),
+    ("masked_select_like", lambda x: paddle.where(
+        x > 0, x, paddle.zeros_like(x)), [f32(3, 4)], [0]),
+    ("take_along_axis", lambda x: paddle.take_along_axis(
+        x, paddle.to_tensor(np.array([[0], [1], [0]], np.int64)), 1),
+     [f32(3, 4)], [0]),
+    ("repeat_interleave", lambda x: paddle.repeat_interleave(x, 2, 0),
+     [f32(2, 3)], [0]),
+    ("moveaxis", lambda x: paddle.moveaxis(x, 0, 1), [f32(2, 3)], [0]),
+    ("rot90", lambda x: paddle.rot90(x), [f32(2, 3)], [0]),
+    ("as_strided_like_t", lambda x: paddle.t(x), [f32(2, 3)], [0]),
+    # -- linalg -------------------------------------------------------------
+    ("matmul", paddle.matmul, [f32(3, 4), f32(4, 2)], [0, 1]),
+    ("matmul_bT", lambda x, y: paddle.matmul(x, y, transpose_y=True),
+     [f32(3, 4), f32(2, 4)], [0, 1]),
+    ("bmm", paddle.bmm, [f32(2, 3, 4), f32(2, 4, 2)], [0, 1]),
+    ("dot", paddle.dot, [f32(4), f32(4)], [0, 1]),
+    ("outer", paddle.outer, [f32(3), f32(4)], [0, 1]),
+    ("einsum", lambda x, y: paddle.einsum("ij,jk->ik", x, y),
+     [f32(3, 4), f32(4, 2)], [0, 1]),
+    ("mv", lambda x, y: paddle.mv(x, y), [f32(3, 4), f32(4)], [0, 1]),
+    ("dist", lambda x, y: paddle.dist(x, y, 2),
+     [f32(3, 4), f32(3, 4)], [0]),
+    ("cross", lambda x, y: paddle.cross(x, y),
+     [f32(2, 3), f32(2, 3)], [0, 1]),
+    ("cholesky", lambda x: paddle.linalg.cholesky(
+        paddle.matmul(x, x, transpose_y=True)
+        + 0.5 * paddle.eye(3)), [f32(3, 3)], [0]),
+    ("solve", lambda a, b: paddle.linalg.solve(
+        a + 3.0 * paddle.eye(3), b), [f32(3, 3), f32(3, 2)], [0, 1]),
+    ("pinv_like_inv", lambda a: paddle.linalg.inv(
+        a + 3.0 * paddle.eye(3)), [f32(3, 3)], [0]),
+    # -- activations --------------------------------------------------------
+    ("relu", F.relu, [f32(2, 3) + 0.05], [0]),
+    ("relu6", F.relu6, [f32(2, 3)], [0]),
+    ("gelu", F.gelu, [f32(2, 3)], [0]),
+    ("silu", F.silu, [f32(2, 3)], [0]),
+    ("elu", F.elu, [f32(2, 3) + 0.05], [0]),
+    ("celu", F.celu, [f32(2, 3) + 0.05], [0]),
+    ("selu", F.selu, [f32(2, 3) + 0.05], [0]),
+    ("mish", F.mish, [f32(2, 3)], [0]),
+    ("swish", F.swish, [f32(2, 3)], [0]),
+    ("softplus", F.softplus, [f32(2, 3)], [0]),
+    ("hardswish", F.hardswish, [f32(2, 3) * 2], [0]),
+    ("hardsigmoid", F.hardsigmoid, [f32(2, 3)], [0]),
+    ("hardtanh", F.hardtanh, [f32(2, 3) * 0.5], [0]),
+    ("leaky_relu", F.leaky_relu, [f32(2, 3) + 0.05], [0]),
+    ("log_sigmoid", F.log_sigmoid, [f32(2, 3)], [0]),
+    ("tanhshrink", F.tanhshrink, [f32(2, 3)], [0]),
+    ("softshrink", lambda x: F.softshrink(x, 0.1), [f32(2, 3) + 0.5], [0]),
+    ("hardshrink", lambda x: F.hardshrink(x, 0.1), [f32(2, 3) + 0.5], [0]),
+    ("prelu_f", lambda x: F.prelu(x, paddle.to_tensor([0.2])),
+     [f32(2, 3) + 0.05], [0]),
+    ("glu", F.glu, [f32(2, 4)], [0]),
+    ("swiglu", lambda x, y: __import__(
+        "paddle_tpu.incubate.nn.functional",
+        fromlist=["swiglu"]).swiglu(x, y),
+     [f32(2, 3), f32(2, 3)], [0, 1]),
+    ("softmax", lambda x: F.softmax(x, axis=-1), [f32(2, 5)], [0]),
+    ("log_softmax", lambda x: F.log_softmax(x, axis=-1), [f32(2, 5)], [0]),
+    ("gumbel_like_maxout", lambda x: F.maxout(x, 2, 1), [f32(2, 4, 3)], [0]),
+    # -- losses / norm ------------------------------------------------------
+    ("mse_loss", lambda x, y: F.mse_loss(x, y),
+     [f32(3, 4), f32(3, 4)], [0]),
+    ("l1_loss", lambda x, y: F.l1_loss(x, y + 5.0),
+     [f32(3, 4), f32(3, 4)], [0]),
+    ("smooth_l1", lambda x, y: F.smooth_l1_loss(x, y),
+     [f32(3, 4), f32(3, 4) + 3.0], [0]),
+    ("kl_div", lambda x, y: F.kl_div(
+        F.log_softmax(x, -1), F.softmax(y, -1)),
+     [f32(3, 4), f32(3, 4)], [0]),
+    ("bce_logits", lambda x, _tgt=prob(3, 4):
+        F.binary_cross_entropy_with_logits(x, paddle.to_tensor(_tgt)),
+     [f32(3, 4)], [0]),
+    ("cross_entropy_g", lambda x: F.cross_entropy(
+        x, paddle.to_tensor(np.array([0, 2, 1], np.int64))),
+     [f32(3, 4)], [0]),
+    ("nll_loss_g", lambda x: F.nll_loss(
+        F.log_softmax(x, -1),
+        paddle.to_tensor(np.array([0, 2, 1], np.int64))), [f32(3, 4)], [0]),
+    ("layer_norm_g", lambda x: F.layer_norm(x, 4), [f32(3, 4)], [0]),
+    ("rms_norm_g", lambda x: F.rms_norm(x), [f32(3, 4)], [0]),
+    ("normalize", lambda x: F.normalize(x, axis=-1), [f32(3, 4)], [0]),
+    ("label_smooth", lambda x: F.label_smooth(x, epsilon=0.1),
+     [prob(3, 4)], [0]),
+    ("cosine_similarity", lambda x, y: F.cosine_similarity(x, y),
+     [f32(3, 4), f32(3, 4)], [0, 1]),
+    ("interpolate_g", lambda x: F.interpolate(
+        x, scale_factor=2, mode="nearest"), [f32(1, 2, 3, 3)], [0]),
+    ("one_hot_consume", lambda x: (paddle.nn.functional.one_hot(
+        paddle.to_tensor(np.array([0, 1], np.int64)), 3) * x).sum(),
+     [f32(2, 3)], [0]),
+]
+
+
+@pytest.mark.parametrize("name,fn,inputs,grad_idx", GRAD_CASES,
+                         ids=[c[0] for c in GRAD_CASES])
+def test_grad_sweep(name, fn, inputs, grad_idx):
+    """Every differentiable op: tape backward vs central differences."""
+    for gi in grad_idx:
+        check_grad(fn, inputs, gi)
+
+
+# ops whose outputs are discrete / non-differentiable: output checks only
+OUTPUT_CASES = [
+    ("argmax", lambda x: paddle.argmax(x, axis=1),
+     lambda a: np.argmax(a, 1), [f32(3, 4)]),
+    ("argmin", lambda x: paddle.argmin(x, axis=1),
+     lambda a: np.argmin(a, 1), [f32(3, 4)]),
+    ("argsort", lambda x: paddle.argsort(x, axis=1),
+     lambda a: np.argsort(a, 1, kind="stable"), [f32(3, 4)]),
+    ("sort", lambda x: paddle.sort(x, axis=1),
+     lambda a: np.sort(a, 1), [f32(3, 4)]),
+    ("floor", paddle.floor, np.floor, [f32(3, 4) * 3]),
+    ("ceil", paddle.ceil, np.ceil, [f32(3, 4) * 3]),
+    ("round", paddle.round, np.round, [f32(3, 4) * 3]),
+    ("trunc", paddle.trunc, np.trunc, [f32(3, 4) * 3]),
+    ("sign", paddle.sign, np.sign, [f32(3, 4)]),
+    ("isnan", paddle.isnan, np.isnan, [f32(3, 4)]),
+    ("isinf", paddle.isinf, np.isinf, [f32(3, 4)]),
+    ("isfinite", paddle.isfinite, np.isfinite, [f32(3, 4)]),
+    ("equal", paddle.equal, np.equal, [f32(2, 3), f32(2, 3)]),
+    ("greater_than", paddle.greater_than, np.greater,
+     [f32(2, 3), f32(2, 3)]),
+    ("less_equal", paddle.less_equal, np.less_equal,
+     [f32(2, 3), f32(2, 3)]),
+    ("logical_and", lambda x, y: paddle.logical_and(x > 0, y > 0),
+     lambda a, b: np.logical_and(a > 0, b > 0), [f32(2, 3), f32(2, 3)]),
+    ("bitwise_not_b", lambda x: paddle.bitwise_not(x > 0),
+     lambda a: ~(a > 0), [f32(2, 3)]),
+    ("clip_int", lambda x: paddle.clip(x, -1.0, 1.0),
+     lambda a: np.clip(a, -1, 1), [f32(3, 4) * 3]),
+    ("mod", paddle.mod, np.mod, [pos(2, 3) * 5, pos(2, 3)]),
+    ("floor_divide", paddle.floor_divide, np.floor_divide,
+     [pos(2, 3) * 5, pos(2, 3)]),
+    ("bincount", lambda x: paddle.bincount(x),
+     lambda a: np.bincount(a),
+     [np.array([0, 1, 1, 3], np.int64)]),
+    ("unique_vals", lambda x: paddle.unique(x),
+     lambda a: np.unique(a), [np.array([3, 1, 2, 1, 3], np.int64)]),
+    ("topk_vals", lambda x: paddle.topk(x, 2)[0],
+     lambda a: np.sort(a, -1)[..., ::-1][..., :2], [f32(3, 5)]),
+    ("kthvalue_v", lambda x: paddle.kthvalue(x, 2)[0],
+     lambda a: np.sort(a, -1)[..., 1], [f32(3, 5)]),
+    ("median", lambda x: paddle.median(x, axis=1),
+     lambda a: np.median(a, 1), [f32(3, 5)]),
+    ("quantile", lambda x: paddle.quantile(x, 0.5, axis=1),
+     lambda a: np.quantile(a, 0.5, 1), [f32(3, 5)]),
+    ("count_nonzero", lambda x: paddle.count_nonzero(x, axis=1),
+     lambda a: np.count_nonzero(a, 1), [f32(3, 4)]),
+    ("searchsorted", lambda x: paddle.searchsorted(
+        paddle.to_tensor(np.array([0., 1., 2.], np.float32)), x),
+     lambda a: np.searchsorted(np.array([0., 1., 2.]), a),
+     [prob(2, 3)]),
+    ("allclose_s", lambda x: paddle.allclose(x, x),
+     lambda a: np.array(True), [f32(2, 3)]),
+]
+
+
+@pytest.mark.parametrize("name,fn,ref,inputs", OUTPUT_CASES,
+                         ids=[c[0] for c in OUTPUT_CASES])
+def test_output_sweep(name, fn, ref, inputs):
+    check_output(fn, ref, inputs)
+
+
+class TestOddShapes:
+    """0-size and scalar inputs through the core families (the reference
+    sweeps odd shapes per op; op_test.py dtype/shape grids)."""
+
+    @pytest.mark.parametrize("op", [paddle.add, paddle.multiply,
+                                    paddle.maximum])
+    def test_zero_size_binary(self, op):
+        out = op(paddle.to_tensor(np.zeros((0, 3), np.float32)),
+                 paddle.to_tensor(np.zeros((0, 3), np.float32)))
+        assert list(out.shape) == [0, 3]
+
+    def test_zero_size_reduce(self):
+        x = paddle.to_tensor(np.zeros((0, 3), np.float32))
+        assert float(paddle.sum(x)) == 0.0
+        assert list(paddle.sum(x, axis=0).shape) == [3]
+
+    def test_zero_size_concat_matmul(self):
+        a = paddle.to_tensor(np.zeros((0, 4), np.float32))
+        b = paddle.to_tensor(np.ones((2, 4), np.float32))
+        assert list(paddle.concat([a, b], 0).shape) == [2, 4]
+        w = paddle.to_tensor(np.ones((4, 5), np.float32))
+        assert list(paddle.matmul(a, w).shape) == [0, 5]
+
+    def test_scalar_tensors(self):
+        s = paddle.to_tensor(np.float32(2.5))
+        assert list(s.shape) == []
+        assert float(paddle.exp(s)) == pytest.approx(np.exp(2.5), rel=1e-6)
+        assert float(s + s) == 5.0
+
+    def test_odd_dims_softmax_norm(self):
+        x = paddle.to_tensor(f32(1, 1, 7))
+        np.testing.assert_allclose(
+            float(F.softmax(x, -1).sum()), 1.0, rtol=1e-5)
+        y = F.layer_norm(paddle.to_tensor(f32(5, 1)), 1)
+        assert list(y.shape) == [5, 1]
+
+
+class TestBF16Sweep:
+    """bf16 runs of the core families stay finite and near the f32 result
+    (reference: OpTest dtype sweep with bf16 tolerances)."""
+
+    @pytest.mark.parametrize("fn,inputs", [
+        (paddle.matmul, [f32(8, 16), f32(16, 8)]),
+        (lambda x: F.softmax(x, -1), [f32(4, 16)]),
+        (lambda x: F.layer_norm(x, 16), [f32(4, 16)]),
+        (paddle.tanh, [f32(4, 8)]),
+        (lambda x, y: paddle.add(x, y), [f32(4, 8), f32(4, 8)]),
+    ], ids=["matmul", "softmax", "layer_norm", "tanh", "add"])
+    def test_bf16_close_to_f32(self, fn, inputs):
+        import jax.numpy as jnp
+        t32 = [paddle.to_tensor(i) for i in inputs]
+        t16 = [paddle.to_tensor(i, dtype="bfloat16") for i in inputs]
+        out32 = fn(*t32).numpy()
+        out16 = np.asarray(fn(*t16)._data.astype(jnp.float32))
+        assert np.isfinite(out16).all()
+        np.testing.assert_allclose(out16, out32, rtol=3e-2, atol=3e-2)
