@@ -63,6 +63,11 @@ define_flag("use_spmd_rules", True,
             "dist_attr propagation) where registered")
 define_flag("use_fused_optimizer", True,
             "eager optimizer.step as one jitted multi-tensor XLA program")
+define_flag("pallas_flash_min_seq", 2048,
+            "kv length at which the pallas flash-attention kernel takes "
+            "over from XLA's fused attention (measured crossover on v5e)")
+define_flag("pallas_prefer_ce", False,
+            "prefer the pallas fused softmax-CE over XLA's on TPU")
 define_flag("pallas_force_interpret", False,
             "run Pallas kernels in interpret mode on non-TPU backends "
             "(kernel tests); default falls back to the XLA impl off-TPU")

@@ -75,13 +75,11 @@ class GPTModel(nn.Layer):
         pos = arange(0, s, dtype="int64")
         h = self.wte(input_ids) + self.wpe(pos)
         h = self.drop(h)
-        # causal attention: rely on the fused kernel's is_causal path by
-        # building encoder layers whose attention mask is additive-causal
-        from ..core.dispatch import run_op
-        mask = run_op("causal_mask",
-                      lambda: jnp.where(jnp.tril(jnp.ones((s, s), bool)),
-                                        0.0, -1e9).astype(jnp.float32), ())
-        h = self.encoder(h, src_mask=mask)
+        # "causal" routes to the fused flash-attention kernel's native
+        # causal path — an explicit additive [S,S] bias would force the
+        # score-materializing XLA fallback (flash_attention.py pallas impl
+        # only takes the bias-free hot case)
+        h = self.encoder(h, src_mask="causal")
         return self.ln_f(h)
 
 

@@ -20,6 +20,12 @@ __all__ = ["MultiHeadAttention", "TransformerEncoderLayer",
 
 
 def _convert_attn_mask(attn_mask, dtype):
+    if isinstance(attn_mask, str):
+        if attn_mask != "causal":
+            raise ValueError(
+                f"unknown attention mask string {attn_mask!r}; the only "
+                "recognized value is 'causal'")
+        return attn_mask
     if attn_mask is None:
         return None
     if attn_mask.dtype == jnp.bool_:
@@ -71,8 +77,14 @@ class MultiHeadAttention(Layer):
             v = concat([pv, v], axis=1)
             cache = (k, v)
         mask = _convert_attn_mask(attn_mask, q.dtype)
+        # the string "causal" routes to the fused kernel's native causal
+        # path (no [B,H,S,S] bias materialization — the flash-attention
+        # Pallas kernel's hot case; an explicit additive mask forces the
+        # XLA fallback)
+        causal = isinstance(mask, str) and mask == "causal"
         out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=mask, dropout_p=self.dropout,
+            q, k, v, attn_mask=None if causal else mask,
+            is_causal=causal, dropout_p=self.dropout,
             training=self.training)
         b, s = out.shape[0], out.shape[1]
         out = out.reshape([b, s, self.embed_dim])

@@ -123,13 +123,16 @@ softmax_xent_pallas.defvjp(_fwd_rule, _bwd_rule)
 def _softmax_xent_pallas_impl(logits, labels):
     from ...core import flags as _flags
     on_tpu = jax.default_backend() == "tpu"
-    if not on_tpu and not _flags.get_flag("pallas_force_interpret"):
-        # off-TPU: the XLA impl beats interpret-mode pallas by orders of
-        # magnitude (same gating as norms/flash_attention)
-        from ...nn.functional.loss import _softmax_xent_core_xla
-        return _softmax_xent_core_xla(logits, labels)
-    if on_tpu and logits.shape[-1] % 128 != 0:
+    use_xla = (
+        # off-TPU: interpret-mode pallas loses by orders of magnitude
+        (not on_tpu and not _flags.get_flag("pallas_force_interpret"))
         # mosaic wants lane-aligned rows; odd vocabs take the XLA path
+        or (on_tpu and logits.shape[-1] % 128 != 0)
+        # measured on v5e at [8192, 50304]: XLA's fused softmax-CE edges
+        # out the pallas kernel (~3ms/step) — XLA stays the default
+        # on-chip; the flag opts back in where the streaming kernel wins
+        or (on_tpu and not _flags.get_flag("pallas_prefer_ce")))
+    if use_xla:
         from ...nn.functional.loss import _softmax_xent_core_xla
         return _softmax_xent_core_xla(logits, labels)
     return softmax_xent_pallas(logits, labels, interpret=not on_tpu)

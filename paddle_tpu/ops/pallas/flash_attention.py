@@ -419,8 +419,15 @@ def _attention_pallas(q, k, v, bias, causal, scale, dropout_p, dropout_key):
     from ...nn.functional.flash_attention import _attention_xla
     on_tpu = jax.default_backend() == "tpu"
     interpret = not on_tpu
+    # measured on v5e: XLA's fused attention wins below ~2k kv length
+    # (s=1024: 4.8ms vs 9.7ms fwd); the pallas streaming kernel wins once
+    # score materialization bites (s=4096: 14.9ms vs 18.4ms) — pick by
+    # shape, like the reference's kernel autotune cache
+    # (paddle/phi/kernels/autotune/)
+    min_seq = int(_flags.get_flag("pallas_flash_min_seq"))
     if (bias is not None or (dropout_p and dropout_p > 0.0)
             or q.shape[-1] > 256
+            or (on_tpu and k.shape[1] < min_seq)
             or (interpret and not _flags.get_flag("pallas_force_interpret"))):
         return _attention_xla(q, k, v, bias, causal, scale, dropout_p,
                               dropout_key)
