@@ -38,14 +38,17 @@ from . import vision  # noqa: F401
 from . import jit  # noqa: F401
 from . import distribution  # noqa: F401
 from . import sparse  # noqa: F401
+from . import audio  # noqa: F401
+from . import text  # noqa: F401
+from . import inference  # noqa: F401
+from . import utils  # noqa: F401
 from . import quantization  # noqa: F401
 from .hapi import Model, callbacks  # noqa: F401
 from .framework import save, load, in_dynamic_mode, is_compiled_with_cuda, is_compiled_with_xpu, is_compiled_with_rocm, is_compiled_with_custom_device  # noqa: F401
 from .nn.layer.layers import Layer  # noqa: F401
 from .nn.parameter import Parameter, create_parameter  # noqa: F401
 
-disable_static = lambda place=None: None  # dygraph-first: always dynamic
-enable_static = lambda: (_ for _ in ()).throw(
-    NotImplementedError("static graph mode is jit.to_static in paddle_tpu"))
+from . import static  # noqa: F401
+from .static import enable_static, disable_static  # noqa: F401
 
 __version__ = "0.1.0"

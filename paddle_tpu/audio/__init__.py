@@ -1,0 +1,222 @@
+"""Audio domain library (parity: python/paddle/audio/ — functional window/
+mel/dct utilities and the Spectrogram/MelSpectrogram/LogMelSpectrogram/
+MFCC feature layers).
+
+TPU-native: framing + windowing + rFFT compose into one XLA program (the
+MXU eats the mel-filterbank matmul); everything is differentiable and
+batchable, unlike the reference's CPU feature path."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["functional", "features"]
+
+
+class functional:
+    """paddle.audio.functional."""
+
+    @staticmethod
+    def hz_to_mel(freq, htk: bool = False):
+        f = np.asarray(freq, np.float64)
+        if htk:
+            out = 2595.0 * np.log10(1.0 + f / 700.0)
+        else:
+            f_min, f_sp = 0.0, 200.0 / 3
+            out = (f - f_min) / f_sp
+            min_log_hz = 1000.0
+            min_log_mel = (min_log_hz - f_min) / f_sp
+            logstep = math.log(6.4) / 27.0
+            safe = np.maximum(f, 1e-30)  # both where-branches evaluate
+            out = np.where(f >= min_log_hz,
+                           min_log_mel + np.log(safe / min_log_hz) / logstep,
+                           out)
+        return float(out) if np.isscalar(freq) else out
+
+    @staticmethod
+    def mel_to_hz(mel, htk: bool = False):
+        m = np.asarray(mel, np.float64)
+        if htk:
+            out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+        else:
+            f_min, f_sp = 0.0, 200.0 / 3
+            out = f_min + f_sp * m
+            min_log_hz = 1000.0
+            min_log_mel = (min_log_hz - f_min) / f_sp
+            logstep = math.log(6.4) / 27.0
+            out = np.where(m >= min_log_mel,
+                           min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                           out)
+        return float(out) if np.isscalar(mel) else out
+
+    @staticmethod
+    def get_window(window: str, win_length: int, fftbins: bool = True):
+        """hann/hamming/blackman/bartlett/kaiser (parity:
+        audio/functional/window.py)."""
+        n = win_length
+        sym = not fftbins
+        m = n if sym else n + 1
+        k = np.arange(m)
+        if window == "hann":
+            w = 0.5 - 0.5 * np.cos(2 * np.pi * k / (m - 1))
+        elif window == "hamming":
+            w = 0.54 - 0.46 * np.cos(2 * np.pi * k / (m - 1))
+        elif window == "blackman":
+            w = (0.42 - 0.5 * np.cos(2 * np.pi * k / (m - 1))
+                 + 0.08 * np.cos(4 * np.pi * k / (m - 1)))
+        elif window == "bartlett":
+            w = 1.0 - np.abs(2 * k / (m - 1) - 1)
+        elif window == "kaiser":
+            w = np.kaiser(m, 12.0)
+        else:
+            raise ValueError(f"unknown window {window!r}")
+        if not sym:
+            w = w[:-1]
+        return Tensor(jnp.asarray(w, jnp.float32))
+
+    @staticmethod
+    def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                             f_min: float = 0.0, f_max=None,
+                             htk: bool = False, norm="slaney"):
+        """Mel filterbank [n_mels, n_fft//2+1] (parity:
+        audio/functional/functional.py compute_fbank_matrix)."""
+        f_max = f_max or sr / 2.0
+        n_bins = n_fft // 2 + 1
+        fft_freqs = np.linspace(0, sr / 2.0, n_bins)
+        mel_pts = np.linspace(functional.hz_to_mel(f_min, htk),
+                              functional.hz_to_mel(f_max, htk), n_mels + 2)
+        hz_pts = functional.mel_to_hz(mel_pts, htk)
+        fb = np.zeros((n_mels, n_bins))
+        for i in range(n_mels):
+            lo, c, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+            up = (fft_freqs - lo) / max(c - lo, 1e-10)
+            down = (hi - fft_freqs) / max(hi - c, 1e-10)
+            fb[i] = np.maximum(0.0, np.minimum(up, down))
+        if norm == "slaney":
+            enorm = 2.0 / (hz_pts[2:n_mels + 2] - hz_pts[:n_mels])
+            fb *= enorm[:, None]
+        return Tensor(jnp.asarray(fb, jnp.float32))
+
+    @staticmethod
+    def create_dct(n_mfcc: int, n_mels: int, norm="ortho"):
+        """DCT-II matrix [n_mels, n_mfcc] (parity: create_dct)."""
+        k = np.arange(n_mels)
+        dct = np.cos(np.pi / n_mels * (k[:, None] + 0.5)
+                     * np.arange(n_mfcc)[None, :])
+        if norm == "ortho":
+            dct[:, 0] *= 1.0 / math.sqrt(n_mels)
+            dct[:, 1:] *= math.sqrt(2.0 / n_mels)
+        else:
+            dct *= 2.0
+        return Tensor(jnp.asarray(dct, jnp.float32))
+
+    @staticmethod
+    def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                    top_db=80.0):
+        def fn(s):
+            log_spec = 10.0 * jnp.log10(jnp.maximum(s, amin))
+            log_spec = log_spec - 10.0 * jnp.log10(
+                jnp.maximum(amin, ref_value))
+            if top_db is not None:
+                log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+            return log_spec
+        return run_op("power_to_db", fn, (spect,))
+
+
+def _stft_mag(x, n_fft, hop_length, window, power, center):
+    """|STFT|^power over the last axis: frame -> window -> rfft."""
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)],
+                    mode="reflect")
+    n = x.shape[-1]
+    n_frames = 1 + (n - n_fft) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])
+    frames = x[..., idx] * window            # [..., frames, n_fft]
+    spec = jnp.abs(jnp.fft.rfft(frames, axis=-1)) ** power
+    return jnp.swapaxes(spec, -1, -2)        # [..., freq, frames]
+
+
+class features:
+    """paddle.audio.features layers."""
+
+    class Spectrogram(Layer):
+        def __init__(self, n_fft: int = 512, hop_length=None,
+                     win_length=None, window: str = "hann", power: float = 2.0,
+                     center: bool = True, pad_mode: str = "reflect",
+                     dtype: str = "float32"):
+            super().__init__()
+            self.n_fft = n_fft
+            self.hop_length = hop_length or n_fft // 4
+            self.power = power
+            self.center = center
+            win_length = win_length or n_fft
+            w = functional.get_window(window, win_length)._data
+            if win_length < n_fft:  # center-pad the window to n_fft
+                lpad = (n_fft - win_length) // 2
+                w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+            self.register_buffer("window", Tensor(w))
+
+        def forward(self, x):
+            win = self.window._data
+            return run_op(
+                "spectrogram",
+                lambda a: _stft_mag(a, self.n_fft, self.hop_length, win,
+                                    self.power, self.center), (x,))
+
+    class MelSpectrogram(Layer):
+        def __init__(self, sr: int = 22050, n_fft: int = 512,
+                     hop_length=None, win_length=None, window: str = "hann",
+                     power: float = 2.0, center: bool = True,
+                     n_mels: int = 64, f_min: float = 50.0, f_max=None,
+                     htk: bool = False, norm="slaney", dtype="float32"):
+            super().__init__()
+            self.spectrogram = features.Spectrogram(
+                n_fft, hop_length, win_length, window, power, center)
+            fb = functional.compute_fbank_matrix(
+                sr, n_fft, n_mels, f_min, f_max, htk, norm)
+            self.register_buffer("fbank", fb)
+
+        def forward(self, x):
+            spec = self.spectrogram(x)
+            fb = self.fbank._data
+            return run_op("mel_spectrogram",
+                          lambda s: jnp.einsum("mf,...ft->...mt", fb, s),
+                          (spec,))
+
+    class LogMelSpectrogram(Layer):
+        def __init__(self, *args, ref_value: float = 1.0, amin: float = 1e-10,
+                     top_db=None, **kwargs):
+            super().__init__()
+            self.mel = features.MelSpectrogram(*args, **kwargs)
+            self.ref_value = ref_value
+            self.amin = amin
+            self.top_db = top_db
+
+        def forward(self, x):
+            return functional.power_to_db(self.mel(x), self.ref_value,
+                                          self.amin, self.top_db)
+
+    class MFCC(Layer):
+        def __init__(self, sr: int = 22050, n_mfcc: int = 40,
+                     n_mels: int = 64, **kwargs):
+            super().__init__()
+            self.logmel = features.LogMelSpectrogram(sr, n_mels=n_mels,
+                                                     **kwargs)
+            self.register_buffer("dct", functional.create_dct(n_mfcc,
+                                                              n_mels))
+
+        def forward(self, x):
+            lm = self.logmel(x)
+            dct = self.dct._data
+            return run_op("mfcc",
+                          lambda s: jnp.einsum("mk,...mt->...kt", dct, s),
+                          (lm,))

@@ -57,6 +57,19 @@ def _unwrap(x):
     return x
 
 
+_static_mod = None
+
+
+def _static_mode_on() -> bool:
+    global _static_mod
+    if _static_mod is None:
+        import sys
+        _static_mod = sys.modules.get("paddle_tpu.static")
+        if _static_mod is None:
+            return False
+    return _static_mod.in_static_mode()
+
+
 def _is_inexact(arr) -> bool:
     return jnp.issubdtype(jnp.result_type(arr), jnp.inexact)
 
@@ -102,6 +115,14 @@ def run_op(
 
 def _run_op_impl(name, jax_fn, operands, num_nondiff_outputs,
                  out_stop_gradient, attrs=None):
+    if _static_mode_on():
+        from ..static import Variable, record_op
+        if any(isinstance(o, Variable) for o in operands):
+            # static mode: append an OpNode to the current Program instead
+            # of executing (the reference's append_op path,
+            # base/framework.py LayerHelper.append_op)
+            return record_op(name, jax_fn, operands, num_nondiff_outputs,
+                             attrs)
     arrays = [_unwrap(o) for o in operands]
 
     cast_to = amp_state.amp_cast_dtype(name)

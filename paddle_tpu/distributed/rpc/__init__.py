@@ -1,0 +1,193 @@
+"""RPC (parity: python/paddle/distributed/rpc/ — init_rpc, rpc_sync,
+rpc_async, get_worker_info, shutdown; reference transport is the brpc
+parameter-server service).
+
+TPU-native design: host-side control RPC rides the same TCPStore the
+launcher/elastic stack already uses (SURVEY §5.8: host coordination via
+the KV store) — each worker runs an agent thread that polls its request
+queue, executes the pickled callable, and writes the pickled reply. Data
+movement between chips stays in XLA collectives; this is the
+control-plane sidecar, exactly the role the reference's RPC plays."""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+import traceback
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from ..store import TCPStore
+
+__all__ = ["init_rpc", "shutdown", "rpc_sync", "rpc_async",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+_PREFIX = "__rpc"
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+
+
+class _Agent:
+    def __init__(self, store: TCPStore, name: str, rank: int,
+                 world_size: int):
+        self.store = store
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self._stop = threading.Event()
+        self._served = 0
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name=f"rpc-agent:{name}")
+
+    def start(self):
+        self.store.set(f"{_PREFIX}/worker/{self.name}", str(self.rank))
+        self.store.add(f"{_PREFIX}/registered", 1)
+        self._thread.start()
+
+    def _serve(self):
+        qkey = f"{_PREFIX}/q/{self.name}"
+        while not self._stop.is_set():
+            try:
+                pending = self.store.add(qkey, 0)
+            except Exception:
+                return
+            if pending <= self._served:
+                time.sleep(0.01)
+                continue
+            seq = self._served
+            self._served += 1
+            try:
+                raw = self.store.get(f"{qkey}/{seq}")
+                fn, args, kwargs = pickle.loads(raw)
+                try:
+                    result = (True, fn(*args, **(kwargs or {})))
+                except Exception as e:  # ship the error to the caller
+                    result = (False, f"{type(e).__name__}: {e}\n"
+                                     f"{traceback.format_exc()}")
+                self.store.set(f"{qkey}/{seq}/reply", pickle.dumps(result))
+            except Exception:
+                if not self._stop.is_set():
+                    continue
+                return
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(1.0)
+
+
+_STATE: Dict[str, Any] = {"store": None, "agent": None}
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: str = "127.0.0.1:0",
+             store: Optional[TCPStore] = None) -> WorkerInfo:
+    """Join the RPC world (parity: dist.rpc.init_rpc). rank 0 hosts the
+    rendezvous store unless an existing store is passed."""
+    if _STATE["agent"] is not None:
+        raise RuntimeError("init_rpc already called; call shutdown() first")
+    rank = rank if rank is not None else 0
+    world_size = world_size or 1
+    if store is None:
+        host, port = master_endpoint.rsplit(":", 1)
+        store = TCPStore(host, int(port), is_master=rank == 0,
+                         world_size=world_size)
+    agent = _Agent(store, name, rank, world_size)
+    agent.start()
+    _STATE.update(store=store, agent=agent)
+    return WorkerInfo(name, rank)
+
+
+def _require_agent() -> _Agent:
+    agent = _STATE["agent"]
+    if agent is None:
+        raise RuntimeError("call init_rpc() first")
+    return agent
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    agent = _require_agent()
+    if name is None or name == agent.name:
+        return WorkerInfo(agent.name, agent.rank)
+    raw = agent.store.get(f"{_PREFIX}/worker/{name}")
+    return WorkerInfo(name, int(raw.decode()))
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    agent = _require_agent()
+    n = agent.store.add(f"{_PREFIX}/registered", 0)
+    del n  # names are not centrally enumerated; reference returns the map
+    return [get_worker_info()]
+
+
+class _Future:
+    """Parity: the FutureWrapper rpc_async returns."""
+
+    def __init__(self, store, qkey, seq, timeout):
+        self._store = store
+        self._key = f"{qkey}/{seq}/reply"
+        self._timeout = timeout
+        self._done = threading.Event()
+        self._result = None
+        self._thread = threading.Thread(target=self._poll, daemon=True)
+        self._thread.start()
+
+    def _poll(self):
+        deadline = time.monotonic() + self._timeout
+        while time.monotonic() < deadline:
+            try:
+                raw = self._store.get(self._key, wait=False)
+            except KeyError:
+                time.sleep(0.01)
+                continue
+            if raw:
+                self._result = pickle.loads(raw)
+                self._done.set()
+                return
+            time.sleep(0.01)
+        self._result = (False, f"rpc reply timed out after {self._timeout}s")
+        self._done.set()
+
+    def wait(self):
+        self._done.wait()
+        ok, value = self._result
+        if not ok:
+            raise RuntimeError(f"remote call failed: {value}")
+        return value
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None,
+              timeout: float = 30.0) -> _Future:
+    agent = _require_agent()
+    qkey = f"{_PREFIX}/q/{to}"
+    payload = pickle.dumps((fn, tuple(args), kwargs or {}))
+    # claim a sequence slot, publish the request, then bump the pending
+    # counter the target agent polls
+    seq = agent.store.add(f"{qkey}/next", 1) - 1
+    agent.store.set(f"{qkey}/{seq}", payload)
+    agent.store.add(qkey, 1)
+    return _Future(agent.store, qkey, seq, timeout)
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 30.0):
+    return rpc_async(to, fn, args, kwargs, timeout).wait()
+
+
+def shutdown(graceful: bool = True):
+    agent = _STATE["agent"]
+    if agent is not None:
+        agent.stop()
+    store = _STATE["store"]
+    if store is not None and graceful:
+        try:
+            store.close()
+        except Exception:
+            pass
+    _STATE.update(store=None, agent=None)

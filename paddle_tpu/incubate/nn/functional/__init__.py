@@ -18,7 +18,8 @@ from ....nn import functional as F
 
 __all__ = ["fused_rotary_position_embedding", "fused_rms_norm",
            "fused_layer_norm", "fused_dropout_add", "swiglu",
-           "fused_linear", "fused_bias_act"]
+           "fused_linear", "fused_bias_act",
+           "masked_multihead_attention", "block_multihead_attention"]
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
@@ -181,3 +182,139 @@ def fused_bias_act(x, bias=None, act_method="gelu", name=None):
         return acts[act_method](a)
     ops = (x,) if bias is None else (x, bias)
     return run_op("fused_bias_act", fn, ops)
+
+
+# -- inference-decode attention (the reference's serving kernel class) -------
+
+def masked_multihead_attention(x, cache_kv, src_mask=None, seq_lens=None,
+                               num_heads=None, name=None):
+    """Single-step decode attention with a contiguous KV cache (parity:
+    paddle/phi/kernels/fusion/gpu/masked_multihead_attention.cu via
+    incubate.nn.functional.masked_multihead_attention).
+
+    x         [B, 3*H*D]  — the new token's fused qkv
+    cache_kv  [2, B, H, S_max, D] — rolling cache; the new k/v are written
+              at position ``seq_lens`` and attention runs over the prefix
+    seq_lens  [B] int32 — tokens already in the cache per sequence
+    -> (out [B, H*D], updated cache_kv)
+
+    TPU-native: one XLA program — dynamic_update_slice writes the cache,
+    an iota mask closes the future; decode is HBM-bound so XLA's fusion
+    is the right lowering (no hand kernel needed)."""
+    from ....core.tensor import Tensor
+    if num_heads is None:
+        h = cache_kv.shape[2] if not isinstance(cache_kv, Tensor) \
+            else cache_kv._data.shape[2]
+    else:
+        h = num_heads
+
+    def fn(*args):
+        if src_mask is not None:
+            xa, cache, lens, mask = args
+        else:
+            (xa, cache, lens), mask = args, None
+        b = xa.shape[0]
+        d = cache.shape[-1]
+        smax = cache.shape[3]
+        qkv = xa.reshape(b, 3, h, d)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]   # [B, H, D]
+
+        def upd(cache_b, k_b, v_b, n):
+            z = jnp.int32(0)  # index dtypes must match under x64
+            ck = jax.lax.dynamic_update_slice(cache_b[0], k_b[:, None, :],
+                                              (z, n, z))
+            cv = jax.lax.dynamic_update_slice(cache_b[1], v_b[:, None, :],
+                                              (z, n, z))
+            return jnp.stack([ck, cv])
+
+        # cache [2,B,H,S,D] -> per-batch [2,H,S,D]
+        cache_b = jnp.moveaxis(cache, 1, 0)          # [B,2,H,S,D]
+        new_cache_b = jax.vmap(upd)(cache_b, k, v,
+                                    lens.astype(jnp.int32))
+        new_cache = jnp.moveaxis(new_cache_b, 0, 1)  # [2,B,H,S,D]
+
+        keys, vals = new_cache[0], new_cache[1]      # [B,H,S,D]
+        scores = jnp.einsum("bhd,bhsd->bhs", q, keys) * (d ** -0.5)
+        pos = jnp.arange(smax)[None, None, :]
+        valid = pos <= lens.astype(jnp.int32)[:, None, None]
+        scores = jnp.where(valid, scores, -jnp.inf)
+        if mask is not None:
+            # additive mask over cache positions (reference applies it to
+            # the scores): accept [B, S], [B, 1, S] or [B, H, S]
+            m = mask.reshape(b, -1, mask.shape[-1])
+            scores = scores + m.astype(scores.dtype)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("bhs,bhsd->bhd", probs.astype(vals.dtype), vals)
+        return out.reshape(b, h * d), new_cache
+
+    ops = (x, cache_kv, seq_lens) if src_mask is None \
+        else (x, cache_kv, seq_lens, src_mask)
+    return run_op("masked_multihead_attention", fn, ops)
+
+
+def block_multihead_attention(q, k, v, key_cache, value_cache, block_tables,
+                              seq_lens, block_size=None, name=None):
+    """Paged-KV decode attention (parity:
+    paddle/phi/kernels/fusion/gpu/block_multi_head_attention.cu — the
+    vLLM-style paged attention the reference serves with).
+
+    q, k, v      [B, H, D]    — the new token per sequence
+    key_cache /
+    value_cache  [num_blocks, H, block_size, D] — the shared block pool
+    block_tables [B, max_blocks_per_seq] int32  — logical->physical blocks
+    seq_lens     [B] int32    — tokens already stored per sequence
+    -> (out [B, H, D], new_key_cache, new_value_cache)
+
+    TPU-native: block gather is one XLA gather over the pool; the scatter
+    of the new token hits exactly one (block, slot) per sequence. Gather +
+    batched matmul keeps the MXU busy; no CUDA-style warp choreography."""
+
+    def fn(qa, ka, va, kc, vc, tables, lens):
+        b, h, d = qa.shape
+        bs = kc.shape[2] if block_size is None else block_size
+        max_blocks = tables.shape[1]
+        lens = lens.astype(jnp.int32)
+        if not isinstance(lens, jax.core.Tracer):
+            # eager path: catch the append-without-free-slot contract
+            # violation that a traced run would silently clamp
+            if bool((lens >= max_blocks * bs).any()):
+                raise ValueError(
+                    "block_multihead_attention: a sequence's block table "
+                    f"is full (len >= {max_blocks * bs}); allocate a new "
+                    "block before appending (the reference's block "
+                    "manager contract)")
+        # scatter the new k/v into (physical block, slot)
+        blk_idx = lens // bs
+        slot = lens % bs
+        phys = jnp.take_along_axis(tables, blk_idx[:, None], 1)[:, 0]
+
+        def write(cache, token):
+            def one(cache, i):
+                z = jnp.int32(0)
+                return jax.lax.dynamic_update_slice(
+                    cache, token[i][None, :, None, :].astype(cache.dtype),
+                    (phys[i].astype(jnp.int32), z,
+                     slot[i].astype(jnp.int32), z))
+            for i in range(b):  # b is small at decode time; unrolled scatter
+                cache = one(cache, i)
+            return cache
+
+        new_kc = write(kc, ka)
+        new_vc = write(vc, va)
+
+        # gather each sequence's blocks: [B, max_blocks, H, bs, D]
+        gk = new_kc[tables]
+        gv = new_vc[tables]
+        # -> [B, H, max_blocks*bs, D]
+        gk = jnp.moveaxis(gk, 2, 1).reshape(b, h, max_blocks * bs, d)
+        gv = jnp.moveaxis(gv, 2, 1).reshape(b, h, max_blocks * bs, d)
+        scores = jnp.einsum("bhd,bhsd->bhs", qa, gk) * (d ** -0.5)
+        pos = jnp.arange(max_blocks * bs)[None, None, :]
+        valid = pos <= lens[:, None, None]
+        scores = jnp.where(valid, scores, -jnp.inf)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("bhs,bhsd->bhd", probs.astype(gv.dtype), gv)
+        return out, new_kc, new_vc
+
+    return run_op("block_multihead_attention", fn,
+                  (q, k, v, key_cache, value_cache, block_tables, seq_lens))

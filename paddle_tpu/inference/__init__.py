@@ -1,0 +1,136 @@
+"""Inference Python API (parity: python/paddle/inference/ wrapping the
+AnalysisPredictor, reference paddle/fluid/inference/api/analysis_predictor.cc).
+
+TPU-native design: the deployment artifact is the StableHLO export that
+``paddle.jit.save`` writes (SURVEY §7.1: "export path = StableHLO" — XLA
+is the inference engine, so the reference's 90k-LoC analysis/TensorRT
+stack has no role). ``Config`` points at the exported prefix;
+``create_predictor`` loads it and compiles once per input signature;
+handles copy numpy in/out like the reference's Tensor handles.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+class Config:
+    """Parity: paddle.inference.Config(prog_file, params_file) — here one
+    prefix, the path given to paddle.jit.save."""
+
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
+        if model_path is not None and model_path.endswith(".pdmodel"):
+            model_path = model_path[:-len(".pdmodel")]
+        self.model_path = model_path
+        self.params_path = params_path
+        self._precision = PrecisionType.Float32
+        self._memory_pool_mb = None
+
+    def set_prog_file(self, path: str):
+        self.model_path = path[:-len(".pdmodel")] \
+            if path.endswith(".pdmodel") else path
+
+    def prog_file(self):
+        return self.model_path
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        pass  # accelerator selection is the runtime's (libtpu) job
+
+    def disable_gpu(self):
+        pass
+
+    def enable_memory_optim(self):
+        pass  # XLA owns buffer assignment
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA owns graph optimization
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        raise NotImplementedError(
+            "TensorRT has no TPU analog; XLA compiles the exported "
+            "StableHLO directly")
+
+
+class _IOHandle:
+    """Parity: the predictor's input/output Tensor handle."""
+
+    def __init__(self, name: str, owner: "Predictor", is_input: bool):
+        self.name = name
+        self._owner = owner
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        assert self._is_input
+        self._owner._inputs[self.name] = np.asarray(arr)
+
+    def reshape(self, shape):
+        pass  # shapes come from the copied array
+
+    def copy_to_cpu(self) -> np.ndarray:
+        assert not self._is_input
+        return self._owner._outputs[self.name]
+
+    def shape(self):
+        src = self._owner._inputs if self._is_input else self._owner._outputs
+        return list(src[self.name].shape)
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit import load
+        if config.model_path is None:
+            raise ValueError("Config has no model path")
+        self._layer = load(config.model_path)
+        self._config = config
+        n_in = len(self._layer.input_spec) or 1
+        self._input_names = [f"x{i}" for i in range(n_in)]
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: Dict[str, np.ndarray] = {}
+        self._output_names: List[str] = []
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> _IOHandle:
+        return _IOHandle(name, self, True)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> _IOHandle:
+        return _IOHandle(name, self, False)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute; positional ``inputs`` are accepted like the newer
+        reference API, else the copy_from_cpu'd handles are used."""
+        if inputs is not None:
+            args = [np.asarray(a) for a in inputs]
+        else:
+            args = [self._inputs[n] for n in self._input_names]
+        outs = self._layer(*args)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        self._output_names = [f"out{i}" for i in range(len(outs))]
+        self._outputs = {
+            n: np.asarray(o.numpy() if hasattr(o, "numpy") else o)
+            for n, o in zip(self._output_names, outs)}
+        if inputs is not None:
+            return [self._outputs[n] for n in self._output_names]
+        return True
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

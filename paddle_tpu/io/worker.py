@@ -152,6 +152,12 @@ class MultiprocessLoaderIter:
                     f"'{method}' start method: {e}") from e
             self.procs.append(p)
         self._done = [False] * self.num_workers
+        self._started = [False] * self.num_workers
+        self._t0 = __import__("time").monotonic()
+        # workers re-import the framework (jax alone is ~5s) under
+        # forkserver; the user-facing timeout must not tick during startup
+        # (reference: its timeout is per-batch once workers are live)
+        self._startup_grace = 120.0
         self._next = 0
 
     def __iter__(self):
@@ -175,6 +181,7 @@ class MultiprocessLoaderIter:
                 try:
                     rec = self.queues[w].pop(
                         timeout_s=max(0.05, min(1.0, remaining)))
+                    self._started[w] = True
                     break
                 except TimeoutError:
                     proc = self.procs[w]
@@ -184,6 +191,12 @@ class MultiprocessLoaderIter:
                             f"DataLoader worker {w} died (exit code "
                             f"{proc.exitcode})") from None
                     if remaining <= 0:
+                        if not self._started[w] and \
+                                time.monotonic() - self._t0 < \
+                                self._startup_grace:
+                            # still importing/booting: extend, don't fail
+                            deadline = time.monotonic() + self.timeout
+                            continue
                         raise
             if rec is None:
                 self._done[w] = True

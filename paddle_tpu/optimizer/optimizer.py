@@ -195,6 +195,13 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static import Program, TrainNode, Variable
+        if isinstance(loss, Variable):
+            # static mode: append the backward + update step to the loss's
+            # program (parity: append_backward + the optimizer ops)
+            loss.program.train_node = TrainNode(loss, self)
+            loss.program._version += 1
+            return None, None
         loss.backward()
         self.step()
         self.clear_grad()

@@ -1,0 +1,486 @@
+"""Static-graph front end (parity: python/paddle/static/ + the Program/
+Block/Variable model of python/paddle/base/framework.py — ~30k LoC in the
+reference).
+
+TPU-native design: the reference's static mode builds a ProgramDesc that
+its interpreters execute; here a ``Program`` records the op DAG at
+API-call time (the dispatch funnel appends an ``OpNode`` whenever an
+operand is a symbolic ``Variable``) and ``Executor.run`` compiles the
+recorded DAG into ONE jitted XLA program per feed signature — the
+StandaloneExecutor/_ExecutorCache pair collapses onto jax.jit and its
+cache (SURVEY §7.1). ``Optimizer.minimize`` inside a program appends a
+training node, so ``exe.run(feed, fetch_list)`` is a full compiled train
+step, exactly the reference's usage shape:
+
+    paddle.enable_static()
+    x = static.data('x', [None, 784])
+    y = static.data('y', [None, 1], 'int64')
+    loss = F.cross_entropy(net(x), y)
+    opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(static.default_startup_program())
+    loss_val, = exe.run(feed={'x': xs, 'y': ys}, fetch_list=[loss])
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Variable", "Program", "Executor", "data", "program_guard",
+           "default_main_program", "default_startup_program",
+           "enable_static", "disable_static", "in_static_mode", "scope_guard",
+           "global_scope", "name_scope", "InputSpec"]
+
+_STATIC_MODE = [False]
+_counter = itertools.count()
+
+
+def enable_static():
+    _STATIC_MODE[0] = True
+
+
+def disable_static(place=None):
+    del place  # parity: paddle.disable_static(place)
+    _STATIC_MODE[0] = False
+
+
+def in_static_mode() -> bool:
+    return _STATIC_MODE[0]
+
+
+class Variable:
+    """Symbolic tensor in a Program (parity: base/framework.py Variable).
+    Shape may contain None (dynamic batch); dtype is a jnp dtype."""
+
+    def __init__(self, program: "Program", shape, dtype, name=None,
+                 producer=None, out_idx: int = 0, is_input: bool = False):
+        self.program = program
+        self.shape = list(shape)
+        self.dtype = jnp.dtype(dtype) if not isinstance(dtype, jnp.dtype) \
+            else dtype
+        self.name = name or f"var_{next(_counter)}"
+        self.producer = producer      # OpNode or None (feed input)
+        self.out_idx = out_idx
+        self.is_input = is_input
+        self.stop_gradient = True
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def sds(self, dynamic: Optional[Dict[str, int]] = None):
+        shape = tuple(1 if d is None else d for d in self.shape)
+        return jax.ShapeDtypeStruct(shape, self.dtype)
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+    # -- operator sugar (static-graph arithmetic) -------------------------
+    def _binop(self, other, opname):
+        from .. import tensor as T
+        return getattr(T, opname)(self, other)
+
+    def __add__(self, other):
+        return self._binop(other, "add")
+
+    def __radd__(self, other):
+        return self._binop(other, "add")
+
+    def __sub__(self, other):
+        return self._binop(other, "subtract")
+
+    def __mul__(self, other):
+        return self._binop(other, "multiply")
+
+    def __rmul__(self, other):
+        return self._binop(other, "multiply")
+
+    def __truediv__(self, other):
+        return self._binop(other, "divide")
+
+    def __pow__(self, other):
+        from ..tensor.math import pow as _pow
+        return _pow(self, other)
+
+    def __neg__(self):
+        from ..tensor.math import neg
+        return neg(self)
+
+    def __matmul__(self, other):
+        from ..tensor.linalg import matmul
+        return matmul(self, other)
+
+    def reshape(self, shape):
+        from ..tensor.manipulation import reshape
+        return reshape(self, shape)
+
+    def astype(self, dtype):
+        from ..tensor.manipulation import cast
+        return cast(self, dtype)
+
+    def sum(self, axis=None, keepdim=False):
+        from ..tensor.math import sum as _sum
+        return _sum(self, axis=axis, keepdim=keepdim)
+
+    def mean(self, axis=None, keepdim=False):
+        from ..tensor.math import mean
+        return mean(self, axis=axis, keepdim=keepdim)
+
+
+class OpNode:
+    """One recorded op: a pure jax function over resolved operand values
+    (parity: one OpDesc in the reference's ProgramDesc)."""
+
+    def __init__(self, name, jax_fn, operands, outputs):
+        self.name = name
+        self.jax_fn = jax_fn
+        self.operands = list(operands)   # Variable | Tensor | raw value
+        self.outputs = outputs           # list[Variable]
+
+
+class TrainNode:
+    """Appended by Optimizer.minimize: grads of ``loss`` w.r.t. the
+    program's captured parameters + the optimizer update (parity: the
+    backward + optimizer ops append_backward emits)."""
+
+    def __init__(self, loss_var: Variable, optimizer):
+        self.loss = loss_var
+        self.optimizer = optimizer
+        self._states = None  # optimizer state, shared across feed shapes
+
+
+class Program:
+    """A recorded op DAG (parity: static.Program)."""
+
+    def __init__(self):
+        self.inputs: Dict[str, Variable] = {}
+        self.nodes: List[OpNode] = []
+        self.train_node: Optional[TrainNode] = None
+        self._version = 0
+
+    def _add_input(self, var: Variable):
+        self.inputs[var.name] = var
+        self._version += 1
+
+    def _add_node(self, node: OpNode):
+        self.nodes.append(node)
+        self._version += 1
+
+    def parameters(self):
+        """Captured concrete Tensors (the reference's persistable vars)."""
+        from ..core.tensor import Tensor
+        seen, out = set(), []
+        for n in self.nodes:
+            for o in n.operands:
+                if isinstance(o, Tensor) and not o.stop_gradient \
+                        and id(o) not in seen:
+                    seen.add(id(o))
+                    out.append(o)
+        return out
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        p = Program()
+        p.inputs = dict(self.inputs)
+        p.nodes = list(self.nodes)
+        p.train_node = None if for_test else self.train_node
+        return p
+
+
+_MAIN = [Program()]
+_STARTUP = [Program()]
+
+
+def default_main_program() -> Program:
+    return _MAIN[0]
+
+
+def default_startup_program() -> Program:
+    return _STARTUP[0]
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program or Program()
+
+    def __enter__(self):
+        self._saved = (_MAIN[0], _STARTUP[0])
+        _MAIN[0] = self.main
+        _STARTUP[0] = self.startup
+        return self
+
+    def __exit__(self, *exc):
+        _MAIN[0], _STARTUP[0] = self._saved
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Variable:
+    """Feed placeholder (parity: static.data)."""
+    del lod_level
+    v = Variable(default_main_program(), shape,
+                 _np_dtype(dtype), name=name, is_input=True)
+    default_main_program()._add_input(v)
+    return v
+
+
+def _np_dtype(dtype):
+    mapping = {"float32": jnp.float32, "float64": jnp.float64,
+               "float16": jnp.float16, "bfloat16": jnp.bfloat16,
+               "int32": jnp.int32, "int64": jnp.int64, "bool": jnp.bool_,
+               "int8": jnp.int8, "uint8": jnp.uint8}
+    if isinstance(dtype, str):
+        return mapping.get(dtype, jnp.float32)
+    return dtype
+
+
+# paddle.static.InputSpec IS the jit InputSpec in the reference; reuse it
+# so jit.save/to_static accept either import path
+from ..jit import InputSpec  # noqa: E402
+
+
+# -- recording hook (called from core/dispatch.py) ---------------------------
+
+def record_op(name, jax_fn, operands, num_nondiff_outputs=0, attrs=None):
+    """Append an OpNode; infer output shapes with jax.eval_shape over
+    ShapeDtypeStructs (the infer_meta analog: no execution)."""
+    del attrs
+    prog = None
+    for o in operands:
+        if isinstance(o, Variable):
+            prog = o.program
+            break
+    assert prog is not None
+
+    def as_sds(o):
+        from ..core.tensor import Tensor
+        if isinstance(o, Variable):
+            return o.sds()
+        if isinstance(o, Tensor):
+            return jax.ShapeDtypeStruct(o._data.shape, o._data.dtype)
+        arr = jnp.asarray(o)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+    out_shape = jax.eval_shape(jax_fn, *[as_sds(o) for o in operands])
+    single = not isinstance(out_shape, (tuple, list))
+    out_list = [out_shape] if single else list(out_shape)
+    node = OpNode(name, jax_fn, operands, [])
+    # dynamic leading dim: shape inference ran with the None batch mapped
+    # to 1; if any Variable operand was dynamic on dim 0 and the output's
+    # dim 0 still reads 1, keep it symbolic so user shape introspection
+    # sees None, not a baked 1 (a heuristic — reshapes that consume the
+    # literal batch extent still need a concrete-shape program)
+    dyn_batch = any(isinstance(o, Variable) and o.ndim and
+                    o.shape[0] is None for o in operands)
+    outs = []
+    for i, s in enumerate(out_list):
+        shape = list(s.shape)
+        if dyn_batch and shape and shape[0] == 1:
+            shape[0] = None
+        outs.append(Variable(prog, shape, s.dtype, producer=node,
+                             out_idx=i))
+    node.outputs = outs
+    prog._add_node(node)
+    return outs[0] if single else tuple(outs)
+
+
+def is_recording() -> bool:
+    return _STATIC_MODE[0]
+
+
+# -- executor ---------------------------------------------------------------
+
+class Executor:
+    """Compiles the recorded DAG per feed signature and runs it as one XLA
+    program (parity: base/executor.py Executor + _ExecutorCache:855)."""
+
+    def __init__(self, place=None):
+        del place
+        self._cache: Dict = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        if isinstance(program, Program) and feed is None and not fetch_list:
+            return []  # startup program: params are initialized eagerly
+        program = program if isinstance(program, Program) \
+            else default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        from ..core.tensor import Tensor
+
+        feed_arrays = {k: jnp.asarray(np.asarray(v)) for k, v in feed.items()}
+        sig = (id(program), program._version,
+               tuple(sorted((k, a.shape, str(a.dtype))
+                            for k, a in feed_arrays.items())),
+               tuple(id(f) for f in fetch_list))
+        entry = self._cache.get(sig)
+        if entry is None:
+            entry = self._compile(program, feed_arrays, fetch_list)
+            self._cache[sig] = entry
+        fn, param_tensors, opt_pack = entry
+
+        params = {t.name or str(i): t._data
+                  for i, t in enumerate(param_tensors)}
+        if opt_pack is None:
+            outs = fn(feed_arrays, params)
+        else:
+            # optimizer state lives on the TrainNode, shared across ALL
+            # compiled signatures of this program (a new batch shape must
+            # not reset Adam moments)
+            optimizer = opt_pack
+            tn = program.train_node
+            outs, new_params, new_states = fn(feed_arrays, params,
+                                              tn._states,
+                                              optimizer.get_lr())
+            for i, t in enumerate(param_tensors):
+                t._data = new_params[t.name or str(i)]
+            tn._states = new_states
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    # -- compilation -------------------------------------------------------
+    def _compile(self, program: Program, feed_arrays, fetch_list):
+        from ..core.tensor import Tensor
+        param_tensors = []
+        seen = set()
+        for n in program.nodes:
+            for o in n.operands:
+                if isinstance(o, Tensor) and id(o) not in seen:
+                    seen.add(id(o))
+                    param_tensors.append(o)
+        for i, t in enumerate(param_tensors):
+            if not t.name:
+                t.name = f"__static_p{i}"
+
+        def forward(feeds, params, targets):
+            env: Dict[int, Any] = {}
+
+            def resolve(o):
+                if isinstance(o, Variable):
+                    if o.is_input:
+                        if o.name not in feeds:
+                            raise KeyError(
+                                f"feed missing input '{o.name}'")
+                        return feeds[o.name]
+                    if id(o) not in env:
+                        raise KeyError(
+                            f"fetch target {o.name} was not produced by "
+                            "this program")
+                    return env[id(o)]
+                if isinstance(o, Tensor):
+                    return params[o.name]
+                return o
+
+            needed = _reachable(targets)
+            for node in program.nodes:
+                if node not in needed:
+                    continue
+                vals = node.jax_fn(*[resolve(o) for o in node.operands])
+                vals = vals if isinstance(vals, tuple) else (vals,)
+                for var, v in zip(node.outputs, vals):
+                    env[id(var)] = v
+            return [resolve(t) for t in targets]
+
+        tn = program.train_node
+        if tn is None:
+            def run_fn(feeds, params):
+                return forward(feeds, params, list(fetch_list))
+            return jax.jit(run_fn), param_tensors, None
+
+        optimizer = tn.optimizer
+        trainable = [t for t in param_tensors if not t.stop_gradient]
+        if getattr(tn, "_states", None) is None:
+            tn._states = optimizer.init_state_tree(
+                {t.name: t._data for t in trainable})
+
+        def train_fn(feeds, params, states, lr):
+            def loss_of(tparams):
+                merged = dict(params)
+                merged.update(tparams)
+                return forward(feeds, merged, [tn.loss])[0]
+
+            tparams = {t.name: params[t.name] for t in trainable}
+            loss, grads = jax.value_and_grad(loss_of)(tparams)
+            new_t, new_states = optimizer.apply_gradients(
+                tparams, grads, states, lr)
+            new_params = dict(params)
+            new_params.update(new_t)
+            # non-loss fetches evaluate with PRE-update params, and the
+            # fetched loss is the pre-update loss (reference semantics:
+            # fetches observe the program state the step ran with)
+            fetches = forward(feeds, params,
+                              [f for f in fetch_list if f is not tn.loss])
+            outs = []
+            fi = iter(fetches)
+            for f in fetch_list:
+                outs.append(loss if f is tn.loss else next(fi))
+            return outs, new_params, new_states
+
+        return jax.jit(train_fn), param_tensors, optimizer
+
+
+def _reachable(targets):
+    """All OpNodes needed to materialize ``targets``."""
+    out = set()
+    stack = [t for t in targets if isinstance(t, Variable)]
+    visited = set()
+    while stack:
+        v = stack.pop()
+        if id(v) in visited or v.producer is None:
+            visited.add(id(v))
+            continue
+        visited.add(id(v))
+        node = v.producer
+        if node in out:
+            continue
+        out.add(node)
+        for o in node.operands:
+            if isinstance(o, Variable):
+                stack.append(o)
+    return out
+
+
+# -- misc parity shims -------------------------------------------------------
+
+class _Scope(dict):
+    pass
+
+
+_SCOPE = [_Scope()]
+
+
+def global_scope():
+    return _SCOPE[0]
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        self._saved = _SCOPE[0]
+        _SCOPE[0] = self.scope
+        return self
+
+    def __exit__(self, *exc):
+        _SCOPE[0] = self._saved
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,159 @@
+"""Text domain library (parity: python/paddle/text/ — ViterbiDecoder +
+the dataset loaders).
+
+TPU-native: Viterbi runs as one ``lax.scan`` over the sequence — the
+whole batch decodes in a single XLA program (the reference's
+viterbi_decode CUDA kernel, paddle/phi/kernels/gpu/viterbi_decode_kernel).
+Dataset classes read user-supplied local files (this environment has no
+network egress; the reference downloads)."""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor
+from ..io import Dataset
+from ..nn.layer.layers import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "UCIHousing",
+           "Conll05st"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag: bool = True):
+    """Batch Viterbi (parity: paddle.text.viterbi_decode): potentials
+    [B, T, N], transitions [N, N] (+2 rows/cols for BOS/EOS when tagged)
+    -> (scores [B], paths [B, T])."""
+
+    def fn(emis, trans):
+        b, t, n = emis.shape
+        if include_bos_eos_tag:
+            # reference convention: tags n-2 = BOS, n-1 = EOS
+            start = trans[n - 2, :] if trans.shape[0] == n else 0.0
+            stop = trans[:, n - 1] if trans.shape[0] == n else 0.0
+        else:
+            start = 0.0
+            stop = 0.0
+        alpha0 = emis[:, 0, :] + start
+
+        def step(alpha, emit):
+            scores = alpha[:, :, None] + trans[None, :, :] + emit[:, None, :]
+            back = jnp.argmax(scores, axis=1)
+            return jnp.max(scores, axis=1), back
+
+        alpha, backs = jax.lax.scan(
+            step, alpha0, jnp.swapaxes(emis[:, 1:, :], 0, 1))
+        alpha = alpha + stop
+        last = jnp.argmax(alpha, axis=-1)
+        score = jnp.max(alpha, axis=-1)
+
+        def backtrack(tag, back):
+            prev = jnp.take_along_axis(back, tag[:, None], 1)[:, 0]
+            return prev, prev
+
+        _, path_rev = jax.lax.scan(backtrack, last, backs, reverse=True)
+        path = jnp.concatenate([jnp.swapaxes(path_rev, 0, 1),
+                                last[:, None]], axis=1)
+        return score.astype(emis.dtype), path.astype(jnp.int64)
+
+    return run_op("viterbi_decode", fn, (potentials, transition_params),
+                  num_nondiff_outputs=1)
+
+
+class ViterbiDecoder(Layer):
+    """Parity: paddle.text.ViterbiDecoder."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        del name
+        t = transitions if isinstance(transitions, Tensor) \
+            else Tensor(jnp.asarray(np.asarray(transitions, np.float32)))
+        self.register_buffer("transitions", t)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+def _need_file(path, what):
+    if path is None or not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{what}: this environment has no network egress — pass "
+            "data_file= pointing at a local copy (the reference downloads "
+            "from paddle's dataset mirror)")
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (parity: paddle.text.Imdb) over a local aclImdb
+    directory; builds the vocabulary from the training split."""
+
+    def __init__(self, data_dir=None, mode="train", cutoff: int = 150):
+        super().__init__()
+        _need_file(data_dir, "Imdb")
+        import re
+        pat = re.compile(r"[A-Za-z']+")
+        texts, labels = [], []
+        for label, sub in ((0, "neg"), (1, "pos")):
+            d = os.path.join(data_dir, mode, sub)
+            _need_file(d, "Imdb split")
+            for fn in sorted(os.listdir(d)):
+                with open(os.path.join(d, fn), errors="ignore") as f:
+                    texts.append(pat.findall(f.read().lower()))
+                labels.append(label)
+        freq = {}
+        for t in texts:
+            for w in t:
+                freq[w] = freq.get(w, 0) + 1
+        vocab = {w: i for i, (w, c) in enumerate(
+            sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))
+            if c >= cutoff}
+        self.word_idx = vocab
+        self.docs = [[vocab[w] for w in t if w in vocab] for t in texts]
+        self.labels = labels
+
+    def __getitem__(self, i):
+        return np.asarray(self.docs[i], np.int64), self.labels[i]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class UCIHousing(Dataset):
+    """Parity: paddle.text.datasets.UCIHousing over a local housing.data."""
+
+    def __init__(self, data_file=None, mode="train"):
+        super().__init__()
+        _need_file(data_file, "UCIHousing")
+        raw = np.loadtxt(data_file).astype(np.float32)
+        x, y = raw[:, :-1], raw[:, -1:]
+        mu, sigma = x.mean(0), x.std(0) + 1e-8
+        x = (x - mu) / sigma
+        split = int(0.8 * len(x))
+        if mode == "train":
+            self.x, self.y = x[:split], y[:split]
+        else:
+            self.x, self.y = x[split:], y[split:]
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Conll05st(Dataset):
+    """Parity stub for the SRL dataset: local-file only."""
+
+    def __init__(self, data_file=None, **kwargs):
+        super().__init__()
+        _need_file(data_file, "Conll05st")
+        raise NotImplementedError(
+            "Conll05st parsing is not ported yet; the class exists for "
+            "API-surface parity")

@@ -1,0 +1,21 @@
+"""paddle.utils parity namespace."""
+from . import cpp_extension  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError as e:
+        raise ImportError(
+            f"{name} is required but not installed: {e}") from None
+
+
+def run_check():
+    """Parity: paddle.utils.run_check — one tiny device computation."""
+    import jax
+    import jax.numpy as jnp
+    out = jnp.ones((2, 2)) @ jnp.ones((2, 2))
+    jax.block_until_ready(out)
+    dev = jax.devices()[0]
+    print(f"PaddlePaddle(TPU) works on {dev.platform}:{dev.id}.")

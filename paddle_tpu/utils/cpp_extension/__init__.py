@@ -1,0 +1,188 @@
+"""Custom-op extension point (parity: python/paddle/utils/cpp_extension/ —
+JIT-compiling user C++ into loadable ops; reference builds against the
+paddle::Tensor C API, paddle/phi/api/ext/).
+
+TPU-native design: device code is Pallas/XLA (write a Python op and
+register it with core.op_registry); the C++ extension point covers the
+OTHER role the reference's custom ops play — host-side compute (custom
+tokenizers, feature extractors, IO decoders) — by compiling the user's
+C++ with the in-image g++ into a shared library and exposing each
+``extern "C"`` function as a framework op through ``jax.pure_callback``
+(the host bridge XLA provides). The ABI is documented and checked:
+
+    extern "C" void my_op(const float* x, float* out, int64_t n);          // unary
+    extern "C" void my_op2(const float* x, const float* y, float* out,
+                           int64_t n);                                     // binary
+
+Functions named ``<op>_grad`` with the matching arity+1 signature are
+registered as the op's vjp (straight product with the cotangent).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import re
+import subprocess
+import tempfile
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["load", "CppExtension", "get_build_directory"]
+
+_SIG_RE = re.compile(
+    r'extern\s+"C"\s+void\s+(\w+)\s*\(([^)]*)\)')
+
+
+def get_build_directory() -> str:
+    d = os.environ.get("PADDLE_EXTENSION_DIR") or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    """Parity shim: setup(ext_modules=[CppExtension(sources=[...])])."""
+
+    def __init__(self, sources: Sequence[str], name: Optional[str] = None,
+                 extra_compile_args: Optional[List[str]] = None, **kwargs):
+        self.sources = list(sources)
+        self.name = name
+        self.extra_compile_args = list(extra_compile_args or [])
+
+
+def _discover(sources: Sequence[str]) -> Dict[str, int]:
+    """{symbol: n_float_inputs} for every extern "C" fn matching the ABI."""
+    out = {}
+    for src in sources:
+        text = open(src).read()
+        for sym, params in _SIG_RE.findall(text):
+            parts = [p.strip() for p in params.split(",") if p.strip()]
+            n_in = sum(1 for p in parts if p.startswith("const float"))
+            has_out = any(p.startswith("float") and not
+                          p.startswith("const") for p in parts)
+            has_n = any("int64_t" in p for p in parts)
+            if has_out and has_n and n_in >= 1:
+                out[sym] = n_in
+    return out
+
+
+def _compile(name: str, sources: Sequence[str],
+             extra_cflags: Sequence[str]) -> str:
+    build = get_build_directory()
+    tag = hashlib.sha1("".join(open(s).read() for s in sources)
+                       .encode()).hexdigest()[:12]
+    so = os.path.join(build, f"{name}_{tag}.so")
+    if not os.path.exists(so):
+        cmd = (["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]
+               + list(extra_cflags) + list(sources) + ["-o", so])
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"cpp_extension build failed:\n{' '.join(cmd)}\n{r.stderr}")
+    return so
+
+
+class _LoadedExtension:
+    """Module-like: one attribute per discovered op."""
+
+    def __init__(self, name, so_path, symbols: Dict[str, int]):
+        self.name = name
+        self.so_path = so_path
+        self._lib = ctypes.CDLL(so_path)
+        fp = ctypes.POINTER(ctypes.c_float)
+        self._ops = {}
+        grads = {s[:-5]: n for s, n in symbols.items()
+                 if s.endswith("_grad")}
+        for sym, n_in in symbols.items():
+            if sym.endswith("_grad"):
+                continue
+            cfun = self._lib[sym]
+            cfun.restype = None
+            cfun.argtypes = [fp] * (n_in + 1) + [ctypes.c_int64]
+            gfun = None
+            if sym in grads:
+                gfun = self._lib[sym + "_grad"]
+                gfun.restype = None
+                gfun.argtypes = [fp] * (grads[sym] + 1) + [ctypes.c_int64]
+            op = _make_op(sym, cfun, n_in, gfun)
+            self._ops[sym] = op
+            setattr(self, sym, op)
+
+    def op_names(self):
+        return sorted(self._ops)
+
+
+def _call_c(cfun, arrays: List[np.ndarray]) -> np.ndarray:
+    arrays = [np.ascontiguousarray(a, np.float32) for a in arrays]
+    out = np.empty_like(arrays[0])
+    n = out.size
+    fp = ctypes.POINTER(ctypes.c_float)
+    args = [a.ctypes.data_as(fp) for a in arrays] + \
+        [out.ctypes.data_as(fp), ctypes.c_int64(n)]
+    cfun(*args)
+    return out
+
+
+def _make_op(sym, cfun, n_in, gfun):
+    import jax
+    import jax.numpy as jnp
+
+    from ...core.dispatch import run_op
+    from ...core.op_registry import register_op
+
+    def host(*arrays):
+        return _call_c(cfun, [np.asarray(a) for a in arrays])
+
+    def impl(*ars):
+        out_sds = jax.ShapeDtypeStruct(ars[0].shape, jnp.float32)
+        return jax.pure_callback(host, out_sds, *ars,
+                                 vmap_method="sequential")
+
+    if gfun is not None:
+        @jax.custom_vjp
+        def core(*ars):
+            return impl(*ars)
+
+        def fwd(*ars):
+            return impl(*ars), ars
+
+        def bwd(res, g):
+            def ghost(*arrays):
+                return _call_c(gfun, [np.asarray(a) for a in arrays])
+            out_sds = jax.ShapeDtypeStruct(res[0].shape, jnp.float32)
+            gx = jax.pure_callback(ghost, out_sds, *(res + (g,)),
+                                   vmap_method="sequential")
+            # the C grad fn returns d/d(first input); other inputs get None
+            return (gx,) + (None,) * (len(res) - 1)
+        core.defvjp(fwd, bwd)
+        fn = core
+    else:
+        fn = impl
+
+    register_op(sym, impl=fn,
+                vjp="custom" if gfun is not None else "auto")
+
+    def op(*tensors):
+        return run_op(sym, fn, tensors,
+                      out_stop_gradient=gfun is None)
+    op.__name__ = sym
+    return op
+
+
+def load(name: str, sources: Sequence[str],
+         extra_cflags: Optional[Sequence[str]] = None,
+         extra_cuda_cflags=None, verbose: bool = False,
+         functions: Optional[Dict[str, int]] = None) -> _LoadedExtension:
+    """Compile + load (parity: cpp_extension.load). ``functions`` overrides
+    symbol discovery: {symbol: n_float_inputs}."""
+    del extra_cuda_cflags, verbose  # no CUDA on TPU
+    symbols = dict(functions) if functions else _discover(sources)
+    if not symbols:
+        raise ValueError(
+            "no extern \"C\" functions matching the ABI found; expected "
+            "e.g. extern \"C\" void my_op(const float* x, float* out, "
+            "int64_t n)")
+    so = _compile(name, sources, extra_cflags or [])
+    return _LoadedExtension(name, so, symbols)

@@ -206,3 +206,92 @@ class TestIncubateFused:
         out = IF.fused_linear(x, w, b)
         np.testing.assert_allclose(out.numpy(),
                                    x.numpy() @ w.numpy() + 1, rtol=1e-5)
+
+
+class TestDecodeAttention:
+    """Inference-decode attention kernels (reference fusion/gpu/
+    masked_multihead_attention.cu + block_multi_head_attention.cu)."""
+
+    def _oracle(self, q, keys, vals, n_valid):
+        # q [H,D], keys/vals [H,S,D] with n_valid live positions
+        s = np.einsum("hd,hsd->hs", q, keys[:, :n_valid]) \
+            / np.sqrt(q.shape[-1])
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        return np.einsum("hs,hsd->hd", p, vals[:, :n_valid])
+
+    def test_masked_mha_decode_step(self):
+        from paddle_tpu.incubate.nn.functional import \
+            masked_multihead_attention
+        rng = np.random.RandomState(0)
+        B, H, D, S = 2, 4, 16, 8
+        lens = np.array([3, 5], np.int32)
+        cache = rng.randn(2, B, H, S, D).astype(np.float32)
+        cache[:, 0, :, 3:] = 0.0
+        cache[:, 1, :, 5:] = 0.0
+        x = rng.randn(B, 3 * H * D).astype(np.float32)
+        out, new_cache = masked_multihead_attention(
+            paddle.to_tensor(x), paddle.to_tensor(cache),
+            seq_lens=paddle.to_tensor(lens))
+        out, new_cache = out.numpy(), new_cache.numpy()
+        qkv = x.reshape(B, 3, H, D)
+        for b in range(B):
+            # the new k/v landed at position lens[b]
+            np.testing.assert_allclose(new_cache[0, b, :, lens[b]],
+                                       qkv[b, 1], rtol=1e-5)
+            np.testing.assert_allclose(new_cache[1, b, :, lens[b]],
+                                       qkv[b, 2], rtol=1e-5)
+            ref = self._oracle(qkv[b, 0], new_cache[0, b], new_cache[1, b],
+                               int(lens[b]) + 1)
+            np.testing.assert_allclose(out[b].reshape(H, D), ref,
+                                       rtol=2e-4, atol=1e-5)
+
+    def test_block_mha_paged_equals_contiguous(self):
+        from paddle_tpu.incubate.nn.functional import \
+            block_multihead_attention
+        rng = np.random.RandomState(1)
+        B, H, D, BS, NBLK, MAXB = 2, 4, 16, 4, 8, 3
+        lens = np.array([5, 9], np.int32)
+        # physical pool + per-seq tables (deliberately shuffled)
+        kc = rng.randn(NBLK, H, BS, D).astype(np.float32)
+        vc = rng.randn(NBLK, H, BS, D).astype(np.float32)
+        tables = np.array([[6, 1, 4], [0, 3, 7]], np.int32)
+        q = rng.randn(B, H, D).astype(np.float32)
+        k = rng.randn(B, H, D).astype(np.float32)
+        v = rng.randn(B, H, D).astype(np.float32)
+        out, nkc, nvc = block_multihead_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(kc), paddle.to_tensor(vc),
+            paddle.to_tensor(tables), paddle.to_tensor(lens))
+        out, nkc, nvc = out.numpy(), nkc.numpy(), nvc.numpy()
+        for b in range(B):
+            # rebuild the contiguous cache from the table
+            ks = np.concatenate([nkc[t] for t in tables[b]], axis=1)
+            vs = np.concatenate([nvc[t] for t in tables[b]], axis=1)
+            # new token written at lens[b]
+            np.testing.assert_allclose(ks[:, lens[b]], k[b], rtol=1e-5)
+            np.testing.assert_allclose(vs[:, lens[b]], v[b], rtol=1e-5)
+            ref = self._oracle(q[b], ks, vs, int(lens[b]) + 1)
+            np.testing.assert_allclose(out[b], ref, rtol=2e-4, atol=1e-5)
+
+    def test_block_mha_pool_untouched_elsewhere(self):
+        from paddle_tpu.incubate.nn.functional import \
+            block_multihead_attention
+        rng = np.random.RandomState(2)
+        kc = rng.randn(4, 2, 4, 8).astype(np.float32)
+        vc = rng.randn(4, 2, 4, 8).astype(np.float32)
+        tables = np.array([[2, 0]], np.int32)
+        lens = np.array([1], np.int32)
+        q = rng.randn(1, 2, 8).astype(np.float32)
+        k = rng.randn(1, 2, 8).astype(np.float32)
+        v = rng.randn(1, 2, 8).astype(np.float32)
+        _, nkc, _ = block_multihead_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(kc), paddle.to_tensor(vc),
+            paddle.to_tensor(tables), paddle.to_tensor(lens))
+        nkc = nkc.numpy()
+        # only (block 2, slot 1) changed
+        mask = np.ones_like(kc, bool)
+        mask[2, :, 1, :] = False
+        np.testing.assert_array_equal(nkc[mask], kc[mask])
+        np.testing.assert_allclose(nkc[2, :, 1], k[0], rtol=1e-6)
