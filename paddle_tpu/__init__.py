@@ -43,8 +43,26 @@ from . import text  # noqa: F401
 from . import inference  # noqa: F401
 from . import utils  # noqa: F401
 from . import quantization  # noqa: F401
+from . import linalg  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
+from . import geometric  # noqa: F401
 from .hapi import Model, callbacks  # noqa: F401
 from .framework import save, load, in_dynamic_mode, is_compiled_with_cuda, is_compiled_with_xpu, is_compiled_with_rocm, is_compiled_with_custom_device  # noqa: F401
+from .framework import (iinfo, finfo, CPUPlace, CUDAPlace, CUDAPinnedPlace,  # noqa: F401
+                        TPUPlace, set_printoptions, disable_signal_handler,
+                        check_shape, LazyGuard, batch)
+from .core.dtype import bool_ as bool  # noqa: F401,A001
+from .nn.parameter import ParamAttr  # noqa: F401
+from .tensor.math import mod as floor_mod  # noqa: F401
+from .tensor.inplace import mod_ as remainder_, mod_ as floor_mod_  # noqa: F401
+from .hapi import summary, flops  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
+
+
+def dtype(d):  # parity: paddle.dtype constructor-style alias
+    from .core.dtype import convert_dtype
+    return convert_dtype(d)
 from .nn.layer.layers import Layer  # noqa: F401
 from .nn.parameter import Parameter, create_parameter  # noqa: F401
 

@@ -86,6 +86,10 @@ def seed(s: int):
     """Set the global random seed (parity: paddle.seed)."""
     default_generator.manual_seed(s)
     np.random.seed(s % (2 ** 32))
+    import sys  # host-side samplers keep their own generator
+    _geo = sys.modules.get("paddle_tpu.geometric")
+    if _geo is not None:
+        _geo._reseed_sampling(s)
     return default_generator
 
 

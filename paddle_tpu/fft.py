@@ -1,0 +1,185 @@
+"""Discrete Fourier transforms (parity: python/paddle/fft.py, 22 public
+APIs). All transforms lower to XLA's FFT HLO via jnp.fft — single fused op,
+no Pallas needed. Gradients flow through the tape like any other op.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import run_op
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_VALID_NORMS = ("forward", "backward", "ortho")
+
+
+def _norm(norm):
+    if norm is None:
+        return "backward"
+    if norm not in _VALID_NORMS:
+        raise ValueError(
+            f"Unexpected norm: {norm!r}. Norm should be forward, backward "
+            "or ortho")
+    return norm
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    norm = _norm(norm)
+    return run_op("fft", lambda a: jnp.fft.fft(a, n=n, axis=axis, norm=norm),
+                  (x,))
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    norm = _norm(norm)
+    return run_op("ifft", lambda a: jnp.fft.ifft(a, n=n, axis=axis, norm=norm),
+                  (x,))
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    norm = _norm(norm)
+    return run_op("rfft", lambda a: jnp.fft.rfft(a, n=n, axis=axis, norm=norm),
+                  (x,))
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    norm = _norm(norm)
+    return run_op("irfft",
+                  lambda a: jnp.fft.irfft(a, n=n, axis=axis, norm=norm), (x,))
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    norm = _norm(norm)
+    return run_op("hfft", lambda a: jnp.fft.hfft(a, n=n, axis=axis, norm=norm),
+                  (x,))
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    norm = _norm(norm)
+    return run_op("ihfft",
+                  lambda a: jnp.fft.ihfft(a, n=n, axis=axis, norm=norm), (x,))
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    norm = _norm(norm)
+    return run_op("fft2", lambda a: jnp.fft.fft2(a, s=s, axes=axes, norm=norm),
+                  (x,))
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    norm = _norm(norm)
+    return run_op("ifft2",
+                  lambda a: jnp.fft.ifft2(a, s=s, axes=axes, norm=norm), (x,))
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    norm = _norm(norm)
+    return run_op("rfft2",
+                  lambda a: jnp.fft.rfft2(a, s=s, axes=axes, norm=norm), (x,))
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    norm = _norm(norm)
+    return run_op("irfft2",
+                  lambda a: jnp.fft.irfft2(a, s=s, axes=axes, norm=norm), (x,))
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    norm = _norm(norm)
+
+    def _hfft2(a):
+        n = s[-1] if s is not None else 2 * (a.shape[axes[-1]] - 1)
+        pre = jnp.fft.ifft(a, n=s[-2] if s is not None else None,
+                           axis=axes[-2], norm=norm)
+        return jnp.fft.hfft(pre, n=n, axis=axes[-1], norm=norm)
+
+    return run_op("hfft2", _hfft2, (x,))
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    norm = _norm(norm)
+
+    def _ihfft2(a):
+        h = jnp.fft.ihfft(a, n=s[-1] if s is not None else None,
+                          axis=axes[-1], norm=norm)
+        return jnp.fft.fft(h, n=s[-2] if s is not None else None,
+                           axis=axes[-2], norm=norm)
+
+    return run_op("ihfft2", _ihfft2, (x,))
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    norm = _norm(norm)
+    return run_op("fftn", lambda a: jnp.fft.fftn(a, s=s, axes=axes, norm=norm),
+                  (x,))
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    norm = _norm(norm)
+    return run_op("ifftn",
+                  lambda a: jnp.fft.ifftn(a, s=s, axes=axes, norm=norm), (x,))
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    norm = _norm(norm)
+    return run_op("rfftn",
+                  lambda a: jnp.fft.rfftn(a, s=s, axes=axes, norm=norm), (x,))
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    norm = _norm(norm)
+    return run_op("irfftn",
+                  lambda a: jnp.fft.irfftn(a, s=s, axes=axes, norm=norm), (x,))
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    norm = _norm(norm)
+
+    def _hfftn(a):
+        ax = axes if axes is not None else tuple(range(a.ndim))
+        pre_axes, last = ax[:-1], ax[-1]
+        pre_s = None if s is None else s[:-1]
+        h = jnp.fft.ifftn(a, s=pre_s, axes=pre_axes, norm=norm) \
+            if pre_axes else a
+        n = None if s is None else s[-1]
+        return jnp.fft.hfft(h, n=n, axis=last, norm=norm)
+
+    return run_op("hfftn", _hfftn, (x,))
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    norm = _norm(norm)
+
+    def _ihfftn(a):
+        ax = axes if axes is not None else tuple(range(a.ndim))
+        pre_axes, last = ax[:-1], ax[-1]
+        n = None if s is None else s[-1]
+        h = jnp.fft.ihfft(a, n=n, axis=last, norm=norm)
+        if pre_axes:
+            pre_s = None if s is None else s[:-1]
+            h = jnp.fft.fftn(h, s=pre_s, axes=pre_axes, norm=norm)
+        return h
+
+    return run_op("ihfftn", _ihfftn, (x,))
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.fftfreq(n, d=d).astype(dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.rfftfreq(n, d=d).astype(dtype or "float32"))
+
+
+def fftshift(x, axes=None, name=None):
+    return run_op("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), (x,))
+
+
+def ifftshift(x, axes=None, name=None):
+    return run_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), (x,))

@@ -1,0 +1,270 @@
+"""Graph-learning message passing ops (parity: python/paddle/geometric/ —
+send_u_recv / send_ue_recv / send_uv, segment pooling, graph reindex and
+neighbor sampling). Gather/scatter-segment ops lower to XLA scatter-add,
+which TPU executes natively; sampling ops are host-side (data-prep class,
+like the reference's CPU kernels for sample_neighbors).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.dispatch import run_op
+from .core.tensor import Tensor
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "reindex_graph", "reindex_heter_graph",
+    "sample_neighbors", "weighted_sample_neighbors",
+]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _num_segments(ids, count):
+    if count is not None:
+        return int(count)
+    data = np.asarray(ids)
+    return int(data.max()) + 1 if data.size else 0
+
+
+# -- segment pooling ------------------------------------------------------
+
+def segment_sum(data, segment_ids, name=None):
+    n = _num_segments(_arr(segment_ids), None)
+    return run_op("segment_sum",
+                  lambda d, s: jax.ops.segment_sum(d, s.astype(jnp.int32),
+                                                   num_segments=n),
+                  (data, segment_ids))
+
+
+def segment_mean(data, segment_ids, name=None):
+    n = _num_segments(_arr(segment_ids), None)
+
+    def fn(d, s):
+        s = s.astype(jnp.int32)
+        tot = jax.ops.segment_sum(d, s, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), s,
+                                  num_segments=n)
+        shape = (n,) + (1,) * (d.ndim - 1)
+        return tot / jnp.maximum(cnt.reshape(shape), 1)
+    return run_op("segment_mean", fn, (data, segment_ids))
+
+
+def segment_min(data, segment_ids, name=None):
+    n = _num_segments(_arr(segment_ids), None)
+
+    def fn(d, s):
+        out = jax.ops.segment_min(d, s.astype(jnp.int32), num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],)), s.astype(jnp.int32),
+                                  num_segments=n)
+        shape = (n,) + (1,) * (d.ndim - 1)
+        return jnp.where(cnt.reshape(shape) > 0, out, 0).astype(d.dtype)
+    return run_op("segment_min", fn, (data, segment_ids))
+
+
+def segment_max(data, segment_ids, name=None):
+    n = _num_segments(_arr(segment_ids), None)
+
+    def fn(d, s):
+        out = jax.ops.segment_max(d, s.astype(jnp.int32), num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],)), s.astype(jnp.int32),
+                                  num_segments=n)
+        shape = (n,) + (1,) * (d.ndim - 1)
+        return jnp.where(cnt.reshape(shape) > 0, out, 0).astype(d.dtype)
+    return run_op("segment_max", fn, (data, segment_ids))
+
+
+# -- message passing ------------------------------------------------------
+
+_REDUCERS = {"sum": jax.ops.segment_sum, "mean": None, "min": jax.ops.segment_min,
+             "max": jax.ops.segment_max}
+
+
+def _reduce(msgs, dst, n, pool):
+    dst = dst.astype(jnp.int32)
+    if pool == "sum":
+        return jax.ops.segment_sum(msgs, dst, num_segments=n)
+    if pool == "mean":
+        tot = jax.ops.segment_sum(msgs, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), msgs.dtype), dst,
+                                  num_segments=n)
+        return tot / jnp.maximum(cnt.reshape((n,) + (1,) * (msgs.ndim - 1)), 1)
+    seg = jax.ops.segment_min if pool == "min" else jax.ops.segment_max
+    out = seg(msgs, dst, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],)), dst, num_segments=n)
+    return jnp.where(cnt.reshape((n,) + (1,) * (msgs.ndim - 1)) > 0, out,
+                     0).astype(msgs.dtype)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src], reduce onto dst (parity: paddle.geometric.send_u_recv,
+    python/paddle/geometric/message_passing/send_recv.py)."""
+    n = out_size or _arr(x).shape[0]
+
+    def fn(xv, s, d):
+        return _reduce(xv[s.astype(jnp.int32)], d, n, reduce_op)
+    return run_op("send_u_recv", fn, (x, src_index, dst_index))
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """x[src] (op) edge_feature -> reduce onto dst."""
+    n = out_size or _arr(x).shape[0]
+
+    def fn(xv, e, s, d):
+        m = xv[s.astype(jnp.int32)]
+        if message_op == "add":
+            m = m + e
+        elif message_op == "sub":
+            m = m - e
+        elif message_op == "mul":
+            m = m * e
+        elif message_op == "div":
+            m = m / e
+        else:
+            raise ValueError(f"unknown message_op {message_op}")
+        return _reduce(m, d, n, reduce_op)
+    return run_op("send_ue_recv", fn, (x, y, src_index, dst_index))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message x[src] (op) y[dst] (parity: send_uv)."""
+    def fn(xv, yv, s, d):
+        a = xv[s.astype(jnp.int32)]
+        b = yv[d.astype(jnp.int32)]
+        if message_op == "add":
+            return a + b
+        if message_op == "sub":
+            return a - b
+        if message_op == "mul":
+            return a * b
+        if message_op == "div":
+            return a / b
+        raise ValueError(f"unknown message_op {message_op}")
+    return run_op("send_uv", fn, (x, y, src_index, dst_index))
+
+
+# -- graph utilities (host-side data prep, no grads) ----------------------
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global ids to local ids (parity: paddle.geometric.reindex_graph).
+    Host-side: output shape is data-dependent."""
+    xs = np.asarray(_arr(x))
+    nb = np.asarray(_arr(neighbors))
+    uniq = {}
+    for v in xs.tolist():
+        if v not in uniq:
+            uniq[v] = len(uniq)
+    out_nodes = list(xs.tolist())
+    for v in nb.tolist():
+        if v not in uniq:
+            uniq[v] = len(uniq)
+            out_nodes.append(v)
+    reindex_src = np.asarray([uniq[v] for v in nb.tolist()], np.int64)
+    cnt = np.asarray(_arr(count))
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    return (Tensor(jnp.asarray(reindex_src)), Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(np.asarray(out_nodes, np.int64))))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: neighbors/count are per-edge-type lists."""
+    xs = np.asarray(_arr(x))
+    uniq = {}
+    for v in xs.tolist():
+        if v not in uniq:
+            uniq[v] = len(uniq)
+    out_nodes = list(xs.tolist())
+    srcs, dsts = [], []
+    for nb_t, cnt_t in zip(neighbors, count):
+        nb = np.asarray(_arr(nb_t))
+        cnt = np.asarray(_arr(cnt_t))
+        for v in nb.tolist():
+            if v not in uniq:
+                uniq[v] = len(uniq)
+                out_nodes.append(v)
+        srcs.append(np.asarray([uniq[v] for v in nb.tolist()], np.int64))
+        dsts.append(np.repeat(np.arange(len(xs), dtype=np.int64), cnt))
+    return (Tensor(jnp.asarray(np.concatenate(srcs))),
+            Tensor(jnp.asarray(np.concatenate(dsts))),
+            Tensor(jnp.asarray(np.asarray(out_nodes, np.int64))))
+
+
+_sample_rng = np.random.default_rng()
+
+
+def _reseed_sampling(seed):
+    """Hooked by paddle.seed for deterministic neighbor sampling."""
+    global _sample_rng
+    _sample_rng = np.random.default_rng(seed)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """CSC neighbor sampling (parity: paddle.geometric.sample_neighbors).
+    Host-side randomized data prep, as in the reference CPU kernel."""
+    r = np.asarray(_arr(row))
+    cp = np.asarray(_arr(colptr))
+    nodes = np.asarray(_arr(input_nodes))
+    e = np.asarray(_arr(eids)) if eids is not None else None
+    rng = _sample_rng
+    out_n, out_cnt, out_e = [], [], []
+    for v in nodes.tolist():
+        beg, end = int(cp[v]), int(cp[v + 1])
+        nbrs = r[beg:end]
+        ids = np.arange(beg, end)
+        if sample_size != -1 and len(nbrs) > sample_size:
+            sel = rng.choice(len(nbrs), size=sample_size, replace=False)
+            nbrs, ids = nbrs[sel], ids[sel]
+        out_n.append(nbrs)
+        out_cnt.append(len(nbrs))
+        if e is not None:
+            out_e.append(e[ids])
+    neigh = np.concatenate(out_n) if out_n else np.empty((0,), r.dtype)
+    cnt = np.asarray(out_cnt, np.int32)
+    if return_eids:
+        ee = np.concatenate(out_e) if out_e else np.empty((0,), np.int64)
+        return (Tensor(jnp.asarray(neigh)), Tensor(jnp.asarray(cnt)),
+                Tensor(jnp.asarray(ee)))
+    return Tensor(jnp.asarray(neigh)), Tensor(jnp.asarray(cnt))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-biased neighbor sampling (parity: weighted_sample_neighbors)."""
+    r = np.asarray(_arr(row))
+    cp = np.asarray(_arr(colptr))
+    w = np.asarray(_arr(edge_weight))
+    nodes = np.asarray(_arr(input_nodes))
+    e = np.asarray(_arr(eids)) if eids is not None else None
+    rng = _sample_rng
+    out_n, out_cnt, out_e = [], [], []
+    for v in nodes.tolist():
+        beg, end = int(cp[v]), int(cp[v + 1])
+        nbrs = r[beg:end]
+        ids = np.arange(beg, end)
+        if sample_size != -1 and len(nbrs) > sample_size:
+            pw = w[beg:end].astype(np.float64)
+            pw = pw / pw.sum()
+            sel = rng.choice(len(nbrs), size=sample_size, replace=False, p=pw)
+            nbrs, ids = nbrs[sel], ids[sel]
+        out_n.append(nbrs)
+        out_cnt.append(len(nbrs))
+        if e is not None:
+            out_e.append(e[ids])
+    neigh = np.concatenate(out_n) if out_n else np.empty((0,), r.dtype)
+    cnt = np.asarray(out_cnt, np.int32)
+    if return_eids:
+        ee = np.concatenate(out_e) if out_e else np.empty((0,), np.int64)
+        return (Tensor(jnp.asarray(neigh)), Tensor(jnp.asarray(cnt)),
+                Tensor(jnp.asarray(ee)))
+    return Tensor(jnp.asarray(neigh)), Tensor(jnp.asarray(cnt))

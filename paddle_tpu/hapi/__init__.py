@@ -3,3 +3,57 @@ from . import callbacks  # noqa: F401
 from .callbacks import (Callback, EarlyStopping, LRScheduler,  # noqa: F401
                         ModelCheckpoint, ProgBarLogger)
 from .model import Model  # noqa: F401
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print a per-layer parameter summary (parity: paddle.summary,
+    python/paddle/hapi/model_summary.py)."""
+    import numpy as np
+    n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+    lines = [f"{type(net).__name__}"]
+    for name, sub in net.named_sublayers():
+        sub_n = sum(int(np.prod(p.shape))
+                    for p in sub.parameters(include_sublayers=False))
+        if sub_n:
+            lines.append(f"  {name} ({type(sub).__name__}): {sub_n:,}")
+    lines.append(f"Total params: {n_params:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    lines.append(f"Non-trainable params: {n_params - trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": n_params, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Estimate forward FLOPs by jaxpr cost analysis (parity: paddle.flops,
+    python/paddle/hapi/dynamic_flops.py — theirs hooks per-layer; XLA's
+    cost analysis covers every op the layer lowers to)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    from ..core.autograd import tape_paused
+    from ..nn.layer.layers import functional_state, _swapped_state
+
+    shape = list(input_size)
+    params = functional_state(net)
+
+    def fwd(p, x):
+        with _swapped_state(net, p):
+            with tape_paused():
+                out = net(Tensor(x))
+        return out._data if isinstance(out, Tensor) else out
+
+    x = jnp.zeros(shape, jnp.float32)
+    try:
+        lowered = jax.jit(fwd).lower(params, x)
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0] if cost else {}
+        total = int(cost.get("flops", 0))
+    except Exception:
+        total = 0
+    if print_detail:
+        print(f"Total FLOPs: {total:,}")
+    return total

@@ -1,0 +1,17 @@
+"""paddle.linalg namespace (parity: python/paddle/linalg.py — a re-export
+of the tensor linear-algebra surface under a dedicated module)."""
+from __future__ import annotations
+
+from .tensor.linalg import (  # noqa: F401
+    cholesky, cholesky_solve, cond, corrcoef, cov, det, eig, eigh, eigvals,
+    eigvalsh, householder_product, inv, lstsq, lu, lu_unpack, matrix_exp,
+    matrix_norm, matrix_power, matrix_rank, multi_dot, norm, pca_lowrank,
+    pinv, qr, slogdet, solve, svd, triangular_solve, vector_norm)
+
+__all__ = [
+    "cholesky", "norm", "cond", "cov", "corrcoef", "inv", "eig", "eigvals",
+    "multi_dot", "matrix_rank", "svd", "qr", "householder_product",
+    "pca_lowrank", "lu", "lu_unpack", "matrix_exp", "matrix_power", "det",
+    "slogdet", "eigh", "eigvalsh", "pinv", "solve", "cholesky_solve",
+    "triangular_solve", "lstsq", "matrix_norm", "vector_norm",
+]

@@ -1,0 +1,91 @@
+"""Short-time Fourier transforms (parity: python/paddle/signal.py —
+stft/istft over the frame + fft kernels). Framing is a gather; the FFT
+lowers to XLA's FFT HLO — both fuse under jit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import run_op
+from .core.tensor import Tensor
+
+__all__ = ["stft", "istft"]
+
+
+def _frame(x, frame_length, hop_length):
+    # x: (..., T) -> (..., n_frames, frame_length)
+    n = x.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    return x[..., idx]
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """(parity: paddle.signal.stft, python/paddle/signal.py). Returns
+    (..., n_fft//2+1 or n_fft, num_frames) complex."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    w = window._data if isinstance(window, Tensor) else window
+
+    def fn(a):
+        arr = a
+        if center:
+            pad = n_fft // 2
+            cfg = [(0, 0)] * (arr.ndim - 1) + [(pad, pad)]
+            arr = jnp.pad(arr, cfg, mode=pad_mode)
+        frames = _frame(arr, n_fft, hop)  # (..., frames, n_fft)
+        if w is not None:
+            win = w
+            if wl < n_fft:  # center-pad the window to n_fft
+                lp = (n_fft - wl) // 2
+                win = jnp.pad(win, (lp, n_fft - wl - lp))
+            frames = frames * win
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+            else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)  # (..., freq, frames)
+
+    return run_op("stft", fn, (x,))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """(parity: paddle.signal.istft). Overlap-add inverse of stft."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    w = window._data if isinstance(window, Tensor) else window
+
+    def fn(spec_):
+        spec = jnp.swapaxes(spec_, -1, -2)  # (..., frames, freq)
+        if normalized:
+            spec = spec * jnp.sqrt(n_fft)
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided \
+            else jnp.fft.ifft(spec, axis=-1).real
+        win = w if w is not None else jnp.ones((wl,), frames.dtype)
+        if wl < n_fft:
+            lp = (n_fft - wl) // 2
+            win = jnp.pad(win, (lp, n_fft - wl - lp))
+        frames = frames * win
+        num = frames.shape[-2]
+        t_len = n_fft + hop * (num - 1)
+        # one scatter-add over the same index matrix the forward gather
+        # uses: idx[i, j] = i*hop + j
+        idx = (jnp.arange(num)[:, None] * hop
+               + jnp.arange(n_fft)[None, :])            # (num, n_fft)
+        out = jnp.zeros((*frames.shape[:-2], t_len), frames.dtype)
+        out = out.at[..., idx].add(frames)
+        wsum = jnp.zeros((t_len,), frames.dtype).at[idx].add(
+            jnp.broadcast_to(win * win, (num, n_fft)))
+        out = out / jnp.where(wsum > 1e-11, wsum, 1.0)
+        if center:
+            out = out[..., n_fft // 2: t_len - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return run_op("istft", fn, (x,))

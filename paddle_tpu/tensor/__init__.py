@@ -17,11 +17,14 @@ from .search import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
+from .inplace import *  # noqa: F401,F403
 
 from . import creation, math, manipulation, linalg, logic, search, stat
+from . import inplace as _inplace_mod
 from . import random as _random_mod
 
-_METHOD_SOURCES = [math, manipulation, linalg, logic, search, stat, creation]
+_METHOD_SOURCES = [math, manipulation, linalg, logic, search, stat, creation,
+                   _inplace_mod]
 
 # names that must not shadow core Tensor attributes/properties
 _SKIP = {"to_tensor", "Tensor", "t"}

@@ -5,6 +5,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.dispatch import run_op
 from ..core.dtype import convert_dtype, get_default_dtype
 from ..core.tensor import Tensor, to_tensor
 
@@ -12,7 +13,7 @@ __all__ = [
     "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
     "full_like", "empty", "empty_like", "arange", "linspace", "logspace",
     "eye", "diag", "diagflat", "meshgrid", "tril", "triu", "assign",
-    "clone", "tril_indices", "triu_indices", "complex", "polar",
+    "clone", "tril_indices", "triu_indices", "complex", "polar", "diag_embed",
 ]
 
 
@@ -172,3 +173,18 @@ def _shape(shape):
     if isinstance(shape, (int, np.integer)):
         return (int(shape),)
     return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal embedding (parity: paddle.diag_embed)."""
+    def fn(a):
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros((*a.shape[:-1], n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = base.at[..., r, c].set(a)
+        if (dim1, dim2) not in ((-2, -1), (out.ndim - 2, out.ndim - 1)):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+    return run_op("diag_embed", fn, (input,))

@@ -1,0 +1,141 @@
+"""Inplace op variants (parity: the reference's `<op>_` APIs, generated
+from ops.yaml `inplace:` maps — e.g. paddle.tanh_ / Tensor.tanh_).
+
+XLA arrays are immutable, so "inplace" here means: run the functional op,
+then adopt the result into the receiver Tensor (rebind `_data` and the tape
+node). Autograd keeps working — the adopted node records the pre-op value
+as input, which matches the reference's inplace-version-counter semantics
+for non-leaf tensors.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+__all__: list = []  # filled by _register below
+
+
+def _adopt(x: Tensor, out: Tensor) -> Tensor:
+    if out._node is not None:
+        # The op's tape node holds `x` itself as an input; after adoption
+        # x points at the op's output, which would make the node its own
+        # ancestor. Swap in a shadow Tensor carrying x's pre-op identity
+        # (data + producer node) so backward walks the pre-op graph.
+        shadow = Tensor(x._data, stop_gradient=x.stop_gradient)
+        shadow._node = x._node
+        shadow._out_idx = x._out_idx
+        shadow._grad = x._grad
+        shadow._hooks = x._hooks
+        shadow.name = x.name
+        node = out._node
+        node.inputs = [shadow if inp is x else inp for inp in node.inputs]
+    x._data = out._data
+    x._node = out._node
+    x._out_idx = out._out_idx
+    if not out.stop_gradient:
+        x.stop_gradient = False
+    return x
+
+
+def _make_inplace(fn, name=None):
+    base = name or fn.__name__
+
+    def inplace(x, *args, **kwargs):
+        return _adopt(x, fn(x, *args, **kwargs))
+    inplace.__name__ = base + "_"
+    inplace.__doc__ = f"Inplace variant of ``{base}`` (adopts the " \
+                      "functional result into the receiver)."
+    return inplace
+
+
+# (module, [op names]) — every listed op gains an `<op>_` inplace variant.
+_INPLACE_SPECS = [
+    ("math", [
+        "abs", "acos", "asin", "atan", "ceil", "clip", "cos", "cumsum",
+        "cumprod", "digamma", "divide", "erf", "exp", "expm1", "floor",
+        "floor_divide", "frac", "gammaln", "gcd", "hypot", "i0", "lcm",
+        "ldexp", "lerp", "lgamma", "log", "log10", "log1p", "log2", "logit",
+        "mod", "multigammaln", "multiply", "nan_to_num", "neg", "polygamma",
+        "pow", "reciprocal", "remainder", "renorm", "round", "rsqrt", "scale",
+        "sigmoid", "sin", "sinh", "sqrt", "square", "subtract", "tan", "tanh",
+        "trunc", "copysign", "add",
+    ]),
+    ("manipulation", [
+        "cast", "index_add", "index_put", "masked_fill", "masked_scatter",
+        "scatter", "index_fill", "put_along_axis",
+    ]),
+    ("logic", [
+        "logical_and", "logical_or", "logical_xor", "logical_not",
+        "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+        "equal", "not_equal", "less_than", "less_equal", "greater_than",
+        "greater_equal", "bitwise_left_shift", "bitwise_right_shift",
+    ]),
+    ("creation", ["triu", "tril", "diag_embed"]),
+    ("search", ["where"]),
+]
+
+_ALIASES = {
+    "floor_mod_": ("math", "mod"),
+    "divide_": ("math", "divide"),
+    "transpose_": ("manipulation", "transpose"),
+    "t_": ("linalg", "t"),
+    "addmm_": ("math", "addmm"),
+    "acosh_": ("math", "acosh"),
+    "asinh_": ("math", "asinh"),
+    "atanh_": ("math", "atanh"),
+    "cosh_": ("math", "cosh"),
+    "erfinv_": ("math", "erfinv"),
+    "atan2_": ("math", "atan2"),
+    "nextafter_": ("math", "nextafter"),
+}
+
+
+def _register():
+    import importlib
+    here = globals()
+    for modname, names in _INPLACE_SPECS:
+        mod = importlib.import_module(f".{modname}", __package__)
+        for n in names:
+            fn = getattr(mod, n, None)
+            if fn is None:
+                continue
+            ip = _make_inplace(fn, name=n)
+            here[ip.__name__] = ip
+            __all__.append(ip.__name__)
+    for alias, (modname, n) in _ALIASES.items():
+        mod = importlib.import_module(f".{modname}", __package__)
+        fn = getattr(mod, n, None)
+        if fn is None:
+            continue
+        ip = _make_inplace(fn)
+        ip.__name__ = alias
+        here[alias] = ip
+        __all__.append(alias)
+
+
+_register()
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """Fill with Cauchy samples (parity: paddle.Tensor.cauchy_)."""
+    import jax
+    import jax.numpy as jnp
+    from .random import _key
+    u = jax.random.uniform(_key(), tuple(x.shape),
+                           dtype=jnp.float32) - 0.5
+    x._data = (loc + scale * jnp.tan(jnp.pi * u)).astype(x.dtype)
+    x._node = None
+    return x
+
+
+def geometric_(x, probs, name=None):
+    """Fill with Geometric(probs) samples (parity: Tensor.geometric_)."""
+    import jax
+    import jax.numpy as jnp
+    from .random import _key
+    u = jax.random.uniform(_key(), tuple(x.shape), dtype=jnp.float32)
+    x._data = jnp.ceil(jnp.log1p(-u) / jnp.log1p(-probs)).astype(x.dtype)
+    x._node = None
+    return x
+
+
+__all__ += ["cauchy_", "geometric_"]

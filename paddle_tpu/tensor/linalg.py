@@ -16,7 +16,8 @@ __all__ = [
     "matrix_power", "qr", "lu", "eig", "eigvals", "eigh", "eigvalsh",
     "multi_dot", "svd", "pinv", "solve", "triangular_solve", "lstsq", "slogdet",
     "det", "matrix_rank", "corrcoef", "cov", "householder_product", "vander",
-    "vecdot", "matrix_norm", "vector_norm", "inv",
+    "vecdot", "matrix_norm", "vector_norm", "inv", "lu_unpack",
+    "matrix_exp", "pca_lowrank",
 ]
 
 
@@ -189,12 +190,42 @@ def multi_dot(x, name=None):
     return run_op("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs), tuple(x))
 
 
+def _svd_on_host(*operands) -> bool:
+    """The axon/TPU remote compiler crashes lowering the SVD HLO; run the
+    SVD-family ops (svd/pinv/lstsq) on the host in eager mode there —
+    the reference keeps CPU fallback kernels for exactly this class
+    (paddle/phi/core/kernel_factory.h CPU-fallback path). Differentiable
+    jnp path is kept on CPU (tests) and under tracing. When the caller
+    needs gradients the silent host detach would zero them — raise
+    instead so the failure is visible."""
+    if jax.default_backend() == "cpu":
+        return False
+    from ..core import autograd as _ag
+    if _ag.is_tape_active() and any(
+            isinstance(o, Tensor) and not o.stop_gradient for o in operands):
+        raise NotImplementedError(
+            "svd/pinv/lstsq gradients are unavailable on the TPU backend "
+            "(the platform compiler cannot lower SVD; the op runs on the "
+            "host without a tape). Compute this op under paddle.no_grad() "
+            "or on the CPU backend.")
+    return True
+
+
 def svd(x, full_matrices=False, name=None):
+    a = x._data if isinstance(x, Tensor) else x
+    if not isinstance(a, jax.core.Tracer) and _svd_on_host(x):
+        u, s, vh = np.linalg.svd(np.asarray(a), full_matrices=full_matrices)
+        return (Tensor(jnp.asarray(u)), Tensor(jnp.asarray(s)),
+                Tensor(jnp.asarray(vh)))
     return run_op("svd",
                   lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), (x,))
 
 
 def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    a = x._data if isinstance(x, Tensor) else x
+    if not isinstance(a, jax.core.Tracer) and not hermitian \
+            and _svd_on_host(x):
+        return Tensor(jnp.asarray(np.linalg.pinv(np.asarray(a), rcond=rcond)))
     return run_op("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), (x,))
 
 
@@ -214,6 +245,15 @@ def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, nam
 
 
 def lstsq(x, y, rcond=None, driver=None, name=None):
+    a0 = x._data if isinstance(x, Tensor) else x
+    if not isinstance(a0, jax.core.Tracer) and _svd_on_host(x, y):
+        b0 = y._data if isinstance(y, Tensor) else y
+        sol, res, rank, sv = np.linalg.lstsq(
+            np.asarray(a0), np.asarray(b0), rcond=rcond)
+        return (Tensor(jnp.asarray(sol)), Tensor(jnp.asarray(res)),
+                Tensor(jnp.asarray(np.int32(rank))),
+                Tensor(jnp.asarray(sv)))
+
     def fn(a, b):
         sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
         return sol, res, rank.astype(jnp.int32), sv
@@ -277,3 +317,66 @@ def _ax(axis):
     if isinstance(axis, (list, tuple)):
         return tuple(int(a) for a in axis)
     return int(axis)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack LU factorization (parity: paddle.linalg.lu_unpack over the
+    `lu_unpack` kernel, reference python/paddle/tensor/linalg.py)."""
+    def fn(lu_, piv):
+        *batch, m, n = lu_.shape
+        k = min(m, n)
+        l_ = jnp.tril(lu_[..., :, :k], -1) + jnp.broadcast_to(
+            jnp.eye(m, k, dtype=lu_.dtype), (*batch, m, k))
+        u = jnp.triu(lu_[..., :k, :])
+        # pivots are 1-based sequential row swaps -> permutation matrix
+        piv0 = piv.astype(jnp.int32) - 1
+        perm = jnp.broadcast_to(jnp.arange(m, dtype=jnp.int32),
+                                (*batch, m))
+
+        def body(i, pm):
+            j = piv0[..., i]
+            idx_i = jnp.full((*batch, 1), i, jnp.int32)
+            vi = jnp.take_along_axis(pm, idx_i, axis=-1)
+            vj = jnp.take_along_axis(pm, j[..., None], axis=-1)
+            pm = jnp.put_along_axis(pm, idx_i, vj, axis=-1, inplace=False)
+            pm = jnp.put_along_axis(pm, j[..., None], vi, axis=-1,
+                                    inplace=False)
+            return pm
+
+        perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+        # P[perm[i], i] = 1  (A = P L U with row swaps recorded in perm)
+        p = jnp.swapaxes(jax.nn.one_hot(perm, m, dtype=lu_.dtype), -1, -2)
+        return p, l_, u
+    return run_op("lu_unpack", fn, (x, y))
+
+
+def matrix_exp(x, name=None):
+    return run_op("matrix_exp", jax.scipy.linalg.expm, (x,))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Low-rank PCA via randomized SVD (parity: paddle.linalg.pca_lowrank).
+    Composed from matmul/qr/svd ops so the small SVD takes the host
+    fallback on TPU (see _svd_on_host)."""
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    m, n = xt.shape[-2], xt.shape[-1]
+    k = q if q is not None else min(6, m, n)
+    if center:
+        from .math import mean, subtract
+        b = subtract(xt, mean(xt, axis=-2, keepdim=True))
+    else:
+        b = xt
+    omega = Tensor(jax.random.normal(jax.random.key(0),
+                                     (*xt.shape[:-2], n, k), xt.dtype))
+    y = matmul(b, omega)
+    for _ in range(niter):
+        y = matmul(b, matmul(b, y, transpose_x=True))
+    qmat, _ = qr(y)
+    bsmall = matmul(qmat, b, transpose_x=True)
+    u_s, s, vh = svd(bsmall, full_matrices=False)
+    u = matmul(qmat, u_s)
+    from .manipulation import transpose as _tr
+    perm = list(range(len(vh.shape)))
+    perm[-1], perm[-2] = perm[-2], perm[-1]
+    v = _tr(vh, perm)
+    return u, s, v

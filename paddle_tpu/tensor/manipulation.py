@@ -25,7 +25,10 @@ __all__ = [
     "as_complex", "as_real", "view", "view_as", "unstack", "numel",
     "atleast_1d", "atleast_2d", "atleast_3d", "diagonal", "fill_diagonal_",
     "shard_index", "tolist", "tensordot", "take", "select_scatter",
-    "diagonal_scatter", "flatten_", "pad_sequences",
+    "diagonal_scatter", "flatten_", "pad_sequences", "hstack", "vstack",
+    "dstack", "column_stack", "row_stack", "reverse", "unflatten",
+    "as_strided", "slice_scatter", "masked_scatter", "index_fill",
+    "combinations", "rank", "shape",
 ]
 
 
@@ -529,3 +532,117 @@ def _static_shape(shape):
     if isinstance(shape, (int, np.integer)):
         return (int(shape),)
     return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def hstack(x, name=None):
+    return run_op("hstack", lambda *xs: jnp.hstack(xs), tuple(x))
+
+
+def vstack(x, name=None):
+    return run_op("vstack", lambda *xs: jnp.vstack(xs), tuple(x))
+
+
+def dstack(x, name=None):
+    return run_op("dstack", lambda *xs: jnp.dstack(xs), tuple(x))
+
+
+def column_stack(x, name=None):
+    return run_op("column_stack", lambda *xs: jnp.column_stack(xs), tuple(x))
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def reverse(x, axis, name=None):
+    return flip(x, axis)
+
+
+def unflatten(x, axis, shape, name=None):
+    def fn(a):
+        ax = axis % a.ndim
+        new = list(a.shape[:ax]) + list(shape) + list(a.shape[ax + 1:])
+        return jnp.reshape(a, new)
+    return run_op("unflatten", fn, (x,))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """View with explicit strides (parity: paddle.as_strided over the
+    stride kernels, paddle/phi/kernels/stride/). XLA arrays are dense, so
+    this materializes the gather the strided view describes."""
+    def fn(a):
+        flat = jnp.ravel(a)
+        idx = jnp.full(tuple(shape), offset, jnp.int32)
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            r = jnp.arange(s, dtype=jnp.int32) * st
+            idx = idx + jnp.reshape(r, (-1,) + (1,) * (len(shape) - d - 1))
+        return flat[idx]
+    return run_op("as_strided", fn, (x,))
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    import builtins
+
+    def fn(a, v):
+        sl = [builtins.slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            sl[ax] = builtins.slice(st, en, sd)
+        return a.at[tuple(sl)].set(v)
+    return run_op("slice_scatter", fn, (x, value))
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill masked positions from `value` taken in row-major order
+    (parity: paddle.masked_scatter)."""
+    from ..core.tensor import Tensor as _T
+    m_eager = mask._data if isinstance(mask, _T) else mask
+    v_eager = value._data if isinstance(value, _T) else value
+    if not isinstance(m_eager, jax.core.Tracer) \
+            and not isinstance(v_eager, jax.core.Tracer):
+        needed = int(np.asarray(m_eager).sum())
+        have = int(np.prod(np.asarray(v_eager).shape))
+        if have < needed:
+            raise ValueError(
+                f"masked_scatter: value has {have} elements but mask "
+                f"selects {needed}")
+    def fn(a, m, v):
+        m = jnp.broadcast_to(m, a.shape)
+        flat_m = jnp.ravel(m)
+        # k-th True position takes v.flat[k]
+        ord_ = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+        src = jnp.ravel(v)[jnp.clip(ord_, 0, v.size - 1)]
+        return jnp.reshape(jnp.where(flat_m, src, jnp.ravel(a)), a.shape)
+    return run_op("masked_scatter", fn, (x, mask, value))
+
+
+def index_fill(x, index, axis, value, name=None):
+    import builtins
+
+    def fn(a, idx):
+        sl = [builtins.slice(None)] * a.ndim
+        sl[axis] = idx
+        return a.at[tuple(sl)].set(value)
+    return run_op("index_fill", fn, (x, index))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+    n = x.shape[0] if hasattr(x, "shape") else len(x)
+    gen = itertools.combinations_with_replacement if with_replacement \
+        else itertools.combinations
+    idx = np.asarray(list(gen(range(n), r)), np.int32).reshape(-1, r)
+
+    def fn(a):
+        return a[idx]
+    return run_op("combinations", fn, (x,))
+
+
+def rank(input, name=None):
+    from ..core.tensor import Tensor as _T
+    return _T(jnp.asarray(input.ndim if hasattr(input, "ndim")
+                          else np.ndim(input)))
+
+
+def shape(input, name=None):
+    from ..core.tensor import Tensor as _T
+    return _T(jnp.asarray(list(input.shape), jnp.int32))

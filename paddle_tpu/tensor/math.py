@@ -26,7 +26,9 @@ __all__ = [
     "gcd", "lcm", "diff", "angle", "heaviside", "nan_to_num", "count_nonzero",
     "inner", "outer", "logaddexp", "logit", "hypot", "ldexp", "trapezoid",
     "kron", "digamma", "lgamma", "gamma", "polygamma", "i0", "multigammaln",
-    "increment", "broadcast_shape",
+    "increment", "broadcast_shape", "gammaln", "i0e", "i1", "i1e",
+    "copysign", "frexp", "sgn", "signbit", "nextafter", "renorm", "trace",
+    "cdist", "pdist", "cumulative_trapezoid", "conj", "real", "imag", "addmm",
 ]
 
 
@@ -336,3 +338,134 @@ def increment(x, value=1.0, name=None):
 
 def broadcast_shape(x_shape, y_shape):
     return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def gammaln(x, name=None):
+    return run_op("gammaln", jax.scipy.special.gammaln, (x,))
+
+
+def i0e(x, name=None):
+    return run_op("i0e", jax.scipy.special.i0e, (x,))
+
+
+def i1(x, name=None):
+    return run_op("i1", jax.scipy.special.i1, (x,))
+
+
+def i1e(x, name=None):
+    return run_op("i1e", jax.scipy.special.i1e, (x,))
+
+
+def copysign(x, y, name=None):
+    return run_op("copysign", jnp.copysign, (x, y))
+
+
+def frexp(x, name=None):
+    m, e = run_op("frexp", lambda a: tuple(jnp.frexp(a)), (x,),
+                  num_nondiff_outputs=1)
+    return m, e
+
+
+def sgn(x, name=None):
+    def fn(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(a)
+    return run_op("sgn", fn, (x,))
+
+
+def signbit(x, name=None):
+    return run_op("signbit", jnp.signbit, (x,), out_stop_gradient=True)
+
+
+def nextafter(x, y, name=None):
+    return run_op("nextafter", jnp.nextafter, (x, y),
+                  out_stop_gradient=True)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Per-slice p-norm clamp along `axis` (parity: paddle.renorm over the
+    renorm kernel, python/paddle/tensor/math.py)."""
+    def fn(a):
+        ax = axis + a.ndim if axis < 0 else axis
+        dims = tuple(i for i in range(a.ndim) if i != ax)
+        norms = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=dims,
+                                  keepdims=True), 1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+    return run_op("renorm", fn, (x,))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return run_op("trace",
+                  lambda a: jnp.trace(a, offset=offset, axis1=axis1,
+                                      axis2=axis2), (x,))
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise distances between row-vector batches. The p==2 path is the
+    |x|^2 + |y|^2 - 2xy expansion — one MXU matmul instead of a broadcast
+    of size (..., P, R, M) (parity: paddle.cdist)."""
+    def fn(a, b):
+        if p == 2.0 and compute_mode != "donot_use_mm_for_euclid_dist":
+            x2 = jnp.sum(a * a, axis=-1, keepdims=True)
+            y2 = jnp.sum(b * b, axis=-1, keepdims=True)
+            sq = x2 + jnp.swapaxes(y2, -1, -2) - 2 * (a @ jnp.swapaxes(b, -1, -2))
+            return jnp.sqrt(jnp.maximum(sq, 0.0))
+        d = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == 0:
+            return jnp.sum(d != 0, axis=-1).astype(a.dtype)
+        if p == float("inf"):
+            return jnp.max(d, axis=-1)
+        return jnp.power(jnp.sum(jnp.power(d, p), axis=-1), 1.0 / p)
+    return run_op("cdist", fn, (x, y))
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of one point set (parity: paddle.pdist)."""
+    def fn(a):
+        n = a.shape[-2]
+        iu, ju = jnp.triu_indices(n, k=1)
+        d = jnp.abs(a[..., iu, :] - a[..., ju, :])
+        if p == 0:
+            return jnp.sum(d != 0, axis=-1).astype(a.dtype)
+        if p == float("inf"):
+            return jnp.max(d, axis=-1)
+        return jnp.power(jnp.sum(jnp.power(d, p), axis=-1), 1.0 / p)
+    return run_op("pdist", fn, (x,))
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def _ct(yy, xx=None):
+        import numpy as _np
+        yl = jnp.take(yy, jnp.arange(yy.shape[axis] - 1), axis=axis)
+        yr = jnp.take(yy, jnp.arange(1, yy.shape[axis]), axis=axis)
+        if xx is not None:
+            xl = jnp.take(xx, jnp.arange(xx.shape[axis] - 1), axis=axis)
+            xr = jnp.take(xx, jnp.arange(1, xx.shape[axis]), axis=axis)
+            step = xr - xl
+        else:
+            step = dx or 1.0
+        return jnp.cumsum((yl + yr) * 0.5 * step, axis=axis)
+    if x is not None:
+        return run_op("cumulative_trapezoid", _ct, (y, x))
+    return run_op("cumulative_trapezoid", _ct, (y,))
+
+
+def conj(x, name=None):
+    return run_op("conj", jnp.conj, (x,))
+
+
+def real(x, name=None):
+    return run_op("real", jnp.real, (x,))
+
+
+def imag(x, name=None):
+    return run_op("imag", jnp.imag, (x,))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return run_op("addmm",
+                  lambda i, a, b: beta * i + alpha * (a @ b), (input, x, y))

@@ -1,0 +1,385 @@
+"""Tests for paddle_tpu.linalg / fft / signal / geometric + the new
+tensor-op breadth (inplace variants, stacking, distances).
+
+Oracle pattern follows the reference's OpTest idea: compare against
+numpy/scipy references (reference: test/legacy_test/op_test.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+t = paddle.to_tensor
+rng = np.random.RandomState(42)
+
+
+class TestLinalgNamespace:
+    def test_all_symbols_present(self):
+        for name in ["cholesky", "norm", "cond", "cov", "corrcoef", "inv",
+                     "eig", "eigvals", "multi_dot", "matrix_rank", "svd",
+                     "qr", "householder_product", "pca_lowrank", "lu",
+                     "lu_unpack", "matrix_exp", "matrix_power", "det",
+                     "slogdet", "eigh", "eigvalsh", "pinv", "solve",
+                     "cholesky_solve", "triangular_solve", "lstsq"]:
+            assert hasattr(paddle.linalg, name), name
+
+    def test_lu_unpack_reconstructs(self):
+        a = rng.randn(6, 6).astype(np.float32)
+        lu_t, piv = paddle.linalg.lu(t(a))
+        p, l, u = paddle.linalg.lu_unpack(lu_t, piv)
+        rec = p.numpy() @ l.numpy() @ u.numpy()
+        np.testing.assert_allclose(rec, a, atol=1e-5)
+
+    def test_matrix_exp_identity(self):
+        z = np.zeros((3, 3), np.float32)
+        np.testing.assert_allclose(paddle.linalg.matrix_exp(t(z)).numpy(),
+                                   np.eye(3), atol=1e-6)
+
+    def test_matrix_exp_vs_series(self):
+        a = (rng.randn(4, 4) * 0.1).astype(np.float32)
+        got = paddle.linalg.matrix_exp(t(a)).numpy()
+        ref = np.eye(4) + a + a @ a / 2 + a @ a @ a / 6 + a @ a @ a @ a / 24
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_pca_lowrank_spans_top_subspace(self):
+        # rank-2 matrix: pca with q=2 must reproduce it
+        b = rng.randn(10, 2).astype(np.float32)
+        c = rng.randn(2, 7).astype(np.float32)
+        a = b @ c
+        u, s, v = paddle.linalg.pca_lowrank(t(a), q=2, center=False)
+        rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        np.testing.assert_allclose(rec, a, atol=1e-3)
+
+    def test_svd_roundtrip(self):
+        a = rng.randn(5, 3).astype(np.float32)
+        u, s, vh = paddle.linalg.svd(t(a))
+        rec = u.numpy() @ np.diag(s.numpy()) @ vh.numpy()
+        np.testing.assert_allclose(rec, a, atol=1e-5)
+
+    def test_lstsq_matches_numpy(self):
+        a = rng.randn(8, 4).astype(np.float32)
+        b = rng.randn(8, 2).astype(np.float32)
+        sol, res, rk, sv = paddle.linalg.lstsq(t(a), t(b))
+        ref = np.linalg.lstsq(a, b, rcond=None)[0]
+        np.testing.assert_allclose(sol.numpy(), ref, atol=1e-4)
+
+
+class TestFFT:
+    def test_fft_matches_numpy(self):
+        x = rng.randn(16).astype(np.float32)
+        np.testing.assert_allclose(paddle.fft.fft(t(x)).numpy(),
+                                   np.fft.fft(x), atol=1e-4)
+
+    def test_ifft_roundtrip(self):
+        x = rng.randn(16).astype(np.float32)
+        y = paddle.fft.ifft(paddle.fft.fft(t(x)))
+        np.testing.assert_allclose(y.numpy().real, x, atol=1e-5)
+
+    def test_rfft_irfft(self):
+        x = rng.randn(32).astype(np.float32)
+        r = paddle.fft.rfft(t(x))
+        np.testing.assert_allclose(r.numpy(), np.fft.rfft(x), atol=1e-4)
+        back = paddle.fft.irfft(r)
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-5)
+
+    def test_fft2_and_fftn(self):
+        x = rng.randn(8, 8).astype(np.float32)
+        np.testing.assert_allclose(paddle.fft.fft2(t(x)).numpy(),
+                                   np.fft.fft2(x), atol=1e-3)
+        np.testing.assert_allclose(paddle.fft.fftn(t(x)).numpy(),
+                                   np.fft.fftn(x), atol=1e-3)
+
+    def test_hfft_ihfft(self):
+        x = rng.randn(9).astype(np.float32) + 1j * rng.randn(9).astype(np.float32)
+        np.testing.assert_allclose(paddle.fft.hfft(t(x)).numpy(),
+                                   np.fft.hfft(x), atol=1e-4)
+        xr = rng.randn(16).astype(np.float32)
+        np.testing.assert_allclose(paddle.fft.ihfft(t(xr)).numpy(),
+                                   np.fft.ihfft(xr), atol=1e-5)
+
+    def test_fftfreq_shift(self):
+        np.testing.assert_allclose(paddle.fft.fftfreq(8, d=0.5).numpy(),
+                                   np.fft.fftfreq(8, d=0.5), atol=1e-6)
+        x = np.arange(8.0, dtype=np.float32)
+        np.testing.assert_allclose(paddle.fft.fftshift(t(x)).numpy(),
+                                   np.fft.fftshift(x))
+        np.testing.assert_allclose(paddle.fft.ifftshift(t(x)).numpy(),
+                                   np.fft.ifftshift(x))
+
+    def test_norm_validation(self):
+        with pytest.raises(ValueError):
+            paddle.fft.fft(t(rng.randn(8).astype(np.float32)), norm="bogus")
+
+    def test_fft_grad(self):
+        x = t(rng.randn(8).astype(np.float32), stop_gradient=False)
+        y = paddle.fft.rfft(x)
+        loss = (y.abs() ** 2).sum()
+        loss.backward()
+        assert x.grad is not None
+        assert np.isfinite(x.grad.numpy()).all()
+
+
+class TestSignal:
+    def test_stft_shape_and_roundtrip(self):
+        x = rng.randn(2, 512).astype(np.float32)
+        spec = paddle.signal.stft(t(x), n_fft=64, hop_length=16)
+        assert spec.shape[0] == 2 and spec.shape[1] == 33
+        back = paddle.signal.istft(spec, n_fft=64, hop_length=16,
+                                   length=512)
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-3)
+
+    def test_stft_with_window(self):
+        x = rng.randn(256).astype(np.float32)
+        w = np.hanning(64).astype(np.float32)
+        spec = paddle.signal.stft(t(x), n_fft=64, hop_length=16,
+                                  window=t(w))
+        back = paddle.signal.istft(spec, n_fft=64, hop_length=16,
+                                   window=t(w), length=256)
+        # edges lose energy under the window; compare the interior
+        np.testing.assert_allclose(back.numpy()[32:-32], x[32:-32], atol=1e-3)
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        data = np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]], np.float32)
+        seg = np.array([0, 0, 1, 2], np.int64)
+        np.testing.assert_allclose(
+            paddle.geometric.segment_sum(t(data), t(seg)).numpy(),
+            [[4., 6.], [5., 6.], [7., 8.]])
+        np.testing.assert_allclose(
+            paddle.geometric.segment_mean(t(data), t(seg)).numpy(),
+            [[2., 3.], [5., 6.], [7., 8.]])
+        np.testing.assert_allclose(
+            paddle.geometric.segment_min(t(data), t(seg)).numpy(),
+            [[1., 2.], [5., 6.], [7., 8.]])
+        np.testing.assert_allclose(
+            paddle.geometric.segment_max(t(data), t(seg)).numpy(),
+            [[3., 4.], [5., 6.], [7., 8.]])
+
+    def test_send_u_recv(self):
+        x = np.array([[1.0], [2.0], [3.0]], np.float32)
+        src = np.array([0, 1, 2, 0], np.int64)
+        dst = np.array([1, 2, 1, 0], np.int64)
+        out = paddle.geometric.send_u_recv(t(x), t(src), t(dst),
+                                           reduce_op="sum")
+        np.testing.assert_allclose(out.numpy(), [[1.], [4.], [2.]])
+
+    def test_send_ue_recv_and_uv(self):
+        x = np.array([[1.0], [2.0]], np.float32)
+        e = np.array([[10.0], [20.0]], np.float32)
+        src = np.array([0, 1], np.int64)
+        dst = np.array([1, 0], np.int64)
+        out = paddle.geometric.send_ue_recv(t(x), t(e), t(src), t(dst),
+                                            message_op="add",
+                                            reduce_op="sum")
+        np.testing.assert_allclose(out.numpy(), [[22.], [11.]])
+        uv = paddle.geometric.send_uv(t(x), t(x), t(src), t(dst),
+                                      message_op="mul")
+        np.testing.assert_allclose(uv.numpy(), [[2.], [2.]])
+
+    def test_sample_neighbors(self):
+        # CSC: node0 -> {1,2}, node1 -> {2}, node2 -> {}
+        row = np.array([1, 2, 2], np.int64)
+        colptr = np.array([0, 2, 3, 3], np.int64)
+        nb, cnt = paddle.geometric.sample_neighbors(
+            t(row), t(colptr), t(np.array([0, 1, 2], np.int64)))
+        assert cnt.numpy().tolist() == [2, 1, 0]
+        assert sorted(nb.numpy().tolist()[:2]) == [1, 2]
+
+    def test_reindex_graph(self):
+        x = np.array([5, 9], np.int64)
+        neighbors = np.array([9, 7, 5], np.int64)
+        count = np.array([2, 1], np.int64)
+        src, dst, nodes = paddle.geometric.reindex_graph(
+            t(x), t(neighbors), t(count))
+        assert nodes.numpy().tolist() == [5, 9, 7]
+        assert src.numpy().tolist() == [1, 2, 0]
+        assert dst.numpy().tolist() == [0, 0, 1]
+
+
+class TestInplaceVariants:
+    def test_basic_math_inplace(self):
+        x = t(np.array([1.0, 4.0], np.float32))
+        assert x.sqrt_() is x
+        np.testing.assert_allclose(x.numpy(), [1.0, 2.0])
+        x.add_(t(np.array([1.0, 1.0], np.float32)))
+        np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+
+    def test_grad_flows_through_inplace(self):
+        x = t(np.array([0.5, 1.5], np.float32), stop_gradient=False)
+        y = x * 2.0
+        y.tanh_()
+        y.sum().backward()
+        ref = 2.0 * (1 - np.tanh(np.array([1.0, 3.0])) ** 2)
+        np.testing.assert_allclose(x.grad.numpy(), ref, atol=1e-6)
+
+    def test_chained_inplace_grad(self):
+        x = t(np.array([2.0], np.float32), stop_gradient=False)
+        y = x + 0.0
+        y.square_()
+        y.log_()
+        y.sum().backward()
+        # d/dx log(x^2) = 2/x
+        np.testing.assert_allclose(x.grad.numpy(), [1.0], atol=1e-6)
+
+    def test_top_level_inplace_exports(self):
+        for name in ["tanh_", "sqrt_", "clip_", "scatter_", "tril_",
+                     "triu_", "cast_", "masked_fill_", "index_add_",
+                     "logical_and_", "bitwise_and_", "cauchy_",
+                     "geometric_", "remainder_", "floor_mod_"]:
+            assert hasattr(paddle, name), name
+            assert hasattr(paddle.Tensor, name), f"Tensor.{name}"
+
+    def test_cauchy_geometric_fill(self):
+        g = t(np.zeros(2000, np.float32))
+        g.geometric_(0.5)
+        assert g.numpy().min() >= 1.0
+        assert abs(g.numpy().mean() - 2.0) < 0.2
+
+
+class TestNewTensorOps:
+    def test_stacks(self):
+        a = rng.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.hstack([t(a), t(a)]).numpy(),
+                                   np.hstack([a, a]))
+        np.testing.assert_allclose(paddle.vstack([t(a), t(a)]).numpy(),
+                                   np.vstack([a, a]))
+        np.testing.assert_allclose(paddle.dstack([t(a), t(a)]).numpy(),
+                                   np.dstack([a, a]))
+        np.testing.assert_allclose(paddle.column_stack([t(a), t(a)]).numpy(),
+                                   np.column_stack([a, a]))
+        np.testing.assert_allclose(paddle.row_stack([t(a), t(a)]).numpy(),
+                                   np.vstack([a, a]))
+
+    def test_distances(self):
+        import scipy.spatial.distance as ssd
+        x = rng.randn(5, 3).astype(np.float32)
+        y = rng.randn(4, 3).astype(np.float32)
+        np.testing.assert_allclose(paddle.cdist(t(x), t(y)).numpy(),
+                                   ssd.cdist(x, y), atol=1e-4)
+        np.testing.assert_allclose(paddle.cdist(t(x), t(y), p=1.0).numpy(),
+                                   ssd.cdist(x, y, "minkowski", p=1),
+                                   atol=1e-4)
+        np.testing.assert_allclose(paddle.pdist(t(x)).numpy(),
+                                   ssd.pdist(x), atol=1e-4)
+
+    def test_special_functions(self):
+        import scipy.special as sp
+        x = rng.rand(8).astype(np.float32) * 3 + 0.1
+        np.testing.assert_allclose(paddle.gammaln(t(x)).numpy(),
+                                   sp.gammaln(x), atol=1e-4)
+        np.testing.assert_allclose(paddle.i0e(t(x)).numpy(), sp.i0e(x),
+                                   atol=1e-5)
+        np.testing.assert_allclose(paddle.i1(t(x)).numpy(), sp.i1(x),
+                                   atol=1e-5)
+        np.testing.assert_allclose(paddle.i1e(t(x)).numpy(), sp.i1e(x),
+                                   atol=1e-5)
+
+    def test_sign_family(self):
+        x = np.array([-2.0, 0.0, 3.0], np.float32)
+        np.testing.assert_allclose(paddle.sgn(t(x)).numpy(), np.sign(x))
+        np.testing.assert_allclose(paddle.signbit(t(x)).numpy(),
+                                   np.signbit(x))
+        y = np.array([1.0, -1.0, 2.0], np.float32)
+        np.testing.assert_allclose(paddle.copysign(t(x), t(y)).numpy(),
+                                   np.copysign(x, y))
+        np.testing.assert_allclose(paddle.nextafter(t(x), t(y)).numpy(),
+                                   np.nextafter(x, y))
+
+    def test_trace_renorm(self):
+        a = rng.randn(4, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.trace(t(a)).numpy(), np.trace(a),
+                                   atol=1e-5)
+        r = paddle.renorm(t(a), 2.0, 0, 1.0).numpy()
+        norms = np.linalg.norm(r, axis=1)
+        assert (norms <= 1.0 + 1e-5).all()
+
+    def test_frexp_ldexp(self):
+        x = np.array([0.5, 8.0, -3.0], np.float32)
+        m, e = paddle.frexp(t(x))
+        np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(), x)
+
+    def test_unflatten_as_strided(self):
+        a = rng.randn(24).astype(np.float32)
+        assert paddle.unflatten(t(a), 0, [2, 3, 4]).shape == [2, 3, 4]
+        got = paddle.as_strided(t(a), [3, 2], [2, 1]).numpy()
+        ref = np.lib.stride_tricks.as_strided(a, (3, 2), (8, 4))
+        np.testing.assert_allclose(got, ref)
+
+    def test_masked_scatter_combinations(self):
+        a = rng.randn(3, 3).astype(np.float32)
+        m = a > 0
+        v = np.arange(9, dtype=np.float32)
+        ref = a.copy()
+        ref[m] = v[:m.sum()]
+        np.testing.assert_allclose(
+            paddle.masked_scatter(t(a), t(m), t(v)).numpy(), ref)
+        c = paddle.combinations(t(np.arange(4)), 2).numpy()
+        assert c.shape == (6, 2)
+
+    def test_complex_views(self):
+        x = (rng.randn(4) + 1j * rng.randn(4)).astype(np.complex64)
+        np.testing.assert_allclose(paddle.real(t(x)).numpy(), x.real)
+        np.testing.assert_allclose(paddle.imag(t(x)).numpy(), x.imag)
+        np.testing.assert_allclose(paddle.conj(t(x)).numpy(), np.conj(x))
+
+    def test_diag_embed(self):
+        v = rng.randn(2, 3).astype(np.float32)
+        out = paddle.diag_embed(t(v)).numpy()
+        assert out.shape == (2, 3, 3)
+        for b in range(2):
+            np.testing.assert_allclose(out[b], np.diag(v[b]))
+        off = paddle.diag_embed(t(v), offset=1).numpy()
+        assert off.shape == (2, 4, 4)
+
+    def test_cumulative_trapezoid(self):
+        import scipy.integrate as si
+        y = rng.randn(10).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.cumulative_trapezoid(t(y)).numpy(),
+            si.cumulative_trapezoid(y), atol=1e-5)
+
+    def test_addmm(self):
+        i = rng.randn(3, 4).astype(np.float32)
+        x = rng.randn(3, 5).astype(np.float32)
+        y = rng.randn(5, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.addmm(t(i), t(x), t(y), beta=0.5, alpha=2.0).numpy(),
+            0.5 * i + 2.0 * (x @ y), atol=1e-5)
+
+    def test_rank_shape_utilities(self):
+        a = rng.randn(3, 4).astype(np.float32)
+        assert int(paddle.rank(t(a)).numpy()) == 2
+        assert paddle.shape(t(a)).numpy().tolist() == [3, 4]
+
+
+class TestFrameworkBits:
+    def test_iinfo_finfo(self):
+        assert paddle.iinfo("int8").max == 127
+        assert paddle.finfo("float32").bits == 32
+        assert paddle.finfo("bfloat16").bits == 16
+
+    def test_places(self):
+        assert paddle.CPUPlace() == paddle.CPUPlace()
+        assert paddle.CUDAPlace(0) == paddle.CUDAPlace(0)
+        assert paddle.CUDAPlace(0) != paddle.CUDAPlace(1)
+
+    def test_batch_reader(self):
+        reader = paddle.batch(lambda: iter(range(7)), batch_size=3)
+        batches = list(reader())
+        assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+        reader = paddle.batch(lambda: iter(range(7)), batch_size=3,
+                              drop_last=True)
+        assert list(reader()) == [[0, 1, 2], [3, 4, 5]]
+
+    def test_summary_flops(self):
+        net = paddle.nn.Linear(8, 4)
+        info = paddle.summary(net)
+        assert info["total_params"] == 8 * 4 + 4
+        f = paddle.flops(net, [2, 8])
+        assert f > 0
+
+    def test_lazy_guard(self):
+        with paddle.LazyGuard():
+            net = paddle.nn.Linear(4, 4)
+        assert net.weight.shape == [4, 4]
