@@ -27,5 +27,13 @@ from . import passes  # noqa: F401
 from .comm_watchdog import (CommTaskManager, CommTimeoutError,  # noqa: F401
                             get_comm_task_manager, set_comm_task_manager)
 
+from .extras import (spawn, scatter_object_list, broadcast_object_list,  # noqa: F401
+                     gloo_init_parallel_env, gloo_barrier, gloo_release,
+                     split, ParallelMode, is_available, get_backend,
+                     shard_dataloader, ReduceType, Strategy,
+                     CountFilterEntry, ShowClickEntry, ProbabilityEntry,
+                     QueueDataset, InMemoryDataset)
+from . import io  # noqa: F401
+
 alltoall = all_to_all
 alltoall_single = all_to_all_single

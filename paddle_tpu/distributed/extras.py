@@ -1,0 +1,382 @@
+"""Remaining paddle.distributed surface (parity: spawn, object
+collectives, gloo env shims, TP split API, dataset entries, strategy).
+
+reference: python/paddle/distributed/spawn.py, communication/*_object_list,
+fleet/base/role_maker gloo paths, fleet/layers/mpu/mp_ops.py:700 (split),
+distributed/entry_attr.py, auto_parallel/strategy.py.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "spawn", "scatter_object_list", "broadcast_object_list",
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release", "split",
+    "ParallelMode", "is_available", "get_backend", "shard_dataloader",
+    "ReduceType", "Strategy", "CountFilterEntry", "ShowClickEntry",
+    "ProbabilityEntry", "QueueDataset", "InMemoryDataset",
+]
+
+
+# -- process spawning ------------------------------------------------------
+
+def _spawn_target(func, rank, nprocs, env, args):
+    for k, v in env.items():
+        os.environ[k] = v
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    func(*args)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Launch ``func`` in ``nprocs`` processes with the PADDLE_TRAINER_*
+    env contract (parity: paddle.distributed.spawn — the reference forks
+    one process per GPU; here one per requested worker, spawn-start to be
+    fork-safe with JAX threads)."""
+    if nprocs == -1:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    ctx = multiprocessing.get_context("spawn")
+    procs = []
+    env = {k: v for k, v in os.environ.items()
+           if k.startswith(("PADDLE_", "FLAGS_"))}
+    for rank in range(nprocs):
+        prc = ctx.Process(target=_spawn_target,
+                          args=(func, rank, nprocs, env, args),
+                          daemon=daemon)
+        prc.start()
+        procs.append(prc)
+
+    class _Context:
+        def __init__(self, ps):
+            self.processes = ps
+
+        def join(self, timeout=None):
+            for p_ in self.processes:
+                p_.join(timeout)
+            bad = [i for i, p_ in enumerate(self.processes)
+                   if p_.exitcode not in (0, None)]
+            if bad:
+                raise RuntimeError(
+                    f"spawned ranks {bad} exited with nonzero status")
+    c = _Context(procs)
+    if join:
+        c.join()
+    return c
+
+
+# -- object collectives ----------------------------------------------------
+
+def _obj_to_tensor(obj):
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+    return Tensor(jnp.asarray(payload.copy()))
+
+
+def _tensor_to_obj(t):
+    return pickle.loads(np.asarray(t._data).tobytes())
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """(parity: paddle.distributed.broadcast_object_list). On the global-
+    array substrate every process sees identical values, so the broadcast
+    is identity for the src's data; the API contract (in-place fill of
+    object_list) is preserved."""
+    from .communication import broadcast
+    out = []
+    for obj in object_list:
+        t = _obj_to_tensor(obj)
+        t = broadcast(t, src=src, group=group)
+        out.append(_tensor_to_obj(t))
+    object_list[:] = out
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """(parity: paddle.distributed.scatter_object_list)."""
+    from .parallel import get_rank, get_world_size
+    world = get_world_size(group)
+    rank = get_rank(group)
+    if in_object_list is None:
+        in_object_list = []
+    if world <= 1:
+        out_object_list[:] = list(in_object_list[:1]) or [None]
+        return out_object_list
+    if len(in_object_list) % world != 0:
+        raise ValueError(
+            f"scatter_object_list: {len(in_object_list)} objects not "
+            f"divisible by world size {world}")
+    per = len(in_object_list) // world
+    chunk = in_object_list[rank * per:(rank + 1) * per]
+    out_object_list[:] = chunk
+    return out_object_list
+
+
+# -- gloo shims ------------------------------------------------------------
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU rendezvous env init (parity: paddle.distributed
+    .gloo_init_parallel_env — gloo is the reference's CPU backend; this
+    build's host coordination uses the TCPStore)."""
+    from .store import create_or_get_global_tcp_store
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    host, port = server_endpoint.rsplit(":", 1)
+    os.environ.setdefault("MASTER_ADDR", host)
+    os.environ.setdefault("MASTER_PORT", port)
+    create_or_get_global_tcp_store()
+
+
+def gloo_barrier():
+    """(parity: paddle.distributed.gloo_barrier)"""
+    from .communication import barrier
+    barrier()
+
+
+def gloo_release():
+    """(parity: paddle.distributed.gloo_release) — host KV teardown."""
+
+
+# -- TP split API ----------------------------------------------------------
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Megatron-style distributed fc/embedding (parity:
+    paddle.distributed.split, fleet/layers/mpu/mp_ops.py:700).
+
+    operation='linear': axis=0 row-parallel / axis=1 column-parallel
+    Linear over the model-parallel group; operation='embedding':
+    vocab-parallel embedding. Returns a constructed layer applied to x.
+    """
+    from .fleet.layers.mpu.mp_layers import (ColumnParallelLinear,
+                                             RowParallelLinear,
+                                             VocabParallelEmbedding)
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:
+            layer = ColumnParallelLinear(in_f, out_f,
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        else:
+            layer = RowParallelLinear(in_f, out_f,
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      input_is_parallel=False)
+        return layer(x)
+    if operation == "embedding":
+        num_emb, emb_dim = size
+        layer = VocabParallelEmbedding(num_emb, emb_dim,
+                                       weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unsupported operation {operation!r}")
+
+
+# -- metadata / config -----------------------------------------------------
+
+from .fleet.fleet import ParallelMode  # noqa: E402,F401
+
+
+class ReduceType:
+    """(parity: paddle.distributed.ReduceType — reduce kinds for Partial
+    placements)"""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+def is_available():
+    """(parity: paddle.distributed.is_available)"""
+    return True
+
+
+def get_backend(group=None):
+    """(parity: paddle.distributed.get_backend) — the collective backend
+    on this substrate is XLA's compiled collectives over ICI/DCN."""
+    return "XCCL"
+
+
+class Strategy:
+    """Auto-parallel strategy config (parity: paddle.distributed.Strategy,
+    auto_parallel/strategy.py — nested toggle namespaces)."""
+
+    class _Config:
+        def __init__(self, defaults, overrides):
+            self.__dict__.update(defaults)
+            self.__dict__.update(overrides or {})
+
+        def __repr__(self):
+            return repr(self.__dict__)
+
+    def __init__(self, config=None):
+        cfg = config or {}
+        self.sharding = Strategy._Config(
+            dict(enable=False, stage=1, degree=8), cfg.get("sharding"))
+        self.fused_passes = Strategy._Config(
+            dict(enable=False, fused_passes_list=[]),
+            cfg.get("fused_passes"))
+        self.gradient_merge = Strategy._Config(
+            dict(enable=False, k_steps=1, avg=True),
+            cfg.get("gradient_merge"))
+        self.pipeline = Strategy._Config(
+            dict(enable=False, schedule_mode="1F1B", micro_batch_size=1,
+                 accumulate_steps=1), cfg.get("pipeline"))
+        self.amp = Strategy._Config(
+            dict(enable=False, dtype="float16", level="O1"),
+            cfg.get("amp"))
+        self.recompute = Strategy._Config(
+            dict(enable=False), cfg.get("recompute"))
+
+
+# -- dataset entry configs (PS-stack metadata; inventoried for parity) -----
+
+class _EntryAttr:
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class CountFilterEntry(_EntryAttr):
+    """(parity: paddle.distributed.CountFilterEntry — sparse feature
+    admission by click count; metadata object on this substrate)"""
+
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self._count_filter = count_filter
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self._count_filter}"
+
+
+class ShowClickEntry(_EntryAttr):
+    """(parity: paddle.distributed.ShowClickEntry)"""
+
+    def __init__(self, show_name, click_name):
+        self._show = show_name
+        self._click = click_name
+
+    def _to_attr(self):
+        return f"show_click_entry:{self._show}:{self._click}"
+
+
+class ProbabilityEntry(_EntryAttr):
+    """(parity: paddle.distributed.ProbabilityEntry)"""
+
+    def __init__(self, probability):
+        if not 0 <= probability <= 1:
+            raise ValueError("probability must be in [0, 1]")
+        self._probability = probability
+
+    def _to_attr(self):
+        return f"probability_entry:{self._probability}"
+
+
+class QueueDataset:
+    """Streaming file-fed dataset (parity: paddle.distributed.QueueDataset
+    — the reference feeds an async C++ pipeline; here a generator over
+    files consumed by the DataLoader)."""
+
+    def __init__(self):
+        self._filelist = []
+        self._pipe_command = None
+        self._batch_size = 1
+        self._thread_num = 1
+
+    def init(self, batch_size=1, thread_num=1, pipe_command=None,
+             use_var=None, **kwargs):
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        self._pipe_command = pipe_command
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def _iter_lines(self):
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    yield line.rstrip("\n")
+
+    def __iter__(self):
+        return self._iter_lines()
+
+
+class InMemoryDataset(QueueDataset):
+    """(parity: paddle.distributed.InMemoryDataset — loads into memory,
+    supports shuffle before feeding)."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = []
+
+    def load_into_memory(self):
+        self._samples = list(self._iter_lines())
+
+    def local_shuffle(self):
+        rng = np.random.default_rng(0)
+        rng.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._samples = []
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def __iter__(self):
+        if self._samples:
+            return iter(self._samples)
+        return self._iter_lines()
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,
+                     is_dataset_splitted=False):
+    """Wrap a DataLoader so each batch lands sharded on the given mesh(es)
+    (parity: paddle.distributed.shard_dataloader,
+    auto_parallel/api.py:1783)."""
+    from .auto_parallel.api import shard_tensor
+    from .process_mesh import Replicate, Shard
+
+    meshes_list = meshes if isinstance(meshes, (list, tuple)) else [meshes]
+
+    class _ShardedLoader:
+        def __init__(self, dl):
+            self._dl = dl
+
+        def __len__(self):
+            return len(self._dl)
+
+        def _place(self, item, mesh, dim):
+            if isinstance(item, (list, tuple)):
+                return type(item)(self._place(v, mesh, dim) for v in item)
+            if isinstance(item, dict):
+                return {k: self._place(v, mesh, dim)
+                        for k, v in item.items()}
+            if isinstance(item, Tensor):
+                placements = [Replicate()] * len(mesh.shape)
+                if dim is not None:
+                    axis = mesh.dim_names.index(dim) \
+                        if isinstance(dim, str) else dim
+                    placements[axis] = Shard(0)
+                return shard_tensor(item, mesh, placements)
+            return item
+
+        def __iter__(self):
+            mesh = meshes_list[0]
+            dim = shard_dims if not isinstance(shard_dims, (list, tuple)) \
+                else shard_dims[0]
+            for batch in self._dl:
+                yield self._place(batch, mesh, dim)
+    return _ShardedLoader(dataloader)

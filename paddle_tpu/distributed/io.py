@@ -1,0 +1,40 @@
+"""Distributed IO helpers (parity: python/paddle/distributed/io.py —
+save/load for distributed training programs)."""
+from __future__ import annotations
+
+import os
+
+from ..framework import load as _load
+from ..framework import save as _save
+
+__all__ = ["save_persistables", "load_persistables",
+           "is_persistable", "save_distributed_persistables"]
+
+
+def is_persistable(var):
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    """Save a program's persistable params (parity: io.save_persistables).
+    In this build the 'program' is a Layer or a state_dict."""
+    obj = main_program
+    state = obj.state_dict() if hasattr(obj, "state_dict") else obj
+    path = os.path.join(dirname, filename or "persistables.pdparams")
+    _save(state, path)
+    return path
+
+
+def save_distributed_persistables(executor=None, dirname=None,
+                                  main_program=None, **kw):
+    return save_persistables(executor, dirname, main_program, **kw)
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    path = os.path.join(dirname, filename or "persistables.pdparams")
+    state = _load(path)
+    if main_program is not None and hasattr(main_program, "set_state_dict"):
+        main_program.set_state_dict(state)
+    return state

@@ -319,3 +319,6 @@ from .transform import (Transform, AffineTransform,  # noqa: E402,F401
                         ExpTransform, SigmoidTransform, TanhTransform,
                         PowerTransform, ChainTransform,
                         TransformedDistribution)
+
+from .continuous import (ContinuousBernoulli, ExponentialFamily,  # noqa: F401
+                         MultivariateNormal)

@@ -22,7 +22,9 @@ from . import Distribution, _tensor, register_kl
 
 __all__ = ["Beta", "Gamma", "Dirichlet", "Laplace", "Multinomial",
            "LogNormal", "Gumbel", "Geometric", "Cauchy", "StudentT",
-           "Poisson", "Binomial", "Chi2", "Independent"]
+           "Poisson", "Binomial", "Chi2", "Independent", "ExponentialFamily", "ContinuousBernoulli",
+    "MultivariateNormal",
+]
 
 _EULER = float(np.euler_gamma)
 
@@ -582,3 +584,233 @@ def _kl_geometric(p, q):
         return ((1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qp))
                 + jnp.log(pp) - jnp.log(qp))
     return run_op("kl_geometric_geometric", fn, (p.probs, q.probs))
+
+
+class ExponentialFamily(Distribution):
+    """Base class for exponential-family distributions (parity:
+    paddle.distribution.ExponentialFamily — provides the Bregman-divergence
+    entropy identity via natural parameters)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        """H = A(eta) - <eta, grad A(eta)> + E[carrier] via autodiff of the
+        log-normalizer (the reference's same trick, distribution/
+        exponential_family.py). Runs through the dispatch funnel so the
+        entropy itself stays differentiable w.r.t. the parameters."""
+        nat = [n if isinstance(n, Tensor) else _tensor(n)
+               for n in self._natural_parameters]
+
+        def fn(*arrs):
+            val, vjp = jax.vjp(lambda *es: self._log_normalizer(*es),
+                               *arrs)
+            grads = vjp(jnp.ones_like(val))
+            ent = val - self._mean_carrier_measure
+            for e, g in zip(arrs, grads):
+                ent = ent - e * g
+            return ent
+        return run_op("expfam_entropy", fn, tuple(nat))
+
+
+class ContinuousBernoulli(ExponentialFamily):
+    """(parity: paddle.distribution.ContinuousBernoulli — CB(probs) with
+    the log-normalizing constant C(p))."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = _tensor(probs)
+        self._lims = lims
+        super().__init__(tuple(self.probs._data.shape))
+
+    def _cont_bern_mean(self, p):
+        """E[X] for CB(p) with the same cut/Taylor stabilization as the
+        log-normalizer."""
+        safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+        cut = (safe < self._lims[0]) | (safe > self._lims[1])
+        sp = jnp.where(cut, safe, 0.4)
+        m = sp / (2 * sp - 1) + 1 / (2 * jnp.arctanh(1 - 2 * sp))
+        x = safe - 0.5
+        taylor = 0.5 + (1.0 / 3.0 + 16.0 / 45.0 * x * x) * x
+        return jnp.where(cut, m, taylor)
+
+    def _cont_bern_log_norm(self, p):
+        # log C(p); near p=0.5 use the Taylor expansion (the reference's
+        # numerically-stabilized branch)
+        safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+        cut = (safe < self._lims[0]) | (safe > self._lims[1])
+        sp = jnp.where(cut, safe, 0.4)
+        log_norm = jnp.log(
+            jnp.abs(2.0 * jnp.arctanh(1 - 2 * sp))) - jnp.log(
+                jnp.abs(1 - 2 * sp))
+        x = safe - 0.5
+        taylor = jnp.log(2.0) + (4.0 / 3.0 + 104.0 / 45.0 * x * x) * x * x
+        return jnp.where(cut, log_norm, taylor)
+
+    @property
+    def mean(self):
+        return run_op("cb_mean", self._cont_bern_mean, (self.probs,))
+
+    @property
+    def variance(self):
+        def fn(p):
+            safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+            cut = (safe < self._lims[0]) | (safe > self._lims[1])
+            sp = jnp.where(cut, safe, 0.4)
+            v = sp * (sp - 1) / (2 * sp - 1) ** 2 \
+                + 1 / (2 * jnp.arctanh(1 - 2 * sp)) ** 2
+            x = safe - 0.5
+            taylor = 1.0 / 12.0 - (1.0 / 15.0 - 128.0 / 945.0 * x * x) * x * x
+            return jnp.where(cut, v, taylor)
+        return run_op("cb_var", fn, (self.probs,))
+
+    def log_prob(self, value):
+        def fn(p, v):
+            safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+            return (v * jnp.log(safe) + (1 - v) * jnp.log1p(-safe)
+                    + self._cont_bern_log_norm(safe))
+        return run_op("cb_log_prob", fn, (self.probs, value))
+
+    def prob(self, value):
+        from ..tensor.math import exp
+        return exp(self.log_prob(value))
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(self._key(), shape, minval=1e-6,
+                               maxval=1 - 1e-6)
+
+        def fn(p):
+            safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+            cut = (safe < self._lims[0]) | (safe > self._lims[1])
+            sp = jnp.where(cut, safe, 0.4)
+            icdf = (jnp.log1p(u * (2 * sp - 1) / (1 - sp))
+                    / (jnp.log(sp) - jnp.log1p(-sp)))
+            return jnp.where(cut, icdf, u)
+        return run_op("cb_rsample", fn, (self.probs,))
+
+    def entropy(self):
+        def fn(p):
+            safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+            mean = self._cont_bern_mean(p)
+            return -(mean * jnp.log(safe) + (1 - mean) * jnp.log1p(-safe)
+                     + self._cont_bern_log_norm(safe))
+        return run_op("cb_entropy", fn, (self.probs,))
+
+    def kl_divergence(self, other):
+        def fn(p, q):
+            # E_p[log p(x) - log q(x)] with CB mean under p
+            safe_p = jnp.clip(p, 1e-6, 1 - 1e-6)
+            safe_q = jnp.clip(q, 1e-6, 1 - 1e-6)
+            mean = self._cont_bern_mean(p)
+            lp = (mean * jnp.log(safe_p) + (1 - mean) * jnp.log1p(-safe_p)
+                  + self._cont_bern_log_norm(safe_p))
+            lq = (mean * jnp.log(safe_q) + (1 - mean) * jnp.log1p(-safe_q)
+                  + self._cont_bern_log_norm(safe_q))
+            return lp - lq
+        return run_op("cb_kl", fn, (self.probs, other.probs))
+
+
+class MultivariateNormal(Distribution):
+    """(parity: paddle.distribution.MultivariateNormal — loc +
+    covariance/precision/scale_tril parameterizations)."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None):
+        self.loc = _tensor(loc)
+        given = sum(m is not None for m in (covariance_matrix,
+                                            precision_matrix, scale_tril))
+        if given != 1:
+            raise ValueError(
+                "Exactly one of covariance_matrix, precision_matrix, "
+                "scale_tril must be specified")
+        if scale_tril is not None:
+            self.scale_tril = _tensor(scale_tril)
+        elif covariance_matrix is not None:
+            cov = _tensor(covariance_matrix)
+            self.scale_tril = run_op("mvn_chol", jnp.linalg.cholesky,
+                                     (cov,))
+            self.covariance_matrix = cov
+        else:
+            prec = _tensor(precision_matrix)
+
+            def fn(pm):
+                return jnp.linalg.cholesky(jnp.linalg.inv(pm))
+            self.scale_tril = run_op("mvn_prec_chol", fn, (prec,))
+            self.precision_matrix = prec
+        super().__init__(tuple(self.loc._data.shape[:-1]))
+        self.event_dim = self.loc._data.shape[-1]
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        def fn(l):
+            return jnp.sum(l ** 2, axis=-1)
+        return run_op("mvn_var", fn, (self.scale_tril,))
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape + (self.event_dim,)
+        eps = jax.random.normal(self._key(), shape)
+
+        def fn(m, l):
+            return m + jnp.einsum("...ij,...j->...i", l, eps)
+        return run_op("mvn_rsample", fn, (self.loc, self.scale_tril))
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        def fn(m, l, v):
+            d = v - m
+            sol = jax.scipy.linalg.solve_triangular(l, d[..., None],
+                                                    lower=True)[..., 0]
+            maha = jnp.sum(sol ** 2, axis=-1)
+            logdet = jnp.sum(jnp.log(jnp.diagonal(l, axis1=-2, axis2=-1)),
+                             axis=-1)
+            k = self.event_dim
+            return -0.5 * (maha + k * jnp.log(2 * jnp.pi)) - logdet
+        return run_op("mvn_log_prob", fn, (self.loc, self.scale_tril,
+                                           value))
+
+    def prob(self, value):
+        from ..tensor.math import exp
+        return exp(self.log_prob(value))
+
+    def entropy(self):
+        def fn(l):
+            logdet = jnp.sum(jnp.log(jnp.diagonal(l, axis1=-2, axis2=-1)),
+                             axis=-1)
+            k = self.event_dim
+            return 0.5 * k * (1 + jnp.log(2 * jnp.pi)) + logdet
+        return run_op("mvn_entropy", fn, (self.scale_tril,))
+
+    def kl_divergence(self, other):
+        def fn(m0, l0, m1, l1):
+            k = self.event_dim
+            logdet0 = jnp.sum(jnp.log(jnp.diagonal(l0, axis1=-2, axis2=-1)),
+                              axis=-1)
+            logdet1 = jnp.sum(jnp.log(jnp.diagonal(l1, axis1=-2, axis2=-1)),
+                              axis=-1)
+            # tr(S1^-1 S0) = ||L1^-1 L0||_F^2
+            sol = jax.scipy.linalg.solve_triangular(l1, l0, lower=True)
+            tr = jnp.sum(sol ** 2, axis=(-2, -1))
+            d = m1 - m0
+            md = jax.scipy.linalg.solve_triangular(l1, d[..., None],
+                                                  lower=True)[..., 0]
+            maha = jnp.sum(md ** 2, axis=-1)
+            return 0.5 * (tr + maha - k) + logdet1 - logdet0
+        return run_op("mvn_kl", fn, (self.loc, self.scale_tril, other.loc,
+                                     other.scale_tril))

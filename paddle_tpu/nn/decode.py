@@ -1,0 +1,190 @@
+"""Decoding utilities (parity: python/paddle/nn/decode.py — Decoder :42,
+BeamSearchDecoder :153, dynamic_decode :994; and the gather_tree op the
+finalize step uses).
+
+The decode loop is host-driven eager code (the reference's dygraph
+dynamic_decode is the same shape: a Python while over decoder.step); each
+step's math is XLA. Beam state lives in (batch, beam)-shaped tensors.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..tensor.manipulation import concat, gather, reshape, stack
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode", "gather_tree"]
+
+_INF = 1e9
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def gather_tree(ids, parents):
+    """Recover full beams from per-step ids/parent pointers (parity:
+    paddle.nn.functional.gather_tree over the gather_tree kernel). Both
+    inputs are (T, batch, beam); output is (T, batch, beam) where column k
+    holds the k-th complete beam."""
+    ids_a = np.asarray(_arr(ids))
+    par_a = np.asarray(_arr(parents))
+    T, B, K = ids_a.shape
+    out = np.zeros_like(ids_a)
+    out[T - 1] = ids_a[T - 1]
+    beam_idx = np.tile(np.arange(K), (B, 1))  # (B, K) current beam per slot
+    for t in range(T - 1, 0, -1):
+        beam_idx = np.take_along_axis(par_a[t], beam_idx, axis=1)
+        out[t - 1] = np.take_along_axis(ids_a[t - 1], beam_idx, axis=1)
+    return Tensor(jnp.asarray(out))
+
+
+class Decoder:
+    """Abstract decode interface (parity: nn/decode.py:42)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNN cell (parity: nn/decode.py:153).
+
+    step keeps (batch, beam) log-prob scores; candidate scoring expands to
+    (batch, beam*vocab) and takes top-k, with finished beams pinned to
+    repeat end_token at probability one.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        a = _arr(x)
+        tiled = jnp.repeat(a[:, None, ...], beam_size, axis=1)
+        return Tensor(tiled.reshape((-1,) + a.shape[1:]))
+
+    def _merge(self, a):  # (B, K, ...) -> (B*K, ...)
+        return a.reshape((-1,) + a.shape[2:])
+
+    def _split(self, a, batch):  # (B*K, ...) -> (B, K, ...)
+        return a.reshape((batch, self.beam_size) + a.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        import jax
+        states = initial_cell_states
+        flat = states if isinstance(states, (tuple, list)) else (states,)
+        batch = flat[0].shape[0]
+        self._batch = batch
+        tiled = tuple(
+            Tensor(self._merge(jnp.repeat(_arr(s)[:, None], self.beam_size,
+                                          axis=1)))
+            for s in flat)
+        cell_states = tiled if isinstance(states, (tuple, list)) \
+            else tiled[0]
+        ids = jnp.full((batch, self.beam_size), self.start_token, jnp.int64)
+        # only beam 0 is live at t=0 so identical beams don't divide mass
+        scores = jnp.where(jnp.arange(self.beam_size)[None, :] == 0,
+                           0.0, -_INF)
+        scores = jnp.broadcast_to(scores, (batch, self.beam_size))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        init_inputs = Tensor(ids.reshape(-1))
+        if self.embedding_fn is not None:
+            init_inputs = self.embedding_fn(init_inputs)
+        return init_inputs, (cell_states, Tensor(scores),
+                             Tensor(finished)), Tensor(finished)
+
+    def step(self, time, inputs, states, **kwargs):
+        import jax
+        cell_states, beam_scores, finished = states
+        B, K = self._batch, self.beam_size
+        cell_out, next_cell_states = self.cell(inputs, cell_states)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = _arr(cell_out)  # (B*K, V)
+        V = logits.shape[-1]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        logp = self._split(logp, B)  # (B, K, V)
+        fin = _arr(finished)
+        # finished beams may only extend with end_token at logp 0
+        pin = jnp.full((V,), -_INF).at[self.end_token].set(0.0)
+        logp = jnp.where(fin[..., None], pin[None, None, :], logp)
+        total = _arr(beam_scores)[..., None] + logp  # (B, K, V)
+        flat = total.reshape(B, K * V)
+        top_scores, top_idx = jax.lax.top_k(flat, K)  # (B, K)
+        parent = (top_idx // V).astype(jnp.int64)
+        token = (top_idx % V).astype(jnp.int64)
+        new_finished = jnp.take_along_axis(fin, parent, axis=1) | \
+            (token == self.end_token)
+        # reorder cell states by parent beam
+        gidx = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+
+        def regather(s):
+            return Tensor(_arr(s)[gidx])
+        if isinstance(next_cell_states, (tuple, list)):
+            next_cell_states = tuple(regather(s) for s in next_cell_states)
+        else:
+            next_cell_states = regather(next_cell_states)
+        outputs = {"scores": Tensor(top_scores),
+                   "predicted_ids": Tensor(token),
+                   "parent_ids": Tensor(parent)}
+        next_inputs = Tensor(token.reshape(-1))
+        if self.embedding_fn is not None:
+            next_inputs = self.embedding_fn(next_inputs)
+        next_states = (next_cell_states, Tensor(top_scores),
+                       Tensor(new_finished))
+        return outputs, next_states, next_inputs, Tensor(new_finished)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        ids = stack([o["predicted_ids"] for o in outputs], axis=0)
+        parents = stack([o["parent_ids"] for o in outputs], axis=0)
+        beams = gather_tree(ids, parents)  # (T, B, K)
+        return beams, final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run decoder.step until all finished or max_step_num (parity:
+    paddle.nn.dynamic_decode, nn/decode.py:994)."""
+    inputs, states, finished = decoder.initialize(inits)
+    outputs = []
+    step = 0
+    lengths = np.zeros(np.asarray(_arr(finished)).shape, np.int64)
+    while True:
+        if max_step_num is not None and step >= max_step_num:
+            break
+        out, states, inputs, finished = decoder.step(step, inputs, states,
+                                                     **kwargs)
+        outputs.append(out)
+        fin = np.asarray(_arr(finished))
+        lengths += (~fin).astype(np.int64)
+        step += 1
+        if bool(fin.all()):
+            break
+    final, final_states = decoder.finalize(outputs, states, None) \
+        if hasattr(decoder, "finalize") else (outputs, states)
+    if not output_time_major and isinstance(final, Tensor) \
+            and final.ndim >= 2:
+        perm = [1, 0] + list(range(2, final.ndim))
+        final = final.transpose(perm)
+    if return_length:
+        return final, final_states, Tensor(jnp.asarray(lengths))
+    return final, final_states

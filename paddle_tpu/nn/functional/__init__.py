@@ -9,3 +9,4 @@ from .flash_attention import (  # noqa: F401
     flash_attention, scaled_dot_product_attention, flash_attn_unpadded,
     sdp_kernel,
 )
+from ..decode import gather_tree  # noqa: F401

@@ -13,18 +13,13 @@ __all__ = [
     "leaky_relu", "elu", "celu", "selu", "prelu", "rrelu", "hardshrink",
     "hardsigmoid", "hardswish", "hardtanh", "log_sigmoid", "log_softmax",
     "softmax", "softmax_", "softplus", "softshrink", "softsign", "mish",
-    "tanhshrink", "thresholded_relu", "glu", "gumbel_softmax", "maxout",
+    "tanhshrink", "thresholded_relu", "glu", "gumbel_softmax", "maxout", "elu_", "hardtanh_", "leaky_relu_", "tanh_",
+    "thresholded_relu_",
 ]
 
 
 def relu(x, name=None):
     return run_op("relu", jax.nn.relu, (x,))
-
-
-def relu_(x, name=None):
-    out = relu(x)
-    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
-    return x
 
 
 def relu6(x, name=None):
@@ -200,3 +195,18 @@ def maxout(x, groups, axis=1, name=None):
         shape[axis:axis + 1] = [ch // groups, groups]
         return jnp.max(a.reshape(shape), axis=axis + 1)
     return run_op("maxout", fn, (x,))
+
+
+def _act_inplace(fn_name):
+    import sys
+    from ...tensor.inplace import _make_inplace
+    return _make_inplace(getattr(sys.modules[__name__], fn_name),
+                         name=fn_name)
+
+
+elu_ = _act_inplace("elu")
+hardtanh_ = _act_inplace("hardtanh")
+leaky_relu_ = _act_inplace("leaky_relu")
+tanh_ = _act_inplace("tanh")
+thresholded_relu_ = _act_inplace("thresholded_relu")
+relu_ = _act_inplace("relu")

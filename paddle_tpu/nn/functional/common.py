@@ -19,7 +19,10 @@ __all__ = [
     "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
     "embedding", "one_hot", "pad", "interpolate", "upsample", "unfold",
     "fold", "cosine_similarity", "pixel_shuffle", "pixel_unshuffle",
-    "channel_shuffle", "label_smooth", "bilinear", "class_center_sample",
+    "channel_shuffle", "label_smooth", "bilinear", "class_center_sample", "pairwise_distance", "sequence_mask", "zeropad2d",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d",
+    "fractional_max_pool2d", "fractional_max_pool3d", "affine_grid",
+    "grid_sample", "temporal_shift", "sparse_attention",
 ]
 
 
@@ -298,3 +301,369 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     remap = -np.ones(num_classes, dtype=np.int64)
     remap[sampled] = np.arange(len(sampled))
     return (Tensor(jnp.asarray(remap[data])), Tensor(jnp.asarray(sampled)))
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """(parity: F.pairwise_distance)"""
+    def fn(a, b):
+        d = jnp.abs(a - b) + epsilon
+        if p == float("inf"):
+            out = jnp.max(d, axis=-1, keepdims=keepdim)
+        else:
+            out = jnp.power(jnp.sum(jnp.power(d, p), axis=-1,
+                                    keepdims=keepdim), 1.0 / p)
+        return out
+    return run_op("pairwise_distance", fn, (x, y))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """lengths -> (..., maxlen) validity mask (parity: F.sequence_mask)."""
+    from ...core.tensor import Tensor as _T
+    lengths = x._data if isinstance(x, _T) else jnp.asarray(x)
+    m = maxlen if maxlen is not None else int(jnp.max(lengths))
+
+    def fn(l):
+        return (jnp.arange(m) < l[..., None]).astype(dtype)
+    return run_op("sequence_mask", fn, (x,), out_stop_gradient=True)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    l, r, top, bot = padding
+    cfg = ((0, 0), (0, 0), (top, bot), (l, r)) if data_format == "NCHW" \
+        else ((0, 0), (top, bot), (l, r), (0, 0))
+    return run_op("zeropad2d", lambda a: jnp.pad(a, cfg), (x,))
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                spatial_ndim, data_format, name):
+    """Shared unpool: scatter pooled values back to their argmax positions
+    (parity: F.max_unpool1d/2d/3d over the unpool kernels)."""
+    if isinstance(kernel_size, int):
+        kernel_size = [kernel_size] * spatial_ndim
+    if stride is None:
+        stride = kernel_size
+    elif isinstance(stride, int):
+        stride = [stride] * spatial_ndim
+    def fn(a, idx):
+        n, c = a.shape[0], a.shape[1]
+        in_sp = a.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(output_size[-spatial_ndim:])
+        else:
+            out_sp = tuple(
+                (i - 1) * s + k - 2 * (padding if isinstance(padding, int)
+                                       else padding[d])
+                for d, (i, s, k) in enumerate(zip(in_sp, stride,
+                                                  kernel_size)))
+        flat_len = int(np.prod(out_sp))
+        a2 = a.reshape(n, c, -1)
+        i2 = idx.reshape(n, c, -1).astype(jnp.int32)
+        out = jnp.zeros((n, c, flat_len), a.dtype)
+        out = jnp.put_along_axis(out, i2, a2, axis=2, inplace=False)
+        return out.reshape(n, c, *out_sp)
+    return run_op("max_unpool", fn, (x, indices))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 1, data_format, name)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 2, data_format, name)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 3, data_format, name)
+
+
+def _fractional_seq(in_sz, out_sz, u):
+    """Fractional pooling boundaries (the reference follows Graham's
+    formula: idx_i = ceil(alpha*(i+u)) - ceil(alpha*u))."""
+    alpha = in_sz / out_sz
+    i = np.arange(out_sz + 1)
+    seq = np.ceil(alpha * (i + u)).astype(np.int64) - \
+        int(np.ceil(alpha * u))
+    seq = np.clip(seq, 0, in_sz)
+    seq[-1] = in_sz
+    return seq
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """(parity: F.fractional_max_pool2d). Host-computed region boundaries
+    (they depend only on shapes and u), XLA segment maxes. When
+    kernel_size is given, windows are fixed-size and anchored at the
+    fractional start points (overlapping-pool semantics); otherwise the
+    disjoint fractional regions are pooled."""
+    from ...core.tensor import Tensor as _T
+    a = x._data if isinstance(x, _T) else jnp.asarray(x)
+    n, c, h, w = a.shape
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    u = float(random_u) if random_u is not None else \
+        float(np.random.uniform(0.1, 0.9))
+    hs = _fractional_seq(h, oh, u)
+    ws = _fractional_seq(w, ow, u)
+    if kernel_size is not None:
+        kh, kw = (kernel_size, kernel_size) \
+            if isinstance(kernel_size, int) else kernel_size
+        hs_end = np.minimum(hs[:-1] + kh, h)
+        ws_end = np.minimum(ws[:-1] + kw, w)
+    else:
+        hs_end = hs[1:]
+        ws_end = ws[1:]
+
+    def fn(arr):
+        outs = []
+        idxs = []
+        for i in range(oh):
+            row_o, row_i = [], []
+            for j in range(ow):
+                sl = arr[:, :, hs[i]:hs_end[i], ws[j]:ws_end[j]]
+                flat = sl.reshape(n, c, -1)
+                row_o.append(jnp.max(flat, axis=2))
+                amax = jnp.argmax(flat, axis=2)
+                hh = amax // (ws_end[j] - ws[j]) + hs[i]
+                ww = amax % (ws_end[j] - ws[j]) + ws[j]
+                row_i.append(hh * w + ww)
+            outs.append(jnp.stack(row_o, axis=2))
+            idxs.append(jnp.stack(row_i, axis=2))
+        out = jnp.stack(outs, axis=2)
+        idx = jnp.stack(idxs, axis=2)
+        return out, idx.astype(jnp.int32)
+    out, idx = run_op("fractional_max_pool2d", fn, (x,),
+                      num_nondiff_outputs=1)
+    if return_mask:
+        return out, idx
+    return out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """(parity: F.fractional_max_pool3d)"""
+    from ...core.tensor import Tensor as _T
+    a = x._data if isinstance(x, _T) else jnp.asarray(x)
+    n, c, d, h, w = a.shape
+    if isinstance(output_size, int):
+        od = oh = ow = output_size
+    else:
+        od, oh, ow = output_size
+    u = float(random_u) if random_u is not None else \
+        float(np.random.uniform(0.1, 0.9))
+    ds = _fractional_seq(d, od, u)
+    hs = _fractional_seq(h, oh, u)
+    ws = _fractional_seq(w, ow, u)
+    if kernel_size is not None:
+        if isinstance(kernel_size, int):
+            kd = kh = kw = kernel_size
+        else:
+            kd, kh, kw = kernel_size
+        ds_end = np.minimum(ds[:-1] + kd, d)
+        hs_end = np.minimum(hs[:-1] + kh, h)
+        ws_end = np.minimum(ws[:-1] + kw, w)
+    else:
+        ds_end = ds[1:]
+        hs_end = hs[1:]
+        ws_end = ws[1:]
+
+    def fn(arr):
+        outs = []
+        idxs = []
+        for k in range(od):
+            plane_o, plane_i = [], []
+            for i in range(oh):
+                row_o, row_i = [], []
+                for j in range(ow):
+                    sl = arr[:, :, ds[k]:ds_end[k], hs[i]:hs_end[i],
+                             ws[j]:ws_end[j]]
+                    flat = sl.reshape(n, c, -1)
+                    row_o.append(jnp.max(flat, axis=2))
+                    amax = jnp.argmax(flat, axis=2)
+                    wd = ws_end[j] - ws[j]
+                    hd = hs_end[i] - hs[i]
+                    dd_ = amax // (hd * wd) + ds[k]
+                    rem = amax % (hd * wd)
+                    hh = rem // wd + hs[i]
+                    wwp = rem % wd + ws[j]
+                    row_i.append((dd_ * h + hh) * w + wwp)
+                plane_o.append(jnp.stack(row_o, axis=2))
+                plane_i.append(jnp.stack(row_i, axis=2))
+            outs.append(jnp.stack(plane_o, axis=2))
+            idxs.append(jnp.stack(plane_i, axis=2))
+        out = jnp.stack(outs, axis=2)
+        idx = jnp.stack(idxs, axis=2)
+        return out, idx.astype(jnp.int32)
+    out, idx = run_op("fractional_max_pool3d", fn, (x,),
+                      num_nondiff_outputs=1)
+    if return_mask:
+        return out, idx
+    return out
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2-D affine sampling grid (parity: F.affine_grid)."""
+    def fn(th):
+        n, _, h, w = [int(s) for s in out_shape] if len(out_shape) == 4 \
+            else (out_shape[0], 1, out_shape[1], out_shape[2])
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, w)
+            ys = jnp.linspace(-1.0, 1.0, h)
+        else:
+            xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+            ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+        gx, gy = jnp.meshgrid(xs, ys)  # (h, w)
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # (h, w, 3)
+        out = jnp.einsum("hwk,nok->nhwo", base, th)  # theta: (n, 2, 3)
+        return out
+    return run_op("affine_grid", fn, (theta,))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample NCHW input at normalized grid locations (parity:
+    F.grid_sample; bilinear/nearest, zeros/border/reflection padding).
+    Gathers + weighted sums — XLA fuses them into one kernel."""
+    def fn(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]  # (n, gh, gw)
+        if align_corners:
+            fx = (gx + 1) * 0.5 * (w - 1)
+            fy = (gy + 1) * 0.5 * (h - 1)
+        else:
+            fx = ((gx + 1) * w - 1) * 0.5
+            fy = ((gy + 1) * h - 1) * 0.5
+
+        def reflect(v, lo, hi):
+            rng_ = hi - lo
+            v = jnp.abs((v - lo) % (2 * rng_) - rng_) + lo \
+                if rng_ > 0 else jnp.zeros_like(v)
+            return v
+        if padding_mode == "reflection":
+            if align_corners:
+                fx = reflect(fx, 0.0, w - 1.0)
+                fy = reflect(fy, 0.0, h - 1.0)
+            else:
+                fx = reflect(fx + 0.5, 0.0, float(w)) - 0.5
+                fy = reflect(fy + 0.5, 0.0, float(h)) - 0.5
+                fx = jnp.clip(fx, 0, w - 1)
+                fy = jnp.clip(fy, 0, h - 1)
+
+        def gather(ix, iy):
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+            vals = a[jnp.arange(n)[:, None, None], :, iyc, ixc]
+            # vals: (n, gh, gw, c) -> (n, c, gh, gw)
+            vals = jnp.moveaxis(vals, -1, 1)
+            if padding_mode == "zeros":
+                valid = ((ix >= 0) & (ix <= w - 1) & (iy >= 0)
+                         & (iy <= h - 1))
+                vals = vals * valid[:, None, :, :]
+            return vals
+
+        if mode == "nearest":
+            return gather(jnp.round(fx).astype(jnp.int32),
+                          jnp.round(fy).astype(jnp.int32))
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = fx - x0
+        wy = fy - y0
+        v00 = gather(x0, y0)
+        v01 = gather(x1, y0)
+        v10 = gather(x0, y1)
+        v11 = gather(x1, y1)
+        wx_ = wx[:, None]
+        wy_ = wy[:, None]
+        return (v00 * (1 - wx_) * (1 - wy_) + v01 * wx_ * (1 - wy_)
+                + v10 * (1 - wx_) * wy_ + v11 * wx_ * wy_)
+    return run_op("grid_sample", fn, (x, grid))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM temporal channel shift (parity: F.temporal_shift)."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"unsupported data_format {data_format}")
+
+    def fn(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        back = jnp.concatenate(
+            [v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, fold:2 * fold]),
+             v[:, :-1, fold:2 * fold]], axis=1)
+        keep = v[:, :, 2 * fold:]
+        out = jnp.concatenate([back, fwd, keep], axis=2).reshape(
+            nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+    return run_op("temporal_shift", fn, (x,))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-CSR masked attention (parity: F.sparse_attention — the
+    reference is a CUDA-only kernel; here the CSR pattern gathers the
+    allowed keys per query row, softmaxes over just those, and scatters
+    back: O(nnz) memory like the original). key_padding_mask (B, S) and
+    attn_mask (B, S) follow the reference: 0 masks the position out."""
+    from ...core.tensor import Tensor as _T
+    kpm = key_padding_mask._data if isinstance(key_padding_mask, _T) \
+        else key_padding_mask
+    am = attn_mask._data if isinstance(attn_mask, _T) else attn_mask
+
+    def fn(q, k, v, off, cols, *masks):
+        b, h, m, d = q.shape
+        offs = off.astype(jnp.int32)
+        colz = cols.astype(jnp.int32)
+        mi = 0
+        kpm_ = masks[mi] if kpm is not None else None
+        mi += 1 if kpm is not None else 0
+        am_ = masks[mi] if am is not None else None
+
+        def per_bh(qb, kb, vb, ob, cb, kpm_b, am_b):
+            rows = jnp.searchsorted(ob, jnp.arange(cb.shape[0]),
+                                    side="right") - 1
+            qg = qb[rows]                      # (nnz, d)
+            kg = kb[cb]                        # (nnz, d)
+            logits = jnp.sum(qg * kg, axis=-1) / jnp.sqrt(float(d))
+            if kpm_b is not None:
+                logits = jnp.where(kpm_b[cb] == 0, -1e9, logits)
+            if am_b is not None:
+                logits = jnp.where(am_b[cb] == 0, -1e9, logits)
+            mx = jax.ops.segment_max(logits, rows, num_segments=qb.shape[0])
+            ex = jnp.exp(logits - mx[rows])
+            den = jax.ops.segment_sum(ex, rows, num_segments=qb.shape[0])
+            p = ex / den[rows]
+            vg = vb[cb] * p[:, None]
+            return jax.ops.segment_sum(vg, rows,
+                                       num_segments=qb.shape[0])
+        outs = []
+        for bi in range(b):
+            kpm_b = kpm_[bi] if kpm_ is not None else None
+            am_b = am_[bi] if am_ is not None else None
+            outs.append(jax.vmap(
+                lambda qb, kb, vb, ob, cb: per_bh(qb, kb, vb, ob, cb,
+                                                  kpm_b, am_b))(
+                q[bi], k[bi], v[bi], offs[bi], colz[bi]))
+        return jnp.stack(outs)
+    ops = [query, key, value, sparse_csr_offset, sparse_csr_columns]
+    if kpm is not None:
+        ops.append(key_padding_mask)
+    if am is not None:
+        ops.append(attn_mask)
+    return run_op("sparse_attention", fn, tuple(ops))
+

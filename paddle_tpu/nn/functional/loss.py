@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...core.dispatch import register_op_impl, run_op, select_impl
 from ...core.tensor import Tensor
@@ -17,7 +18,8 @@ __all__ = [
     "cosine_embedding_loss", "triplet_margin_loss", "poisson_nll_loss",
     "square_error_cost", "log_loss", "sigmoid_focal_loss", "dice_loss",
     "ctc_loss", "gaussian_nll_loss", "multi_label_soft_margin_loss",
-    "soft_margin_loss",
+    "soft_margin_loss", "multi_margin_loss", "triplet_margin_with_distance_loss",
+    "npair_loss", "hsigmoid_loss", "margin_cross_entropy", "rnnt_loss",
 ]
 
 
@@ -374,3 +376,216 @@ def soft_margin_loss(input, label, reduction="mean", name=None):
     return run_op("soft_margin_loss",
                   lambda z, y: _reduce(jnp.log1p(jnp.exp(-y * z)), reduction),
                   (input, label))
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """(parity: paddle.nn.functional.multi_margin_loss)"""
+    def fn(x, y, *w):
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, y[:, None].astype(jnp.int32),
+                                      axis=1)
+        m = jnp.maximum(margin - correct + x, 0.0) ** p
+        if w:
+            m = m * w[0][y.astype(jnp.int32)][:, None]
+        mask = jax.nn.one_hot(y.astype(jnp.int32), c, dtype=x.dtype)
+        loss = jnp.sum(m * (1 - mask), axis=1) / c
+        return _reduce(loss, reduction)
+    ops = (input, label) + ((weight,) if weight is not None else ())
+    return run_op("multi_margin_loss", fn, ops)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """(parity: F.triplet_margin_with_distance_loss)"""
+    from ...core.tensor import Tensor as _T
+    if distance_function is None:
+        def distance_function(a, b):
+            diff = a - b
+            return (diff * diff).sum(axis=-1).sqrt() \
+                if isinstance(diff, _T) else jnp.sqrt(
+                    jnp.sum(diff * diff, axis=-1))
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    if swap:
+        d_pn = distance_function(positive, negative)
+        from ...tensor.math import minimum
+        d_neg = minimum(d_neg, d_pn)
+
+    def fn(dp, dn):
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return run_op("triplet_margin_with_distance_loss", fn, (d_pos, d_neg))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """(parity: F.npair_loss — cross entropy over anchor @ positive.T plus
+    l2 on embeddings, python/paddle/nn/functional/loss.py)"""
+    def fn(a, pos, y):
+        reg = jnp.mean(jnp.sum(a * a, axis=1)) \
+            + jnp.mean(jnp.sum(pos * pos, axis=1))
+        reg = reg * 0.25 * l2_reg * a.shape[0]
+        sim = a @ pos.T  # (B, B)
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = same / jnp.sum(same, axis=1, keepdims=True)
+        xent = jnp.mean(jnp.sum(
+            -tgt * jax.nn.log_softmax(sim, axis=1), axis=1))
+        return xent + reg
+    return run_op("npair_loss", fn, (anchor, positive, labels))
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (parity: F.hsigmoid_loss). Default tree =
+    complete binary tree over num_classes leaves (the reference kernel's
+    layout: internal node ids code the path; code bits decide sign)."""
+    if path_table is None:
+        # heap-encoded complete binary tree (the reference kernel's
+        # default layout, paddle/phi/kernels/funcs/matrix_bit_code.h):
+        # leaf for class c is heap node c + num_classes; internal nodes
+        # are 1..num_classes-1 (weight row = node - 1 -> C-1 rows);
+        # padded with -1 to the max path length.
+        paths, codes = [], []
+        for c in range(num_classes):
+            leaf = c + num_classes
+            path, code = [], []
+            node = leaf
+            while node > 1:
+                path.append(node // 2 - 1)  # internal row, 0-indexed
+                code.append(node & 1)
+                node //= 2
+            paths.append(list(reversed(path)))
+            codes.append(list(reversed(code)))
+        depth = max(len(p_) for p_ in paths)
+        pt = jnp.asarray([p_ + [-1] * (depth - len(p_)) for p_ in paths],
+                         jnp.int32)
+        pc = jnp.asarray([c_ + [0] * (depth - len(c_)) for c_ in codes],
+                         jnp.float32)
+
+        def fn(x, y, w, *bb):
+            yi = y.astype(jnp.int32).reshape(-1)
+            nodes = pt[yi]          # (B, D) internal rows, -1 = pad
+            code = pc[yi]           # (B, D) 0/1
+            valid = (nodes >= 0).astype(x.dtype)
+            safe_nodes = jnp.maximum(nodes, 0)
+            wv = w[safe_nodes]      # (B, D, F)
+            logits = jnp.einsum("bdf,bf->bd", wv, x)
+            if bb:
+                logits = logits + bb[0][safe_nodes]
+            # P(step) = sigmoid(logit) if bit==0 else sigmoid(-logit)
+            sgn = 1.0 - 2.0 * code
+            loss = -(jax.nn.log_sigmoid(sgn * logits) * valid).sum(axis=1)
+            return loss[:, None]
+        ops = (input, label, weight) + ((bias,) if bias is not None else ())
+        return run_op("hsigmoid_loss", fn, ops)
+
+    def fn(x, y, w, pt_, pc_, *bb):
+        pt_i = pt_.astype(jnp.int32)
+        valid = (pt_i >= 0).astype(x.dtype)
+        nodes = jnp.maximum(pt_i, 0)
+        wv = w[nodes]
+        logits = jnp.einsum("bdf,bf->bd", wv, x)
+        if bb:
+            logits = logits + bb[0][nodes]
+        sgn = 1.0 - 2.0 * pc_.astype(x.dtype)
+        loss = -(jax.nn.log_sigmoid(sgn * logits) * valid).sum(axis=1)
+        return loss[:, None]
+    ops = (input, label, weight, path_table, path_code) + \
+        ((bias,) if bias is not None else ())
+    return run_op("hsigmoid_loss", fn, ops)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-class margin softmax (parity: F.margin_cross_entropy,
+    reference margin_cross_entropy op: cos(m1*theta + m2) - m3 on the
+    target logit, then scaled softmax CE)."""
+    def fn(lg, y):
+        yi = y.astype(jnp.int32).reshape(-1)
+        tgt = jnp.take_along_axis(lg, yi[:, None], axis=1)[:, 0]
+        tgt = jnp.clip(tgt, -1.0, 1.0)
+        theta = jnp.arccos(tgt)
+        m_tgt = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(yi, lg.shape[1], dtype=lg.dtype)
+        adj = lg * (1 - onehot) + m_tgt[:, None] * onehot
+        adj = adj * scale
+        logp = jax.nn.log_softmax(adj, axis=1)
+        loss = -jnp.take_along_axis(logp, yi[:, None], axis=1)
+        sm = jnp.exp(logp)
+        if reduction == "mean":
+            lo = jnp.mean(loss)
+        elif reduction == "sum":
+            lo = jnp.sum(loss)
+        else:
+            lo = loss
+        return lo, sm
+    loss, sm = run_op("margin_cross_entropy", fn, (logits, label))
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (parity: F.rnnt_loss over the warprnnt kernel).
+
+    input: (B, T, U+1, V) log-probs or logits; label: (B, U). Forward
+    log-alpha DP over the (T, U+1) lattice, one lax.scan over T with an
+    inner scan over U (XLA compiles both to fused loops). FastEmit
+    (fastemit_lambda > 0) scales the label-emission gradient by
+    (1 + lambda) without changing the loss value — the warprnnt
+    implementation's contract — via a value-neutral second DP whose
+    blank terms carry stop_gradient."""
+    def _forward_ll(blank_lp, lab_lp, tlen, ulen):
+        B, T, U1 = blank_lp.shape
+
+        def first_row(carry, u):
+            a = carry + lab_lp[:, 0, u - 1]
+            return a, a
+        a00 = jnp.zeros((B,))
+        _, rest = jax.lax.scan(first_row, a00, jnp.arange(1, U1))
+        alpha0 = jnp.concatenate([a00[None], rest], axis=0).T
+
+        def step(alpha_prev, t):
+            top = alpha_prev + blank_lp[:, t - 1, :]
+
+            def inner(carry, u):
+                cand = jnp.logaddexp(top[:, u],
+                                     carry + lab_lp[:, t, u - 1])
+                return cand, cand
+            a_t0 = top[:, 0]
+            _, rest_t = jax.lax.scan(inner, a_t0, jnp.arange(1, U1))
+            alpha_t = jnp.concatenate([a_t0[None], rest_t], axis=0).T
+            return alpha_t, alpha_t
+        _, alphas = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)
+        alphas = jnp.transpose(alphas, (1, 0, 2))
+        ti = tlen.astype(jnp.int32) - 1
+        ui = ulen.astype(jnp.int32)
+        a_final = alphas[jnp.arange(B), ti, ui]
+        final_blank = blank_lp[jnp.arange(B), ti, ui]
+        return a_final + final_blank
+
+    def fn(acts, lab, tlen, ulen):
+        B, T, U1, V = acts.shape
+        logp = jax.nn.log_softmax(acts, axis=-1)
+        blank_lp = logp[..., blank]                      # (B, T, U1)
+        lab_i = lab.astype(jnp.int32)
+        lab_lp = jnp.take_along_axis(
+            logp[:, :, :-1, :], lab_i[:, None, :, None], axis=3)[..., 0]
+        # pad label-emission row so both have U1 columns
+        lab_lp = jnp.pad(lab_lp, ((0, 0), (0, 0), (0, 1)),
+                         constant_values=-1e30)          # (B, T, U1)
+        ll = _forward_ll(blank_lp, lab_lp, tlen, ulen)
+        loss = -ll
+        if fastemit_lambda:
+            ll_fe = _forward_ll(jax.lax.stop_gradient(blank_lp), lab_lp,
+                                tlen, ulen)
+            loss = loss - fastemit_lambda * (
+                ll_fe - jax.lax.stop_gradient(ll_fe))
+        return _reduce(loss, reduction)
+    return run_op("rnnt_loss", fn, (input, label, input_lengths,
+                                    label_lengths))

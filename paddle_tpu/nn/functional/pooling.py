@@ -22,12 +22,19 @@ def _tup(v, n):
 
 
 def _pool(name, ndim, x, kernel_size, stride, padding, reducer, init,
-          ceil_mode, data_format, count_include_pad=True, exclusive=True):
+          ceil_mode, data_format, count_include_pad=True, exclusive=True,
+          return_mask=False):
     n = ndim
     ks = _tup(kernel_size, n)
     st = _tup(stride if stride is not None else kernel_size, n)
     pd = _tup(padding, n)
     cf = data_format.startswith("NC")
+    if return_mask and reducer == "max":
+        if not cf:
+            raise ValueError(
+                f"{name}: return_mask=True requires a channels-first "
+                "data_format")
+        return _max_pool_with_mask(name, n, x, ks, st, pd)
 
     def fn(a):
         if cf:
@@ -55,22 +62,69 @@ def _pool(name, ndim, x, kernel_size, stride, padding, reducer, init,
     return run_op(name, fn, (x,))
 
 
+def _max_pool_with_mask(name, ndim, x, ks, st, pd):
+    """Max pool that also returns flat argmax indices over the input's
+    spatial dims (the contract max_unpool consumes; parity: the
+    reference's max_pool*d return_mask=True kernels). The value output is
+    the ordinary differentiable reduce_window; the index output is a
+    separate non-taped variadic reduce (vjp of variadic reduce_window
+    with an integer carry is unsupported)."""
+    window = (1, 1) + ks
+    strides = (1, 1) + st
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+
+    def val_fn(a):
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            neg = jnp.asarray(-jnp.inf, a.dtype)
+        else:
+            neg = jnp.asarray(jnp.iinfo(a.dtype).min, a.dtype)
+        return jax.lax.reduce_window(a, neg, jax.lax.max, window, strides,
+                                     pads)
+
+    def idx_fn(a):
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            neg = jnp.asarray(-jnp.inf, a.dtype)
+        else:
+            neg = jnp.asarray(jnp.iinfo(a.dtype).min, a.dtype)
+        spatial = a.shape[2:]
+        flat_sp = int(np.prod(spatial))
+        pos = jnp.arange(flat_sp).reshape((1, 1) + tuple(spatial))
+        pos = jnp.broadcast_to(pos, a.shape).astype(jnp.int32)
+
+        def reducer(x_, y_):
+            take_y = y_[0] > x_[0]
+            return (jax.lax.select(take_y, y_[0], x_[0]),
+                    jax.lax.select(take_y, y_[1], x_[1]))
+
+        _, idx = jax.lax.reduce_window(
+            (a, pos), (neg, jnp.int32(-1)), reducer, window, strides, pads)
+        return idx
+
+    out = run_op(name, val_fn, (x,))
+    from ...core.tensor import Tensor as _T
+    xd = x.detach() if isinstance(x, _T) else x
+    idx = run_op(name + "_mask", idx_fn, (xd,), out_stop_gradient=True)
+    return out, idx
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     return _pool("max_pool1d", 1, x, kernel_size, stride, padding, "max",
-                 None, ceil_mode, "NCW" if data_format in ("NCL", "NCW") else "NWC")
+                 None, ceil_mode,
+                 "NCW" if data_format in ("NCL", "NCW") else "NWC",
+                 return_mask=return_mask)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
     return _pool("max_pool2d", 2, x, kernel_size, stride, padding, "max",
-                 None, ceil_mode, data_format)
+                 None, ceil_mode, data_format, return_mask=return_mask)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     return _pool("max_pool3d", 3, x, kernel_size, stride, padding, "max",
-                 None, ceil_mode, data_format)
+                 None, ceil_mode, data_format, return_mask=return_mask)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,

@@ -7,3 +7,5 @@ from .pooling import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .container import *  # noqa: F401,F403
 from .transformer import *  # noqa: F401,F403
+from .rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN,  # noqa: F401
+                  BiRNN, SimpleRNN, LSTM, GRU)

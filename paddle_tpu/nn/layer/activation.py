@@ -9,7 +9,7 @@ __all__ = ["ReLU", "ReLU6", "GELU", "SiLU", "Swish", "Sigmoid", "Tanh",
            "LeakyReLU", "ELU", "CELU", "SELU", "PReLU", "RReLU", "Hardshrink",
            "Hardsigmoid", "Hardswish", "Hardtanh", "LogSigmoid", "LogSoftmax",
            "Softmax", "Softplus", "Softshrink", "Softsign", "Mish",
-           "Tanhshrink", "ThresholdedReLU", "GLU", "Maxout"]
+           "Tanhshrink", "ThresholdedReLU", "GLU", "Maxout", "Softmax2D", "Silu"]
 
 
 def _simple(name, fn_name, **fixed):
@@ -191,3 +191,28 @@ class Maxout(Layer):
 
     def forward(self, x):
         return F.maxout(x, self._groups, self._axis)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input (parity:
+    paddle.nn.Softmax2D)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        assert x.ndim in (3, 4), "Softmax2D expects 3-D or 4-D input"
+        from .. import functional as F
+        return F.softmax(x, axis=-3)
+
+
+class Silu(Layer):
+    """Alias of SiLU with the reference's class name (parity:
+    paddle.nn.Silu)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        from .. import functional as F
+        return F.silu(x)

@@ -9,7 +9,10 @@ from .layers import Layer
 __all__ = ["Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D",
            "AlphaDropout", "Flatten", "Identity", "Pad1D", "Pad2D", "Pad3D",
            "Upsample", "UpsamplingBilinear2D", "UpsamplingNearest2D",
-           "CosineSimilarity", "Bilinear", "Unfold", "Fold"]
+           "CosineSimilarity", "Bilinear", "Unfold", "Fold", "PairwiseDistance",
+           "PixelShuffle", "PixelUnshuffle", "ChannelShuffle", "ZeroPad2D",
+           "Unflatten", "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+           "FractionalMaxPool2D", "FractionalMaxPool3D"]
 
 
 class Linear(Layer):
@@ -235,3 +238,165 @@ class Fold(Layer):
 
     def forward(self, input):
         return F.fold(input, self.output_sizes, *self.args)
+
+
+class PairwiseDistance(Layer):
+    """(parity: paddle.nn.PairwiseDistance)"""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class PixelShuffle(Layer):
+    """(parity: paddle.nn.PixelShuffle)"""
+
+    def __init__(self, upscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor, self.data_format)
+
+
+class PixelUnshuffle(Layer):
+    """(parity: paddle.nn.PixelUnshuffle)"""
+
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.downscale_factor = downscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.downscale_factor, self.data_format)
+
+
+class ChannelShuffle(Layer):
+    """(parity: paddle.nn.ChannelShuffle)"""
+
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class ZeroPad2D(Layer):
+    """(parity: paddle.nn.ZeroPad2D)"""
+
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.zeropad2d(x, self.padding, self.data_format)
+
+
+class Unflatten(Layer):
+    """(parity: paddle.nn.Unflatten)"""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = shape
+
+    def forward(self, x):
+        from ...tensor.manipulation import unflatten as _unf
+        return _unf(x, self.axis, self.shape)
+
+
+class MaxUnPool1D(Layer):
+    """(parity: paddle.nn.MaxUnPool1D)"""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format,
+                              self.output_size)
+
+
+class MaxUnPool2D(Layer):
+    """(parity: paddle.nn.MaxUnPool2D)"""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format,
+                              self.output_size)
+
+
+class MaxUnPool3D(Layer):
+    """(parity: paddle.nn.MaxUnPool3D)"""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format,
+                              self.output_size)
+
+
+class FractionalMaxPool2D(Layer):
+    """(parity: paddle.nn.FractionalMaxPool2D)"""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.kernel_size = kernel_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size,
+                                       self.kernel_size, self.random_u,
+                                       self.return_mask)
+
+
+class FractionalMaxPool3D(Layer):
+    """(parity: paddle.nn.FractionalMaxPool3D)"""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.kernel_size = kernel_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size,
+                                       self.kernel_size, self.random_u,
+                                       self.return_mask)

@@ -1,0 +1,462 @@
+"""Recurrent layers (parity: python/paddle/nn/layer/rnn.py — RNNCellBase,
+SimpleRNNCell :852, LSTMCell :1039, GRUCell :1234, RNN :1327, BiRNN :1342,
+SimpleRNN/LSTM/GRU multi-layer stacks).
+
+TPU-first design: the time loop is ONE ``jax.lax.scan`` per layer inside a
+single dispatched op, so the whole sequence compiles to a fused XLA while
+loop (weights enter as differentiable operands; grads come from vjp-of-scan).
+A Python per-step loop of tape ops — the eager equivalent of the reference's
+C++ loop — would trace seq_len copies of the cell; scan traces one.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor
+from ..initializer import Uniform
+from .layers import Layer
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+def _std_init(hidden_size):
+    k = 1.0 / math.sqrt(hidden_size)
+    return Uniform(-k, k)
+
+
+class RNNCellBase(Layer):
+    """Base for single-step recurrent cells (parity: RNNCellBase —
+    provides get_initial_states)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        shape = shape if shape is not None else self.state_shape
+        batch = batch_ref.shape[batch_dim_idx]
+
+        def build(s):
+            if isinstance(s, (list, tuple)) and s and \
+                    isinstance(s[0], (list, tuple)):
+                return tuple(build(sub) for sub in s)
+            dims = [batch] + [d for d in (s if isinstance(s, (list, tuple))
+                                          else [s])]
+            return Tensor(jnp.full(dims, init_value, jnp.float32))
+        return build(shape)
+
+    # subclasses define: forward(inputs, states) -> (out, new_states),
+    # plus a pure `_step(params_dict, x, states)` used by the scan runner.
+
+
+class SimpleRNNCell(RNNCellBase):
+    """h' = act(W_ih x + b_ih + W_hh h + b_hh) (ref rnn.py:852)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        if activation not in ("tanh", "relu"):
+            raise ValueError(f"activation must be tanh or relu: {activation}")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        init = _std_init(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = None if bias_ih_attr is False else \
+            self.create_parameter([hidden_size], attr=bias_ih_attr,
+                                  is_bias=True, default_initializer=init)
+        self.bias_hh = None if bias_hh_attr is False else \
+            self.create_parameter([hidden_size], attr=bias_hh_attr,
+                                  is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def _params(self):
+        return [p for p in (self.weight_ih, self.weight_hh, self.bias_ih,
+                            self.bias_hh) if p is not None]
+
+    def _step(self, arrs, x, states):
+        w_ih, w_hh = arrs[0], arrs[1]
+        b = arrs[2:]
+        h = states if not isinstance(states, tuple) else states[0]
+        z = x @ w_ih.T + h @ w_hh.T
+        for bias in b:
+            z = z + bias
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        h2 = act(z)
+        return h2, h2
+
+    def _state_tuple(self):
+        return False
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = run_op(
+            "simple_rnn_cell",
+            lambda x, h, *ps: self._step(ps, x, h)[0],
+            (inputs, states, *self._params()))
+        return out, out
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class LSTMCell(RNNCellBase):
+    """Gate order [i, f, g, o] over 4H rows (ref rnn.py:1039)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        if proj_size:
+            raise NotImplementedError(
+                "LSTMCell proj_size is not supported yet")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _std_init(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = None if bias_ih_attr is False else \
+            self.create_parameter([4 * hidden_size], attr=bias_ih_attr,
+                                  is_bias=True, default_initializer=init)
+        self.bias_hh = None if bias_hh_attr is False else \
+            self.create_parameter([4 * hidden_size], attr=bias_hh_attr,
+                                  is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def _params(self):
+        return [p for p in (self.weight_ih, self.weight_hh, self.bias_ih,
+                            self.bias_hh) if p is not None]
+
+    def _state_tuple(self):
+        return True
+
+    def _step(self, arrs, x, states):
+        w_ih, w_hh = arrs[0], arrs[1]
+        b = arrs[2:]
+        h, c = states
+        gates = x @ w_ih.T + h @ w_hh.T
+        for bias in b:
+            gates = gates + bias
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        o = jax.nn.sigmoid(o)
+        c2 = f * c + i * jnp.tanh(g)
+        h2 = o * jnp.tanh(c2)
+        return h2, (h2, c2)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        h2, c2 = run_op(
+            "lstm_cell",
+            lambda x, hh, cc, *ps: self._step(ps, x, (hh, cc))[1],
+            (inputs, h, c, *self._params()))
+        return h2, (h2, c2)
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class GRUCell(RNNCellBase):
+    """Gate order [r, z, c] over 3H rows; h' = (h - c)*z + c
+    (ref rnn.py:1234)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _std_init(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = None if bias_ih_attr is False else \
+            self.create_parameter([3 * hidden_size], attr=bias_ih_attr,
+                                  is_bias=True, default_initializer=init)
+        self.bias_hh = None if bias_hh_attr is False else \
+            self.create_parameter([3 * hidden_size], attr=bias_hh_attr,
+                                  is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def _params(self):
+        return [p for p in (self.weight_ih, self.weight_hh, self.bias_ih,
+                            self.bias_hh) if p is not None]
+
+    def _state_tuple(self):
+        return False
+
+    def _step(self, arrs, x, states):
+        w_ih, w_hh = arrs[0], arrs[1]
+        h = states if not isinstance(states, tuple) else states[0]
+        xg = x @ w_ih.T
+        hg = h @ w_hh.T
+        if len(arrs) > 2:
+            xg = xg + arrs[2]
+        if len(arrs) > 3:
+            hg = hg + arrs[3]
+        x_r, x_z, x_c = jnp.split(xg, 3, axis=-1)
+        h_r, h_z, h_c = jnp.split(hg, 3, axis=-1)
+        r = jax.nn.sigmoid(x_r + h_r)
+        z = jax.nn.sigmoid(x_z + h_z)
+        c = jnp.tanh(x_c + r * h_c)
+        h2 = (h - c) * z + c
+        return h2, h2
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = run_op(
+            "gru_cell",
+            lambda x, h, *ps: self._step(ps, x, h)[0],
+            (inputs, states, *self._params()))
+        return out, out
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+def _scan_layer(cell, xs, init, params, reverse=False, mask=None):
+    """Run one cell over time with a single lax.scan.
+
+    xs: (T, B, I) time-major array; init: state pytree of arrays;
+    params: list of weight arrays (diff operands). mask: optional (T, B)
+    validity mask from sequence_length — invalid steps carry state through
+    (the reference's sequence_length contract).
+    Returns (outs (T,B,H), final_state pytree).
+    """
+    tuple_state = cell._state_tuple()
+
+    def fn(xarr, marr, *arrs):
+        n_state = 2 if tuple_state else 1
+        st0 = tuple(arrs[:n_state])
+        ws = arrs[n_state:]
+        state0 = st0 if tuple_state else st0[0]
+
+        def step(carry, inp):
+            x_t, m_t = inp
+            out, new_state = cell._step(ws, x_t, carry)
+            if m_t is not None:
+                keep = m_t[:, None]
+                if tuple_state:
+                    new_state = tuple(
+                        jnp.where(keep, ns, cs)
+                        for ns, cs in zip(new_state, carry))
+                else:
+                    new_state = jnp.where(keep, new_state, carry)
+                out = jnp.where(keep, out, jnp.zeros_like(out))
+            return new_state, out
+
+        if marr is None:
+            final, outs = jax.lax.scan(
+                lambda c, x_t: step(c, (x_t, None)), state0, xarr,
+                reverse=reverse)
+        else:
+            final, outs = jax.lax.scan(step, state0, (xarr, marr),
+                                       reverse=reverse)
+        if tuple_state:
+            return (outs, *final)
+        return (outs, final)
+
+    init_ops = list(init) if tuple_state else [init]
+    if mask is not None:
+        res = run_op("rnn_scan", lambda x, m, *a: fn(x, m, *a),
+                     (xs, mask, *init_ops, *params))
+    else:
+        res = run_op("rnn_scan", lambda x, *a: fn(x, None, *a),
+                     (xs, *init_ops, *params))
+    outs = res[0]
+    final = tuple(res[1:]) if tuple_state else res[1]
+    return outs, final
+
+
+class RNN(Layer):
+    """Wrap a cell into a sequence runner (parity: paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs
+        if not self.time_major:
+            x = x.transpose([1, 0, 2])
+        if initial_states is None:
+            batch_ref_axis = 1  # x is (T, B, I) now
+            initial_states = self.cell.get_initial_states(
+                x, batch_dim_idx=batch_ref_axis)
+        mask = None
+        if sequence_length is not None:
+            T = x.shape[0]
+            sl = sequence_length._data if isinstance(sequence_length, Tensor) \
+                else jnp.asarray(sequence_length)
+            mask = Tensor((jnp.arange(T)[:, None] < sl[None, :]))
+        outs, final = _scan_layer(self.cell, x, initial_states,
+                                  self.cell._params(),
+                                  reverse=self.is_reverse, mask=mask)
+        if not self.time_major:
+            outs = outs.transpose([1, 0, 2])
+        return outs, final
+
+
+class BiRNN(Layer):
+    """Forward + backward cells, outputs concatenated (parity: nn.BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        st_fw = st_bw = None
+        if initial_states is not None:
+            st_fw, st_bw = initial_states
+        out_f, f_fw = self.rnn_fw(inputs, st_fw, sequence_length)
+        out_b, f_bw = self.rnn_bw(inputs, st_bw, sequence_length)
+        from ...tensor.manipulation import concat
+        return concat([out_f, out_b], axis=-1), (f_fw, f_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) stack shared by
+    SimpleRNN/LSTM/GRU (parity: the reference's RNNBase, rnn.py:1352)."""
+
+    CELL = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation=None, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction in ("bidirect", "bidirectional"):
+            self.num_directions = 2
+        elif direction == "forward":
+            self.num_directions = 1
+        else:
+            raise ValueError(f"direction must be forward or bidirect, "
+                             f"got {direction}")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.direction = direction
+        kw = dict(weight_ih_attr=weight_ih_attr,
+                  weight_hh_attr=weight_hh_attr, bias_ih_attr=bias_ih_attr,
+                  bias_hh_attr=bias_hh_attr)
+        if activation is not None:
+            kw["activation"] = activation
+        from .container import LayerList
+        self.layers = LayerList()
+        for l in range(num_layers):
+            in_sz = input_size if l == 0 \
+                else hidden_size * self.num_directions
+            fw = type(self)._make_cell(in_sz, hidden_size, kw)
+            if self.num_directions == 2:
+                bw = type(self)._make_cell(in_sz, hidden_size, kw)
+                self.layers.append(BiRNN(fw, bw, time_major=True))
+            else:
+                self.layers.append(RNN(fw, time_major=True))
+
+    @classmethod
+    def _make_cell(cls, in_sz, hidden, kw):
+        return cls.CELL(in_sz, hidden, **kw)
+
+    @property
+    def _tuple_state(self):
+        return self.CELL is LSTMCell
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs
+        if not self.time_major:
+            x = x.transpose([1, 0, 2])
+        L, D = self.num_layers, self.num_directions
+        # initial_states: (h0[, c0]) with shape (L*D, B, H)
+        per_layer = [None] * (L * D)
+        if initial_states is not None:
+            if self._tuple_state:
+                h0, c0 = initial_states
+                for i in range(L * D):
+                    per_layer[i] = (h0[i], c0[i])
+            else:
+                for i in range(L * D):
+                    per_layer[i] = initial_states[i]
+        finals = []
+        out = x
+        for l, runner in enumerate(self.layers):
+            if D == 2:
+                st = None
+                if per_layer[2 * l] is not None:
+                    st = (per_layer[2 * l], per_layer[2 * l + 1])
+                out, (f_fw, f_bw) = runner(out, st, sequence_length)
+                finals.extend([f_fw, f_bw])
+            else:
+                out, f = runner(out, per_layer[l], sequence_length)
+                finals.append(f)
+            if self.dropout and l < L - 1 and self.training:
+                from .. import functional as F
+                out = F.dropout(out, p=self.dropout, training=True)
+        from ...tensor.manipulation import stack
+        if self._tuple_state:
+            h = stack([f[0] for f in finals], axis=0)
+            c = stack([f[1] for f in finals], axis=0)
+            final = (h, c)
+        else:
+            final = stack(finals, axis=0)
+        if not self.time_major:
+            out = out.transpose([1, 0, 2])
+        return out, final
+
+
+class SimpleRNN(_RNNBase):
+    """(parity: paddle.nn.SimpleRNN)"""
+    CELL = SimpleRNNCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation=activation, **kw)
+
+
+class LSTM(_RNNBase):
+    """(parity: paddle.nn.LSTM)"""
+    CELL = LSTMCell
+
+
+class GRU(_RNNBase):
+    """(parity: paddle.nn.GRU)"""
+    CELL = GRUCell

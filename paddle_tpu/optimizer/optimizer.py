@@ -16,12 +16,13 @@ from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor
 from ..nn.clip import ClipGradBase
 from .lr import LRScheduler
 
-__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "RMSProp", "Adam",
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "RMSProp", "Adam", "Rprop", "LBFGS",
            "AdamW", "Adamax", "Lamb", "Adadelta"]
 
 
@@ -508,3 +509,216 @@ class Lamb(Optimizer):
         ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         return param - lr * ratio * r, \
             {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (parity: paddle.optimizer.Rprop — per-element
+    step sizes grown/shrunk by gradient sign agreement; reference
+    python/paddle/optimizer/rprop.py)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         name, multi_precision)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _init_state(self, p):
+        return {
+            "prev_grad": jnp.zeros_like(p._data, dtype=jnp.float32),
+            "lr": jnp.full(p._data.shape, float(self._base_lr_value()),
+                           jnp.float32),
+        }
+
+    def _base_lr_value(self):
+        lr = self._learning_rate
+        return lr if isinstance(lr, float) else lr()
+
+    def _update(self, param, grad, state, lr, wd=0.0):
+        eta_neg, eta_pos = self._etas
+        lo, hi = self._lr_range
+        sign = jnp.sign(grad * state["prev_grad"])
+        factor = jnp.where(sign > 0, eta_pos,
+                           jnp.where(sign < 0, eta_neg, 1.0))
+        new_lr = jnp.clip(state["lr"] * factor, lo, hi)
+        # on sign flip the reference zeroes the step and the stored grad
+        step_grad = jnp.where(sign < 0, 0.0, grad)
+        new_param = param - jnp.sign(step_grad) * new_lr
+        return new_param, {"prev_grad": step_grad, "lr": new_lr}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with strong-Wolfe line search (parity:
+    paddle.optimizer.LBFGS, reference python/paddle/optimizer/lbfgs.py).
+
+    Full-batch second-order method: ``step(closure)`` re-evaluates the
+    loss/gradients through the closure, matching the reference contract.
+    History is kept on host; the directional math is vectorized XLA.
+    """
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, False)
+        self._max_iter = max_iter
+        self._max_eval = max_eval if max_eval is not None \
+            else max_iter * 5 // 4
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = history_size
+        self._line_search_fn = line_search_fn
+        self._s_hist = []
+        self._y_hist = []
+        self._rho = []
+        self._prev_flat_grad = None
+
+    def _flat(self, arrays):
+        return jnp.concatenate([a.reshape(-1) for a in arrays])
+
+    def _gather(self):
+        params = [p for p in self._parameter_list]
+        flat_p = self._flat([p._data.astype(jnp.float32) for p in params])
+        if self._grad_clip is not None:
+            pg = [(p, p.grad) for p in params if p.grad is not None]
+            clipped = dict(
+                (id(p), g) for p, g in self._grad_clip(pg))
+        else:
+            clipped = None
+        grads = []
+        for p in params:
+            g = p.grad if clipped is None else clipped.get(id(p), p.grad)
+            garr = jnp.zeros_like(p._data, jnp.float32) if g is None \
+                else g._data.astype(jnp.float32)
+            decay = self._decay_of(p)
+            if decay:
+                garr = garr + decay * p._data.astype(jnp.float32)
+            grads.append(garr)
+        flat_g = self._flat(grads)
+        return params, flat_p, flat_g
+
+    def _scatter(self, params, flat_p):
+        off = 0
+        for p in params:
+            n = int(np.prod(p._data.shape)) if p._data.shape else 1
+            chunk = flat_p[off:off + n].reshape(p._data.shape)
+            p._data = chunk.astype(p._data.dtype)
+            off += n
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure that "
+                             "re-evaluates the model and returns the loss")
+        lr = self._learning_rate if isinstance(self._learning_rate, float) \
+            else self._learning_rate()
+        loss = closure()
+        params, flat_p, flat_g = self._gather()
+        n_eval = 1
+        for it in range(self._max_iter):
+            if float(jnp.max(jnp.abs(flat_g))) <= self._tol_grad:
+                break
+            # two-loop recursion
+            q = -flat_g
+            alphas = []
+            for s, y, rho in zip(reversed(self._s_hist),
+                                 reversed(self._y_hist),
+                                 reversed(self._rho)):
+                a = rho * jnp.dot(s, q)
+                alphas.append(a)
+                q = q - a * y
+            if self._y_hist:
+                y_last = self._y_hist[-1]
+                s_last = self._s_hist[-1]
+                gamma = jnp.dot(s_last, y_last) / jnp.maximum(
+                    jnp.dot(y_last, y_last), 1e-10)
+                q = q * gamma
+            for (s, y, rho), a in zip(zip(self._s_hist, self._y_hist,
+                                          self._rho), reversed(alphas)):
+                b = rho * jnp.dot(y, q)
+                q = q + (a - b) * s
+            direction = q
+            gtd = float(jnp.dot(flat_g, direction))
+            if gtd > -1e-15:
+                direction = -flat_g
+                gtd = float(jnp.dot(flat_g, direction))
+            t = lr if it > 0 or self._s_hist else \
+                min(1.0, 1.0 / max(float(jnp.sum(jnp.abs(flat_g))), 1e-10)) \
+                * lr
+            if self._line_search_fn == "strong_wolfe":
+                t, loss, flat_g_new, evals = self._strong_wolfe(
+                    closure, params, flat_p, float(loss), flat_g,
+                    direction, t, gtd)
+                n_eval += evals
+            else:
+                self._scatter(params, flat_p + t * direction)
+                loss = closure()
+                n_eval += 1
+                _, _, flat_g_new = self._gather()
+            flat_p_new = flat_p + t * direction
+            self._scatter(params, flat_p_new)
+            s = flat_p_new - flat_p
+            y = flat_g_new - flat_g
+            sy = float(jnp.dot(s, y))
+            if sy > 1e-10:
+                self._s_hist.append(s)
+                self._y_hist.append(y)
+                self._rho.append(1.0 / sy)
+                if len(self._s_hist) > self._history:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+                    self._rho.pop(0)
+            if float(jnp.max(jnp.abs(s))) <= self._tol_change:
+                flat_p, flat_g = flat_p_new, flat_g_new
+                break
+            flat_p, flat_g = flat_p_new, flat_g_new
+            if n_eval >= self._max_eval:
+                break
+        return loss
+
+    def _strong_wolfe(self, closure, params, flat_p, f0, g0, d, t, gtd0,
+                      c1=1e-4, c2=0.9, max_ls=25):
+        """Bracketing strong-Wolfe line search (reference lbfgs.py
+        _strong_wolfe)."""
+        evals = 0
+        f_prev, t_prev = f0, 0.0
+        g_prev = g0
+        for ls in range(max_ls):
+            self._scatter(params, flat_p + t * d)
+            f_new = float(closure())
+            _, _, g_new = self._gather()
+            evals += 1
+            gtd_new = float(jnp.dot(g_new, d))
+            if f_new > f0 + c1 * t * gtd0 or (ls > 0 and f_new >= f_prev):
+                return self._zoom(closure, params, flat_p, f0, gtd0, d,
+                                  t_prev, t, f_prev, f_new, c1, c2,
+                                  evals)
+            if abs(gtd_new) <= -c2 * gtd0:
+                return t, f_new, g_new, evals
+            if gtd_new >= 0:
+                return self._zoom(closure, params, flat_p, f0, gtd0, d,
+                                  t, t_prev, f_new, f_prev, c1, c2,
+                                  evals)
+            t_prev, f_prev, g_prev = t, f_new, g_new
+            t = t * 2.0
+        return t, f_new, g_new, evals
+
+    def _zoom(self, closure, params, flat_p, f0, gtd0, d, t_lo, t_hi,
+              f_lo, f_hi, c1, c2, evals, max_zoom=10):
+        for _ in range(max_zoom):
+            t = 0.5 * (t_lo + t_hi)
+            self._scatter(params, flat_p + t * d)
+            f_new = float(closure())
+            _, _, g_new = self._gather()
+            evals += 1
+            gtd_new = float(jnp.dot(g_new, d))
+            if f_new > f0 + c1 * t * gtd0 or f_new >= f_lo:
+                t_hi, f_hi = t, f_new
+            else:
+                if abs(gtd_new) <= -c2 * gtd0:
+                    return t, f_new, g_new, evals
+                if gtd_new * (t_hi - t_lo) >= 0:
+                    t_hi, f_hi = t_lo, f_lo
+                t_lo, f_lo = t, f_new
+        return t, f_new, g_new, evals

@@ -70,6 +70,8 @@ class SparseCooTensor:
     def to_dense(self) -> Tensor:
         def fn(values):
             out = jnp.zeros(tuple(self.shape), values.dtype)
+            if values.dtype == jnp.bool_:
+                return out.at[tuple(self.indices)].set(values)
             return out.at[tuple(self.indices)].add(values)
         return run_op("sparse_to_dense", fn, (Tensor(self.values),))
 
@@ -403,3 +405,98 @@ class nn:
         leaky_relu = staticmethod(lambda x, s=0.01: leaky_relu(x, s))
         softmax = staticmethod(lambda x, axis=-1: softmax(x, axis))
         attention = None  # reference sparse attention: not yet ported
+
+
+def coalesce(x):
+    """Merge duplicate COO indices (parity: paddle.sparse.coalesce)."""
+    return _coo(x).coalesce()
+
+
+def reshape(x, shape):
+    """Reshape a sparse COO tensor (parity: paddle.sparse.reshape) —
+    recompute indices through the flat offset."""
+    coo = _coo(x).coalesce()
+    old_shape = tuple(coo.shape)
+    new_shape = tuple(int(s) for s in shape)
+    neg = [i for i, s in enumerate(new_shape) if s == -1]
+    if neg:
+        known = int(np.prod([s for s in new_shape if s != -1]))
+        total = int(np.prod(old_shape))
+        new_shape = tuple(total // known if s == -1 else s
+                          for s in new_shape)
+    idx = np.asarray(coo.indices)
+    flat = np.zeros(idx.shape[1], np.int64)
+    for d, size in enumerate(old_shape):
+        flat = flat * size + idx[d]
+    new_idx = []
+    rem = flat
+    for size in reversed(new_shape):
+        new_idx.append(rem % size)
+        rem = rem // size
+    new_idx = np.stack(list(reversed(new_idx)), 0)
+    return SparseCooTensor(new_idx, coo.values, new_shape, coalesced=True)
+
+
+def slice(x, axes, starts, ends):
+    """Slice a sparse COO tensor (parity: paddle.sparse.slice)."""
+    import builtins
+    coo = _coo(x).coalesce()
+    idx = np.asarray(coo.indices)
+    vals = coo.values
+    shape = list(coo.shape)
+    keep = np.ones(idx.shape[1], bool)
+    offsets = {}
+    for ax, st, en in zip(axes, starts, ends):
+        size = shape[ax]
+        st = st + size if st < 0 else builtins.min(st, size)
+        en = en + size if en < 0 else builtins.min(en, size)
+        keep &= (idx[ax] >= st) & (idx[ax] < en)
+        offsets[ax] = st
+        shape[ax] = en - st
+    new_idx = idx[:, keep].copy()
+    for ax, off in offsets.items():
+        new_idx[ax] -= off
+    sel = np.nonzero(keep)[0]
+    from ..core.dispatch import run_op as _run
+    new_vals = _run("sparse_slice_vals",
+                    lambda v: v[jnp.asarray(sel)], (vals,))
+    return SparseCooTensor(new_idx, new_vals, tuple(shape), coalesced=True)
+
+
+def isnan(x):
+    """Elementwise isnan on stored values (parity: paddle.sparse.isnan)."""
+    coo = _coo(x)
+    from ..core.dispatch import run_op as _run
+    vals = _run("sparse_isnan", jnp.isnan, (coo.values,),
+                out_stop_gradient=True)
+    return SparseCooTensor(coo.indices, vals, coo.shape)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """dense + sparse @ dense (parity: paddle.sparse.addmm)."""
+    prod = matmul(x, y)
+    from ..core.dispatch import run_op as _run
+    return _run("sparse_addmm",
+                lambda i, m: beta * i + alpha * m, (input, prod))
+
+
+def deg2rad(x):
+    coo = _coo(x)
+    from ..core.dispatch import run_op as _run
+    vals = _run("sparse_deg2rad", jnp.deg2rad, (coo.values,))
+    return SparseCooTensor(coo.indices, vals, coo.shape)
+
+
+def rad2deg(x):
+    coo = _coo(x)
+    from ..core.dispatch import run_op as _run
+    vals = _run("sparse_rad2deg", jnp.rad2deg, (coo.values,))
+    return SparseCooTensor(coo.indices, vals, coo.shape)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """(parity: paddle.sparse.pca_lowrank — densifies then delegates; the
+    reference supports sparse input to the same randomized algorithm)."""
+    from ..tensor.linalg import pca_lowrank as _dense_pca
+    dense = x.to_dense() if hasattr(x, "to_dense") else x
+    return _dense_pca(dense, q=q, center=center, niter=niter)

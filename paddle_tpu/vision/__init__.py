@@ -2,6 +2,7 @@
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
+from . import ops  # noqa: F401
 from .models import (ResNet, resnet18, resnet34, resnet50,  # noqa: F401
                      resnet101, resnet152, LeNet, VGG, vgg16,
                      MobileNetV2, mobilenet_v2)

@@ -1,0 +1,849 @@
+"""Detection / vision ops (parity: python/paddle/vision/ops.py — nms,
+roi_align/roi_pool/psroi_pool, deform_conv2d, yolo_box/yolo_loss,
+prior_box, box_coder, proposals, image decode).
+
+Dense per-box math (roi align, box coder, yolo decode) is XLA; ops whose
+output size is data-dependent (nms, proposal generation) run host-side like
+the reference's CPU kernels for the same stage of the pipeline.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "yolo_loss", "yolo_box", "prior_box", "box_coder", "deform_conv2d",
+    "DeformConv2D", "distribute_fpn_proposals", "generate_proposals",
+    "read_file", "decode_jpeg", "roi_pool", "RoIPool", "psroi_pool",
+    "PSRoIPool", "roi_align", "RoIAlign", "nms", "matrix_nms",
+]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# -- NMS family (host-side: output count is data-dependent) ----------------
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    xx1 = np.maximum(x1[:, None], x1[None, :])
+    yy1 = np.maximum(y1[:, None], y1[None, :])
+    xx2 = np.minimum(x2[:, None], x2[None, :])
+    yy2 = np.minimum(y2[:, None], y2[None, :])
+    inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+    return inter / np.maximum(area[:, None] + area[None, :] - inter, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy hard NMS (parity: paddle.vision.ops.nms). Returns kept box
+    indices, score-descending."""
+    b = np.asarray(_arr(boxes), np.float64)
+    n = b.shape[0]
+    sc = np.asarray(_arr(scores)) if scores is not None \
+        else np.arange(n, 0, -1, dtype=np.float64)
+    if category_idxs is not None:
+        # per-category NMS: offset boxes per category so they never overlap
+        cat = np.asarray(_arr(category_idxs))
+        off = cat.astype(np.float64) * (b.max() + 1.0)
+        b = b + off[:, None]
+    order = np.argsort(-sc)
+    iou = _iou_matrix(b)
+    keep = []
+    suppressed = np.zeros(n, bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        suppressed |= iou[i] > iou_threshold
+        suppressed[i] = True
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Soft decay NMS (parity: paddle.vision.ops.matrix_nms — the SOLOv2
+    matrix NMS). Host-side."""
+    bb = np.asarray(_arr(bboxes))  # (N, M, 4)
+    sc = np.asarray(_arr(scores))  # (N, C, M)
+    all_out, all_idx, rois_num = [], [], []
+    N, C, M = sc.shape
+    for n in range(N):
+        dets, idxs = [], []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            sel = np.nonzero(s > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            sel = sel[np.argsort(-s[sel])][:nms_top_k]
+            boxes_c = bb[n, sel]
+            s_c = s[sel]
+            iou = _iou_matrix(boxes_c)
+            iou = np.triu(iou, k=1)
+            # compensate IoU: for suppressor i, its own max overlap with
+            # any higher-scored box (row-wise broadcast — SOLOv2 eq. 5)
+            iou_cmax = iou.max(0) if iou.size else np.zeros(len(sel))
+            if use_gaussian:
+                decay = np.exp((iou_cmax[:, None] ** 2 - iou ** 2)
+                               / gaussian_sigma).min(0) \
+                    if iou.size else np.ones(len(sel))
+            else:
+                decay = ((1 - iou)
+                         / np.maximum(1 - iou_cmax[:, None], 1e-10)).min(0) \
+                    if iou.size else np.ones(len(sel))
+            s_dec = s_c * decay
+            ok = s_dec >= post_threshold
+            for j in np.nonzero(ok)[0]:
+                dets.append([c, s_dec[j], *boxes_c[j]])
+                idxs.append(n * M + sel[j])
+        dets = np.asarray(dets, np.float32) if dets else \
+            np.zeros((0, 6), np.float32)
+        idxs = np.asarray(idxs, np.int64) if idxs else \
+            np.zeros((0,), np.int64)
+        if len(dets) > keep_top_k:
+            ordr = np.argsort(-dets[:, 1])[:keep_top_k]
+            dets, idxs = dets[ordr], idxs[ordr]
+        all_out.append(dets)
+        all_idx.append(idxs)
+        rois_num.append(len(dets))
+    out = Tensor(jnp.asarray(np.concatenate(all_out, 0)))
+    index = Tensor(jnp.asarray(np.concatenate(all_idx, 0)))
+    rn = Tensor(jnp.asarray(np.asarray(rois_num, np.int32)))
+    res = [out]
+    if return_index:
+        res.append(index)
+    if return_rois_num:
+        res.append(rn)
+    return tuple(res) if len(res) > 1 else out
+
+
+# -- RoI ops (XLA: fixed output shapes) ------------------------------------
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign with bilinear sampling (parity: paddle.vision.ops.roi_align,
+    reference roi_align kernel semantics incl. `aligned` half-pixel)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    bn = np.asarray(_arr(boxes_num))
+    batch_idx = np.repeat(np.arange(len(bn)), bn).astype(np.int32)
+
+    def fn(feat, bx):
+        n, c, h, w = feat.shape
+        offset = 0.5 if aligned else 0.0
+        x1 = bx[:, 0] * spatial_scale - offset
+        y1 = bx[:, 1] * spatial_scale - offset
+        x2 = bx[:, 2] * spatial_scale - offset
+        y2 = bx[:, 3] * spatial_scale - offset
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        # sample points per bin: (sr x sr) bilinear taps, averaged
+        iy = (jnp.arange(ph)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr)
+        ix = (jnp.arange(pw)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr)
+        # absolute sample coords per roi: (R, ph, sr)
+        sy = y1[:, None, None] + iy[None] * bin_h[:, None, None]
+        sx = x1[:, None, None] + ix[None] * bin_w[:, None, None]
+
+        def bilinear(img, yy, xx):
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y1_ = y0 + 1
+            x1_ = x0 + 1
+            wy = yy - y0
+            wx = xx - x0
+
+            def at(yi, xi):
+                yc = jnp.clip(yi, 0, h - 1)
+                xc = jnp.clip(xi, 0, w - 1)
+                v = img[:, yc, xc]
+                valid = ((yi >= -1) & (yi <= h) & (xi >= -1) & (xi <= w))
+                return v * valid
+            return (at(y0, x0) * (1 - wy) * (1 - wx)
+                    + at(y0, x1_) * (1 - wy) * wx
+                    + at(y1_, x0) * wy * (1 - wx)
+                    + at(y1_, x1_) * wy * wx)
+
+        def per_roi(b_idx, syr, sxr):
+            img = feat[b_idx]  # (c, h, w)
+            # grid of all (ph*sr, pw*sr) sample points
+            yy = syr.reshape(-1)          # (ph*sr,)
+            xx = sxr.reshape(-1)          # (pw*sr,)
+            gy, gx = jnp.meshgrid(yy, xx, indexing="ij")
+            vals = bilinear(img, gy, gx)  # (c, ph*sr, pw*sr)
+            vals = vals.reshape(c, ph, sr, pw, sr)
+            return vals.mean(axis=(2, 4))
+        return jax.vmap(per_roi)(jnp.asarray(batch_idx), sy, sx)
+    return run_op("roi_align", fn, (x, boxes))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """Max RoI pooling (parity: paddle.vision.ops.roi_pool)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    bn = np.asarray(_arr(boxes_num))
+    batch_idx = np.repeat(np.arange(len(bn)), bn).astype(np.int32)
+
+    def fn(feat, bx):
+        n, c, h, w = feat.shape
+        x1 = jnp.round(bx[:, 0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(bx[:, 1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(bx[:, 2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(bx[:, 3] * spatial_scale).astype(jnp.int32)
+
+        def per_roi(b_idx, xx1, yy1, xx2, yy2):
+            img = feat[b_idx]
+            rw = jnp.maximum(xx2 - xx1 + 1, 1)
+            rh = jnp.maximum(yy2 - yy1 + 1, 1)
+            outs = []
+            for i in range(ph):
+                for j in range(pw):
+                    hs = yy1 + (i * rh) // ph
+                    he = yy1 + ((i + 1) * rh + ph - 1) // ph
+                    ws = xx1 + (j * rw) // pw
+                    we = xx1 + ((j + 1) * rw + pw - 1) // pw
+                    ys = jnp.arange(h)
+                    xs = jnp.arange(w)
+                    my = (ys >= hs) & (ys < jnp.maximum(he, hs + 1))
+                    mx = (xs >= ws) & (xs < jnp.maximum(we, ws + 1))
+                    m = my[:, None] & mx[None, :]
+                    big = jnp.where(m[None], img,
+                                    jnp.full_like(img, -jnp.inf))
+                    outs.append(big.max(axis=(1, 2)))
+            return jnp.stack(outs, 1).reshape(c, ph, pw)
+        return jax.vmap(per_roi)(jnp.asarray(batch_idx), x1, y1, x2, y2)
+    return run_op("roi_pool", fn, (x, boxes))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (parity: psroi_pool — channel
+    c*(ph*pw) maps each output bin to its own channel group)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    bn = np.asarray(_arr(boxes_num))
+    batch_idx = np.repeat(np.arange(len(bn)), bn).astype(np.int32)
+
+    def fn(feat, bx):
+        n, c, h, w = feat.shape
+        oc = c // (ph * pw)
+        x1 = bx[:, 0] * spatial_scale
+        y1 = bx[:, 1] * spatial_scale
+        x2 = bx[:, 2] * spatial_scale
+        y2 = bx[:, 3] * spatial_scale
+        bh = (y2 - y1) / ph
+        bw = (x2 - x1) / pw
+
+        def per_roi(b_idx, xx1, yy1, bhh, bww):
+            img = feat[b_idx].reshape(oc, ph, pw, h, w)
+            outs = []
+            for i in range(ph):
+                for j in range(pw):
+                    hs = yy1 + i * bhh
+                    he = yy1 + (i + 1) * bhh
+                    ws = xx1 + j * bww
+                    we = xx1 + (j + 1) * bww
+                    ys = jnp.arange(h)
+                    xs = jnp.arange(w)
+                    my = (ys >= jnp.floor(hs)) & (ys < jnp.ceil(he))
+                    mx = (xs >= jnp.floor(ws)) & (xs < jnp.ceil(we))
+                    m = (my[:, None] & mx[None, :]).astype(img.dtype)
+                    cnt = jnp.maximum(m.sum(), 1.0)
+                    v = (img[:, i, j] * m[None]).sum(axis=(1, 2)) / cnt
+                    outs.append(v)
+            return jnp.stack(outs, 1).reshape(oc, ph, pw)
+        return jax.vmap(per_roi)(jnp.asarray(batch_idx), x1, y1, bh, bw)
+    return run_op("psroi_pool", fn, (x, boxes))
+
+
+# -- box utilities ---------------------------------------------------------
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (parity: paddle.vision.ops.prior_box)."""
+    fh, fw = _arr(input).shape[2:]
+    ih, iw = _arr(image).shape[2:]
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    for s in min_sizes:
+        boxes.append((s, s))
+        if max_sizes:
+            for ms in max_sizes:
+                d = np.sqrt(s * ms)
+                boxes.append((d, d))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            boxes.append((s * np.sqrt(ar), s / np.sqrt(ar)))
+    num = len(boxes)
+    cy = (np.arange(fh) + offset) * step_h
+    cx = (np.arange(fw) + offset) * step_w
+    out = np.zeros((fh, fw, num, 4), np.float32)
+    for k, (bw, bh) in enumerate(boxes):
+        out[:, :, k, 0] = (cx[None, :] - bw / 2) / iw
+        out[:, :, k, 1] = (cy[:, None] - bh / 2) / ih
+        out[:, :, k, 2] = (cx[None, :] + bw / 2) / iw
+        out[:, :, k, 3] = (cy[:, None] + bh / 2) / ih
+    if clip:
+        out = np.clip(out, 0, 1)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (parity: box_coder op)."""
+    def fn(pb, tb, *pbv_):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        if pbv_:
+            v = pbv_[0]
+        else:
+            v = jnp.ones_like(pb)
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / v[None, :, 0]
+            oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / v[None, :, 1]
+            ow = jnp.log(tw[:, None] / pw[None, :]) / v[None, :, 2]
+            oh = jnp.log(th[:, None] / ph[None, :]) / v[None, :, 3]
+            return jnp.stack([ox, oy, ow, oh], axis=-1)
+        # decode_center_size: target (N, M, 4) deltas against priors
+        if axis == 0:
+            pcx_, pcy_, pw_, ph_ = (pcx[None, :], pcy[None, :],
+                                    pw[None, :], ph[None, :])
+            vv = v[None, :, :]
+        else:
+            pcx_, pcy_, pw_, ph_ = (pcx[:, None], pcy[:, None],
+                                    pw[:, None], ph[:, None])
+            vv = v[:, None, :]
+        dcx = vv[..., 0] * tb[..., 0] * pw_ + pcx_
+        dcy = vv[..., 1] * tb[..., 1] * ph_ + pcy_
+        dw = jnp.exp(vv[..., 2] * tb[..., 2]) * pw_
+        dh = jnp.exp(vv[..., 3] * tb[..., 3]) * ph_
+        return jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                          dcx + dw * 0.5 - norm, dcy + dh * 0.5 - norm],
+                         axis=-1)
+    if prior_box_var is not None and not np.isscalar(prior_box_var):
+        return run_op("box_coder", fn, (prior_box, target_box,
+                                        prior_box_var))
+    return run_op("box_coder", fn, (prior_box, target_box))
+
+
+# -- YOLO ------------------------------------------------------------------
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head output to boxes+scores (parity: yolo_box op)."""
+    na = len(anchors) // 2
+    anc = np.asarray(anchors, np.float32).reshape(na, 2)
+
+    def fn(feat, imsz):
+        n, c, h, w = feat.shape
+        if iou_aware:
+            # PP-YOLO layout: na IoU channels first, then the standard
+            # na*(5+classes) block (reference yolo_box_kernel iou_aware)
+            iou_pred = jax.nn.sigmoid(feat[:, :na])        # (n, na, h, w)
+            feat = feat[:, na:]
+        v = feat.reshape(n, na, -1, h, w)
+        box_attr = v[:, :, :4]
+        obj = jax.nn.sigmoid(v[:, :, 4])
+        if iou_aware:
+            obj = (obj ** (1.0 - iou_aware_factor)) \
+                * (iou_pred ** iou_aware_factor)
+        cls = jax.nn.sigmoid(v[:, :, 5:5 + class_num])
+        gx = jnp.arange(w, dtype=feat.dtype)
+        gy = jnp.arange(h, dtype=feat.dtype)
+        bx = (jax.nn.sigmoid(box_attr[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gx[None, None, None, :]) / w
+        by = (jax.nn.sigmoid(box_attr[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gy[None, None, :, None]) / h
+        bw = jnp.exp(box_attr[:, :, 2]) \
+            * anc[None, :, 0, None, None] / (w * downsample_ratio)
+        bh = jnp.exp(box_attr[:, :, 3]) \
+            * anc[None, :, 1, None, None] / (h * downsample_ratio)
+        im_h = imsz[:, 0].astype(feat.dtype)
+        im_w = imsz[:, 1].astype(feat.dtype)
+        x1 = (bx - bw / 2) * im_w[:, None, None, None]
+        y1 = (by - bh / 2) * im_h[:, None, None, None]
+        x2 = (bx + bw / 2) * im_w[:, None, None, None]
+        y2 = (by + bh / 2) * im_h[:, None, None, None]
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, im_w[:, None, None, None] - 1)
+            y1 = jnp.clip(y1, 0, im_h[:, None, None, None] - 1)
+            x2 = jnp.clip(x2, 0, im_w[:, None, None, None] - 1)
+            y2 = jnp.clip(y2, 0, im_h[:, None, None, None] - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+        scores = (obj[:, :, None] * cls).transpose(0, 1, 3, 4, 2) \
+            .reshape(n, -1, class_num)
+        mask = (obj.reshape(n, -1) > conf_thresh).astype(feat.dtype)
+        boxes = boxes * mask[..., None]
+        scores = scores * mask[..., None]
+        return boxes, scores
+    return run_op("yolo_box", fn, (x, img_size))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (parity: yolo_loss op — coordinate +
+    objectness + class terms over assigned anchors; predictions whose
+    decoded IoU with any GT exceeds ignore_thresh are excluded from the
+    negative-objectness term; gt_score weights positive samples)."""
+    na_all = len(anchors) // 2
+    anc_all = np.asarray(anchors, np.float32).reshape(na_all, 2)
+    mask = list(anchor_mask)
+    na = len(mask)
+
+    def fn(feat, gtb, gtl, *rest):
+        gsc = rest[0] if rest else None
+        n, c, h, w = feat.shape
+        v = feat.reshape(n, na, 5 + class_num, h, w)
+        px = jax.nn.sigmoid(v[:, :, 0])
+        py = jax.nn.sigmoid(v[:, :, 1])
+        pw_ = v[:, :, 2]
+        ph_ = v[:, :, 3]
+        pobj = v[:, :, 4]
+        pcls = v[:, :, 5:]
+        in_sz = w * downsample_ratio
+        b = gtb.shape[1]
+        loss = jnp.zeros((n,), feat.dtype)
+        obj_target = jnp.zeros((n, na, h, w), feat.dtype)
+        obj_weight = jnp.zeros((n, na, h, w), feat.dtype)
+        # decoded predicted boxes for the ignore-threshold test
+        gx_grid = jnp.arange(w, dtype=feat.dtype)
+        gy_grid = jnp.arange(h, dtype=feat.dtype)
+        pbx = (jax.nn.sigmoid(v[:, :, 0]) + gx_grid[None, None, None, :]) / w
+        pby = (jax.nn.sigmoid(v[:, :, 1]) + gy_grid[None, None, :, None]) / h
+        anc_sel = anc_all[mask]  # (na, 2)
+        pbw = jnp.exp(v[:, :, 2]) * anc_sel[None, :, 0, None, None] / in_sz
+        pbh = jnp.exp(v[:, :, 3]) * anc_sel[None, :, 1, None, None] / in_sz
+        best_iou = jnp.zeros((n, na, h, w), feat.dtype)
+        for bi in range(b):
+            gx_, gy_, gw_, gh_ = (gtb[:, bi, 0], gtb[:, bi, 1],
+                                  gtb[:, bi, 2], gtb[:, bi, 3])
+            valid_ = ((gw_ > 0) & (gh_ > 0)).astype(feat.dtype)
+            ix1 = jnp.maximum(pbx - pbw / 2,
+                              (gx_ - gw_ / 2)[:, None, None, None])
+            iy1 = jnp.maximum(pby - pbh / 2,
+                              (gy_ - gh_ / 2)[:, None, None, None])
+            ix2 = jnp.minimum(pbx + pbw / 2,
+                              (gx_ + gw_ / 2)[:, None, None, None])
+            iy2 = jnp.minimum(pby + pbh / 2,
+                              (gy_ + gh_ / 2)[:, None, None, None])
+            inter_ = (jnp.maximum(ix2 - ix1, 0)
+                      * jnp.maximum(iy2 - iy1, 0))
+            union_ = (pbw * pbh
+                      + (gw_ * gh_)[:, None, None, None] - inter_)
+            iou_ = inter_ / jnp.maximum(union_, 1e-10)
+            best_iou = jnp.maximum(best_iou,
+                                   iou_ * valid_[:, None, None, None])
+        # negatives with IoU above ignore_thresh contribute no loss
+        obj_mask = (best_iou <= ignore_thresh).astype(feat.dtype)
+        for bi in range(b):
+            gx, gy, gw, gh = (gtb[:, bi, 0], gtb[:, bi, 1],
+                              gtb[:, bi, 2], gtb[:, bi, 3])
+            valid = (gw > 0) & (gh > 0)
+            gi = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)
+            gj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+            # best anchor by IoU of (w, h) only, over ALL anchors
+            inter = (jnp.minimum(gw[:, None] * in_sz, anc_all[None, :, 0])
+                     * jnp.minimum(gh[:, None] * in_sz, anc_all[None, :, 1]))
+            union = (gw[:, None] * in_sz * gh[:, None] * in_sz
+                     + anc_all[None, :, 0] * anc_all[None, :, 1] - inter)
+            best = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=1)
+            for k, am in enumerate(mask):
+                sel = valid & (best == am)
+                selx = sel.astype(feat.dtype)
+                if gsc is not None:
+                    selx = selx * gsc[:, bi]
+                tx = gx * w - gi
+                ty = gy * h - gj
+                tw = jnp.log(jnp.maximum(
+                    gw * in_sz / anc_all[am, 0], 1e-9))
+                th = jnp.log(jnp.maximum(
+                    gh * in_sz / anc_all[am, 1], 1e-9))
+                scale = 2.0 - gw * gh
+                bidx = jnp.arange(n)
+                lx = (px[bidx, k, gj, gi] - tx) ** 2
+                ly = (py[bidx, k, gj, gi] - ty) ** 2
+                lw = (pw_[bidx, k, gj, gi] - tw) ** 2
+                lh = (ph_[bidx, k, gj, gi] - th) ** 2
+                loss = loss + selx * scale * (lx + ly + lw + lh)
+                cls_t = jax.nn.one_hot(gtl[:, bi].astype(jnp.int32),
+                                       class_num, dtype=feat.dtype)
+                if use_label_smooth:
+                    delta = 1.0 / class_num
+                    cls_t = cls_t * (1 - delta) + delta / class_num
+                logits = pcls[bidx, k, :, gj, gi]
+                lc = jnp.sum(
+                    jnp.maximum(logits, 0) - logits * cls_t
+                    + jnp.log1p(jnp.exp(-jnp.abs(logits))), axis=1)
+                loss = loss + selx * lc
+                obj_target = obj_target.at[bidx, k, gj, gi].max(
+                    sel.astype(feat.dtype))
+                obj_weight = obj_weight.at[bidx, k, gj, gi].max(selx)
+        lobj = (jnp.maximum(pobj, 0) - pobj * obj_target
+                + jnp.log1p(jnp.exp(-jnp.abs(pobj))))
+        # positives weighted by gt_score; negatives gated by ignore mask
+        wobj = jnp.where(obj_target > 0, obj_weight, obj_mask)
+        loss = loss + (lobj * wobj).sum(axis=(1, 2, 3))
+        return loss
+    ops = (x, gt_box, gt_label) + ((gt_score,)
+                                   if gt_score is not None else ())
+    return run_op("yolo_loss", fn, ops)
+
+
+# -- deformable conv -------------------------------------------------------
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (parity: paddle.vision.ops.deform_conv2d
+    — v2 when mask is given). Implemented as grid_sample-style gathers at
+    offset positions + matmul: the MXU does the contraction."""
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else \
+        tuple(dilation)
+
+    def fn(a, off, wt, *rest):
+        n, cin, h, w = a.shape
+        cout, cpg, kh, kw = wt.shape
+        oh = (h + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        ow = (w + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        msk = None
+        bia = None
+        ri = 0
+        if mask is not None:
+            msk = rest[ri]
+            ri += 1
+        if bias is not None:
+            bia = rest[ri]
+        # base sampling grid (kh*kw taps per output position)
+        base_y = (jnp.arange(oh) * st[0] - pd[0])[:, None, None] \
+            + (jnp.arange(kh) * dl[0])[None, :, None]      # (oh, kh, 1)
+        base_x = (jnp.arange(ow) * st[1] - pd[1])[:, None, None] \
+            + (jnp.arange(kw) * dl[1])[None, :, None]      # (ow, kw, 1)
+        off = off.reshape(n, deformable_groups, kh * kw, 2, oh, ow)
+
+        def sample(img, yy, xx):
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            wy = yy - y0
+            wx = xx - x0
+
+            def at(yi, xi):
+                yc = jnp.clip(yi, 0, h - 1)
+                xc = jnp.clip(xi, 0, w - 1)
+                v = img[:, yc, xc]
+                ok = (yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1)
+                return v * ok
+            return (at(y0, x0) * (1 - wy) * (1 - wx)
+                    + at(y0, x0 + 1) * (1 - wy) * wx
+                    + at(y0 + 1, x0) * wy * (1 - wx)
+                    + at(y0 + 1, x0 + 1) * wy * wx)
+
+        cols = []
+        cg = cin // deformable_groups
+        for g in range(deformable_groups):
+            img_g = a[:, g * cg:(g + 1) * cg]
+            taps = []
+            for ki in range(kh):
+                for kj in range(kw):
+                    k = ki * kw + kj
+                    dy = off[:, g, k, 0]
+                    dx = off[:, g, k, 1]
+                    yy = base_y[None, :, ki, 0][..., None] + dy  # (n,oh,ow)
+                    xx = base_x[None, None, :, kj, 0] + dx
+                    vals = jax.vmap(sample)(img_g, yy, xx)
+                    if msk is not None:
+                        mm = msk.reshape(n, deformable_groups, kh * kw,
+                                         oh, ow)[:, g, k]
+                        vals = vals * mm[:, None]
+                    taps.append(vals)
+            cols.append(jnp.stack(taps, 2))  # (n, cg, k, oh, ow)
+        col = jnp.concatenate(cols, 1)       # (n, cin, khkw, oh, ow)
+        col = col.reshape(n, cin * kh * kw, oh * ow)
+        wmat = wt.reshape(cout, cpg * kh * kw)
+        if groups == 1:
+            out = jnp.einsum("ok,nkp->nop", wmat, col)
+        else:
+            cpg_out = cout // groups
+            outs = []
+            for g in range(groups):
+                cslice = col.reshape(n, cin, kh * kw, oh * ow)[
+                    :, g * cpg:(g + 1) * cpg].reshape(
+                        n, cpg * kh * kw, oh * ow)
+                wslice = wmat[g * cpg_out:(g + 1) * cpg_out]
+                outs.append(jnp.einsum("ok,nkp->nop", wslice, cslice))
+            out = jnp.concatenate(outs, 1)
+        out = out.reshape(n, cout, oh, ow)
+        if bia is not None:
+            out = out + bia[None, :, None, None]
+        return out
+    ops = [x, offset, weight]
+    if mask is not None:
+        ops.append(mask)
+    if bias is not None:
+        ops.append(bias)
+    return run_op("deform_conv2d", fn, tuple(ops))
+
+
+class DeformConv2D:
+    """Layer wrapper for deform_conv2d (parity: paddle.vision.ops
+    .DeformConv2D)."""
+
+    def __new__(cls, *args, **kwargs):
+        from ..nn.layer.layers import Layer
+
+        class _DeformConv2D(Layer):
+            def __init__(self, in_channels, out_channels, kernel_size,
+                         stride=1, padding=0, dilation=1,
+                         deformable_groups=1, groups=1, weight_attr=None,
+                         bias_attr=None):
+                super().__init__()
+                ks = (kernel_size, kernel_size) \
+                    if isinstance(kernel_size, int) else tuple(kernel_size)
+                self._stride = stride
+                self._padding = padding
+                self._dilation = dilation
+                self._deformable_groups = deformable_groups
+                self._groups = groups
+                self.weight = self.create_parameter(
+                    [out_channels, in_channels // groups, *ks],
+                    attr=weight_attr)
+                self.bias = None if bias_attr is False else \
+                    self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True)
+
+            def forward(self, x, offset, mask=None):
+                return deform_conv2d(
+                    x, offset, self.weight, self.bias, self._stride,
+                    self._padding, self._dilation,
+                    self._deformable_groups, self._groups, mask)
+        return _DeformConv2D(*args, **kwargs)
+
+
+# -- proposals -------------------------------------------------------------
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (parity:
+    distribute_fpn_proposals op). Host-side (ragged outputs)."""
+    rois = np.asarray(_arr(fpn_rois))
+    off = 1.0 if pixel_offset else 0.0
+    ws = np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+    hs = np.maximum(rois[:, 3] - rois[:, 1] + off, 0)
+    scale = np.sqrt(ws * hs)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, idxs = [], []
+    order = []
+    for L in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == L)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel])))
+        idxs.append(sel)
+        order.append(sel)
+    restore = np.argsort(np.concatenate(order)) if order else \
+        np.zeros((0,), np.int64)
+    rois_num_per_level = None
+    if rois_num is not None:
+        rn = np.asarray(_arr(rois_num))
+        batch_of = np.repeat(np.arange(len(rn)), rn)
+        rois_num_per_level = [
+            Tensor(jnp.asarray(np.bincount(batch_of[i],
+                                           minlength=len(rn)).astype(
+                np.int32)))
+            for i in idxs]
+    return outs, Tensor(jnp.asarray(restore.astype(np.int32))), \
+        rois_num_per_level
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation: decode deltas -> clip -> filter ->
+    NMS (parity: generate_proposals op). Host-side."""
+    sc = np.asarray(_arr(scores))      # (N, A, H, W)
+    bd = np.asarray(_arr(bbox_deltas))  # (N, 4A, H, W)
+    ims = np.asarray(_arr(img_size))   # (N, 2)
+    anc = np.asarray(_arr(anchors)).reshape(-1, 4)  # (H*W*A, 4)
+    var = np.asarray(_arr(variances)).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    all_rois, all_scores, rois_num = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)           # (H*W*A,)
+        d = bd[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = anc[:, 2] - anc[:, 0] + off
+        ah = anc[:, 3] - anc[:, 1] + off
+        acx = anc[:, 0] + aw * 0.5
+        acy = anc[:, 1] + ah * 0.5
+        cx = var[:, 0] * d[:, 0] * aw + acx
+        cy = var[:, 1] * d[:, 1] * ah + acy
+        wf = np.exp(np.minimum(var[:, 2] * d[:, 2], np.log(1000 / 16))) * aw
+        hf = np.exp(np.minimum(var[:, 3] * d[:, 3], np.log(1000 / 16))) * ah
+        props = np.stack([cx - wf / 2, cy - hf / 2,
+                          cx + wf / 2 - off, cy + hf / 2 - off], 1)
+        ih, iw = ims[n]
+        props[:, 0] = np.clip(props[:, 0], 0, iw - off)
+        props[:, 1] = np.clip(props[:, 1], 0, ih - off)
+        props[:, 2] = np.clip(props[:, 2], 0, iw - off)
+        props[:, 3] = np.clip(props[:, 3], 0, ih - off)
+        keepsz = ((props[:, 2] - props[:, 0] + off >= min_size)
+                  & (props[:, 3] - props[:, 1] + off >= min_size))
+        props, s = props[keepsz], s[keepsz]
+        order = np.argsort(-s)[:pre_nms_top_n]
+        props, s = props[order], s[order]
+        iou = _iou_matrix(props)
+        suppressed = np.zeros(len(props), bool)
+        keep = []
+        for i in range(len(props)):
+            if suppressed[i]:
+                continue
+            keep.append(i)
+            if len(keep) >= post_nms_top_n:
+                break
+            suppressed |= iou[i] > nms_thresh
+            suppressed[i] = True
+        keep = np.asarray(keep, np.int64)
+        all_rois.append(props[keep])
+        all_scores.append(s[keep])
+        rois_num.append(len(keep))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0).astype(
+        np.float32)))
+    rscores = Tensor(jnp.asarray(np.concatenate(all_scores, 0).astype(
+        np.float32)))
+    if return_rois_num:
+        return rois, rscores, Tensor(jnp.asarray(np.asarray(rois_num,
+                                                            np.int32)))
+    return rois, rscores
+
+
+# -- image IO --------------------------------------------------------------
+
+def read_file(path, name=None):
+    """Read raw bytes into a uint8 tensor (parity: paddle.vision.ops
+    .read_file)."""
+    with open(path, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (parity: decode_jpeg —
+    the reference uses nvjpeg; PIL is this build's host decoder)."""
+    data = bytes(np.asarray(_arr(x)).tobytes())
+    import io
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("decode_jpeg requires Pillow") from e
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+class RoIPool:
+    """(parity: paddle.vision.ops.RoIPool)"""
+
+    def __new__(cls, output_size, spatial_scale=1.0):
+        from ..nn.layer.layers import Layer
+
+        class _RoIPool(Layer):
+            def __init__(self):
+                super().__init__()
+                self.output_size = output_size
+                self.spatial_scale = spatial_scale
+
+            def forward(self, x, boxes, boxes_num):
+                return roi_pool(x, boxes, boxes_num, self.output_size,
+                                self.spatial_scale)
+        return _RoIPool()
+
+
+class RoIAlign:
+    """(parity: paddle.vision.ops.RoIAlign)"""
+
+    def __new__(cls, output_size, spatial_scale=1.0):
+        from ..nn.layer.layers import Layer
+
+        class _RoIAlign(Layer):
+            def __init__(self):
+                super().__init__()
+                self.output_size = output_size
+                self.spatial_scale = spatial_scale
+
+            def forward(self, x, boxes, boxes_num, aligned=True):
+                return roi_align(x, boxes, boxes_num, self.output_size,
+                                 self.spatial_scale, aligned=aligned)
+        return _RoIAlign()
+
+
+class PSRoIPool:
+    """(parity: paddle.vision.ops.PSRoIPool)"""
+
+    def __new__(cls, output_size, spatial_scale=1.0):
+        from ..nn.layer.layers import Layer
+
+        class _PSRoIPool(Layer):
+            def __init__(self):
+                super().__init__()
+                self.output_size = output_size
+                self.spatial_scale = spatial_scale
+
+            def forward(self, x, boxes, boxes_num):
+                return psroi_pool(x, boxes, boxes_num, self.output_size,
+                                  self.spatial_scale)
+        return _PSRoIPool()

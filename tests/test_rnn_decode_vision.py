@@ -1,0 +1,505 @@
+"""Wave-B tests: RNN family (torch oracle), beam-search decode, new
+losses (incl. RNN-T vs brute-force), vision ops, sparse/distribution
+additions, Rprop/LBFGS, distributed extras."""
+import itertools
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+t = paddle.to_tensor
+rng = np.random.RandomState(7)
+
+
+def _copy_cell_to_torch(cell, tcell):
+    with torch.no_grad():
+        tcell.weight_ih.copy_(torch.tensor(cell.weight_ih.numpy()))
+        tcell.weight_hh.copy_(torch.tensor(cell.weight_hh.numpy()))
+        tcell.bias_ih.copy_(torch.tensor(cell.bias_ih.numpy()))
+        tcell.bias_hh.copy_(torch.tensor(cell.bias_hh.numpy()))
+
+
+class TestRNNFamily:
+    def test_lstm_cell_matches_torch(self):
+        cell = paddle.nn.LSTMCell(4, 6)
+        tcell = torch.nn.LSTMCell(4, 6)
+        _copy_cell_to_torch(cell, tcell)
+        x = rng.randn(3, 4).astype(np.float32)
+        h0 = rng.randn(3, 6).astype(np.float32)
+        c0 = rng.randn(3, 6).astype(np.float32)
+        _, (h1, c1) = cell(t(x), (t(h0), t(c0)))
+        th, tc = tcell(torch.tensor(x), (torch.tensor(h0),
+                                         torch.tensor(c0)))
+        np.testing.assert_allclose(h1.numpy(), th.detach().numpy(),
+                                   atol=1e-5)
+        np.testing.assert_allclose(c1.numpy(), tc.detach().numpy(),
+                                   atol=1e-5)
+
+    def test_gru_cell_matches_torch(self):
+        cell = paddle.nn.GRUCell(4, 6)
+        tcell = torch.nn.GRUCell(4, 6)
+        _copy_cell_to_torch(cell, tcell)
+        x = rng.randn(3, 4).astype(np.float32)
+        h0 = rng.randn(3, 6).astype(np.float32)
+        h1, _ = cell(t(x), t(h0))
+        th = tcell(torch.tensor(x), torch.tensor(h0))
+        np.testing.assert_allclose(h1.numpy(), th.detach().numpy(),
+                                   atol=1e-5)
+
+    def test_multilayer_lstm_matches_torch(self):
+        net = paddle.nn.LSTM(4, 6, num_layers=2)
+        tnet = torch.nn.LSTM(4, 6, num_layers=2, batch_first=True)
+        with torch.no_grad():
+            for l in range(2):
+                cf = net.layers[l].cell
+                getattr(tnet, f"weight_ih_l{l}").copy_(
+                    torch.tensor(cf.weight_ih.numpy()))
+                getattr(tnet, f"weight_hh_l{l}").copy_(
+                    torch.tensor(cf.weight_hh.numpy()))
+                getattr(tnet, f"bias_ih_l{l}").copy_(
+                    torch.tensor(cf.bias_ih.numpy()))
+                getattr(tnet, f"bias_hh_l{l}").copy_(
+                    torch.tensor(cf.bias_hh.numpy()))
+        xs = rng.randn(3, 5, 4).astype(np.float32)
+        out, (h, c) = net(t(xs))
+        tout, (th, tc) = tnet(torch.tensor(xs))
+        np.testing.assert_allclose(out.numpy(), tout.detach().numpy(),
+                                   atol=1e-5)
+        np.testing.assert_allclose(h.numpy(), th.detach().numpy(),
+                                   atol=1e-5)
+        np.testing.assert_allclose(c.numpy(), tc.detach().numpy(),
+                                   atol=1e-5)
+
+    def test_bidirectional_shapes_and_grads(self):
+        net = paddle.nn.GRU(4, 6, direction="bidirect")
+        xs = rng.randn(2, 5, 4).astype(np.float32)
+        out, final = net(t(xs))
+        assert out.shape == [2, 5, 12]
+        assert final.shape == [2, 2, 6]
+        (out ** 2).mean().backward()
+        w = net.layers[0].cell_fw.weight_ih
+        assert w.grad is not None
+        assert np.isfinite(w.grad.numpy()).all()
+
+    def test_sequence_length_masks_outputs(self):
+        cell = paddle.nn.SimpleRNNCell(4, 6)
+        runner = paddle.nn.RNN(cell)
+        xs = rng.randn(3, 5, 4).astype(np.float32)
+        out, _ = runner(t(xs), sequence_length=t(np.array([5, 2, 4])))
+        o = out.numpy()
+        assert np.abs(o[1, 2:]).max() == 0.0
+        assert np.abs(o[2, 4:]).max() == 0.0
+        assert np.abs(o[0]).min() > 0.0
+
+    def test_rnn_training_reduces_loss(self):
+        paddle.seed(0)
+        net = paddle.nn.LSTM(8, 16)
+        head = paddle.nn.Linear(16, 1)
+        opt = paddle.optimizer.Adam(
+            1e-2, parameters=net.parameters() + head.parameters())
+        xs = t(rng.randn(8, 10, 8).astype(np.float32))
+        ys = t(rng.randn(8, 1).astype(np.float32))
+        losses = []
+        for _ in range(25):
+            out, (h, _) = net(xs)
+            pred = head(h[-1])
+            loss = ((pred - ys) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5
+
+
+class TestDecode:
+    def test_beam_search_shapes(self):
+        paddle.seed(0)
+        emb = paddle.nn.Embedding(11, 8)
+        cell = paddle.nn.GRUCell(8, 8)
+        proj = paddle.nn.Linear(8, 11)
+        dec = paddle.nn.BeamSearchDecoder(cell, start_token=1, end_token=2,
+                                          beam_size=3, embedding_fn=emb,
+                                          output_fn=proj)
+        h0 = t(rng.randn(2, 8).astype(np.float32))
+        out, states, lens = paddle.nn.dynamic_decode(
+            dec, inits=h0, max_step_num=6, return_length=True)
+        assert out.shape[0] == 2 and out.shape[2] == 3
+        assert lens.shape == [2, 3]
+
+    def test_gather_tree(self):
+        ids = t(np.array([[[1, 2, 3]], [[4, 5, 6]]], np.int64))
+        par = t(np.array([[[0, 0, 0]], [[2, 1, 0]]], np.int64))
+        got = F.gather_tree(ids, par).numpy()
+        assert got.tolist() == [[[3, 2, 1]], [[4, 5, 6]]]
+
+    def test_beam_scores_sorted(self):
+        paddle.seed(1)
+        emb = paddle.nn.Embedding(7, 4)
+        cell = paddle.nn.SimpleRNNCell(4, 4)
+        proj = paddle.nn.Linear(4, 7)
+        dec = paddle.nn.BeamSearchDecoder(cell, 1, 2, beam_size=2,
+                                          embedding_fn=emb, output_fn=proj)
+        inputs, states, fin = dec.initialize(
+            t(rng.randn(3, 4).astype(np.float32)))
+        out, states, inputs, fin = dec.step(0, inputs, states)
+        sc = out["scores"].numpy()
+        assert (np.diff(sc, axis=1) <= 1e-6).all()
+
+
+class TestNewLosses:
+    def test_rnnt_loss_vs_bruteforce(self):
+        B, T, U, V = 1, 3, 2, 4
+        acts = rng.randn(B, T, U + 1, V).astype(np.float32)
+        labels = np.array([[1, 2]], np.int64)
+        logp = acts - np.log(np.exp(acts).sum(-1, keepdims=True))
+        total = -np.inf
+        for comb in itertools.combinations(range(T + U - 1), U):
+            tpos, upos, lp, ok = 0, 0, 0.0, True
+            for step in range(T + U - 1):
+                if step in comb:
+                    if upos >= U:
+                        ok = False
+                        break
+                    lp += logp[0, tpos, upos, labels[0, upos]]
+                    upos += 1
+                else:
+                    if tpos >= T - 1:
+                        ok = False
+                        break
+                    lp += logp[0, tpos, upos, 0]
+                    tpos += 1
+            if ok and upos == U and tpos == T - 1:
+                lp += logp[0, T - 1, U, 0]
+                total = np.logaddexp(total, lp)
+        got = F.rnnt_loss(t(acts), t(labels), t(np.array([T])),
+                          t(np.array([U])), blank=0, reduction="none")
+        np.testing.assert_allclose(got.numpy(), [-total], atol=1e-4)
+
+    def test_rnnt_grads_finite(self):
+        acts = t(rng.randn(2, 4, 3, 5).astype(np.float32),
+                 stop_gradient=False)
+        loss = F.rnnt_loss(acts, t(np.array([[1, 2], [3, 4]], np.int64)),
+                           t(np.array([4, 4])), t(np.array([2, 2])))
+        loss.backward()
+        assert np.isfinite(acts.grad.numpy()).all()
+
+    def test_multi_margin_matches_torch(self):
+        x = rng.randn(5, 7).astype(np.float32)
+        y = rng.randint(0, 7, 5).astype(np.int64)
+        got = F.multi_margin_loss(t(x), t(y))
+        ref = TF.multi_margin_loss(torch.tensor(x), torch.tensor(y))
+        np.testing.assert_allclose(float(got.numpy()), float(ref),
+                                   atol=1e-6)
+
+    def test_triplet_wd_matches_torch(self):
+        a, pos, neg = [rng.randn(4, 8).astype(np.float32)
+                       for _ in range(3)]
+        got = F.triplet_margin_with_distance_loss(t(a), t(pos), t(neg))
+        ref = TF.triplet_margin_with_distance_loss(
+            torch.tensor(a), torch.tensor(pos), torch.tensor(neg))
+        np.testing.assert_allclose(float(got.numpy()), float(ref),
+                                   atol=1e-6)
+
+    def test_margin_ce_neutral_is_softmax_ce(self):
+        lg = rng.randn(4, 6).astype(np.float32) * 0.1
+        y = np.array([0, 1, 2, 3], np.int64)
+        got = F.margin_cross_entropy(t(lg), t(y), margin1=1.0, margin2=0.0,
+                                     margin3=0.0, scale=1.0)
+        sm = lg - np.log(np.exp(lg).sum(1, keepdims=True))
+        np.testing.assert_allclose(float(got.numpy()),
+                                   -sm[np.arange(4), y].mean(), atol=1e-5)
+
+    def test_hsigmoid_runs_with_grads(self):
+        w = t(rng.randn(16, 8).astype(np.float32), stop_gradient=False)
+        loss = F.hsigmoid_loss(t(rng.randn(3, 8).astype(np.float32)),
+                               t(np.array([0, 5, 9], np.int64)), 10, w)
+        assert loss.shape == [3, 1]
+        loss.sum().backward()
+        assert np.isfinite(w.grad.numpy()).all()
+
+    def test_layer_wrappers(self):
+        l1 = paddle.nn.MultiMarginLoss()
+        l2 = paddle.nn.RNNTLoss()
+        l3 = paddle.nn.HSigmoidLoss(8, 10)
+        assert callable(l1) and callable(l2) and callable(l3)
+
+
+class TestFunctionalAdditions:
+    def test_grid_sample_matches_torch(self):
+        x = rng.randn(2, 3, 5, 7).astype(np.float32)
+        g = (rng.rand(2, 4, 6, 2).astype(np.float32) * 2 - 1)
+        for mode in ["bilinear", "nearest"]:
+            got = F.grid_sample(t(x), t(g), mode=mode).numpy()
+            ref = TF.grid_sample(torch.tensor(x), torch.tensor(g),
+                                 mode=mode, padding_mode="zeros",
+                                 align_corners=True).numpy()
+            np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_affine_grid_matches_torch(self):
+        th = rng.randn(2, 2, 3).astype(np.float32)
+        got = F.affine_grid(t(th), [2, 3, 4, 5]).numpy()
+        ref = TF.affine_grid(torch.tensor(th), [2, 3, 4, 5],
+                             align_corners=True).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_max_pool_mask_and_unpool_match_torch(self):
+        xp = rng.randn(2, 3, 6, 6).astype(np.float32)
+        tout, tidx = TF.max_pool2d(torch.tensor(xp), 2,
+                                   return_indices=True)
+        pout, pidx = F.max_pool2d(t(xp), 2, return_mask=True)
+        assert (pidx.numpy() == tidx.numpy()).all()
+        got = F.max_unpool2d(pout, pidx, 2).numpy()
+        ref = TF.max_unpool2d(tout, tidx, 2).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+
+    def test_pairwise_and_sequence_mask(self):
+        a = rng.randn(4, 8).astype(np.float32)
+        b = rng.randn(4, 8).astype(np.float32)
+        got = F.pairwise_distance(t(a), t(b)).numpy()
+        ref = TF.pairwise_distance(torch.tensor(a),
+                                   torch.tensor(b)).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+        sm = F.sequence_mask(t(np.array([2, 0, 3], np.int64)),
+                             maxlen=4).numpy()
+        assert sm.tolist() == [[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]]
+
+    def test_sparse_attention_full_pattern_is_dense(self):
+        B, H, M, D = 1, 1, 4, 8
+        q, k, v = [rng.randn(B, H, M, D).astype(np.float32)
+                   for _ in range(3)]
+        off = np.tile(np.arange(0, M * M + 1, M, dtype=np.int32),
+                      (B, H, 1))
+        cols = np.tile(np.tile(np.arange(M, dtype=np.int32), M),
+                       (B, H, 1))
+        got = F.sparse_attention(t(q), t(k), t(v), t(off), t(cols)).numpy()
+        att = (q[0, 0] @ k[0, 0].T) / np.sqrt(D)
+        pr = np.exp(att - att.max(1, keepdims=True))
+        pr /= pr.sum(1, keepdims=True)
+        np.testing.assert_allclose(got[0, 0], pr @ v[0, 0], atol=1e-5)
+
+    def test_inplace_activations(self):
+        x = t(np.array([-1.0, 2.0], np.float32))
+        assert F.relu_(x) is x
+        assert x.numpy().tolist() == [0.0, 2.0]
+        F.tanh_(x)
+        np.testing.assert_allclose(x.numpy(), np.tanh([0.0, 2.0]),
+                                   atol=1e-6)
+
+
+class TestVisionOps:
+    V = paddle.vision.ops
+
+    def test_nms(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                          [20, 20, 30, 30]], np.float32)
+        keep = self.V.nms(t(boxes), 0.5,
+                          t(np.array([0.9, 0.8, 0.7], np.float32)))
+        assert keep.numpy().tolist() == [0, 2]
+
+    def test_roi_align_const_and_pool_max(self):
+        img = np.full((1, 1, 8, 8), 5.0, np.float32)
+        bxs = np.array([[1.0, 1.0, 6.0, 6.0]], np.float32)
+        out = self.V.roi_align(t(img), t(bxs),
+                               t(np.array([1], np.int32)), 2).numpy()
+        np.testing.assert_allclose(out, 5.0, atol=1e-5)
+        imgp = rng.randn(1, 2, 8, 8).astype(np.float32)
+        outp = self.V.roi_pool(t(imgp),
+                               t(np.array([[0., 0., 7., 7.]], np.float32)),
+                               t(np.array([1], np.int32)), 1).numpy()
+        np.testing.assert_allclose(outp[0, :, 0, 0],
+                                   imgp[0].max((1, 2)), atol=1e-6)
+
+    def test_deform_conv_zero_offset_is_conv(self):
+        x = rng.randn(1, 3, 6, 6).astype(np.float32)
+        wt = rng.randn(4, 3, 3, 3).astype(np.float32)
+        off = np.zeros((1, 18, 4, 4), np.float32)
+        got = self.V.deform_conv2d(t(x), t(off), t(wt)).numpy()
+        ref = TF.conv2d(torch.tensor(x), torch.tensor(wt)).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_yolo_box_and_prior_box_shapes(self):
+        feat = rng.randn(1, 3 * 7, 4, 4).astype(np.float32)
+        boxes, scores = self.V.yolo_box(
+            t(feat), t(np.array([[64, 64]], np.int32)),
+            [10, 13, 16, 30, 33, 23], 2, 0.01, 16)
+        assert boxes.shape == [1, 48, 4]
+        assert scores.shape == [1, 48, 2]
+        pb, pv = self.V.prior_box(
+            t(np.zeros((1, 3, 4, 4), np.float32)),
+            t(np.zeros((1, 3, 32, 32), np.float32)),
+            min_sizes=[8.0], aspect_ratios=[2.0])
+        assert pb.shape == pv.shape
+
+    def test_generate_and_distribute_proposals(self):
+        N, A, H, W = 1, 2, 4, 4
+        scores = rng.rand(N, A, H, W).astype(np.float32)
+        deltas = (rng.randn(N, 4 * A, H, W) * 0.1).astype(np.float32)
+        anchors = np.tile(np.array([[0, 0, 8, 8], [0, 0, 16, 16]],
+                                   np.float32), (H * W, 1))
+        var = np.ones_like(anchors)
+        rois, rs, rn = self.V.generate_proposals(
+            t(scores), t(deltas), t(np.array([[32, 32]], np.float32)),
+            t(anchors), t(var), pre_nms_top_n=10, post_nms_top_n=5,
+            return_rois_num=True)
+        assert rois.shape[1] == 4
+        assert int(rn.numpy()[0]) == rois.shape[0]
+        outs, restore, _ = self.V.distribute_fpn_proposals(
+            rois, 2, 5, 4, 224)
+        assert sum(o.shape[0] for o in outs) == rois.shape[0]
+
+    def test_matrix_nms_runs(self):
+        bb = rng.rand(1, 6, 4).astype(np.float32) * 20
+        bb[..., 2:] += bb[..., :2]
+        sc = rng.rand(1, 3, 6).astype(np.float32)
+        out, rn = self.V.matrix_nms(t(bb), t(sc), score_threshold=0.1,
+                                    post_threshold=0.0)
+        assert out.shape[1] == 6
+
+
+class TestDistributionAdditions:
+    def test_mvn_matches_torch(self):
+        loc = np.array([1.0, -1.0], np.float32)
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        mvn = paddle.distribution.MultivariateNormal(
+            loc, covariance_matrix=cov)
+        tm = torch.distributions.MultivariateNormal(
+            torch.tensor(loc), torch.tensor(cov))
+        v = np.array([0.3, 0.7], np.float32)
+        np.testing.assert_allclose(
+            float(mvn.log_prob(t(v)).numpy()),
+            float(tm.log_prob(torch.tensor(v))), atol=1e-5)
+        np.testing.assert_allclose(float(mvn.entropy().numpy()),
+                                   float(tm.entropy()), atol=1e-5)
+
+    def test_cb_matches_torch(self):
+        cb = paddle.distribution.ContinuousBernoulli(
+            np.array([0.3], np.float32))
+        tcb = torch.distributions.ContinuousBernoulli(torch.tensor([0.3]))
+        np.testing.assert_allclose(
+            float(cb.log_prob(t(np.array([0.6], np.float32))).numpy()),
+            float(tcb.log_prob(torch.tensor([0.6]))), atol=1e-5)
+        np.testing.assert_allclose(float(cb.mean.numpy()),
+                                   float(tcb.mean), atol=1e-5)
+        np.testing.assert_allclose(float(cb.entropy().numpy()),
+                                   float(tcb.entropy()), atol=1e-5)
+
+    def test_mvn_kl(self):
+        loc = np.zeros(2, np.float32)
+        m1 = paddle.distribution.MultivariateNormal(
+            loc + 1, covariance_matrix=np.eye(2, dtype=np.float32) * 2)
+        m2 = paddle.distribution.MultivariateNormal(
+            loc, covariance_matrix=np.eye(2, dtype=np.float32))
+        t1 = torch.distributions.MultivariateNormal(
+            torch.ones(2), torch.eye(2) * 2)
+        t2 = torch.distributions.MultivariateNormal(
+            torch.zeros(2), torch.eye(2))
+        np.testing.assert_allclose(
+            float(m1.kl_divergence(m2).numpy()),
+            float(torch.distributions.kl_divergence(t1, t2)), atol=1e-5)
+
+
+class TestSparseAdditions:
+    S = paddle.sparse
+
+    def _coo(self):
+        return self.S.sparse_coo_tensor(
+            np.array([[0, 1, 1], [1, 0, 2]]),
+            np.array([2., 3., 4.], np.float32), (2, 3))
+
+    def test_reshape_slice(self):
+        dense = np.array([[0, 2.0, 0], [3.0, 0, 4.0]], np.float32)
+        np.testing.assert_allclose(
+            self.S.reshape(self._coo(), (3, 2)).to_dense().numpy(),
+            dense.reshape(3, 2))
+        np.testing.assert_allclose(
+            self.S.slice(self._coo(), [1], [0], [2]).to_dense().numpy(),
+            dense[:, :2])
+
+    def test_addmm_isnan_deg2rad(self):
+        dense = np.array([[0, 2.0, 0], [3.0, 0, 4.0]], np.float32)
+        y = rng.randn(3, 4).astype(np.float32)
+        inp = rng.randn(2, 4).astype(np.float32)
+        am = self.S.addmm(t(inp), self._coo(), t(y), beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(am.numpy(), 0.5 * inp + 2 * (dense @ y),
+                                   atol=1e-5)
+        assert not self.S.isnan(self._coo()).to_dense().numpy().any()
+        np.testing.assert_allclose(
+            self.S.deg2rad(self._coo()).to_dense().numpy(),
+            np.deg2rad(dense), atol=1e-6)
+
+    def test_coalesce_merges_duplicates(self):
+        coo = self.S.sparse_coo_tensor(
+            np.array([[0, 0], [1, 1]]), np.array([1., 2.], np.float32),
+            (2, 3))
+        c = self.S.coalesce(coo)
+        assert c.nnz() == 1
+        assert float(c.to_dense().numpy()[0, 1]) == 3.0
+
+
+class TestNewOptimizers:
+    def test_rprop_converges(self):
+        paddle.seed(0)
+        w = t(np.array([4.0, -3.0], np.float32), stop_gradient=False)
+        opt = paddle.optimizer.Rprop(learning_rate=0.1, parameters=[w])
+        for _ in range(60):
+            loss = (w * w).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert abs(w.numpy()).max() < 1e-3
+
+    def test_lbfgs_solves_quadratic(self):
+        x = t(np.array([3.0, -2.0], np.float32), stop_gradient=False)
+        opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=30,
+                                     line_search_fn="strong_wolfe",
+                                     parameters=[x])
+        A = np.array([[3.0, 0.5], [0.5, 1.0]], np.float32)
+
+        def closure():
+            opt.clear_grad()
+            loss = (x.matmul(t(A)) * x).sum()
+            loss.backward()
+            return loss
+        loss = opt.step(closure)
+        assert float(loss) < 1e-8
+
+
+class TestDistributedExtras:
+    def test_strategy_and_misc(self):
+        dist = paddle.distributed
+        s = dist.Strategy({"pipeline": {"enable": True,
+                                        "accumulate_steps": 4}})
+        assert s.pipeline.enable and s.pipeline.accumulate_steps == 4
+        assert dist.is_available()
+        assert dist.get_backend() == "XCCL"
+        assert dist.ReduceType.kRedSum == 0
+
+    def test_object_collectives_single_process(self):
+        objs = [{"a": 1}, [2, 3]]
+        paddle.distributed.broadcast_object_list(objs, src=0)
+        assert objs == [{"a": 1}, [2, 3]]
+        out = [None]
+        paddle.distributed.scatter_object_list(out, [[5]], src=0)
+        assert out == [[5]]
+
+    def test_entries_validate(self):
+        dist = paddle.distributed
+        with pytest.raises(ValueError):
+            dist.ProbabilityEntry(1.5)
+        with pytest.raises(ValueError):
+            dist.CountFilterEntry(-1)
+        assert "show" in dist.ShowClickEntry("show", "clk")._to_attr()
+
+    def test_inmemory_dataset(self, tmp_path):
+        f = tmp_path / "data.txt"
+        f.write_text("a\nb\nc\n")
+        ds = paddle.distributed.InMemoryDataset()
+        ds.init(batch_size=1)
+        ds.set_filelist([str(f)])
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 3
+        assert sorted(ds) == ["a", "b", "c"]
