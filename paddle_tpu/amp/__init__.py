@@ -209,3 +209,15 @@ class debugging:
     def disable_tensor_checker():
         from ..core import flags
         flags.set_flags({"check_nan_inf": 0})
+
+
+def is_float16_supported(device=None):
+    """(parity: paddle.amp.is_float16_supported) — TPUs compute fp16 via
+    bf16/fp32 paths; XLA accepts the dtype."""
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    """(parity: paddle.amp.is_bfloat16_supported) — bf16 is the native
+    MXU dtype."""
+    return True

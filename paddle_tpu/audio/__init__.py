@@ -17,7 +17,8 @@ from ..core.dispatch import run_op
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
 
-__all__ = ["functional", "features"]
+__all__ = ["functional", "features", "backends", "datasets", "load",
+           "save", "info"]
 
 
 class functional:
@@ -220,3 +221,8 @@ class features:
             return run_op("mfcc",
                           lambda s: jnp.einsum("mk,...mt->...kt", dct, s),
                           (lm,))
+
+
+from . import backends  # noqa: E402
+from . import datasets  # noqa: E402
+from .backends import info, load, save  # noqa: E402,F401

@@ -1,0 +1,98 @@
+"""Audio IO backends (parity: python/paddle/audio/backends/ — load/save/
+info dispatch over a selected backend). The in-tree backend decodes
+16/32-bit PCM WAV with the stdlib wave module — no soundfile dependency;
+the reference's default ("wave_backend") has the same scope.
+"""
+from __future__ import annotations
+
+import wave as _wave
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["list_available_backends", "get_current_backend", "set_backend",
+           "load", "save", "info", "AudioInfo"]
+
+_BACKEND = "wave_backend"
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return _BACKEND
+
+
+def set_backend(backend_name):
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"backend {backend_name} is unavailable; only the stdlib "
+            "wave_backend ships in the TPU build")
+
+
+class AudioInfo:
+    """(parity: paddle.audio.backends.backend.AudioInfo)"""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath):
+    """(parity: paddle.audio.info)"""
+    with _wave.open(filepath, "rb") as w:
+        return AudioInfo(w.getframerate(), w.getnframes(),
+                         w.getnchannels(), w.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Load a PCM WAV file (parity: paddle.audio.load). Returns
+    (waveform Tensor, sample_rate)."""
+    with _wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        n = w.getnframes()
+        ch = w.getnchannels()
+        width = w.getsampwidth()
+        w.setpos(min(frame_offset, n))
+        count = n - frame_offset if num_frames < 0 else num_frames
+        raw = w.readframes(count)
+    dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dt).reshape(-1, ch)
+    if normalize:
+        if width == 1:
+            arr = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            arr = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    else:
+        arr = data
+    if channels_first:
+        arr = arr.T
+    return Tensor(jnp.asarray(arr)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16):
+    """Write a PCM WAV file (parity: paddle.audio.save)."""
+    arr = np.asarray(src._data if isinstance(src, Tensor) else src)
+    if channels_first:
+        arr = arr.T  # (T, C)
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    width = bits_per_sample // 8
+    if np.issubdtype(arr.dtype, np.floating):
+        scale = float(2 ** (bits_per_sample - 1) - 1)
+        arr = np.clip(arr, -1.0, 1.0) * scale
+        arr = arr.astype({2: np.int16, 4: np.int32}[width])
+    with _wave.open(filepath, "wb") as w:
+        w.setnchannels(arr.shape[1])
+        w.setsampwidth(width)
+        w.setframerate(int(sample_rate))
+        w.writeframes(arr.tobytes())

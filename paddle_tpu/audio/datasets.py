@@ -1,0 +1,112 @@
+"""Audio datasets (parity: python/paddle/audio/datasets/ — TESS, ESC50).
+Local-directory contract (no network egress in this environment)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+from . import backends as _backends
+
+__all__ = ["TESS", "ESC50"]
+
+
+def _need_dir(path, what):
+    if path is None or not os.path.isdir(path):
+        raise FileNotFoundError(
+            f"{what}: this environment has no network egress — pass the "
+            "local dataset directory (the reference downloads an archive)")
+
+
+class _FolderAudioDataset(Dataset):
+    def __init__(self, data_dir, feat_type="raw", archive=None, **kwargs):
+        super().__init__()
+        self.feat_type = feat_type
+        self.files = []
+        self.labels = []
+        self._scan(data_dir)
+        self._feat_kwargs = kwargs
+
+    def _scan(self, data_dir):
+        raise NotImplementedError
+
+    def _features(self, wav, sr):
+        if self.feat_type == "raw":
+            return wav
+        if self.feat_type == "melspectrogram":
+            from . import features
+            mel = features.MelSpectrogram(sr=sr, **self._feat_kwargs)
+            return mel(wav)
+        raise ValueError(f"unsupported feat_type {self.feat_type}")
+
+    def __getitem__(self, idx):
+        wav, sr = _backends.load(self.files[idx])
+        feat = self._features(wav, sr)
+        return feat, np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+class TESS(_FolderAudioDataset):
+    """Toronto emotional speech set (parity: paddle.audio.datasets.TESS):
+    <data_dir>/<speaker>_<word>_<emotion>.wav layout or nested dirs."""
+
+    emotions = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                "sad"]
+
+    def __init__(self, mode="train", data_dir=None, n_folds=5,
+                 split=1, feat_type="raw", archive=None, **kwargs):
+        _need_dir(data_dir, "TESS")
+        self.mode = mode
+        self.n_folds = n_folds
+        self.split = split
+        super().__init__(data_dir, feat_type, archive, **kwargs)
+
+    def _scan(self, data_dir):
+        wavs = []
+        for root, _, files in os.walk(data_dir):
+            for f in sorted(files):
+                if f.lower().endswith(".wav"):
+                    wavs.append(os.path.join(root, f))
+        for i, path in enumerate(wavs):
+            emotion = os.path.splitext(os.path.basename(path))[0] \
+                .split("_")[-1].lower()
+            if emotion not in self.emotions:
+                continue
+            fold = i % self.n_folds + 1
+            keep = (fold != self.split) if self.mode == "train" \
+                else (fold == self.split)
+            if keep:
+                self.files.append(path)
+                self.labels.append(self.emotions.index(emotion))
+
+
+class ESC50(_FolderAudioDataset):
+    """ESC-50 environmental sounds (parity: paddle.audio.datasets.ESC50):
+    <data_dir>/audio/<fold>-*.wav names '{fold}-{src}-{take}-{target}.wav'."""
+
+    def __init__(self, mode="train", data_dir=None, split=1,
+                 feat_type="raw", archive=None, **kwargs):
+        _need_dir(data_dir, "ESC50")
+        self.mode = mode
+        self.split = split
+        super().__init__(data_dir, feat_type, archive, **kwargs)
+
+    def _scan(self, data_dir):
+        audio_dir = os.path.join(data_dir, "audio")
+        if not os.path.isdir(audio_dir):
+            audio_dir = data_dir
+        for f in sorted(os.listdir(audio_dir)):
+            if not f.lower().endswith(".wav"):
+                continue
+            parts = os.path.splitext(f)[0].split("-")
+            if len(parts) != 4:
+                continue
+            fold, target = int(parts[0]), int(parts[3])
+            keep = (fold != self.split) if self.mode == "train" \
+                else (fold == self.split)
+            if keep:
+                self.files.append(os.path.join(audio_dir, f))
+                self.labels.append(target)

@@ -113,3 +113,25 @@ def jacobian(ys, xs, batch_axis=None):
 def hessian(func, xs, batch_axis=None):
     raise NotImplementedError(
         "use jax.hessian on a functional model (paddle_tpu.jit)")
+
+
+class saved_tensors_hooks:
+    """Context manager installing pack/unpack hooks for tensors saved for
+    backward (parity: paddle.autograd.saved_tensors_hooks,
+    python/paddle/autograd/saved_tensors_hooks.py). The tape applies
+    pack_hook when an op records its inputs and unpack_hook when backward
+    reads them."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from ..core import autograd as _ag
+        _ag._saved_tensor_hooks.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        from ..core import autograd as _ag
+        _ag._saved_tensor_hooks.pop()
+        return False

@@ -137,6 +137,21 @@ class TapeNode:
         self.single_out = single_out
 
 
+# saved-tensors pack/unpack hook stack (parity: the reference's
+# PyLayer saved_tensors_hooks; installed via
+# paddle.autograd.saved_tensors_hooks). When active, ops record packed
+# inputs and defer jax.vjp to backward time (recompute-from-unpacked).
+_saved_tensor_hooks: List[Tuple[Any, Any]] = []
+
+
+def saved_hooks_active() -> bool:
+    return bool(_saved_tensor_hooks)
+
+
+def current_saved_hooks():
+    return _saved_tensor_hooks[-1]
+
+
 def _toposort(roots: Sequence[TapeNode]) -> List[TapeNode]:
     """Reverse DFS postorder over the producer DAG: consumers before producers."""
     seen = set()

@@ -156,13 +156,33 @@ def _run_op_impl(name, jax_fn, operands, num_nondiff_outputs,
                 buf[i] = diff_arrays[k]
             return jax_fn(*buf)
 
-        outs, raw_vjp = jax.vjp(f, *[arrays[i] for i in diff_idx])
         node_inputs = [operands[i] for i in diff_idx]
+        if _ag.saved_hooks_active():
+            # pack saved inputs now; defer jax.vjp to backward time and
+            # recompute from the unpacked values (the offload use case of
+            # paddle.autograd.saved_tensors_hooks)
+            pack, unpack = _ag.current_saved_hooks()
+            packed = [pack(t) for t in node_inputs]
+            outs = jax_fn(*arrays)
+            single = not isinstance(outs, tuple)
 
-        def vjp_fn(cts, _raw=raw_vjp, _single=not isinstance(outs, tuple)):
-            if _single:
-                return _raw(cts[0])
-            return _raw(tuple(cts))
+            def vjp_fn(cts, _packed=packed, _unpack=unpack, _f=f,
+                       _single=single):
+                vals = []
+                for obj in _packed:
+                    v = _unpack(obj)
+                    vals.append(v._data if isinstance(v, Tensor)
+                                else jnp.asarray(v))
+                _, raw = jax.vjp(_f, *vals)
+                return raw(cts[0]) if _single else raw(tuple(cts))
+        else:
+            outs, raw_vjp = jax.vjp(f, *[arrays[i] for i in diff_idx])
+            single = not isinstance(outs, tuple)
+
+            def vjp_fn(cts, _raw=raw_vjp, _single=single):
+                if _single:
+                    return _raw(cts[0])
+                return _raw(tuple(cts))
 
         out_list = outs if isinstance(outs, tuple) else (outs,)
         node = _ag.TapeNode(

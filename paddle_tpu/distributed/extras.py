@@ -106,7 +106,7 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
     if in_object_list is None:
         in_object_list = []
     if world <= 1:
-        out_object_list[:] = list(in_object_list[:1]) or [None]
+        out_object_list[:] = list(in_object_list) or [None]
         return out_object_list
     if len(in_object_list) % world != 0:
         raise ValueError(

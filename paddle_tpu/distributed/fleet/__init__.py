@@ -9,3 +9,7 @@ from .meta_parallel import (PipelineLayer, LayerDesc, SharedLayerDesc,  # noqa: 
                             get_rng_state_tracker)
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from . import elastic  # noqa: F401
+from .role_makers import (Role, PaddleCloudRoleMaker,  # noqa: E402,F401
+                           UserDefinedRoleMaker, UtilBase,
+                           MultiSlotDataGenerator,
+                           MultiSlotStringDataGenerator)

@@ -1,0 +1,165 @@
+"""Role makers + util base + data generators (parity:
+python/paddle/distributed/fleet/base/role_maker.py, util_base.py,
+data_generator/).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+           "UtilBase", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class Role:
+    """(parity: fleet.base.role_maker.Role)"""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """Reads the PADDLE_TRAINER_* env contract (parity:
+    fleet.PaddleCloudRoleMaker — the collective path)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._endpoints = eps.split(",") if eps else []
+
+    def worker_index(self):
+        return self._rank
+
+    def worker_num(self):
+        return self._size
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self._rank == 0
+
+    def role(self):
+        return Role.WORKER
+
+    def get_trainer_endpoints(self):
+        return self._endpoints
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit ranks instead of env (parity: fleet.UserDefinedRoleMaker)."""
+
+    def __init__(self, is_collective=True, init_gloo=False, **kwargs):
+        super().__init__(is_collective)
+        self._rank = kwargs.get("current_id", 0)
+        self._size = kwargs.get("worker_num",
+                                len(kwargs.get("worker_endpoints", [])) or 1)
+        self._endpoints = kwargs.get("worker_endpoints", [])
+        self._role = kwargs.get("role", Role.WORKER)
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def role(self):
+        return self._role
+
+
+class UtilBase:
+    """Cross-worker utilities (parity: fleet.UtilBase,
+    fleet/base/util_factory.py) over the collective API."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from .. import communication as C
+        from ...core.tensor import Tensor
+        t = input if isinstance(input, Tensor) else Tensor(np.asarray(input))
+        op = {"sum": C.ReduceOp.SUM, "max": C.ReduceOp.MAX,
+              "min": C.ReduceOp.MIN}[mode]
+        out = C.all_reduce(t, op=op)
+        return np.asarray((out if out is not None else t).numpy())
+
+    def barrier(self, comm_world="worker"):
+        from .. import communication as C
+        C.barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        from .. import communication as C
+        from ...core.tensor import Tensor
+        import numpy as np
+        t = input if isinstance(input, Tensor) else Tensor(np.asarray(input))
+        outs = []
+        C.all_gather(outs, t)
+        return [np.asarray(o.numpy()) for o in outs]
+
+    def get_file_shard(self, files):
+        import os as _os
+        rank = int(_os.environ.get("PADDLE_TRAINER_ID", "0"))
+        size = int(_os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        return files[rank::size]
+
+    def print_on_rank(self, message, rank_id=0):
+        import os as _os
+        if int(_os.environ.get("PADDLE_TRAINER_ID", "0")) == rank_id:
+            print(message)
+
+
+class _DataGeneratorBase:
+    """line -> sample generator -> batched slot output (parity:
+    fleet.data_generator — feeds the PS/QueueDataset pipeline)."""
+
+    def __init__(self):
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "implement generate_sample returning an iterator of "
+            "(name, value-list) tuples")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            for out in self._lines_out(line):
+                sys.stdout.write(out)
+
+    def _lines_out(self, line):
+        gen = self.generate_sample(line)
+        for sample in gen():
+            yield self._format(sample)
+
+
+class MultiSlotDataGenerator(_DataGeneratorBase):
+    def _format(self, sample):
+        parts = []
+        for name, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(_DataGeneratorBase):
+    def _format(self, sample):
+        parts = []
+        for name, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"

@@ -1,3 +1,205 @@
 """Incubating features (parity: python/paddle/incubate/)."""
 from . import moe  # noqa: F401
 from . import nn  # noqa: F401
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax in one op (parity:
+    paddle.incubate.softmax_mask_fuse_upper_triangle — the fused CUDA
+    kernel; XLA fuses the mask+softmax into one kernel here)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import run_op
+
+    def fn(a):
+        q = a.shape[-2]
+        k = a.shape[-1]
+        mask = jnp.tril(jnp.ones((q, k), bool))
+        return jax.nn.softmax(jnp.where(mask, a, -1e4), axis=-1)
+    return run_op("softmax_mask_fuse_upper_triangle", fn, (x,))
+
+
+def softmax_mask_fuse(x, mask):
+    """(parity: paddle.incubate.softmax_mask_fuse)"""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import run_op
+
+    def fn(a, m):
+        return jax.nn.softmax(a + m, axis=-1)
+    return run_op("softmax_mask_fuse", fn, (x, mask))
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as a loss (parity: paddle.incubate.identity_loss)."""
+    from ..core.dispatch import run_op
+    import jax.numpy as jnp
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+
+    def fn(a):
+        if red == "mean":
+            return jnp.mean(a)
+        if red == "sum":
+            return jnp.sum(a)
+        return a
+    return run_op("identity_loss", fn, (x,))
+
+
+# graph ops delegate to the geometric package (same kernels)
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, flag_buffer_hashtable=False,
+                  name=None):
+    from ..geometric import reindex_graph
+    return reindex_graph(x, neighbors, count, value_buffer, index_buffer)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    from ..geometric import sample_neighbors
+    return sample_neighbors(row, colptr, input_nodes, sample_size,
+                            eids=eids, return_eids=return_eids)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (parity:
+    paddle.incubate.graph_khop_sampler) — repeated one-hop sampling with
+    reindexing."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    from ..geometric import reindex_graph, sample_neighbors
+    cur = input_nodes
+    frontiers, all_neigh, all_cnt = [], [], []
+    for sz in sample_sizes:
+        nb, cnt = sample_neighbors(row, colptr, cur, sample_size=sz)
+        frontiers.append(cur)
+        all_neigh.append(nb)
+        all_cnt.append(cnt)
+        cur = nb  # next frontier = sampled neighbors
+    # reindex against every source frontier: len(count) == len(x) holds
+    xs = Tensor(jnp.concatenate(
+        [f._data if isinstance(f, Tensor) else jnp.asarray(f)
+         for f in frontiers]))
+    neighbors = Tensor(jnp.concatenate([n._data for n in all_neigh]))
+    counts = Tensor(jnp.concatenate([c._data for c in all_cnt]))
+    src, dst, nodes = reindex_graph(xs, neighbors, counts)
+    return src, dst, nodes, counts
+
+
+from ..geometric import (segment_max, segment_mean, segment_min,  # noqa: E402,F401
+                         segment_sum)
+
+
+class LookAhead:
+    """Lookahead optimizer wrapper (parity: paddle.incubate.LookAhead,
+    python/paddle/incubate/optimizer/lookahead.py): every k steps the
+    slow weights move alpha toward the fast weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_num = 0
+        self._slow = None
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        import jax.numpy as jnp
+        self.inner_optimizer.step()
+        params = self.inner_optimizer._parameter_list
+        if self._slow is None:
+            self._slow = [p._data for p in params]
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            for i, p in enumerate(params):
+                slow = self._slow[i] + self.alpha * (p._data - self._slow[i])
+                self._slow[i] = slow
+                p._data = slow.astype(p._data.dtype)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_num
+        return sd
+
+
+class ModelAverage:
+    """Exponential/window average of parameters for eval (parity:
+    paddle.incubate.ModelAverage,
+    python/paddle/incubate/optimizer/modelaverage.py)."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        self._sum = [p._data * 0 for p in self._params]
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        for i, p in enumerate(self._params):
+            self._sum[i] = self._sum[i] + p._data
+        self._count += 1
+        window = max(self._min_w, min(
+            self._max_w, int(self._count * self._rate) or 1))
+        if self._count > window:
+            # restart accumulation from the current average
+            for i in range(len(self._params)):
+                self._sum[i] = self._sum[i] / self._count * window
+            self._count = window
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap in averaged params (context-manager style)."""
+        self._backup = [p._data for p in self._params]
+        n = max(self._count, 1)
+        for i, p in enumerate(self._params):
+            p._data = (self._sum[i] / n).astype(p._data.dtype)
+
+        class _Ctx:
+            def __init__(self, outer, restore):
+                self.outer = outer
+                self.restore = restore
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                if self.restore:
+                    self.outer.restore()
+                return False
+        return _Ctx(self, need_restore)
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self._params, self._backup):
+                p._data = b
+            self._backup = None
+
+    def minimize(self, loss):
+        self.step()

@@ -357,3 +357,47 @@ class DataLoader:
             if item is stop:
                 break
             yield item
+
+
+class SubsetRandomSampler(Sampler):
+    """Sample a fixed subset in random order (parity:
+    paddle.io.SubsetRandomSampler)."""
+
+    def __init__(self, indices):
+        if len(indices) == 0:
+            raise ValueError(
+                "SubsetRandomSampler requires a non-empty index list")
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import numpy as _np
+        order = _np.random.permutation(len(self.indices))
+        return iter([self.indices[i] for i in order])
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class ConcatDataset(Dataset):
+    """Concatenation of datasets (parity: paddle.io.ConcatDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("datasets should not be an empty iterable")
+        self.cumulative_sizes = []
+        total = 0
+        for d in self.datasets:
+            total += len(d)
+            self.cumulative_sizes.append(total)
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+    def __getitem__(self, idx):
+        import bisect
+        if idx < 0:
+            idx += len(self)
+        ds = bisect.bisect_right(self.cumulative_sizes, idx)
+        prev = self.cumulative_sizes[ds - 1] if ds > 0 else 0
+        return self.datasets[ds][idx - prev]

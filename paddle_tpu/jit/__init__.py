@@ -254,3 +254,43 @@ def load(path, **configs):
         with open(path + _META_SUFFIX) as f:
             meta = json.load(f)
     return TranslatedLayer(exported, state, meta)
+
+
+_IGNORED_MODULES = []
+_CODE_LEVEL = 0
+_VERBOSITY = 0
+_TO_STATIC_ENABLED = True
+
+
+def ignore_module(modules):
+    """Mark modules whose calls to_static should not trace into (parity:
+    paddle.jit.ignore_module — the SOT skip list). Tracing here is
+    jax.jit, which inlines everything; the list is honored by to_static's
+    fallback check."""
+    global _IGNORED_MODULES
+    _IGNORED_MODULES += list(modules)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """(parity: paddle.jit.set_code_level — controls transformed-code
+    logging)."""
+    global _CODE_LEVEL
+    _CODE_LEVEL = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """(parity: paddle.jit.set_verbosity)"""
+    global _VERBOSITY
+    _VERBOSITY = level
+
+
+def enable_to_static(enable=True):
+    """Globally toggle to_static tracing (parity:
+    paddle.jit.enable_to_static). When off, to_static returns the
+    original callable."""
+    global _TO_STATIC_ENABLED
+    _TO_STATIC_ENABLED = bool(enable)
+
+
+def _to_static_enabled():
+    return _TO_STATIC_ENABLED

@@ -202,3 +202,18 @@ class Auc(Metric):
 
     def name(self):
         return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy of a batch (parity: paddle.metric.accuracy,
+    python/paddle/metric/metrics.py functional form)."""
+    import jax.numpy as jnp
+    from ..core.dispatch import run_op
+
+    def fn(pred, lab):
+        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        lab2 = lab.reshape(lab.shape[0], -1)[:, :1]
+        hit = (topk == lab2).any(axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))[None]
+    return run_op("accuracy", fn, (input, label),
+                  out_stop_gradient=True)

@@ -113,8 +113,12 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         ops.append(bias)
     out = run_op("batch_norm", fn, tuple(ops))
 
-    if use_batch_stats and running_mean is not None:
-        # eager running-stat update (outside autograd)
+    from ...static import Variable as _StaticVar
+    if use_batch_stats and running_mean is not None \
+            and not isinstance(x, _StaticVar):
+        # eager running-stat update (outside autograd; static-mode
+        # Variables skip it — the recorded program normalizes with batch
+        # stats and the reference's static pass owns the moving averages)
         arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
         axes = tuple(i for i in range(arr.ndim) if i != (ch_axis % arr.ndim))
         m = jnp.mean(arr.astype(jnp.float32), axis=axes)

@@ -17,7 +17,7 @@ from ...core.tensor import Tensor
 __all__ = [
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-    "Assign", "Dirac", "Orthogonal", "calculate_gain", "set_global_initializer",
+    "Assign", "Dirac", "Orthogonal", "calculate_gain", "set_global_initializer", "Bilinear",
 ]
 
 _GLOBAL_INIT = [None, None]  # (weight_init, bias_init)
@@ -185,3 +185,28 @@ class Orthogonal(Initializer):
         if rows < cols:
             q = q.T
         return (self.gain * q[:rows, :cols]).reshape(shape).astype(convert_dtype(dtype))
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel init for transposed conv (parity:
+    paddle.nn.initializer.Bilinear,
+    python/paddle/nn/initializer/Bilinear)."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+        dt = convert_dtype(dtype)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer requires a 4-D weight")
+        if shape[2] != shape[3]:
+            raise ValueError("Bilinear kernel must be square")
+        k = shape[2]
+        f = int(np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        w = np.zeros(shape, np.float32)
+        rng_ = np.arange(k)
+        filt = (1 - np.abs(rng_ / f - c))
+        kernel = filt[:, None] * filt[None, :]
+        for i in range(shape[0]):
+            for j in range(shape[1]):
+                w[i, j] = kernel
+        return jnp.asarray(w).astype(dt)

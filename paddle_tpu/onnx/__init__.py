@@ -1,0 +1,24 @@
+"""paddle.onnx (parity: python/paddle/onnx/__init__.py — export).
+
+The reference delegates to the external paddle2onnx converter. This build
+has no ONNX runtime in-image; export() lowers the traced model through the
+jit.save StableHLO path (the portable interchange format of the XLA
+stack) and writes <path>.onnx.* artifacts. A true ONNX protobuf writer
+would require the onnx package (not in-image).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export a Layer for interchange (parity: paddle.onnx.export's
+    signature; artifact format is StableHLO, see module docstring)."""
+    from ..jit import save as jit_save
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    jit_save(layer, path + ".onnx", input_spec=input_spec)
+    return path + ".onnx"

@@ -16,7 +16,8 @@ from .. import nn as _nn
 from ..core.dispatch import run_op
 from ..core.tensor import Tensor
 
-__all__ = ["quantize_linear", "dequantize_linear", "abs_max_scale",
+__all__ = ["BaseQuanter", "BaseObserver", "quanter",
+           "quantize_linear", "dequantize_linear", "abs_max_scale",
            "channel_wise_abs_max_scale", "FakeQuanterWithAbsMax",
            "FakeQuanterChannelWiseAbsMax", "AbsmaxObserver", "HistObserver",
            "QuantConfig", "QAT", "PTQ", "WeightOnlyLinear",
@@ -385,3 +386,57 @@ class WeightOnlyLinear(_nn.Layer):
         if self.bias is not None:
             out = out + self.bias
         return out
+
+
+class BaseQuanter:
+    """Abstract trainable quanter (parity: paddle.quantization.BaseQuanter,
+    python/paddle/quantization/factory.py). Subclasses implement
+    forward/scales/zero_points."""
+
+    def forward(self, input):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        raise NotImplementedError
+
+    def bit_length(self):
+        return 8
+
+
+class BaseObserver(BaseQuanter):
+    """Abstract calibration observer (parity:
+    paddle.quantization.BaseObserver)."""
+
+    def cal_thresholds(self):
+        raise NotImplementedError
+
+
+class _QuanterFactory:
+    def __init__(self, cls, *args, **kwargs):
+        self.cls = cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def _instance(self, layer=None):
+        return self.cls(*self.args, **self.kwargs)
+
+    def __call__(self, *args, **kwargs):
+        return self.cls(*args, **kwargs)
+
+
+def quanter(name):
+    """Class decorator registering a quanter and generating its partial-
+    construction factory (parity: paddle.quantization.quanter)."""
+    def deco(cls):
+        import sys
+        mod = sys.modules[__name__]
+
+        def factory(*args, **kwargs):
+            return _QuanterFactory(cls, *args, **kwargs)
+        factory.__name__ = name
+        setattr(mod, name, factory)
+        return cls
+    return deco

@@ -484,3 +484,18 @@ class name_scope:
 
     def __exit__(self, *exc):
         return False
+
+from .extras import (append_backward, gradients, BuildStrategy,  # noqa: E402,F401
+                     CompiledProgram, ExecutionStrategy, ipu_shard_guard,
+                     IpuCompiledProgram, IpuStrategy, set_ipu_shard, Print,
+                     py_func, WeightNormParamAttr,
+                     ExponentialMovingAverage, save, load,
+                     save_inference_model, load_inference_model,
+                     serialize_program, serialize_persistables,
+                     save_to_file, deserialize_program,
+                     deserialize_persistables, load_from_file,
+                     normalize_program, load_program_state,
+                     set_program_state, cpu_places, cuda_places,
+                     xpu_places, create_global_var, create_parameter,
+                     accuracy, auc, device_guard, ctr_metric_bundle)
+from . import nn  # noqa: E402,F401

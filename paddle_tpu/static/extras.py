@@ -1,0 +1,597 @@
+"""Remaining paddle.static surface (parity: python/paddle/static/
+__init__.py — program serialization, grads, strategies, EMA, metrics).
+
+The static substrate here is the recorded OpNode DAG (static/__init__.py);
+"programs" serialize as pickled graphs + numpy params, and gradient APIs
+delegate to the same jax.grad machinery the Executor's train path uses.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "append_backward", "gradients", "BuildStrategy", "CompiledProgram",
+    "ExecutionStrategy", "ipu_shard_guard", "IpuCompiledProgram",
+    "IpuStrategy", "set_ipu_shard", "Print", "py_func",
+    "WeightNormParamAttr", "ExponentialMovingAverage", "save", "load",
+    "save_inference_model", "load_inference_model", "serialize_program",
+    "serialize_persistables", "save_to_file", "deserialize_program",
+    "deserialize_persistables", "load_from_file", "normalize_program",
+    "load_program_state", "set_program_state", "cpu_places", "cuda_places",
+    "xpu_places", "create_global_var", "create_parameter", "accuracy",
+    "auc", "device_guard", "ctr_metric_bundle",
+]
+
+
+def _prog():
+    from . import default_main_program
+    return default_main_program()
+
+
+# -- gradients -------------------------------------------------------------
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Symbolic grads of targets w.r.t. inputs (parity: static.gradients).
+    Adds grad OpNodes producing d(sum(targets))/d(inputs)."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    # each grad is one OpNode whose jax_fn rebuilds the target subgraph
+    # functionally and differentiates it with jax.grad at compile time
+    return [_symbolic_grad(targets, inp, target_gradients)
+            for inp in inputs]
+
+
+def _symbolic_grad(targets, inp, target_gradients=None):
+    from . import Variable, record_op, _reachable
+    from ..core.tensor import Tensor
+    prog = inp.program if isinstance(inp, Variable) else _prog()
+
+    nodes = _reachable([t for t in targets])
+
+    def fn(inp_arr, *leaf_arrs):
+        # rebuild the forward subgraph with inp replaced by inp_arr;
+        # other leaves (params AND other feed Variables) arrive in
+        # leaf_arrs in registration order
+        leaves = list(leaf_arrs)
+
+        def fwd(x):
+            env = {id(inp): x}
+            li = iter(leaves)
+            leaf_map = {}
+
+            def resolve(o):
+                if isinstance(o, Variable):
+                    if id(o) in env:
+                        return env[id(o)]
+                    if id(o) not in leaf_map:
+                        leaf_map[id(o)] = next(li)
+                    return leaf_map[id(o)]
+                if isinstance(o, Tensor):
+                    if id(o) not in leaf_map:
+                        leaf_map[id(o)] = next(li)
+                    return leaf_map[id(o)]
+                return o
+            total = 0.0
+            for node in prog.nodes:
+                if node not in nodes:
+                    continue
+                vals = node.jax_fn(*[resolve(o) for o in node.operands])
+                vals = vals if isinstance(vals, tuple) else (vals,)
+                for var, v in zip(node.outputs, vals):
+                    env[id(var)] = v
+            for t in targets:
+                total = total + jnp.sum(env[id(t)])
+            return total
+        return jax.grad(fwd)(inp_arr)
+
+    # every leaf feeding the subgraph except inp itself: Tensor params
+    # and other input Variables, in traversal order (matches leaf_map's
+    # first-touch order inside fwd)
+    leaf_ops = []
+    seen = {id(inp)}
+    for node in prog.nodes:
+        if node not in nodes:
+            continue
+        for o in node.operands:
+            if id(o) in seen:
+                continue
+            if isinstance(o, Tensor) or (isinstance(o, Variable)
+                                         and o.is_input):
+                seen.add(id(o))
+                leaf_ops.append(o)
+    return record_op(f"grad_of_{getattr(inp, 'name', 'x')}", fn,
+                     (inp, *leaf_ops))
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """(parity: static.append_backward) — returns [(param, grad_var)].
+    On this substrate the Executor's train path computes grads with
+    jax.value_and_grad at compile time; this API materializes explicit
+    grad vars for programs that want them."""
+    from ..core.tensor import Tensor
+    from . import _reachable
+    params = parameter_list
+    if params is None:
+        nodes = _reachable([loss])
+        params, seen = [], set()
+        for node in loss.program.nodes:
+            if node not in nodes:
+                continue
+            for o in node.operands:
+                if isinstance(o, Tensor) and not o.stop_gradient \
+                        and id(o) not in seen:
+                    seen.add(id(o))
+                    params.append(o)
+    pairs = []
+    for p in params:
+        g = _symbolic_grad_wrt_param(loss, p)
+        pairs.append((p, g))
+    return pairs
+
+
+def _symbolic_grad_wrt_param(loss, param):
+    from ..core.tensor import Tensor
+    from . import Variable, _reachable, record_op
+    prog = loss.program
+    nodes = _reachable([loss])
+
+    def fn(p_arr, *rest):
+        feeds = list(rest)
+
+        def fwd(pv):
+            env = {}
+            fi = iter(feeds)
+            fmap = {}
+
+            def resolve(o):
+                if isinstance(o, Variable):
+                    if id(o) in env:
+                        return env[id(o)]
+                    if id(o) not in fmap:
+                        fmap[id(o)] = next(fi)
+                    return fmap[id(o)]
+                if isinstance(o, Tensor):
+                    if o is param:
+                        return pv
+                    if id(o) not in fmap:
+                        fmap[id(o)] = next(fi)
+                    return fmap[id(o)]
+                return o
+            for node in prog.nodes:
+                if node not in nodes:
+                    continue
+                vals = node.jax_fn(*[resolve(o) for o in node.operands])
+                vals = vals if isinstance(vals, tuple) else (vals,)
+                for var, v in zip(node.outputs, vals):
+                    env[id(var)] = v
+            return jnp.sum(env[id(loss)])
+        return jax.grad(fwd)(p_arr)
+
+    rest_ops = []
+    seen = {id(param)}
+    for node in prog.nodes:
+        if node not in nodes:
+            continue
+        for o in node.operands:
+            if isinstance(o, (Tensor, Variable)) and id(o) not in seen:
+                if isinstance(o, Variable) and not o.is_input:
+                    continue
+                seen.add(id(o))
+                rest_ops.append(o)
+    return record_op(f"{param.name or 'param'}@GRAD", fn,
+                     (param, *rest_ops))
+
+
+# -- strategies / compiled program ----------------------------------------
+
+class BuildStrategy:
+    """(parity: static.BuildStrategy — build knobs; XLA owns fusion and
+    scheduling here, so the fields are recorded but the compiler decides)"""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.memory_optimize = True
+        self.build_cinn_pass = False
+        self.debug_graphviz_path = ""
+
+
+class ExecutionStrategy:
+    """(parity: static.ExecutionStrategy)"""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 100
+        self.num_iteration_per_run = 1
+
+
+class CompiledProgram:
+    """(parity: static.CompiledProgram — jit compilation happens in the
+    Executor's signature cache; this wrapper carries the strategy)."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_program"], item)
+
+
+# -- IPU shims (inventoried; no IPU on this substrate) ---------------------
+
+class IpuStrategy:
+    """(parity: static.IpuStrategy — config container only; there is no
+    IPU backend on the TPU substrate)"""
+
+    def __init__(self):
+        self.num_ipus = 1
+        self.is_training = True
+        self.micro_batch_size = 1
+        self.enable_manual_shard = False
+
+    def set_graph_config(self, num_ipus=1, is_training=True,
+                         micro_batch_size=1, enable_manual_shard=False):
+        self.num_ipus = num_ipus
+        self.is_training = is_training
+        self.micro_batch_size = micro_batch_size
+        self.enable_manual_shard = enable_manual_shard
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, scope=None, ipu_strategy=None):
+        raise RuntimeError(
+            "IPU execution is not available in the TPU build; use the "
+            "Executor (XLA) directly")
+
+
+class ipu_shard_guard:
+    def __init__(self, index=-1, stage=-1):
+        del index, stage
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    del index, stage
+    return call_func
+
+
+# -- debugging ops ---------------------------------------------------------
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Print a var's value at run time via jax.debug.print (parity:
+    static.Print op)."""
+    from . import record_op
+    msg = message or ""
+    name = getattr(input, "name", "var")
+
+    def fn(a):
+        jax.debug.print(msg + " {name} shape={shape} value={v}",
+                        name=name, shape=str(a.shape), v=a)
+        return a
+    return record_op("print", fn, (input,))
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-callback op (parity: static.py_func — runs a Python fn on
+    host tensors inside the program via jax.pure_callback)."""
+    from . import Variable, record_op
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(1 if s is None else s
+                                         for s in o.shape), o.dtype)
+              for o in outs]
+
+    def fn(*arrs):
+        res = jax.pure_callback(
+            lambda *hs: func(*hs), shapes if len(shapes) > 1 else shapes[0],
+            *arrs)
+        return res
+    return record_op("py_func", fn, tuple(xs))
+
+
+# -- param attrs / EMA -----------------------------------------------------
+
+from ..nn.parameter import ParamAttr  # noqa: E402
+
+
+class WeightNormParamAttr(ParamAttr):
+    """(parity: static.WeightNormParamAttr — weight-norm reparameterized
+    parameter attribute)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         regularizer=regularizer, trainable=trainable)
+        self.dim = dim
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (parity:
+    static.ExponentialMovingAverage, python/paddle/static/nn/...).
+    Eager-friendly: update() after each step; apply()/restore() swap."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema = {}
+        self._backup = None
+        self._step = 0
+        self._params = None
+
+    def _param_list(self):
+        if self._params is not None:
+            return self._params
+        from . import default_main_program
+        return default_main_program().parameters()
+
+    def bind(self, parameters):
+        self._params = list(parameters)
+
+    def update(self):
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._param_list():
+            key = p.name or str(id(p))
+            prev = self._ema.get(key)
+            cur = p._data
+            self._ema[key] = cur if prev is None else \
+                d * prev + (1 - d) * cur
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = [(p, p._data) for p in self._param_list()]
+        for p in self._param_list():
+            key = p.name or str(id(p))
+            if key in self._ema:
+                p._data = self._ema[key].astype(p._data.dtype)
+        outer = self
+
+        class _Ctx:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                if need_restore:
+                    outer.restore()
+                return False
+        return _Ctx()
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p, d in self._backup:
+                p._data = d
+            self._backup = None
+
+
+# -- serialization ---------------------------------------------------------
+
+def _program_state(program):
+    state = {}
+    for i, t in enumerate(program.parameters()):
+        if not t.name:
+            t.name = f"__static_p{i}"
+        state[t.name] = np.asarray(t._data)
+    return state
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """(parity: static.serialize_program) — pickled graph metadata."""
+    prog = program or _prog()
+    meta = {
+        "inputs": [v.name for v in (feed_vars if isinstance(
+            feed_vars, (list, tuple)) else [feed_vars])],
+        "outputs": [getattr(v, "name", "") for v in (
+            fetch_vars if isinstance(fetch_vars, (list, tuple))
+            else [fetch_vars])],
+        "n_nodes": len(prog.nodes),
+    }
+    return pickle.dumps(meta)
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    """(parity: static.serialize_persistables)"""
+    prog = program or _prog()
+    return pickle.dumps(_program_state(prog))
+
+
+def save_to_file(path, content):
+    """(parity: static.save_to_file)"""
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    """(parity: static.load_from_file)"""
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    """(parity: static.deserialize_program)"""
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    """(parity: static.deserialize_persistables)"""
+    state = pickle.loads(data)
+    set_program_state(program, state)
+    return state
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """(parity: static.normalize_program — prunes to the feed->fetch
+    subgraph; our executor prunes at compile time, so this is a marker)."""
+    return program
+
+
+def save(program, model_path, protocol=4, **configs):
+    """(parity: static.save — <path>.pdparams + .pdmodel)"""
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(_program_state(program), f, protocol=protocol)
+    with open(model_path + ".pdmodel", "wb") as f:
+        f.write(serialize_program([], [], program))
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """(parity: static.load)"""
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    set_program_state(program, state)
+    return state
+
+
+def load_program_state(model_path, var_list=None):
+    """(parity: static.load_program_state)"""
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    """(parity: static.set_program_state)"""
+    for t in program.parameters():
+        if t.name in state_dict:
+            t._data = jnp.asarray(state_dict[t.name]).astype(t._data.dtype)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """(parity: static.save_inference_model)"""
+    prog = program or _prog()
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    save_to_file(path_prefix + ".pdmodel",
+                 serialize_program(feed_vars, fetch_vars, prog))
+    save_to_file(path_prefix + ".pdiparams",
+                 serialize_persistables(feed_vars, fetch_vars, prog))
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """(parity: static.load_inference_model) — returns (program_meta,
+    feed_names, fetch_names)."""
+    meta = deserialize_program(load_from_file(path_prefix + ".pdmodel"))
+    return meta, meta.get("inputs", []), meta.get("outputs", [])
+
+
+# -- places / vars / metrics ----------------------------------------------
+
+def cpu_places(device_count=None):
+    from ..framework import CPUPlace
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..framework import CUDAPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    raise RuntimeError("XPU devices are not available in the TPU build")
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """(parity: static.create_global_var) — a persistable Tensor the
+    program references as a leaf."""
+    from ..core.tensor import Tensor
+    t = Tensor(jnp.full(tuple(shape), value, dtype=dtype), name=name)
+    t.persistable = persistable
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """(parity: static.create_parameter)"""
+    from ..nn.parameter import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """(parity: static.accuracy — same math as paddle.metric.accuracy,
+    usable on Variables in a program)."""
+    from . import Variable, record_op
+
+    def fn(pred, lab):
+        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        lab2 = lab.reshape(lab.shape[0], -1)[:, :1]
+        hit = (topk == lab2).any(axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))[None]
+    if isinstance(input, Variable):
+        return record_op("accuracy", fn, (input, label))
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """(parity: static.auc) — batch AUC via the trapezoid over thresholded
+    TPR/FPR."""
+    from . import Variable, record_op
+
+    def fn(pred, lab):
+        pos_score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 \
+            else pred.reshape(-1)
+        lab_f = lab.reshape(-1).astype(jnp.float32)
+        ths = jnp.linspace(0.0, 1.0, num_thresholds)
+        preds_at = pos_score[None, :] >= ths[:, None]
+        tp = jnp.sum(preds_at * lab_f[None, :], axis=1)
+        fp = jnp.sum(preds_at * (1 - lab_f[None, :]), axis=1)
+        pos = jnp.maximum(jnp.sum(lab_f), 1e-6)
+        neg = jnp.maximum(jnp.sum(1 - lab_f), 1e-6)
+        tpr = tp / pos
+        fpr = fp / neg
+        return jnp.abs(jnp.trapezoid(tpr, fpr))[None]
+    if isinstance(input, Variable):
+        return record_op("auc", fn, (input, label))
+    from ..core.dispatch import run_op
+    return run_op("auc", fn, (input, label), out_stop_gradient=True)
+
+
+class device_guard:
+    """(parity: static.device_guard — XLA owns placement; context is a
+    marker)."""
+
+    def __init__(self, device=None):
+        self.device = device
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def ctr_metric_bundle(input, label):
+    """(parity: static.ctr_metric_bundle — abs/sq error sums for CTR)."""
+    from ..core.dispatch import run_op
+
+    def fn(pred, lab):
+        lab_f = lab.astype(jnp.float32).reshape(-1)
+        pr = pred.reshape(-1)
+        abserr = jnp.sum(jnp.abs(pr - lab_f))
+        sqrerr = jnp.sum((pr - lab_f) ** 2)
+        return abserr[None], sqrerr[None], jnp.sum(pr)[None], \
+            jnp.asarray([pr.shape[0]], jnp.float32)
+    return run_op("ctr_metric_bundle", fn, (input, label),
+                  out_stop_gradient=True)

@@ -1,0 +1,548 @@
+"""paddle.static.nn (parity: python/paddle/static/nn/__init__.py — the
+static-graph layer builders: each call creates parameters eagerly and
+records the forward ops into the current Program via the dispatch funnel's
+static-mode branch; the reference's LayerHelper.append_op equivalent).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "fc", "batch_norm", "bilinear_tensor_product", "embedding", "case",
+    "cond", "static_pylayer", "conv2d", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "nce", "prelu", "py_func", "row_conv",
+    "spectral_norm", "switch_case", "while_loop", "sparse_embedding",
+    "sequence_conv", "sequence_softmax", "sequence_pool",
+    "sequence_concat", "sequence_first_step", "sequence_last_step",
+    "sequence_slice", "sequence_expand", "sequence_expand_as",
+    "sequence_pad", "sequence_unpad", "sequence_reshape",
+    "sequence_scatter", "sequence_enumerate", "sequence_reverse",
+]
+
+
+def _shape_of(x):
+    return [1 if s is None else s for s in x.shape]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """(parity: static.nn.fc — flattens trailing dims, xW+b, activation)"""
+    from .. import nn
+    from ..nn import functional as F
+    in_f = int(np.prod(_shape_of(x)[num_flatten_dims:]))
+    layer = nn.Linear(in_f, size, weight_attr=weight_attr,
+                      bias_attr=bias_attr)
+    h = x
+    if len(x.shape) > num_flatten_dims + 1:
+        from ..tensor.manipulation import reshape
+        h = reshape(h, _shape_of(x)[:num_flatten_dims] + [in_f])
+    out = layer(h)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """(parity: static.nn.embedding)"""
+    from .. import nn
+    layer = nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                         sparse=is_sparse, weight_attr=param_attr)
+    return layer(input)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """(parity: static.nn.sparse_embedding — the PS sparse table variant;
+    dense embedding on this substrate)"""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    """(parity: static.nn.batch_norm)"""
+    from .. import nn
+    from ..nn import functional as F
+    c = _shape_of(input)[1 if data_layout == "NCHW" else -1]
+    layer = nn.BatchNorm2D(c, momentum=momentum, epsilon=epsilon,
+                           weight_attr=param_attr, bias_attr=bias_attr,
+                           data_format=data_layout)
+    if is_test or use_global_stats:
+        layer.eval()
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              enable_scale_and_shift=False, name=None, moving_mean_name=None,
+              moving_variance_name=None, do_model_average_for_mean_and_var=True,
+              slot_dim=-1, summary_decay_rate=0.9999999, sync_stats=False,
+              scale_w=None, bias=None):
+    """(parity: static.nn.data_norm — normalization by accumulated
+    batch statistics; stateless normalized form here)"""
+    from ..core.dispatch import run_op
+
+    def fn(a):
+        mean = jnp.mean(a, axis=0, keepdims=True)
+        var = jnp.var(a, axis=0, keepdims=True)
+        return (a - mean) / jnp.sqrt(var + epsilon)
+    return run_op("data_norm", fn, (input,))
+
+
+def _conv_layer(cls, input, num_filters, filter_size, stride, padding,
+                dilation, groups, param_attr, bias_attr, data_format, act):
+    from ..nn import functional as F
+    c_axis = 1 if data_format.startswith("NC") else -1
+    in_c = _shape_of(input)[c_axis]
+    layer = cls(in_c, num_filters, filter_size, stride=stride,
+                padding=padding, dilation=dilation, groups=groups or 1,
+                weight_attr=param_attr, bias_attr=bias_attr,
+                data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCHW"):
+    from .. import nn
+    return _conv_layer(nn.Conv2D, input, num_filters, filter_size, stride,
+                       padding, dilation, groups, param_attr, bias_attr,
+                       data_format, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    from .. import nn
+    return _conv_layer(nn.Conv3D, input, num_filters, filter_size, stride,
+                       padding, dilation, groups, param_attr, bias_attr,
+                       data_format, act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    from .. import nn
+    return _conv_layer(nn.Conv2DTranspose, input, num_filters,
+                       filter_size, stride, padding, dilation, groups,
+                       param_attr, bias_attr, data_format, act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    from .. import nn
+    return _conv_layer(nn.Conv3DTranspose, input, num_filters,
+                       filter_size, stride, padding, dilation, groups,
+                       param_attr, bias_attr, data_format, act)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, weight_attr=None, bias_attr=None,
+                  name=None):
+    """(parity: static.nn.deform_conv2d over the vision op)"""
+    from ..nn.parameter import create_parameter
+    from ..vision.ops import deform_conv2d as _dc
+    ks = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    in_c = _shape_of(x)[1]
+    weight = create_parameter([num_filters, in_c // groups, *ks],
+                              "float32", attr=weight_attr)
+    bias = None if bias_attr is False else create_parameter(
+        [num_filters], "float32", attr=bias_attr, is_bias=True)
+    return _dc(x, offset, weight, bias, stride, padding, dilation,
+               deformable_groups, groups, mask)
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from .. import nn
+    from ..nn import functional as F
+    c = _shape_of(input)[1 if data_layout == "NCHW" else -1]
+    layer = nn.GroupNorm(groups, c, epsilon=epsilon,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    from .. import nn
+    c = _shape_of(input)[1]
+    layer = nn.InstanceNorm2D(c, epsilon=epsilon, weight_attr=param_attr,
+                              bias_attr=bias_attr)
+    return layer(input)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from .. import nn
+    from ..nn import functional as F
+    shape = _shape_of(input)[begin_norm_axis:]
+    layer = nn.LayerNorm(shape, epsilon=epsilon,
+                         weight_attr=param_attr if scale else False,
+                         bias_attr=bias_attr if shift else False)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from .. import nn
+    from ..nn import functional as F
+    layer = nn.Bilinear(_shape_of(x)[-1], _shape_of(y)[-1], size,
+                        weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(x, y)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from .. import nn
+    if mode == "all":
+        num = 1
+    elif mode == "channel":
+        num = _shape_of(x)[1 if data_format == "NCHW" else -1]
+    else:
+        num = int(np.prod(_shape_of(x)[1:]))
+    layer = nn.PReLU(num_parameters=num, weight_attr=param_attr,
+                     data_format=data_format)
+    return layer(x)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (parity: static.nn.nce). Uniform
+    negative sampling; logistic discrimination of true vs noise classes."""
+    from ..core.dispatch import run_op
+    from ..nn.parameter import create_parameter
+    dim = _shape_of(input)[-1]
+    weight = create_parameter([num_total_classes, dim], "float32",
+                              attr=param_attr)
+    bias = None if bias_attr is False else create_parameter(
+        [num_total_classes], "float32", attr=bias_attr, is_bias=True)
+    k = num_neg_samples or 10
+    neg = np.random.RandomState(seed or 0).randint(
+        0, num_total_classes, size=(k,))
+
+    def fn(x_, lab, w, *bb):
+        lab_i = lab.astype(jnp.int32).reshape(-1)
+        pos_logit = jnp.sum(x_ * w[lab_i], axis=-1)
+        if bb:
+            pos_logit = pos_logit + bb[0][lab_i]
+        neg_w = w[neg]                       # (k, dim)
+        neg_logit = x_ @ neg_w.T             # (B, k)
+        if bb:
+            neg_logit = neg_logit + bb[0][neg]
+        loss = -jax.nn.log_sigmoid(pos_logit) \
+            - jnp.sum(jax.nn.log_sigmoid(-neg_logit), axis=-1)
+        return loss[:, None]
+    ops = (input, label, weight) + ((bias,) if bias is not None else ())
+    return run_op("nce", fn, ops)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (parity: static.nn.row_conv)."""
+    from ..core.dispatch import run_op
+    from ..nn.parameter import create_parameter
+    d = _shape_of(input)[-1]
+    w = create_parameter([future_context_size + 1, d], "float32",
+                         attr=param_attr)
+
+    def fn(a, wt):
+        # a: (B, T, D); out[t] = sum_{i=0..C} a[t+i] * w[i]
+        T = a.shape[-2]
+        out = jnp.zeros_like(a)
+        for i in range(future_context_size + 1):
+            pad = [(0, 0)] * (a.ndim - 2) + [(0, i), (0, 0)]
+            sl = jnp.pad(a[..., i:, :], pad)
+            out = out + sl * wt[i]
+        return out
+    return run_op("row_conv", fn, (input, w))
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from .. import nn
+    layer = nn.SpectralNorm(_shape_of(weight), dim=dim,
+                            power_iters=power_iters, eps=eps)
+    return layer(weight)
+
+
+# -- control flow ----------------------------------------------------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None,
+         return_names=None):
+    """(parity: static.nn.cond). With a concrete predicate (eager) this
+    picks the branch; under tracing it lowers to jax.lax.cond when both
+    branches return matching structures."""
+    from ..core.tensor import Tensor
+    p = pred._data if isinstance(pred, Tensor) else pred
+    if isinstance(p, jax.core.Tracer):
+        return jax.lax.cond(p.reshape(()), lambda _: true_fn(),
+                            lambda _: false_fn(), operand=None)
+    if bool(np.asarray(p)):
+        return true_fn() if true_fn else None
+    return false_fn() if false_fn else None
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """(parity: static.nn.case)"""
+    from ..core.tensor import Tensor
+    for pred, fn in pred_fn_pairs:
+        p = pred._data if isinstance(pred, Tensor) else pred
+        if bool(np.asarray(p)):
+            return fn()
+    if default is not None:
+        return default()
+    return pred_fn_pairs[-1][1]()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """(parity: static.nn.switch_case)"""
+    from ..core.tensor import Tensor
+    idx = branch_index._data if isinstance(branch_index, Tensor) \
+        else branch_index
+    idx = int(np.asarray(idx))
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) \
+        else branch_fns
+    if idx in fns:
+        return fns[idx]()
+    if default is not None:
+        return default()
+    raise ValueError(f"branch {idx} not found and no default")
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+    """(parity: static.nn.while_loop). Concrete condition: Python loop
+    (dygraph semantics); traced: jax.lax.while_loop."""
+    from ..core.tensor import Tensor
+
+    def concrete(v):
+        return not isinstance(v._data if isinstance(v, Tensor) else v,
+                              jax.core.Tracer)
+    if all(concrete(v) for v in loop_vars):
+        vars_ = list(loop_vars)
+        while bool(np.asarray(
+                cond_fn(*vars_)._data if isinstance(cond_fn(*vars_), Tensor)
+                else cond_fn(*vars_))):
+            out = body(*vars_)
+            vars_ = list(out) if isinstance(out, (tuple, list)) else [out]
+        return vars_
+    arrs = [v._data if isinstance(v, Tensor) else v for v in loop_vars]
+
+    def c(vs):
+        r = cond_fn(*[Tensor(v) for v in vs])
+        return (r._data if isinstance(r, Tensor) else r).reshape(())
+
+    def b(vs):
+        out = body(*[Tensor(v) for v in vs])
+        out = out if isinstance(out, (tuple, list)) else [out]
+        return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+    res = jax.lax.while_loop(c, b, tuple(arrs))
+    return [Tensor(r) for r in res]
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """(parity: static.nn.static_pylayer — custom fwd/bwd block). Maps to
+    the PyLayer mechanism."""
+    from ..autograd import PyLayer
+
+    class _P(PyLayer):
+        @staticmethod
+        def forward(ctx, *xs):
+            return forward_fn(*xs)
+
+        @staticmethod
+        def backward(ctx, *gs):
+            if backward_fn is None:
+                return gs
+            return backward_fn(*gs)
+    return _P.apply(*inputs)
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    from .extras import py_func as _pf
+    return _pf(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+# -- sequence ops (LoD-free: padded (B, T, ...) + lengths) ------------------
+
+def _seq_op(name, fn, *ops):
+    from ..core.dispatch import run_op
+    return run_op(name, fn, ops)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    return _seq_op("sequence_softmax",
+                   lambda a: jax.nn.softmax(a, axis=-1), input)
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):
+    pt = pool_type.lower()
+
+    def fn(a):
+        if pt == "sum":
+            return jnp.sum(a, axis=1)
+        if pt in ("average", "avg"):
+            return jnp.mean(a, axis=1)
+        if pt == "max":
+            return jnp.max(a, axis=1)
+        if pt == "sqrt":
+            return jnp.sum(a, axis=1) / jnp.sqrt(float(a.shape[1]))
+        if pt == "first":
+            return a[:, 0]
+        if pt == "last":
+            return a[:, -1]
+        raise ValueError(f"unknown pool_type {pool_type}")
+    return _seq_op("sequence_pool", fn, input)
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_concat(input, name=None):
+    from ..tensor.manipulation import concat
+    return concat(list(input), axis=1)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Temporal convolution over padded sequences (parity:
+    static.nn.sequence_conv)."""
+    from ..core.dispatch import run_op
+    from ..nn.parameter import create_parameter
+    d = _shape_of(input)[-1]
+    w = create_parameter([filter_size * d, num_filters], "float32",
+                         attr=param_attr)
+    b = None if bias_attr is False else create_parameter(
+        [num_filters], "float32", attr=bias_attr, is_bias=True)
+    start = padding_start if padding_start is not None \
+        else -(filter_size // 2)
+
+    def fn(a, wt, *bb):
+        B, T, D = a.shape
+        cols = []
+        for i in range(filter_size):
+            off = start + i
+            if off < 0:
+                sl = jnp.pad(a, ((0, 0), (-off, 0), (0, 0)))[:, :T]
+            else:
+                sl = jnp.pad(a, ((0, 0), (0, off), (0, 0)))[:, off:T + off]
+            cols.append(sl)
+        col = jnp.concatenate(cols, axis=-1)  # (B, T, fs*D)
+        out = col @ wt
+        if bb:
+            out = out + bb[0]
+        return out
+    ops = (input, w) + ((b,) if b is not None else ())
+    out = run_op("sequence_conv", fn, ops)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    def fn(a, off, ln):
+        # static slice per batch row via gather of a length-L window
+        L = int(np.asarray(ln).max())
+        idx = np.asarray(off).reshape(-1, 1) + np.arange(L)[None, :]
+        return jnp.take_along_axis(
+            a, jnp.asarray(idx)[..., None].astype(jnp.int32), axis=1)
+    return _seq_op("sequence_slice", fn, input, offset, length)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    def fn(a, b):
+        rep = b.shape[1] // max(a.shape[1], 1)
+        return jnp.repeat(a, max(rep, 1), axis=1)
+    return _seq_op("sequence_expand", fn, x, y)
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    def fn(a, pv):
+        target = maxlen or a.shape[1]
+        extra = target - a.shape[1]
+        if extra <= 0:
+            return a[:, :target], jnp.full((a.shape[0],), a.shape[1],
+                                           jnp.int64)
+        pad_cfg = [(0, 0), (0, extra)] + [(0, 0)] * (a.ndim - 2)
+        mask_cfg = [(0, 0), (0, extra)]
+        valid = jnp.pad(jnp.ones(a.shape[:2], bool), mask_cfg)
+        padded = jnp.pad(a, pad_cfg)
+        shape = (1, padded.shape[1]) + (1,) * (a.ndim - 2)
+        valid = valid.reshape(a.shape[0], padded.shape[1],
+                              *([1] * (a.ndim - 2)))
+        padded = jnp.where(valid, padded, pv.reshape((1,) * padded.ndim))
+        return padded, jnp.full((a.shape[0],), a.shape[1], jnp.int64)
+    return _seq_op("sequence_pad", fn, x, pad_value)
+
+
+def sequence_unpad(x, length, name=None):
+    def fn(a, ln):
+        L = int(np.asarray(ln).max())
+        return a[:, :L]
+    return _seq_op("sequence_unpad", fn, x, length)
+
+
+def sequence_reshape(input, new_dim):
+    def fn(a):
+        B = a.shape[0]
+        return a.reshape(B, -1, new_dim)
+    return _seq_op("sequence_reshape", fn, input)
+
+
+def sequence_scatter(input, index, updates, name=None):
+    def fn(a, idx, upd):
+        return a.at[jnp.arange(a.shape[0])[:, None],
+                    idx.astype(jnp.int32)].add(upd)
+    return _seq_op("sequence_scatter", fn, input, index, updates)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    def fn(a):
+        B, T = a.shape[:2]
+        out = jnp.full((B, T, win_size), pad_value, a.dtype)
+        for i in range(win_size):
+            valid = T - i
+            out = out.at[:, :valid, i].set(a[:, i:])
+        return out
+    return _seq_op("sequence_enumerate", fn, input)
+
+
+def sequence_reverse(x, name=None):
+    return _seq_op("sequence_reverse", lambda a: jnp.flip(a, axis=1), x)

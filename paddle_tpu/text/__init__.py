@@ -21,7 +21,8 @@ from ..io import Dataset
 from ..nn.layer.layers import Layer
 
 __all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "UCIHousing",
-           "Conll05st"]
+           "Conll05st", "Imikolov", "Movielens", "WMT14", "WMT16",
+]
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,
@@ -157,3 +158,143 @@ class Conll05st(Dataset):
         raise NotImplementedError(
             "Conll05st parsing is not ported yet; the class exists for "
             "API-surface parity")
+
+
+class Imikolov(Dataset):
+    """PTB language-model n-grams (parity: paddle.text.Imikolov) over a
+    local simple-examples directory."""
+
+    def __init__(self, data_dir=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50):
+        super().__init__()
+        _need_file(data_dir, "Imikolov")
+        import collections
+        split = {"train": "ptb.train.txt", "test": "ptb.valid.txt"}[mode]
+        path = os.path.join(data_dir, "data", split) \
+            if os.path.isdir(os.path.join(data_dir, "data")) \
+            else os.path.join(data_dir, split)
+        _need_file(path, "Imikolov")
+        counter = collections.Counter()
+        with open(path) as f:
+            lines = [ln.strip().split() for ln in f]
+        for ws in lines:
+            counter.update(ws)
+        vocab = {w: i for i, (w, c) in enumerate(
+            sorted(counter.items(), key=lambda kv: (-kv[1], kv[0])))
+            if c >= min_word_freq}
+        self.word_idx = vocab
+        unk = len(vocab)
+        self.data = []
+        n = window_size if window_size > 0 else 5
+        for ws in lines:
+            ids = [vocab.get(w, unk) for w in ws]
+            if data_type.upper() == "NGRAM":
+                for i in range(len(ids) - n + 1):
+                    self.data.append(np.asarray(ids[i:i + n], np.int64))
+            else:  # SEQ
+                self.data.append(np.asarray(ids, np.int64))
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (parity: paddle.text.Movielens) over a local
+    ml-1m directory (ratings.dat/users.dat/movies.dat)."""
+
+    def __init__(self, data_dir=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        super().__init__()
+        _need_file(data_dir, "Movielens")
+        rat = os.path.join(data_dir, "ratings.dat")
+        _need_file(rat, "Movielens ratings.dat")
+        rows = []
+        with open(rat, encoding="latin1") as f:
+            for ln in f:
+                u, m, r, _ = ln.strip().split("::")
+                rows.append((int(u), int(m), float(r)))
+        rng_ = np.random.RandomState(rand_seed)
+        order = rng_.permutation(len(rows))
+        cut = int(len(rows) * (1 - test_ratio))
+        sel = order[:cut] if mode == "train" else order[cut:]
+        self.data = [rows[i] for i in sel]
+
+    def __getitem__(self, i):
+        u, m, r = self.data[i]
+        return (np.asarray([u], np.int64), np.asarray([m], np.int64),
+                np.asarray([r], np.float32))
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(Dataset):
+    """WMT14 en-fr translation pairs (parity: paddle.text.WMT14) over a
+    local extracted directory with .src/.trg token files."""
+
+    def __init__(self, data_dir=None, mode="train", dict_size=-1):
+        super().__init__()
+        _need_file(data_dir, "WMT14")
+        src = os.path.join(data_dir, f"{mode}.src")
+        trg = os.path.join(data_dir, f"{mode}.trg")
+        _need_file(src, "WMT14 source file")
+        _need_file(trg, "WMT14 target file")
+        with open(src) as f:
+            s_lines = [ln.split() for ln in f]
+        with open(trg) as f:
+            t_lines = [ln.split() for ln in f]
+        self.src_dict, self.trg_dict = self._dicts(s_lines, t_lines,
+                                                   dict_size)
+        self.data = [
+            (np.asarray([self.src_dict.get(w, 2) for w in s], np.int64),
+             np.asarray([self.trg_dict.get(w, 2) for w in t], np.int64))
+            for s, t in zip(s_lines, t_lines)]
+
+    @staticmethod
+    def _dicts(s_lines, t_lines, dict_size):
+        import collections
+
+        def build(lines):
+            c = collections.Counter()
+            for ws in lines:
+                c.update(ws)
+            vocab = {"<s>": 0, "<e>": 1, "<unk>": 2}
+            for w, _ in c.most_common(
+                    None if dict_size <= 0 else dict_size - 3):
+                vocab[w] = len(vocab)
+            return vocab
+        return build(s_lines), build(t_lines)
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT16(WMT14):
+    """WMT16 multimodal en-de (parity: paddle.text.WMT16) — same local
+    file contract as WMT14 with language-suffixed files."""
+
+    def __init__(self, data_dir=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en"):
+        _need_file(data_dir, "WMT16")
+        src = os.path.join(data_dir, f"{mode}.{lang}")
+        other = "de" if lang == "en" else "en"
+        trg = os.path.join(data_dir, f"{mode}.{other}")
+        _need_file(src, "WMT16 source file")
+        _need_file(trg, "WMT16 target file")
+        Dataset.__init__(self)
+        with open(src) as f:
+            s_lines = [ln.split() for ln in f]
+        with open(trg) as f:
+            t_lines = [ln.split() for ln in f]
+        self.src_dict, _ = self._dicts(s_lines, t_lines, src_dict_size)
+        _, self.trg_dict = self._dicts(s_lines, t_lines, trg_dict_size)
+        self.data = [
+            (np.asarray([self.src_dict.get(w, 2) for w in s], np.int64),
+             np.asarray([self.trg_dict.get(w, 2) for w in t], np.int64))
+            for s, t in zip(s_lines, t_lines)]

@@ -19,3 +19,45 @@ def run_check():
     jax.block_until_ready(out)
     dev = jax.devices()[0]
     print(f"PaddlePaddle(TPU) works on {dev.platform}:{dev.id}.")
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (parity:
+    paddle.utils.deprecated — warns once per call site)."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f". Reason: {reason}"
+            if level == 0:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            elif level >= 2:
+                raise RuntimeError(msg)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version (parity:
+    paddle.utils.require_version)."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"version {__version__} < required min {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"version {__version__} > allowed max {max_version}")
+    return True

@@ -6,3 +6,41 @@ from . import ops  # noqa: F401
 from .models import (ResNet, resnet18, resnet34, resnet50,  # noqa: F401
                      resnet101, resnet152, LeNet, VGG, vgg16,
                      MobileNetV2, mobilenet_v2)
+
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """(parity: paddle.vision.set_image_backend)"""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2', 'tensor'], "
+            f"but got {backend}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    """(parity: paddle.vision.get_image_backend)"""
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file (parity: paddle.vision.image_load)."""
+    backend = backend or _image_backend
+    if backend == "cv2":
+        raise RuntimeError("cv2 backend is unavailable in this build; "
+                           "use the 'pil' or 'tensor' backend")
+    from PIL import Image
+    img = Image.open(path)
+    if backend == "pil":
+        return img
+    import numpy as _np
+
+    from ..core.tensor import Tensor as _T
+    import jax.numpy as _jnp
+    arr = _np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    return _T(_jnp.asarray(arr.transpose(2, 0, 1)))

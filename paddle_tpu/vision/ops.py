@@ -156,7 +156,16 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             rh = jnp.maximum(rh, 1.0)
         bin_h = rh / ph
         bin_w = rw / pw
-        sr = sampling_ratio if sampling_ratio > 0 else 2
+        if sampling_ratio > 0:
+            sr = sampling_ratio
+        else:
+            # reference kernel: adaptive ceil(roi_extent / pooled_size),
+            # uniform across the batch (static shapes) via the max roi
+            max_rh = float(np.max(np.asarray(rh))) if not isinstance(
+                rh, jax.core.Tracer) else ph
+            max_rw = float(np.max(np.asarray(rw))) if not isinstance(
+                rw, jax.core.Tracer) else pw
+            sr = max(int(np.ceil(max(max_rh / ph, max_rw / pw))), 1)
         # sample points per bin: (sr x sr) bilinear taps, averaged
         iy = (jnp.arange(ph)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr)
         ix = (jnp.arange(pw)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr)

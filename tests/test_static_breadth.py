@@ -1,0 +1,478 @@
+"""Wave-C tests: static graph APIs (gradients FD-checked, save/load,
+static.nn layers, control flow, sequence ops), audio WAV codec + datasets,
+text datasets, incubate optimizers/fused ops, saved_tensors_hooks,
+misc module parity (amp/jit/metric/utils/quantization/profiler)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as st
+
+rng = np.random.RandomState(3)
+t = paddle.to_tensor
+
+
+class TestStaticExtras:
+    def test_fc_program_with_gradients_fd(self):
+        paddle.seed(0)
+        st.enable_static()
+        try:
+            prog = st.Program()
+            with st.program_guard(prog):
+                x = st.data("x", [None, 4], "float32")
+                h = st.nn.fc(x, 8, activation="relu")
+                out = st.nn.fc(h, 1)
+                loss = (out * out).mean()
+                gx = st.gradients([loss], [x])[0]
+            exe = st.Executor()
+            xs = rng.randn(3, 4).astype(np.float32)
+            l0, g = exe.run(prog, feed={"x": xs}, fetch_list=[loss, gx])
+            eps = 1e-3
+            xs2 = xs.copy()
+            xs2[1, 2] += eps
+            l1 = exe.run(prog, feed={"x": xs2}, fetch_list=[loss])[0]
+            fd = (float(l1) - float(l0)) / eps
+            np.testing.assert_allclose(fd, g[1, 2], rtol=0.05, atol=1e-3)
+        finally:
+            st.disable_static()
+
+    def test_append_backward_param_grads(self):
+        paddle.seed(0)
+        st.enable_static()
+        try:
+            prog = st.Program()
+            with st.program_guard(prog):
+                x = st.data("x", [2, 3], "float32")
+                y = st.nn.fc(x, 1)
+                loss = (y * y).sum()
+                pairs = st.append_backward(loss)
+            assert len(pairs) >= 1
+            exe = st.Executor()
+            xs = rng.randn(2, 3).astype(np.float32)
+            res = exe.run(prog, feed={"x": xs},
+                          fetch_list=[loss, pairs[0][1]])
+            p0 = pairs[0][0]
+            assert res[1].shape == tuple(p0.shape)
+            assert np.isfinite(res[1]).all()
+        finally:
+            st.disable_static()
+
+    def test_program_save_load_roundtrip(self, tmp_path):
+        paddle.seed(0)
+        st.enable_static()
+        try:
+            prog = st.Program()
+            with st.program_guard(prog):
+                x = st.data("x", [2, 3], "float32")
+                y = st.nn.fc(x, 2)
+            path = str(tmp_path / "m")
+            st.save(prog, path)
+            state = st.load_program_state(path)
+            for k, v in state.items():
+                state[k] = v * 0
+            st.set_program_state(prog, state)
+            exe = st.Executor()
+            out = exe.run(prog, feed={"x": np.ones((2, 3), np.float32)},
+                          fetch_list=[y])[0]
+            assert np.abs(out).max() == 0.0
+        finally:
+            st.disable_static()
+
+    def test_serialize_deserialize(self):
+        st.enable_static()
+        try:
+            prog = st.Program()
+            with st.program_guard(prog):
+                x = st.data("x", [2, 2], "float32")
+                y = st.nn.fc(x, 2)
+            data = st.serialize_program([x], [y], prog)
+            meta = st.deserialize_program(data)
+            assert meta["inputs"] == ["x"]
+            blob = st.serialize_persistables([x], [y], prog)
+            state = st.deserialize_persistables(prog, blob)
+            assert len(state) >= 1
+        finally:
+            st.disable_static()
+
+    def test_ema(self):
+        w = t(np.array([1.0], np.float32), stop_gradient=False)
+        w.name = "w_ema_test"
+        ema = st.ExponentialMovingAverage(0.5)
+        ema.bind([w])
+        import jax.numpy as jnp
+        for v in [1.0, 2.0]:
+            w._data = jnp.full_like(w._data, v)
+            ema.update()
+        with ema.apply():
+            assert float(w.numpy()[0]) != 2.0
+        assert float(w.numpy()[0]) == 2.0
+
+    def test_places_and_misc(self):
+        assert len(st.cpu_places(2)) == 2
+        assert st.cuda_places([0])[0].device_id == 0
+        g = st.create_global_var([2, 2], 1.5, "float32")
+        assert float(g.numpy().sum()) == 6.0
+        bs = st.BuildStrategy()
+        assert bs.memory_optimize
+        with st.device_guard("cpu"):
+            pass
+
+    def test_static_accuracy_auc(self):
+        pred = t(np.array([[0.2, 0.8], [0.9, 0.1]], np.float32))
+        lab = t(np.array([[1], [0]], np.int64))
+        acc = st.accuracy(pred, lab)
+        assert float(acc.numpy()) == 1.0
+        a = st.auc(pred, t(np.array([1, 0], np.int64)))
+        assert 0.99 <= float(a.numpy()) <= 1.01
+
+
+class TestStaticNN:
+    def test_conv_and_norm_builders(self):
+        st.enable_static()
+        try:
+            prog = st.Program()
+            with st.program_guard(prog):
+                x = st.data("x", [2, 3, 8, 8], "float32")
+                h = st.nn.conv2d(x, 4, 3, padding=1, act="relu")
+                h = st.nn.batch_norm(h)
+                h = st.nn.group_norm(h, groups=2)
+            exe = st.Executor()
+            out = exe.run(prog, feed={"x": rng.randn(2, 3, 8, 8).astype(
+                np.float32)}, fetch_list=[h])[0]
+            assert out.shape == (2, 4, 8, 8)
+        finally:
+            st.disable_static()
+
+    def test_embedding_and_layer_norm(self):
+        st.enable_static()
+        try:
+            prog = st.Program()
+            with st.program_guard(prog):
+                ids = st.data("ids", [2, 5], "int64")
+                emb = st.nn.embedding(ids, (10, 6))
+                out = st.nn.layer_norm(emb, begin_norm_axis=2)
+            exe = st.Executor()
+            o = exe.run(prog, feed={"ids": rng.randint(
+                0, 10, (2, 5)).astype(np.int64)}, fetch_list=[out])[0]
+            assert o.shape == (2, 5, 6)
+            np.testing.assert_allclose(o.mean(-1), 0, atol=1e-5)
+        finally:
+            st.disable_static()
+
+    def test_control_flow_eager(self):
+        assert st.nn.cond(t(np.array(True)), lambda: "a",
+                          lambda: "b") == "a"
+        assert st.nn.case([(t(np.array(False)), lambda: 1),
+                           (t(np.array(True)), lambda: 2)]) == 2
+        assert st.nn.switch_case(t(np.array(1)),
+                                 {0: lambda: "x", 1: lambda: "y"}) == "y"
+        out = st.nn.while_loop(lambda i: i < 3, lambda i: i + 1,
+                               [t(np.array(0))])
+        assert int(out[0].numpy()) == 3
+
+    def test_sequence_ops(self):
+        sq = t(np.arange(12, dtype=np.float32).reshape(1, 4, 3))
+        assert st.nn.sequence_pool(sq, "max").numpy().tolist() == \
+            [[9.0, 10.0, 11.0]]
+        assert st.nn.sequence_first_step(sq).numpy().tolist() == \
+            [[0.0, 1.0, 2.0]]
+        rev = st.nn.sequence_reverse(sq).numpy()
+        assert rev[0, 0].tolist() == [9.0, 10.0, 11.0]
+        sm = st.nn.sequence_softmax(sq).numpy()
+        np.testing.assert_allclose(sm.sum(-1), 1.0, atol=1e-5)
+        enum = st.nn.sequence_enumerate(
+            t(np.arange(4)[None]), win_size=2).numpy()
+        assert enum.shape == (1, 4, 2)
+
+    def test_sequence_conv_shapes(self):
+        paddle.seed(0)
+        sq = t(rng.randn(2, 5, 4).astype(np.float32))
+        out = st.nn.sequence_conv(sq, 6, filter_size=3)
+        assert out.shape == [2, 5, 6]
+
+    def test_nce_runs(self):
+        paddle.seed(0)
+        x = t(rng.randn(4, 8).astype(np.float32), stop_gradient=False)
+        lab = t(rng.randint(0, 20, (4, 1)).astype(np.int64))
+        loss = st.nn.nce(x, lab, 20, num_neg_samples=5)
+        assert loss.shape == [4, 1]
+        loss.sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+
+class TestAudio:
+    def test_wav_roundtrip(self, tmp_path):
+        sig = np.sin(np.linspace(0, 50, 4000)).astype(np.float32)[None]
+        path = str(tmp_path / "a.wav")
+        paddle.audio.save(path, t(sig), 16000)
+        wav, sr = paddle.audio.load(path)
+        assert sr == 16000
+        np.testing.assert_allclose(wav.numpy(), sig, atol=1e-4)
+        inf = paddle.audio.info(path)
+        assert inf.sample_rate == 16000
+        assert inf.num_channels == 1
+        assert inf.bits_per_sample == 16
+
+    def test_backends_listing(self):
+        assert "wave_backend" in paddle.audio.backends.list_available_backends()
+        with pytest.raises(NotImplementedError):
+            paddle.audio.backends.set_backend("soundfile")
+
+    def test_tess_dataset(self, tmp_path):
+        sig = np.zeros((1, 100), np.float32)
+        for emo in ["angry", "happy", "sad", "fear"]:
+            paddle.audio.save(str(tmp_path / f"OAF_w_{emo}.wav"),
+                              t(sig), 16000)
+        train = paddle.audio.datasets.TESS(mode="train",
+                                           data_dir=str(tmp_path), split=5)
+        dev = paddle.audio.datasets.TESS(mode="dev",
+                                         data_dir=str(tmp_path), split=5)
+        assert len(train) + len(dev) == 4
+        feat, lab = train[0]
+        assert feat.shape == [1, 100]
+
+    def test_esc50_layout(self, tmp_path):
+        os.makedirs(tmp_path / "audio", exist_ok=True)
+        sig = np.zeros((1, 64), np.float32)
+        for fold, target in [(1, 3), (2, 7), (3, 7)]:
+            paddle.audio.save(
+                str(tmp_path / "audio" / f"{fold}-1234-A-{target}.wav"),
+                t(sig), 16000)
+        ds = paddle.audio.datasets.ESC50(mode="train",
+                                         data_dir=str(tmp_path), split=1)
+        assert len(ds) == 2
+
+
+class TestTextDatasets:
+    def test_imikolov(self, tmp_path):
+        f = tmp_path / "ptb.train.txt"
+        f.write_text("the cat sat on the mat the cat\n" * 30)
+        ds = paddle.text.Imikolov(data_dir=str(tmp_path), mode="train",
+                                  window_size=3, min_word_freq=5)
+        assert len(ds) > 0
+        assert ds[0].shape == (3,)
+
+    def test_movielens(self, tmp_path):
+        f = tmp_path / "ratings.dat"
+        f.write_text("1::10::4.0::97\n2::20::3.5::98\n3::30::5.0::99\n"
+                     "4::40::2.0::99\n")
+        tr = paddle.text.Movielens(data_dir=str(tmp_path), mode="train",
+                                   test_ratio=0.25)
+        te = paddle.text.Movielens(data_dir=str(tmp_path), mode="test",
+                                   test_ratio=0.25)
+        assert len(tr) + len(te) == 4
+
+    def test_wmt14(self, tmp_path):
+        (tmp_path / "train.src").write_text("a b c\nd e\n")
+        (tmp_path / "train.trg").write_text("x y\nz\n")
+        ds = paddle.text.WMT14(data_dir=str(tmp_path), mode="train")
+        assert len(ds) == 2
+        s, tr = ds[0]
+        assert s.dtype == np.int64
+
+    def test_missing_dir_raises(self):
+        with pytest.raises(FileNotFoundError):
+            paddle.text.Imikolov(data_dir=None)
+
+
+class TestIncubate:
+    def test_fused_softmax_masks(self):
+        x = t(rng.randn(2, 2, 4, 4).astype(np.float32))
+        out = paddle.incubate.softmax_mask_fuse_upper_triangle(x).numpy()
+        assert np.allclose(out[0, 0][np.triu_indices(4, 1)], 0, atol=1e-6)
+        np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+        m = np.zeros((2, 1, 4, 4), np.float32)
+        m[..., 2] = -1e9
+        out2 = paddle.incubate.softmax_mask_fuse(x, t(m)).numpy()
+        assert np.abs(out2[..., 2]).max() < 1e-6
+
+    def test_lookahead_converges(self):
+        paddle.seed(0)
+        w = t(np.array([4.0], np.float32), stop_gradient=False)
+        la = paddle.incubate.LookAhead(
+            paddle.optimizer.SGD(0.3, parameters=[w]), alpha=0.5, k=2)
+        for _ in range(25):
+            loss = (w * w).sum()
+            loss.backward()
+            la.step()
+            la.clear_grad()
+        assert abs(float(w.numpy()[0])) < 0.5
+
+    def test_model_average(self):
+        import jax.numpy as jnp
+        w = t(np.array([0.0], np.float32), stop_gradient=False)
+        ma = paddle.incubate.ModelAverage(0.5, parameters=[w])
+        for v in [1.0, 2.0, 3.0]:
+            w._data = jnp.full_like(w._data, v)
+            ma.step()
+        with ma.apply():
+            assert float(w.numpy()[0]) == pytest.approx(2.0)
+        assert float(w.numpy()[0]) == 3.0
+
+    def test_graph_aliases(self):
+        x = np.array([[1.0], [2.0], [3.0]], np.float32)
+        src = np.array([0, 1], np.int64)
+        dst = np.array([1, 2], np.int64)
+        out = paddle.incubate.graph_send_recv(t(x), t(src), t(dst))
+        np.testing.assert_allclose(out.numpy(), [[0.], [1.], [2.]])
+        seg = paddle.incubate.segment_sum(
+            t(x), t(np.array([0, 0, 1], np.int64)))
+        np.testing.assert_allclose(seg.numpy(), [[3.], [3.]])
+
+
+class TestSavedTensorsHooks:
+    def test_pack_unpack_offload(self):
+        packed, unpacked = [], []
+
+        def pack(tensor):
+            packed.append(1)
+            return np.asarray(tensor.numpy())
+
+        def unpack(obj):
+            unpacked.append(1)
+            return paddle.to_tensor(obj)
+
+        x = t(np.array([2.0, 3.0], np.float32), stop_gradient=False)
+        with paddle.autograd.saved_tensors_hooks(pack, unpack):
+            y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+        assert packed and unpacked
+
+    def test_no_hooks_outside_context(self):
+        x = t(np.array([2.0], np.float32), stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+class TestMiscModules:
+    def test_amp_support_flags(self):
+        assert paddle.amp.is_bfloat16_supported()
+        assert paddle.amp.is_float16_supported()
+
+    def test_jit_toggles(self):
+        paddle.jit.set_verbosity(3)
+        paddle.jit.set_code_level(50)
+        paddle.jit.ignore_module([os])
+        paddle.jit.enable_to_static(False)
+        try:
+            assert not paddle.jit._to_static_enabled()
+        finally:
+            paddle.jit.enable_to_static(True)
+
+    def test_metric_accuracy(self):
+        pred = t(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+        lab = t(np.array([[1], [0]], np.int64))
+        assert float(paddle.metric.accuracy(pred, lab).numpy()) == 1.0
+
+    def test_utils_deprecated_and_version(self):
+        @paddle.utils.deprecated(update_to="new_fn", since="0.1")
+        def old_fn():
+            return 42
+        with pytest.warns(DeprecationWarning):
+            assert old_fn() == 42
+        assert paddle.utils.require_version("0.0.1")
+        with pytest.raises(Exception):
+            paddle.utils.require_version("999.0.0")
+
+    def test_io_samplers(self):
+        s = paddle.io.SubsetRandomSampler([3, 5, 7])
+        assert sorted(s) == [3, 5, 7]
+
+        class _DS(paddle.io.Dataset):
+            def __init__(self, n):
+                self.n = n
+
+            def __len__(self):
+                return self.n
+
+            def __getitem__(self, i):
+                return i
+
+        cd = paddle.io.ConcatDataset([_DS(3), _DS(2)])
+        assert len(cd) == 5
+        assert cd[3] == 0 and cd[4] == 1
+
+    def test_quanter_registration(self):
+        @paddle.quantization.quanter("TestQReg")
+        class _Q(paddle.quantization.BaseQuanter):
+            def __init__(self, bits=8):
+                self.bits = bits
+        factory = paddle.quantization.TestQReg(bits=4)
+        assert factory._instance().bits == 4
+
+    def test_bilinear_initializer(self):
+        init = paddle.nn.initializer.Bilinear()
+        w = init([2, 2, 4, 4], "float32")
+        assert w.shape == (2, 2, 4, 4)
+        assert float(np.asarray(w)[0, 0, 1, 1]) > 0
+
+    def test_profiler_sorted_keys(self):
+        assert paddle.profiler.SortedKeys.CPUTotal == 0
+
+    def test_onnx_export(self, tmp_path):
+        net = paddle.nn.Linear(4, 2)
+        path = paddle.onnx.export(
+            net, str(tmp_path / "m"),
+            input_spec=[paddle.static.InputSpec([1, 4], "float32")])
+        assert path.endswith(".onnx")
+
+    def test_fleet_role_maker(self):
+        rm = paddle.distributed.fleet.PaddleCloudRoleMaker()
+        assert rm.is_worker() and rm.worker_index() == 0
+        u = paddle.distributed.fleet.UserDefinedRoleMaker(
+            current_id=1, worker_endpoints=["a:1", "b:2"])
+        assert u.worker_index() == 1 and u.worker_num() == 2
+        util = paddle.distributed.fleet.UtilBase()
+        files = util.get_file_shard(["a", "b", "c"])
+        assert files == ["a", "b", "c"]
+
+
+class TestReviewRegressions:
+    def test_gradients_with_two_feeds(self):
+        st.enable_static()
+        try:
+            prog = st.Program()
+            with st.program_guard(prog):
+                x = st.data("x", [2, 3], "float32")
+                y = st.data("y", [2, 1], "float32")
+                out = st.nn.fc(x, 1)
+                loss = ((out - y) ** 2).mean()
+                gx = st.gradients([loss], [x])[0]
+            exe = st.Executor()
+            xs = rng.randn(2, 3).astype(np.float32)
+            ys = rng.randn(2, 1).astype(np.float32)
+            l0, g = exe.run(prog, feed={"x": xs, "y": ys},
+                            fetch_list=[loss, gx])
+            eps = 1e-3
+            xs2 = xs.copy()
+            xs2[0, 1] += eps
+            l1 = exe.run(prog, feed={"x": xs2, "y": ys},
+                         fetch_list=[loss])[0]
+            np.testing.assert_allclose((float(l1) - float(l0)) / eps,
+                                       g[0, 1], rtol=0.05, atol=1e-3)
+        finally:
+            st.disable_static()
+
+    def test_sequence_pad_value(self):
+        sq = t(np.ones((1, 2, 3), np.float32))
+        padded, lens = st.nn.sequence_pad(
+            sq, t(np.float32(-1.0)), maxlen=4)
+        assert padded.numpy()[0, 2:].max() == -1.0
+        assert padded.numpy()[0, :2].min() == 1.0
+
+    def test_khop_sampler_multihop(self):
+        row = np.array([1, 2, 2, 0], np.int64)
+        colptr = np.array([0, 2, 3, 4], np.int64)
+        src, dst, nodes, counts = paddle.incubate.graph_khop_sampler(
+            t(row), t(colptr), t(np.array([0], np.int64)), [2, 1])
+        assert len(nodes.numpy()) >= 1
+        assert src.numpy().shape == dst.numpy().shape
+
+    def test_scatter_object_list_single_rank_keeps_all(self):
+        out = [None]
+        paddle.distributed.scatter_object_list(out, [1, 2, 3], src=0)
+        assert out == [1, 2, 3]
