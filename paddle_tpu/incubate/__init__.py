@@ -203,3 +203,5 @@ class ModelAverage:
 
     def minimize(self, loss):
         self.step()
+
+from ..ops.fused_ce import fused_linear_cross_entropy  # noqa: E402,F401

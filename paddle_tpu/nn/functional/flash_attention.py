@@ -31,6 +31,23 @@ def _attention_xla(q, k, v, bias, causal, scale, dropout_p, dropout_key):
         rep = Hq // Hk
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
+    if bias is None and dropout_p == 0.0 \
+            and jnp.issubdtype(q.dtype, jnp.floating) \
+            and q.dtype == k.dtype == v.dtype:
+        # MXU-native mixed precision: storage-dtype operands with f32
+        # accumulation; XLA's autodiff of this form keeps the big bwd
+        # matmuls at bf16 rate too (measured faster than a custom-vjp
+        # that pins bf16 residuals — the saved S^2 probs cost more HBM
+        # than the f32 cotangent saves)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k,
+                            preferred_element_type=jnp.float32)
+        if causal:
+            mask = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+            logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v,
+                         preferred_element_type=jnp.float32)
+        return out.astype(q.dtype)
     qf = q.astype(jnp.float32) * scale
     logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
     if causal:
@@ -44,6 +61,8 @@ def _attention_xla(q, k, v, bias, causal, scale, dropout_p, dropout_key):
         probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
+
+
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,

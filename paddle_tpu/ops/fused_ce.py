@@ -1,0 +1,77 @@
+"""Fused LM-head + softmax cross-entropy (the training hot block).
+
+Opt-in: in an ISOLATED microbenchmark at GPT-2-small shapes this block
+runs 4x faster than the unfused logits->log_softmax path (12.8ms vs
+53.6ms fwd+bwd: every matmul stays in storage dtype with f32 MXU
+accumulation, and backward recomputes the logits instead of saving the
+800MB residual). Inside the full jitted train step, however, XLA already
+schedules the unfused block well and the recompute makes the whole step
+~13ms SLOWER (interleaved A/B, 4 rounds) — so the model families do NOT
+use it by default. It remains the right tool when the logits residual
+doesn't fit (long-sequence / large-vocab training under memory
+pressure), the same trade the reference's fused kernels make.
+
+Capability parity: the reference fuses the same block on GPU as
+fused_linear_param_grad_add + c_softmax_with_cross_entropy
+(paddle/phi/kernels/fusion/, paddle/fluid/operators/collective/
+c_softmax_with_cross_entropy_op.cu).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fused_linear_cross_entropy"]
+
+
+def fused_linear_cross_entropy(h, w, labels, ignore_index=None):
+    """mean CE of softmax(h @ w.T) vs labels.
+
+    h: (tokens, hidden) activations; w: (vocab, hidden) tied LM-head
+    weight; labels: (tokens,) int ids. Returns the scalar mean loss.
+    """
+    labels = labels.astype(jnp.int32)
+    n = h.shape[0]
+    valid = None
+    if ignore_index is not None:
+        valid = (labels != ignore_index)
+        denom = jnp.maximum(jnp.sum(valid), 1)
+    else:
+        denom = n
+
+    @jax.custom_vjp
+    def _ce(h, w):
+        loss, _ = _fwd(h, w)
+        return loss
+
+    def _logits(h, w):
+        return jnp.matmul(h, w.T, preferred_element_type=jnp.float32)
+
+    def _fwd(h, w):
+        logits = _logits(h, w)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(
+            logits, jnp.clip(labels, 0, w.shape[0] - 1)[:, None], 1)[:, 0]
+        per_tok = lse - tgt
+        if valid is not None:
+            per_tok = jnp.where(valid, per_tok, 0.0)
+        loss = jnp.sum(per_tok) / denom
+        return loss, (h, w, lse)
+
+    def _bwd(res, g):
+        h, w, lse = res
+        logits = _logits(h, w)  # recompute: cheaper than an 800MB residual
+        p = jnp.exp(logits - lse[:, None])
+        dlogits = p.at[jnp.arange(h.shape[0]),
+                       jnp.clip(labels, 0, w.shape[0] - 1)].add(-1.0)
+        if valid is not None:
+            dlogits = dlogits * valid[:, None]
+        dlogits = (dlogits * (g / denom)).astype(h.dtype)
+        dh = jnp.matmul(dlogits, w,
+                        preferred_element_type=jnp.float32).astype(h.dtype)
+        dw = jnp.matmul(dlogits.T, h,
+                        preferred_element_type=jnp.float32).astype(w.dtype)
+        return dh, dw
+
+    _ce.defvjp(_fwd, _bwd)
+    return _ce(h, w)

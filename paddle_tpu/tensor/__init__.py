@@ -53,6 +53,22 @@ def _patch_tensor_methods():
     Tensor.exponential_ = _random_mod.exponential_
     Tensor.uniform_ = _random_mod.uniform_
     Tensor.normal_ = _random_mod.normal_
+    Tensor.floor_mod = math.mod
+    Tensor.inverse = linalg.inv
+    from ..signal import istft as _istft
+    from ..signal import stft as _stft
+    Tensor.stft = _stft
+    Tensor.istft = _istft
+    Tensor.multinomial = _random_mod.multinomial
+
+    from .random import top_p_sampling as _tps
+    Tensor.top_p_sampling = _tps
+
+    def _create_tensor(self, *a, **k):
+        raise TypeError("create_tensor is a static-graph helper; use "
+                        "paddle.to_tensor in dygraph")
+    Tensor.create_tensor = _create_tensor
+    Tensor.create_parameter = _create_tensor
 
     import jax.numpy as jnp
     from ..core.dispatch import run_op

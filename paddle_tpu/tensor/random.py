@@ -17,7 +17,7 @@ __all__ = [
     "rand", "randn", "standard_normal", "randint", "randint_like", "uniform",
     "normal", "gaussian", "bernoulli", "multinomial", "randperm", "poisson",
     "exponential_", "uniform_", "normal_", "binomial", "standard_gamma",
-    "log_normal",
+    "log_normal", "top_p_sampling",
 ]
 
 
@@ -151,3 +151,34 @@ def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
 def normal_(x, mean=0.0, std=1.0, name=None):
     x._data = mean + std * jax.random.normal(_key(), tuple(x.shape), dtype=x.dtype)
     return x
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
+                   mode="truncated", return_top=False, name=None):
+    """Nucleus sampling over the last axis (parity: paddle.tensor
+    .top_p_sampling — the inference-decode sampler). Returns
+    (sampled values, sampled ids). seed=-1 (default) draws from the
+    framework RNG stream; a non-negative seed is deterministic."""
+    from ..core.dispatch import run_op
+
+    if threshold is not None or k or mode != "truncated" or return_top:
+        raise NotImplementedError(
+            "top_p_sampling: threshold/k/mode/return_top are not "
+            "supported yet; only the default truncated nucleus sampler")
+    key = _key() if seed in (None, -1) else jax.random.key(seed)
+
+    def fn(logits, p_):
+        sorted_idx = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, sorted_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < p_[..., None]  # always keep the top token
+        masked = jnp.where(keep, sorted_logits, -jnp.inf)
+        g = jax.random.gumbel(key, masked.shape)
+        choice = jnp.argmax(masked + g, axis=-1)
+        ids = jnp.take_along_axis(sorted_idx, choice[..., None], axis=-1)
+        vals = jnp.take_along_axis(logits, ids, axis=-1)
+        return vals, ids.astype(jnp.int64)
+    vals, ids = run_op("top_p_sampling", fn, (x, ps),
+                       num_nondiff_outputs=1)
+    return vals, ids

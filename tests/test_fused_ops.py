@@ -295,3 +295,114 @@ class TestDecodeAttention:
         mask[2, :, 1, :] = False
         np.testing.assert_array_equal(nkc[mask], kc[mask])
         np.testing.assert_allclose(nkc[2, :, 1], k[0], rtol=1e-6)
+
+
+class TestFusedLinearCrossEntropy:
+    def test_matches_unfused(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.fused_ce import fused_linear_cross_entropy
+        rng = np.random.RandomState(0)
+        h = jnp.asarray(rng.randn(64, 32), jnp.float32) * 0.1
+        w = jnp.asarray(rng.randn(100, 32), jnp.float32) * 0.1
+        y = jnp.asarray(rng.randint(0, 100, (64,)), jnp.int32)
+
+        def unfused(h, w):
+            logits = (h @ w.T).astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            tgt = jnp.take_along_axis(logits, y[:, None], 1)[:, 0]
+            return jnp.mean(lse - tgt)
+
+        l1 = fused_linear_cross_entropy(h, w, y)
+        l2 = unfused(h, w)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+        g1 = jax.grad(lambda a, b: fused_linear_cross_entropy(a, b, y),
+                      argnums=(0, 1))(h, w)
+        g2 = jax.grad(unfused, argnums=(0, 1))(h, w)
+        np.testing.assert_allclose(g1[0], g2[0], atol=1e-5)
+        np.testing.assert_allclose(g1[1], g2[1], atol=1e-5)
+
+    def test_ignore_index(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.fused_ce import fused_linear_cross_entropy
+        rng = np.random.RandomState(0)
+        h = jnp.asarray(rng.randn(8, 16), jnp.float32)
+        w = jnp.asarray(rng.randn(20, 16), jnp.float32)
+        y = jnp.asarray([1, 2, -100, 3, -100, 4, 5, 6], jnp.int32)
+        l_masked = fused_linear_cross_entropy(h, w, y, ignore_index=-100)
+        keep = np.array([0, 1, 3, 5, 6, 7])
+        l_ref = fused_linear_cross_entropy(h[keep], w, y[keep])
+        np.testing.assert_allclose(float(l_masked), float(l_ref), rtol=1e-5)
+
+
+
+
+class TestMixedPrecisionAttention:
+    def _ref(self, q, k, v, scale):
+        import jax
+        import jax.numpy as jnp
+        qf = q.astype(jnp.float32) * scale
+        S = q.shape[1]
+        lg = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        lg = jnp.where(mask, lg, -1e30)
+        return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(lg, -1),
+                          v.astype(jnp.float32))
+
+    def test_f32_inputs_match_reference(self):
+        import importlib
+        import jax.numpy as jnp
+        FA = importlib.import_module(
+            "paddle_tpu.nn.functional.flash_attention")
+        rng = np.random.RandomState(1)
+        q, k, v = [jnp.asarray(rng.randn(2, 64, 4, 32), jnp.float32) * 0.3
+                   for _ in range(3)]
+        out = FA._attention_xla(q, k, v, None, True, 0.176, 0.0, None)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(self._ref(q, k, v, 0.176)),
+                                   atol=1e-5)
+
+    def test_bf16_mixed_path_close_to_f32(self):
+        import importlib
+        import jax
+        import jax.numpy as jnp
+        FA = importlib.import_module(
+            "paddle_tpu.nn.functional.flash_attention")
+        rng = np.random.RandomState(2)
+        qf, kf, vf = [jnp.asarray(rng.randn(2, 64, 4, 32),
+                                  jnp.float32) * 0.3 for _ in range(3)]
+        q, k, v = (qf.astype(jnp.bfloat16), kf.astype(jnp.bfloat16),
+                   vf.astype(jnp.bfloat16))
+        out = FA._attention_xla(q, k, v, None, True, 0.176, 0.0, None)
+        assert out.dtype == jnp.bfloat16
+        ref = self._ref(qf, kf, vf, 0.176)
+        # bf16 storage: ~2-3 decimal digits
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref), atol=3e-2)
+
+    def test_bf16_grads_finite_and_close(self):
+        import importlib
+        import jax
+        import jax.numpy as jnp
+        FA = importlib.import_module(
+            "paddle_tpu.nn.functional.flash_attention")
+        rng = np.random.RandomState(3)
+        qf, kf, vf = [jnp.asarray(rng.randn(1, 32, 2, 16),
+                                  jnp.float32) * 0.3 for _ in range(3)]
+
+        def loss_mixed(q, k, v):
+            return FA._attention_xla(
+                q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+                v.astype(jnp.bfloat16), None, True, 0.25, 0.0,
+                None).astype(jnp.float32).sum()
+
+        def loss_ref(q, k, v):
+            return self._ref(q, k, v, 0.25).sum()
+        g1 = jax.grad(loss_mixed, argnums=(0, 1, 2))(qf, kf, vf)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(qf, kf, vf)
+        for a, b in zip(g1, g2):
+            assert np.isfinite(np.asarray(a, np.float32)).all()
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b), atol=5e-2)
