@@ -1,0 +1,137 @@
+"""Kernel autotune cache (parity: paddle/phi/kernels/autotune/ — the
+reference measures candidate kernels per op+shape key and caches the
+winner; switch_autotune.h exposes enable/disable).
+
+Here the candidates are the registered impls of a fused op ("pallas" vs
+"xla"). A call with CONCRETE arrays and a new (op, shapes, dtypes) key
+times every candidate on the live device and caches the fastest; calls
+under tracing (jit, or inside the autograd tape's jax.vjp — i.e. any
+forward that needs grads) consult the cache without measuring. The
+measurement therefore happens on no-grad eager calls: run one eval/
+warmup batch per shape (or preload a cache file) before training, and
+the jitted train step picks up the cached winners. The cache can persist
+to a JSON file so later processes skip the measurement, like the
+reference's serialized autotune cache.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+__all__ = ["enable_autotune", "disable_autotune", "autotune_status",
+           "set_autotune_cache_file", "clear_autotune_cache"]
+
+_CACHE: Dict[str, str] = {}
+_CACHE_FILE: Optional[str] = None
+_STATS = {"hits": 0, "misses": 0, "measured": 0}
+
+
+def _flag_on() -> bool:
+    from . import flags as _flags
+    return bool(_flags.get_flag("use_autotune"))
+
+
+def enable_autotune() -> None:
+    from . import flags as _flags
+    _flags.set_flags({"use_autotune": True})
+
+
+def disable_autotune() -> None:
+    from . import flags as _flags
+    _flags.set_flags({"use_autotune": False})
+
+
+def autotune_status() -> dict:
+    """(parity: paddle.incubate.autotune status surface)"""
+    return {"use_autotune": _flag_on(), "cache_size": len(_CACHE),
+            **_STATS}
+
+
+def set_autotune_cache_file(path: Optional[str]) -> None:
+    """Persist decisions to ``path`` (JSON) and preload existing ones."""
+    global _CACHE_FILE
+    _CACHE_FILE = path
+    if path and os.path.exists(path):
+        try:
+            with open(path) as f:
+                _CACHE.update(json.load(f))
+        except Exception:
+            pass
+
+
+def clear_autotune_cache() -> None:
+    _CACHE.clear()
+    _STATS.update(hits=0, misses=0, measured=0)
+
+
+def _key(name: str, arrays) -> str:
+    parts = [name]
+    for a in arrays:
+        if hasattr(a, "shape"):
+            parts.append(f"{tuple(a.shape)}:{a.dtype}")
+        else:
+            parts.append(repr(a)[:20])
+    return "|".join(parts)
+
+
+def _save() -> None:
+    if _CACHE_FILE:
+        try:
+            with open(_CACHE_FILE, "w") as f:
+                json.dump(_CACHE, f, indent=0)
+        except Exception:
+            pass
+
+
+def _measure(fn, args, warmup: int = 1, iters: int = 3):
+    out = fn(*args)
+    jax.tree_util.tree_map(
+        lambda x: jax.device_get(x) if hasattr(x, "shape") else x, out)
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.tree_util.tree_map(
+            lambda x: jax.device_get(x) if hasattr(x, "shape") else x, out)
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def pick_impl(name: str, impls: Dict[str, Any], arrays, call):
+    """Return ``(winner_name, winner_output)`` for this call, measuring
+    candidates on a cache miss (concrete arrays only). ``call(impl_name)``
+    must run the op with the given impl and return its outputs. Returns
+    ``(None, None)`` when autotuning does not apply (disabled, single
+    impl, or tracing with an empty cache); a cache hit returns
+    ``(name, None)`` — the caller runs the winner itself."""
+    if not _flag_on() or len(impls) < 2:
+        return None, None
+    if any(isinstance(a, jax.core.Tracer) for a in arrays):
+        # traced call (jit or inside jax.vjp): consult-only
+        k = _key(name, arrays)
+        choice = _CACHE.get(k)
+        if choice is not None:
+            _STATS["hits"] += 1
+        return choice, None
+    k = _key(name, arrays)
+    if k in _CACHE:
+        _STATS["hits"] += 1
+        return _CACHE[k], None
+    _STATS["misses"] += 1
+    best_name, best_t, best_out = None, float("inf"), None
+    for impl_name in impls:
+        try:
+            t, out = _measure(lambda *a: call(impl_name), arrays)
+        except Exception:
+            continue  # a candidate that crashes never wins
+        _STATS["measured"] += 1
+        if t < best_t:
+            best_name, best_t, best_out = impl_name, t, out
+    if best_name is not None:
+        _CACHE[k] = best_name
+        _save()  # one small JSON per NEW key; misses are one-time per shape
+    return best_name, best_out

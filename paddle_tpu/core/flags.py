@@ -58,6 +58,7 @@ define_flag("check_nan_inf", False, "check every op output for NaN/Inf")
 define_flag("check_index_bounds", False,
             "eager range-check of gather/embedding indices (host sync)")
 define_flag("use_pallas_kernels", True, "prefer Pallas fused kernels over XLA lowering")
+define_flag("use_autotune", False, "measure-and-cache fused-kernel impl selection per op+shape (parity: FLAGS_use_autotune, paddle/phi/kernels/autotune/switch_autotune.h)")
 define_flag("use_spmd_rules", True,
             "apply explicit per-op SPMD rules (sharding constraints + "
             "dist_attr propagation) where registered")

@@ -205,3 +205,5 @@ class ModelAverage:
         self.step()
 
 from ..ops.fused_ce import fused_linear_cross_entropy  # noqa: E402,F401
+
+from ..core import autotune  # noqa: E402,F401

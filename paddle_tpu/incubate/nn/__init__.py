@@ -1,2 +1,6 @@
 """incubate.nn (parity: python/paddle/incubate/nn/)."""
 from . import functional  # noqa: F401
+from .layer import (FusedMultiHeadAttention, FusedFeedForward,  # noqa: F401
+                    FusedTransformerEncoderLayer, FusedMultiTransformer,
+                    FusedLinear, FusedBiasDropoutResidualLayerNorm,
+                    FusedDropoutAdd, FusedEcMoe)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
+import threading
 import struct
 from typing import List
 
@@ -54,6 +55,16 @@ def _to_numpy_batch(batch) -> List[np.ndarray]:
 
 def _worker_loop(dataset, index_batches, collate_fn, qname, worker_id,
                  num_workers, init_fn, seed):
+    # data-prep workers are host-side: pin the child to the CPU backend
+    # BEFORE any jax array op, so a worker never initializes (or dials,
+    # on remote-TPU platforms) the accelerator it inherited via env —
+    # a saturated TPU tunnel must not stall the input pipeline
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax as _jax
+        _jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, dataset, seed)
     np.random.seed((seed + worker_id) % (2 ** 32))
@@ -86,6 +97,9 @@ def _worker_loop(dataset, index_batches, collate_fn, qname, worker_id,
     finally:
         q.close()
     os._exit(0)  # skip atexit/jax teardown inherited from the parent
+
+
+_ENV_SCRUB_LOCK = threading.Lock()
 
 
 class WorkerStartupError(RuntimeError):
@@ -136,21 +150,54 @@ class MultiprocessLoaderIter:
         else:
             shares = [None] * self.num_workers
         self.procs = []
-        for w in range(self.num_workers):
-            p = ctx.Process(
-                target=_worker_loop,
-                args=(loader.dataset, shares[w], collate,
-                      self.queues[w].name, w, self.num_workers,
-                      loader.worker_init_fn, seed),
-                daemon=True)
-            try:
-                p.start()
-            except Exception as e:
-                self.shutdown()
-                raise WorkerStartupError(
-                    f"could not start DataLoader worker {w} under the "
-                    f"'{method}' start method: {e}") from e
-            self.procs.append(p)
+        # Serialize the env scrub across threads: the window mutates
+        # process-global env, so concurrent iterator construction must
+        # not interleave save/restore (and the window is kept as short
+        # as possible — only the Process.start calls).
+        # Children must inherit a CPU-pinned jax: dataset args can hold
+        # jax arrays whose UNPICKLING (before _worker_loop's own guard
+        # runs) initializes the default backend — on remote-TPU platforms
+        # that dials the accelerator tunnel from every data worker. The
+        # guard also covers the forkserver helper, which captures env at
+        # its first boot.
+        scrub = {"JAX_PLATFORMS": "cpu"}
+        # remote-TPU platforms register their backend from sitecustomize
+        # whenever their trigger env is present, ignoring JAX_PLATFORMS —
+        # strip the trigger too so a data worker can never register (let
+        # alone dial) the accelerator plugin
+        for trigger in ("PALLAS_AXON_POOL_IPS",):
+            if trigger in os.environ:
+                scrub[trigger] = None
+        _ENV_SCRUB_LOCK.acquire()
+        prev_env = {k: os.environ.get(k) for k in scrub}
+        for k, v in scrub.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            for w in range(self.num_workers):
+                p = ctx.Process(
+                    target=_worker_loop,
+                    args=(loader.dataset, shares[w], collate,
+                          self.queues[w].name, w, self.num_workers,
+                          loader.worker_init_fn, seed),
+                    daemon=True)
+                try:
+                    p.start()
+                except Exception as e:
+                    self.shutdown()
+                    raise WorkerStartupError(
+                        f"could not start DataLoader worker {w} under the "
+                        f"'{method}' start method: {e}") from e
+                self.procs.append(p)
+        finally:
+            for k, v in prev_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            _ENV_SCRUB_LOCK.release()
         self._done = [False] * self.num_workers
         self._started = [False] * self.num_workers
         self._t0 = __import__("time").monotonic()

@@ -1,6 +1,8 @@
 """Fused kernels: Pallas cross-entropy, fused optimizer step, incubate
 fused functional ops (reference test models: test/legacy_test/
 test_softmax_with_cross_entropy_op.py, fused-op tests)."""
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -406,3 +408,62 @@ class TestMixedPrecisionAttention:
             assert np.isfinite(np.asarray(a, np.float32)).all()
             np.testing.assert_allclose(np.asarray(a, np.float32),
                                        np.asarray(b), atol=5e-2)
+
+
+class TestAutotuneCache:
+    def test_measures_once_then_hits(self):
+        import importlib
+
+        import paddle_tpu as paddle
+        from paddle_tpu.core import autotune
+
+        autotune.clear_autotune_cache()
+        autotune.enable_autotune()
+        try:
+            import paddle_tpu.nn.functional as F
+            x = paddle.to_tensor(
+                np.random.RandomState(0).randn(2, 64, 4, 32).astype(
+                    np.float32))
+            F.flash_attention(x, x, x, causal=True)
+            st1 = autotune.autotune_status()
+            assert st1["misses"] == 1
+            assert st1["cache_size"] == 1
+            F.flash_attention(x, x, x, causal=True)
+            st2 = autotune.autotune_status()
+            assert st2["hits"] >= 1
+            assert st2["misses"] == 1  # no re-measure
+            # a different shape is a new key
+            y = paddle.to_tensor(
+                np.random.RandomState(0).randn(1, 32, 2, 16).astype(
+                    np.float32))
+            F.flash_attention(y, y, y, causal=True)
+            assert autotune.autotune_status()["cache_size"] == 2
+        finally:
+            autotune.disable_autotune()
+            autotune.clear_autotune_cache()
+
+    def test_cache_file_roundtrip(self, tmp_path):
+        from paddle_tpu.core import autotune
+        autotune.clear_autotune_cache()
+        path = str(tmp_path / "at.json")
+        autotune.set_autotune_cache_file(path)
+        autotune.enable_autotune()
+        try:
+            import paddle_tpu as paddle
+            import paddle_tpu.nn.functional as F
+            x = paddle.to_tensor(
+                np.random.RandomState(0).randn(2, 64, 4, 32).astype(
+                    np.float32))
+            F.flash_attention(x, x, x, causal=True)
+            assert os.path.exists(path)
+            import json
+            data = json.load(open(path))
+            assert len(data) == 1
+            # preload path
+            autotune.clear_autotune_cache()
+            autotune.set_autotune_cache_file(path)
+            assert autotune.autotune_status()["cache_size"] == 1
+        finally:
+            autotune.disable_autotune()
+            autotune.clear_autotune_cache()
+            autotune.set_autotune_cache_file(None)
