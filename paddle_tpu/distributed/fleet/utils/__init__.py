@@ -4,3 +4,107 @@ from . import sequence_parallel_utils  # noqa: F401
 from . import hybrid_parallel_util  # noqa: F401
 from . import timer_helper  # noqa: F401
 from .timer_helper import get_timers, set_timers  # noqa: F401
+
+
+class LocalFS:
+    """Local filesystem client (parity: paddle.distributed.fleet.utils
+    .LocalFS, fleet/utils/fs.py — the FS interface the checkpoint and
+    PS paths use)."""
+
+    def ls_dir(self, fs_path):
+        import os
+        dirs, files = [], []
+        if not os.path.exists(fs_path):
+            return dirs, files
+        for e in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, e))
+             else files).append(e)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        import os
+        os.makedirs(fs_path, exist_ok=True)
+
+    def is_dir(self, fs_path):
+        import os
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path):
+        import os
+        return os.path.isfile(fs_path)
+
+    def is_exist(self, fs_path):
+        import os
+        return os.path.exists(fs_path)
+
+    def delete(self, fs_path):
+        import os
+        import shutil
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        import os
+        os.rename(fs_src_path, fs_dst_path)
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=True):
+        import os
+        if not overwrite and os.path.exists(dst_path):
+            raise FileExistsError(dst_path)
+        os.replace(src_path, dst_path)
+
+    def upload(self, local_path, fs_path):
+        import shutil
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        import shutil
+        shutil.copy(fs_path, local_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        import os
+        if os.path.exists(fs_path) and not exist_ok:
+            raise FileExistsError(fs_path)
+        open(fs_path, "a").close()
+
+    def cat(self, fs_path):
+        with open(fs_path, "rb") as f:
+            return f.read()
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient:
+    """HDFS client stub (parity surface: fleet.utils.HDFSClient — the
+    reference shells out to the hadoop CLI; no hadoop exists in this
+    image, so construction requires an explicit local fallback)."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=300000,
+                 sleep_inter=1000):
+        raise RuntimeError(
+            "HDFSClient is not implemented in the TPU build (the "
+            "reference shells out to the hadoop CLI, which this image "
+            "does not ship) — use LocalFS or mount the HDFS path")
+
+
+class DistributedInfer:
+    """Distributed inference helper (parity: fleet.utils.DistributedInfer
+    — the reference rewrites a PS program for inference; here it wraps a
+    Layer/program and runs the local shard)."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        if dirname is not None:
+            from ....framework import load
+            state = load(dirname)
+            if hasattr(self._main, "set_state_dict"):
+                self._main.set_state_dict(state)
+
+    def get_dist_infer_program(self):
+        return self._main

@@ -6,3 +6,4 @@ from .layer import *  # noqa: F401,F403
 from .layer.layers import Layer, functional_state, functional_call  # noqa: F401
 from .parameter import Parameter, ParamAttr, create_parameter  # noqa: F401
 from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,197 @@
+"""Parameter utilities (parity: python/paddle/nn/utils/ — weight_norm,
+spectral_norm reparameterizations, flat-vector conversion, in-place grad
+clipping)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+def _norm_except(w, dim):
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize ``layer.<name>`` as g * v / ||v|| (parity:
+    paddle.nn.utils.weight_norm, python/paddle/nn/utils/weight_norm_hook.py).
+    Adds <name>_g / <name>_v parameters and recomputes <name> in a
+    forward-pre hook, so optimizers train g and v."""
+    w = getattr(layer, name)
+    arr = w._data
+    if dim is not None and dim < 0:
+        dim = arr.ndim + dim  # normalize negative dims for _norm_except
+    if dim is None:
+        g0 = jnp.sqrt(jnp.sum(arr * arr)).reshape(())
+    else:
+        g0 = _norm_except(arr, dim).reshape(-1)
+    g = layer.create_parameter(list(g0.shape) or [1],
+                               default_initializer=lambda s, d: g0.reshape(
+                                   tuple(s)))
+    v = layer.create_parameter(list(arr.shape),
+                               default_initializer=lambda s, d: arr)
+    setattr(layer, f"{name}_g", g)
+    setattr(layer, f"{name}_v", v)
+    # the base weight is no longer a trainable parameter
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _recompute(lyr, inputs):
+        def fn(gv, vv):
+            if dim is None:
+                n = jnp.sqrt(jnp.sum(vv * vv))
+                return vv * (gv.reshape(()) / jnp.maximum(n, 1e-12))
+            n = _norm_except(vv, dim)
+            shape = [1] * vv.ndim
+            shape[dim] = -1
+            return vv * (gv.reshape(shape) / jnp.maximum(n, 1e-12))
+        setattr(lyr, name, run_op("weight_norm", fn, (g, v)))
+        return inputs
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_hook = (name, handle)
+    _recompute(layer, ())  # materialize immediately
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """(parity: paddle.nn.utils.remove_weight_norm)"""
+    hook = getattr(layer, "_weight_norm_hook", None)
+    if hook is None or hook[0] != name:
+        raise ValueError(f"layer has no weight_norm on '{name}'")
+    _, handle = hook
+    handle.remove()
+    w = getattr(layer, name)
+    g = getattr(layer, f"{name}_g")
+    v = getattr(layer, f"{name}_v")
+    for pname in (f"{name}_g", f"{name}_v"):
+        if pname in layer._parameters:
+            del layer._parameters[pname]
+        if hasattr(layer, pname):
+            delattr(layer, pname)
+    # re-install the materialized weight as a plain parameter
+    new_w = layer.create_parameter(
+        list(w.shape), default_initializer=lambda s, d: w._data)
+    setattr(layer, name, new_w)
+    del layer._weight_norm_hook
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Divide the weight by its largest singular value, estimated by
+    power iteration (parity: paddle.nn.utils.spectral_norm)."""
+    w = getattr(layer, name)
+    arr = w._data
+    if dim is None:
+        dim = 0
+    mat = jnp.moveaxis(arr, dim, 0).reshape(arr.shape[dim], -1)
+    key = jax.random.key(0)
+    u0 = jax.random.normal(key, (mat.shape[0],))
+    u0 = u0 / jnp.linalg.norm(u0)
+    state = {"u": u0}
+    v_param = layer.create_parameter(
+        list(arr.shape), default_initializer=lambda s, d: arr)
+    setattr(layer, f"{name}_orig", v_param)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _recompute(lyr, inputs):
+        def fn(vv):
+            m = jnp.moveaxis(vv, dim, 0).reshape(vv.shape[dim], -1)
+            u = state["u"]
+            for _ in range(n_power_iterations):
+                v = m.T @ u
+                v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+                u = m @ v
+                u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+            sigma = u @ (m @ v)
+            return vv / jnp.maximum(sigma, eps)
+        out = run_op("spectral_norm_weight", fn, (v_param,))
+        if not isinstance(out._data, jax.core.Tracer):
+            # advance the persisted power-iteration vector eagerly
+            m = jnp.moveaxis(v_param._data, dim, 0).reshape(
+                v_param._data.shape[dim], -1)
+            u = state["u"]
+            v = m.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = m @ v
+            state["u"] = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        setattr(lyr, name, out)
+        return inputs
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._spectral_norm_hook = (name, handle)
+    _recompute(layer, ())
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten a parameter list into one 1-D tensor (parity:
+    paddle.nn.utils.parameters_to_vector)."""
+    params = list(parameters)
+    return run_op("parameters_to_vector",
+                  lambda *ps: jnp.concatenate([p.reshape(-1) for p in ps]),
+                  tuple(params))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Write slices of ``vec`` back into the parameters in order
+    (parity: paddle.nn.utils.vector_to_parameters)."""
+    params = list(parameters)
+    arr = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    need = sum(int(np.prod(p.shape)) if p.shape else 1 for p in params)
+    if need != arr.shape[0]:
+        raise ValueError(
+            f"vector has {arr.shape[0]} elements but parameters need "
+            f"{need}")
+    off = 0
+    for p in params:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p._data = arr[off:off + n].reshape(tuple(p.shape)).astype(
+            p._data.dtype)
+        off += n
+    return params
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm clip of ``.grad`` (parity:
+    paddle.nn.utils.clip_grad_norm_). Returns the total norm."""
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple))
+                          else [parameters]) if p.grad is not None]
+    if not params:
+        return Tensor(jnp.asarray(0.0))
+    grads = [p.grad._data.astype(jnp.float32) for p in params]
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.asarray([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g), norm_type)) for g in grads),
+            1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"the total norm of gradients is non-finite ({total})")
+    coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p.grad._data = (p.grad._data.astype(jnp.float32) * coef).astype(
+            p.grad._data.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """In-place elementwise clip of ``.grad`` to [-v, v] (parity:
+    paddle.nn.utils.clip_grad_value_)."""
+    v = float(clip_value)
+    for p in (parameters if isinstance(parameters, (list, tuple))
+              else [parameters]):
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -v, v)

@@ -6,6 +6,20 @@ from . import ops  # noqa: F401
 from .models import (ResNet, resnet18, resnet34, resnet50,  # noqa: F401
                      resnet101, resnet152, LeNet, VGG, vgg16,
                      MobileNetV2, mobilenet_v2)
+from .models import (AlexNet, DenseNet, GoogLeNet, InceptionV3,  # noqa: F401
+                     MobileNetV1, MobileNetV3Large, MobileNetV3Small,
+                     ShuffleNetV2, SqueezeNet, alexnet, densenet121,
+                     densenet161, densenet169, densenet201, densenet264,
+                     googlenet, inception_v3, mobilenet_v1,
+                     mobilenet_v3_large, mobilenet_v3_small,
+                     resnext50_32x4d, resnext50_64x4d, resnext101_32x4d,
+                     resnext101_64x4d, resnext152_32x4d,
+                     resnext152_64x4d, shufflenet_v2_swish,
+                     shufflenet_v2_x0_5, shufflenet_v2_x0_25,
+                     shufflenet_v2_x0_33, shufflenet_v2_x1_0,
+                     shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+                     squeezenet1_0, squeezenet1_1)
+
 
 
 _image_backend = "pil"

@@ -16,7 +16,8 @@ import numpy as np
 
 from ...io import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
+           "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]
 
 
 class FakeData(Dataset):
@@ -170,3 +171,196 @@ class Cifar100(_CifarBase):
     _TRAIN_FILES = ["train"]
     _TEST_FILES = ["test"]
     _LABEL_KEY = b"fine_labels"
+
+
+_IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                   ".tif", ".tiff", ".webp")
+
+
+def _load_image(path):
+    from ..ops import decode_jpeg, read_file
+    try:
+        from PIL import Image
+        img = Image.open(path).convert("RGB")
+        return np.asarray(img)
+    except ImportError:  # pragma: no cover
+        return np.asarray(decode_jpeg(read_file(path), mode="rgb")
+                          .numpy()).transpose(1, 2, 0)
+
+
+class DatasetFolder(Dataset):
+    """Class-per-subdirectory dataset (parity:
+    paddle.vision.datasets.DatasetFolder,
+    python/paddle/vision/datasets/folder.py)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        super().__init__()
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _load_image
+        extensions = extensions or _IMG_EXTENSIONS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders found in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for f in sorted(files):
+                    path = os.path.join(dirpath, f)
+                    ok = is_valid_file(path) if is_valid_file else \
+                        f.lower().endswith(tuple(extensions))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(
+                f"found 0 files in subfolders of {root} with extensions "
+                f"{extensions}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat/recursive image listing without labels (parity:
+    paddle.vision.datasets.ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        super().__init__()
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _load_image
+        extensions = extensions or _IMG_EXTENSIONS
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                path = os.path.join(dirpath, f)
+                ok = is_valid_file(path) if is_valid_file else \
+                    f.lower().endswith(tuple(extensions))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"found 0 images under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (parity: paddle.vision.datasets.Flowers) over a
+    local extracted directory: jpg/ images + imagelabels.mat-style
+    labels.txt (one label per line) or setid split files."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend=None):
+        super().__init__()
+        if download:
+            raise RuntimeError(
+                "this environment has no network egress; pass data_file "
+                "pointing at a local extracted Flowers directory")
+        _need_dir(data_file, "Flowers")
+        img_dir = os.path.join(data_file, "jpg") \
+            if os.path.isdir(os.path.join(data_file, "jpg")) else data_file
+        files = sorted(
+            os.path.join(img_dir, f) for f in os.listdir(img_dir)
+            if f.lower().endswith(_IMG_EXTENSIONS))
+        labels_path = label_file or next(
+            (os.path.join(data_file, n)
+             for n in ("imagelabels.mat", "labels.txt")
+             if os.path.exists(os.path.join(data_file, n))), None)
+        labels = [0] * len(files)
+        if labels_path and labels_path.endswith(".mat"):
+            import scipy.io
+            labels = list(scipy.io.loadmat(labels_path)["labels"]
+                          .reshape(-1).astype(int))
+        elif labels_path:
+            with open(labels_path) as f:
+                labels = [int(x) for x in f.read().split()]
+        # split by setid (1-based image indices per the reference layout)
+        setid_path = setid_file or os.path.join(data_file, "setid.mat")
+        if os.path.exists(setid_path):
+            import scipy.io
+            setid = scipy.io.loadmat(setid_path)
+            key = {"train": "trnid", "valid": "valid",
+                   "test": "tstid"}.get(mode, "trnid")
+            idx = [i - 1 for i in setid[key].reshape(-1).astype(int)
+                   if 0 < i <= len(files)]
+            self.files = [files[i] for i in idx]
+            self.labels = [labels[i] for i in idx]
+        else:
+            self.files = files
+            self.labels = labels
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = _load_image(self.files[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC 2012 segmentation (parity:
+    paddle.vision.datasets.VOC2012) over a local VOCdevkit tree."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        super().__init__()
+        if download:
+            raise RuntimeError(
+                "this environment has no network egress; pass data_file "
+                "pointing at a local VOCdevkit/VOC2012 directory")
+        _need_dir(data_file, "VOC2012")
+        root = data_file
+        if os.path.isdir(os.path.join(root, "VOCdevkit", "VOC2012")):
+            root = os.path.join(root, "VOCdevkit", "VOC2012")
+        split_name = {"train": "train", "valid": "val", "val": "val",
+                      "test": "trainval"}.get(mode, "train")
+        split = os.path.join(root, "ImageSets", "Segmentation",
+                             f"{split_name}.txt")
+        with open(split) as f:
+            ids = [ln.strip() for ln in f if ln.strip()]
+        self.images = [os.path.join(root, "JPEGImages", f"{i}.jpg")
+                       for i in ids]
+        self.masks = [os.path.join(root, "SegmentationClass", f"{i}.png")
+                      for i in ids]
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = _load_image(self.images[idx])
+        mask = _load_image(self.masks[idx])[..., 0]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self.images)
+
+
+def _need_dir(path, what):
+    if path is None or not os.path.isdir(path):
+        raise FileNotFoundError(
+            f"{what}: this environment has no network egress — pass the "
+            "local dataset directory (the reference downloads an archive)")

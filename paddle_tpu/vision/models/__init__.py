@@ -5,3 +5,30 @@ from .resnet import (BasicBlock, BottleneckBlock, ResNet,  # noqa: F401
                      resnet18, resnet34, resnet50, resnet101, resnet152,
                      wide_resnet50_2, wide_resnet101_2)
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+
+from .alexnet import AlexNet, alexnet  # noqa: F401
+from .densenet import (DenseNet, densenet121, densenet161,  # noqa: F401
+                       densenet169, densenet201, densenet264)
+from .inception import (GoogLeNet, InceptionV3, googlenet,  # noqa: F401
+                        inception_v3)
+from .mobilenet import (MobileNetV1, MobileNetV3Large,  # noqa: F401
+                        MobileNetV3Small, mobilenet_v1,
+                        mobilenet_v3_large, mobilenet_v3_small)
+from .resnet import (resnext50_32x4d, resnext50_64x4d,  # noqa: F401
+                     resnext101_32x4d, resnext101_64x4d,
+                     resnext152_32x4d, resnext152_64x4d)
+from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_swish,  # noqa: F401
+                           shufflenet_v2_x0_5, shufflenet_v2_x0_25,
+                           shufflenet_v2_x0_33, shufflenet_v2_x1_0,
+                           shufflenet_v2_x1_5, shufflenet_v2_x2_0)
+from .squeezenet import (SqueezeNet, squeezenet1_0,  # noqa: F401
+                         squeezenet1_1)
+
+def _check_pretrained(pretrained):
+    """Shared guard: pretrained weights cannot be fetched in a zero-egress
+    environment — load a local state_dict instead."""
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are unavailable in this zero-egress "
+            "build; load a local state_dict with set_state_dict")
+

@@ -1,0 +1,227 @@
+"""GoogLeNet + InceptionV3 (parity: python/paddle/vision/models/
+googlenet.py, inceptionv3.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor.manipulation import concat
+
+__all__ = ["GoogLeNet", "googlenet", "InceptionV3", "inception_v3"]
+
+
+class _BN(nn.Layer):
+    def __init__(self, in_c, out_c, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride,
+                              padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _InceptionA(nn.Layer):  # GoogLeNet-style 4-branch block
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = _BN(in_c, c1, 1)
+        self.b3 = nn.Sequential(_BN(in_c, c3r, 1), _BN(c3r, c3, 3,
+                                                       padding=1))
+        self.b5 = nn.Sequential(_BN(in_c, c5r, 1), _BN(c5r, c5, 5,
+                                                       padding=2))
+        self.bp = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _BN(in_c, pp, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b3(x), self.b5(x), self.bp(x)],
+                      axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """(parity: paddle.vision.models.GoogLeNet — forward always returns
+    the (out, aux1, aux2) triple, matching the reference's contract)"""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _BN(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _BN(64, 64, 1), _BN(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.inc3a = _InceptionA(192, 64, 96, 128, 16, 32, 32)
+        self.inc3b = _InceptionA(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inc4a = _InceptionA(480, 192, 96, 208, 16, 48, 64)
+        self.inc4b = _InceptionA(512, 160, 112, 224, 24, 64, 64)
+        self.inc4c = _InceptionA(512, 128, 128, 256, 24, 64, 64)
+        self.inc4d = _InceptionA(512, 112, 144, 288, 32, 64, 64)
+        self.inc4e = _InceptionA(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inc5a = _InceptionA(832, 256, 160, 320, 32, 128, 128)
+        self.inc5b = _InceptionA(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+            # aux heads (train-time deep supervision)
+            self.aux1 = nn.Sequential(
+                nn.AdaptiveAvgPool2D((4, 4)), nn.Flatten(),
+                nn.Linear(512 * 16, 1024), nn.ReLU(), nn.Dropout(0.7),
+                nn.Linear(1024, num_classes))
+            self.aux2 = nn.Sequential(
+                nn.AdaptiveAvgPool2D((4, 4)), nn.Flatten(),
+                nn.Linear(528 * 16, 1024), nn.ReLU(), nn.Dropout(0.7),
+                nn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.inc3b(self.inc3a(x)))
+        x = self.inc4a(x)
+        aux1_in = x
+        x = self.inc4d(self.inc4c(self.inc4b(x)))
+        aux2_in = x
+        x = self.pool4(self.inc4e(x))
+        x = self.inc5b(self.inc5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            out = self.fc(self.dropout(x.flatten(1)))
+            out1 = self.aux1(aux1_in)
+            out2 = self.aux2(aux2_in)
+            return out, out1, out2
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    from . import _check_pretrained
+    _check_pretrained(pretrained)
+    return GoogLeNet(**kwargs)
+
+
+class _IncV3A(nn.Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _BN(in_c, 64, 1)
+        self.b5 = nn.Sequential(_BN(in_c, 48, 1), _BN(48, 64, 5,
+                                                      padding=2))
+        self.b3 = nn.Sequential(_BN(in_c, 64, 1),
+                                _BN(64, 96, 3, padding=1),
+                                _BN(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _BN(in_c, pool_c, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)],
+                      axis=1)
+
+
+class _IncV3B(nn.Layer):  # grid reduction
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _BN(in_c, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_BN(in_c, 64, 1),
+                                 _BN(64, 96, 3, padding=1),
+                                 _BN(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class _IncV3C(nn.Layer):  # 7x1/1x7 factorized
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _BN(in_c, 192, 1)
+        self.b7 = nn.Sequential(
+            _BN(in_c, c7, 1), _BN(c7, c7, (1, 7), padding=(0, 3)),
+            _BN(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _BN(in_c, c7, 1), _BN(c7, c7, (7, 1), padding=(3, 0)),
+            _BN(c7, c7, (1, 7), padding=(0, 3)),
+            _BN(c7, c7, (7, 1), padding=(3, 0)),
+            _BN(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _BN(in_c, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)],
+                      axis=1)
+
+
+class _IncV3D(nn.Layer):  # grid reduction 2
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = nn.Sequential(_BN(in_c, 192, 1),
+                                _BN(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _BN(in_c, 192, 1), _BN(192, 192, (1, 7), padding=(0, 3)),
+            _BN(192, 192, (7, 1), padding=(3, 0)),
+            _BN(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _IncV3E(nn.Layer):  # expanded-filter-bank output block
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _BN(in_c, 320, 1)
+        self.b3_stem = _BN(in_c, 384, 1)
+        self.b3_a = _BN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _BN(384, 384, (3, 1), padding=(1, 0))
+        self.bd_stem = nn.Sequential(_BN(in_c, 448, 1),
+                                     _BN(448, 384, 3, padding=1))
+        self.bd_a = _BN(384, 384, (1, 3), padding=(0, 1))
+        self.bd_b = _BN(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _BN(in_c, 192, 1))
+
+    def forward(self, x):
+        s3 = self.b3_stem(x)
+        sd = self.bd_stem(x)
+        return concat([self.b1(x),
+                       self.b3_a(s3), self.b3_b(s3),
+                       self.bd_a(sd), self.bd_b(sd),
+                       self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """(parity: paddle.vision.models.InceptionV3)"""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _BN(3, 32, 3, stride=2), _BN(32, 32, 3),
+            _BN(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            _BN(64, 80, 1), _BN(80, 192, 3), nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            _IncV3A(192, 32), _IncV3A(256, 64), _IncV3A(288, 64),
+            _IncV3B(288),
+            _IncV3C(768, 128), _IncV3C(768, 160), _IncV3C(768, 160),
+            _IncV3C(768, 192),
+            _IncV3D(768),
+            _IncV3E(1280), _IncV3E(2048))
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    from . import _check_pretrained
+    _check_pretrained(pretrained)
+    return InceptionV3(**kwargs)

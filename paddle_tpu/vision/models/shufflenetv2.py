@@ -1,0 +1,115 @@
+"""ShuffleNetV2 (parity: python/paddle/vision/models/shufflenetv2.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...nn import functional as F
+from ...tensor.manipulation import concat, split
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+           "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+           "shufflenet_v2_swish"]
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048]}
+_REPEATS = [4, 8, 4]
+
+
+def _act_layer(act):
+    return nn.Swish() if act == "swish" else nn.ReLU()
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 2:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=2, padding=1,
+                          groups=in_c, bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), _act_layer(act))
+            b2_in = in_c
+        else:
+            self.branch1 = None
+            b2_in = in_c // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(b2_in, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), _act_layer(act),
+            nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                      groups=branch_c, bias_attr=False),
+            nn.BatchNorm2D(branch_c),
+            nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), _act_layer(act))
+
+    def forward(self, x):
+        if self.stride == 2:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        else:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        return F.channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    """(parity: paddle.vision.models.ShuffleNetV2(scale, act, ...))"""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        assert scale in _STAGE_OUT, f"unsupported scale {scale}"
+        outs = _STAGE_OUT[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, outs[0], 3, stride=2, padding=1,
+                      bias_attr=False),
+            nn.BatchNorm2D(outs[0]), _act_layer(act))
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = outs[0]
+        for si, rep in enumerate(_REPEATS):
+            out_c = outs[si + 1]
+            stages.append(_ShuffleUnit(in_c, out_c, 2, act))
+            for _ in range(rep - 1):
+                stages.append(_ShuffleUnit(out_c, out_c, 1, act))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(in_c, outs[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(outs[-1]), _act_layer(act))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(outs[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _builder(scale, act="relu", name=""):
+    def fn(pretrained=False, **kwargs):
+        from . import _check_pretrained
+        _check_pretrained(pretrained)
+        return ShuffleNetV2(scale=scale, act=act, **kwargs)
+    fn.__name__ = name
+    return fn
+
+
+shufflenet_v2_x0_25 = _builder(0.25, name="shufflenet_v2_x0_25")
+shufflenet_v2_x0_33 = _builder(0.33, name="shufflenet_v2_x0_33")
+shufflenet_v2_x0_5 = _builder(0.5, name="shufflenet_v2_x0_5")
+shufflenet_v2_x1_0 = _builder(1.0, name="shufflenet_v2_x1_0")
+shufflenet_v2_x1_5 = _builder(1.5, name="shufflenet_v2_x1_5")
+shufflenet_v2_x2_0 = _builder(2.0, name="shufflenet_v2_x2_0")
+shufflenet_v2_swish = _builder(1.0, act="swish",
+                               name="shufflenet_v2_swish")

@@ -17,7 +17,13 @@ __all__ = ["Compose", "ToTensor", "Resize", "CenterCrop", "RandomCrop",
            "RandomHorizontalFlip", "RandomVerticalFlip", "Normalize",
            "Transpose", "BrightnessTransform", "Pad",
            "to_tensor", "resize", "center_crop", "crop", "hflip", "vflip",
-           "normalize", "pad"]
+           "normalize", "pad", "RandomResizedCrop", "SaturationTransform", "ContrastTransform",
+           "HueTransform", "ColorJitter",
+           "RandomAffine", "RandomRotation", "RandomPerspective",
+           "Grayscale", "RandomErasing", "affine", "rotate", "perspective",
+           "to_grayscale", "adjust_brightness", "adjust_contrast",
+           "adjust_hue", "adjust_saturation", "erase",
+]
 
 
 def _as_hwc(img) -> np.ndarray:
@@ -290,3 +296,415 @@ class Pad(BaseTransform):
 
     def _apply_image(self, img):
         return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+# -- wave-3 functional ops (parity: paddle.vision.transforms.functional) --
+
+def _value_scale(arr):
+    """Value range by dtype: integer images are 0-255, floats 0-1 (the
+    reference's convention — never inferred from pixel content)."""
+    return 255.0 if np.issubdtype(arr.dtype, np.integer) else 1.0
+
+
+def _cast_back(out, dtype, scale):
+    out = np.clip(out, 0, scale)
+    if np.issubdtype(dtype, np.integer):
+        out = np.round(out)
+    return out.astype(dtype)
+
+
+def adjust_brightness(img, brightness_factor):
+    """(parity: F.adjust_brightness — blend with black)"""
+    arr = _as_hwc(img)
+    out = arr.astype(np.float32) * brightness_factor
+    return _cast_back(out, arr.dtype, _value_scale(arr))
+
+
+def adjust_contrast(img, contrast_factor):
+    """(parity: F.adjust_contrast — blend with the gray mean)"""
+    arr = _as_hwc(img)
+    f32 = arr.astype(np.float32)
+    gray = f32.mean(axis=(0, 1), keepdims=True).mean()
+    out = gray + contrast_factor * (f32 - gray)
+    return _cast_back(out, arr.dtype, _value_scale(arr))
+
+
+def _rgb_to_hsv(arr):
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    maxc = np.maximum(np.maximum(r, g), b)
+    minc = np.minimum(np.minimum(r, g), b)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    rc = np.where(delta > 0, (maxc - r) / np.maximum(delta, 1e-12), 0.0)
+    gc = np.where(delta > 0, (maxc - g) / np.maximum(delta, 1e-12), 0.0)
+    bc = np.where(delta > 0, (maxc - b) / np.maximum(delta, 1e-12), 0.0)
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    return np.stack([h, s, v], axis=-1)
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[..., 0], hsv[..., 1], hsv[..., 2]
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    cond = [i == k for k in range(6)]
+    r = np.select(cond, [v, q, p, p, t, v])
+    g = np.select(cond, [t, v, v, q, p, p])
+    b = np.select(cond, [p, p, t, v, v, q])
+    return np.stack([r, g, b], axis=-1)
+
+
+def adjust_hue(img, hue_factor):
+    """(parity: F.adjust_hue — shift hue by hue_factor in [-0.5, 0.5])"""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _as_hwc(img)
+    scale = _value_scale(arr)
+    hsv = _rgb_to_hsv(arr.astype(np.float32) / scale)
+    hsv[..., 0] = (hsv[..., 0] + hue_factor) % 1.0
+    out = _hsv_to_rgb(hsv) * scale
+    return _cast_back(out, arr.dtype, scale)
+
+
+def adjust_saturation(img, saturation_factor):
+    """(parity: F.adjust_saturation — blend with grayscale)"""
+    arr = _as_hwc(img)
+    f32 = arr.astype(np.float32)
+    gray = f32 @ np.asarray([0.299, 0.587, 0.114], np.float32)
+    out = gray[..., None] + saturation_factor * (f32 - gray[..., None])
+    return _cast_back(out, arr.dtype, _value_scale(arr))
+
+
+def to_grayscale(img, num_output_channels=1):
+    """(parity: F.to_grayscale — ITU-R 601-2 luma)"""
+    arr = _as_hwc(img).astype(np.float32)
+    gray = arr @ np.asarray([0.299, 0.587, 0.114], np.float32)
+    out = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return out.astype(_as_hwc(img).dtype)
+
+
+def _affine_grid_sample(arr, matrix, fill=0):
+    """Apply the inverse 2x3 affine matrix with bilinear sampling."""
+    h, w = arr.shape[:2]
+    ys, xs = np.meshgrid(np.arange(h, dtype=np.float32),
+                         np.arange(w, dtype=np.float32), indexing="ij")
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    xs_c, ys_c = xs - cx, ys - cy
+    a, b, tx, c, d, ty = matrix
+    src_x = a * xs_c + b * ys_c + tx + cx
+    src_y = c * xs_c + d * ys_c + ty + cy
+    x0 = np.floor(src_x).astype(np.int32)
+    y0 = np.floor(src_y).astype(np.int32)
+    wx = src_x - x0
+    wy = src_y - y0
+    out = np.zeros_like(arr, np.float32)
+
+    def at(yi, xi):
+        yc = np.clip(yi, 0, h - 1)
+        xc = np.clip(xi, 0, w - 1)
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        v = arr[yc, xc].astype(np.float32)
+        return np.where(valid[..., None], v, float(fill))
+
+    out = (at(y0, x0) * ((1 - wy) * (1 - wx))[..., None]
+           + at(y0, x0 + 1) * ((1 - wy) * wx)[..., None]
+           + at(y0 + 1, x0) * (wy * (1 - wx))[..., None]
+           + at(y0 + 1, x0 + 1) * (wy * wx)[..., None])
+    return out.astype(arr.dtype)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="bilinear",
+           fill=0, center=None):
+    """(parity: F.affine — rotation+translation+scale+shear about the
+    image center; inverse-warp sampling)"""
+    arr = _as_hwc(img)
+    # positive angle = counter-clockwise in image coordinates (the
+    # reference/PIL convention); array coords have y down, so negate
+    rot = -np.deg2rad(angle)
+    sx, sy = [np.deg2rad(s) for s in (shear if isinstance(
+        shear, (list, tuple)) else (shear, 0.0))]
+    # forward matrix: R(rot) * Shear(sx, sy) * scale; then invert for
+    # inverse warping
+    m = np.asarray([
+        [np.cos(rot + sy), -np.sin(rot + sx)],
+        [np.sin(rot + sy), np.cos(rot + sx)]], np.float32) * scale
+    inv = np.linalg.inv(m)
+    t = np.asarray(translate, np.float32)
+    itx, ity = -inv @ t
+    return _affine_grid_sample(
+        arr, [inv[0, 0], inv[0, 1], itx, inv[1, 0], inv[1, 1], ity],
+        fill)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """(parity: F.rotate — positive angle is counter-clockwise; expand
+    grows the canvas to hold the whole rotated image)"""
+    arr = _as_hwc(img)
+    if expand:
+        h, w = arr.shape[:2]
+        rad = np.deg2rad(angle)
+        nw = int(np.ceil(abs(w * np.cos(rad)) + abs(h * np.sin(rad))))
+        nh = int(np.ceil(abs(w * np.sin(rad)) + abs(h * np.cos(rad))))
+        pt, pl = (nh - h) // 2, (nw - w) // 2
+        arr = np.pad(arr, ((pt, nh - h - pt), (pl, nw - w - pl), (0, 0)),
+                     constant_values=fill)
+    return affine(arr, angle, (0, 0), 1.0, (0.0, 0.0), fill=fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """(parity: F.perspective — 4-point homography, inverse-warped)"""
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    # solve the homography mapping endpoints -> startpoints (inverse)
+    A = []
+    for (x, y), (u, v) in zip(endpoints, startpoints):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+    A = np.asarray(A, np.float64)
+    bvec = np.asarray([c for (u, v) in startpoints for c in (u, v)],
+                      np.float64)
+    coeffs = np.linalg.lstsq(A, bvec, rcond=None)[0]
+    ha, hb, hc, hd, he, hf, hg, hh = coeffs
+    ys, xs = np.meshgrid(np.arange(h, dtype=np.float64),
+                         np.arange(w, dtype=np.float64), indexing="ij")
+    den = hg * xs + hh * ys + 1.0
+    src_x = (ha * xs + hb * ys + hc) / den
+    src_y = (hd * xs + he * ys + hf) / den
+    x0 = np.round(src_x).astype(np.int32)
+    y0 = np.round(src_y).astype(np.int32)
+    valid = (x0 >= 0) & (x0 < w) & (y0 >= 0) & (y0 < h)
+    out = np.full_like(arr, fill)
+    out[valid] = arr[np.clip(y0, 0, h - 1),
+                     np.clip(x0, 0, w - 1)][valid]
+    return out
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """(parity: F.erase — fill the region [i:i+h, j:j+w] with v)"""
+    chw = isinstance(img, np.ndarray) and img.ndim == 3 and \
+        img.shape[0] in (1, 3) and img.shape[0] < img.shape[2]
+    arr = img if inplace else np.array(img)
+    if chw:
+        arr[:, i:i + h, j:j + w] = v
+    else:
+        arr[i:i + h, j:j + w] = v
+    return arr
+
+
+# -- wave-3 transform classes ---------------------------------------------
+
+class RandomResizedCrop(BaseTransform):
+    """(parity: paddle.vision.transforms.RandomResizedCrop)"""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _as_hwc(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            log_r = np.random.uniform(np.log(self.ratio[0]),
+                                      np.log(self.ratio[1]))
+            ar = np.exp(log_r)
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                return resize(crop(arr, top, left, ch, cw), self.size,
+                              self.interpolation)
+        return resize(center_crop(arr, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class ContrastTransform(BaseTransform):
+    """(parity: paddle.vision.transforms.ContrastTransform)"""
+
+    def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError("contrast value should be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    """(parity: paddle.vision.transforms.SaturationTransform)"""
+
+    def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError("saturation value should be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    """(parity: paddle.vision.transforms.HueTransform)"""
+
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(-self.value, self.value)
+        return adjust_hue(img, f)
+
+
+class ColorJitter(BaseTransform):
+    """(parity: paddle.vision.transforms.ColorJitter — random order of
+    the four component transforms)"""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for i in order:
+            img = self.transforms[i]._apply_image(img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    """(parity: paddle.vision.transforms.RandomRotation)"""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, fill=self.fill)
+
+
+class RandomAffine(BaseTransform):
+    """(parity: paddle.vision.transforms.RandomAffine)"""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _as_hwc(img)
+        h, w = arr.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0],
+                                   self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1],
+                                   self.translate[1]) * h
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        sh = np.random.uniform(*self.shear) if self.shear else 0.0
+        return affine(arr, angle, (tx, ty), sc, (sh, 0.0),
+                      fill=self.fill)
+
+
+class RandomPerspective(BaseTransform):
+    """(parity: paddle.vision.transforms.RandomPerspective)"""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = _as_hwc(img)
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        half_h, half_w = int(h * d / 2), int(w * d / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, half_w + 1),
+                np.random.randint(0, half_h + 1)),
+               (w - 1 - np.random.randint(0, half_w + 1),
+                np.random.randint(0, half_h + 1)),
+               (w - 1 - np.random.randint(0, half_w + 1),
+                h - 1 - np.random.randint(0, half_h + 1)),
+               (np.random.randint(0, half_w + 1),
+                h - 1 - np.random.randint(0, half_h + 1))]
+        return perspective(arr, start, end, fill=self.fill)
+
+
+class Grayscale(BaseTransform):
+    """(parity: paddle.vision.transforms.Grayscale)"""
+
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomErasing(BaseTransform):
+    """(parity: paddle.vision.transforms.RandomErasing)"""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and \
+            arr.shape[0] < arr.shape[2]
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target / ar)))
+            ew = int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                v = self.value if self.value != "random" \
+                    else np.random.rand()
+                return erase(arr, i, j, eh, ew, v, self.inplace)
+        return img

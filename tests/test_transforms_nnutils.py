@@ -1,0 +1,213 @@
+"""Wave-3 breadth tests: vision transforms (color/geometry/erasing),
+folder datasets, nn.utils (weight/spectral norm, vector round-trip, grad
+clipping), fleet LocalFS."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+T = paddle.vision.transforms
+U = paddle.nn.utils
+
+
+class TestTransformsWave3:
+    img = (np.random.RandomState(0).rand(24, 24, 3) * 255).astype(np.uint8)
+
+    def test_adjust_ops_identity(self):
+        np.testing.assert_allclose(
+            T.adjust_brightness(self.img, 1.0), self.img)
+        np.testing.assert_allclose(
+            T.adjust_contrast(self.img, 1.0), self.img, atol=1)
+        np.testing.assert_allclose(
+            T.adjust_saturation(self.img, 1.0), self.img, atol=1)
+        np.testing.assert_allclose(
+            T.adjust_hue(self.img, 0.0), self.img, atol=1)
+
+    def test_adjust_brightness_scales(self):
+        out = T.adjust_brightness(self.img.astype(np.float32) / 255, 0.5)
+        np.testing.assert_allclose(out, self.img.astype(np.float32)
+                                   / 255 * 0.5, atol=1e-5)
+
+    def test_grayscale(self):
+        g1 = T.to_grayscale(self.img, 1)
+        assert g1.shape == (24, 24, 1)
+        g3 = T.Grayscale(3)._apply_image(self.img)
+        assert g3.shape == (24, 24, 3)
+        np.testing.assert_allclose(g3[..., 0], g3[..., 1])
+
+    def test_rotate_90_maps_corners(self):
+        arr = np.zeros((21, 21, 1), np.float32)
+        arr[0, 0] = 1.0  # top-left
+        out = T.rotate(arr, 90)
+        # 90-deg CCW about center: top-left -> bottom-left region
+        assert out[0, 0, 0] < 0.5
+        assert out[20, 0, 0] > 0.4 or out[20, 1, 0] > 0.4 \
+            or out[19, 0, 0] > 0.4
+
+    def test_affine_translate(self):
+        arr = np.zeros((10, 10, 1), np.float32)
+        arr[4, 4] = 1.0
+        out = T.affine(arr, 0, (2, 0), 1.0, 0.0)
+        assert out[4, 6, 0] > 0.9  # shifted right by 2
+
+    def test_perspective_identity(self):
+        pts = [(0, 0), (23, 0), (23, 23), (0, 23)]
+        out = T.perspective(self.img, pts, pts)
+        np.testing.assert_allclose(out, self.img, atol=1)
+
+    def test_erase(self):
+        out = T.erase(self.img.copy(), 2, 3, 5, 6, 0)
+        assert (out[2:7, 3:9] == 0).all()
+        assert (out[0:2] == self.img[0:2]).all()
+
+    def test_random_transforms_shapes(self):
+        np.random.seed(0)
+        assert T.RandomResizedCrop(12)._apply_image(self.img).shape \
+            == (12, 12, 3)
+        assert T.ColorJitter(0.4, 0.4, 0.4, 0.2)._apply_image(
+            self.img).shape == (24, 24, 3)
+        assert T.RandomRotation(30)._apply_image(self.img).shape \
+            == (24, 24, 3)
+        assert T.RandomAffine(10, translate=(0.1, 0.1))._apply_image(
+            self.img).shape == (24, 24, 3)
+        assert T.RandomPerspective(prob=1.0)._apply_image(
+            self.img).shape == (24, 24, 3)
+        erased = T.RandomErasing(prob=1.0)._apply_image(self.img.copy())
+        assert (erased != self.img).any()
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            T.HueTransform(0.9)
+        with pytest.raises(ValueError):
+            T.ContrastTransform(-1)
+        with pytest.raises(ValueError):
+            T.adjust_hue(self.img, 0.7)
+
+
+class TestFolderDatasets:
+    def _tree(self, tmp_path):
+        from PIL import Image
+        rng = np.random.RandomState(0)
+        for cls in ["a", "b"]:
+            os.makedirs(tmp_path / cls, exist_ok=True)
+            for i in range(2):
+                Image.fromarray(
+                    (rng.rand(8, 8, 3) * 255).astype(np.uint8)).save(
+                    str(tmp_path / cls / f"{i}.png"))
+        return str(tmp_path)
+
+    def test_dataset_folder(self, tmp_path):
+        root = self._tree(tmp_path)
+        ds = paddle.vision.datasets.DatasetFolder(root)
+        assert len(ds) == 4
+        assert ds.classes == ["a", "b"]
+        img, label = ds[0]
+        assert img.shape == (8, 8, 3)
+        assert label == 0
+        assert ds[3][1] == 1
+
+    def test_image_folder(self, tmp_path):
+        root = self._tree(tmp_path)
+        ds = paddle.vision.datasets.ImageFolder(root)
+        assert len(ds) == 4
+        assert ds[0][0].shape == (8, 8, 3)
+
+    def test_transform_applied(self, tmp_path):
+        root = self._tree(tmp_path)
+        ds = paddle.vision.datasets.DatasetFolder(
+            root, transform=T.Compose([T.Resize(4), T.ToTensor()]))
+        img, _ = ds[0]
+        assert tuple(np.asarray(img).shape) == (3, 4, 4)
+
+    def test_empty_raises(self, tmp_path):
+        os.makedirs(tmp_path / "empty_cls")
+        with pytest.raises(RuntimeError):
+            paddle.vision.datasets.DatasetFolder(str(tmp_path))
+
+    def test_flowers_voc_need_dirs(self):
+        with pytest.raises((FileNotFoundError, RuntimeError)):
+            paddle.vision.datasets.Flowers(data_file=None)
+        with pytest.raises((FileNotFoundError, RuntimeError)):
+            paddle.vision.datasets.VOC2012(data_file=None)
+
+
+class TestNNUtils:
+    def test_weight_norm_roundtrip(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 6)
+        w0 = lin.weight.numpy().copy()
+        U.weight_norm(lin, dim=0)
+        assert sorted(lin._parameters) == ["bias", "weight_g", "weight_v"]
+        np.testing.assert_allclose(lin.weight.numpy(), w0, atol=1e-5)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 4).astype(np.float32))
+        lin(x)
+        U.remove_weight_norm(lin)
+        assert sorted(lin._parameters) == ["bias", "weight"]
+        np.testing.assert_allclose(lin.weight.numpy(), w0, atol=1e-5)
+
+    def test_weight_norm_trains(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(3, 3)
+        U.weight_norm(lin)
+        opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        g0 = lin.weight_g.numpy().copy()
+        loss = (lin(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        assert not np.allclose(lin.weight_g.numpy(), g0)
+
+    def test_spectral_norm_unit_sv(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(6, 6)
+        U.spectral_norm(lin, n_power_iterations=8)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 6).astype(np.float32))
+        lin(x)
+        sv = np.linalg.svd(lin.weight.numpy(), compute_uv=False)[0]
+        assert abs(sv - 1.0) < 0.1
+
+    def test_vector_roundtrip(self):
+        lin = paddle.nn.Linear(3, 2)
+        vec = U.parameters_to_vector(lin.parameters())
+        assert vec.shape == [3 * 2 + 2]
+        vals = np.arange(8, dtype=np.float32)
+        U.vector_to_parameters(paddle.to_tensor(vals), lin.parameters())
+        back = U.parameters_to_vector(lin.parameters())
+        np.testing.assert_allclose(back.numpy(), vals)
+
+    def test_clip_grad_norm(self):
+        w = paddle.to_tensor(np.array([3.0, 4.0], np.float32),
+                             stop_gradient=False)
+        (w * np.array([3.0, 4.0], np.float32)).sum().backward()
+        total = U.clip_grad_norm_([w], max_norm=1.0)
+        assert abs(float(total.numpy()) - 5.0) < 1e-4
+        np.testing.assert_allclose(
+            np.linalg.norm(w.grad.numpy()), 1.0, atol=1e-4)
+
+    def test_clip_grad_value(self):
+        w = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        (w * 10).sum().backward()
+        U.clip_grad_value_([w], 0.25)
+        assert float(w.grad.numpy()[0]) == 0.25
+
+
+class TestFleetFS:
+    def test_local_fs(self, tmp_path):
+        fs = paddle.distributed.fleet.utils.LocalFS()
+        d = str(tmp_path / "x")
+        fs.mkdirs(d)
+        assert fs.is_dir(d)
+        f = str(tmp_path / "f.txt")
+        fs.touch(f)
+        assert fs.is_file(f)
+        fs.rename(f, str(tmp_path / "g.txt"))
+        assert fs.is_exist(str(tmp_path / "g.txt"))
+        dirs, files = fs.ls_dir(str(tmp_path))
+        assert "x" in dirs and "g.txt" in files
+        fs.delete(d)
+        assert not fs.is_exist(d)

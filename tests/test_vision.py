@@ -157,3 +157,107 @@ class TestDatasets:
     def test_download_rejected(self):
         with pytest.raises(ValueError, match="egress"):
             MNIST(download=True)
+
+
+class TestModelZooWave3:
+    """New families (alexnet/squeezenet/densenet/mobilenet v1+v3/
+    shufflenetv2/resnext/googlenet/inceptionv3): forward shapes, canonical
+    parameter counts, and a train step."""
+
+    rng = np.random.RandomState(11)
+
+    def _n_params(self, net):
+        return sum(int(np.prod(p.shape)) for p in net.parameters())
+
+    def test_zoo_presence(self):
+        names = ["AlexNet", "DenseNet", "GoogLeNet", "InceptionV3",
+                 "MobileNetV1", "MobileNetV3Large", "MobileNetV3Small",
+                 "ShuffleNetV2", "SqueezeNet", "alexnet", "densenet121",
+                 "densenet161", "densenet169", "densenet201",
+                 "densenet264", "googlenet", "inception_v3",
+                 "mobilenet_v1", "mobilenet_v3_large",
+                 "mobilenet_v3_small", "resnext50_32x4d",
+                 "resnext50_64x4d", "resnext101_32x4d",
+                 "resnext101_64x4d", "resnext152_32x4d",
+                 "resnext152_64x4d", "shufflenet_v2_swish",
+                 "shufflenet_v2_x0_5", "shufflenet_v2_x0_25",
+                 "shufflenet_v2_x0_33", "shufflenet_v2_x1_0",
+                 "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+                 "squeezenet1_0", "squeezenet1_1"]
+        for n in names:
+            assert hasattr(paddle.vision.models, n), n
+            assert hasattr(paddle.vision, n), f"vision.{n}"
+
+    def test_forward_shapes_and_counts(self):
+        x = paddle.to_tensor(
+            self.rng.randn(1, 3, 64, 64).astype(np.float32))
+        checks = [
+            (paddle.vision.models.squeezenet1_1(num_classes=10), None),
+            (paddle.vision.models.mobilenet_v1(scale=0.25,
+                                               num_classes=10), None),
+            (paddle.vision.models.shufflenet_v2_x0_25(num_classes=10),
+             None),
+        ]
+        for net, _ in checks:
+            net.eval()
+            assert net(x).shape == [1, 10]
+        # canonical full-size counts (1000 classes)
+        rx = paddle.vision.models.resnext50_32x4d()
+        assert abs(self._n_params(rx) - 25_028_904) / 25_028_904 < 0.01
+        al = paddle.vision.models.alexnet()
+        assert abs(self._n_params(al) - 61_100_840) / 61_100_840 < 0.01
+
+    def test_googlenet_aux_heads(self):
+        net = paddle.vision.models.googlenet(num_classes=7)
+        net.eval()
+        x = paddle.to_tensor(
+            self.rng.randn(1, 3, 96, 96).astype(np.float32))
+        out, aux1, aux2 = net(x)
+        assert out.shape == [1, 7]
+        assert aux1.shape == [1, 7]
+        assert aux2.shape == [1, 7]
+
+    def test_mobilenet_v3_trains(self):
+        paddle.seed(0)
+        net = paddle.vision.models.mobilenet_v3_small(scale=0.35,
+                                                      num_classes=4)
+        opt = paddle.optimizer.Adam(5e-3, parameters=net.parameters())
+        x = paddle.to_tensor(
+            self.rng.randn(4, 3, 32, 32).astype(np.float32))
+        y = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
+        losses = []
+        for _ in range(6):
+            loss = paddle.nn.functional.cross_entropy(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_inception_and_alexnet_forward(self):
+        net = paddle.vision.models.inception_v3(num_classes=5)
+        net.eval()
+        x = paddle.to_tensor(
+            self.rng.randn(1, 3, 299, 299).astype(np.float32))
+        assert net(x).shape == [1, 5]
+        al = paddle.vision.models.alexnet(num_classes=5)
+        al.eval()
+        x2 = paddle.to_tensor(
+            self.rng.randn(1, 3, 96, 96).astype(np.float32))
+        assert al(x2).shape == [1, 5]
+        sq = paddle.vision.models.SqueezeNet(version="1.1",
+                                             num_classes=0,
+                                             with_pool=True)
+        sq.eval()
+        x3 = paddle.to_tensor(
+            self.rng.randn(1, 3, 64, 64).astype(np.float32))
+        assert sq(x3).shape[2:] == [1, 1]
+
+    def test_densenet_channel_growth(self):
+        net = paddle.vision.models.densenet121(num_classes=0,
+                                               with_pool=True)
+        net.eval()
+        x = paddle.to_tensor(
+            self.rng.randn(1, 3, 64, 64).astype(np.float32))
+        out = net(x)
+        assert out.shape[1] == 1024  # 121-depth final feature width
