@@ -45,6 +45,8 @@ from . import utils  # noqa: F401
 from . import quantization  # noqa: F401
 from . import incubate  # noqa: F401
 from . import onnx  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import hub  # noqa: F401
 from . import linalg  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401

@@ -159,58 +159,6 @@ class GradScaler:
         self._bad_steps = d.get("bad_steps", 0)
 
 
-class debugging:
-    """Numeric debugging shims (parity: paddle.amp.debugging — the op-level
-    NaN/Inf checker maps to FLAGS_check_nan_inf in the dispatch funnel)."""
-
-    @staticmethod
-    def enable_operator_stats_collection():
-        from ..core import flags
-        flags.set_flags({"low_precision_op_list": 1})
-
-    @staticmethod
-    def disable_operator_stats_collection():
-        from ..core import flags
-        flags.set_flags({"low_precision_op_list": 0})
-
-    @staticmethod
-    def check_numerics(tensor, op_type="", var_name=""):
-        bad = bool(jnp.any(~jnp.isfinite(tensor._data)))
-        if bad:
-            raise FloatingPointError(
-                f"NaN/Inf detected in {op_type}:{var_name}")
-        return tensor
-
-    class TensorCheckerConfig:
-        """Op-level numeric-check config (parity: amp/debugging.py:157 —
-        enable_check, debug modes CHECK_NAN_INF_AND_ABORT/CHECK_NAN_INF)."""
-
-        def __init__(self, enable: bool, debug_mode=None,
-                     output_dir=None, checked_op_list=None,
-                     skipped_op_list=None, debug_step=None,
-                     stack_height_limit=None):
-            self.enable = enable
-            self.debug_mode = debug_mode
-            self.output_dir = output_dir
-            self.checked_op_list = checked_op_list
-            self.skipped_op_list = skipped_op_list
-            self.debug_step = debug_step
-            self.stack_height_limit = stack_height_limit
-
-    @staticmethod
-    def enable_tensor_checker(config):
-        """Turn on the per-op NaN/Inf funnel check
-        (FLAGS_check_nan_inf in the dispatch funnel, dispatch.py)."""
-        from ..core import flags
-        if config.enable:
-            flags.set_flags({"check_nan_inf": 1})
-
-    @staticmethod
-    def disable_tensor_checker():
-        from ..core import flags
-        flags.set_flags({"check_nan_inf": 0})
-
-
 def is_float16_supported(device=None):
     """(parity: paddle.amp.is_float16_supported) — TPUs compute fp16 via
     bf16/fp32 paths; XLA accepts the dtype."""
@@ -221,3 +169,6 @@ def is_bfloat16_supported(device=None):
     """(parity: paddle.amp.is_bfloat16_supported) — bf16 is the native
     MXU dtype."""
     return True
+
+from . import debugging  # noqa: E402,F401
+from . import _op_stats  # noqa: E402,F401

@@ -1,0 +1,34 @@
+"""AMP per-op dtype call counters (parity: the reference's op-stats
+collection in paddle/fluid/imperative/amp_auto_cast + OpStats printed by
+disable_operator_stats_collection). Populated by the dispatch funnel when
+FLAGS_low_precision_op_list is on."""
+from __future__ import annotations
+
+from collections import Counter
+
+_COUNTS: Counter = Counter()
+
+
+def record(op_name: str, dtype) -> None:
+    _COUNTS[(op_name, str(dtype))] += 1
+
+
+def stats() -> dict:
+    return dict(_COUNTS)
+
+
+def clear() -> None:
+    _COUNTS.clear()
+
+
+def report() -> None:
+    if not _COUNTS:
+        return
+    print("<------------------- op list of amp run ------------------->")
+    by_op: dict = {}
+    for (op, dt), n in sorted(_COUNTS.items()):
+        by_op.setdefault(op, []).append(f"{dt}: {n}")
+    for op, entries in sorted(by_op.items()):
+        print(f"  {op:<30s} {', '.join(entries)}")
+    print("<----------------------------------------------------------->")
+    clear()

@@ -1,0 +1,142 @@
+"""AMP debugging utilities (parity: python/paddle/amp/debugging.py —
+TensorCheckerConfig :157, check_numerics :339, op-stats collection, the
+CHECK_NAN_INF debug modes). The per-op funnel check is the dispatch
+funnel's FLAGS_check_nan_inf branch (core/dispatch.py)."""
+from __future__ import annotations
+
+import contextlib
+from enum import Enum
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import flags as _flags
+from ..core.tensor import Tensor
+
+__all__ = ["DebugMode", "TensorCheckerConfig", "check_numerics",
+           "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "enable_tensor_checker", "disable_tensor_checker",
+           "compare_accuracy", "check_layer_numerics"]
+
+
+class DebugMode(Enum):
+    """(parity: amp.debugging.DebugMode)"""
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+    CHECK_ALL_AND_ABORT = 4
+    DUMP_ALL = 5
+
+
+class TensorCheckerConfig:
+    """(parity: amp/debugging.py:157)"""
+
+    def __init__(self, enable, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None,
+                 stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+        self.debug_step = debug_step
+        self.stack_height_limit = stack_height_limit
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Raise (or report) on NaN/Inf; returns (num_nan, num_inf, num_zero)
+    like the reference's check_numerics (amp/debugging.py:339)."""
+    arr = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    n_nan = int(jnp.sum(jnp.isnan(arr)))
+    n_inf = int(jnp.sum(jnp.isinf(arr)))
+    n_zero = int(jnp.sum(arr == 0))
+    abort = debug_mode in (None, DebugMode.CHECK_NAN_INF_AND_ABORT,
+                           DebugMode.CHECK_ALL_AND_ABORT)
+    if (n_nan or n_inf) and abort:
+        raise FloatingPointError(
+            f"NaN/Inf detected in {op_type}:{var_name} "
+            f"(nan={n_nan}, inf={n_inf})")
+    return (Tensor(jnp.asarray(n_nan)), Tensor(jnp.asarray(n_inf)),
+            Tensor(jnp.asarray(n_zero)))
+
+
+def enable_operator_stats_collection():
+    """(parity: start collecting per-op dtype call counts)"""
+    _flags.set_flags({"low_precision_op_list": 1})
+
+
+def disable_operator_stats_collection():
+    _flags.set_flags({"low_precision_op_list": 0})
+    from . import _op_stats
+    _op_stats.report()
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """(parity: amp.debugging.collect_operator_stats context manager)"""
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def enable_tensor_checker(checker_config):
+    """(parity: turn the per-op NaN/Inf funnel check on)"""
+    if checker_config.enable:
+        _flags.set_flags({"check_nan_inf": 1})
+
+
+def disable_tensor_checker():
+    _flags.set_flags({"check_nan_inf": 0})
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    """Compare two tensor-dump directories and write a CSV of mismatches
+    (parity: amp.debugging.compare_accuracy over .npy dumps)."""
+    import csv
+    import os
+    rows = []
+    a_files = {f: os.path.join(dump_path, f)
+               for f in sorted(os.listdir(dump_path))} \
+        if os.path.isdir(dump_path) else {}
+    for name, apath in a_files.items():
+        bpath = os.path.join(another_dump_path, name)
+        if not os.path.exists(bpath) or not name.endswith(".npy"):
+            continue
+        a = np.load(apath)
+        b = np.load(bpath)
+        if a.shape != b.shape:
+            rows.append([name, "shape-mismatch", str(a.shape),
+                         str(b.shape)])
+            continue
+        diff = np.abs(a.astype(np.float64) - b.astype(np.float64))
+        rows.append([name, "ok", float(diff.max()), float(diff.mean())])
+    with open(output_filename, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["tensor", "status", "max_diff", "mean_diff"])
+        w.writerows(rows)
+    return rows
+
+
+def check_layer_numerics(func):
+    """Decorator checking a Layer.forward's inputs/outputs for NaN/Inf
+    (parity: amp.debugging.check_layer_numerics)."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                check_numerics(a, type(self).__name__, f"input{i}")
+        out = func(self, *args, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        for i, o in enumerate(outs):
+            if isinstance(o, Tensor):
+                check_numerics(o, type(self).__name__, f"output{i}")
+        return out
+    return wrapper

@@ -208,6 +208,10 @@ def _run_op_impl(name, jax_fn, operands, num_nondiff_outputs,
     if _flags.get_flag("check_nan_inf"):
         _check_finite(name, out_list)
 
+    if _flags.get_flag("low_precision_op_list"):
+        from ..amp import _op_stats
+        _op_stats.record(name, getattr(out_list[0], "dtype", "?"))
+
     if out_stop_gradient is None:
         out_stop_gradient = not diff_idx
 

@@ -34,6 +34,7 @@ from .extras import (spawn, scatter_object_list, broadcast_object_list,  # noqa:
                      CountFilterEntry, ShowClickEntry, ProbabilityEntry,
                      QueueDataset, InMemoryDataset)
 from . import io  # noqa: F401
+from . import utils  # noqa: F401
 
 alltoall = all_to_all
 alltoall_single = all_to_all_single

@@ -1,0 +1,8 @@
+"""paddle.distributed.utils (parity: python/paddle/distributed/utils/ —
+__all__ is empty in the reference; the module hosts moe_utils'
+global_scatter/global_gather helpers used by the MoE stack)."""
+from __future__ import annotations
+
+__all__ = []
+
+from .moe_utils import global_gather, global_scatter  # noqa: E402,F401

@@ -1,0 +1,69 @@
+"""MoE dispatch all-to-alls (parity: python/paddle/distributed/utils/
+moe_utils.py:20 global_scatter, :153 global_gather — the reference's CUDA
+collective ops; here the exchange is the expert-parallel all_to_all the
+incubate MoE layer compiles over the 'ep' mesh axis)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def _counts(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
+    """Reorder rows of ``x`` from local (expert, rank)-bucket order into
+    the receive layout ``global_count`` describes (parity:
+    moe_utils.py:20). In the single-process global-array view the
+    exchange is a row permutation: bucket (e, r) of size
+    local_count[e*W+r] moves to the position global_count assigns it;
+    under an 'ep'-sharded mesh GSPMD compiles the same movement as the
+    all-to-all."""
+    lc = np.asarray(_counts(local_count)).astype(np.int64)
+    gc = np.asarray(_counts(global_count)).astype(np.int64)
+    if lc.sum() != gc.sum():
+        raise ValueError(
+            f"global_scatter: local rows {int(lc.sum())} != global rows "
+            f"{int(gc.sum())}")
+    src_off = np.concatenate([[0], np.cumsum(lc)[:-1]])
+    dst_off = np.concatenate([[0], np.cumsum(gc)[:-1]])
+    perm = np.empty(int(lc.sum()), np.int64)
+    for b in range(len(lc)):
+        n = int(lc[b])
+        if n:
+            perm[dst_off[b]:dst_off[b] + n] = np.arange(
+                src_off[b], src_off[b] + n)
+
+    def fn(xv):
+        return xv[jnp.asarray(perm)]
+    return run_op("global_scatter", fn, (x,))
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    """Inverse row movement of global_scatter (parity: moe_utils.py:153)."""
+    lc = np.asarray(_counts(local_count)).astype(np.int64)
+    gc = np.asarray(_counts(global_count)).astype(np.int64)
+    if lc.sum() != gc.sum():
+        raise ValueError(
+            f"global_gather: local rows {int(lc.sum())} != global rows "
+            f"{int(gc.sum())}")
+    src_off = np.concatenate([[0], np.cumsum(lc)[:-1]])
+    dst_off = np.concatenate([[0], np.cumsum(gc)[:-1]])
+    perm = np.empty(int(lc.sum()), np.int64)
+    for b in range(len(lc)):
+        n = int(lc[b])
+        if n:
+            perm[src_off[b]:src_off[b] + n] = np.arange(
+                dst_off[b], dst_off[b] + n)
+
+    def fn(xv):
+        return xv[jnp.asarray(perm)]
+    return run_op("global_gather", fn, (x,))

@@ -322,3 +322,6 @@ from .transform import (Transform, AffineTransform,  # noqa: E402,F401
 
 from .continuous import (ContinuousBernoulli, ExponentialFamily,  # noqa: F401
                          MultivariateNormal)
+from .transform import (AbsTransform, IndependentTransform,  # noqa: E402,F401
+                        ReshapeTransform, SoftmaxTransform,
+                        StackTransform, StickBreakingTransform)

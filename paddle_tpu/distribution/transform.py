@@ -16,7 +16,9 @@ from ..core.tensor import Tensor
 
 __all__ = ["Transform", "AffineTransform", "ExpTransform",
            "SigmoidTransform", "TanhTransform", "PowerTransform",
-           "ChainTransform", "TransformedDistribution"]
+           "ChainTransform", "TransformedDistribution", "AbsTransform", "IndependentTransform", "ReshapeTransform",
+           "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+]
 
 
 class Transform:
@@ -180,3 +182,176 @@ class TransformedDistribution:
 
     def prob(self, value):
         return run_op("tdist_prob", jnp.exp, (self.log_prob(value),))
+
+
+class AbsTransform(Transform):
+    """y = |x| (parity: paddle.distribution.AbsTransform)."""
+
+    def forward(self, x):
+        from ..tensor.math import abs as _abs
+        return _abs(x)
+
+    def inverse(self, y):
+        return y  # principal branch (y >= 0 maps to itself)
+
+    def forward_log_det_jacobian(self, x):
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        return Tensor(jnp.zeros_like(arr))
+
+
+class IndependentTransform(Transform):
+    """Reinterpret batch dims as event dims (parity:
+    paddle.distribution.IndependentTransform)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self._base = base
+        self._rank = reinterpreted_batch_rank
+
+    def forward(self, x):
+        return self._base.forward(x)
+
+    def inverse(self, y):
+        return self._base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+        ld = self._base.forward_log_det_jacobian(x)
+        arr = ld._data if isinstance(ld, Tensor) else jnp.asarray(ld)
+        axes = tuple(range(arr.ndim - self._rank, arr.ndim))
+        return Tensor(jnp.sum(arr, axis=axes) if axes else arr)
+
+
+class ReshapeTransform(Transform):
+    """Reshape the event (parity: paddle.distribution.ReshapeTransform)."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        import numpy as np
+        if int(np.prod(in_event_shape)) != int(np.prod(out_event_shape)):
+            raise ValueError(
+                f"event sizes differ: {in_event_shape} vs "
+                f"{out_event_shape}")
+        self._in = tuple(in_event_shape)
+        self._out = tuple(out_event_shape)
+
+    def forward(self, x):
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        batch = arr.shape[:arr.ndim - len(self._in)]
+        return Tensor(arr.reshape(batch + self._out))
+
+    def inverse(self, y):
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+        arr = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        batch = arr.shape[:arr.ndim - len(self._out)]
+        return Tensor(arr.reshape(batch + self._in))
+
+    def forward_log_det_jacobian(self, x):
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        batch = arr.shape[:arr.ndim - len(self._in)]
+        return Tensor(jnp.zeros(batch))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis (parity:
+    paddle.distribution.SoftmaxTransform — not bijective; inverse is
+    log up to an additive constant, like the reference)."""
+
+    def forward(self, x):
+        from ..core.tensor import Tensor
+        import jax
+        import jax.numpy as jnp
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        return Tensor(jax.nn.softmax(arr, axis=-1))
+
+    def inverse(self, y):
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+        arr = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        return Tensor(jnp.log(arr))
+
+
+class StackTransform(Transform):
+    """Apply a sequence of transforms to slices along ``axis``
+    (parity: paddle.distribution.StackTransform)."""
+
+    def __init__(self, transforms, axis=0):
+        self._transforms = list(transforms)
+        self._axis = axis
+
+    def _map(self, fn_name, x):
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        slices = jnp.split(arr, len(self._transforms), axis=self._axis)
+        outs = []
+        for t, s in zip(self._transforms, slices):
+            r = getattr(t, fn_name)(Tensor(s))
+            outs.append(r._data if isinstance(r, Tensor) else r)
+        return Tensor(jnp.concatenate(outs, axis=self._axis))
+
+    def forward(self, x):
+        return self._map("forward", x)
+
+    def inverse(self, y):
+        return self._map("inverse", y)
+
+    def forward_log_det_jacobian(self, x):
+        return self._map("forward_log_det_jacobian", x)
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^k -> (k+1)-simplex by stick breaking (parity:
+    paddle.distribution.StickBreakingTransform)."""
+
+    def forward(self, x):
+        from ..core.tensor import Tensor
+        import jax
+        import jax.numpy as jnp
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        k = arr.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=arr.dtype))
+        z = jax.nn.sigmoid(arr - offset)
+        cum = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(cum[..., :1]), cum[..., :-1]], axis=-1)
+        first = z * lead
+        last = cum[..., -1:]
+        return Tensor(jnp.concatenate([first, last], axis=-1))
+
+    def inverse(self, y):
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+        arr = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        k = arr.shape[-1] - 1
+        cum = 1 - jnp.cumsum(arr[..., :-1], axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(cum[..., :1]), cum[..., :-1]], axis=-1)
+        z = arr[..., :-1] / jnp.maximum(lead, 1e-30)
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=arr.dtype))
+        return Tensor(jnp.log(z) - jnp.log1p(-z) + offset)
+
+    def forward_log_det_jacobian(self, x):
+        """sum_i [log sigmoid(x_i - off_i) + log(1 - z_i) + log lead_i]
+        (the reference's stick-breaking log-det)."""
+        from ..core.tensor import Tensor
+        import jax
+        import jax.numpy as jnp
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        k = arr.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=arr.dtype))
+        t_ = arr - offset
+        z = jax.nn.sigmoid(t_)
+        cum = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(cum[..., :1]), cum[..., :-1]], axis=-1)
+        # d(stick_i)/dx_i = z_i (1 - z_i) * lead_i; log-det is the sum
+        ld = jax.nn.log_sigmoid(t_) + jax.nn.log_sigmoid(-t_) \
+            + jnp.log(jnp.maximum(lead, 1e-30))
+        return Tensor(jnp.sum(ld, axis=-1))

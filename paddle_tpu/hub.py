@@ -1,0 +1,57 @@
+"""paddle.hub (parity: python/paddle/hapi/hub.py — list/help/load over a
+hubconf.py). Zero-egress build: only local directories are supported
+(source='local'); github/gitee sources raise."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir}")
+    # unique per-repo module name: loading repo B must not clobber the
+    # module objects (and pickled class identities) of repo A
+    mod_name = f"paddle_tpu_hubconf_{abs(hash(os.path.abspath(repo_dir)))}"
+    if mod_name in sys.modules:
+        return sys.modules[mod_name]
+    spec = importlib.util.spec_from_file_location(mod_name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[mod_name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source != "local":
+        raise RuntimeError(
+            "this environment has no network egress; only source='local' "
+            "hub repos are supported")
+
+
+def list(repo_dir, source="local", force_reload=False):
+    """Entrypoint names exported by the repo's hubconf (parity:
+    paddle.hub.list)."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    """Docstring of a hub entrypoint (parity: paddle.hub.help)."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, *args, source="local", force_reload=False,
+         **kwargs):
+    """Build a model from a hub entrypoint (parity: paddle.hub.load)."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model)(*args, **kwargs)

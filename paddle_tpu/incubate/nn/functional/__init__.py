@@ -19,7 +19,12 @@ from ....nn import functional as F
 __all__ = ["fused_rotary_position_embedding", "fused_rms_norm",
            "fused_layer_norm", "fused_dropout_add", "swiglu",
            "fused_linear", "fused_bias_act",
-           "masked_multihead_attention", "block_multihead_attention"]
+           "masked_multihead_attention", "block_multihead_attention", "fused_multi_head_attention", "fused_feedforward",
+           "fused_multi_transformer", "fused_matmul_bias",
+           "fused_linear_activation",
+           "fused_bias_dropout_residual_layer_norm", "fused_ec_moe",
+           "variable_length_memory_efficient_attention",
+]
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
@@ -318,3 +323,238 @@ def block_multihead_attention(q, k, v, key_cache, value_cache, block_tables,
 
     return run_op("block_multihead_attention", fn,
                   (q, k, v, key_cache, value_cache, block_tables, seq_lens))
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False,
+                      transpose_y=False, name=None):
+    """(parity: incubate.nn.functional.fused_matmul_bias — cublasLt gemm
+    epilogue in the reference; XLA fuses the bias add here)"""
+    from ....core.dispatch import run_op
+
+    def fn(a, b, *bb):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        if bb:
+            out = out + bb[0]
+        return out
+    ops = (x, y) + ((bias,) if bias is not None else ())
+    return run_op("fused_matmul_bias", fn, ops)
+
+
+def fused_linear_activation(x, y, b, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    """(parity: fused_linear_activation — gemm + bias + act epilogue)"""
+    out = fused_matmul_bias(x, y, b, trans_x, trans_y)
+    from ....nn import functional as F
+    act = {"gelu": F.gelu, "relu": F.relu, "none": lambda v: v}[activation]
+    return act(out)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True, mode=
+        "upscale_in_train", name=None):
+    """(parity: incubate.nn.functional
+    .fused_bias_dropout_residual_layer_norm)"""
+    from ....nn import functional as F
+    h = x if bias is None else x + bias
+    h = F.dropout(h, p=dropout_rate, training=training, mode=mode)
+    h = residual + h
+    norm_shape = [h.shape[-1]]
+    return F.layer_norm(h, norm_shape, weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)
+
+
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm=False,
+        pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
+        pre_ln_epsilon=1e-5, qkv_bias=None, linear_bias=None,
+        cache_kv=None, attn_mask=None, dropout_rate=0.5,
+        attn_dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", ring_id=-1, add_residual=True,
+        num_heads=-1, transpose_qkv_wb=False, name=None):
+    """Functional fused attention block (parity:
+    incubate.nn.functional.fused_multi_head_attention,
+    fused_attention_op.cu semantics: (pre-)LN -> fused qkv -> attention
+    -> out proj -> dropout -> residual (+ post-LN))."""
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention cache_kv decode is not supported "
+            "yet; use masked_multihead_attention for decode")
+    if transpose_qkv_wb:
+        raise NotImplementedError(
+            "fused_multi_head_attention transpose_qkv_wb=True (2-D qkv "
+            "weight layout) is not supported yet; pass the (3, H, D, E) "
+            "layout")
+    from ....core.dispatch import run_op
+    from ....nn import functional as F
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, [h.shape[-1]], weight=pre_ln_scale,
+                         bias=pre_ln_bias, epsilon=pre_ln_epsilon)
+
+    def qkv_fn(a, w, *bb):
+        # w: (3, H, D, E)
+        out = jnp.einsum("bse,khde->kbshd", a, w)
+        if bb:
+            out = out + bb[0][:, None, None]
+        return out[0], out[1], out[2]
+    ops = (h, qkv_weight) + ((qkv_bias,) if qkv_bias is not None else ())
+    q, k, v = run_op("fused_qkv", qkv_fn, ops)
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
+        training=training)
+    b, s = out.shape[0], out.shape[1]
+    out = out.reshape([b, s, -1])
+    out = F.linear(out, linear_weight, linear_bias)
+    out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [out.shape[-1]], weight=ln_scale,
+                           bias=ln_bias, epsilon=ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1, name=None):
+    """(parity: incubate.nn.functional.fused_feedforward,
+    fused_feedforward_op.cu semantics)"""
+    from ....nn import functional as F
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, [h.shape[-1]], weight=ln1_scale,
+                         bias=ln1_bias, epsilon=ln1_epsilon)
+    h = F.linear(h, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    h = F.dropout(h, p=dropout1_rate, training=training, mode=mode)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    h = F.dropout(h, p=dropout2_rate, training=training, mode=mode)
+    h = residual + h
+    if not pre_layer_norm:
+        h = F.layer_norm(h, [h.shape[-1]], weight=ln2_scale,
+                         bias=ln2_bias, epsilon=ln2_epsilon)
+    return h
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-05, cache_kvs=None, pre_caches=None, rotary_embs=None,
+        time_step=None, attn_mask=None, dropout_rate=0.0,
+        activation="gelu", training=False, mode="upscale_in_train",
+        trans_qkvw=True, ring_id=-1, name=None):
+    """Stacked fused transformer layers (parity:
+    incubate.nn.functional.fused_multi_transformer). Per-layer weight
+    lists; decode caches are not supported yet (use
+    masked_multihead_attention for decode)."""
+    if cache_kvs is not None or time_step is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer decode caches are not supported "
+            "yet; use masked_multihead_attention for decode")
+    h = x
+    n_layers = len(qkv_weights)
+    for i in range(n_layers):
+        h = fused_multi_head_attention(
+            h, qkv_weights[i], linear_weights[i],
+            pre_layer_norm=pre_layer_norm, pre_ln_scale=ln_scales[i],
+            pre_ln_bias=ln_biases[i] if ln_biases else None,
+            ln_scale=ln_scales[i],
+            ln_bias=ln_biases[i] if ln_biases else None,
+            qkv_bias=qkv_biases[i] if qkv_biases else None,
+            linear_bias=linear_biases[i] if linear_biases else None,
+            attn_mask=attn_mask, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate, training=training, mode=mode)
+        h = fused_feedforward(
+            h, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=ffn1_biases[i] if ffn1_biases else None,
+            linear2_bias=ffn2_biases[i] if ffn2_biases else None,
+            ln1_scale=ffn_ln_scales[i],
+            ln1_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+            ln2_scale=ffn_ln_scales[i],
+            ln2_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            activation=activation, pre_layer_norm=pre_layer_norm,
+            training=training, mode=mode)
+    return h
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu"):
+    """Expert-choice MoE block (parity: incubate.nn.functional
+    .fused_ec_moe; same math as incubate.nn.FusedEcMoe)."""
+    from ....core.dispatch import run_op
+
+    def fn(a, g, w1, b1, w2, b2):
+        b, s, h = a.shape
+        e = w1.shape[0]
+        tokens = a.reshape(b * s, h)
+        logits = g.reshape(b * s, e)
+        probs = jax.nn.softmax(logits, axis=-1)
+        cap = max((b * s) // e, 1)
+        gval, gidx = jax.lax.top_k(probs.T, cap)
+        picked = tokens[gidx]
+        hmid = jnp.einsum("ech,ehi->eci", picked, w1) + b1[:, None] \
+            if b1.ndim == 2 else jnp.einsum("ech,ehi->eci", picked,
+                                            w1) + b1
+        act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[act_type]
+        hmid = act(hmid)
+        hout = jnp.einsum("eci,eih->ech", hmid, w2) + b2[:, None] \
+            if b2.ndim == 2 else jnp.einsum("eci,eih->ech", hmid,
+                                            w2) + b2
+        hout = hout * gval[..., None]
+        out = jnp.zeros_like(tokens).at[gidx.reshape(-1)].add(
+            hout.reshape(-1, h))
+        return out.reshape(b, s, h)
+    return run_op("fused_ec_moe", fn,
+                  (x, gate, bmm0_weight, bmm0_bias, bmm1_weight,
+                   bmm1_bias))
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0):
+    """Varlen memory-efficient attention (parity: incubate.nn.functional
+    .variable_length_memory_efficient_attention — cutlass kernel in the
+    reference). Layout (B, H, S, D); per-sequence lengths mask the
+    attention; lowers to the fused attention path with a length mask."""
+    if pre_cache_length:
+        raise NotImplementedError(
+            "variable_length_memory_efficient_attention pre_cache_length "
+            "is not supported yet")
+    from ....core.dispatch import run_op
+
+    def fn(q, k, v, sl, kvl, *mm):
+        b, h, sq, d = q.shape
+        sk = k.shape[2]
+        sc = scale if scale is not None else 1.0 / (d ** 0.5)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * sc
+        q_valid = jnp.arange(sq)[None, :] < sl.reshape(-1, 1)
+        k_valid = jnp.arange(sk)[None, :] < kvl.reshape(-1, 1)
+        msk = (q_valid[:, None, :, None] & k_valid[:, None, None, :])
+        if causal:
+            msk = msk & jnp.tril(jnp.ones((sq, sk), bool))[None, None]
+        logits = jnp.where(msk, logits, -1e9)
+        if mm:
+            logits = logits + mm[0].astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v,
+                         preferred_element_type=jnp.float32)
+        out = out.astype(q.dtype)
+        return jnp.where(q_valid[:, None, :, None], out, 0)
+    ops = [query, key, value, seq_lens, kv_seq_lens]
+    if mask is not None:
+        ops.append(mask)
+    return run_op("varlen_mem_efficient_attention", fn, tuple(ops))

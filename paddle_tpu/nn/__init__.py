@@ -7,3 +7,4 @@ from .layer.layers import Layer, functional_state, functional_call  # noqa: F401
 from .parameter import Parameter, ParamAttr, create_parameter  # noqa: F401
 from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
 from . import utils  # noqa: F401
+from . import quant  # noqa: F401

@@ -1,0 +1,96 @@
+"""paddle.nn.quant (parity: python/paddle/nn/quant/ — Stub observer
+placeholder + weight-only / llm.int8 linear ops)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor
+from ...quantization import weight_dequantize, weight_quantize  # noqa: F401
+
+__all__ = ["Stub", "weight_only_linear", "llm_int8_linear",
+           "weight_quantize", "weight_dequantize"]
+
+
+class Stub:
+    """Marker layer the quantizer replaces with a real quant/dequant op
+    (parity: paddle.nn.quant.Stub)."""
+
+    def __init__(self, observer=None):
+        self._observer = observer
+
+    def forward(self, x):
+        return x
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """y = x @ dequant(weight).T + b (parity: nn.quant.weight_only_linear
+    — the reference's fused weight-only-int8/int4 gemm; XLA fuses the
+    dequant into the matmul epilogue here). Weight layout is the
+    weight_quantize output contract: (out_features, in_features) with a
+    per-out-feature scale; arch/group_size are GPU-kernel knobs with no
+    TPU meaning."""
+    def fn(a, w, *rest):
+        ri = 0
+        scale = None
+        if weight_scale is not None:
+            scale = rest[ri]; ri += 1
+        b = rest[ri] if bias is not None else None
+        wf = w.astype(a.dtype)
+        if scale is not None:
+            wf = wf * scale.astype(a.dtype)[:, None]
+        out = a @ wf.T
+        if b is not None:
+            out = out + b
+        return out
+    ops = [x, weight]
+    if weight_scale is not None:
+        ops.append(weight_scale)
+    if bias is not None:
+        ops.append(bias)
+    return run_op("weight_only_linear", fn, tuple(ops))
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """LLM.int8() mixed-precision linear (parity: nn.quant
+    .llm_int8_linear): outlier activation columns (any |x| > threshold)
+    use the full-precision dequantized weight; regular columns go through
+    a REQUANTIZED int8 weight path (round-to-int8 of the dequantized
+    weight), reproducing the reference's accuracy split — on TPU both
+    matmuls are MXU ops, the int8 path modeling the quantization error."""
+    def fn(a, w, *rest):
+        ri = 0
+        scale = rest[ri] if weight_scale is not None else None
+        if scale is not None:
+            ri += 1
+        b = rest[ri] if bias is not None else None
+        wf = w.astype(a.dtype)
+        if scale is not None:
+            wf = wf * scale.astype(a.dtype)[:, None]
+        outlier = (jnp.abs(a) > threshold).any(
+            axis=tuple(range(a.ndim - 1)))  # per input-feature column
+        a_out = jnp.where(outlier, a, 0.0)
+        a_reg = a - a_out
+        # regular path: weight snapped back to the int8 grid
+        if scale is not None:
+            w_int8 = jnp.clip(jnp.round(wf / scale.astype(
+                a.dtype)[:, None]), -127, 127) * scale.astype(
+                a.dtype)[:, None]
+        else:
+            w_int8 = jnp.clip(jnp.round(wf), -127, 127)
+        out = a_reg @ w_int8.T + a_out @ wf.T
+        if b is not None:
+            out = out + b
+        return out
+    ops = [x, weight]
+    if weight_scale is not None:
+        ops.append(weight_scale)
+    if bias is not None:
+        ops.append(bias)
+    return run_op("llm_int8_linear", fn, tuple(ops))

@@ -1,0 +1,19 @@
+"""paddle.sysconfig (parity: python/paddle/sysconfig.py)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory containing the framework's headers (the native csrc
+    sources double as the public surface of this build)."""
+    return os.path.join(os.path.dirname(__file__), "csrc")
+
+
+def get_lib():
+    """Directory containing compiled native libraries."""
+    root = os.path.join(os.path.dirname(__file__), "csrc")
+    build = os.path.join(root, "build")
+    return build if os.path.isdir(build) else root

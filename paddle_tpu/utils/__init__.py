@@ -61,3 +61,7 @@ def require_version(min_version, max_version=None):
         raise Exception(
             f"version {__version__} > allowed max {max_version}")
     return True
+
+from . import unique_name  # noqa: E402,F401
+from . import dlpack  # noqa: E402,F401
+from . import download  # noqa: E402,F401

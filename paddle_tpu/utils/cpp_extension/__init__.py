@@ -29,7 +29,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["load", "CppExtension", "get_build_directory"]
+__all__ = ["load", "CppExtension", "CUDAExtension", "setup",
+           "get_build_directory"]
 
 _SIG_RE = re.compile(
     r'extern\s+"C"\s+void\s+(\w+)\s*\(([^)]*)\)')
@@ -186,3 +187,36 @@ def load(name: str, sources: Sequence[str],
             "int64_t n)")
     so = _compile(name, sources, extra_cflags or [])
     return _LoadedExtension(name, so, symbols)
+
+
+class CUDAExtension(CppExtension):
+    """(parity: paddle.utils.cpp_extension.CUDAExtension — accepted for
+    API compatibility; there is no CUDA toolchain on the TPU build, so
+    .cu sources are rejected and C++ sources compile as a CppExtension)."""
+
+    def __init__(self, sources, name=None, extra_compile_args=None,
+                 **kwargs):
+        cu = [s for s in sources if str(s).endswith((".cu", ".cuh"))]
+        if cu:
+            raise RuntimeError(
+                f"CUDAExtension: no CUDA toolchain in the TPU build "
+                f"(rejected sources: {cu}); write TPU kernels with "
+                "Pallas and host code as C++ CppExtension")
+        super().__init__(sources, name=name,
+                         extra_compile_args=extra_compile_args, **kwargs)
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Build extensions eagerly (parity: paddle.utils.cpp_extension.setup
+    — the reference wraps setuptools.setup with its BuildExtension; here
+    each extension JIT-compiles into the build directory and the result
+    is importable via ``load``)."""
+    exts = ext_modules if isinstance(ext_modules, (list, tuple)) \
+        else [ext_modules] if ext_modules else []
+    built = []
+    for ext in exts:
+        ext_name = getattr(ext, "name", None) or name
+        built.append(load(name=ext_name, sources=ext.sources,
+                          extra_cflags=getattr(ext, "extra_compile_args",
+                                               None)))
+    return built

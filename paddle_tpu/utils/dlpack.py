@@ -1,0 +1,28 @@
+"""DLPack interchange (parity: python/paddle/utils/dlpack.py) — jax
+arrays speak DLPack natively; Tensors wrap/unwrap around it."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack-protocol object (parity: paddle.utils.dlpack
+    .to_dlpack). Modern DLPack interchange passes the object exposing
+    __dlpack__/__dlpack_device__ (the jax array itself) rather than a
+    bare capsule; every current consumer (torch/numpy/jax from_dlpack)
+    accepts it."""
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def from_dlpack(dlpack):
+    """DLPack object (or legacy capsule) -> Tensor (parity:
+    paddle.utils.dlpack.from_dlpack)."""
+    if hasattr(dlpack, "__dlpack__"):
+        return Tensor(jnp.from_dlpack(dlpack))
+    from jax import dlpack as jax_dlpack
+    return Tensor(jax_dlpack.from_dlpack(dlpack))

@@ -1,0 +1,22 @@
+"""Model-weight download helper (parity: python/paddle/utils/download.py
+get_weights_path_from_url). Zero-egress: only cache hits resolve."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_weights_path_from_url"]
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/hapi/weights")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """Return the local cache path for ``url`` if it exists; this
+    environment has no network egress, so a cache miss raises with the
+    expected path instead of downloading."""
+    fname = os.path.basename(url)
+    path = os.path.join(WEIGHTS_HOME, fname)
+    if os.path.exists(path):
+        return path
+    raise RuntimeError(
+        f"no network egress: place {fname} at {path} manually "
+        f"(requested from {url})")

@@ -1,0 +1,49 @@
+"""Unique name generator (parity: python/paddle/utils/unique_name.py —
+generate/switch/guard over per-generator counters)."""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["generate", "switch", "guard"]
+
+
+class _Generator:
+    def __init__(self, prefix=""):
+        self.ids = {}
+        self.prefix = prefix
+
+    def __call__(self, key):
+        self.ids[key] = self.ids.get(key, 0)
+        name = f"{self.prefix}{key}_{self.ids[key]}"
+        self.ids[key] += 1
+        return name
+
+
+_generator = _Generator()
+
+
+def generate(key):
+    """(parity: unique_name.generate)"""
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    """Swap in a fresh (or given) generator; returns the old one."""
+    global _generator
+    old = _generator
+    _generator = new_generator or _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Scoped generator switch (parity: unique_name.guard). A string
+    argument becomes the name prefix of a fresh generator, matching the
+    reference's guard('block0/') usage."""
+    if isinstance(new_generator, str):
+        new_generator = _Generator(prefix=new_generator)
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -1,0 +1,221 @@
+"""Wave-4 parity tests: fused incubate functionals, distribution
+transforms (torch oracles), amp.debugging module, nn.quant, dlpack
+interop, unique_name, hub, sysconfig, cpp_extension setup surface."""
+import os
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+
+t = paddle.to_tensor
+rng = np.random.RandomState(5)
+
+
+class TestFusedFunctionals:
+    F = None
+
+    @classmethod
+    def setup_class(cls):
+        cls.F = paddle.incubate.nn.functional
+
+    def test_fused_matmul_bias(self):
+        x = rng.randn(2, 3).astype(np.float32)
+        y = rng.randn(3, 4).astype(np.float32)
+        b = rng.randn(4).astype(np.float32)
+        out = self.F.fused_matmul_bias(t(x), t(y), t(b))
+        np.testing.assert_allclose(out.numpy(), x @ y + b, atol=1e-5)
+
+    def test_fused_linear_activation(self):
+        x = rng.randn(2, 3).astype(np.float32)
+        y = rng.randn(3, 4).astype(np.float32)
+        b = np.zeros(4, np.float32)
+        out = self.F.fused_linear_activation(t(x), t(y), t(b),
+                                             activation="relu")
+        np.testing.assert_allclose(out.numpy(),
+                                   np.maximum(x @ y, 0), atol=1e-5)
+
+    def test_fused_mha_shapes_and_grads(self):
+        x = t(rng.randn(2, 6, 16).astype(np.float32), stop_gradient=False)
+        qkvw = t(rng.randn(3, 4, 4, 16).astype(np.float32) * 0.1,
+                 stop_gradient=False)
+        lw = t(rng.randn(16, 16).astype(np.float32) * 0.1)
+        out = self.F.fused_multi_head_attention(
+            x, qkvw, lw, pre_layer_norm=True,
+            pre_ln_scale=t(np.ones(16, np.float32)),
+            pre_ln_bias=t(np.zeros(16, np.float32)),
+            ln_scale=t(np.ones(16, np.float32)),
+            ln_bias=t(np.zeros(16, np.float32)),
+            dropout_rate=0.0, attn_dropout_rate=0.0, training=False)
+        assert out.shape == [2, 6, 16]
+        (out ** 2).mean().backward()
+        assert np.isfinite(qkvw.grad.numpy()).all()
+
+    def test_fused_feedforward(self):
+        x = t(rng.randn(2, 4, 8).astype(np.float32))
+        w1 = t(rng.randn(8, 16).astype(np.float32) * 0.1)
+        w2 = t(rng.randn(16, 8).astype(np.float32) * 0.1)
+        out = self.F.fused_feedforward(
+            x, w1, w2, dropout1_rate=0.0, dropout2_rate=0.0,
+            ln2_scale=t(np.ones(8, np.float32)),
+            ln2_bias=t(np.zeros(8, np.float32)), training=False)
+        assert out.shape == [2, 4, 8]
+
+    def test_varlen_attention_masks(self):
+        q = t(rng.randn(2, 2, 6, 8).astype(np.float32))
+        out = self.F.variable_length_memory_efficient_attention(
+            q, q, q, t(np.array([6, 3], np.int32)),
+            t(np.array([6, 3], np.int32)))
+        assert np.abs(out.numpy()[1, :, 3:]).max() == 0.0
+        assert np.abs(out.numpy()[0]).max() > 0.0
+
+    def test_fused_multi_transformer_rejects_cache(self):
+        with pytest.raises(NotImplementedError):
+            self.F.fused_multi_transformer(
+                t(np.zeros((1, 2, 8), np.float32)), [], [], [], [], [],
+                [], [], [], [], [], [], [], cache_kvs=[1])
+
+
+class TestDistributionTransforms:
+    def test_stickbreaking_matches_torch(self):
+        x = rng.randn(5).astype(np.float32)
+        sb = paddle.distribution.StickBreakingTransform()
+        y = sb.forward(t(x))
+        ty = torch.distributions.StickBreakingTransform()(torch.tensor(x))
+        np.testing.assert_allclose(y.numpy(), ty.numpy(), atol=1e-5)
+        back = sb.inverse(y)
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-4)
+
+    def test_softmax_and_reshape(self):
+        x = rng.randn(4).astype(np.float32)
+        st = paddle.distribution.SoftmaxTransform()
+        np.testing.assert_allclose(float(st.forward(t(x)).numpy().sum()),
+                                   1.0, atol=1e-5)
+        rt = paddle.distribution.ReshapeTransform((6,), (2, 3))
+        assert rt.forward(t(np.zeros(6, np.float32))).shape == [2, 3]
+        assert rt.inverse(
+            t(np.zeros((2, 3), np.float32))).shape == [6]
+        with pytest.raises(ValueError):
+            paddle.distribution.ReshapeTransform((6,), (2, 2))
+
+    def test_stack_and_abs(self):
+        stk = paddle.distribution.StackTransform(
+            [paddle.distribution.ExpTransform(),
+             paddle.distribution.ExpTransform()], axis=0)
+        out = stk.forward(t(np.array([0.0, 1.0], np.float32)))
+        np.testing.assert_allclose(out.numpy(), np.exp([0.0, 1.0]),
+                                   atol=1e-5)
+        ab = paddle.distribution.AbsTransform()
+        np.testing.assert_allclose(
+            ab.forward(t(np.array([-2.0], np.float32))).numpy(), [2.0])
+
+
+class TestAmpDebugging:
+    def test_check_numerics_counts(self):
+        n, i, z = paddle.amp.debugging.check_numerics(
+            t(np.array([np.nan, np.inf, 0.0, 1.0], np.float32)),
+            "op", "v",
+            debug_mode=paddle.amp.debugging.DebugMode.CHECK_NAN_INF)
+        assert int(n.numpy()) == 1
+        assert int(i.numpy()) == 1
+        assert int(z.numpy()) == 1
+
+    def test_check_numerics_aborts(self):
+        with pytest.raises(FloatingPointError):
+            paddle.amp.debugging.check_numerics(
+                t(np.array([np.nan], np.float32)), "op", "v")
+
+    def test_collect_operator_stats(self, capsys):
+        with paddle.amp.debugging.collect_operator_stats():
+            x = t(np.ones((2, 2), np.float32))
+            (x @ x).sum()
+        out = capsys.readouterr().out
+        assert "matmul" in out
+
+    def test_tensor_checker_flags(self):
+        cfg = paddle.amp.debugging.TensorCheckerConfig(enable=True)
+        paddle.amp.debugging.enable_tensor_checker(cfg)
+        assert paddle.get_flags(["check_nan_inf"])["check_nan_inf"]
+        paddle.amp.debugging.disable_tensor_checker()
+        assert not paddle.get_flags(["check_nan_inf"])["check_nan_inf"]
+
+    def test_compare_accuracy(self, tmp_path):
+        a_dir, b_dir = tmp_path / "a", tmp_path / "b"
+        os.makedirs(a_dir)
+        os.makedirs(b_dir)
+        np.save(a_dir / "t0.npy", np.ones(4))
+        np.save(b_dir / "t0.npy", np.ones(4) + 1e-6)
+        out_csv = str(tmp_path / "cmp.csv")
+        rows = paddle.amp.debugging.compare_accuracy(
+            str(a_dir), str(b_dir), out_csv)
+        assert rows and rows[0][1] == "ok"
+        assert os.path.exists(out_csv)
+
+
+class TestNNQuant:
+    def test_weight_only_linear(self):
+        x = np.ones((2, 4), np.float32)
+        w = (np.ones((3, 4)) * 2).astype(np.int8)
+        scale = np.full(3, 0.5, np.float32)
+        out = paddle.nn.quant.weight_only_linear(
+            t(x), t(w), weight_scale=t(scale))
+        np.testing.assert_allclose(out.numpy(), np.full((2, 3), 4.0))
+
+    def test_llm_int8_linear_runs(self):
+        x = rng.randn(2, 4).astype(np.float32)
+        w = rng.randint(-127, 127, (3, 4)).astype(np.int8)
+        scale = np.full(3, 0.01, np.float32)
+        out = paddle.nn.quant.llm_int8_linear(t(x), t(w),
+                                              weight_scale=t(scale))
+        ref = x @ (w.astype(np.float32) * scale[:, None]).T
+        np.testing.assert_allclose(out.numpy(), ref, atol=1e-4)
+
+    def test_stub(self):
+        s = paddle.nn.quant.Stub()
+        x = t(np.ones(3, np.float32))
+        assert s(x) is x
+
+
+class TestInteropUtils:
+    def test_dlpack_roundtrip_and_torch(self):
+        x = t(np.arange(6.0, dtype=np.float32))
+        y = paddle.utils.dlpack.from_dlpack(paddle.utils.dlpack.to_dlpack(x))
+        np.testing.assert_allclose(y.numpy(), x.numpy())
+        tt = torch.from_dlpack(paddle.utils.dlpack.to_dlpack(x))
+        np.testing.assert_allclose(tt.numpy(), x.numpy())
+        back = paddle.utils.dlpack.from_dlpack(tt)
+        np.testing.assert_allclose(back.numpy(), x.numpy())
+
+    def test_unique_name(self):
+        with paddle.utils.unique_name.guard():
+            a = paddle.utils.unique_name.generate("fc")
+            b = paddle.utils.unique_name.generate("fc")
+        assert a != b
+        assert a.startswith("fc_")
+
+    def test_hub(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny(n=4):\n"
+            "    'Builds a tiny Linear'\n"
+            "    import paddle_tpu as p\n"
+            "    return p.nn.Linear(n, n)\n")
+        assert paddle.hub.list(str(tmp_path)) == ["tiny"]
+        assert "tiny" in paddle.hub.help(str(tmp_path), "tiny")
+        net = paddle.hub.load(str(tmp_path), "tiny", 3)
+        assert net.weight.shape == [3, 3]
+        with pytest.raises(RuntimeError):
+            paddle.hub.load("org/repo", "x", source="github")
+
+    def test_sysconfig(self):
+        assert os.path.isdir(paddle.sysconfig.get_include())
+        assert isinstance(paddle.sysconfig.get_lib(), str)
+
+    def test_cuda_extension_rejects_cu(self):
+        with pytest.raises(RuntimeError):
+            paddle.utils.cpp_extension.CUDAExtension(["kernel.cu"])
+
+    def test_download_cache_miss_raises(self):
+        with pytest.raises(RuntimeError):
+            paddle.utils.download.get_weights_path_from_url(
+                "https://example.com/nonexistent_weights_xyz.pdparams")
