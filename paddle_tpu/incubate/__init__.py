@@ -206,4 +206,9 @@ class ModelAverage:
 
 from ..ops.fused_ce import fused_linear_cross_entropy  # noqa: E402,F401
 
-from ..core import autotune  # noqa: E402,F401
+
+from . import asp  # noqa: E402,F401
+from . import autograd  # noqa: E402,F401
+from . import autotune  # noqa: E402,F401
+from . import multiprocessing  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401

@@ -1,0 +1,114 @@
+"""Automatic SParsity (parity: python/paddle/incubate/asp/ — 2:4
+structured sparsity: prune weights to the n:m pattern the reference's
+sparse tensor cores consume; on TPU the pruned weights run as dense
+bf16 — the capability kept is the pruning workflow + mask maintenance)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers",
+           "add_supported_layer"]
+
+_EXCLUDED: set = set()
+_SUPPORTED_TYPES = {"Linear", "Conv2D"}
+# mask registry keyed by id(parameter) with a weakref for liveness
+# (Tensor's elementwise __eq__ rules out dict/WeakKeyDictionary keys;
+# names are unreliable — default parameters carry an empty name)
+import weakref
+_MASKS: dict = {}  # id(param) -> (weakref(param), mask)
+
+
+def _register_mask(p, mask):
+    _MASKS[id(p)] = (weakref.ref(p), mask)
+
+
+def _mask_of(p):
+    ent = _MASKS.get(id(p))
+    if ent is None:
+        return None
+    ref, mask = ent
+    live = ref()
+    if live is None or live is not p:  # id was recycled
+        del _MASKS[id(p)]
+        return None
+    return mask
+
+
+def calculate_density(x):
+    """Fraction of nonzeros (parity: asp.calculate_density)."""
+    arr = x._data if isinstance(x, Tensor) else np.asarray(x)
+    arr = np.asarray(arr)
+    return float((arr != 0).sum() / max(arr.size, 1))
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """(parity: asp.set_excluded_layers)"""
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """(parity: asp.add_supported_layer)"""
+    name = layer if isinstance(layer, str) else type(layer).__name__
+    _SUPPORTED_TYPES.add(name)
+
+
+def _prune_2_4(w):
+    """Keep the 2 largest-|w| of every 4 along the LAST axis (the
+    reduction dim of the (in, out)->out contraction is handled by the
+    caller transposing when needed); requires last-dim % 4 == 0."""
+    groups = w.reshape(*w.shape[:-1], w.shape[-1] // 4, 4)
+    order = np.argsort(-np.abs(groups), axis=-1)
+    mask = np.zeros_like(groups, bool)
+    np.put_along_axis(mask, order[..., :2], True, axis=-1)
+    return mask.reshape(w.shape)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Prune supported layers' weights to the n:m pattern along the
+    input (first, for the (in, out) Linear layout) dim (parity:
+    asp.prune_model). Masks are registered per parameter object so the
+    decorated optimizer re-applies them after each step."""
+    pruned = {}
+    for pname, p in model.named_parameters():
+        leaf = pname.split(".")[-1]
+        if leaf != "weight" or pname in _EXCLUDED:
+            continue
+        w = np.asarray(p._data)
+        if w.ndim < 2 or w.shape[0] % 4:
+            continue
+        # 2:4 along the input/reduction dim (axis 0 of the (in, out)
+        # Linear weight): transpose so the grouped axis is last
+        mask = _prune_2_4(w.T).T
+        p._data = jnp.asarray(w * mask).astype(p._data.dtype)
+        _register_mask(p, jnp.asarray(mask))
+        pruned[pname] = calculate_density(p)
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap an optimizer so every step re-applies the sparsity masks
+    (parity: asp.decorate — the reference's OptimizerWithSparsityGuarantee)."""
+
+    class _ASPOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, item):
+            return getattr(self._inner, item)
+
+        def step(self):
+            self._inner.step()
+            params = getattr(self._inner, "_parameter_list", None) or []
+            for p in params:
+                mask = _mask_of(p)
+                if mask is not None:
+                    p._data = (p._data * mask).astype(p._data.dtype)
+    return _ASPOptimizer(optimizer)

@@ -1,0 +1,144 @@
+"""Functional autodiff transforms (parity: python/paddle/incubate/
+autograd/ — vjp/jvp/Jacobian/Hessian/forward_grad/grad + the prim
+toggles). On this substrate these ARE jax's native transforms, exposed
+through the Tensor wrapper."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import tape_paused
+from ...core.tensor import Tensor
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "forward_grad", "grad"]
+
+_PRIM = [False]
+
+
+def enable_prim():
+    """(parity: incubate.autograd.enable_prim — the reference switches to
+    primitive-op decomposition for higher-order AD; jax composes
+    transforms natively, so the toggle is bookkeeping)"""
+    _PRIM[0] = True
+
+
+def disable_prim():
+    _PRIM[0] = False
+
+
+def _unwrap(x):
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap(x):
+    if isinstance(x, (list, tuple)):
+        return type(x)(_wrap(v) for v in x)
+    return Tensor(x)
+
+
+def _functional(func):
+    def fn(*arrays):
+        with tape_paused():
+            out = func(*[Tensor(a) for a in arrays])
+        return _unwrap(out)
+    return fn
+
+
+def vjp(func, xs, v=None):
+    """(parity: incubate.autograd.vjp) -> (outputs, vjp_result)"""
+    xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [_unwrap(x) for x in xs_t]
+    out, pullback = jax.vjp(_functional(func), *arrays)
+    if v is None:
+        ct = jnp.ones_like(out) if not isinstance(out, (tuple, list)) \
+            else type(out)(jnp.ones_like(o) for o in out)
+    else:
+        ct = _unwrap(v)
+    grads = pullback(ct)
+    grads = _wrap(list(grads))
+    return _wrap(out), grads if len(grads) > 1 else grads[0]
+
+
+def jvp(func, xs, v=None):
+    """(parity: incubate.autograd.jvp) -> (outputs, jvp_result)"""
+    xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [_unwrap(x) for x in xs_t]
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        v_t = v if isinstance(v, (list, tuple)) else [v]
+        tangents = [_unwrap(t) for t in v_t]
+    out, tangent_out = jax.jvp(_functional(func), tuple(arrays),
+                               tuple(tangents))
+    return _wrap(out), _wrap(tangent_out)
+
+
+class Jacobian:
+    """Lazy Jacobian (parity: incubate.autograd.Jacobian — row/col
+    sliceable; computed with jax.jacobian)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._xs = xs if isinstance(xs, (list, tuple)) else [xs]
+        arrays = [_unwrap(x) for x in self._xs]
+        jac = jax.jacobian(_functional(func),
+                           argnums=tuple(range(len(arrays))))(*arrays)
+        j = jac[0] if len(arrays) == 1 else jac
+        if isinstance(j, (tuple, list)):
+            j = jnp.concatenate([x.reshape(x.shape[0], -1) for x in j],
+                                axis=-1)
+        else:
+            out_dim = j.shape[: j.ndim - arrays[0].ndim]
+            j = j.reshape((int(jnp.prod(jnp.asarray(out_dim))) or 1, -1))
+        self._mat = j
+
+    def __getitem__(self, idx):
+        return Tensor(self._mat[idx])
+
+    @property
+    def shape(self):
+        return list(self._mat.shape)
+
+
+class Hessian:
+    """Lazy Hessian of a scalar function (parity:
+    incubate.autograd.Hessian)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._xs = xs if isinstance(xs, (list, tuple)) else [xs]
+        arrays = [_unwrap(x) for x in self._xs]
+        if len(arrays) > 1:
+            raise NotImplementedError(
+                "Hessian over multiple inputs is not supported yet; "
+                "concatenate the inputs into one tensor")
+
+        def scalar(*a):
+            out = _functional(func)(*a)
+            return out.reshape(()) if hasattr(out, "reshape") else out
+        h = jax.hessian(scalar)(*arrays)
+        n = arrays[0].size
+        self._mat = h.reshape(n, n)
+
+    def __getitem__(self, idx):
+        return Tensor(self._mat[idx])
+
+    @property
+    def shape(self):
+        return list(self._mat.shape)
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode grads d outputs / d inputs (parity:
+    incubate.autograd.forward_grad; requires functional use via jvp)."""
+    raise NotImplementedError(
+        "forward_grad over recorded graphs is not supported; use "
+        "incubate.autograd.jvp(func, xs) — forward-mode AD on this "
+        "substrate is a functional transform")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """(parity: incubate.autograd.grad — same contract as paddle.grad)"""
+    from ...core.autograd import grad as _grad
+    return _grad(outputs, inputs, grad_outputs)

@@ -1,0 +1,24 @@
+"""paddle.incubate.autotune.set_config (parity: python/paddle/incubate/
+autotune.py — JSON/dict config for kernel/layout/dataloader tuning).
+Kernel autotuning maps onto core/autotune.py's measure-and-cache."""
+from __future__ import annotations
+
+import json
+
+__all__ = ["set_config"]
+
+
+def set_config(config=None):
+    """Accepts {"kernel": {"enable": bool, "tuning_range": ...},
+    "layout": {...}, "dataloader": {...}} or a JSON file path."""
+    from ..core import autotune as _at
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    config = config or {}
+    kernel = config.get("kernel", {})
+    if kernel.get("enable"):
+        _at.enable_autotune()
+    elif "enable" in kernel:
+        _at.disable_autotune()
+    return config

@@ -1,0 +1,37 @@
+"""paddle.incubate.multiprocessing (parity: python/paddle/incubate/
+multiprocessing/ — tensor-aware reductions for mp queues; __all__ is
+empty in the reference). Tensors cross process boundaries as numpy
+payloads here (jax arrays are not shareable cross-process)."""
+from __future__ import annotations
+
+import multiprocessing
+from multiprocessing import *  # noqa: F401,F403
+
+__all__ = []
+
+
+def _reduce_tensor(t):
+    import numpy as np
+    return (_rebuild_tensor, (np.asarray(t._data), t.stop_gradient,
+                              t.name, t.persistable, t.trainable))
+
+
+def _rebuild_tensor(arr, stop_gradient, name="", persistable=False,
+                    trainable=None):
+    import jax.numpy as jnp
+    from ...core.tensor import Tensor
+    out = Tensor(jnp.asarray(arr), stop_gradient=stop_gradient)
+    out.name = name
+    out.persistable = persistable
+    if trainable is not None:
+        out.trainable = trainable
+    return out
+
+
+def _install_reductions():
+    import copyreg
+    from ...core.tensor import Tensor
+    copyreg.pickle(Tensor, _reduce_tensor)
+
+
+_install_reductions()

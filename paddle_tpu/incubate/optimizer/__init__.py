@@ -1,0 +1,8 @@
+"""paddle.incubate.optimizer (parity: python/paddle/incubate/optimizer/
+— LBFGS graduated to paddle.optimizer in this build; re-exported here
+for the reference import path)."""
+from ...optimizer import LBFGS  # noqa: F401
+
+__all__ = ["LBFGS"]
+
+from .. import LookAhead, ModelAverage  # noqa: E402,F401

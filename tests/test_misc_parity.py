@@ -219,3 +219,56 @@ class TestInteropUtils:
         with pytest.raises(RuntimeError):
             paddle.utils.download.get_weights_path_from_url(
                 "https://example.com/nonexistent_weights_xyz.pdparams")
+
+
+class TestIncubateAutogradASP:
+    def test_vjp_jvp(self):
+        IA = paddle.incubate.autograd
+
+        def f(x):
+            return (x * x).sum()
+        out, g = IA.vjp(f, t(np.array([1.0, 2.0], np.float32)))
+        assert float(out.numpy()) == 5.0
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+        _, tangent = IA.jvp(f, t(np.array([1.0, 2.0], np.float32)))
+        assert float(tangent.numpy()) == 6.0
+
+    def test_jacobian_hessian(self):
+        IA = paddle.incubate.autograd
+        J = IA.Jacobian(lambda x: x * 3,
+                        t(np.array([1.0, 2.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(J[:].numpy()),
+                                   np.eye(2) * 3)
+        H = IA.Hessian(lambda x: (x ** 2).sum(),
+                       t(np.array([1.0, 2.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(H[:].numpy()),
+                                   np.eye(2) * 2)
+
+    def test_asp_prune_and_decorate(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(8, 4)
+        paddle.incubate.asp.prune_model(lin)
+        assert abs(paddle.incubate.asp.calculate_density(lin.weight)
+                   - 0.5) < 1e-6
+        opt = paddle.incubate.asp.decorate(
+            paddle.optimizer.SGD(0.1, parameters=lin.parameters()))
+        x = t(np.ones((2, 8), np.float32))
+        loss = (lin(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        # mask survives the optimizer step
+        assert abs(paddle.incubate.asp.calculate_density(lin.weight)
+                   - 0.5) < 1e-6
+
+    def test_tensor_mp_pickle(self):
+        import pickle
+        x = t(np.arange(3.0, dtype=np.float32))
+        y = pickle.loads(pickle.dumps(x))
+        np.testing.assert_allclose(y.numpy(), x.numpy())
+
+    def test_autotune_set_config(self):
+        from paddle_tpu.core import autotune as core_at
+        paddle.incubate.autotune.set_config({"kernel": {"enable": True}})
+        assert core_at.autotune_status()["use_autotune"]
+        paddle.incubate.autotune.set_config({"kernel": {"enable": False}})
+        assert not core_at.autotune_status()["use_autotune"]
