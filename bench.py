@@ -224,16 +224,91 @@ def _probe_accelerator(timeout_s: int = 90) -> bool:
         return False
 
 
+def _zero_result(error: str) -> str:
+    return json.dumps({"metric": "gpt2s_train_tokens_per_sec_per_chip",
+                       "value": 0.0, "unit": "tokens/s",
+                       "vs_baseline": 0.0, "error": error})
+
+
+def _run_child(env_overrides: dict, timeout_s: int):
+    """Run this script's main() in a subprocess (the only reliable way to
+    bound a device call hung inside the C++ runtime) and return its
+    result dict, or None. The result is the last stdout line that parses
+    as JSON with the bench's metric key — runtime log lines around it
+    don't confuse the search. Child stderr is forwarded (tail) so
+    failures stay diagnosable."""
+    import os
+    import subprocess
+    env = dict(os.environ)
+    env.update(env_overrides)
+    env["PADDLE_TPU_BENCH_CHILD"] = "1"
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, env=env,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(
+            f"bench: child exceeded {timeout_s}s and was killed\n")
+        return None
+    except Exception as e:
+        sys.stderr.write(f"bench: could not spawn child: {e!r}\n")
+        return None
+    if r.stderr:
+        tail = r.stderr.strip().splitlines()[-8:]
+        sys.stderr.write("\n".join(f"bench-child: {ln}" for ln in tail)
+                         + "\n")
+    for line in reversed((r.stdout or "").strip().splitlines()):
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if obj.get("metric") == "gpt2s_train_tokens_per_sec_per_chip":
+            return obj
+    sys.stderr.write(
+        f"bench: child exited {r.returncode} without a result line\n")
+    return None
+
+
 if __name__ == "__main__":
     import os
-    if not _probe_accelerator():
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ["PYTHONPATH"] = ""
-        sys.stderr.write("bench: accelerator unavailable, CPU fallback\n")
-    try:
-        main()
-    except Exception as e:  # never crash the driver: report the failure
-        print(json.dumps({"metric": "gpt2s_train_tokens_per_sec_per_chip",
-                          "value": 0.0, "unit": "tokens/s",
-                          "vs_baseline": 0.0, "error": repr(e)}))
+    if os.environ.get("PADDLE_TPU_BENCH_CHILD") == "1":
+        # child mode: just run; the parent owns timeouts and fallbacks
+        try:
+            main()
+        except Exception as e:
+            print(_zero_result(repr(e)))
         sys.exit(0)
+
+    # orchestrator: attempt the accelerator in a bounded subprocess; on
+    # failure/hang, report the CPU number WITH the TPU error attached so
+    # a TPU-only regression can never ship as a clean green result
+    tpu_ok = _probe_accelerator()
+    result = None
+    tpu_error = None
+    if tpu_ok:
+        result = _run_child({}, timeout_s=1500)
+        if result is not None and result.get("error"):
+            tpu_error = result["error"]
+            result = None
+        elif result is None:
+            tpu_error = "TPU bench subprocess hung or died"
+    else:
+        tpu_error = "accelerator probe failed (tunnel down)"
+    if result is None:
+        sys.stderr.write(f"bench: TPU path unavailable ({tpu_error}); "
+                         "running the CPU fallback\n")
+        result = _run_child({"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+                            timeout_s=1200)
+        if result is not None:
+            # honest annotation: the score did not come from the TPU
+            result.setdefault("extra", {})["tpu_error"] = tpu_error
+            result["vs_baseline"] = 0.0
+        else:
+            print(_zero_result(f"TPU failed ({tpu_error}) and CPU "
+                               "fallback also failed"))
+            sys.exit(0)
+    print(json.dumps(result))
+    sys.exit(0)
