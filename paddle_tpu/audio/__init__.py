@@ -25,6 +25,28 @@ class functional:
     """paddle.audio.functional."""
 
     @staticmethod
+    def fft_frequencies(sr, n_fft, dtype="float32"):
+        """(parity: audio.functional.fft_frequencies)"""
+        import numpy as _np
+        return Tensor(jnp.asarray(_np.linspace(
+            0, sr / 2, 1 + n_fft // 2).astype(dtype)))
+
+    @staticmethod
+    def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                        dtype="float32"):
+        """(parity: audio.functional.mel_frequencies)"""
+        lo = functional.hz_to_mel(f_min, htk)
+        hi = functional.hz_to_mel(f_max, htk)
+        import numpy as _np
+        lo = float(lo) if not hasattr(lo, "numpy") else float(lo.numpy())
+        hi = float(hi) if not hasattr(hi, "numpy") else float(hi.numpy())
+        mels = _np.linspace(lo, hi, n_mels)
+        out = [functional.mel_to_hz(float(m), htk) for m in mels]
+        out = [float(o.numpy()) if hasattr(o, "numpy") else float(o)
+               for o in out]
+        return Tensor(jnp.asarray(_np.asarray(out, dtype)))
+
+    @staticmethod
     def hz_to_mel(freq, htk: bool = False):
         f = np.asarray(freq, np.float64)
         if htk:

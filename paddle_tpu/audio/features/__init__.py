@@ -1,0 +1,9 @@
+"""Path-faithful module (parity: python/paddle/audio/features/)."""
+from .. import features as _ns
+
+Spectrogram = _ns.Spectrogram
+MelSpectrogram = _ns.MelSpectrogram
+LogMelSpectrogram = _ns.LogMelSpectrogram
+MFCC = _ns.MFCC
+
+__all__ = ["LogMelSpectrogram", "MelSpectrogram", "MFCC", "Spectrogram"]

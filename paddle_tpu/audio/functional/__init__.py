@@ -1,0 +1,15 @@
+"""Path-faithful module (parity: python/paddle/audio/functional/)."""
+from .. import functional as _ns
+
+compute_fbank_matrix = _ns.compute_fbank_matrix
+create_dct = _ns.create_dct
+fft_frequencies = _ns.fft_frequencies
+hz_to_mel = _ns.hz_to_mel
+mel_frequencies = _ns.mel_frequencies
+mel_to_hz = _ns.mel_to_hz
+power_to_db = _ns.power_to_db
+get_window = _ns.get_window
+
+__all__ = ["compute_fbank_matrix", "create_dct", "fft_frequencies",
+           "hz_to_mel", "mel_frequencies", "mel_to_hz", "power_to_db",
+           "get_window"]

@@ -1,0 +1,9 @@
+"""paddle.callbacks (parity: python/paddle/callbacks.py — re-export of
+the hapi callback suite)."""
+from .hapi.callbacks import (Callback, EarlyStopping, LRScheduler,  # noqa: F401
+                             ModelCheckpoint, ProgBarLogger,
+                             ReduceLROnPlateau, VisualDL, WandbCallback)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
+           "LRScheduler", "EarlyStopping", "ReduceLROnPlateau",
+           "WandbCallback"]

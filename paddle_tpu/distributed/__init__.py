@@ -7,7 +7,7 @@ from .auto_parallel.api import (shard_tensor, reshard, shard_layer,  # noqa: F40
                                 unshard_dtensor, local_value, DistAttr,
                                 ShardingStage0, ShardingStage1,
                                 ShardingStage2, ShardingStage3)
-from .communication import (Group, new_group, get_group, all_reduce,  # noqa: F401
+from .communication_impl import (Group, new_group, get_group, all_reduce,  # noqa: F401
                             all_gather, all_gather_object, all_to_all,
                             all_to_all_single, reduce_scatter, broadcast,
                             reduce, scatter, gather, send, recv, isend,
@@ -35,6 +35,7 @@ from .extras import (spawn, scatter_object_list, broadcast_object_list,  # noqa:
                      QueueDataset, InMemoryDataset)
 from . import io  # noqa: F401
 from . import utils  # noqa: F401
+from . import communication  # noqa: F401
 
 alltoall = all_to_all
 alltoall_single = all_to_all_single

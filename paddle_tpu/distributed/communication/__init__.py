@@ -1,0 +1,13 @@
+"""Path-faithful package (parity: python/paddle/distributed/
+communication/): the collective API lives in distributed/communication.py
+on this build; this package re-exports it plus the stream.* async
+variants."""
+from ..communication_impl import *  # noqa: F401,F403
+from ..communication_impl import __all__  # noqa: F401
+# the impl module also exports a `stream` class namespace whose name
+# shadows the submodule on `from . import stream`; resolve the real
+# submodule through importlib so the package attribute is the module
+# (the reference's layout)
+import importlib as _importlib
+
+stream = _importlib.import_module(__name__ + ".stream")

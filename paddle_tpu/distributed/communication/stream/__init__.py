@@ -1,0 +1,20 @@
+"""paddle.distributed.communication.stream (parity:
+python/paddle/distributed/communication/stream/ — the *_on_calc_stream
+async variants; XLA compiles collectives into programs, so these are the
+same ops with the reference's (sync_op, use_calc_stream) signature)."""
+from ...communication_impl import stream as _ns
+
+all_gather = _ns.all_gather
+all_reduce = _ns.all_reduce
+alltoall = _ns.alltoall
+from ...communication_impl import all_to_all_single as alltoall_single
+broadcast = _ns.broadcast
+reduce = _ns.reduce
+reduce_scatter = _ns.reduce_scatter
+recv = _ns.recv
+send = _ns.send
+scatter = _ns.scatter
+
+__all__ = ["all_gather", "all_reduce", "alltoall", "alltoall_single",
+           "broadcast", "reduce", "reduce_scatter", "recv", "send",
+           "scatter"]

@@ -87,7 +87,7 @@ def broadcast_object_list(object_list, src=0, group=None):
     array substrate every process sees identical values, so the broadcast
     is identity for the src's data; the API contract (in-place fill of
     object_list) is preserved."""
-    from .communication import broadcast
+    from .communication_impl import broadcast
     out = []
     for obj in object_list:
         t = _obj_to_tensor(obj)
@@ -135,7 +135,7 @@ def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
 
 def gloo_barrier():
     """(parity: paddle.distributed.gloo_barrier)"""
-    from .communication import barrier
+    from .communication_impl import barrier
     barrier()
 
 

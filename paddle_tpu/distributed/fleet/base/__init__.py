@@ -1,0 +1,2 @@
+"""fleet.base namespace (parity: python/paddle/distributed/fleet/base/)."""
+from . import topology  # noqa: F401

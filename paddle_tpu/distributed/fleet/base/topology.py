@@ -1,0 +1,4 @@
+"""Path-faithful module (parity: fleet/base/topology.py)."""
+from ..topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]

@@ -17,7 +17,7 @@ from typing import Optional
 
 import numpy as np
 
-from ..communication import Group, _set_world_group
+from ..communication_impl import Group, _set_world_group
 from ..parallel import DataParallel, init_parallel_env
 from .topology import CommunicateTopology, HybridCommunicateGroup
 
@@ -155,7 +155,7 @@ class Fleet:
         pass
 
     def barrier_worker(self):
-        from ..communication import barrier
+        from ..communication_impl import barrier
         barrier()
 
     def stop_worker(self):

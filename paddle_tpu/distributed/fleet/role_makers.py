@@ -81,7 +81,7 @@ class UtilBase:
     def all_reduce(self, input, mode="sum", comm_world="worker"):
         import numpy as np
 
-        from .. import communication as C
+        from .. import communication_impl as C
         from ...core.tensor import Tensor
         t = input if isinstance(input, Tensor) else Tensor(np.asarray(input))
         op = {"sum": C.ReduceOp.SUM, "max": C.ReduceOp.MAX,
@@ -90,11 +90,11 @@ class UtilBase:
         return np.asarray((out if out is not None else t).numpy())
 
     def barrier(self, comm_world="worker"):
-        from .. import communication as C
+        from .. import communication_impl as C
         C.barrier()
 
     def all_gather(self, input, comm_world="worker"):
-        from .. import communication as C
+        from .. import communication_impl as C
         from ...core.tensor import Tensor
         import numpy as np
         t = input if isinstance(input, Tensor) else Tensor(np.asarray(input))

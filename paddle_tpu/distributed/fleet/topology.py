@@ -16,7 +16,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..communication import Group
+from ..communication_impl import Group
 from ..process_mesh import ProcessMesh
 
 __all__ = ["CommunicateTopology", "HybridCommunicateGroup"]

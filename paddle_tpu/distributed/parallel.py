@@ -51,7 +51,7 @@ def init_parallel_env():
     paddle.distributed.init_parallel_env)."""
     import jax
 
-    from .communication import Group, _set_world_group
+    from .communication_impl import Group, _set_world_group
     from .process_mesh import ProcessMesh
 
     _maybe_init_jax_distributed()

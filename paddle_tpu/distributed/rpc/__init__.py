@@ -20,7 +20,8 @@ from typing import Any, Dict, List, Optional
 from ..store import TCPStore
 
 __all__ = ["init_rpc", "shutdown", "rpc_sync", "rpc_async",
-           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo", "get_current_worker_info",
+]
 
 _PREFIX = "__rpc"
 
@@ -191,3 +192,15 @@ def shutdown(graceful: bool = True):
         except Exception:
             pass
     _STATE.update(store=None, agent=None)
+
+
+def get_current_worker_info():
+    """(parity: paddle.distributed.rpc.get_current_worker_info) — the
+    live agent's identity when init_rpc has run, env contract otherwise."""
+    agent = _STATE.get("agent") if isinstance(_STATE, dict) else None
+    if agent is not None:
+        return WorkerInfo(agent.name, agent.rank)
+    import os
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    name = os.environ.get("PADDLE_WORKER_NAME", f"worker{rank}")
+    return WorkerInfo(name, rank)

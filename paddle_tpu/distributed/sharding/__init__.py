@@ -1,0 +1,37 @@
+"""paddle.distributed.sharding (parity: python/paddle/distributed/
+sharding/ — group_sharded_parallel/save_group_sharded_model, the dygraph
+ZeRO entry points over the fleet sharding stages)."""
+from __future__ import annotations
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """Wrap model+optimizer for ZeRO stage os/os_g/p_g_os (parity:
+    sharding/group_sharded_parallel). Maps onto the GSPMD sharding
+    stages: os -> ShardingStage1, os_g -> Stage2, p_g_os -> Stage3."""
+    from ..auto_parallel.api import (ShardingStage1, ShardingStage2,
+                                     ShardingStage3, shard_optimizer)
+    stage = {"os": ShardingStage1, "os_g": ShardingStage2,
+             "p_g_os": ShardingStage3}.get(level)
+    if stage is None:
+        raise ValueError(
+            f"level must be os | os_g | p_g_os, got {level!r}")
+    opt = shard_optimizer(optimizer, stage())
+    return model, opt, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """(parity: sharding.save_group_sharded_model)"""
+    import os
+
+    from ...framework import save
+    os.makedirs(output, exist_ok=True)
+    save(model.state_dict(), os.path.join(output, "model.pdmodel"))
+    if optimizer is not None and hasattr(optimizer, "state_dict"):
+        save(optimizer.state_dict(), os.path.join(output,
+                                                  "model.pdopt"))

@@ -1,0 +1,4 @@
+"""Path-faithful module (parity: python/paddle/distribution/kl.py)."""
+from . import kl_divergence, register_kl  # noqa: F401
+
+__all__ = ["register_kl", "kl_divergence"]

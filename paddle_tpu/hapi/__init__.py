@@ -1,7 +1,8 @@
 """High-level Model API (parity: python/paddle/hapi/)."""
 from . import callbacks  # noqa: F401
 from .callbacks import (Callback, EarlyStopping, LRScheduler,  # noqa: F401
-                        ModelCheckpoint, ProgBarLogger)
+                        ModelCheckpoint, ProgBarLogger,
+                        ReduceLROnPlateau, VisualDL, WandbCallback)
 from .model import Model  # noqa: F401
 
 

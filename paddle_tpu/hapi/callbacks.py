@@ -9,7 +9,8 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
-           "EarlyStopping", "LRScheduler"]
+           "EarlyStopping", "LRScheduler", "ReduceLROnPlateau", "VisualDL", "WandbCallback",
+]
 
 
 class Callback:
@@ -196,3 +197,145 @@ class LRScheduler(Callback):
     def on_epoch_end(self, epoch, logs=None):
         if not self.by_step:
             self._step()
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce LR when a monitored metric plateaus (parity:
+    paddle.callbacks.ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.mode = mode
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self._wait = 0
+        self._cooldown_counter = 0
+        self._best = None
+
+    def _is_improvement(self, current):
+        if self._best is None:
+            return True
+        if self.mode == "max" or (self.mode == "auto"
+                                  and "acc" in self.monitor):
+            return current > self._best + self.min_delta
+        return current < self._best - self.min_delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        current = logs.get(self.monitor)
+        if current is None:
+            return
+        current = float(current[0] if isinstance(
+            current, (list, tuple)) else current)
+        if self._cooldown_counter > 0:
+            self._cooldown_counter -= 1
+            self._wait = 0
+            if self._is_improvement(current):
+                self._best = current
+            return
+        if self._is_improvement(current):
+            self._best = current
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                lr = opt.get_lr() if hasattr(opt, "get_lr") else None
+                if lr is not None:
+                    new_lr = max(lr * self.factor, self.min_lr)
+                    if hasattr(opt, "set_lr"):
+                        opt.set_lr(new_lr)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr {lr:.2e} -> "
+                              f"{new_lr:.2e}")
+            self._cooldown_counter = self.cooldown
+            self._wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logger (parity: paddle.callbacks.VisualDL — the reference
+    writes VisualDL event files; this build appends JSONL scalars the
+    same dashboard semantics can consume)."""
+
+    def __init__(self, log_dir="./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._step = 0
+
+    def _write(self, tag, logs):
+        import json as _json
+        import os as _os
+        _os.makedirs(self.log_dir, exist_ok=True)
+        path = _os.path.join(self.log_dir, "scalars.jsonl")
+        record = {"step": self._step, "tag": tag}
+        for k, v in (logs or {}).items():
+            try:
+                record[k] = float(v[0] if isinstance(v, (list, tuple))
+                                  else v)
+            except (TypeError, ValueError):
+                continue
+        with open(path, "a") as f:
+            f.write(_json.dumps(record) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if self._step % 10 == 0:
+            self._write("train", logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._write("train_epoch", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)
+
+
+class WandbCallback(Callback):
+    """Weights&Biases logger (parity: paddle.callbacks.WandbCallback).
+    The wandb package is not in-image; construction requires it and
+    raises with a clear message otherwise."""
+
+    def __init__(self, project=None, **kwargs):
+        super().__init__()
+        try:
+            import wandb
+        except ImportError as e:
+            raise ImportError(
+                "WandbCallback requires the wandb package, which is not "
+                "installed in this environment") from e
+        self._wandb = wandb
+        self._run = wandb.init(project=project, **kwargs)
+        self._step = 0
+
+    def _log(self, logs):
+        record = {}
+        for k, v in (logs or {}).items():
+            try:
+                record[k] = float(v[0] if isinstance(v, (list, tuple))
+                                  else v)
+            except (TypeError, ValueError):
+                continue
+        if record:
+            self._wandb.log(record, step=self._step)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if self._step % 10 == 0:
+            self._log(logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._log(logs)
+
+    def on_eval_end(self, logs=None):
+        self._log({f"eval_{k}": v for k, v in (logs or {}).items()})
+
+    def on_train_end(self, logs=None):
+        if self._run is not None:
+            self._run.finish()

@@ -1,0 +1,134 @@
+"""Functional second-order minimizers (parity: python/paddle/incubate/
+optimizer/functional/ — minimize_bfgs/minimize_lbfgs over a pure
+objective). jax.grad supplies the gradients."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _as_arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _line_search(f, x, d, g, max_iters=20, c1=1e-4, rho=0.5):
+    t = 1.0
+    fx = f(x)
+    gtd = jnp.dot(g, d)
+    for _ in range(max_iters):
+        if f(x + t * d) <= fx + c1 * t * gtd:
+            break
+        t *= rho
+    return t
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None,
+                  line_search_fn="strong_wolfe", dtype="float32",
+                  name=None):
+    """(parity: incubate.optimizer.functional.minimize_bfgs). Returns
+    (is_converge, num_func_calls, position, objective_value,
+    objective_gradient, inverse_hessian_estimate)."""
+    def f(arr):
+        out = objective_func(Tensor(arr))
+        return _as_arr(out).reshape(())
+
+    grad_f = jax.grad(f)
+    x = _as_arr(initial_position).astype(dtype)
+    n = x.size
+    h = jnp.eye(n, dtype=x.dtype) \
+        if initial_inverse_hessian_estimate is None \
+        else _as_arr(initial_inverse_hessian_estimate)
+    g = grad_f(x)
+    calls = 1
+    converged = False
+    for _ in range(max_iters):
+        if float(jnp.max(jnp.abs(g))) <= tolerance_grad:
+            converged = True
+            break
+        d = -(h @ g)
+        t = _line_search(f, x, d, g)
+        s = t * d
+        x_new = x + s
+        g_new = grad_f(x_new)
+        calls += 2
+        y = g_new - g
+        sy = jnp.dot(s, y)
+        if float(sy) > 1e-10:
+            rho_ = 1.0 / sy
+            eye = jnp.eye(n, dtype=x.dtype)
+            v = eye - rho_ * jnp.outer(s, y)
+            h = v @ h @ v.T + rho_ * jnp.outer(s, s)
+        if float(jnp.max(jnp.abs(s))) <= tolerance_change:
+            x, g = x_new, g_new
+            converged = True
+            break
+        x, g = x_new, g_new
+    return (Tensor(jnp.asarray(converged)), Tensor(jnp.asarray(calls)),
+            Tensor(x), Tensor(f(x)), Tensor(g), Tensor(h))
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-7,
+                   tolerance_change=1e-9,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe", dtype="float32",
+                   name=None):
+    """(parity: incubate.optimizer.functional.minimize_lbfgs). Returns
+    (is_converge, num_func_calls, position, objective_value,
+    objective_gradient)."""
+    def f(arr):
+        out = objective_func(Tensor(arr))
+        return _as_arr(out).reshape(())
+
+    grad_f = jax.grad(f)
+    x = _as_arr(initial_position).astype(dtype)
+    g = grad_f(x)
+    calls = 1
+    s_hist, y_hist, rho_hist = [], [], []
+    converged = False
+    for _ in range(max_iters):
+        if float(jnp.max(jnp.abs(g))) <= tolerance_grad:
+            converged = True
+            break
+        q = -g
+        alphas = []
+        for s, y, r in zip(reversed(s_hist), reversed(y_hist),
+                           reversed(rho_hist)):
+            a = r * jnp.dot(s, q)
+            alphas.append(a)
+            q = q - a * y
+        if y_hist:
+            gamma = jnp.dot(s_hist[-1], y_hist[-1]) / jnp.maximum(
+                jnp.dot(y_hist[-1], y_hist[-1]), 1e-10)
+            q = q * gamma
+        for (s, y, r), a in zip(zip(s_hist, y_hist, rho_hist),
+                                reversed(alphas)):
+            b = r * jnp.dot(y, q)
+            q = q + (a - b) * s
+        d = q
+        t = _line_search(f, x, d, g)
+        s = t * d
+        x_new = x + s
+        g_new = grad_f(x_new)
+        calls += 2
+        y = g_new - g
+        sy = float(jnp.dot(s, y))
+        if sy > 1e-10:
+            s_hist.append(s)
+            y_hist.append(y)
+            rho_hist.append(1.0 / sy)
+            if len(s_hist) > history_size:
+                s_hist.pop(0); y_hist.pop(0); rho_hist.pop(0)
+        if float(jnp.max(jnp.abs(s))) <= tolerance_change:
+            x, g = x_new, g_new
+            converged = True
+            break
+        x, g = x_new, g_new
+    return (Tensor(jnp.asarray(converged)), Tensor(jnp.asarray(calls)),
+            Tensor(x), Tensor(f(x)), Tensor(g))

@@ -1,0 +1,3 @@
+"""(parity: python/paddle/incubate/xpu/ — XPU-only fused blocks; no XPU
+exists on the TPU substrate, the resnet block runs as plain XLA)"""
+from . import resnet_block  # noqa: F401

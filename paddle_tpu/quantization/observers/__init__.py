@@ -1,0 +1,4 @@
+"""(parity: python/paddle/quantization/observers/)"""
+from .. import AbsmaxObserver  # noqa: F401
+
+__all__ = ["AbsmaxObserver"]

@@ -1,0 +1,4 @@
+"""(parity: python/paddle/quantization/quanters/)"""
+from .. import FakeQuanterWithAbsMax as FakeQuanterWithAbsMaxObserver  # noqa: F401
+
+__all__ = ["FakeQuanterWithAbsMaxObserver"]

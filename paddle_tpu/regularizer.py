@@ -1,0 +1,30 @@
+"""paddle.regularizer (parity: python/paddle/regularizer.py — L1/L2
+penalty configs consumed by ParamAttr/optimizers)."""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L1Decay:
+    """(parity: paddle.regularizer.L1Decay)"""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+        self._regularization_coeff = coeff
+
+    def __call__(self, param):
+        from .tensor.math import abs as _abs
+        from .tensor.math import sum as _sum
+        return _sum(_abs(param)) * self.coeff
+
+
+class L2Decay:
+    """(parity: paddle.regularizer.L2Decay)"""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+        self._regularization_coeff = coeff
+
+    def __call__(self, param):
+        from .tensor.math import sum as _sum
+        return _sum(param * param) * (0.5 * self.coeff)

@@ -1,0 +1,4 @@
+"""Path-faithful module (parity: python/paddle/text/viterbi_decode.py)."""
+from . import ViterbiDecoder, viterbi_decode  # noqa: F401
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
