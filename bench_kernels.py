@@ -91,7 +91,8 @@ def main():
         return
 
     from paddle_tpu.ops.pallas.cross_entropy import softmax_xent_pallas
-    from paddle_tpu.ops.pallas.flash_attention import flash_attention_pallas
+    from paddle_tpu.ops.pallas.flash_attention import (
+        flash_attention_ext, flash_attention_pallas, seed_from_key)
     from paddle_tpu.ops.pallas.norms import layer_norm_pallas, rms_norm_pallas
     from paddle_tpu.nn.functional.flash_attention import _attention_xla
 
@@ -119,6 +120,23 @@ def main():
                 q, k, v, None, True, _s, 0.0, None),
             (q, k, v), results,
             iters=3 if S >= 4096 else 5)
+
+    # ---- flash attention with in-kernel dropout (VERDICT r2 #3: the
+    # dropout training config must keep the fast path) --------------------
+    B, S, Hq, Hk, D = 2, 4096, 16, 16, 128
+    q = jnp.asarray(rng.randn(B, S, Hq, D), jnp.bfloat16) * 0.1
+    k = jnp.asarray(rng.randn(B, S, Hk, D), jnp.bfloat16) * 0.1
+    v = jnp.asarray(rng.randn(B, S, Hk, D), jnp.bfloat16) * 0.1
+    seed = seed_from_key(jax.random.key(0))
+    dkey = jax.random.key(0)
+    scale = float(D) ** -0.5
+    bench_pair(
+        "fa_s4k_dropout0.1",
+        lambda q, k, v, _s=scale: flash_attention_ext(
+            q, k, v, None, seed, True, _s, 0.1, 128, 128, False),
+        lambda q, k, v, _s=scale: _attention_xla(
+            q, k, v, None, True, _s, 0.1, dkey),
+        (q, k, v), results, iters=3)
 
     # ---- fused cross-entropy at LM-head shapes --------------------------
     for name, rows, vocab in (("ce_4k_50k", 4096, 50304),

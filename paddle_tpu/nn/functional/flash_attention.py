@@ -57,7 +57,14 @@ def _attention_xla(q, k, v, bias, causal, scale, dropout_p, dropout_key):
         logits = logits + bias.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
     if dropout_p > 0.0 and dropout_key is not None:
-        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        # deterministic (seed, position)-hashed mask shared with the Pallas
+        # kernel (reference (seed, offset) contract, ops.yaml:978-989):
+        # both impls drop the same positions for a given key
+        from ...ops.pallas.flash_attention import (dropout_keep_mask,
+                                                   seed_from_key)
+        B, H, Sq2, Sk2 = probs.shape
+        keep = dropout_keep_mask(seed_from_key(dropout_key), B * H, Sq2,
+                                 Sk2, dropout_p).reshape(B, H, Sq2, Sk2)
         probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)

@@ -3,22 +3,35 @@
 TPU-native equivalent of the reference's dynloaded flash-attn CUDA library
 (paddle/phi/backends/dynload/flashattn.h; call sites
 paddle/phi/kernels/gpu/flash_attn_kernel.cu:91,199). Contract matches the
-reference op (paddle/phi/api/yaml/ops.yaml flash_attn entry): q/k/v are
-[batch, seqlen, num_heads, head_dim]; GQA (kv heads < q heads); causal
+reference op (paddle/phi/api/yaml/ops.yaml:978-989 flash_attn entry): q/k/v
+are [batch, seqlen, num_heads, head_dim]; GQA (kv heads < q heads); causal
 masking uses the (Sk - Sq)-offset diagonal; softmax statistics (lse) are
-produced by the forward pass and consumed by the backward kernels.
+produced by the forward pass and consumed by the backward kernels; dropout
+follows the reference's (seed, offset) determinism contract — the mask is a
+pure function of (seed, batch*head, query index, key index), replayed
+bit-exactly by the backward kernels instead of being stored.
 
 Design (online-softmax, Dao et al. 2022, re-derived for the MXU):
 - forward: grid (batch*heads, q_blocks, k_blocks) with the k dimension
   innermost/sequential ("arbitrary"); VMEM scratch carries the running
   (acc, m, l) across k blocks; causal blocks above the diagonal are skipped
   with pl.when.
-- backward: one kernel for dq (grid like forward), one for dk/dv (grid
-  (batch*heads, k_blocks, q_blocks)); recomputes p from q,k and the saved
-  lse instead of storing the S×S probability matrix.
+- backward: one kernel for dq (+ dbias when bias is given), one for dk/dv
+  (grid (batch*heads, k_blocks, q_blocks)); recomputes p from q,k and the
+  saved lse instead of storing the S×S probability matrix.
 - GQA is expressed in the BlockSpec index maps (kv block index derived from
   the q head index), so kv tensors are never materialised per-q-head in the
   forward; backward produces per-q-head dk/dv then sums the head groups.
+- dropout: the keep-mask is a murmur3-finalizer hash of the global (row,
+  col) element index mixed with a per-(batch*head) seed — plain int32
+  vector ops, so the identical mask is produced by the compiled Mosaic
+  kernel, interpret mode, and the XLA fallback (which shares
+  ``dropout_keep_mask`` below); softmax statistics (l, lse) are computed
+  from the *undropped* probabilities, dropout scales only the value
+  accumulation, matching dropout-after-softmax semantics.
+- additive bias (attn_mask) broadcastable over batch/head/query dims rides
+  in as an extra block input; its gradient is emitted by the dq kernel and
+  sum-reduced onto the broadcast shape.
 """
 from __future__ import annotations
 
@@ -37,7 +50,8 @@ from ...core.dispatch import register_op_impl
 from .common import _Z
 
 
-__all__ = ["flash_attention_pallas"]
+__all__ = ["flash_attention_pallas", "flash_attention_ext",
+           "dropout_keep_mask", "seed_from_key"]
 
 _NEG_INF = float("-inf")
 _LANES = 128
@@ -54,14 +68,85 @@ def _kv_index(bh, hq, hk):
 
 
 # ---------------------------------------------------------------------------
+# deterministic dropout mask (shared by the kernels, the XLA fallback, and
+# the parity tests — the TPU analog of the reference's (seed, offset) pairs)
+# ---------------------------------------------------------------------------
+
+def _dropout_thresh(rate: float) -> np.uint32:
+    """keep iff hash >= thresh, so P(drop) == rate."""
+    return np.uint32(min(int(float(rate) * 2 ** 32), 2 ** 32 - 1))
+
+
+def _mix_seed(seed, bh):
+    """Per-(batch*head) 32-bit seed: murmur-style avalanche of seed ^ bh."""
+    h = seed.astype(jnp.uint32) ^ (jnp.uint32(bh) * np.uint32(0x9E3779B1))
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> 7)
+    h = h * np.uint32(0xC2B2AE35)
+    h = h ^ (h >> 15)
+    return h
+
+
+def _keep_block(seed_bh, q_start, k_start, bq, bk, sk, thresh):
+    """(bq, bk) bool keep-mask for the block at (q_start, k_start).
+
+    The hash input is the *global* element index row * Sk + col with the
+    real (unpadded) Sk stride — padded key columns hash to colliding
+    indices, but those positions are masked out by the sk_real check before
+    they ever matter."""
+    rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    h = (rows * np.int32(sk) + cols).astype(jnp.uint32) ^ seed_bh
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * np.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h >= thresh
+
+
+def seed_from_key(key) -> jax.Array:
+    """Fold a jax PRNG key (typed or raw uint32 pair) to the (1,)-shaped
+    int32 seed the kernels consume."""
+    if jnp.issubdtype(getattr(key, "dtype", None), jax.dtypes.prng_key):
+        data = jax.random.key_data(key)
+    else:
+        data = jnp.asarray(key)
+    data = data.astype(jnp.uint32).reshape(-1)
+    folded = data[0]
+    for i in range(1, int(data.shape[0])):
+        folded = folded ^ data[i]
+    return folded.astype(jnp.int32).reshape(1)
+
+
+def dropout_keep_mask(seed, bh_total, sq, sk, rate):
+    """Full (BH, Sq, Sk) keep-mask — the exact mask the kernels generate,
+    computed with plain XLA ops. Used by the XLA fallback (so both impls
+    drop the same positions for a given seed) and by the parity tests."""
+    thresh = _dropout_thresh(rate)
+    seed = jnp.asarray(seed).reshape(-1)[0]
+
+    def one(bh):
+        return _keep_block(_mix_seed(seed, bh), 0, 0, sq, sk, sk, thresh)
+    return jax.vmap(one)(jnp.arange(bh_total, dtype=jnp.int32))
+
+
+# ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                scale, causal, offset, bq, bk, nk, sk_real):
+def _fwd_kernel(*refs, scale, causal, offset, bq, bk, nk, sk_real, has_bias,
+                rate):
     scale = np.float32(scale)  # strong f64 scalars poison Mosaic under x64
-    ki = pl.program_id(2)
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    bias_ref = next(it) if has_bias else None
+    seed_ref = next(it) if rate > 0.0 else None
+    o_ref, lse_ref = next(it), next(it)
+    acc_ref, m_ref, l_ref = next(it), next(it), next(it)
+
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
+    ki = pl.program_id(2)
     q_start = qi * bq
     k_start = ki * bk
 
@@ -83,6 +168,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         k = k_ref[0].astype(jnp.float32)                         # (bk, d)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         kidx = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = kidx < sk_real                                    # pad keys off
         if causal:
@@ -98,11 +185,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         m_safe = jnp.where(m_new == _NEG_INF, 0.0, m_new)
         alpha = jnp.exp(m_prev - m_safe)                         # (bq, LANES)
         p = jnp.exp(s - m_safe[:, :1])                           # (bq, bk)
+        # l and lse come from the UNDROPPED probabilities (dropout applies
+        # after softmax); only the value accumulation sees the mask
         l_ref[...] = alpha * l_ref[...] + jnp.broadcast_to(
             jnp.sum(p, axis=1, keepdims=True), m_prev.shape)
+        if rate > 0.0:
+            keep = _keep_block(_mix_seed(seed_ref[0], bh), q_start, k_start,
+                               bq, bk, sk_real, _dropout_thresh(rate))
+            p_v = jnp.where(keep, p * np.float32(1.0 / (1.0 - rate)), 0.0)
+        else:
+            p_v = p
         v = v_ref[0].astype(jnp.float32)                         # (bk, d)
         acc_ref[...] = acc_ref[...] * alpha[:, :1] + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p_v, v, preferred_element_type=jnp.float32)
         m_ref[...] = m_new
 
     @pl.when(ki == nk - 1)
@@ -119,26 +214,38 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
                                _NEG_INF)
 
 
-def _fwd(q3, k3, v3, hq, hk, causal, scale, offset, sk_real, bq, bk,
-         interpret):
-    """q3: (B*Hq, Sq, D) padded; k3/v3: (B*Hk, Sk, D) padded."""
+def _fwd(q3, k3, v3, bias3, seed, hq, hk, causal, scale, offset, sk_real,
+         bq, bk, bias_maps, interpret):
+    """q3: (B*Hq, Sq, D) padded; k3/v3: (B*Hk, Sk, D) padded; bias3:
+    (Bb*Hb, Sqb, Sk_pad) or None; seed: (1,) i32 or None."""
     bhq, sq, d = q3.shape
     sk = k3.shape[1]
     nq, nk = sq // bq, sk // bk
     grid = (bhq, nq, nk)
     kv_map = functools.partial(_kv_index, hq=hq, hk=hk)
+    has_bias = bias3 is not None
+
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _Z)),
+        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (kv_map(bh), ki, _Z)),
+        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (kv_map(bh), ki, _Z)),
+    ]
+    args = [q3, k3, v3]
+    if has_bias:
+        in_specs.append(_bias_spec(bias_maps, bq, bk))
+        args.append(bias3)
+    if seed is not None:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, offset=offset,
-        bq=bq, bk=bk, nk=nk, sk_real=sk_real)
+        bq=bq, bk=bk, nk=nk, sk_real=sk_real, has_bias=has_bias,
+        rate=bias_maps["rate"])
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _Z)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (kv_map(bh), ki, _Z)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (kv_map(bh), ki, _Z)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _Z)),
             pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, _Z)),
@@ -155,24 +262,105 @@ def _fwd(q3, k3, v3, hq, hk, causal, scale, offset, sk_real, bq, bk,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q3, k3, v3)
+    )(*args)
     return out, lse[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# bias plumbing: (B?, H?, Sq?, Sk) broadcastable bias -> flattened 3-D block
+# input whose index map collapses broadcast dims
+# ---------------------------------------------------------------------------
+
+def _bias_shape4(bias):
+    return (1,) * (4 - jnp.asarray(bias).ndim) + tuple(
+        jnp.asarray(bias).shape)
+
+
+def bias_supported(bias, B, Hq, Sq, Sk) -> bool:
+    """Single source of truth for which bias layouts the kernels take:
+    broadcastable to (B, Hq, Sq, Sk) with the Sk dim full."""
+    Bb, Hb, Sqb, Skb = _bias_shape4(bias)
+    return (Skb == Sk and Sqb in (1, Sq) and Bb in (1, B)
+            and Hb in (1, Hq))
+
+
+def _prep_bias(bias, B, Hq, Sq, Sk, bq, bk):
+    """Normalise bias to (Bb*Hb, Sqb_pad, Sk_pad) + static map info.
+
+    Supports any bias broadcastable to (B, Hq, Sq, Sk) where the Sk dim is
+    full (singleton batch/head/query dims stay singleton — never
+    materialised)."""
+    if not bias_supported(bias, B, Hq, Sq, Sk):
+        raise ValueError(f"bias shape {bias.shape} not broadcastable to "
+                         f"({B},{Hq},{Sq},{Sk}) with full Sk")
+    b4 = jnp.asarray(bias)
+    while b4.ndim < 4:
+        b4 = b4[None]
+    Bb, Hb, Sqb, Skb = b4.shape
+    b3 = b4.reshape(Bb * Hb, Sqb, Skb)
+    pad_k = (-Skb) % bk
+    pad_q = 0 if Sqb == 1 else (-Sqb) % bq
+    if pad_k or pad_q:
+        b3 = jnp.pad(b3, ((0, 0), (0, pad_q), (0, pad_k)))
+    # full == dbias can be emitted tile-per-tile by the dq kernel with no
+    # memory amplification; anything broadcast goes through the bounded
+    # recompute path in _fa_bwd instead
+    full = (Bb == B and Hb == Hq and Sqb == Sq)
+    return b3, {"Bb": Bb, "Hb": Hb, "Sqb": Sqb, "B": B, "Hq": Hq,
+                "full": full}
+
+
+def _bias_row(maps, bh):
+    Bb, Hb, Hq = maps["Bb"], maps["Hb"], maps["Hq"]
+    b = bh // np.int32(Hq)
+    h = bh % np.int32(Hq)
+    return (b if Bb > 1 else np.int32(0)) * np.int32(Hb) + \
+        (h if Hb > 1 else np.int32(0))
+
+
+def _bias_spec(maps, bq, bk, kq_grid=False):
+    """Bias block spec; ``kq_grid`` flips the (qi, ki) grid-arg order for
+    the dkv kernel's (bh, ki, qi) grid."""
+    Sqb = maps["Sqb"]
+    bq_eff = 1 if Sqb == 1 else bq
+
+    def idx(bh, a, b):
+        qi, ki = (b, a) if kq_grid else (a, b)
+        return (_bias_row(maps, bh),
+                np.int32(0) if Sqb == 1 else qi, ki)
+    return pl.BlockSpec((1, bq_eff, bk), idx)
 
 
 # ---------------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------------
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, scale, causal, offset, bq, bk, nk, sk_real):
+def _dq_kernel(*refs, scale, causal, offset, bq, bk, nk, sk_real, has_bias,
+               emit_dbias, rate):
     scale = np.float32(scale)  # strong f64 scalars poison Mosaic under x64
-    ki = pl.program_id(2)
+    it = iter(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (
+        next(it), next(it), next(it), next(it), next(it), next(it))
+    bias_ref = next(it) if has_bias else None
+    seed_ref = next(it) if rate > 0.0 else None
+    dq_ref = next(it)
+    dbias_ref = next(it) if emit_dbias else None
+    dq_acc = next(it)
+
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
+    ki = pl.program_id(2)
     q_start, k_start = qi * bq, ki * bk
 
     @pl.when(ki == 0)
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    if emit_dbias:
+        # every (qi, ki) block owns exactly one dbias tile; causally-skipped
+        # tiles must still be written (zeros), so zero first and let _body
+        # overwrite
+        dbias_ref[0] = jnp.zeros_like(dbias_ref[0])
 
     run = True
     if causal:
@@ -188,6 +376,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         lse_safe = jnp.where(lse == _NEG_INF, 0.0, lse)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         kidx = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = kidx < sk_real
         if causal:
@@ -197,7 +387,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse_safe)                               # (bq, bk)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if rate > 0.0:
+            keep = _keep_block(_mix_seed(seed_ref[0], bh), q_start, k_start,
+                               bq, bk, sk_real, _dropout_thresh(rate))
+            dp = jnp.where(keep, dp * np.float32(1.0 / (1.0 - rate)), 0.0)
         ds = p * (dp - delta_ref[0])                            # (bq, bk)
+        if emit_dbias:
+            dbias_ref[0] = ds.astype(dbias_ref.dtype)
         dq_acc[...] += jax.lax.dot(ds, k,
                                    preferred_element_type=jnp.float32) * scale
 
@@ -206,12 +402,20 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                dv_ref, dk_acc, dv_acc, *, scale, causal, offset, bq, bk, nq,
-                sk_real):
+def _dkv_kernel(*refs, scale, causal, offset, bq, bk, nq, sk_real, has_bias,
+                rate):
     scale = np.float32(scale)  # strong f64 scalars poison Mosaic under x64
-    qi = pl.program_id(2)
+    it = iter(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (
+        next(it), next(it), next(it), next(it), next(it), next(it))
+    bias_ref = next(it) if has_bias else None
+    seed_ref = next(it) if rate > 0.0 else None
+    dk_ref, dv_ref = next(it), next(it)
+    dk_acc, dv_acc = next(it), next(it)
+
+    bh = pl.program_id(0)
     ki = pl.program_id(1)
+    qi = pl.program_id(2)
     q_start, k_start = qi * bq, ki * bk
 
     @pl.when(qi == 0)
@@ -234,6 +438,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         lse_safe = jnp.where(lse == _NEG_INF, 0.0, lse)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         kidx = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = kidx < sk_real
         if causal:
@@ -241,11 +447,20 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
             mask = mask & (kidx <= qidx + offset)
         s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse_safe)                               # (bq, bk)
+        if rate > 0.0:
+            keep = _keep_block(_mix_seed(seed_ref[0], bh), q_start, k_start,
+                               bq, bk, sk_real, _dropout_thresh(rate))
+            inv = np.float32(1.0 / (1.0 - rate))
+            p_v = jnp.where(keep, p * inv, 0.0)
+        else:
+            p_v = p
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_v, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                  # (bk, d)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if rate > 0.0:
+            dp = jnp.where(keep, dp * np.float32(1.0 / (1.0 - rate)), 0.0)
         ds = p * (dp - delta_ref[0])
         # q was pre-scaled on load, so dk = ds^T @ (scale*q) needs no extra
         # scale factor
@@ -259,51 +474,92 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bwd_impl(q3, kx, vx, do3, lse, delta, causal, scale, offset, sk_real,
-              bq, bk, interpret):
+def _bwd_impl(q3, kx, vx, do3, lse, delta, bias3, seed, causal, scale,
+              offset, sk_real, bq, bk, bias_maps, interpret):
     """All inputs per-q-head flattened: q3/do3 (BHq, Sq, D); kx/vx already
-    expanded to (BHq, Sk, D). Returns (dq, dk, dv) per q head."""
+    expanded to (BHq, Sk, D). Returns (dq, dk, dv, dbias_blocks)."""
     bhq, sq, d = q3.shape
     sk = kx.shape[1]
     nq, nk = sq // bq, sk // bk
     lse3 = lse[..., None]                                   # (bhq, sq, 1)
     delta3 = delta[..., None]
+    has_bias = bias3 is not None
+    # in-kernel dbias tiles only when bias is full per-(batch, head): then
+    # the output is exactly bias-sized. Broadcast biases would amplify to
+    # (B*Hq, Sq, Sk) — they take the bounded recompute path in _fa_bwd.
+    emit_dbias = has_bias and bias_maps["full"]
+    rate = bias_maps["rate"]
+
+    base_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _Z)),
+        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, _Z)),
+        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, _Z)),
+        pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _Z)),
+        pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, _Z)),
+        pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, _Z)),
+    ]
+    args = [q3, kx, vx, do3, lse3, delta3]
+    in_specs = list(base_specs)
+    if has_bias:
+        in_specs.append(_bias_spec(bias_maps, bq, bk))
+        args.append(bias3)
+    if rate > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+
+    dq_out_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _Z))]
+    dq_out_shape = [jax.ShapeDtypeStruct((bhq, sq, d), q3.dtype)]
+    if emit_dbias:
+        dq_out_specs.append(
+            pl.BlockSpec((1, bq, bk), lambda bh, qi, ki: (bh, qi, ki)))
+        dq_out_shape.append(
+            jax.ShapeDtypeStruct((bhq, sq, sk), jnp.float32))
 
     scratch = [pltpu.VMEM((bq, d), jnp.float32)]
-    dq = pl.pallas_call(
+    dq_outs = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          offset=offset, bq=bq, bk=bk, nk=nk, sk_real=sk_real),
+                          offset=offset, bq=bq, bk=bk, nk=nk,
+                          sk_real=sk_real, has_bias=has_bias,
+                          emit_dbias=emit_dbias, rate=rate),
         grid=(bhq, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _Z)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, _Z)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, _Z)),
-            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _Z)),
-            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, _Z)),
-            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, _Z)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _Z)),
-        out_shape=jax.ShapeDtypeStruct((bhq, sq, d), q3.dtype),
+        in_specs=in_specs,
+        out_specs=dq_out_specs if emit_dbias else dq_out_specs[0],
+        out_shape=dq_out_shape if emit_dbias else dq_out_shape[0],
         scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q3, kx, vx, do3, lse3, delta3)
+    )(*args)
+    if emit_dbias:
+        dq, dbias_blocks = dq_outs
+    else:
+        dq, dbias_blocks = dq_outs, None
+
+    kq_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, _Z)),
+        pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, _Z)),
+        pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, _Z)),
+        pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, _Z)),
+        pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, _Z)),
+        pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, _Z)),
+    ]
+    kq_args = [q3, kx, vx, do3, lse3, delta3]
+    if has_bias:
+        kq_specs.append(_bias_spec(bias_maps, bq, bk, kq_grid=True))
+        kq_args.append(bias3)
+    if rate > 0.0:
+        kq_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        kq_args.append(seed)
 
     scratch2 = [pltpu.VMEM((bk, d), jnp.float32),
                 pltpu.VMEM((bk, d), jnp.float32)]
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          offset=offset, bq=bq, bk=bk, nq=nq, sk_real=sk_real),
+                          offset=offset, bq=bq, bk=bk, nq=nq,
+                          sk_real=sk_real, has_bias=has_bias, rate=rate),
         grid=(bhq, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, _Z)),
-            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, _Z)),
-            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, _Z)),
-            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, _Z)),
-            pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, _Z)),
-            pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, _Z)),
-        ],
+        in_specs=kq_specs,
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, _Z)),
             pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, _Z)),
@@ -316,13 +572,61 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, causal, scale, offset, sk_real,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q3, kx, vx, do3, lse3, delta3)
-    return dq, dk, dv
+    )(*kq_args)
+    return dq, dk, dv, dbias_blocks
 
 
 # ---------------------------------------------------------------------------
 # custom_vjp wrapper in the reference layout [B, S, H, D]
 # ---------------------------------------------------------------------------
+
+def _dbias_broadcast(q3, kx, vx, do3, lse_p, delta, bias3, seed, maps,
+                     causal, scale, offset, sk_real, Sq, Sk):
+    """Memory-bounded dbias for broadcast bias shapes: recompute ds one
+    (batch*head) row at a time with a sequential fori_loop, accumulating
+    straight into the reduced (Bb*Hb, Sqb, Sk) buffer — peak extra memory
+    is one (Sq_pad, Sk_pad) matrix, never (B*Hq, Sq, Sk)."""
+    bhq, sq_pad, d = q3.shape
+    sk_pad = kx.shape[1]
+    Hq, Sqb = maps["Hq"], maps["Sqb"]
+    rate = maps["rate"]
+    acc0 = jnp.zeros((bias3.shape[0], bias3.shape[1], sk_pad), jnp.float32)
+
+    def body(bh, acc):
+        qb = jax.lax.dynamic_index_in_dim(q3, bh, 0, keepdims=False)
+        kb = jax.lax.dynamic_index_in_dim(kx, bh, 0, keepdims=False)
+        vb = jax.lax.dynamic_index_in_dim(vx, bh, 0, keepdims=False)
+        dob = jax.lax.dynamic_index_in_dim(do3, bh, 0, keepdims=False)
+        lse_b = jax.lax.dynamic_index_in_dim(lse_p, bh, 0, keepdims=False)
+        delta_b = jax.lax.dynamic_index_in_dim(delta, bh, 0, keepdims=False)
+        bias_b = jax.lax.dynamic_index_in_dim(
+            bias3, _bias_row(maps, bh), 0, keepdims=False)
+        s = jnp.dot(qb.astype(jnp.float32) * np.float32(scale),
+                    kb.astype(jnp.float32).T,
+                    preferred_element_type=jnp.float32)
+        s = s + bias_b.astype(jnp.float32)
+        kidx = jax.lax.broadcasted_iota(jnp.int32, (sq_pad, sk_pad), 1)
+        mask = kidx < sk_real
+        if causal:
+            qidx = jax.lax.broadcasted_iota(jnp.int32, (sq_pad, sk_pad), 0)
+            mask = mask & (kidx <= qidx + offset)
+        s = jnp.where(mask, s, _NEG_INF)
+        lse_safe = jnp.where(lse_b == _NEG_INF, 0.0, lse_b)
+        p = jnp.exp(s - lse_safe[:, None])
+        dp = jnp.dot(dob.astype(jnp.float32), vb.astype(jnp.float32).T,
+                     preferred_element_type=jnp.float32)
+        if rate > 0.0:
+            keep = _keep_block(_mix_seed(seed[0], bh), 0, 0, sq_pad, sk_pad,
+                               sk_real, _dropout_thresh(rate))
+            dp = jnp.where(keep, dp * np.float32(1.0 / (1.0 - rate)), 0.0)
+        ds = p * (dp - delta_b[:, None])
+        red = ds[:bias3.shape[1]] if Sqb != 1 else \
+            jnp.sum(ds, axis=0, keepdims=True)
+        return acc.at[_bias_row(maps, bh)].add(red)
+
+    acc = jax.lax.fori_loop(0, bhq, body, acc0)
+    return acc[:, :, :Sk]
+
 
 def _pick_block(s, target=128):
     b = min(target, s)
@@ -337,35 +641,55 @@ def _pad_seq(x3, block):
     return x3
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def flash_attention_pallas(q, k, v, causal, scale, interpret):
-    """q [B,Sq,Hq,D], k/v [B,Sk,Hk,D] -> out [B,Sq,Hq,D]."""
-    out, _ = _fa_fwd(q, k, v, causal, scale, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def flash_attention_ext(q, k, v, bias, seed, causal, scale, dropout_rate,
+                        block_q, block_k, interpret):
+    """Full-contract flash attention: q [B,Sq,Hq,D], k/v [B,Sk,Hk,D],
+    optional additive ``bias`` broadcastable to [B,Hq,Sq,Sk] (full Sk dim),
+    deterministic dropout driven by ``seed`` ((1,) int32; see
+    ``dropout_keep_mask``). Returns out [B,Sq,Hq,D]."""
+    out, _ = _fa_fwd(q, k, v, bias, seed, causal, scale, dropout_rate,
+                     block_q, block_k, interpret)
     return out
 
 
-def _fa_fwd(q, k, v, causal, scale, interpret):
+def _fa_fwd(q, k, v, bias, seed, causal, scale, dropout_rate, block_q,
+            block_k, interpret):
     B, Sq, Hq, D = q.shape
     Sk, Hk = k.shape[1], k.shape[2]
-    bq, bk = _pick_block(Sq), _pick_block(Sk)
+    bq, bk = _pick_block(Sq, block_q), _pick_block(Sk, block_k)
     offset = Sk - Sq
 
     q3 = _pad_seq(q.transpose(0, 2, 1, 3).reshape(B * Hq, Sq, D), bq)
     k3 = _pad_seq(k.transpose(0, 2, 1, 3).reshape(B * Hk, Sk, D), bk)
     v3 = _pad_seq(v.transpose(0, 2, 1, 3).reshape(B * Hk, Sk, D), bk)
 
-    out3, lse = _fwd(q3, k3, v3, Hq, Hk, causal, scale, offset, Sk, bq, bk,
-                     interpret)
+    if bias is not None:
+        bias3, maps = _prep_bias(bias, B, Hq, Sq, Sk, bq, bk)
+    else:
+        bias3, maps = None, {}
+    maps = dict(maps, rate=float(dropout_rate))
+    if dropout_rate > 0.0:
+        if seed is None:
+            raise ValueError("flash_attention_ext: seed is required when "
+                             "dropout_rate > 0")
+        seed_in = seed
+    else:
+        seed_in = None
+
+    out3, lse = _fwd(q3, k3, v3, bias3, seed_in, Hq, Hk, causal, scale,
+                     offset, Sk, bq, bk, maps, interpret)
     out = out3[:, :Sq].reshape(B, Hq, Sq, D).transpose(0, 2, 1, 3)
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, bias, seed, out, lse)
 
 
-def _fa_bwd(causal, scale, interpret, res, dout):
-    q, k, v, out, lse = res
+def _fa_bwd(causal, scale, dropout_rate, block_q, block_k, interpret, res,
+            dout):
+    q, k, v, bias, seed, out, lse = res
     B, Sq, Hq, D = q.shape
     Sk, Hk = k.shape[1], k.shape[2]
     rep = Hq // Hk
-    bq, bk = _pick_block(Sq), _pick_block(Sk)
+    bq, bk = _pick_block(Sq, block_q), _pick_block(Sk, block_k)
     offset = Sk - Sq
 
     q3 = _pad_seq(q.transpose(0, 2, 1, 3).reshape(B * Hq, Sq, D), bq)
@@ -392,8 +716,22 @@ def _fa_bwd(causal, scale, interpret, res, dout):
     else:
         lse_p = lse[:, :Sq]
 
-    dq3, dk3, dv3 = _bwd_impl(q3, kx, vx, do3, lse_p, delta, causal, scale,
-                              offset, Sk, bq, bk, interpret)
+    if bias is not None:
+        bias3, maps = _prep_bias(bias, B, Hq, Sq, Sk, bq, bk)
+    else:
+        bias3, maps = None, {}
+    maps = dict(maps, rate=float(dropout_rate))
+    if dropout_rate > 0.0:
+        if seed is None:
+            raise ValueError("flash_attention_ext: seed is required when "
+                             "dropout_rate > 0")
+        seed_in = seed
+    else:
+        seed_in = None
+
+    dq3, dk3, dv3, dbias_blocks = _bwd_impl(
+        q3, kx, vx, do3, lse_p, delta, bias3, seed_in, causal, scale,
+        offset, Sk, bq, bk, maps, interpret)
     dq = dq3[:, :Sq].reshape(B, Hq, Sq, D).transpose(0, 2, 1, 3)
     dk4 = dk3[:, :Sk].reshape(B, Hq, Sk, D)
     dv4 = dv3[:, :Sk].reshape(B, Hq, Sk, D)
@@ -402,10 +740,33 @@ def _fa_bwd(causal, scale, interpret, res, dout):
         dv4 = dv4.reshape(B, Hk, rep, Sk, D).sum(axis=2)
     dk = dk4.transpose(0, 2, 1, 3).astype(k.dtype)
     dv = dv4.transpose(0, 2, 1, 3).astype(v.dtype)
-    return dq.astype(q.dtype), dk, dv
+
+    if bias is None:
+        dbias = None
+    elif dbias_blocks is not None:
+        # full-shape bias: (BHq, Sq_pad, Sk_pad) in-kernel tiles == dbias
+        dbias = dbias_blocks[:, :Sq, :Sk].reshape(B, Hq, Sq, Sk) \
+            .reshape(jnp.asarray(bias).shape).astype(bias.dtype)
+    else:
+        # broadcast bias: memory-bounded sequential recompute
+        db3 = _dbias_broadcast(q3, kx, vx, do3, lse_p, delta, bias3,
+                               seed_in, maps, causal, scale, offset, Sk,
+                               Sq, Sk)
+        dbias = db3[:, :maps["Sqb"]].reshape(
+            jnp.asarray(bias).shape).astype(bias.dtype)
+    dseed = np.zeros(np.shape(seed), jax.dtypes.float0)
+    return dq.astype(q.dtype), dk, dv, dbias, dseed
 
 
-flash_attention_pallas.defvjp(_fa_fwd, _fa_bwd)
+flash_attention_ext.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention_pallas(q, k, v, causal, scale, interpret,
+                           block_q=128, block_k=128):
+    """Bias-free, dropout-free fast path (back-compat signature)."""
+    return flash_attention_ext(q, k, v, None, jnp.zeros((1,), jnp.int32),
+                               causal, scale, 0.0, block_q, block_k,
+                               interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -414,8 +775,12 @@ flash_attention_pallas.defvjp(_fa_fwd, _fa_bwd)
 
 @register_op_impl("flash_attention", "pallas")
 def _attention_pallas(q, k, v, bias, causal, scale, dropout_p, dropout_key):
-    """Pallas path for the bias-free, dropout-free case (the training hot
-    path); everything else falls back to the XLA reference impl."""
+    """Pallas path for the training hot path, now including attention
+    dropout and additive bias in-kernel (reference contract
+    paddle/phi/api/yaml/ops.yaml:978-989); falls back to the XLA reference
+    impl only for head_dim > 256, short sequences (XLA's fused attention
+    wins below ~2k kv length, measured on v5e), unsupported bias layouts,
+    or CPU interpret mode."""
     from ...nn.functional.flash_attention import _attention_xla
     on_tpu = jax.default_backend() == "tpu"
     interpret = not on_tpu
@@ -425,11 +790,16 @@ def _attention_pallas(q, k, v, bias, causal, scale, dropout_p, dropout_key):
     # shape, like the reference's kernel autotune cache
     # (paddle/phi/kernels/autotune/)
     min_seq = int(_flags.get_flag("pallas_flash_min_seq"))
-    if (bias is not None or (dropout_p and dropout_p > 0.0)
-            or q.shape[-1] > 256
+    rate = float(dropout_p or 0.0)
+    bias_ok = bias is None or bias_supported(
+        bias, q.shape[0], q.shape[2], q.shape[1], k.shape[1])
+    if (not bias_ok or q.shape[-1] > 256
+            or (rate > 0.0 and dropout_key is None)
             or (on_tpu and k.shape[1] < min_seq)
             or (interpret and not _flags.get_flag("pallas_force_interpret"))):
         return _attention_xla(q, k, v, bias, causal, scale, dropout_p,
                               dropout_key)
-    return flash_attention_pallas(q, k, v, bool(causal), float(scale),
-                                  interpret)
+    seed = seed_from_key(dropout_key) if rate > 0.0 \
+        else jnp.zeros((1,), jnp.int32)
+    return flash_attention_ext(q, k, v, bias, seed, bool(causal),
+                               float(scale), rate, 128, 128, interpret)

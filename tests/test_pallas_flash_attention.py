@@ -103,3 +103,145 @@ def test_dispatch_uses_pallas_under_flag():
         _flags.set_flags({"pallas_force_interpret": False})
     np.testing.assert_allclose(np.asarray(out.numpy()), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# in-kernel dropout + additive bias (reference contract ops.yaml:978-989:
+# dropout with deterministic (seed, offset)-style replay; attn_mask bias)
+# ---------------------------------------------------------------------------
+from paddle_tpu.ops.pallas.flash_attention import (  # noqa: E402
+    dropout_keep_mask, flash_attention_ext, seed_from_key)
+
+_SEED0 = jnp.zeros((1,), jnp.int32)
+
+
+def _dense_oracle(q, k, v, scale, bias=None, keep=None, rate=0.0,
+                  causal=True):
+    hq, hk = q.shape[2], k.shape[2]
+    if hq != hk:
+        k = jnp.repeat(k, hq // hk, axis=2)
+        v = jnp.repeat(v, hq // hk, axis=2)
+    sq, sk = q.shape[1], k.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        m = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(m, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    if keep is not None:
+        p = jnp.where(keep, p / (1.0 - rate), 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+
+
+@pytest.mark.parametrize("bshape", [
+    (2, 4, 256, 256),   # full
+    (1, 4, 256, 256),   # broadcast batch
+    (2, 1, 1, 256),     # broadcast head + query (additive key mask)
+    (256, 256),         # 2-D mask
+])
+def test_bias_in_kernel(bshape):
+    q, k, v = _mk(2, 256, 256, 4, 2, 64, seed=3)
+    scale = 1.0 / math.sqrt(64)
+    rng = np.random.RandomState(4)
+    bias = jnp.asarray(rng.standard_normal(bshape), jnp.float32) * 0.5
+    out = flash_attention_ext(q, k, v, bias, _SEED0, True, scale, 0.0,
+                              128, 128, True)
+    ref = _dense_oracle(q, k, v, scale, bias=bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-5, atol=3e-5)
+    # grads incl. dbias reduced onto the broadcast shape
+    g = jax.grad(lambda q, b: flash_attention_ext(
+        q, k, v, b, _SEED0, True, scale, 0.0, 128, 128, True).sum(),
+        (0, 1))(q, bias)
+    ge = jax.grad(lambda q, b: _dense_oracle(
+        q, k, v, scale, bias=b).sum(), (0, 1))(q, bias)
+    np.testing.assert_allclose(np.asarray(g[0]), np.asarray(ge[0]),
+                               rtol=3e-4, atol=3e-4)
+    assert g[1].shape == bias.shape
+    np.testing.assert_allclose(np.asarray(g[1]), np.asarray(ge[1]),
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_dropout_exact_mask_replay():
+    """The kernel's dropout is a pure function of (seed, position):
+    dropout_keep_mask reproduces it exactly, so a dense oracle using that
+    mask must match the kernel bit-for-bit in fwd AND bwd (the mask is
+    regenerated, not stored, by the backward kernels)."""
+    b, s, hq, hk, d = 2, 256, 4, 2, 64
+    q, k, v = _mk(b, s, s, hq, hk, d, seed=5)
+    scale = 1.0 / math.sqrt(d)
+    rate = 0.1
+    seed = seed_from_key(jax.random.key(42))
+    keep = dropout_keep_mask(seed, b * hq, s, s, rate).reshape(b, hq, s, s)
+    # drop fraction matches the rate
+    assert abs(float(keep.mean()) - (1.0 - rate)) < 0.01
+
+    out = flash_attention_ext(q, k, v, None, seed, True, scale, rate,
+                              128, 128, True)
+    ref = _dense_oracle(q, k, v, scale, keep=keep, rate=rate)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    g = jax.grad(lambda q, k, v: flash_attention_ext(
+        q, k, v, None, seed, True, scale, rate, 128, 128, True).sum(),
+        (0, 1, 2))(q, k, v)
+    ge = jax.grad(lambda q, k, v: _dense_oracle(
+        q, k, v, scale, keep=keep, rate=rate).sum(), (0, 1, 2))(q, k, v)
+    for a, e in zip(g, ge):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=3e-4, atol=3e-4)
+
+
+def test_dropout_matches_xla_fallback():
+    """The XLA fallback shares dropout_keep_mask, so for the same key the
+    two impls produce identical outputs — dropout no longer forces a
+    strategy change in numerics."""
+    q, k, v = _mk(1, 128, 128, 2, 2, 32, seed=6)
+    scale = 1.0 / math.sqrt(32)
+    key = jax.random.key(7)
+    ref = _attention_xla(q, k, v, None, True, scale, 0.1, key)
+    out = flash_attention_ext(q, k, v, None, seed_from_key(key), True,
+                              scale, 0.1, 128, 128, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_dropout_bias_jit_and_seed_sensitivity():
+    q, k, v = _mk(1, 128, 128, 2, 2, 32, seed=8)
+    scale = 1.0 / math.sqrt(32)
+    rng = np.random.RandomState(9)
+    bias = jnp.asarray(rng.standard_normal((1, 2, 128, 128)),
+                       jnp.float32) * 0.5
+    f = jax.jit(lambda q, k, v, b, s: flash_attention_ext(
+        q, k, v, b, s, False, scale, 0.2, 128, 128, True))
+    s1 = seed_from_key(jax.random.key(1))
+    s2 = seed_from_key(jax.random.key(2))
+    o1, o1b, o2 = f(q, k, v, bias, s1), f(q, k, v, bias, s1), \
+        f(q, k, v, bias, s2)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o1b))
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
+
+
+def test_dispatch_dropout_keeps_pallas_path():
+    """VERDICT r2 #3: dropout_p > 0 must no longer fall back to the XLA
+    path — the registry impl routes it into the Pallas kernel."""
+    from paddle_tpu.ops.pallas.flash_attention import _attention_pallas
+    import paddle_tpu.ops.pallas.flash_attention as fa_mod
+    q, k, v = _mk(1, 128, 128, 2, 2, 32, seed=10)
+    called = {}
+    orig = fa_mod.flash_attention_ext
+
+    def spy(*args, **kw):
+        called["ext"] = True
+        return orig(*args, **kw)
+    fa_mod.flash_attention_ext = spy
+    _flags.set_flags({"pallas_force_interpret": True})
+    try:
+        _attention_pallas(q, k, v, None, True, 1.0 / math.sqrt(32), 0.1,
+                          jax.random.key(3))
+    finally:
+        _flags.set_flags({"pallas_force_interpret": False})
+        fa_mod.flash_attention_ext = orig
+    assert called.get("ext"), "dropout call fell back off the Pallas path"
