@@ -239,7 +239,7 @@ def _tied_decoder_forward(shared_embed: _EmbeddingPipe, h):
 
 
 def bert_pipeline_model(config: BertConfig, num_stages: int,
-                        loss_fn=None):
+                        loss_fn=None, **pipeline_kwargs):
     """Build BERT-for-MLM as a PipelineLayer (flat LayerDesc list with the
     embedding shared between stage 0 and the LM head on the last stage)."""
     from ..distributed.fleet.meta_parallel.parallel_layers import (
@@ -263,7 +263,8 @@ def bert_pipeline_model(config: BertConfig, num_stages: int,
     descs.append(SharedLayerDesc("embed", _EmbeddingPipe, config,
                                  forward_func=_tied_decoder_forward))
     return PipelineLayer(descs, num_stages=num_stages, loss_fn=loss_fn,
-                         seg_method="layer:TransformerEncoderLayer")
+                         seg_method="layer:TransformerEncoderLayer",
+                         **pipeline_kwargs)
 
 
 def bert_param_spec(name: str):
