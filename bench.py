@@ -104,6 +104,36 @@ def pallas_smoke(on_tpu: bool) -> dict:
     return results
 
 
+def eager_overhead() -> dict:
+    """Host-side dispatch cost of the eager path (VERDICT r2 #7): small-op
+    throughput through run_op with the autograd tape recording vs paused.
+    The budget: >= 10k small ops/s taped (the reference's eager hot path is
+    C++ after one CPython hop, SURVEY §3.1; ours is Python — this bounds
+    how far behind that puts us)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core.autograd import tape_paused
+
+    a = paddle.ones([16, 16])
+    b = paddle.ones([16, 16])
+    a.stop_gradient = False  # taped: every op appends a TapeNode
+
+    def rate(fn, n=3000):
+        fn()  # warmup (compile cache for the tiny shape)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        return n / (time.perf_counter() - t0)
+
+    taped = rate(lambda: paddle.add(a, b))
+    with tape_paused():
+        paused = rate(lambda: paddle.add(a, b))
+    return {"taped_ops_per_sec": round(taped),
+            "paused_ops_per_sec": round(paused),
+            "tape_overhead_pct": round((paused / taped - 1.0) * 100, 1),
+            "budget_ops_per_sec": 10000,
+            "meets_budget": bool(taped >= 10000)}
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -115,6 +145,10 @@ def main():
     on_tpu = dev.platform != "cpu"
 
     smoke = pallas_smoke(on_tpu)
+    try:
+        eager = eager_overhead()
+    except Exception as e:  # noqa: BLE001 — a diagnostic, never fatal
+        eager = {"error": repr(e)[:200]}
 
     if on_tpu:
         cfg = GPTConfig(vocab_size=50304, max_position_embeddings=1024,
@@ -186,7 +220,7 @@ def main():
                   "loss_end": round(loss_end, 4),
                   "params": n_params, "device": str(dev),
                   "batch": batch, "seq": seq, "platform": dev.platform,
-                  "pallas_smoke": smoke},
+                  "pallas_smoke": smoke, "eager_overhead": eager},
     }
 
     errors = []

@@ -62,6 +62,13 @@ define_flag("use_autotune", False, "measure-and-cache fused-kernel impl selectio
 define_flag("use_spmd_rules", True,
             "apply explicit per-op SPMD rules (sharding constraints + "
             "dist_attr propagation) where registered")
+define_flag("eager_vjp", False,
+            "linearize ops at forward time instead of deferring jax.vjp "
+            "to backward (slow; debugging aid)")
+define_flag("spmd_strict", False,
+            "raise instead of falling back to GSPMD when a registered "
+            "SPMD rule rejects a call or a sharding constraint fails "
+            "(fallbacks are always counted in dispatch.spmd_rule_stats)")
 define_flag("use_fused_optimizer", True,
             "eager optimizer.step as one jitted multi-tensor XLA program")
 define_flag("pallas_flash_min_seq", 2048,
