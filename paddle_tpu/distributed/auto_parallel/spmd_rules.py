@@ -257,23 +257,48 @@ def _reshape_rule(x: DistTensorSpec, *, shape=None, **_):
         total *= v
     out_shape = [total // known if v == -1 else v for v in out_shape]
     out_map = [-1] * len(out_shape)
+    # factor-group matching (dim_trans proper, reshape.cc): walk both
+    # shapes two-pointer, accumulating products until they agree; within a
+    # group, 1:1 copies the mapping, a split puts the sharding on the
+    # LEADING output factor, a merge keeps a sharded leading input factor
+    # (inner-factor sharding cannot survive a merge/regroup and drops)
     i = j = 0
     while i < x.ndim and j < len(out_shape):
-        if x.shape[i] == out_shape[j]:
-            out_map[j] = x.dims_mapping[i]
-            i += 1
-            j += 1
-        else:
-            break
-    # trailing alignment
-    i, j = x.ndim - 1, len(out_shape) - 1
-    while i >= 0 and j >= 0 and out_map[j] == -1:
-        if x.shape[i] == out_shape[j]:
-            out_map[j] = x.dims_mapping[i]
-            i -= 1
-            j -= 1
-        else:
-            break
+        gi, gj = [i], [j]
+        pi, pj = x.shape[i], out_shape[j]
+        while pi != pj:
+            if pi < pj:
+                i += 1
+                if i >= x.ndim:
+                    break
+                gi.append(i)
+                pi *= x.shape[i]
+            else:
+                j += 1
+                if j >= len(out_shape):
+                    break
+                gj.append(j)
+                pj *= out_shape[j]
+        if pi != pj:
+            break  # shapes don't factor cleanly: leave the rest replicated
+        # size-1 factors carry no data: ignore them when deciding which
+        # factor's sharding survives (unsqueeze/squeeze are just 1-padded
+        # splits/merges)
+        real_in = [k for k in gi if x.shape[k] != 1]
+        real_out = [k for k in gj if out_shape[k] != 1]
+        if len(real_in) <= 1 and len(real_out) >= 1:
+            # 1:1 or split: the (only) data-bearing input dim's sharding
+            # rides on the LEADING data-bearing output factor
+            m = x.dims_mapping[real_in[0]] if real_in else -1
+            out_map[real_out[0]] = m
+        elif len(real_out) == 1 and real_in:     # merge many -> 1
+            lead = real_in[0]
+            if x.dims_mapping[lead] != -1 and all(
+                    x.dims_mapping[k] == -1 for k in real_in[1:]):
+                out_map[real_out[0]] = x.dims_mapping[lead]
+        # many -> many regroup: stays replicated
+        i += 1
+        j += 1
     return [x], [DistTensorSpec(tuple(out_shape), _dedup(out_map),
                                 x.partial_dims)]
 

@@ -47,12 +47,17 @@ class DistModel:
         if data_axis not in jmesh.axis_names:
             data_axis = jmesh.axis_names[0]
         self._data_axis = data_axis
+        others = [a for a in jmesh.axis_names if a != data_axis]
+        self._model_axis = ("tp" if "tp" in others
+                            else (others[0] if others else data_axis))
+        self._explicit_spec_fn = param_spec_fn is not None
         self._spec_fn = param_spec_fn or self._spec_from_placements
         self._train_step = None
         self._eval_fn = None
         self._params = None
         self._opt_state = None
         self._shard_batch = None
+        self._eval_placed = None
 
     # placements already attached to params (shard_tensor/shard_layer)
     # become the compiled layout; everything else replicates
@@ -81,8 +86,40 @@ class DistModel:
             self._eval_fn = None  # mode is baked at trace time: retrace
         return self
 
-    def _ensure_train(self):
+    def _auto_complete(self, x, y):
+        """No user placements anywhere: run the Completer over the recorded
+        DAG to derive every parameter's layout automatically (the
+        reference's Completer+Planner step of to_static, engine.py:611,
+        completion.py:219)."""
+        if self._explicit_spec_fn:
+            return  # explicit param_spec_fn wins
+        self._param_index = dict(self._layer.named_parameters())
+        if any(isinstance(getattr(p._data, "sharding", None), NamedSharding)
+               and not getattr(p._data.sharding, "is_fully_replicated", True)
+               for p in self._param_index.values()):
+            return  # user annotated at least one param: respect placements
+        from .completion import derive_param_specs
+        # planning is metadata-only: hand over shapes/dtypes, never data
+        import jax
+        xs = jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype) \
+            if not hasattr(x, "dtype") else jax.ShapeDtypeStruct(
+                x.shape, x.dtype)
+        ys = None
+        if y is not None:
+            ys = jax.ShapeDtypeStruct(np.shape(y), np.asarray(y).dtype) \
+                if not hasattr(y, "dtype") else jax.ShapeDtypeStruct(
+                    y.shape, y.dtype)
+        specs = derive_param_specs(
+            self._layer, self._jmesh, (xs, ys),
+            loss_fn=self._loss if ys is not None else None,
+            data_axis=self._data_axis, model_axis=self._model_axis)
+        if specs:
+            self._spec_fn = lambda name: specs.get(name, PartitionSpec())
+
+    def _ensure_train(self, x=None, y=None):
         if self._train_step is None:
+            if x is not None:
+                self._auto_complete(x, y)
             from ...models.trainer import create_sharded_train_step
             loss_fn = None
             if self._loss is not None:
@@ -119,16 +156,26 @@ class DistModel:
         state = functional_state(self._layer)
         if self._params is not None:
             state.update(self._params)
+        elif self._eval_placed is not None:
+            state.update(self._eval_placed)
         return state
 
     def __call__(self, *args):
         if self._mode == "train":
             x, y = args
             return self.train_batch(x, y)
-        self._ensure_eval()
         x = args[0]._data if isinstance(args[0], Tensor) else args[0]
         y = args[1] if len(args) > 1 else None
         y = y._data if isinstance(y, Tensor) else y
+        if self._eval_fn is None and self._params is None:
+            # eval-only DistModel still gets the auto-derived layout
+            self._auto_complete(x, y)
+            from ...models.trainer import place_by_spec
+            self._eval_placed = {
+                name: place_by_spec(p._data, self._spec_fn(name),
+                                    self._jmesh)
+                for name, p in self._layer.named_parameters()}
+        self._ensure_eval()
         from ...core import random as _random
         with self._jmesh:
             return Tensor(
@@ -137,7 +184,9 @@ class DistModel:
                 stop_gradient=True)
 
     def train_batch(self, x, y, lr: Optional[float] = None):
-        self._ensure_train()
+        x0 = x._data if isinstance(x, Tensor) else x
+        y0 = y._data if isinstance(y, Tensor) else y
+        self._ensure_train(x0, y0)
         if lr is None:
             lr = float(self._optimizer.get_lr()) \
                 if hasattr(self._optimizer, "get_lr") else 1e-3

@@ -16,7 +16,27 @@ from ..core.autograd import tape_paused
 from ..core.tensor import Tensor
 from ..nn.layer.layers import _swapped_state, functional_state
 
-__all__ = ["create_train_step", "create_sharded_train_step", "write_back"]
+__all__ = ["create_train_step", "create_sharded_train_step",
+           "place_by_spec", "write_back"]
+
+
+def place_by_spec(arr, spec, mesh):
+    """device_put ``arr`` with ``spec`` over ``mesh``, replicating instead
+    when the spec doesn't divide the array evenly."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    ok = True
+    for i, s in enumerate(spec):
+        if s is None:
+            continue
+        axes = s if isinstance(s, tuple) else (s,)
+        size = int(np.prod([sizes[a] for a in axes]))
+        if i >= arr.ndim or arr.shape[i] % size:
+            ok = False
+    if not ok:
+        spec = PartitionSpec()
+    return jax.device_put(arr, NamedSharding(mesh, spec))
 
 
 def _wd_mask(names):
@@ -66,20 +86,7 @@ def create_sharded_train_step(model, optimizer, mesh, param_spec_fn,
     step, params, opt_state = create_train_step(model, optimizer, loss_fn)
 
     def place(name, arr):
-        spec = param_spec_fn(name)
-        # drop specs that don't divide evenly (replicate instead)
-        ok = True
-        for i, s in enumerate(spec):
-            if s is None:
-                continue
-            axes = s if isinstance(s, tuple) else (s,)
-            size = int(np.prod([dict(zip(mesh.axis_names, mesh.devices.shape))[a]
-                                for a in axes]))
-            if i >= arr.ndim or arr.shape[i] % size:
-                ok = False
-        if not ok:
-            spec = PartitionSpec()
-        return jax.device_put(arr, NamedSharding(mesh, spec))
+        return place_by_spec(arr, param_spec_fn(name), mesh)
 
     params = {k: place(k, v) for k, v in params.items()}
     new_state = {}

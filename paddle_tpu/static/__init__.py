@@ -136,11 +136,13 @@ class OpNode:
     """One recorded op: a pure jax function over resolved operand values
     (parity: one OpDesc in the reference's ProgramDesc)."""
 
-    def __init__(self, name, jax_fn, operands, outputs):
+    def __init__(self, name, jax_fn, operands, outputs, attrs=None):
         self.name = name
         self.jax_fn = jax_fn
         self.operands = list(operands)   # Variable | Tensor | raw value
         self.outputs = outputs           # list[Variable]
+        self.attrs = dict(attrs) if attrs else {}  # static op attributes
+        # (consumed by the auto-parallel Completer's SPMD rules)
 
 
 class TrainNode:
@@ -251,7 +253,6 @@ from ..jit import InputSpec  # noqa: E402
 def record_op(name, jax_fn, operands, num_nondiff_outputs=0, attrs=None):
     """Append an OpNode; infer output shapes with jax.eval_shape over
     ShapeDtypeStructs (the infer_meta analog: no execution)."""
-    del attrs
     prog = None
     for o in operands:
         if isinstance(o, Variable):
@@ -271,7 +272,7 @@ def record_op(name, jax_fn, operands, num_nondiff_outputs=0, attrs=None):
     out_shape = jax.eval_shape(jax_fn, *[as_sds(o) for o in operands])
     single = not isinstance(out_shape, (tuple, list))
     out_list = [out_shape] if single else list(out_shape)
-    node = OpNode(name, jax_fn, operands, [])
+    node = OpNode(name, jax_fn, operands, [], attrs=attrs)
     # dynamic leading dim: shape inference ran with the None batch mapped
     # to 1; if any Variable operand was dynamic on dim 0 and the output's
     # dim 0 still reads 1, keep it symbolic so user shape introspection

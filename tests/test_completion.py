@@ -1,0 +1,168 @@
+"""Automatic sharding completion (reference:
+auto_parallel/static/completion.py:219 Completer + static/engine.py:611
+planning). Device-free unit tests over the recorded DAG + the VERDICT r2 #5
+acceptance: DistModel shards llama-tiny with NO user placements and matches
+the manual-TP loss on the 8-device mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.distributed.auto_parallel.completion import (
+    Completer, derive_param_specs)
+
+pytestmark = pytest.mark.skipif(jax.device_count() < 8,
+                                reason="needs 8 virtual devices")
+
+
+def _mesh2x4():
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "tp"))
+
+
+class TestCompleterUnit:
+    """Pure-metadata completion over a hand-recorded program (the
+    reference's device-free SPMD-rule test discipline)."""
+
+    def _record_mlp(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        l1 = nn.Linear(64, 256, bias_attr=False)
+        l2 = nn.Linear(256, 64, bias_attr=False)
+        static.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [8, 64], "float32")
+                h = l1(x)
+                from paddle_tpu.nn.functional import gelu
+                gelu_out = gelu(h)
+                l2(gelu_out)
+        finally:
+            static.disable_static()
+        names = {id(l1.weight): "l1.w", id(l2.weight): "l2.w"}
+        return prog, names
+
+    def test_megatron_col_row_falls_out_of_cost_model(self):
+        prog, names = self._record_mlp()
+        c = Completer({"dp": 2, "tp": 4})
+        out = c.complete(prog, {"x": (0, -1)}, names)
+        # the classic alternation: first weight column-parallel (out dim on
+        # tp), second row-parallel (contract dim on tp -> one psum)
+        assert out["l1.w"] == (-1, 1), out
+        assert out["l2.w"] == (1, -1), out
+
+    def test_1d_params_follow_rule_wanted_spec(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        lin = nn.Linear(64, 256)  # with bias
+        static.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [8, 64], "float32")
+                lin(x)
+        finally:
+            static.disable_static()
+        names = {id(lin.weight): "w", id(lin.bias): "b"}
+        out = Completer({"dp": 2, "tp": 4}).complete(
+            prog, {"x": (0, -1)}, names)
+        assert out["w"] == (-1, 1)
+        assert out["b"] == (1,)  # bias follows the column-sharded out dim
+
+
+class TestDeriveLlamaSpecs:
+    def test_matches_megatron_pattern(self):
+        from paddle_tpu.models import (LlamaForCausalLM, llama_param_spec,
+                                       llama_tiny)
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny())
+        model.eval()
+        rng = np.random.RandomState(0)
+        cfg = llama_tiny()
+        x = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int64)
+        y = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int64)
+        specs = derive_param_specs(model, _mesh2x4(), (x, y))
+        n_params = 0
+        for name, p in model.named_parameters():
+            n_params += 1
+            d = specs.get(name)
+            assert d is not None, f"no derived spec for {name}"
+            if p._data.ndim >= 2:
+                # every >=2-D param must actually use the tp axis
+                assert "tp" in tuple(d), f"{name} left replicated: {d}"
+            if any(k in name for k in ("q_proj", "k_proj", "v_proj",
+                                       "gate_proj", "up_proj", "o_proj",
+                                       "down_proj", "lm_head")):
+                def norm(s):  # P('tp', None) == P('tp')
+                    t = list(s)
+                    while t and t[-1] is None:
+                        t.pop()
+                    return tuple(t)
+                assert norm(d) == norm(llama_param_spec(name)), \
+                    f"{name}: derived {d} != megatron {llama_param_spec(name)}"
+        assert n_params == 21
+
+
+class TestAutoShardDistModel:
+    def test_auto_matches_manual_tp_loss(self):
+        """VERDICT r2 #5 done-criterion."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.auto_parallel.static_mode import to_static
+        from paddle_tpu.models import (LlamaForCausalLM, llama_param_spec,
+                                       llama_tiny)
+        from paddle_tpu.distributed.process_mesh import ProcessMesh
+
+        cfg = llama_tiny()
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, cfg.vocab_size, (4, 17)).astype(np.int64)
+        ids, labels = x[:, :-1], x[:, 1:]
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "tp"])
+
+        def run(spec_fn):
+            paddle.seed(0)
+            model = LlamaForCausalLM(cfg)
+            model.eval()
+            opt = paddle.optimizer.AdamW(1e-3,
+                                         parameters=model.parameters())
+            dm = to_static(model, loss=None, optimizer=opt, mesh=mesh,
+                           param_spec_fn=spec_fn)
+
+            def loss_model(xv, yv):  # DistModel without loss uses model.loss
+                return None
+            loss = dm.train_batch(ids, labels)
+            return float(loss.numpy()), dm
+
+        manual_loss, _ = run(llama_param_spec)
+        auto_loss, dm = run(None)  # NO user placements: completer derives
+        assert abs(auto_loss - manual_loss) <= 1e-3 * max(1.0,
+                                                          abs(manual_loss))
+        # and the parameters are REALLY sharded on device
+        qname = next(n for n in dm._params if "q_proj" in n)
+        arr = dm._params[qname]
+        local = arr.addressable_shards[0].data.shape
+        assert local[1] * 4 == arr.shape[1], (local, arr.shape)
+
+    def test_eval_only_distmodel_auto_shards(self):
+        """An eval/predict-only DistModel (no optimizer) must not silently
+        run fully replicated: the completer derives the layout from the
+        forward-only DAG and the eval state is placed with it."""
+        from paddle_tpu.distributed.auto_parallel.static_mode import to_static
+        from paddle_tpu.distributed.process_mesh import ProcessMesh
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+        cfg = llama_tiny()
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "tp"])
+        dm = to_static(model, mesh=mesh)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int64)
+        out = dm(ids)
+        assert tuple(out.shape) == (4, 16, cfg.vocab_size)
+        qname = next(n for n in dm._eval_placed if "q_proj" in n)
+        arr = dm._eval_placed[qname]
+        assert arr.addressable_shards[0].data.shape[1] * 4 == arr.shape[1]
