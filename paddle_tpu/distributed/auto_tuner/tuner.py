@@ -1,6 +1,10 @@
 """AutoTuner driver (parity: auto_tuner/tuner.py:21).
 
 TPU-native trial modes:
+- ``run_trial="launch"``: every surviving candidate is MEASURED by a real
+  short training run in a child process (trial.launch_trial) — the
+  reference's profile-based tuning loop; OOM/crash records feed
+  prune_by_history.
 - ``run_trial`` callback: the caller measures a candidate in-process
   (e.g. a jitted train step over a virtual CPU mesh, or a real slice) and
   returns throughput — no subprocess relaunch needed because mesh shape
@@ -11,7 +15,7 @@ TPU-native trial modes:
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional, Union
 
 from .prune import estimate_memory_bytes, prune_by_history, prune_rules
 from .recorder import HistoryRecorder
@@ -20,8 +24,17 @@ from .search import GridSearch
 
 class AutoTuner:
     def __init__(self, tuner_cfg: Dict,
-                 run_trial: Optional[Callable[[Dict], float]] = None):
+                 run_trial: Union[Callable[[Dict], float], str,
+                                  None] = None):
         self.tuner_cfg = dict(tuner_cfg)
+        if isinstance(run_trial, str):
+            if run_trial != "launch":
+                raise ValueError(
+                    f"run_trial: unknown mode {run_trial!r} (expected "
+                    "'launch' or a callable)")
+            from .trial import launch_trial
+            run_trial = lambda cfg: launch_trial(  # noqa: E731
+                self.tuner_cfg, cfg)
         self.run_trial = run_trial
         self.recorder = HistoryRecorder(
             metric_name=self.tuner_cfg.get("metric_cfg", {})
@@ -75,3 +88,9 @@ class AutoTuner:
     def get_best(self) -> Optional[Dict]:
         best = self.recorder.get_best()
         return best["cfg"] if best else None
+
+    def ranked(self) -> List[Dict]:
+        """Strategy list ranked by measured metric, best first — each
+        entry {"cfg", "metric"} (the reference tuner's sorted history)."""
+        return [{"cfg": r["cfg"], "metric": r["metric"]}
+                for r in self.recorder.sorted_records()]

@@ -25,6 +25,41 @@ from ....core.tensor import Tensor
 __all__ = ["recompute", "recompute_sequential", "checkpoint"]
 
 
+def _any_traced(args) -> bool:
+    for a in args:
+        if isinstance(a, Tensor) and isinstance(a._data, jax.core.Tracer):
+            return True
+    return False
+
+
+def _remat_functional(function, args, kwargs):
+    """Functional/jit path: route the call through ``jax.checkpoint`` so XLA
+    rematerializes the segment's activations on the backward pass. Layer
+    parameters are closed-over tracers — they stay residuals (params are
+    live for the optimizer anyway); only the explicit activation args bound
+    the remat segment."""
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    arrays = [args[i]._data for i in tensor_idx]
+    sg = [args[i].stop_gradient for i in tensor_idx]
+    meta = {}
+
+    def pure(*arrs):
+        call = list(args)
+        for j, i in enumerate(tensor_idx):
+            call[i] = Tensor(arrs[j], stop_gradient=sg[j])
+        out = function(*call, **kwargs)
+        single = not isinstance(out, (tuple, list))
+        meta["single"] = single
+        outs = (out,) if single else tuple(out)
+        meta["is_tensor"] = [isinstance(o, Tensor) for o in outs]
+        return tuple(o._data if isinstance(o, Tensor) else o for o in outs)
+
+    res = jax.checkpoint(pure)(*arrays)
+    outs = [Tensor(r, stop_gradient=False) if t else r
+            for r, t in zip(res, meta["is_tensor"])]
+    return outs[0] if meta["single"] else tuple(outs)
+
+
 def recompute(function, *args, **kwargs):
     """paddle.distributed.fleet.utils.recompute parity. ``use_reentrant``
     accepted and ignored (single behavior)."""
@@ -32,6 +67,11 @@ def recompute(function, *args, **kwargs):
     preserve_rng = kwargs.pop("preserve_rng_state", True)
 
     if not is_tape_active():
+        if _any_traced(args):
+            # under a jit/vjp trace (create_train_step, DistModel, the
+            # pipeline chunk programs): real gradient checkpointing
+            return _remat_functional(function, args, kwargs)
+        # plain eager no-grad call: recompute has nothing to save
         return function(*args, **kwargs)
 
     # record RNG state so dropout masks replay identically (reference

@@ -2,7 +2,8 @@
 from .gpt import (GPTConfig, GPTModel, GPTForCausalLM, create_train_step,
                   gpt2_small, gpt2_tiny, write_back)  # noqa: F401
 from .llama import (LlamaConfig, LlamaForCausalLM, llama_7b, llama_13b,  # noqa: F401
-                    llama_tiny, llama_param_spec, llama_fsdp_spec)
+                    llama_tiny, llama_param_spec, llama_fsdp_spec,
+                    llama_pipeline_model)
 from .trainer import create_sharded_train_step  # noqa: F401
 from .bert import (BertConfig, BertModel, BertForPretraining,  # noqa: F401
                    BertForSequenceClassification, bert_base, bert_large,

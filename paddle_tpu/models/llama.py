@@ -17,6 +17,7 @@ flash_attn contract (ops.yaml:978).
 """
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 
 import jax.numpy as jnp
@@ -28,7 +29,7 @@ from ..nn import functional as F
 
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "llama_7b", "llama_13b",
            "llama_tiny", "llama_param_spec", "llama_fsdp_spec",
-           "apply_rotary_pos_emb"]
+           "llama_pipeline_model", "apply_rotary_pos_emb"]
 
 
 @dataclass
@@ -61,11 +62,23 @@ def llama_tiny():
                        max_position_embeddings=128)
 
 
+@functools.lru_cache(maxsize=16)
 def _rope_tables(seq_len, head_dim, theta, dtype=jnp.float32):
+    """Position-only cos/sin tables; cached so every decoder layer (and
+    every pipeline stage) shares one pair per (seq, dim, theta)."""
     inv = 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
     t = np.arange(seq_len)
     freqs = np.outer(t, inv)  # [s, d/2]
     return (jnp.asarray(np.cos(freqs), dtype), jnp.asarray(np.sin(freqs), dtype))
+
+
+def causal_lm_loss(logits, labels):
+    """Token-mean cross entropy over flattened [B,S,V] logits — the one
+    causal-LM loss body shared by the stateful model and the pipeline
+    variant (so a semantics change cannot diverge between them)."""
+    b, s, v = logits.shape
+    return F.cross_entropy(logits.reshape([b * s, v]),
+                           labels.reshape([b * s]))
 
 
 def apply_rotary_pos_emb(q_arr, k_arr, cos, sin):
@@ -194,10 +207,80 @@ class LlamaForCausalLM(nn.Layer):
         return self.lm_head(self.model(input_ids))
 
     def loss(self, input_ids, labels):
-        logits = self(input_ids)
-        b, s, v = logits.shape
-        return F.cross_entropy(logits.reshape([b * s, v]),
-                               labels.reshape([b * s]))
+        return causal_lm_loss(self(input_ids), labels)
+
+
+class _LlamaEmbedPipe(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        from ..nn.initializer import Normal
+        self.embed_tokens = nn.Embedding(
+            cfg.vocab_size, cfg.hidden_size,
+            weight_attr=nn.ParamAttr(initializer=Normal(0.0, 0.02)))
+
+    def forward(self, input_ids):
+        if input_ids.shape[1] > self.cfg.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {input_ids.shape[1]} exceeds "
+                f"max_position_embeddings="
+                f"{self.cfg.max_position_embeddings}")
+        return self.embed_tokens(input_ids)
+
+
+class LlamaDecoderLayerPipe(LlamaDecoderLayer):
+    """Single-tensor-signature decoder layer for PipelineLayer: the RoPE
+    tables are position-only, so each stage recomputes them locally instead
+    of shipping them across the stage boundary."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__(cfg)
+        self.cfg = cfg
+        self._cos_sin = _rope_tables(cfg.max_position_embeddings,
+                                     cfg.hidden_size // cfg.num_heads,
+                                     cfg.rope_theta)
+
+    def forward(self, h):
+        if self.cfg.use_recompute and self.training:
+            from ..distributed.fleet.recompute import recompute
+            return recompute(super().forward, h, self._cos_sin)
+        return super().forward(h, self._cos_sin)
+
+
+class _LlamaHeadPipe(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        from ..nn.initializer import Normal
+        self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.lm_head = nn.Linear(
+            cfg.hidden_size, cfg.vocab_size,
+            weight_attr=nn.ParamAttr(initializer=Normal(0.0, 0.02)),
+            bias_attr=False)
+
+    def forward(self, h):
+        return self.lm_head(self.norm(h))
+
+
+def llama_pipeline_model(cfg: LlamaConfig, num_stages: int, loss_fn=None,
+                         **pipeline_kwargs):
+    """Llama-for-causal-LM as a PipelineLayer (untied head, so a plain
+    LayerDesc chain: embed | decoder x N | norm+head). Same parameterization
+    as LlamaForCausalLM so trial throughputs are comparable across pp and
+    non-pp candidates (reference analog: the gpt PipelineLayer variant in
+    the hybrid-parallel tests)."""
+    from ..distributed.fleet.meta_parallel.parallel_layers import (
+        LayerDesc, PipelineLayer)
+
+    if loss_fn is None:
+        loss_fn = causal_lm_loss
+
+    descs = [LayerDesc(_LlamaEmbedPipe, cfg)]
+    descs += [LayerDesc(LlamaDecoderLayerPipe, cfg)
+              for _ in range(cfg.num_layers)]
+    descs.append(LayerDesc(_LlamaHeadPipe, cfg))
+    return PipelineLayer(descs, num_stages=num_stages, loss_fn=loss_fn,
+                         seg_method="layer:LlamaDecoderLayerPipe",
+                         **pipeline_kwargs)
 
 
 def llama_param_spec(name: str, P=None):
