@@ -72,18 +72,35 @@ def _kv_index(bh, hq, hk):
 # the parity tests — the TPU analog of the reference's (seed, offset) pairs)
 # ---------------------------------------------------------------------------
 
-def _dropout_thresh(rate: float) -> np.uint32:
-    """keep iff hash >= thresh, so P(drop) == rate."""
-    return np.uint32(min(int(float(rate) * 2 ** 32), 2 ** 32 - 1))
+def _i32(v: int) -> np.int32:
+    """uint32 bit-pattern as the int32 Mosaic vector units operate on."""
+    return np.int32(v - (1 << 32) if v >= (1 << 31) else v)
+
+
+_SIGN = _i32(0x80000000)
+
+
+def _dropout_thresh(rate: float) -> np.int32:
+    """keep iff hash >=u thresh, so P(drop) == rate. Returned pre-biased
+    (^0x80000000) so the kernels compare with a plain SIGNED >=: Mosaic's
+    vector ISA is int32 — every hash op below is wraparound-identical in
+    int32, and unsigned compare is signed compare of sign-flipped values."""
+    t = np.uint32(min(int(float(rate) * 2 ** 32), 2 ** 32 - 1))
+    return _i32(int(t)) ^ _SIGN
+
+
+def _srl(h, n):
+    return jax.lax.shift_right_logical(h, np.int32(n))
 
 
 def _mix_seed(seed, bh):
-    """Per-(batch*head) 32-bit seed: murmur-style avalanche of seed ^ bh."""
-    h = seed.astype(jnp.uint32) ^ (jnp.uint32(bh) * np.uint32(0x9E3779B1))
-    h = h * np.uint32(0x85EBCA6B)
-    h = h ^ (h >> 7)
-    h = h * np.uint32(0xC2B2AE35)
-    h = h ^ (h >> 15)
+    """Per-(batch*head) 32-bit seed: murmur-style avalanche of seed ^ bh
+    (int32 wraparound arithmetic == the uint32 reference bit-for-bit)."""
+    h = seed.astype(jnp.int32) ^ (jnp.int32(bh) * _i32(0x9E3779B1))
+    h = h * _i32(0x85EBCA6B)
+    h = h ^ _srl(h, 7)
+    h = h * _i32(0xC2B2AE35)
+    h = h ^ _srl(h, 15)
     return h
 
 
@@ -93,15 +110,15 @@ def _keep_block(seed_bh, q_start, k_start, bq, bk, sk, thresh):
     The hash input is the *global* element index row * Sk + col with the
     real (unpadded) Sk stride — padded key columns hash to colliding
     indices, but those positions are masked out by the sk_real check before
-    they ever matter."""
+    they ever matter. ``thresh`` comes pre-biased from _dropout_thresh."""
     rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    h = (rows * np.int32(sk) + cols).astype(jnp.uint32) ^ seed_bh
-    h = h * np.uint32(0x85EBCA6B)
-    h = h ^ (h >> 13)
-    h = h * np.uint32(0xC2B2AE35)
-    h = h ^ (h >> 16)
-    return h >= thresh
+    h = (rows * np.int32(sk) + cols) ^ seed_bh
+    h = h * _i32(0x85EBCA6B)
+    h = h ^ _srl(h, 13)
+    h = h * _i32(0xC2B2AE35)
+    h = h ^ _srl(h, 16)
+    return (h ^ _SIGN) >= thresh
 
 
 def seed_from_key(key) -> jax.Array:
@@ -801,5 +818,54 @@ def _attention_pallas(q, k, v, bias, causal, scale, dropout_p, dropout_key):
                               dropout_key)
     seed = seed_from_key(dropout_key) if rate > 0.0 \
         else jnp.zeros((1,), jnp.int32)
+    bq, bk, out = _tuned_blocks(q, k, v, bias, seed, bool(causal),
+                                float(scale), rate, interpret)
+    if out is not None:   # autotune just measured the winner end-to-end
+        return out
     return flash_attention_ext(q, k, v, bias, seed, bool(causal),
-                               float(scale), rate, 128, 128, interpret)
+                               float(scale), rate, bq, bk, interpret)
+
+
+# candidate (block_q, block_k) tilings; 128x128 is the safe default, the
+# larger tiles amortize grid overhead at long seq (tuned on-chip via
+# core/autotune.py — the analog of the reference's exhaustive-search cache,
+# paddle/phi/kernels/autotune/cache.h)
+_BLOCK_CANDIDATES = ((128, 128), (256, 256), (512, 256), (256, 512),
+                     (512, 512))
+
+
+def _tuned_blocks(q, k, v, bias, seed, causal, scale, rate, interpret):
+    """(bq, bk[, out]) for this call: consult the autotune cache (traced
+    calls), or measure fwd+bwd per candidate on concrete eager calls. The
+    measured timing includes the backward pass — block sizes that win fwd
+    can lose the dq/dkv kernels."""
+    from ...core import autotune as _autotune
+
+    sq, sk = q.shape[1], k.shape[1]
+    cands = {f"b{a}x{b}": (a, b) for a, b in _BLOCK_CANDIDATES
+             if a <= max(sq, 128) and b <= max(sk, 128)}
+    bias_sig = "x".join(map(str, bias.shape)) if bias is not None else "0"
+    tag = (f"flash_attention_blocks_c{int(causal)}_r{int(rate > 0)}"
+           f"_b{bias_sig}")
+
+    def call(name):
+        a, b = cands[name]
+        out, vjp = jax.vjp(
+            lambda q_, k_, v_: flash_attention_ext(
+                q_, k_, v_, bias, seed, causal, scale, rate, a, b,
+                interpret), q, k, v)
+        grads = vjp(jnp.ones_like(out))
+        # fetch one element per grad so the timed window really includes
+        # the backward kernels (block_until_ready can return early on the
+        # remote-TPU tunnel; a host fetch cannot)
+        for g in grads:
+            jax.device_get(g.ravel()[0])
+        return out
+
+    choice, out = _autotune.pick_impl(tag, cands, (q, k), call)
+    if choice is None or choice not in cands:
+        # choice unknown: autotune off / stale persisted entry from an
+        # older candidate list — degrade to the safe default, never crash
+        return 128, 128, None
+    bq, bk = cands[choice]
+    return bq, bk, out

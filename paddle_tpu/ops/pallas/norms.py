@@ -32,6 +32,18 @@ __all__ = ["rms_norm_pallas", "layer_norm_pallas"]
 _ROW_BLOCK = 256
 
 
+def _row_block(r: int, n: int) -> int:
+    """Rows per block, sized so the f32 x-block stays <= ~1 MiB: with the
+    in + out blocks double-buffered by the pipeline, a fixed 256-row block
+    at wide hidden sizes (256 x 4096 x 4 B = 4 MiB each) blows past VMEM —
+    the rms_8k_4k on-chip compile failure."""
+    cap = max(8, (1 << 20) // max(n * 4, 1))
+    br = 8
+    while br * 2 <= min(cap, _ROW_BLOCK):
+        br *= 2
+    return min(br, max(8, r))
+
+
 def _use_pallas(x):
     on_tpu = jax.default_backend() == "tpu"
     return on_tpu or _flags.get_flag("pallas_force_interpret")
@@ -69,7 +81,7 @@ def rms_norm_pallas(x, w, eps, interpret):
 
 def _rms_fwd(x, w, eps, interpret):
     x2, r, n = _flatten_rows(x)
-    br = min(_ROW_BLOCK, max(8, r))
+    br = _row_block(r, n)
     x2p = pad_rows(x2, br)
     rp = x2p.shape[0]
     y, inv = pl.pallas_call(
@@ -144,7 +156,7 @@ def layer_norm_pallas(x, w, b, eps, interpret):
 
 def _ln_fwd(x, w, b, eps, interpret):
     x2, r, n = _flatten_rows(x)
-    br = min(_ROW_BLOCK, max(8, r))
+    br = _row_block(r, n)
     x2p = pad_rows(x2, br)
     rp = x2p.shape[0]
     y, mu, rstd = pl.pallas_call(

@@ -27,7 +27,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "artifacts", "tpu_capture")
 PROBE_TIMEOUT = 120
 BENCH_TIMEOUT = 2400
-KERNEL_TIMEOUT = 2400
+KERNEL_TIMEOUT = 3600   # block-size tuning adds compiles on first run
 PROBE_INTERVAL = 150          # seconds between probes while tunnel is down
 RECAPTURE_INTERVAL = 2400     # refresh a successful capture every 40 min
 
@@ -111,11 +111,34 @@ def capture(device_info: str) -> bool:
     kscript = os.path.join(REPO, "bench_kernels.py")
     if os.path.exists(kscript):
         kern = run_json_child(kscript, KERNEL_TIMEOUT, "metric")
-        if kern is not None and kern.get("platform") == "tpu" \
-                and not kern.get("error"):
-            with open(os.path.join(OUT, "bench_kernels.json"), "w") as f:
-                json.dump(kern, f, indent=1)
-            log("captured bench_kernels")
+        if kern is not None and kern.get("platform") == "tpu":
+            # persist even with per-kernel errors: partial on-chip ratios
+            # beat no data, and the error strings are themselves evidence —
+            # but never let a flaky partial run clobber a fuller capture
+            n = (kern.get("summary") or {}).get("n_measured") or 0
+            path = os.path.join(OUT, "bench_kernels.json")
+            prev_n = -1
+            if os.path.exists(path):
+                try:
+                    with open(path) as f:
+                        prev_n = (json.load(f).get("summary") or {}
+                                  ).get("n_measured") or 0
+                except Exception:
+                    prev_n = -1
+            if n >= prev_n:
+                with open(path, "w") as f:
+                    json.dump(kern, f, indent=1)
+            else:
+                with open(os.path.join(
+                        OUT, "bench_kernels_partial.json"), "w") as f:
+                    json.dump(kern, f, indent=1)
+                log(f"kept fuller capture ({prev_n} ratios); partial "
+                    f"({n}) written aside")
+            if kern.get("error"):
+                log(f"captured bench_kernels PARTIAL ({n} ratios): "
+                    f"{kern['error'][:160]}")
+            else:
+                log(f"captured bench_kernels ({n} ratios)")
             ok = True
         else:
             log(f"bench_kernels capture failed: "
