@@ -285,6 +285,11 @@ def _load_session_capture():
             with open(kern_p) as f:
                 result.setdefault("extra", {})["kernels_vs_xla"] = \
                     json.load(f)
+        cfg_p = os.path.join(base, "bench_configs.json")
+        if os.path.exists(cfg_p):
+            with open(cfg_p) as f:
+                result.setdefault("extra", {})["baseline_configs"] = \
+                    json.load(f)
         return result
     except Exception:
         return None

@@ -144,6 +144,37 @@ def capture(device_info: str) -> bool:
             log(f"bench_kernels capture failed: "
                 f"{(kern or {}).get('error', 'no/cpu result')}")
 
+    cscript = os.path.join(REPO, "bench_configs.py")
+    if os.path.exists(cscript):
+        cfg = run_json_child(cscript, KERNEL_TIMEOUT, "metric")
+        if cfg is not None and cfg.get("platform") == "tpu":
+            n_ok = sum(1 for c in (cfg.get("configs") or {}).values()
+                       if "error" not in c)
+            path = os.path.join(OUT, "bench_configs.json")
+            prev_ok = -1
+            if os.path.exists(path):
+                try:
+                    with open(path) as f:
+                        prev_ok = sum(
+                            1 for c in (json.load(f).get("configs") or {}
+                                        ).values() if "error" not in c)
+                except Exception:
+                    prev_ok = -1
+            if n_ok >= prev_ok:
+                with open(path, "w") as f:
+                    json.dump(cfg, f, indent=1)
+            else:
+                with open(os.path.join(
+                        OUT, "bench_configs_partial.json"), "w") as f:
+                    json.dump(cfg, f, indent=1)
+                log(f"kept fuller configs capture ({prev_ok} ok); "
+                    f"partial ({n_ok}) written aside")
+            log(f"captured bench_configs ({n_ok} configs ok)")
+            ok = True
+        else:
+            log(f"bench_configs capture failed: "
+                f"{(cfg or {}).get('error', 'no/cpu result')}")
+
     if ok:
         with open(os.path.join(OUT, "meta.json"), "w") as f:
             json.dump({"captured_at_unix": time.time(),
