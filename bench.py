@@ -154,51 +154,65 @@ def main():
         cfg = GPTConfig(vocab_size=50304, max_position_embeddings=1024,
                         hidden_size=768, num_layers=12, num_heads=12,
                         intermediate_size=3072, dropout=0.0)
-        batch, seq, iters, windows = 8, 1024, 20, 3
+        batches, seq, iters, windows = (8, 16), 1024, 20, 3
     else:  # CI fallback so bench never hard-fails
         cfg = GPTConfig(vocab_size=1024, max_position_embeddings=128,
                         hidden_size=128, num_layers=2, num_heads=4,
                         intermediate_size=256, dropout=0.0)
-        batch, seq, iters, windows = 4, 64, 5, 2
+        batches, seq, iters, windows = (4,), 64, 5, 2
 
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
     model.eval()  # dropout off; deterministic step
     opt = paddle.optimizer.AdamW(learning_rate=3e-4, weight_decay=0.01,
                                  parameters=model.parameters())
-    step, params, opt_state = create_train_step(model, opt)
+    step, params0, opt_state0 = create_train_step(model, opt)
 
     # cast params to bf16 for MXU throughput; AdamW state stays f32
-    params = {k: (v.astype(jnp.bfloat16)
-                  if jnp.issubdtype(v.dtype, jnp.floating) else v)
-              for k, v in params.items()}
-
+    params0 = {k: (v.astype(jnp.bfloat16)
+                   if jnp.issubdtype(v.dtype, jnp.floating) else v)
+               for k, v in params0.items()}
     rng = np.random.RandomState(0)
-    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq + 1)),
-                      dtype=jnp.int32)
-    x, y = ids[:, :-1], ids[:, 1:]
     key = jax.random.key(0)
 
-    # warmup / compile; host fetch = hard sync
-    loss, params, opt_state = step(params, opt_state, key, x, y, 3e-4)
-    loss_start = float(jax.device_get(loss))
+    def measure(batch):
+        """(tokens/s, ms/step, loss_start, loss_end) at one batch size."""
+        params, opt_state = dict(params0), jax.tree_util.tree_map(
+            lambda v: v, opt_state0)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq + 1)),
+                          dtype=jnp.int32)
+        x, y = ids[:, :-1], ids[:, 1:]
+        # warmup / compile; host fetch = hard sync
+        loss, params, opt_state = step(params, opt_state, key, x, y, 3e-4)
+        l0 = float(jax.device_get(loss))
+        best_dt = float("inf")
+        step_i = 0
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss, params, opt_state = step(
+                    params, opt_state, jax.random.fold_in(key, step_i),
+                    x, y, 3e-4)
+                step_i += 1
+            # the fetch closes the window: the scalar's bytes depend on the
+            # whole step chain, so they cannot arrive before the work is done
+            l1 = float(jax.device_get(loss))
+            best_dt = min(best_dt, time.perf_counter() - t0)
+        return (batch * seq * iters / best_dt, best_dt / iters * 1e3,
+                l0, l1)
 
-    best_dt = float("inf")
-    step_i = 0
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            loss, params, opt_state = step(
-                params, opt_state, jax.random.fold_in(key, step_i), x, y,
-                3e-4)
-            step_i += 1
-        # the fetch closes the window: the scalar's bytes depend on the whole
-        # step chain, so they cannot arrive before the work is done
-        loss_end = float(jax.device_get(loss))
-        best_dt = min(best_dt, time.perf_counter() - t0)
-
-    ms_per_step = best_dt / iters * 1e3
-    tokens_per_sec = batch * seq * iters / best_dt
+    # batch sweep: keep the best-throughput batch that fits (larger batches
+    # raise MXU utilization until HBM runs out; an OOM candidate is skipped)
+    by_batch, sweep_err = {}, {}
+    for b in batches:
+        try:
+            by_batch[b] = measure(b)
+        except Exception as e:  # noqa: BLE001 — e.g. RESOURCE_EXHAUSTED
+            sweep_err[b] = f"{type(e).__name__}: {e}"[:160]
+    if not by_batch:
+        raise RuntimeError(f"every batch size failed: {sweep_err}")
+    batch = max(by_batch, key=lambda b: by_batch[b][0])
+    tokens_per_sec, ms_per_step, loss_start, loss_end = by_batch[batch]
 
     # config-derived matmul FLOPs: per layer qkv+proj (4 H^2) + mlp (2 H I),
     # plus the logits projection (V H); x6 for fwd+bwd; causal attention at
@@ -209,7 +223,7 @@ def main():
     flops_per_tok = 6 * matmul_params + 3 * L * seq * H
     mfu = tokens_per_sec * flops_per_tok / peak_flops_per_chip(dev)
 
-    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+    n_params = sum(int(np.prod(v.shape)) for v in params0.values())
     result = {
         "metric": "gpt2s_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -220,6 +234,9 @@ def main():
                   "loss_end": round(loss_end, 4),
                   "params": n_params, "device": str(dev),
                   "batch": batch, "seq": seq, "platform": dev.platform,
+                  "batch_sweep": {str(b): round(r[0], 1)
+                                  for b, r in by_batch.items()},
+                  **({"batch_sweep_errors": sweep_err} if sweep_err else {}),
                   "pallas_smoke": smoke, "eager_overhead": eager},
     }
 
