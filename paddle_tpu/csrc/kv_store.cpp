@@ -14,6 +14,12 @@
 //   request : u8 cmd | u32 klen | key | i64 arg | u32 vlen | value
 //   response: i64 ret | u32 vlen | value
 // cmds: 1=SET 2=GET 3=ADD 4=WAIT 5=DEL 6=NUMKEYS 7=PING
+//       8=LEASE_SET (arg = ttl_ms; key expires server-side unless renewed —
+//         the etcd-lease analog the elastic heartbeats ride on)
+//       9=WATCH (arg = timeout_ms; value = 8-byte LE last_version; blocks
+//         until the key's version exceeds last_version — every SET / ADD /
+//         LEASE_SET / DEL / expiry bumps it; reply = 8-byte LE version |
+//         u8 present | value)
 // ret < 0: -1 key missing, -2 timeout, -3 protocol error.
 
 #include <arpa/inet.h>
@@ -37,10 +43,43 @@
 
 namespace {
 
+using Clock = std::chrono::steady_clock;
+
+struct Entry {
+  std::string value;
+  bool has_ttl = false;
+  Clock::time_point deadline{};  // valid iff has_ttl
+};
+
 struct Storage {
   std::mutex mu;
   std::condition_variable cv;
-  std::map<std::string, std::string> data;
+  std::map<std::string, Entry> data;
+  // version log: survives deletion/expiry so watchers never miss a change
+  std::map<std::string, int64_t> versions;
+  int64_t global_version = 0;
+
+  // caller holds mu
+  void bump(const std::string& key) { versions[key] = ++global_version; }
+
+  // caller holds mu: live entry or nullptr; purges an expired lease (and
+  // bumps the version so watchers observe the expiry)
+  Entry* find_live(const std::string& key, Clock::time_point now) {
+    auto it = data.find(key);
+    if (it == data.end()) return nullptr;
+    if (it->second.has_ttl && now >= it->second.deadline) {
+      data.erase(it);
+      bump(key);
+      cv.notify_all();
+      return nullptr;
+    }
+    return &it->second;
+  }
+
+  int64_t version_of(const std::string& key) {
+    auto it = versions.find(key);
+    return it == versions.end() ? 0 : it->second;
+  }
 };
 
 bool read_exact(int fd, void* buf, size_t n) {
@@ -114,44 +153,58 @@ struct Server {
       std::string reply;
       bool alive = true;
       switch (cmd) {
-        case 1: {  // SET
+        case 1: {  // SET (clears any lease: plain keys are persistent)
           std::lock_guard<std::mutex> lk(store.mu);
-          store.data[key] = val;
+          store.data[key] = Entry{val, false, {}};
+          store.bump(key);
           store.cv.notify_all();
           ret = 0;
           break;
         }
         case 2: {  // GET
           std::lock_guard<std::mutex> lk(store.mu);
-          auto it = store.data.find(key);
-          if (it == store.data.end()) {
+          Entry* e = store.find_live(key, Clock::now());
+          if (e == nullptr) {
             ret = -1;
           } else {
             ret = 0;
-            reply = it->second;
+            reply = e->value;
           }
           break;
         }
         case 3: {  // ADD(arg) -> new value; value stored as decimal string
           std::lock_guard<std::mutex> lk(store.mu);
           int64_t cur = 0;
-          auto it = store.data.find(key);
-          if (it != store.data.end() && !it->second.empty()) {
-            cur = std::strtoll(it->second.c_str(), nullptr, 10);
+          Entry* e = store.find_live(key, Clock::now());
+          bool existed = e != nullptr;
+          if (existed && !e->value.empty()) {
+            cur = std::strtoll(e->value.c_str(), nullptr, 10);
           }
           cur += arg;
-          store.data[key] = std::to_string(cur);
-          store.cv.notify_all();
+          std::string next = std::to_string(cur);
+          // ADD(0) is the read-a-counter idiom: only a real value change
+          // (or key creation) counts as a change for WATCHers, and an
+          // existing lease keeps its TTL (reading a heartbeat key must
+          // never pin it alive)
+          bool changed = !existed || next != e->value;
+          bool ttl = existed && e->has_ttl;
+          Clock::time_point dl = existed ? e->deadline : Clock::time_point{};
+          store.data[key] = Entry{std::move(next), ttl, dl};
+          if (changed) {
+            store.bump(key);
+            store.cv.notify_all();
+          }
           // counter travels in the value field: the i64 ret stays a pure
           // status code even for negative counters
           ret = 0;
-          reply = store.data[key];
+          reply = store.data[key].value;
           break;
         }
         case 4: {  // WAIT(timeout_ms in arg; arg<=0 -> wait forever)
           std::unique_lock<std::mutex> lk(store.mu);
           auto pred = [&] {
-            return stopping.load() || store.data.count(key) > 0;
+            return stopping.load() ||
+                   store.find_live(key, Clock::now()) != nullptr;
           };
           bool found;
           if (arg > 0) {
@@ -164,23 +217,97 @@ struct Server {
           if (stopping.load()) {
             alive = false;
           } else {
-            ret = (found && store.data.count(key)) ? 0 : -2;
+            ret = (found &&
+                   store.find_live(key, Clock::now()) != nullptr) ? 0 : -2;
           }
           break;
         }
         case 5: {  // DEL
           std::lock_guard<std::mutex> lk(store.mu);
           ret = static_cast<int64_t>(store.data.erase(key));
+          if (ret > 0) {
+            store.bump(key);
+            store.cv.notify_all();
+          }
           break;
         }
-        case 6: {  // NUMKEYS
+        case 6: {  // NUMKEYS (live keys only)
           std::lock_guard<std::mutex> lk(store.mu);
-          ret = static_cast<int64_t>(store.data.size());
+          auto now = Clock::now();
+          int64_t n = 0;
+          for (auto it = store.data.begin(); it != store.data.end();) {
+            if (it->second.has_ttl && now >= it->second.deadline) {
+              std::string k = it->first;
+              it = store.data.erase(it);
+              store.bump(k);
+            } else {
+              ++n;
+              ++it;
+            }
+          }
+          ret = n;
           break;
         }
         case 7:  // PING
           ret = 0;
           break;
+        case 8: {  // LEASE_SET(arg = ttl_ms)
+          if (arg <= 0) {
+            ret = -3;
+            break;
+          }
+          std::lock_guard<std::mutex> lk(store.mu);
+          store.data[key] = Entry{
+              val, true, Clock::now() + std::chrono::milliseconds(arg)};
+          store.bump(key);
+          store.cv.notify_all();
+          ret = 0;
+          break;
+        }
+        case 9: {  // WATCH(arg = timeout_ms; value = 8-byte last_version)
+          if (vlen != 8) {
+            ret = -3;
+            break;
+          }
+          int64_t last;
+          std::memcpy(&last, val.data(), 8);
+          std::unique_lock<std::mutex> lk(store.mu);
+          auto now = Clock::now();
+          auto wait_deadline =
+              arg > 0 ? now + std::chrono::milliseconds(arg)
+                      : Clock::time_point::max();
+          ret = -2;
+          for (;;) {
+            now = Clock::now();
+            Entry* e = store.find_live(key, now);  // purge-on-check
+            if (store.version_of(key) > last) {
+              int64_t ver = store.version_of(key);
+              reply.resize(9);
+              std::memcpy(&reply[0], &ver, 8);
+              reply[8] = e != nullptr ? 1 : 0;
+              if (e != nullptr) reply += e->value;
+              ret = 0;
+              break;
+            }
+            if (stopping.load()) {
+              alive = false;
+              break;
+            }
+            if (now >= wait_deadline) break;  // -2 timeout
+            // wake at the earliest of: client timeout, this key's lease
+            // expiry (a silent expiry must still wake the watcher)
+            auto next = wait_deadline;
+            if (e != nullptr && e->has_ttl && e->deadline < next) {
+              next = e->deadline;
+            }
+            if (next == Clock::time_point::max()) {
+              store.cv.wait(lk);
+            } else {
+              store.cv.wait_until(lk, next);
+            }
+          }
+          break;
+        }
         default:
           ret = -3;
           break;
@@ -400,6 +527,37 @@ int64_t kv_client_del(void* h, const char* key) {
 
 int64_t kv_client_numkeys(void* h) {
   return roundtrip(static_cast<Client*>(h), 6, "", 0, nullptr, 0, nullptr);
+}
+
+// etcd-lease analog: key expires ttl_ms after the last lease_set
+int64_t kv_client_lease_set(void* h, const char* key, const void* val,
+                            uint32_t vlen, int64_t ttl_ms) {
+  return roundtrip(static_cast<Client*>(h), 8, key, ttl_ms, val, vlen,
+                   nullptr);
+}
+
+// Blocks until the key's version exceeds last_version (any SET / ADD /
+// LEASE_SET / DEL / lease expiry), or timeout_ms elapses (<=0: forever).
+// On success returns the value length (value copied into buf, which may be
+// truncated at buf_len), stores the new version in *version_out and
+// whether the key currently exists in *present_out. Returns -2 on timeout.
+int64_t kv_client_watch(void* h, const char* key, int64_t last_version,
+                        int64_t timeout_ms, void* buf, uint32_t buf_len,
+                        int64_t* version_out, int32_t* present_out) {
+  std::string out;
+  char lv[8];
+  std::memcpy(lv, &last_version, 8);
+  int64_t ret = roundtrip(static_cast<Client*>(h), 9, key, timeout_ms, lv, 8,
+                          &out);
+  if (ret < 0) return ret;
+  if (out.size() < 9) return -100;
+  if (version_out) std::memcpy(version_out, out.data(), 8);
+  if (present_out) *present_out = static_cast<int32_t>(out[8]);
+  uint32_t n = static_cast<uint32_t>(out.size() - 9);
+  if (buf && buf_len && n) {
+    std::memcpy(buf, out.data() + 9, std::min(n, buf_len));
+  }
+  return static_cast<int64_t>(n);
 }
 
 int64_t kv_client_ping(void* h) {

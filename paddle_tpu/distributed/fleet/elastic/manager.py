@@ -11,7 +11,6 @@ re-rendezvous signal) is the primary mode.
 from __future__ import annotations
 
 import threading
-import time
 from typing import List, Optional
 
 from ...store import TCPStore
@@ -34,16 +33,30 @@ class ElasticStatus:
 
 
 class ElasticManager:
-    """Heartbeat this node; watch peers; report membership health."""
+    """Heartbeat this node; watch peers; report membership health.
+
+    ``np_target`` is either a fixed int (FAULT_TOLERANCE: survive member
+    restarts at constant world size) or a ``(min_np, max_np)`` range, which
+    selects ELASTIC level: membership may grow (announce_join) or shrink
+    (leave/death) between epochs, and watch() asks for a re-rendezvous
+    whenever the live membership can change shape (reference:
+    fleet/elastic/manager.py:126 np-range parsing + scale in/out)."""
 
     def __init__(self, store: TCPStore, node_id: str,
-                 np_target: int, heartbeat_interval: float = 1.0,
+                 np_target, heartbeat_interval: float = 1.0,
                  heartbeat_timeout: float = 5.0,
-                 level: int = ElasticLevel.FAULT_TOLERANCE,
+                 level: Optional[int] = None,
                  job_id: str = "default"):
         self.store = store
         self.node_id = node_id
-        self.np_target = np_target
+        if isinstance(np_target, (tuple, list)):
+            self.min_np, self.max_np = int(np_target[0]), int(np_target[1])
+        else:
+            self.min_np = self.max_np = int(np_target)
+        self.np_target = self.min_np
+        if level is None:
+            level = (ElasticLevel.ELASTIC if self.min_np != self.max_np
+                     else ElasticLevel.FAULT_TOLERANCE)
         self.interval = heartbeat_interval
         self.timeout = heartbeat_timeout
         self.level = level
@@ -51,18 +64,20 @@ class ElasticManager:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._epoch_key = f"{self.prefix}/epoch"
-        # node -> (last counter, monotonic time it was first observed)
-        self._seen: dict = {}
+        self._epoch_ver = 0
+        self._last_epoch = 0
 
     # -- heartbeats --------------------------------------------------------
-    # heartbeats are monotonic counters bumped via store.add, and liveness
-    # is "counter changed within timeout BY THE WATCHER'S OWN CLOCK" —
-    # cross-host wall-clock skew can neither kill a healthy node nor mask
-    # a dead one (the reference leans on etcd lease TTLs for the same
-    # property).
+    # each node renews a server-side LEASE (csrc/kv_store.cpp LEASE_SET):
+    # the key expires ttl=heartbeat_timeout after the last renewal, so
+    # liveness is a single existence check with no watcher-side clock
+    # bookkeeping — the reference's etcd-lease contract, natively.
     def start(self):
         import weakref as _weakref
-        self.store.add(f"{self.prefix}/hb/{self.node_id}", 1)
+        self.store.lease_set(f"{self.prefix}/hb/{self.node_id}", "1",
+                             ttl=self.timeout)
+        self._last_epoch = self.current_epoch()
+        self._epoch_ver = self._probe_version(self._epoch_key)
         self._stop.clear()
         # the beat thread holds only a WEAK ref to self: an abandoned
         # manager (no stop() call) must stay collectible so the
@@ -80,44 +95,42 @@ class ElasticManager:
         if self._thread:
             self._thread.join(self.interval * 3)
             self._thread = None
-        self.store.set(f"{self.prefix}/hb/{self.node_id}", "")
+        self.store.delete_key(f"{self.prefix}/hb/{self.node_id}")
 
     def _beat(self):  # kept for API compatibility; start() uses _beat_loop
         _beat_loop(lambda: self, self._stop, self.interval)
+
+    def _probe_version(self, key: str) -> int:
+        """Current change-version of a key (0 if never touched)."""
+        try:
+            ver, _ = self.store.watch(key, 0, timeout=0.001)
+            return ver
+        except TimeoutError:
+            return 0
 
     # -- membership --------------------------------------------------------
     def register_nodes(self, node_ids: List[str]):
         """The launcher registers the full expected membership."""
         self.store.set(f"{self.prefix}/members", ",".join(node_ids))
 
+    def _members(self) -> List[str]:
+        return [n for n in self.store.get(f"{self.prefix}/members")
+                .decode().split(",") if n]
+
+    def _hb_alive(self, node: str) -> bool:
+        try:
+            self.store.get(f"{self.prefix}/hb/{node}", wait=False)
+            return True
+        except KeyError:
+            return False
+
     def _snapshot(self):
-        """One consistent poll: (alive, dead) from a single read pass.
-        A node is alive while its heartbeat counter keeps advancing within
-        ``timeout`` seconds of this watcher's monotonic clock."""
-        members = self.store.get(f"{self.prefix}/members").decode()
-        now = time.monotonic()
+        """One consistent poll: (alive, dead). A node is alive while its
+        heartbeat lease exists — the server expires it ``timeout`` seconds
+        after the last renewal."""
         alive, dead = [], []
-        for n in members.split(","):
-            if not n:
-                continue
-            try:
-                raw = self.store.get(f"{self.prefix}/hb/{n}",
-                                     wait=False).decode()
-            except KeyError:
-                raw = ""
-            if not raw:  # never started, or stopped cleanly
-                self._seen.pop(n, None)
-                dead.append(n)
-                continue
-            counter = int(raw)
-            last = self._seen.get(n)
-            if last is None or last[0] != counter:
-                self._seen[n] = (counter, now)
-                alive.append(n)
-            elif now - last[1] < self.timeout:
-                alive.append(n)
-            else:
-                dead.append(n)
+        for n in self._members():
+            (alive if self._hb_alive(n) else dead).append(n)
         return alive, dead
 
     def alive_nodes(self) -> List[str]:
@@ -126,37 +139,123 @@ class ElasticManager:
     def dead_nodes(self) -> List[str]:
         return self._snapshot()[1]
 
+    # -- elastic membership (level == ELASTIC) ------------------------------
+    def announce_join(self):
+        """A new node asks to join the job: append to the join log and
+        start heartbeating; the cluster re-rendezvouses at the next
+        watch() (reference scale-out path)."""
+        idx = self.store.add(f"{self.prefix}/joinlog/next", 1)
+        self.store.set(f"{self.prefix}/joinlog/{idx}", self.node_id)
+
+    def pending_joiners(self) -> List[str]:
+        """Announced nodes not yet admitted into the membership, oldest
+        first, only those actually heartbeating."""
+        n = self.store.add(f"{self.prefix}/joinlog/next", 0)
+        members = set(self._members())
+        out = []
+        for i in range(1, n + 1):
+            try:
+                node = self.store.get(f"{self.prefix}/joinlog/{i}",
+                                      wait=False).decode()
+            except KeyError:
+                continue
+            try:
+                self.store.get(f"{self.prefix}/joinlog/done/{node}",
+                               wait=False)
+                continue   # already admitted once
+            except KeyError:
+                pass
+            if node and node not in members and node not in out \
+                    and self._hb_alive(node):
+                out.append(node)
+        return out
+
+    def accept_joiners(self) -> List[str]:
+        """Fold pending joiners into the registered membership (launcher
+        calls this while re-rendezvousing after a scale-up RESTART): dead
+        members are dropped first, then joiners are admitted oldest-first
+        up to max_np; joiners that still don't fit stay pending for the
+        next cycle. Returns the new member list."""
+        live, _ = self._snapshot()
+        joiners = self.pending_joiners()
+        admitted = joiners[:max(self.max_np - len(live), 0)]
+        members = live + admitted
+        self.register_nodes(members)
+        for node in admitted:
+            self.store.set(f"{self.prefix}/joinlog/done/{node}", "1")
+        return members
+
+    def drop_dead(self) -> List[str]:
+        """Shrink the registered membership to the live nodes (launcher
+        calls this on a scale-down RESTART). Returns the new member list."""
+        alive, _ = self._snapshot()
+        self.register_nodes(alive)
+        return alive
+
     # -- health decision (parity: manager's watch loop outcome) -----------
     def watch(self) -> str:
-        """One poll: HOLD if healthy, RESTART if a member died (fault
-        tolerance), EXIT if membership can never reach np_target."""
+        """One poll: HOLD if healthy, RESTART when membership must change
+        shape (a member died, or — at ELASTIC level — new nodes can scale
+        the job up), EXIT when the job cannot reach min_np."""
         alive, dead = self._snapshot()
-        if len(alive) >= self.np_target and not dead:
-            return ElasticStatus.HOLD
         if self.level == ElasticLevel.FAULT_TOLERANCE:
+            if len(alive) >= self.np_target and not dead:
+                return ElasticStatus.HOLD
             return ElasticStatus.RESTART
-        # ELASTIC: shrink is acceptable down to 1 node
-        return ElasticStatus.RESTART if alive else ElasticStatus.EXIT
+        # ELASTIC
+        joiners = self.pending_joiners()
+        if dead:
+            return (ElasticStatus.RESTART
+                    if len(alive) + len(joiners) >= self.min_np
+                    else ElasticStatus.EXIT)
+        if joiners and len(alive) < self.max_np:
+            return ElasticStatus.RESTART   # scale up
+        if len(alive) >= self.min_np:
+            return ElasticStatus.HOLD
+        return (ElasticStatus.RESTART if joiners else ElasticStatus.EXIT)
 
     def signal_restart(self):
-        """Bump the job epoch — every node's training loop polls this and
-        re-enters rendezvous (the reference's relaunch signal)."""
+        """Bump the job epoch — every node's training loop observes this
+        and re-enters rendezvous (the reference's relaunch signal)."""
         self.store.add(self._epoch_key, 1)
 
     def current_epoch(self) -> int:
         return self.store.add(self._epoch_key, 0)
 
+    def wait_restart_signal(self, timeout: float) -> Optional[int]:
+        """Block on the native WATCH until signal_restart() advances the
+        epoch past what this manager last observed (no polling; a peer
+        merely reading current_epoch() — which may create the key at 0 —
+        never wakes us). Returns the new epoch, or None on timeout."""
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        while True:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                return None
+            try:
+                ver, val = self.store.watch(self._epoch_key,
+                                            self._epoch_ver, remaining)
+            except TimeoutError:
+                return None
+            self._epoch_ver = ver
+            epoch = int(val or b"0")
+            if epoch > self._last_epoch:
+                self._last_epoch = epoch
+                return epoch
+
 
 def _beat_loop(ref, stop_event, interval):
-    """Heartbeat loop resolving the manager through a weak ref each tick:
-    when the manager is garbage (abandoned without stop()), the thread
-    exits instead of pinning it alive forever."""
+    """Lease-renewal loop resolving the manager through a weak ref each
+    tick: when the manager is garbage (abandoned without stop()), the
+    thread exits and the server expires the lease — peers see us dead."""
     while not stop_event.wait(interval):
         m = ref()
         if m is None:
             return
         try:
-            m.store.add(f"{m.prefix}/hb/{m.node_id}", 1)
+            m.store.lease_set(f"{m.prefix}/hb/{m.node_id}", "1",
+                              ttl=m.timeout)
         except Exception:
             return  # store gone: the watcher will see us dead
         del m  # don't hold the strong ref across the sleep

@@ -47,7 +47,15 @@ def _lib():
                                           ctypes.c_int64]),
                       ("kv_client_del", [ctypes.c_void_p, ctypes.c_char_p]),
                       ("kv_client_numkeys", [ctypes.c_void_p]),
-                      ("kv_client_ping", [ctypes.c_void_p])]:
+                      ("kv_client_ping", [ctypes.c_void_p]),
+                      ("kv_client_lease_set",
+                       [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                        ctypes.c_uint32, ctypes.c_int64]),
+                      ("kv_client_watch",
+                       [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                        ctypes.c_int64, ctypes.c_char_p, ctypes.c_uint32,
+                        ctypes.POINTER(ctypes.c_int64),
+                        ctypes.POINTER(ctypes.c_int32)])]:
         getattr(lib, fn).restype = ctypes.c_int64
         getattr(lib, fn).argtypes = extra
     return lib
@@ -157,6 +165,51 @@ class TCPStore:
             raise TimeoutError(f"TCPStore.wait({key}): timed out after {t}s")
         if r < 0:
             raise RuntimeError(f"TCPStore.wait({key}) failed: {r}")
+
+    def lease_set(self, key: str, value, ttl: float) -> None:
+        """Set ``key`` with a server-side TTL: unless renewed by another
+        lease_set within ``ttl`` seconds, the server expires it (the etcd
+        lease analog — elastic heartbeats ride on this, so a dead node's
+        key vanishes without any watcher-side clock bookkeeping)."""
+        if isinstance(value, str):
+            value = value.encode()
+        r = self._lib.kv_client_lease_set(self._conn(), key.encode(), value,
+                                          len(value), int(ttl * 1000))
+        if r < 0:
+            raise RuntimeError(f"TCPStore.lease_set({key}) failed: {r}")
+
+    def watch(self, key: str, last_version: int = 0,
+              timeout: Optional[float] = None):
+        """Block until the key's version exceeds ``last_version`` — any
+        set / add / lease_set / delete / lease expiry bumps it. Returns
+        ``(version, value_bytes_or_None)``; raises TimeoutError on timeout
+        (a sub-millisecond timeout still means "poll once", never "wait
+        forever"). Pass the returned version back in to resume watching."""
+        t = self.timeout if timeout is None else timeout
+        ver = ctypes.c_int64(0)
+        present = ctypes.c_int32(0)
+        buf = ctypes.create_string_buffer(4096)
+        n = self._lib.kv_client_watch(self._conn(), key.encode(),
+                                      last_version, max(1, int(t * 1000)),
+                                      buf, len(buf), ctypes.byref(ver),
+                                      ctypes.byref(present))
+        if n == -2:
+            raise TimeoutError(
+                f"TCPStore.watch({key}): no change past version "
+                f"{last_version} within {t}s")
+        if n < 0:
+            raise RuntimeError(f"TCPStore.watch({key}) failed: {n}")
+        if not present.value:
+            return int(ver.value), None
+        if n > len(buf):
+            # oversized value: re-read in full (the version still tells the
+            # caller which change woke them; a racing overwrite just means
+            # an even fresher value)
+            try:
+                return int(ver.value), self.get(key, wait=False)
+            except KeyError:
+                return int(ver.value), None
+        return int(ver.value), buf.raw[:n]
 
     def delete_key(self, key: str) -> bool:
         return self._lib.kv_client_del(self._conn(), key.encode()) > 0
