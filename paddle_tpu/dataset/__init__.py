@@ -1,0 +1,10 @@
+"""paddle.dataset parity namespace (legacy dataset loaders).
+
+Parsers are fully functional over files cached in ``common.DATA_HOME``;
+this environment has no network egress, so ``common.download`` validates
+the cache instead of fetching (it errors with exact placement
+instructions when a file is missing).
+"""
+from . import cifar, common, imdb, imikolov, mnist, uci_housing  # noqa: F401
+
+__all__ = ["cifar", "common", "imdb", "imikolov", "mnist", "uci_housing"]

@@ -1,0 +1,57 @@
+"""CIFAR-10/100 reader (parity: python/paddle/dataset/cifar.py — pickled
+batches inside the official tar.gz; yields (image[3072] float32 in [0,1],
+label int))."""
+from __future__ import annotations
+
+import pickle
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+URL_PREFIX = "https://dataset.bj.bcebos.com/cifar/"
+CIFAR10_URL = URL_PREFIX + "cifar-10-python.tar.gz"
+CIFAR100_URL = URL_PREFIX + "cifar-100-python.tar.gz"
+
+
+def reader_creator(filename, sub_name, dataname="data",
+                   labelname="labels"):
+    def reader():
+        with tarfile.open(filename, mode="r") as tf:
+            names = [n for n in tf.getnames() if sub_name in n]
+            for name in sorted(names):
+                f = tf.extractfile(name)
+                if f is None:
+                    continue
+                batch = pickle.load(f, encoding="bytes")
+                data = batch[dataname.encode()]
+                labels = batch.get(labelname.encode())
+                if labels is None:
+                    continue
+                data = np.asarray(data, np.float32) / 255.0
+                for row, label in zip(data, labels):
+                    yield row, int(label)
+    return reader
+
+
+def train10():
+    return reader_creator(common.download(CIFAR10_URL, "cifar"),
+                          "data_batch")
+
+
+def test10():
+    return reader_creator(common.download(CIFAR10_URL, "cifar"),
+                          "test_batch")
+
+
+def train100():
+    return reader_creator(common.download(CIFAR100_URL, "cifar"),
+                          "train", labelname="fine_labels")
+
+
+def test100():
+    return reader_creator(common.download(CIFAR100_URL, "cifar"),
+                          "test", labelname="fine_labels")

@@ -1,0 +1,100 @@
+"""Dataset cache plumbing (parity: python/paddle/dataset/common.py —
+DATA_HOME, md5file, download-with-checksum, fetch_all).
+
+This environment has zero network egress, so ``download`` verifies a
+pre-placed cache file instead of fetching: if the file is present in
+DATA_HOME with the right md5 it is used; otherwise a clear error tells the
+user exactly where to put it. The parsers in the sibling modules are fully
+functional over the cached files.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+
+__all__ = ["DATA_HOME", "md5file", "download", "fetch_all", "split",
+           "cluster_files_reader"]
+
+DATA_HOME = os.path.join(
+    os.environ.get("PADDLE_TPU_DATA_HOME",
+                   os.path.join(os.path.expanduser("~"), ".cache",
+                                "paddle_tpu", "dataset")))
+
+
+def must_mkdirs(path):
+    os.makedirs(path, exist_ok=True)
+
+
+must_mkdirs(DATA_HOME)
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum=None, save_name=None) -> str:
+    """Return the path of the cached file for ``url``; never touches the
+    network (zero-egress environment). Raises with placement instructions
+    when the file is absent or fails its checksum."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    must_mkdirs(dirname)
+    filename = os.path.join(
+        dirname, save_name if save_name else url.split("/")[-1])
+    if os.path.exists(filename):
+        if md5sum is None or md5file(filename) == md5sum:
+            return filename
+        raise RuntimeError(
+            f"dataset cache {filename} fails md5 check "
+            f"(want {md5sum}, got {md5file(filename)}); re-place the file")
+    raise RuntimeError(
+        f"dataset file not cached and this environment has no network "
+        f"egress: place the file from {url} at {filename}")
+
+
+def fetch_all():
+    raise RuntimeError(
+        "fetch_all: no network egress in this environment; pre-place "
+        f"dataset files under {DATA_HOME}/<module>/")
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=pickle.dump):
+    """Split a reader's items into pickled chunk files of ``line_count``."""
+    lines = []
+    idx = 0
+    written = []
+    for item in reader():
+        lines.append(item)
+        if len(lines) >= line_count:
+            path = suffix % idx
+            with open(path, "wb") as f:
+                dumper(lines, f)
+            written.append(path)
+            lines = []
+            idx += 1
+    if lines:
+        path = suffix % idx
+        with open(path, "wb") as f:
+            dumper(lines, f)
+        written.append(path)
+    return written
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=pickle.load):
+    """Round-robin chunk files across trainers (reference :184)."""
+    import glob
+
+    def creator():
+        names = sorted(glob.glob(files_pattern))
+        for i, name in enumerate(names):
+            if i % trainer_count != trainer_id:
+                continue
+            with open(name, "rb") as f:
+                for item in loader(f):
+                    yield item
+    return creator

@@ -1,0 +1,78 @@
+"""IMDB sentiment reader (parity: python/paddle/dataset/imdb.py — aclImdb
+tar: pos/neg review files, word-frequency dict, id sequences + 0/1
+label)."""
+from __future__ import annotations
+
+import collections
+import re
+import string
+import tarfile
+
+from . import common
+
+__all__ = ["build_dict", "train", "test", "word_dict"]
+
+URL = "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz"
+
+
+def tokenize(text: str):
+    text = text.lower().translate(
+        str.maketrans("", "", string.punctuation))
+    return text.split()
+
+
+def _doc_reader(tar_path, pattern):
+    pat = re.compile(pattern)
+
+    def reader():
+        with tarfile.open(tar_path, mode="r") as tf:
+            for member in tf.getmembers():
+                if not pat.match(member.name):
+                    continue
+                f = tf.extractfile(member)
+                if f is None:
+                    continue
+                yield tokenize(f.read().decode("utf-8", "ignore"))
+    return reader
+
+
+def build_dict(pattern, cutoff, tar_path=None):
+    """word -> id by descending frequency; words with freq < cutoff drop;
+    '<unk>' is the last id."""
+    tar_path = tar_path or common.download(URL, "imdb")
+    freq: collections.Counter = collections.Counter()
+    for doc in _doc_reader(tar_path, pattern)():
+        freq.update(doc)
+    items = [(w, c) for w, c in freq.items() if c >= cutoff]
+    items.sort(key=lambda wc: (-wc[1], wc[0]))
+    word_idx = {w: i for i, (w, _) in enumerate(items)}
+    word_idx["<unk>"] = len(word_idx)
+    return word_idx
+
+
+def _labeled(tar_path, pos_pattern, neg_pattern, word_idx):
+    unk = word_idx["<unk>"]
+
+    def reader():
+        for doc in _doc_reader(tar_path, pos_pattern)():
+            yield [word_idx.get(w, unk) for w in doc], 0
+        for doc in _doc_reader(tar_path, neg_pattern)():
+            yield [word_idx.get(w, unk) for w in doc], 1
+    return reader
+
+
+def word_dict(cutoff=150):
+    return build_dict("aclImdb/((train)|(test))/((pos)|(neg))/.*\\.txt$",
+                      cutoff)
+
+
+def train(word_idx, tar_path=None):
+    tar_path = tar_path or common.download(URL, "imdb")
+    return _labeled(tar_path, "aclImdb/train/pos/.*\\.txt$",
+                    "aclImdb/train/neg/.*\\.txt$", word_idx)
+
+
+def test(word_idx, tar_path=None):
+    tar_path = tar_path or common.download(URL, "imdb")
+    return _labeled(tar_path, "aclImdb/test/pos/.*\\.txt$",
+                    "aclImdb/test/neg/.*\\.txt$", word_idx)

@@ -1,0 +1,67 @@
+"""PTB / imikolov language-model reader (parity:
+python/paddle/dataset/imikolov.py — n-gram or sequence modes over the
+simple-examples tarball)."""
+from __future__ import annotations
+
+import collections
+import tarfile
+
+from . import common
+
+__all__ = ["build_dict", "train", "test"]
+
+URL = "https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tgz"
+TRAIN_FILE = "./simple-examples/data/ptb.train.txt"
+TEST_FILE = "./simple-examples/data/ptb.valid.txt"
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def _lines(tar_path, member):
+    with tarfile.open(tar_path, mode="r") as tf:
+        f = tf.extractfile(member)
+        if f is None:
+            raise KeyError(member)
+        for line in f.read().decode("utf-8").splitlines():
+            yield line.strip().split()
+
+
+def build_dict(min_word_freq=50, tar_path=None):
+    tar_path = tar_path or common.download(URL, "imikolov")
+    freq: collections.Counter = collections.Counter()
+    for words in _lines(tar_path, TRAIN_FILE):
+        freq.update(words)
+    freq.pop("<unk>", None)
+    items = [(w, c) for w, c in freq.items() if c >= min_word_freq]
+    items.sort(key=lambda wc: (-wc[1], wc[0]))
+    word_idx = {w: i for i, (w, _) in enumerate(items)}
+    word_idx["<unk>"] = len(word_idx)
+    return word_idx
+
+
+def reader_creator(member, word_idx, n, data_type, tar_path=None):
+    tar_path = tar_path or common.download(URL, "imikolov")
+    unk = word_idx["<unk>"]
+
+    def reader():
+        for words in _lines(tar_path, member):
+            if data_type == DataType.NGRAM:
+                ids = [word_idx.get(w, unk)
+                       for w in ["<s>"] * (n - 1) + words + ["<e>"]]
+                for i in range(n, len(ids) + 1):
+                    yield tuple(ids[i - n:i])
+            else:
+                ids = [word_idx.get(w, unk) for w in words]
+                yield ids[:-1], ids[1:]
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM, tar_path=None):
+    return reader_creator(TRAIN_FILE, word_idx, n, data_type, tar_path)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM, tar_path=None):
+    return reader_creator(TEST_FILE, word_idx, n, data_type, tar_path)

@@ -1,0 +1,58 @@
+"""MNIST reader (parity: python/paddle/dataset/mnist.py — IDX-format
+parser yielding (image[784] float32 in [-1, 1], label int))."""
+from __future__ import annotations
+
+import gzip
+import struct
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+URL_PREFIX = "https://dataset.bj.bcebos.com/mnist/"
+TRAIN_IMAGE = "train-images-idx3-ubyte.gz"
+TRAIN_LABEL = "train-labels-idx1-ubyte.gz"
+TEST_IMAGE = "t10k-images-idx3-ubyte.gz"
+TEST_LABEL = "t10k-labels-idx1-ubyte.gz"
+
+
+def reader_creator(image_filename, label_filename, buffer_size=100):
+    def reader():
+        with gzip.open(image_filename, "rb") as imgf, \
+                gzip.open(label_filename, "rb") as lblf:
+            magic, n, rows, cols = struct.unpack(">IIII", imgf.read(16))
+            if magic != 2051:
+                raise ValueError(
+                    f"{image_filename}: bad IDX image magic {magic}")
+            lmagic, ln = struct.unpack(">II", lblf.read(8))
+            if lmagic != 2049:
+                raise ValueError(
+                    f"{label_filename}: bad IDX label magic {lmagic}")
+            if n != ln:
+                raise ValueError(f"image/label count mismatch: {n} vs {ln}")
+            per = rows * cols
+            remaining = n
+            while remaining > 0:
+                k = min(buffer_size, remaining)
+                imgs = np.frombuffer(imgf.read(k * per), np.uint8)
+                imgs = imgs.reshape(k, per).astype(np.float32)
+                imgs = imgs / 255.0 * 2.0 - 1.0
+                labels = np.frombuffer(lblf.read(k), np.uint8)
+                for i in range(k):
+                    yield imgs[i], int(labels[i])
+                remaining -= k
+    return reader
+
+
+def train():
+    return reader_creator(
+        common.download(URL_PREFIX + TRAIN_IMAGE, "mnist"),
+        common.download(URL_PREFIX + TRAIN_LABEL, "mnist"))
+
+
+def test():
+    return reader_creator(
+        common.download(URL_PREFIX + TEST_IMAGE, "mnist"),
+        common.download(URL_PREFIX + TEST_LABEL, "mnist"))

@@ -1,0 +1,43 @@
+"""UCI housing reader (parity: python/paddle/dataset/uci_housing.py —
+whitespace-separated 14-column text; features normalized to [-1, 1] by
+train-split ranges, 80/20 train/test split)."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+URL = "https://archive.ics.uci.edu/ml/machine-learning-databases/housing/housing.data"
+FEATURE_NUM = 14
+
+
+def load_data(filename, feature_num=FEATURE_NUM, ratio=0.8):
+    data = np.loadtxt(filename).reshape(-1, feature_num)
+    split = int(data.shape[0] * ratio)
+    maxs = data[:split].max(axis=0)
+    mins = data[:split].min(axis=0)
+    span = np.where(maxs > mins, maxs - mins, 1.0)
+    feats = (data[:, :-1] - mins[:-1]) / span[:-1] * 2.0 - 1.0
+    data = np.concatenate(
+        [feats.astype(np.float32),
+         data[:, -1:].astype(np.float32)], axis=1)
+    return data[:split], data[split:]
+
+
+def _creator(part):
+    def reader():
+        for row in part:
+            yield row[:-1], row[-1:]
+    return reader
+
+
+def train():
+    tr, _ = load_data(common.download(URL, "uci_housing"))
+    return _creator(tr)
+
+
+def test():
+    _, te = load_data(common.download(URL, "uci_housing"))
+    return _creator(te)

@@ -1,0 +1,8 @@
+"""paddle.reader parity namespace (decorator pipeline)."""
+from .decorator import (cache, map_readers, shuffle, chain, compose,  # noqa: F401
+                        buffered, firstn, xmap_readers,
+                        multiprocess_reader, ComposeNotAligned)
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers", "multiprocess_reader",
+           "ComposeNotAligned"]

@@ -1,0 +1,238 @@
+"""Reader decorators (parity: python/paddle/reader/decorator.py — the
+legacy composable-iterator pipeline: cache/map/shuffle/chain/compose/
+buffered/firstn/xmap/multiprocess).
+
+A "reader creator" is a zero-arg callable returning an iterable. These are
+host-side convenience shims; the TPU input path is ``paddle_tpu.io
+.DataLoader`` (shared-memory queue + device prefetch), which these
+decorators can feed.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random
+import threading
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers", "multiprocess_reader",
+           "ComposeNotAligned"]
+
+
+def cache(reader):
+    """Materialize the reader's first pass; replay from memory after."""
+    all_data = []
+    state = {"filled": False}
+
+    def creator():
+        if not state["filled"]:
+            all_data.extend(reader())
+            state["filled"] = True
+        return iter(all_data)
+    return creator
+
+
+def map_readers(func, *readers):
+    """Element-wise ``func`` over parallel readers (zip semantics)."""
+    def creator():
+        its = [r() for r in readers]
+        for args in zip(*its):
+            yield func(*args)
+    return creator
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle: fill ``buf_size`` items, emit in random order."""
+    def creator():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            yield from buf
+    return creator
+
+
+def chain(*readers):
+    """Concatenate readers back to back."""
+    def creator():
+        return itertools.chain(*[r() for r in readers])
+    return creator
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    """Tuple-concatenate parallel readers: (a,) + (b1, b2) -> (a, b1, b2).
+    ``check_alignment=True`` (default) raises ComposeNotAligned when one
+    reader runs out before the others."""
+    check_alignment = kwargs.pop("check_alignment", True)
+    if kwargs:
+        raise TypeError(f"compose: unexpected kwargs {sorted(kwargs)}")
+
+    def to_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def creator():
+        its = [r() for r in readers]
+        if not check_alignment:
+            for items in zip(*its):
+                yield sum((to_tuple(i) for i in items), ())
+            return
+        sentinel = object()
+        for items in itertools.zip_longest(*its, fillvalue=sentinel):
+            if any(i is sentinel for i in items):
+                raise ComposeNotAligned(
+                    "compose: input readers have different lengths")
+            yield sum((to_tuple(i) for i in items), ())
+    return creator
+
+
+def buffered(reader, size):
+    """Decouple producer and consumer with a bounded background queue."""
+    end = object()
+
+    def creator():
+        q: "queue.Queue" = queue.Queue(maxsize=size)
+        err = []
+
+        def produce():
+            try:
+                for item in reader():
+                    q.put(item)
+            except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
+                err.append(e)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    return creator
+
+
+def firstn(reader, n):
+    """First ``n`` items only."""
+    def creator():
+        return itertools.islice(reader(), n)
+    return creator
+
+
+class XmapEndSignal:
+    pass
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel ``mapper`` over ``reader`` with ``process_num`` worker
+    threads and a ``buffer_size``-bounded queue; ``order=True`` preserves
+    input order. (Threads, not processes: the mappers here are IO/numpy
+    transforms that release the GIL; the true multi-process input path is
+    io.DataLoader's shm queue.)"""
+    end = XmapEndSignal()
+
+    def creator():
+        in_q: "queue.Queue" = queue.Queue(buffer_size)
+        out_q: "queue.Queue" = queue.Queue(buffer_size)
+        err = []
+
+        def feed():
+            try:
+                for i, item in enumerate(reader()):
+                    in_q.put((i, item))
+            except BaseException as e:  # noqa: BLE001
+                err.append(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end)
+
+        def work():
+            while True:
+                got = in_q.get()
+                if isinstance(got, XmapEndSignal):
+                    out_q.put(end)
+                    return
+                i, item = got
+                try:
+                    out_q.put((i, mapper(item)))
+                except BaseException as e:  # noqa: BLE001
+                    err.append(e)
+                    out_q.put(end)
+                    return
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if not order:
+            while finished < process_num:
+                got = out_q.get()
+                if isinstance(got, XmapEndSignal):
+                    finished += 1
+                    continue
+                yield got[1]
+        else:
+            pending: dict = {}
+            next_i = 0
+            while finished < process_num:
+                got = out_q.get()
+                if isinstance(got, XmapEndSignal):
+                    finished += 1
+                    continue
+                pending[got[0]] = got[1]
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+            while next_i in pending:
+                yield pending.pop(next_i)
+                next_i += 1
+        if err:
+            raise err[0]
+    return creator
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave multiple readers, each drained on its own worker thread
+    into a shared bounded queue (the reference forks processes; the real
+    multi-process path here is io.DataLoader — this keeps the API and the
+    interleaving semantics)."""
+    del use_pipe
+
+    def creator():
+        q: "queue.Queue" = queue.Queue(queue_size)
+        end = object()
+        err = []
+
+        def drain(r):
+            try:
+                for item in r():
+                    q.put(item)
+            except BaseException as e:  # noqa: BLE001
+                err.append(e)
+            finally:
+                q.put(end)
+
+        for r in readers:
+            threading.Thread(target=drain, args=(r,), daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            item = q.get()
+            if item is end:
+                finished += 1
+                continue
+            yield item
+        if err:
+            raise err[0]
+    return creator

@@ -1,0 +1,220 @@
+"""paddle.reader decorators + paddle.dataset parsers (reference test
+models: test/legacy_test/test_multiprocess_reader_exception.py and the
+dataset unittests — parsers validated on synthetic files in the official
+formats, since this environment cannot download)."""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu.reader as reader
+from paddle_tpu.dataset import cifar, common, imdb, imikolov, mnist, \
+    uci_housing
+
+
+def r(seq):
+    return lambda: iter(list(seq))
+
+
+class TestDecorators:
+    def test_cache_replays_single_pass(self):
+        calls = []
+
+        def once():
+            calls.append(1)
+            yield from range(5)
+        c = reader.cache(lambda: once())
+        assert list(c()) == list(range(5))
+        assert list(c()) == list(range(5))
+        assert len(calls) == 1
+
+    def test_map_readers(self):
+        c = reader.map_readers(lambda a, b: a + b, r([1, 2]), r([10, 20]))
+        assert list(c()) == [11, 22]
+
+    def test_shuffle_preserves_multiset(self):
+        c = reader.shuffle(r(range(100)), buf_size=16)
+        out = list(c())
+        assert sorted(out) == list(range(100))
+
+    def test_chain(self):
+        assert list(reader.chain(r([1]), r([2, 3]))()) == [1, 2, 3]
+
+    def test_compose_flattens_tuples(self):
+        c = reader.compose(r([1, 2]), r([(10, 11), (20, 21)]))
+        assert list(c()) == [(1, 10, 11), (2, 20, 21)]
+
+    def test_compose_alignment_error(self):
+        c = reader.compose(r([1, 2, 3]), r([1]))
+        with pytest.raises(reader.ComposeNotAligned):
+            list(c())
+        c2 = reader.compose(r([1, 2, 3]), r([1]), check_alignment=False)
+        assert list(c2()) == [(1, 1)]
+
+    def test_buffered_order_and_error_propagation(self):
+        c = reader.buffered(r(range(50)), size=4)
+        assert list(c()) == list(range(50))
+
+        def boom():
+            yield 1
+            raise ValueError("boom")
+        with pytest.raises(ValueError, match="boom"):
+            list(reader.buffered(lambda: boom(), size=2)())
+
+    def test_firstn(self):
+        assert list(reader.firstn(r(range(100)), 3)()) == [0, 1, 2]
+
+    def test_xmap_unordered_multiset(self):
+        c = reader.xmap_readers(lambda x: x * 2, r(range(40)),
+                                process_num=4, buffer_size=8)
+        assert sorted(c()) == [x * 2 for x in range(40)]
+
+    def test_xmap_ordered(self):
+        c = reader.xmap_readers(lambda x: x * 2, r(range(40)),
+                                process_num=4, buffer_size=8, order=True)
+        assert list(c()) == [x * 2 for x in range(40)]
+
+    def test_multiprocess_reader_interleave(self):
+        c = reader.multiprocess_reader([r(range(10)), r(range(10, 20))])
+        assert sorted(c()) == list(range(20))
+
+
+@pytest.fixture()
+def data_home(tmp_path, monkeypatch):
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    return tmp_path
+
+
+class TestCommon:
+    def test_download_missing_names_placement(self, data_home):
+        with pytest.raises(RuntimeError, match="no network egress"):
+            common.download("http://x/y/file.bin", "mod")
+
+    def test_download_cached_with_md5(self, data_home):
+        p = data_home / "mod"
+        p.mkdir()
+        (p / "file.bin").write_bytes(b"hello")
+        got = common.download("http://x/y/file.bin", "mod",
+                              md5sum=common.md5file(str(p / "file.bin")))
+        assert got == str(p / "file.bin")
+        with pytest.raises(RuntimeError, match="md5"):
+            common.download("http://x/y/file.bin", "mod", md5sum="0" * 32)
+
+    def test_split_and_cluster_reader(self, tmp_path):
+        pattern = str(tmp_path / "chunk-%05d.pickle")
+        files = common.split(r(list(range(10))), 4, suffix=pattern)
+        assert len(files) == 3
+        c0 = common.cluster_files_reader(
+            str(tmp_path / "chunk-*.pickle"), 2, 0)
+        c1 = common.cluster_files_reader(
+            str(tmp_path / "chunk-*.pickle"), 2, 1)
+        assert sorted(list(c0()) + list(c1())) == list(range(10))
+
+
+def _write_idx(tmp, n=7):
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (n, 28, 28), dtype=np.uint8)
+    labels = rng.randint(0, 10, (n,), dtype=np.uint8)
+    ip = tmp / "mnist" / mnist.TRAIN_IMAGE
+    lp = tmp / "mnist" / mnist.TRAIN_LABEL
+    ip.parent.mkdir(exist_ok=True)
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return imgs, labels
+
+
+class TestParsers:
+    def test_mnist_idx_roundtrip(self, data_home):
+        imgs, labels = _write_idx(data_home)
+        out = list(mnist.train()())
+        assert len(out) == len(labels)
+        np.testing.assert_array_equal([l for _, l in out], labels)
+        expect0 = imgs[0].reshape(-1).astype(np.float32) / 255 * 2 - 1
+        np.testing.assert_allclose(out[0][0], expect0, rtol=1e-6)
+
+    def test_uci_housing_normalization_and_split(self, data_home):
+        rng = np.random.RandomState(1)
+        raw = rng.rand(20, 14) * 100
+        d = data_home / "uci_housing"
+        d.mkdir()
+        np.savetxt(d / "housing.data", raw)
+        tr = list(uci_housing.train()())
+        te = list(uci_housing.test()())
+        assert len(tr) == 16 and len(te) == 4
+        feats = np.stack([x for x, _ in tr])
+        assert feats.min() >= -1.0 - 1e-6 and feats.max() <= 1.0 + 1e-6
+        np.testing.assert_allclose(tr[0][1], raw[0, -1:], rtol=1e-5)
+
+    def test_cifar10_tar(self, data_home):
+        rng = np.random.RandomState(2)
+        d = data_home / "cifar"
+        d.mkdir()
+        tar_path = d / "cifar-10-python.tar.gz"
+        batch = {b"data": rng.randint(0, 256, (5, 3072), dtype=np.uint8),
+                 b"labels": [0, 1, 2, 3, 4]}
+        import io as _io
+        with tarfile.open(tar_path, "w:gz") as tf:
+            blob = pickle.dumps(batch)
+            info = tarfile.TarInfo("cifar-10-batches-py/data_batch_1")
+            info.size = len(blob)
+            tf.addfile(info, _io.BytesIO(blob))
+        out = list(cifar.train10()())
+        assert [l for _, l in out] == [0, 1, 2, 3, 4]
+        assert out[0][0].dtype == np.float32
+        assert 0.0 <= out[0][0].min() and out[0][0].max() <= 1.0
+
+    def test_imdb_dict_and_labels(self, data_home):
+        d = data_home / "imdb"
+        d.mkdir()
+        tar_path = d / "aclImdb_v1.tar.gz"
+        import io as _io
+        with tarfile.open(tar_path, "w:gz") as tf:
+            for name, text in [
+                ("aclImdb/train/pos/0_9.txt", "great great movie"),
+                ("aclImdb/train/neg/0_1.txt", "bad movie"),
+            ]:
+                blob = text.encode()
+                info = tarfile.TarInfo(name)
+                info.size = len(blob)
+                tf.addfile(info, _io.BytesIO(blob))
+        word_idx = imdb.build_dict(
+            "aclImdb/train/((pos)|(neg))/.*\\.txt$", cutoff=1,
+            tar_path=str(tar_path))
+        # freq order: great(2), then bad/movie(1 each, alpha)
+        assert word_idx["great"] == 0
+        assert word_idx["movie"] < word_idx["<unk>"]
+        out = list(imdb.train(word_idx, tar_path=str(tar_path))())
+        assert len(out) == 2
+        assert out[0][1] == 0 and out[1][1] == 1  # pos first, then neg
+        assert out[0][0] == [word_idx["great"]] * 2 + [word_idx["movie"]]
+
+    def test_imikolov_ngram_and_seq(self, data_home):
+        d = data_home / "imikolov"
+        d.mkdir()
+        tar_path = d / "simple-examples.tgz"
+        import io as _io
+        text = "a b c\nb c d\n"
+        with tarfile.open(tar_path, "w:gz") as tf:
+            for member in (imikolov.TRAIN_FILE, imikolov.TEST_FILE):
+                blob = text.encode()
+                info = tarfile.TarInfo(member)
+                info.size = len(blob)
+                tf.addfile(info, _io.BytesIO(blob))
+        word_idx = imikolov.build_dict(min_word_freq=1,
+                                       tar_path=str(tar_path))
+        assert set(word_idx) == {"a", "b", "c", "d", "<unk>"}
+        grams = list(imikolov.train(word_idx, 2,
+                                    tar_path=str(tar_path))())
+        assert all(len(g) == 2 for g in grams)
+        seqs = list(imikolov.train(word_idx, 2, imikolov.DataType.SEQ,
+                                   tar_path=str(tar_path))())
+        assert seqs[0][0] == [word_idx["a"], word_idx["b"]]
+        assert seqs[0][1] == [word_idx["b"], word_idx["c"]]
