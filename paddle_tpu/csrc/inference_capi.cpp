@@ -1,0 +1,357 @@
+// C inference API (capability parity: paddle/fluid/inference/capi_exp/ —
+// pd_config.h / pd_predictor.h / pd_tensor.h: a pure-C surface so non-
+// Python deployments can load a saved model and run it).
+//
+// TPU-native design: the deployment artifact is the serialized StableHLO
+// program written by jit.save, and the execution engine is XLA behind the
+// Python predictor. This C ABI embeds a CPython interpreter and drives
+// paddle_tpu.inference through it — the C consumer links this .so plus
+// libpython, calls PD_* functions, and never writes a line of Python.
+// (The reference's capi similarly wraps its C++ AnalysisPredictor; here
+// the predictor lives where XLA's Python bindings are.)
+//
+// Thread-safety: every entry point takes the GIL (PyGILState_Ensure), so
+// the API may be called from any thread.
+//
+// Build:  g++ -O2 -std=c++17 -shared -fPIC $(python3-config --includes)
+//         -o libpd_inference.so inference_capi.cpp
+//         $(python3-config --ldflags) -lpython3.X
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct PDConfig {
+  std::string model_path;
+};
+
+struct PDPredictor {
+  PyObject* predictor = nullptr;       // paddle_tpu.inference.Predictor
+  std::vector<std::string> input_names;
+  std::vector<std::string> output_names;
+};
+
+struct PDTensor {
+  PDPredictor* owner = nullptr;
+  std::string name;
+  bool is_input = false;
+  std::vector<int32_t> shape;          // set by PD_TensorReshape (inputs)
+};
+
+bool g_we_initialized = false;
+char g_last_error[1024] = {0};
+
+void set_error_from_python() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) {
+        std::strncpy(g_last_error, c, sizeof(g_last_error) - 1);
+        g_last_error[sizeof(g_last_error) - 1] = '\0';
+      }
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+void ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+    // release the GIL acquired by Py_Initialize so PyGILState_Ensure
+    // works from any thread, including this one
+    PyEval_SaveThread();
+  }
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() {
+    ensure_python();
+    st = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+// steals nothing; returns new ref or null
+PyObject* np_module() {
+  static PyObject* np = nullptr;
+  if (np == nullptr) np = PyImport_ImportModule("numpy");
+  Py_XINCREF(np);
+  return np;
+}
+
+PyObject* make_array(const void* data, const char* dtype,
+                     const std::vector<int32_t>& shape) {
+  int64_t count = 1;
+  for (int32_t d : shape) count *= d;
+  int64_t itemsize = std::strcmp(dtype, "float32") == 0 ? 4
+                     : std::strcmp(dtype, "int32") == 0 ? 4
+                                                        : 8;
+  PyObject* np = np_module();
+  if (np == nullptr) return nullptr;
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data), count * itemsize);
+  PyObject* flat =
+      bytes ? PyObject_CallMethod(np, "frombuffer", "Os", bytes, dtype)
+            : nullptr;
+  PyObject* shp = PyTuple_New(shape.size());
+  for (size_t i = 0; i < shape.size(); ++i) {
+    PyTuple_SetItem(shp, i, PyLong_FromLong(shape[i]));
+  }
+  PyObject* arr =
+      flat ? PyObject_CallMethod(flat, "reshape", "O", shp) : nullptr;
+  Py_XDECREF(shp);
+  Py_XDECREF(flat);
+  Py_XDECREF(bytes);
+  Py_DECREF(np);
+  return arr;
+}
+
+PyObject* get_output_array(PDTensor* t) {  // new ref or null
+  PyObject* outputs = PyObject_GetAttrString(t->owner->predictor,
+                                             "_outputs");
+  if (outputs == nullptr) return nullptr;
+  PyObject* arr = PyDict_GetItemString(outputs, t->name.c_str());  // borrowed
+  Py_XINCREF(arr);
+  Py_DECREF(outputs);
+  return arr;
+}
+
+void collect_names(PyObject* pred, const char* method,
+                   std::vector<std::string>* out) {
+  PyObject* names = PyObject_CallMethod(pred, method, nullptr);
+  if (names == nullptr) {
+    set_error_from_python();
+    return;
+  }
+  Py_ssize_t n = PyList_Check(names) ? PyList_Size(names) : 0;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+    if (s != nullptr) out->push_back(s);
+  }
+  Py_DECREF(names);
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* PD_GetLastError() { return g_last_error; }
+
+// ---- config ----
+void* PD_ConfigCreate() { return new PDConfig(); }
+
+void PD_ConfigDestroy(void* c) { delete static_cast<PDConfig*>(c); }
+
+void PD_ConfigSetModel(void* c, const char* model_path,
+                       const char* params_path) {
+  (void)params_path;  // prefix-based layout, like the Python Config
+  static_cast<PDConfig*>(c)->model_path = model_path ? model_path : "";
+}
+
+// ---- predictor ----
+void* PD_PredictorCreate(void* c) {
+  auto* cfg = static_cast<PDConfig*>(c);
+  Gil gil;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (mod == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* pycfg = PyObject_CallMethod(mod, "Config", "s",
+                                        cfg->model_path.c_str());
+  PyObject* pred =
+      pycfg ? PyObject_CallMethod(mod, "create_predictor", "O", pycfg)
+            : nullptr;
+  Py_XDECREF(pycfg);
+  Py_DECREF(mod);
+  if (pred == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  auto* p = new PDPredictor();
+  p->predictor = pred;
+  collect_names(pred, "get_input_names", &p->input_names);
+  return p;
+}
+
+void PD_PredictorDestroy(void* h) {
+  auto* p = static_cast<PDPredictor*>(h);
+  if (p == nullptr) return;
+  {
+    Gil gil;
+    Py_XDECREF(p->predictor);
+  }
+  delete p;
+}
+
+size_t PD_PredictorGetInputNum(void* h) {
+  return static_cast<PDPredictor*>(h)->input_names.size();
+}
+
+size_t PD_PredictorGetOutputNum(void* h) {
+  return static_cast<PDPredictor*>(h)->output_names.size();
+}
+
+const char* PD_PredictorGetInputName(void* h, size_t i) {
+  auto* p = static_cast<PDPredictor*>(h);
+  return i < p->input_names.size() ? p->input_names[i].c_str() : "";
+}
+
+const char* PD_PredictorGetOutputName(void* h, size_t i) {
+  auto* p = static_cast<PDPredictor*>(h);
+  return i < p->output_names.size() ? p->output_names[i].c_str() : "";
+}
+
+void* PD_PredictorGetInputHandle(void* h, const char* name) {
+  auto* t = new PDTensor();
+  t->owner = static_cast<PDPredictor*>(h);
+  t->name = name;
+  t->is_input = true;
+  return t;
+}
+
+void* PD_PredictorGetOutputHandle(void* h, const char* name) {
+  auto* t = new PDTensor();
+  t->owner = static_cast<PDPredictor*>(h);
+  t->name = name;
+  t->is_input = false;
+  return t;
+}
+
+int PD_PredictorRun(void* h) {
+  auto* p = static_cast<PDPredictor*>(h);
+  Gil gil;
+  PyObject* ok = PyObject_CallMethod(p->predictor, "run", nullptr);
+  if (ok == nullptr) {
+    set_error_from_python();
+    return 0;
+  }
+  Py_DECREF(ok);
+  p->output_names.clear();
+  collect_names(p->predictor, "get_output_names", &p->output_names);
+  return 1;
+}
+
+// ---- tensor handles ----
+void PD_TensorDestroy(void* t) { delete static_cast<PDTensor*>(t); }
+
+void PD_TensorReshape(void* th, size_t ndims, const int32_t* shape) {
+  auto* t = static_cast<PDTensor*>(th);
+  t->shape.assign(shape, shape + ndims);
+}
+
+static int copy_from(PDTensor* t, const void* data, const char* dtype) {
+  if (!t->is_input || t->shape.empty()) {
+    std::snprintf(g_last_error, sizeof(g_last_error),
+                  !t->is_input
+                      ? "copy_from on an output handle (%s)"
+                      : "PD_TensorReshape not called before copy_from (%s)",
+                  t->name.c_str());
+    return 0;
+  }
+  Gil gil;
+  PyObject* arr = make_array(data, dtype, t->shape);
+  if (arr == nullptr) {
+    set_error_from_python();
+    return 0;
+  }
+  PyObject* inputs = PyObject_GetAttrString(t->owner->predictor, "_inputs");
+  int ok = 0;
+  if (inputs != nullptr) {
+    ok = PyDict_SetItemString(inputs, t->name.c_str(), arr) == 0;
+    Py_DECREF(inputs);
+  }
+  Py_DECREF(arr);
+  if (!ok) set_error_from_python();
+  return ok;
+}
+
+int PD_TensorCopyFromCpuFloat(void* t, const float* data) {
+  return copy_from(static_cast<PDTensor*>(t), data, "float32");
+}
+
+int PD_TensorCopyFromCpuInt64(void* t, const int64_t* data) {
+  return copy_from(static_cast<PDTensor*>(t), data, "int64");
+}
+
+int PD_TensorCopyFromCpuInt32(void* t, const int32_t* data) {
+  return copy_from(static_cast<PDTensor*>(t), data, "int32");
+}
+
+// returns ndims; fills out_shape (if non-null) with up to max_dims dims
+int PD_TensorGetShape(void* th, int32_t* out_shape, int max_dims) {
+  auto* t = static_cast<PDTensor*>(th);
+  if (t->is_input) {
+    int n = static_cast<int>(t->shape.size());
+    for (int i = 0; out_shape != nullptr && i < n && i < max_dims; ++i) {
+      out_shape[i] = t->shape[i];
+    }
+    return n;
+  }
+  Gil gil;
+  PyObject* arr = get_output_array(t);
+  if (arr == nullptr) return -1;
+  PyObject* shp = PyObject_GetAttrString(arr, "shape");
+  int n = shp != nullptr ? static_cast<int>(PyTuple_Size(shp)) : -1;
+  for (int i = 0; shp != nullptr && out_shape != nullptr && i < n
+                  && i < max_dims; ++i) {
+    out_shape[i] =
+        static_cast<int32_t>(PyLong_AsLong(PyTuple_GetItem(shp, i)));
+  }
+  Py_XDECREF(shp);
+  Py_DECREF(arr);
+  return n;
+}
+
+static int copy_to(PDTensor* t, void* out, const char* dtype) {
+  Gil gil;
+  PyObject* arr = get_output_array(t);
+  if (arr == nullptr) {
+    std::strncpy(g_last_error, "output not found (run() first?)",
+                 sizeof(g_last_error) - 1);
+    return 0;
+  }
+  PyObject* np = np_module();
+  PyObject* cast = np ? PyObject_CallMethod(np, "ascontiguousarray", "Os",
+                                            arr, dtype)
+                      : nullptr;
+  PyObject* bytes =
+      cast ? PyObject_CallMethod(cast, "tobytes", nullptr) : nullptr;
+  int ok = 0;
+  if (bytes != nullptr) {
+    char* buf;
+    Py_ssize_t n;
+    if (PyBytes_AsStringAndSize(bytes, &buf, &n) == 0) {
+      std::memcpy(out, buf, n);
+      ok = 1;
+    }
+  }
+  if (!ok) set_error_from_python();
+  Py_XDECREF(bytes);
+  Py_XDECREF(cast);
+  Py_XDECREF(np);
+  Py_DECREF(arr);
+  return ok;
+}
+
+int PD_TensorCopyToCpuFloat(void* t, float* out) {
+  return copy_to(static_cast<PDTensor*>(t), out, "float32");
+}
+
+int PD_TensorCopyToCpuInt64(void* t, int64_t* out) {
+  return copy_to(static_cast<PDTensor*>(t), out, "int64");
+}
+
+}  // extern "C"

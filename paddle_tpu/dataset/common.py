@@ -23,10 +23,8 @@ DATA_HOME = os.path.join(
 
 
 def must_mkdirs(path):
+    # deferred to first use: importing the package must not write to $HOME
     os.makedirs(path, exist_ok=True)
-
-
-must_mkdirs(DATA_HOME)
 
 
 def md5file(fname: str) -> str:

@@ -43,19 +43,28 @@ def build_dict(min_word_freq=50, tar_path=None):
 
 
 def reader_creator(member, word_idx, n, data_type, tar_path=None):
+    """Reference semantics (imikolov.py reader_creator): NGRAM lines are
+    '<s>' + words + '<e>' and lines shorter than n yield nothing; SEQ
+    yields (['<s>'] + ids, ids + ['<e>']), skipping lines longer than n."""
     tar_path = tar_path or common.download(URL, "imikolov")
     unk = word_idx["<unk>"]
 
     def reader():
         for words in _lines(tar_path, member):
             if data_type == DataType.NGRAM:
-                ids = [word_idx.get(w, unk)
-                       for w in ["<s>"] * (n - 1) + words + ["<e>"]]
+                toks = ["<s>"] + words + ["<e>"]
+                if len(toks) < n:
+                    continue
+                ids = [word_idx.get(w, unk) for w in toks]
                 for i in range(n, len(ids) + 1):
                     yield tuple(ids[i - n:i])
             else:
                 ids = [word_idx.get(w, unk) for w in words]
-                yield ids[:-1], ids[1:]
+                src = [word_idx.get("<s>", unk)] + ids
+                trg = ids + [word_idx.get("<e>", unk)]
+                if n > 0 and len(src) > n:
+                    continue
+                yield src, trg
     return reader
 
 

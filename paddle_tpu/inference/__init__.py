@@ -14,7 +14,10 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType"]
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
+           "convert_to_mixed_precision"]
+
+from .convert import convert_to_mixed_precision  # noqa: E402,F401
 
 
 class PrecisionType:

@@ -253,6 +253,12 @@ def load(path, **configs):
     if os.path.exists(path + _META_SUFFIX):
         with open(path + _META_SUFFIX) as f:
             meta = json.load(f)
+    # non-numpy dtypes (bfloat16) are serialized as uint16 bits with the
+    # true dtype recorded in the meta (inference.convert_to_mixed_precision)
+    for k, dt in (meta.get("param_dtypes") or {}).items():
+        if k in state:
+            import ml_dtypes
+            state[k] = state[k].view(np.dtype(getattr(ml_dtypes, dt)))
     return TranslatedLayer(exported, state, meta)
 
 

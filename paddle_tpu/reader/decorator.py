@@ -20,13 +20,15 @@ __all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
 
 
 def cache(reader):
-    """Materialize the reader's first pass; replay from memory after."""
+    """Materialize the reader's first pass; replay from memory after. A
+    first pass that raises commits nothing, so a retry re-reads cleanly."""
     all_data = []
     state = {"filled": False}
 
     def creator():
         if not state["filled"]:
-            all_data.extend(reader())
+            fresh = list(reader())
+            all_data.extend(fresh)
             state["filled"] = True
         return iter(all_data)
     return creator
@@ -94,32 +96,57 @@ def compose(*readers, **kwargs):
     return creator
 
 
+def _put_unless_stopped(q, item, stop) -> bool:
+    """Bounded put that gives up when the consumer abandoned the
+    generator — a blocked producer thread must never outlive its reader."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _get_unless_stopped(q, stop):
+    while not stop.is_set():
+        try:
+            return q.get(timeout=0.1)
+        except queue.Empty:
+            continue
+    return None
+
+
 def buffered(reader, size):
     """Decouple producer and consumer with a bounded background queue."""
     end = object()
 
     def creator():
         q: "queue.Queue" = queue.Queue(maxsize=size)
+        stop = threading.Event()
         err = []
 
         def produce():
             try:
                 for item in reader():
-                    q.put(item)
+                    if not _put_unless_stopped(q, item, stop):
+                        return
             except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
                 err.append(e)
             finally:
-                q.put(end)
+                _put_unless_stopped(q, end, stop)
 
-        t = threading.Thread(target=produce, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is end:
-                if err:
-                    raise err[0]
-                return
-            yield item
+        threading.Thread(target=produce, daemon=True).start()
+        try:
+            while True:
+                item = q.get()
+                if item is end:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            stop.set()   # unblock the producer if we exit early
     return creator
 
 
@@ -145,30 +172,35 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     def creator():
         in_q: "queue.Queue" = queue.Queue(buffer_size)
         out_q: "queue.Queue" = queue.Queue(buffer_size)
+        stop = threading.Event()
         err = []
 
         def feed():
             try:
                 for i, item in enumerate(reader()):
-                    in_q.put((i, item))
+                    if not _put_unless_stopped(in_q, (i, item), stop):
+                        return
             except BaseException as e:  # noqa: BLE001
                 err.append(e)
             finally:
                 for _ in range(process_num):
-                    in_q.put(end)
+                    if not _put_unless_stopped(in_q, end, stop):
+                        return
 
         def work():
             while True:
-                got = in_q.get()
-                if isinstance(got, XmapEndSignal):
-                    out_q.put(end)
+                got = _get_unless_stopped(in_q, stop)
+                if got is None or isinstance(got, XmapEndSignal):
+                    _put_unless_stopped(out_q, end, stop)
                     return
                 i, item = got
                 try:
-                    out_q.put((i, mapper(item)))
+                    if not _put_unless_stopped(out_q, (i, mapper(item)),
+                                               stop):
+                        return
                 except BaseException as e:  # noqa: BLE001
                     err.append(e)
-                    out_q.put(end)
+                    _put_unless_stopped(out_q, end, stop)
                     return
 
         threading.Thread(target=feed, daemon=True).start()
@@ -176,30 +208,33 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             threading.Thread(target=work, daemon=True).start()
 
         finished = 0
-        if not order:
-            while finished < process_num:
-                got = out_q.get()
-                if isinstance(got, XmapEndSignal):
-                    finished += 1
-                    continue
-                yield got[1]
-        else:
-            pending: dict = {}
-            next_i = 0
-            while finished < process_num:
-                got = out_q.get()
-                if isinstance(got, XmapEndSignal):
-                    finished += 1
-                    continue
-                pending[got[0]] = got[1]
+        try:
+            if not order:
+                while finished < process_num:
+                    got = out_q.get()
+                    if isinstance(got, XmapEndSignal):
+                        finished += 1
+                        continue
+                    yield got[1]
+            else:
+                pending: dict = {}
+                next_i = 0
+                while finished < process_num:
+                    got = out_q.get()
+                    if isinstance(got, XmapEndSignal):
+                        finished += 1
+                        continue
+                    pending[got[0]] = got[1]
+                    while next_i in pending:
+                        yield pending.pop(next_i)
+                        next_i += 1
                 while next_i in pending:
                     yield pending.pop(next_i)
                     next_i += 1
-            while next_i in pending:
-                yield pending.pop(next_i)
-                next_i += 1
-        if err:
-            raise err[0]
+            if err:
+                raise err[0]
+        finally:
+            stop.set()   # unblock feed/work threads on early exit
     return creator
 
 
@@ -213,26 +248,31 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     def creator():
         q: "queue.Queue" = queue.Queue(queue_size)
         end = object()
+        stop = threading.Event()
         err = []
 
         def drain(r):
             try:
                 for item in r():
-                    q.put(item)
+                    if not _put_unless_stopped(q, item, stop):
+                        return
             except BaseException as e:  # noqa: BLE001
                 err.append(e)
             finally:
-                q.put(end)
+                _put_unless_stopped(q, end, stop)
 
         for r in readers:
             threading.Thread(target=drain, args=(r,), daemon=True).start()
         finished = 0
-        while finished < len(readers):
-            item = q.get()
-            if item is end:
-                finished += 1
-                continue
-            yield item
-        if err:
-            raise err[0]
+        try:
+            while finished < len(readers):
+                item = q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                yield item
+            if err:
+                raise err[0]
+        finally:
+            stop.set()
     return creator

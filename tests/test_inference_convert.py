@@ -1,0 +1,72 @@
+"""Mixed-precision conversion of saved inference models (reference:
+python/paddle/inference convert_to_mixed_precision — weights rewritten to
+the reduced dtype, graph re-emitted with boundary casts)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import jit
+from paddle_tpu.inference import (Config, convert_to_mixed_precision,
+                                  create_predictor)
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.static import InputSpec
+
+
+def _save_tiny(tmp_path):
+    paddle.seed(0)
+    cfg = llama_tiny()
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    prefix = str(tmp_path / "llama")
+    jit.save(m, prefix, input_spec=[InputSpec([2, 16], "int64")])
+    return prefix, cfg
+
+
+def test_convert_halves_params_and_keeps_numerics(tmp_path):
+    prefix, cfg = _save_tiny(tmp_path)
+    mixed = convert_to_mixed_precision(
+        prefix + ".pdmodel", prefix + ".pdiparams",
+        str(tmp_path / "mixed.pdmodel"), str(tmp_path / "mixed.pdiparams"),
+        mixed_precision="bfloat16")
+    f32 = os.path.getsize(prefix + ".pdiparams")
+    bf16 = os.path.getsize(mixed + ".pdiparams")
+    assert bf16 < 0.62 * f32  # floats halve; int buffers stay
+
+    import json
+
+    with open(mixed + ".pdmeta.json") as f:
+        meta = json.load(f)
+    assert meta["mixed_precision"] == "bfloat16"
+    npz0 = np.load(prefix + ".pdiparams")
+    float_keys = [k for k in npz0.files
+                  if np.issubdtype(npz0[k].dtype, np.floating)]
+    assert float_keys
+    # every float param is recorded as bf16 and serialized as uint16 bits
+    assert set(meta["param_dtypes"]) == set(float_keys)
+    npz = np.load(mixed + ".pdiparams")
+    assert all(npz[k].dtype == np.uint16 for k in float_keys)
+
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 16)).astype("int64")
+    o1 = create_predictor(Config(prefix)).run([ids])[0]
+    o2 = create_predictor(Config(mixed)).run([ids])[0]
+    err = np.abs(o1 - o2).max() / (np.abs(o1).max() + 1e-9)
+    assert err < 0.05, f"bf16 conversion drifted: rel err {err}"
+
+
+def test_convert_black_list_keeps_f32(tmp_path):
+    prefix, _ = _save_tiny(tmp_path)
+    npz0 = np.load(prefix + ".pdiparams")
+    keep = sorted(k for k in npz0.files if "lm_head" in k)
+    assert keep
+    mixed = convert_to_mixed_precision(
+        prefix + ".pdmodel", prefix + ".pdiparams",
+        str(tmp_path / "bl.pdmodel"), str(tmp_path / "bl.pdiparams"),
+        mixed_precision="float16", black_list=keep)
+    npz = np.load(mixed + ".pdiparams")
+    for k in keep:
+        assert npz[k].dtype == np.float32
+    others = [k for k in npz.files if k not in keep
+              and np.issubdtype(npz0[k].dtype, np.floating)]
+    assert others and all(npz[k].dtype == np.float16 for k in others)

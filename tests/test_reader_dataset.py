@@ -213,8 +213,19 @@ class TestParsers:
         assert set(word_idx) == {"a", "b", "c", "d", "<unk>"}
         grams = list(imikolov.train(word_idx, 2,
                                     tar_path=str(tar_path))())
+        # reference shape: '<s>' + words + '<e>' per line, bigrams => 4/line
         assert all(len(g) == 2 for g in grams)
-        seqs = list(imikolov.train(word_idx, 2, imikolov.DataType.SEQ,
+        assert len(grams) == 8
+        unk = word_idx["<unk>"]
+        assert grams[0] == (unk, word_idx["a"])          # (<s>, a)
+        assert grams[3] == (word_idx["c"], unk)          # (c, <e>)
+        seqs = list(imikolov.train(word_idx, 0, imikolov.DataType.SEQ,
                                    tar_path=str(tar_path))())
-        assert seqs[0][0] == [word_idx["a"], word_idx["b"]]
-        assert seqs[0][1] == [word_idx["b"], word_idx["c"]]
+        assert seqs[0][0] == [unk, word_idx["a"], word_idx["b"],
+                              word_idx["c"]]             # <s> + ids
+        assert seqs[0][1] == [word_idx["a"], word_idx["b"], word_idx["c"],
+                              unk]                       # ids + <e>
+        # SEQ with n: lines longer than n are skipped (reference contract)
+        short = list(imikolov.train(word_idx, 2, imikolov.DataType.SEQ,
+                                    tar_path=str(tar_path))())
+        assert short == []
