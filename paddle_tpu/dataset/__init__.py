@@ -5,6 +5,10 @@ this environment has no network egress, so ``common.download`` validates
 the cache instead of fetching (it errors with exact placement
 instructions when a file is missing).
 """
-from . import cifar, common, imdb, imikolov, mnist, uci_housing  # noqa: F401
+from . import (cifar, common, conll05, flowers, image, imdb,  # noqa: F401
+               imikolov, mnist, movielens, uci_housing, voc2012, wmt14,
+               wmt16)
 
-__all__ = ["cifar", "common", "imdb", "imikolov", "mnist", "uci_housing"]
+__all__ = ["cifar", "common", "conll05", "flowers", "image", "imdb",
+           "imikolov", "mnist", "movielens", "uci_housing", "voc2012",
+           "wmt14", "wmt16"]

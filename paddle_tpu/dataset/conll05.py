@@ -1,0 +1,154 @@
+"""CoNLL-2005 SRL reader (parity: python/paddle/dataset/conll05.py — the
+test.wsj words/props gz pair inside the official tar; bracketed prop
+labels flattened to BIO sequences; 9-slot feature tuples for the SRL
+model)."""
+from __future__ import annotations
+
+import gzip
+import tarfile
+
+from . import common
+
+__all__ = ["get_dict", "get_embedding", "test", "corpus_reader",
+           "reader_creator", "load_dict", "load_label_dict"]
+
+DATA_URL = ("http://paddlemodels.bj.bcebos.com/conll05st/"
+            "conll05st-tests.tar.gz")
+WORDDICT_URL = "http://paddlemodels.bj.bcebos.com/conll05st%2FwordDict.txt"
+VERBDICT_URL = "http://paddlemodels.bj.bcebos.com/conll05st%2FverbDict.txt"
+TRGDICT_URL = "http://paddlemodels.bj.bcebos.com/conll05st%2FtargetDict.txt"
+EMB_URL = "http://paddlemodels.bj.bcebos.com/conll05st%2Femb"
+
+UNK_IDX = 0
+
+WORDS_NAME = "conll05st-release/test.wsj/words/test.wsj.words.gz"
+PROPS_NAME = "conll05st-release/test.wsj/props/test.wsj.props.gz"
+
+
+def load_label_dict(filename):
+    """Expand B-/I- over the raw target list (reference load_label_dict)."""
+    d = {}
+    tag_dict = set()
+    with open(filename) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("B-"):
+                tag_dict.add(line[2:])
+            elif line.startswith("I-"):
+                tag_dict.add(line[2:])
+    index = 0
+    for tag in sorted(tag_dict):
+        d["B-" + tag] = index
+        index += 1
+        d["I-" + tag] = index
+        index += 1
+    d["O"] = index
+    return d
+
+
+def load_dict(filename):
+    d = {}
+    with open(filename) as f:
+        for i, line in enumerate(f):
+            d[line.strip()] = i
+    return d
+
+
+def corpus_reader(data_path, words_name=WORDS_NAME, props_name=PROPS_NAME):
+    """Yield (sentence_words, predicate, bio_label_seq) per predicate
+    (reference corpus_reader: bracketed spans -> B-/I-/O)."""
+
+    def reader():
+        with tarfile.open(data_path) as tf:
+            wf = tf.extractfile(words_name)
+            pf = tf.extractfile(props_name)
+            with gzip.GzipFile(fileobj=wf) as words_file, \
+                    gzip.GzipFile(fileobj=pf) as props_file:
+                sentences, labels, one_seg = [], [], []
+                for word, label in zip(words_file, props_file):
+                    word = word.strip().decode()
+                    label = label.strip().decode().split()
+                    if label:
+                        sentences.append(word)
+                        one_seg.append(label)
+                        continue
+                    # end of sentence: transpose prop columns
+                    for i in range(len(one_seg[0]) if one_seg else 0):
+                        labels.append([row[i] for row in one_seg])
+                    if labels:
+                        verb_list = [x for x in labels[0] if x != "-"]
+                        for i, lbl in enumerate(labels[1:]):
+                            cur_tag, in_bracket = "O", False
+                            seq = []
+                            for tok in lbl:
+                                if tok == "*" and not in_bracket:
+                                    seq.append("O")
+                                elif tok == "*" and in_bracket:
+                                    seq.append("I-" + cur_tag)
+                                elif tok == "*)":
+                                    seq.append("I-" + cur_tag)
+                                    in_bracket = False
+                                elif "(" in tok and ")" in tok:
+                                    cur_tag = tok[1:tok.find("*")]
+                                    seq.append("B-" + cur_tag)
+                                    in_bracket = False
+                                elif "(" in tok:
+                                    cur_tag = tok[1:tok.find("*")]
+                                    seq.append("B-" + cur_tag)
+                                    in_bracket = True
+                                else:
+                                    raise RuntimeError(
+                                        f"Unexpected label: {tok}")
+                            yield sentences, verb_list[i], seq
+                    sentences, labels, one_seg = [], [], []
+    return reader
+
+
+def reader_creator(corpus, word_dict, predicate_dict, label_dict):
+    def reader():
+        for sentence, predicate, labels in corpus():
+            sen_len = len(sentence)
+            verb_index = labels.index("B-V")
+            mark = [0] * len(labels)
+
+            def ctx(offset, default):
+                i = verb_index + offset
+                if 0 <= i < len(labels):
+                    mark[i] = 1
+                    return sentence[i]
+                return default
+
+            ctx_n2 = ctx(-2, "bos")
+            ctx_n1 = ctx(-1, "bos")
+            ctx_0 = ctx(0, sentence[verb_index])
+            ctx_p1 = ctx(1, "eos")
+            ctx_p2 = ctx(2, "eos")
+            word_idx = [word_dict.get(w, UNK_IDX) for w in sentence]
+            yield (word_idx,
+                   [word_dict.get(ctx_n2, UNK_IDX)] * sen_len,
+                   [word_dict.get(ctx_n1, UNK_IDX)] * sen_len,
+                   [word_dict.get(ctx_0, UNK_IDX)] * sen_len,
+                   [word_dict.get(ctx_p1, UNK_IDX)] * sen_len,
+                   [word_dict.get(ctx_p2, UNK_IDX)] * sen_len,
+                   [predicate_dict.get(predicate)] * sen_len,
+                   mark,
+                   [label_dict.get(w) for w in labels])
+    return reader
+
+
+def get_dict():
+    word_dict = load_dict(common.download(WORDDICT_URL, "conll05st"))
+    verb_dict = load_dict(common.download(VERBDICT_URL, "conll05st"))
+    label_dict = load_label_dict(common.download(TRGDICT_URL, "conll05st"))
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    return common.download(EMB_URL, "conll05st")
+
+
+def test(tar_path=None, dicts=None):
+    tar_path = tar_path or common.download(DATA_URL, "conll05st")
+    word_dict, verb_dict, label_dict = dicts or get_dict()
+    return reader_creator(corpus_reader(tar_path), word_dict, verb_dict,
+                          label_dict)

@@ -1,0 +1,66 @@
+"""Oxford 102 Flowers reader (parity: python/paddle/dataset/flowers.py —
+102flowers.tgz JPEGs + setid.mat split indices + imagelabels.mat labels;
+yields (HWC uint8 image array, 0-based label))."""
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "valid"]
+
+DATA_URL = "http://paddlemodels.bj.bcebos.com/flowers/102flowers.tgz"
+LABEL_URL = "http://paddlemodels.bj.bcebos.com/flowers/imagelabels.mat"
+SETID_URL = "http://paddlemodels.bj.bcebos.com/flowers/setid.mat"
+
+TRAIN_FLAG = "trnid"
+TEST_FLAG = "tstid"
+VALID_FLAG = "valid"
+
+
+def reader_creator(data_path, label_path, setid_path, flag, mapper=None):
+    def reader():
+        from PIL import Image
+        from scipy.io import loadmat
+
+        indices = loadmat(setid_path)[flag][0]
+        labels = loadmat(label_path)["labels"][0]
+        with tarfile.open(data_path) as tf:
+            members = {m.name: m for m in tf.getmembers()}
+            for idx in indices:
+                name = f"jpg/image_{int(idx):05d}.jpg"
+                if name not in members:
+                    continue
+                data = tf.extractfile(members[name]).read()
+                img = np.array(Image.open(io.BytesIO(data)))
+                label = int(labels[int(idx) - 1]) - 1
+                if mapper is not None:
+                    img = mapper(img)
+                yield img, label
+    return reader
+
+
+def _make(flag, mapper, paths):
+    data, label, setid = paths or (
+        common.download(DATA_URL, "flowers"),
+        common.download(LABEL_URL, "flowers"),
+        common.download(SETID_URL, "flowers"))
+    return reader_creator(data, label, setid, flag, mapper)
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, paths=None):
+    del buffered_size, use_xmap  # compat; mapping stays in-process
+    return _make(TRAIN_FLAG, mapper, paths)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, paths=None):
+    del buffered_size, use_xmap
+    return _make(TEST_FLAG, mapper, paths)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True, paths=None):
+    del buffered_size, use_xmap
+    return _make(VALID_FLAG, mapper, paths)

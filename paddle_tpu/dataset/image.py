@@ -1,0 +1,123 @@
+"""Image utilities (parity: python/paddle/dataset/image.py — the legacy
+cv2-based helpers; implemented over PIL + numpy, same shapes/semantics:
+HWC uint8 in, resize-short / crop / flip / CHW / mean-normalize out)."""
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+__all__ = ["load_image_bytes", "load_image", "resize_short", "to_chw",
+           "center_crop", "random_crop", "left_right_flip",
+           "simple_transform", "load_and_transform",
+           "batch_images_from_tar"]
+
+
+def load_image_bytes(data, is_color=True):
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(data))
+    img = img.convert("RGB" if is_color else "L")
+    return np.array(img)
+
+
+def load_image(path, is_color=True):
+    with open(path, "rb") as f:
+        return load_image_bytes(f.read(), is_color)
+
+
+def resize_short(im, size):
+    """Scale so the SHORT side equals ``size`` (aspect preserved)."""
+    from PIL import Image
+
+    h, w = im.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(round(h * size / w))
+    else:
+        new_w, new_h = int(round(w * size / h)), size
+    img = Image.fromarray(im)
+    return np.array(img.resize((new_w, new_h), Image.BILINEAR))
+
+
+def to_chw(im, order=(2, 0, 1)):
+    if im.ndim == 2:
+        im = im[:, :, None]
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    del is_color
+    h, w = im.shape[:2]
+    h0 = max((h - size) // 2, 0)
+    w0 = max((w - size) // 2, 0)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im, size, is_color=True):
+    del is_color
+    h, w = im.shape[:2]
+    h0 = np.random.randint(0, max(h - size, 0) + 1)
+    w0 = np.random.randint(0, max(w - size, 0) + 1)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    del is_color
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """resize-short -> crop (random+flip when training, center otherwise)
+    -> CHW float32 -> mean-subtract (the reference's standard pipeline)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color)
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        im -= mean if mean.ndim >= 3 else mean.reshape(-1, 1, 1)
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """Pickle (images, labels) batches out of a tar of images (reference
+    :60); returns the batch-list meta file path."""
+    import os
+    import pickle
+    import tarfile
+
+    out_path = f"{data_file}_{dataset_name}_batch"
+    os.makedirs(out_path, exist_ok=True)
+    data, labels, file_id = [], [], 0
+    with tarfile.open(data_file) as tf:
+        for m in tf.getmembers():
+            if m.name not in img2label:
+                continue
+            data.append(tf.extractfile(m).read())
+            labels.append(img2label[m.name])
+            if len(data) == num_per_batch:
+                with open(f"{out_path}/batch_{file_id}", "wb") as f:
+                    pickle.dump({"data": data, "label": labels}, f,
+                                protocol=2)
+                file_id += 1
+                data, labels = [], []
+    if data:
+        with open(f"{out_path}/batch_{file_id}", "wb") as f:
+            pickle.dump({"data": data, "label": labels}, f, protocol=2)
+    meta = f"{out_path}/batch_meta"
+    with open(meta, "w") as f:
+        f.write("\n".join(
+            f"{out_path}/batch_{i}" for i in range(file_id + 1)))
+    return meta

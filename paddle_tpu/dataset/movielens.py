@@ -1,0 +1,147 @@
+"""MovieLens ml-1m reader (parity: python/paddle/dataset/movielens.py —
+'::'-separated users/movies/ratings inside the official zip; yields
+user-features + movie-features + [[rating]] with rating rescaled to
+[-5, 5] via r*2-5)."""
+from __future__ import annotations
+
+import functools
+import re
+import zipfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["MovieInfo", "UserInfo", "train", "test", "get_movie_title_dict",
+           "max_movie_id", "max_user_id", "max_job_id", "movie_categories",
+           "user_info", "movie_info"]
+
+URL = "https://dataset.bj.bcebos.com/movielens%2Fml-1m.zip"
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        return [
+            self.index,
+            [CATEGORIES_DICT[c] for c in self.categories],
+            [MOVIE_TITLE_DICT[w.lower()] for w in self.title.split()],
+        ]
+
+    def __repr__(self):
+        return (f"<MovieInfo id({self.index}), title({self.title}), "
+                f"categories({self.categories})>")
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
+
+    def __repr__(self):
+        return (f"<UserInfo id({self.index}), gender("
+                f"{'M' if self.is_male else 'F'}), age({age_table[self.age]}"
+                f"), job({self.job_id})>")
+
+
+MOVIE_INFO = None
+MOVIE_TITLE_DICT = None
+CATEGORIES_DICT = None
+USER_INFO = None
+
+
+def _meta(zip_path=None):
+    global MOVIE_INFO, MOVIE_TITLE_DICT, CATEGORIES_DICT, USER_INFO
+    zip_path = zip_path or common.download(URL, "movielens")
+    if MOVIE_INFO is None:
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        MOVIE_INFO, USER_INFO = {}, {}
+        titles, cats = set(), set()
+        with zipfile.ZipFile(zip_path) as z:
+            with z.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, categories = \
+                        line.decode("latin").strip().split("::")
+                    categories = categories.split("|")
+                    cats.update(categories)
+                    m = pattern.match(title)
+                    title = m.group(1).strip() if m else title
+                    MOVIE_INFO[int(mid)] = MovieInfo(mid, categories, title)
+                    titles.update(w.lower() for w in title.split())
+            MOVIE_TITLE_DICT = {w: i for i, w in enumerate(sorted(titles))}
+            CATEGORIES_DICT = {c: i for i, c in enumerate(sorted(cats))}
+            with z.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job, _ = \
+                        line.decode("latin").strip().split("::")
+                    USER_INFO[int(uid)] = UserInfo(uid, gender, age, job)
+    return zip_path
+
+
+def _reader(rand_seed=0, test_ratio=0.1, is_test=False, zip_path=None):
+    zip_path = _meta(zip_path)
+    rng = np.random.RandomState(rand_seed)
+    with zipfile.ZipFile(zip_path) as z:
+        with z.open("ml-1m/ratings.dat") as f:
+            for line in f:
+                if (rng.random_sample() < test_ratio) != is_test:
+                    continue
+                uid, mid, rating, _ = \
+                    line.decode("latin").strip().split("::")
+                yield (USER_INFO[int(uid)].value()
+                       + MOVIE_INFO[int(mid)].value()
+                       + [[float(rating) * 2 - 5.0]])
+
+
+def train(zip_path=None):
+    return functools.partial(_reader, is_test=False, zip_path=zip_path)
+
+
+def test(zip_path=None):
+    return functools.partial(_reader, is_test=True, zip_path=zip_path)
+
+
+def get_movie_title_dict(zip_path=None):
+    _meta(zip_path)
+    return MOVIE_TITLE_DICT
+
+
+def max_movie_id(zip_path=None):
+    _meta(zip_path)
+    return max(MOVIE_INFO)
+
+
+def max_user_id(zip_path=None):
+    _meta(zip_path)
+    return max(USER_INFO)
+
+
+def max_job_id(zip_path=None):
+    _meta(zip_path)
+    return max(u.job_id for u in USER_INFO.values())
+
+
+def movie_categories(zip_path=None):
+    _meta(zip_path)
+    return CATEGORIES_DICT
+
+
+def user_info(zip_path=None):
+    _meta(zip_path)
+    return list(USER_INFO.values())
+
+
+def movie_info(zip_path=None):
+    _meta(zip_path)
+    return list(MOVIE_INFO.values())

@@ -1,0 +1,53 @@
+"""Pascal VOC2012 segmentation reader (parity:
+python/paddle/dataset/voc2012.py — JPEG image + PNG class-mask pairs named
+by the ImageSets/Segmentation split files inside the official tar)."""
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "val"]
+
+VOC_URL = ("http://host.robots.ox.ac.uk/pascal/VOC/voc2012/"
+           "VOCtrainval_11-May-2012.tar")
+SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
+
+def reader_creator(tar_path, sub_name):
+    def reader():
+        from PIL import Image
+
+        with tarfile.open(tar_path) as tf:
+            members = {m.name: m for m in tf.getmembers()}
+            sets = tf.extractfile(members[SET_FILE.format(sub_name)])
+            for line in sets:
+                name = line.decode().strip()
+                if not name:
+                    continue
+                data = tf.extractfile(members[DATA_FILE.format(name)]).read()
+                label = tf.extractfile(
+                    members[LABEL_FILE.format(name)]).read()
+                yield (np.array(Image.open(io.BytesIO(data))),
+                       np.array(Image.open(io.BytesIO(label))))
+    return reader
+
+
+def train(tar_path=None):
+    return reader_creator(tar_path or common.download(VOC_URL, "voc2012"),
+                          "trainval")
+
+
+def test(tar_path=None):
+    return reader_creator(tar_path or common.download(VOC_URL, "voc2012"),
+                          "train")
+
+
+def val(tar_path=None):
+    return reader_creator(tar_path or common.download(VOC_URL, "voc2012"),
+                          "val")

@@ -1,0 +1,77 @@
+"""WMT14 en-fr reader (parity: python/paddle/dataset/wmt14.py — tab-
+separated parallel text + src/trg dict files inside the dev+train tar;
+yields (src_ids, trg_ids, trg_ids_next) with <s>/<e> framing and an 80-
+token cap)."""
+from __future__ import annotations
+
+import tarfile
+
+from . import common
+
+__all__ = ["train", "test", "get_dict", "START", "END", "UNK", "UNK_IDX"]
+
+URL_TRAIN = ("http://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz")
+START, END, UNK = "<s>", "<e>", "<unk>"
+UNK_IDX = 2
+
+
+def _read_dicts(tar_path, dict_size):
+    def to_dict(f, size):
+        out = {}
+        for i, line in enumerate(f):
+            if i >= size:
+                break
+            out[line.strip().decode()] = i
+        return out
+
+    with tarfile.open(tar_path, mode="r") as tf:
+        src_name = [n for n in tf.getnames() if n.endswith("src.dict")]
+        trg_name = [n for n in tf.getnames() if n.endswith("trg.dict")]
+        if len(src_name) != 1 or len(trg_name) != 1:
+            raise ValueError(
+                f"{tar_path}: expected exactly one src.dict and one "
+                f"trg.dict, found {src_name} / {trg_name}")
+        return (to_dict(tf.extractfile(src_name[0]), dict_size),
+                to_dict(tf.extractfile(trg_name[0]), dict_size))
+
+
+def reader_creator(tar_path, file_name, dict_size):
+    def reader():
+        src_dict, trg_dict = _read_dicts(tar_path, dict_size)
+        with tarfile.open(tar_path, mode="r") as tf:
+            names = [n for n in tf.getnames() if n.endswith(file_name)]
+            for name in names:
+                for line in tf.extractfile(name):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_words = parts[0].split()
+                    src_ids = [src_dict.get(w, UNK_IDX)
+                               for w in [START] + src_words + [END]]
+                    trg_words = parts[1].split()
+                    trg_ids = [trg_dict.get(w, UNK_IDX) for w in trg_words]
+                    if len(src_ids) > 80 or len(trg_ids) > 80:
+                        continue
+                    trg_next = trg_ids + [trg_dict[END]]
+                    trg_ids = [trg_dict[START]] + trg_ids
+                    yield src_ids, trg_ids, trg_next
+    return reader
+
+
+def train(dict_size, tar_path=None):
+    tar_path = tar_path or common.download(URL_TRAIN, "wmt14")
+    return reader_creator(tar_path, "train/train", dict_size)
+
+
+def test(dict_size, tar_path=None):
+    tar_path = tar_path or common.download(URL_TRAIN, "wmt14")
+    return reader_creator(tar_path, "test/test", dict_size)
+
+
+def get_dict(dict_size, reverse=True, tar_path=None):
+    tar_path = tar_path or common.download(URL_TRAIN, "wmt14")
+    src, trg = _read_dicts(tar_path, dict_size)
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg

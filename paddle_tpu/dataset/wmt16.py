@@ -1,0 +1,114 @@
+"""WMT16 en-de reader (parity: python/paddle/dataset/wmt16.py — BPE'd
+tab-separated parallel text; per-language frequency dicts built from the
+training split with <s>/<e>/<unk> heading the vocabulary; yields
+(src_ids, trg_ids, trg_ids_next))."""
+from __future__ import annotations
+
+import collections
+import os
+import tarfile
+
+from . import common
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+DATA_URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz"
+START_MARK, END_MARK, UNK_MARK = "<s>", "<e>", "<unk>"
+TOTAL_EN_WORDS = 11250
+TOTAL_DE_WORDS = 19220
+
+
+def _build_dict(tar_path, dict_size, save_path, lang):
+    word_dict: collections.Counter = collections.Counter()
+    col = 0 if lang == "en" else 1
+    with tarfile.open(tar_path, mode="r") as tf:
+        for line in tf.extractfile("wmt16/train"):
+            parts = line.decode().strip().split("\t")
+            if len(parts) != 2:
+                continue
+            word_dict.update(parts[col].split())
+    with open(save_path, "wb") as f:
+        f.write(f"{START_MARK}\n{END_MARK}\n{UNK_MARK}\n".encode())
+        for word, _ in word_dict.most_common(dict_size - 3):
+            f.write(word.encode() + b"\n")
+
+
+def _load_dict(tar_path, dict_size, lang, reverse=False):
+    dict_path = os.path.join(common.DATA_HOME, "wmt16",
+                             f"wmt16_{lang}_{dict_size}.dict")
+    common.must_mkdirs(os.path.dirname(dict_path))
+    if not os.path.exists(dict_path):
+        _build_dict(tar_path, dict_size, dict_path, lang)
+    out = {}
+    with open(dict_path, "rb") as f:
+        for idx, line in enumerate(f):
+            word = line.strip().decode()
+            if reverse:
+                out[idx] = word
+            else:
+                out[word] = idx
+    return out
+
+
+def _dict_sizes(src_dict_size, trg_dict_size, src_lang):
+    src_total = TOTAL_EN_WORDS if src_lang == "en" else TOTAL_DE_WORDS
+    trg_total = TOTAL_DE_WORDS if src_lang == "en" else TOTAL_EN_WORDS
+    return min(src_dict_size, src_total), min(trg_dict_size, trg_total)
+
+
+def reader_creator(tar_path, file_name, src_dict_size, trg_dict_size,
+                   src_lang):
+    def reader():
+        src_dict = _load_dict(tar_path, src_dict_size, src_lang)
+        trg_dict = _load_dict(tar_path, trg_dict_size,
+                              "de" if src_lang == "en" else "en")
+        start_id, end_id, unk_id = (src_dict[START_MARK],
+                                    src_dict[END_MARK],
+                                    src_dict[UNK_MARK])
+        src_col = 0 if src_lang == "en" else 1
+        trg_col = 1 - src_col
+        with tarfile.open(tar_path, mode="r") as tf:
+            for line in tf.extractfile(file_name):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src_ids = ([start_id]
+                           + [src_dict.get(w, unk_id)
+                              for w in parts[src_col].split()]
+                           + [end_id])
+                trg_ids = [trg_dict.get(w, unk_id)
+                           for w in parts[trg_col].split()]
+                yield (src_ids, [start_id] + trg_ids, trg_ids + [end_id])
+    return reader
+
+
+def _make(file_name, src_dict_size, trg_dict_size, src_lang, tar_path):
+    if src_lang not in ("en", "de"):
+        raise ValueError(f"wmt16: src_lang must be 'en' or 'de', "
+                         f"got {src_lang!r}")
+    tar_path = tar_path or common.download(DATA_URL, "wmt16")
+    src_dict_size, trg_dict_size = _dict_sizes(src_dict_size,
+                                               trg_dict_size, src_lang)
+    return reader_creator(tar_path, file_name, src_dict_size,
+                          trg_dict_size, src_lang)
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en", tar_path=None):
+    return _make("wmt16/train", src_dict_size, trg_dict_size, src_lang,
+                 tar_path)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en", tar_path=None):
+    return _make("wmt16/test", src_dict_size, trg_dict_size, src_lang,
+                 tar_path)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en", tar_path=None):
+    return _make("wmt16/val", src_dict_size, trg_dict_size, src_lang,
+                 tar_path)
+
+
+def get_dict(lang, dict_size, reverse=False, tar_path=None):
+    tar_path = tar_path or common.download(DATA_URL, "wmt16")
+    total = TOTAL_EN_WORDS if lang == "en" else TOTAL_DE_WORDS
+    return _load_dict(tar_path, min(dict_size, total), lang, reverse)

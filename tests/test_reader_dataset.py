@@ -229,3 +229,180 @@ class TestParsers:
         short = list(imikolov.train(word_idx, 2, imikolov.DataType.SEQ,
                                     tar_path=str(tar_path))())
         assert short == []
+
+
+class TestParsersWave2:
+    def test_movielens(self, data_home):
+        import zipfile
+        from paddle_tpu.dataset import movielens
+        d = data_home / "movielens"
+        d.mkdir()
+        zp = d / "ml-1m.zip"
+        with zipfile.ZipFile(zp, "w") as z:
+            z.writestr("ml-1m/movies.dat",
+                       "1::Toy Story (1995)::Animation|Comedy\n"
+                       "2::Heat (1995)::Action\n")
+            z.writestr("ml-1m/users.dat",
+                       "1::M::25::3::90210\n2::F::35::7::10001\n")
+            z.writestr("ml-1m/ratings.dat",
+                       "1::1::5::978300760\n2::2::3::978302109\n")
+        movielens.MOVIE_INFO = None  # reset module cache
+        rows = list(movielens.train(zip_path=str(zp))()) + \
+            list(movielens.test(zip_path=str(zp))())
+        assert len(rows) == 2
+        # user features: [uid, gender, age_bucket, job]
+        row = next(r for r in rows if r[0] == 1)
+        assert row[:4] == [1, 0, movielens.age_table.index(25), 3]
+        assert row[-1] == [5.0 * 2 - 5.0]
+        assert movielens.max_movie_id(zip_path=str(zp)) == 2
+        assert movielens.max_user_id(zip_path=str(zp)) == 2
+        cats = movielens.movie_categories(zip_path=str(zp))
+        assert set(cats) == {"Animation", "Comedy", "Action"}
+
+    def test_wmt14(self, data_home):
+        import io as _io
+        import tarfile
+        from paddle_tpu.dataset import wmt14
+        d = data_home / "wmt14"
+        d.mkdir()
+        tp = d / "wmt14.tgz"
+        with tarfile.open(tp, "w:gz") as tf:
+            for name, text in [
+                ("wmt14/train/src.dict", "<s>\n<e>\n<unk>\nhello\nworld\n"),
+                ("wmt14/train/trg.dict", "<s>\n<e>\n<unk>\nbonjour\nmonde\n"),
+                ("wmt14/train/train", "hello world\tbonjour monde\n"),
+            ]:
+                blob = text.encode()
+                info = tarfile.TarInfo(name)
+                info.size = len(blob)
+                tf.addfile(info, _io.BytesIO(blob))
+        out = list(wmt14.train(10, tar_path=str(tp))())
+        assert len(out) == 1
+        src, trg, trg_next = out[0]
+        assert src == [0, 3, 4, 1]          # <s> hello world <e>
+        assert trg == [0, 3, 4]             # <s> bonjour monde
+        assert trg_next == [3, 4, 1]        # bonjour monde <e>
+        fwd, _ = wmt14.get_dict(10, reverse=False, tar_path=str(tp))
+        assert fwd["hello"] == 3
+
+    def test_wmt16_builds_dict_from_train(self, data_home):
+        import io as _io
+        import tarfile
+        from paddle_tpu.dataset import wmt16
+        d = data_home / "wmt16"
+        d.mkdir()
+        tp = d / "wmt16.tar.gz"
+        text = "a b b\tx y\nb c\ty z\n"
+        with tarfile.open(tp, "w:gz") as tf:
+            for name in ("wmt16/train", "wmt16/test", "wmt16/val"):
+                blob = text.encode()
+                info = tarfile.TarInfo(name)
+                info.size = len(blob)
+                tf.addfile(info, _io.BytesIO(blob))
+        out = list(wmt16.train(10, 10, "en", tar_path=str(tp))())
+        assert len(out) == 2
+        src, trg, trg_next = out[0]
+        # dict: <s>=0 <e>=1 <unk>=2 then by freq: b(3), a, c
+        assert src[0] == 0 and src[-1] == 1
+        assert src[1:-1] == [4, 3, 3]       # a b b
+        assert trg_next[-1] == 1
+
+    def test_conll05_bracket_to_bio(self, data_home):
+        import gzip as _gzip
+        import io as _io
+        import tarfile
+        from paddle_tpu.dataset import conll05
+        d = data_home / "conll05st"
+        d.mkdir()
+        tp = d / "conll05st-tests.tar.gz"
+        words = "The\ncat\nsat\n\n"
+        props = "-\t*\n-\t(A0*)\nsat\t(V*)\n\n".replace("\t", " ")
+        wz = _io.BytesIO()
+        with _gzip.GzipFile(fileobj=wz, mode="wb") as f:
+            f.write(words.encode())
+        pz = _io.BytesIO()
+        with _gzip.GzipFile(fileobj=pz, mode="wb") as f:
+            f.write(props.encode())
+        with tarfile.open(tp, "w:gz") as tf:
+            for name, blob in [(conll05.WORDS_NAME, wz.getvalue()),
+                               (conll05.PROPS_NAME, pz.getvalue())]:
+                info = tarfile.TarInfo(name)
+                info.size = len(blob)
+                tf.addfile(info, _io.BytesIO(blob))
+        rows = list(conll05.corpus_reader(str(tp))())
+        assert rows == [(["The", "cat", "sat"], "sat",
+                         ["O", "B-A0", "B-V"])]
+        word_dict = {"The": 1, "cat": 2, "sat": 3}
+        label_dict = {"O": 0, "B-A0": 1, "B-V": 2}
+        feat = list(conll05.reader_creator(
+            conll05.corpus_reader(str(tp)), word_dict, {"sat": 7},
+            label_dict)())
+        (w, n2, n1, c0, p1, p2, pred, mark, lbl) = feat[0]
+        assert w == [1, 2, 3]
+        assert pred == [7, 7, 7]
+        assert mark == [1, 1, 1]            # verb at index 2: ctx -1/-2/0
+        assert lbl == [0, 1, 2]
+
+    def test_voc2012_and_flowers_and_image(self, data_home):
+        import io as _io
+        import tarfile
+        from PIL import Image
+        from scipy.io import savemat
+        from paddle_tpu.dataset import flowers, image, voc2012
+
+        def png_bytes(arr):
+            b = _io.BytesIO()
+            Image.fromarray(arr).save(b, format="PNG")
+            return b.getvalue()
+
+        def jpg_bytes(arr):
+            b = _io.BytesIO()
+            Image.fromarray(arr).save(b, format="JPEG")
+            return b.getvalue()
+
+        rgb = np.zeros((8, 8, 3), np.uint8)
+        rgb[:, :, 0] = 200
+        mask = np.ones((8, 8), np.uint8)
+
+        # voc2012
+        d = data_home / "voc2012"
+        d.mkdir()
+        tp = d / "VOCtrainval_11-May-2012.tar"
+        with tarfile.open(tp, "w") as tf:
+            for name, blob in [
+                (voc2012.SET_FILE.format("trainval"), b"img0\n"),
+                (voc2012.DATA_FILE.format("img0"), jpg_bytes(rgb)),
+                (voc2012.LABEL_FILE.format("img0"), png_bytes(mask)),
+            ]:
+                info = tarfile.TarInfo(name)
+                info.size = len(blob)
+                tf.addfile(info, _io.BytesIO(blob))
+        img, lbl = next(voc2012.train(tar_path=str(tp))())
+        assert img.shape == (8, 8, 3) and lbl.shape == (8, 8)
+        assert lbl.max() == 1
+
+        # flowers
+        fd = data_home / "flowers"
+        fd.mkdir()
+        ftar = fd / "102flowers.tgz"
+        with tarfile.open(ftar, "w:gz") as tf:
+            blob = jpg_bytes(rgb)
+            info = tarfile.TarInfo("jpg/image_00001.jpg")
+            info.size = len(blob)
+            tf.addfile(info, _io.BytesIO(blob))
+        savemat(fd / "setid.mat", {"trnid": np.array([[1]])})
+        savemat(fd / "imagelabels.mat", {"labels": np.array([[5]])})
+        out = list(flowers.train(paths=(str(ftar), str(fd / "imagelabels.mat"),
+                                        str(fd / "setid.mat")))())
+        assert len(out) == 1 and out[0][1] == 4  # 0-based label
+
+        # image utils
+        im = image.load_image_bytes(jpg_bytes(rgb))
+        assert im.shape == (8, 8, 3)
+        r = image.resize_short(im, 16)
+        assert min(r.shape[:2]) == 16
+        c = image.center_crop(r, 12)
+        assert c.shape[:2] == (12, 12)
+        chw = image.simple_transform(im, 16, 12, is_train=False,
+                                     mean=[1.0, 2.0, 3.0])
+        assert chw.shape == (3, 12, 12) and chw.dtype == np.float32
