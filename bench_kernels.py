@@ -141,7 +141,8 @@ def main():
         bench_pair(
             name,
             lambda q, k, v, _s=scale, _a=bq, _b=bk: flash_attention_ext(
-                q, k, v, None, zero_seed, True, _s, 0.0, _a, _b, False),
+                q, k, v, None, zero_seed, None, None, True, _s, 0.0, _a,
+                _b, False),
             lambda q, k, v, _s=scale: _attention_xla(
                 q, k, v, None, True, _s, 0.0, None),
             (q, k, v), results,
@@ -160,7 +161,8 @@ def main():
     bench_pair(
         "fa_s4k_dropout0.1",
         lambda q, k, v, _s=scale: flash_attention_ext(
-            q, k, v, None, seed, True, _s, 0.1, dbq, dbk, False),
+            q, k, v, None, seed, None, None, True, _s, 0.1, dbq, dbk,
+            False),
         lambda q, k, v, _s=scale: _attention_xla(
             q, k, v, None, True, _s, 0.1, dkey),
         (q, k, v), results, iters=3)

@@ -100,7 +100,15 @@ def batch_images_from_tar(data_file, dataset_name, img2label,
 
     out_path = f"{data_file}_{dataset_name}_batch"
     os.makedirs(out_path, exist_ok=True)
-    data, labels, file_id = [], [], 0
+    data, labels = [], []
+    written = []
+
+    def flush():
+        path = f"{out_path}/batch_{len(written)}"
+        with open(path, "wb") as f:
+            pickle.dump({"data": data, "label": labels}, f, protocol=2)
+        written.append(path)
+
     with tarfile.open(data_file) as tf:
         for m in tf.getmembers():
             if m.name not in img2label:
@@ -108,16 +116,11 @@ def batch_images_from_tar(data_file, dataset_name, img2label,
             data.append(tf.extractfile(m).read())
             labels.append(img2label[m.name])
             if len(data) == num_per_batch:
-                with open(f"{out_path}/batch_{file_id}", "wb") as f:
-                    pickle.dump({"data": data, "label": labels}, f,
-                                protocol=2)
-                file_id += 1
+                flush()
                 data, labels = [], []
     if data:
-        with open(f"{out_path}/batch_{file_id}", "wb") as f:
-            pickle.dump({"data": data, "label": labels}, f, protocol=2)
+        flush()
     meta = f"{out_path}/batch_meta"
     with open(meta, "w") as f:
-        f.write("\n".join(
-            f"{out_path}/batch_{i}" for i in range(file_id + 1)))
+        f.write("\n".join(written))   # only files that really exist
     return meta

@@ -60,13 +60,18 @@ MOVIE_TITLE_DICT = None
 CATEGORIES_DICT = None
 USER_INFO = None
 
+_META_CACHE: dict = {}   # resolved zip path -> parsed meta tuple
+
 
 def _meta(zip_path=None):
+    """Load (and cache, keyed by the RESOLVED path — two different
+    archives never serve each other's data) the movie/user metadata, and
+    publish it through the reference's module-level globals."""
     global MOVIE_INFO, MOVIE_TITLE_DICT, CATEGORIES_DICT, USER_INFO
-    zip_path = zip_path or common.download(URL, "movielens")
-    if MOVIE_INFO is None:
+    zip_path = str(zip_path or common.download(URL, "movielens"))
+    if zip_path not in _META_CACHE:
         pattern = re.compile(r"^(.*)\((\d+)\)$")
-        MOVIE_INFO, USER_INFO = {}, {}
+        movie_info, user_info = {}, {}
         titles, cats = set(), set()
         with zipfile.ZipFile(zip_path) as z:
             with z.open("ml-1m/movies.dat") as f:
@@ -77,15 +82,18 @@ def _meta(zip_path=None):
                     cats.update(categories)
                     m = pattern.match(title)
                     title = m.group(1).strip() if m else title
-                    MOVIE_INFO[int(mid)] = MovieInfo(mid, categories, title)
+                    movie_info[int(mid)] = MovieInfo(mid, categories, title)
                     titles.update(w.lower() for w in title.split())
-            MOVIE_TITLE_DICT = {w: i for i, w in enumerate(sorted(titles))}
-            CATEGORIES_DICT = {c: i for i, c in enumerate(sorted(cats))}
             with z.open("ml-1m/users.dat") as f:
                 for line in f:
                     uid, gender, age, job, _ = \
                         line.decode("latin").strip().split("::")
-                    USER_INFO[int(uid)] = UserInfo(uid, gender, age, job)
+                    user_info[int(uid)] = UserInfo(uid, gender, age, job)
+        _META_CACHE[zip_path] = (
+            movie_info, {w: i for i, w in enumerate(sorted(titles))},
+            {c: i for i, c in enumerate(sorted(cats))}, user_info)
+    (MOVIE_INFO, MOVIE_TITLE_DICT, CATEGORIES_DICT,
+     USER_INFO) = _META_CACHE[zip_path]
     return zip_path
 
 

@@ -90,15 +90,96 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
+def _segments_from_cu(cu_seqlens, total):
+    """cu_seqlens [n+1] -> per-position segment id [1, total] (positions
+    past cu_seqlens[-1] get the one-past-the-end bucket: they only ever
+    match each other, and their outputs are packing don't-cares)."""
+    import jax.numpy as jnp
+
+    cu = cu_seqlens
+    cu = getattr(cu, "_data", cu)
+    cu = jnp.asarray(cu, jnp.int32).reshape(-1)
+    pos = jnp.arange(total, dtype=jnp.int32)
+    return jnp.searchsorted(cu[1:], pos, side="right") \
+        .astype(jnp.int32)[None, :]
+
+
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
                         causal=False, return_softmax=False,
                         fixed_seed_offset=None, rng_name="", training=True,
                         name=None):
-    """Varlen API parity: runs the dense kernel per contract; ragged batching
-    is simulated by caller-side padding on TPU (static shapes)."""
-    out, _ = flash_attention(query, key, value, dropout=dropout, causal=causal,
-                             training=training)
+    """Varlen flash attention over PACKED inputs (reference contract:
+    flash_attn_unpadded, call site flash_attn_kernel.cu:199): q/k/v are
+    [total_tokens, heads, head_dim] with ``cu_seqlens_*`` delimiting the
+    sequences. TPU-native mechanism: per-position segment ids derived from
+    cu_seqlens are masked IN-KERNEL (attention never crosses a sequence
+    boundary; causal masking applies within each segment because packing
+    keeps positions contiguous) — the segment-ids form of the reference's
+    ragged batching, with no S^2 mask materialization."""
+    import jax
+
+    from ...core import flags as _flags
+    from ...core import random as _random
+    from ...ops.pallas.flash_attention import (flash_attention_ext,
+                                               seed_from_key)
+
+    import jax.numpy as jnp
+
+    from ...core.dispatch import select_impl
+    from ...ops.pallas.flash_attention import _attention_pallas
+
+    del max_seqlen_q, max_seqlen_k, return_softmax  # static shapes own this
+    rate = float(dropout) if training else 0.0
+    dk = _random.default_generator.next_key() if rate > 0.0 else None
+
+    total_q = query.shape[0]
+    total_k = key.shape[0]
+    seg_q = _segments_from_cu(cu_seqlens_q, total_q)
+    seg_k = _segments_from_cu(cu_seqlens_k, total_k)
+
+    on_tpu = jax.default_backend() == "tpu"
+    # honor the registry/sdp_kernel selection exactly like the dense path
+    use_kernel = (select_impl("flash_attention") is _attention_pallas
+                  and (on_tpu or _flags.get_flag("pallas_force_interpret"))
+                  and query.shape[-1] <= 256)
+
+    def _visibility():
+        """(Tq, Tk) bool mask: same segment, per-segment causal diagonal
+        (k_local - Lk <= q_local - Lq when causal)."""
+        def local_and_len(seg_row):
+            pos = jnp.arange(seg_row.shape[0], dtype=jnp.int32)
+            left = jnp.searchsorted(seg_row, seg_row, side="left")
+            right = jnp.searchsorted(seg_row, seg_row, side="right")
+            return (pos - left) - (right - left)   # local - L
+        same = seg_q[0][:, None] == seg_k[0][None, :]
+        if causal:
+            qv = local_and_len(seg_q[0])
+            kv = local_and_len(seg_k[0])
+            same = same & (kv[None, :] <= qv[:, None])
+        return same
+
+    def fn(q, k, v):
+        q4, k4, v4 = q[None], k[None], v[None]
+        if use_kernel:
+            seed = (seed_from_key(dk) if rate > 0.0
+                    else jnp.zeros((1,), jnp.int32))
+            out4 = flash_attention_ext(q4, k4, v4, None, seed, seg_q,
+                                       seg_k, bool(causal), float(scale),
+                                       rate, 128, 128, not on_tpu)
+        else:
+            vis = _visibility()
+            bias = jnp.where(vis, 0.0, float("-inf"))[None, None]
+            out4 = _attention_xla(q4, k4, v4, bias, False, float(scale),
+                                  rate, dk)
+            # a q row with no visible key softmaxes -inf into NaN: zero it
+            # (the kernel path's l==0 handling) so packing don't-cares
+            # never poison real gradients
+            dead = ~jnp.any(vis, axis=-1)                  # (Tq,)
+            out4 = jnp.where(dead[None, :, None, None], 0.0, out4)
+        return out4[0]
+
+    out = run_op("flash_attention", fn, (query, key, value))
     return out, None
 
 

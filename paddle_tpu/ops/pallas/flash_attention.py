@@ -152,11 +152,13 @@ def dropout_keep_mask(seed, bh_total, sq, sk, rate):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(*refs, scale, causal, offset, bq, bk, nk, sk_real, has_bias,
-                rate):
+                has_seg, seg_causal, rate):
     scale = np.float32(scale)  # strong f64 scalars poison Mosaic under x64
     it = iter(refs)
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     bias_ref = next(it) if has_bias else None
+    qseg_ref = next(it) if has_seg else None
+    kseg_ref = next(it) if has_seg else None
     seed_ref = next(it) if rate > 0.0 else None
     o_ref, lse_ref = next(it), next(it)
     acc_ref, m_ref, l_ref = next(it), next(it), next(it)
@@ -192,6 +194,8 @@ def _fwd_kernel(*refs, scale, causal, offset, bq, bk, nk, sk_real, has_bias,
         if causal:
             qidx = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             mask = mask & (kidx <= qidx + offset)
+        if has_seg:  # varlen packing: attention never crosses sequences
+            mask = mask & _seg_mask(qseg_ref[0], kseg_ref[0], seg_causal)
         s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[...]                                      # (bq, LANES)
@@ -232,15 +236,17 @@ def _fwd_kernel(*refs, scale, causal, offset, bq, bk, nk, sk_real, has_bias,
 
 
 def _fwd(q3, k3, v3, bias3, seed, hq, hk, causal, scale, offset, sk_real,
-         bq, bk, bias_maps, interpret):
+         bq, bk, bias_maps, interpret, qseg3=None, kseg3=None):
     """q3: (B*Hq, Sq, D) padded; k3/v3: (B*Hk, Sk, D) padded; bias3:
-    (Bb*Hb, Sqb, Sk_pad) or None; seed: (1,) i32 or None."""
+    (Bb*Hb, Sqb, Sk_pad) or None; seed: (1,) i32 or None; qseg3/kseg3:
+    (B*Hq, Sq, 1) / (B*Hq, 1, Sk) i32 segment ids or None."""
     bhq, sq, d = q3.shape
     sk = k3.shape[1]
     nq, nk = sq // bq, sk // bk
     grid = (bhq, nq, nk)
     kv_map = functools.partial(_kv_index, hq=hq, hk=hk)
     has_bias = bias3 is not None
+    has_seg = qseg3 is not None
 
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _Z)),
@@ -251,6 +257,12 @@ def _fwd(q3, k3, v3, bias3, seed, hq, hk, causal, scale, offset, sk_real,
     if has_bias:
         in_specs.append(_bias_spec(bias_maps, bq, bk))
         args.append(bias3)
+    if has_seg:
+        in_specs.append(
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, _Z)))
+        in_specs.append(
+            pl.BlockSpec((1, 1, bk), lambda bh, qi, ki: (bh, _Z, ki)))
+        args += [qseg3, kseg3]
     if seed is not None:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(seed)
@@ -258,6 +270,7 @@ def _fwd(q3, k3, v3, bias3, seed, hq, hk, causal, scale, offset, sk_real,
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, offset=offset,
         bq=bq, bk=bk, nk=nk, sk_real=sk_real, has_bias=has_bias,
+        has_seg=has_seg, seg_causal=bias_maps.get("seg_causal", False),
         rate=bias_maps["rate"])
     out, lse = pl.pallas_call(
         kernel,
@@ -353,12 +366,14 @@ def _bias_spec(maps, bq, bk, kq_grid=False):
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(*refs, scale, causal, offset, bq, bk, nk, sk_real, has_bias,
-               emit_dbias, rate):
+               has_seg, seg_causal, emit_dbias, rate):
     scale = np.float32(scale)  # strong f64 scalars poison Mosaic under x64
     it = iter(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (
         next(it), next(it), next(it), next(it), next(it), next(it))
     bias_ref = next(it) if has_bias else None
+    qseg_ref = next(it) if has_seg else None
+    kseg_ref = next(it) if has_seg else None
     seed_ref = next(it) if rate > 0.0 else None
     dq_ref = next(it)
     dbias_ref = next(it) if emit_dbias else None
@@ -400,6 +415,8 @@ def _dq_kernel(*refs, scale, causal, offset, bq, bk, nk, sk_real, has_bias,
         if causal:
             qidx = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             mask = mask & (kidx <= qidx + offset)
+        if has_seg:  # varlen packing: attention never crosses sequences
+            mask = mask & _seg_mask(qseg_ref[0], kseg_ref[0], seg_causal)
         s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse_safe)                               # (bq, bk)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -420,12 +437,14 @@ def _dq_kernel(*refs, scale, causal, offset, bq, bk, nk, sk_real, has_bias,
 
 
 def _dkv_kernel(*refs, scale, causal, offset, bq, bk, nq, sk_real, has_bias,
-                rate):
+                has_seg, seg_causal, rate):
     scale = np.float32(scale)  # strong f64 scalars poison Mosaic under x64
     it = iter(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (
         next(it), next(it), next(it), next(it), next(it), next(it))
     bias_ref = next(it) if has_bias else None
+    qseg_ref = next(it) if has_seg else None
+    kseg_ref = next(it) if has_seg else None
     seed_ref = next(it) if rate > 0.0 else None
     dk_ref, dv_ref = next(it), next(it)
     dk_acc, dv_acc = next(it), next(it)
@@ -462,6 +481,8 @@ def _dkv_kernel(*refs, scale, causal, offset, bq, bk, nq, sk_real, has_bias,
         if causal:
             qidx = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             mask = mask & (kidx <= qidx + offset)
+        if has_seg:  # varlen packing: attention never crosses sequences
+            mask = mask & _seg_mask(qseg_ref[0], kseg_ref[0], seg_causal)
         s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse_safe)                               # (bq, bk)
         if rate > 0.0:
@@ -492,7 +513,8 @@ def _dkv_kernel(*refs, scale, causal, offset, bq, bk, nq, sk_real, has_bias,
 
 
 def _bwd_impl(q3, kx, vx, do3, lse, delta, bias3, seed, causal, scale,
-              offset, sk_real, bq, bk, bias_maps, interpret):
+              offset, sk_real, bq, bk, bias_maps, interpret, qseg3=None,
+              kseg3=None):
     """All inputs per-q-head flattened: q3/do3 (BHq, Sq, D); kx/vx already
     expanded to (BHq, Sk, D). Returns (dq, dk, dv, dbias_blocks)."""
     bhq, sq, d = q3.shape
@@ -501,6 +523,7 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, bias3, seed, causal, scale,
     lse3 = lse[..., None]                                   # (bhq, sq, 1)
     delta3 = delta[..., None]
     has_bias = bias3 is not None
+    has_seg = qseg3 is not None
     # in-kernel dbias tiles only when bias is full per-(batch, head): then
     # the output is exactly bias-sized. Broadcast biases would amplify to
     # (B*Hq, Sq, Sk) — they take the bounded recompute path in _fa_bwd.
@@ -520,6 +543,12 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, bias3, seed, causal, scale,
     if has_bias:
         in_specs.append(_bias_spec(bias_maps, bq, bk))
         args.append(bias3)
+    if has_seg:
+        in_specs.append(
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, _Z)))
+        in_specs.append(
+            pl.BlockSpec((1, 1, bk), lambda bh, qi, ki: (bh, _Z, ki)))
+        args += [qseg3, kseg3]
     if rate > 0.0:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(seed)
@@ -538,6 +567,8 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, bias3, seed, causal, scale,
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           offset=offset, bq=bq, bk=bk, nk=nk,
                           sk_real=sk_real, has_bias=has_bias,
+                          has_seg=has_seg,
+                          seg_causal=bias_maps.get("seg_causal", False),
                           emit_dbias=emit_dbias, rate=rate),
         grid=(bhq, nq, nk),
         in_specs=in_specs,
@@ -565,6 +596,12 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, bias3, seed, causal, scale,
     if has_bias:
         kq_specs.append(_bias_spec(bias_maps, bq, bk, kq_grid=True))
         kq_args.append(bias3)
+    if has_seg:
+        kq_specs.append(
+            pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, _Z)))
+        kq_specs.append(
+            pl.BlockSpec((1, 1, bk), lambda bh, ki, qi: (bh, _Z, ki)))
+        kq_args += [qseg3, kseg3]
     if rate > 0.0:
         kq_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         kq_args.append(seed)
@@ -574,7 +611,10 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, bias3, seed, causal, scale,
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           offset=offset, bq=bq, bk=bk, nq=nq,
-                          sk_real=sk_real, has_bias=has_bias, rate=rate),
+                          sk_real=sk_real, has_bias=has_bias,
+                          has_seg=has_seg,
+                          seg_causal=bias_maps.get("seg_causal", False),
+                          rate=rate),
         grid=(bhq, nk, nq),
         in_specs=kq_specs,
         out_specs=[
@@ -598,7 +638,8 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, bias3, seed, causal, scale,
 # ---------------------------------------------------------------------------
 
 def _dbias_broadcast(q3, kx, vx, do3, lse_p, delta, bias3, seed, maps,
-                     causal, scale, offset, sk_real, Sq, Sk):
+                     causal, scale, offset, sk_real, Sq, Sk, qseg3=None,
+                     kseg3=None):
     """Memory-bounded dbias for broadcast bias shapes: recompute ds one
     (batch*head) row at a time with a sequential fori_loop, accumulating
     straight into the reduced (Bb*Hb, Sqb, Sk) buffer — peak extra memory
@@ -627,6 +668,11 @@ def _dbias_broadcast(q3, kx, vx, do3, lse_p, delta, bias3, seed, maps,
         if causal:
             qidx = jax.lax.broadcasted_iota(jnp.int32, (sq_pad, sk_pad), 0)
             mask = mask & (kidx <= qidx + offset)
+        if qseg3 is not None:
+            qs = jax.lax.dynamic_index_in_dim(qseg3, bh, 0, keepdims=False)
+            ks = jax.lax.dynamic_index_in_dim(kseg3, bh, 0, keepdims=False)
+            mask = mask & _seg_mask(qs, ks,
+                                    maps.get("seg_causal", False))
         s = jnp.where(mask, s, _NEG_INF)
         lse_safe = jnp.where(lse_b == _NEG_INF, 0.0, lse_b)
         p = jnp.exp(s - lse_safe[:, None])
@@ -658,20 +704,67 @@ def _pad_seq(x3, block):
     return x3
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
-def flash_attention_ext(q, k, v, bias, seed, causal, scale, dropout_rate,
-                        block_q, block_k, interpret):
+def _encode_seg(seg):
+    """Nondecreasing (B, S) segment ids -> int32 words carrying BOTH the
+    id (high 15 bits) and the end-relative position v = local - seg_len
+    (low 16 bits, biased by 0x8000). Two positions are in the same segment
+    iff their high bits match, and the per-segment causal relation
+    k_local <= q_local + Lk - Lq is exactly klow <= qlow — so varlen
+    causal masking with unequal q/k segment lengths needs no extra kernel
+    inputs. Limits: ids < 2^15, segment length <= 2^15."""
+    seg = seg.astype(jnp.int32)
+    pos = jnp.arange(seg.shape[1], dtype=jnp.int32)
+
+    def one(row):
+        left = jnp.searchsorted(row, row, side="left").astype(jnp.int32)
+        right = jnp.searchsorted(row, row, side="right").astype(jnp.int32)
+        v = (pos - left) - (right - left)         # local - L, in [-L, -1]
+        return (row << 16) | (v + np.int32(0x8000))
+    return jax.vmap(one)(seg)
+
+
+def _seg3(q_seg, k_seg, B, Hq, bq, bk):
+    """(B, Sq)/(B, Sk) segment ids -> per-q-head kernel layouts
+    (BHq, Sq_pad, 1) and (BHq, 1, Sk_pad) of encoded seg words; pads take
+    distinct far-negative words so padded rows/cols can never match
+    anything real (or each other) even after the >>16 id extraction."""
+    pad_q = (-q_seg.shape[1]) % bq
+    pad_k = (-k_seg.shape[1]) % bk
+    qs = jnp.pad(_encode_seg(q_seg), ((0, 0), (0, pad_q)),
+                 constant_values=np.int32(-(1 << 20)))
+    ks = jnp.pad(_encode_seg(k_seg), ((0, 0), (0, pad_k)),
+                 constant_values=np.int32(-(2 << 20)))
+    qs = jnp.repeat(qs, Hq, axis=0)[..., None]       # (BHq, Sq_pad, 1)
+    ks = jnp.repeat(ks, Hq, axis=0)[:, None, :]      # (BHq, 1, Sk_pad)
+    return qs, ks
+
+
+def _seg_mask(qenc, kenc, seg_causal):
+    """(bq,1) x (1,bk) encoded seg words -> (bq,bk) visibility mask."""
+    same = (qenc >> np.int32(16)) == (kenc >> np.int32(16))
+    if seg_causal:
+        low = np.int32(0xFFFF)
+        same = same & ((kenc & low) <= (qenc & low))
+    return same
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12))
+def flash_attention_ext(q, k, v, bias, seed, q_seg, k_seg, causal, scale,
+                        dropout_rate, block_q, block_k, interpret):
     """Full-contract flash attention: q [B,Sq,Hq,D], k/v [B,Sk,Hk,D],
     optional additive ``bias`` broadcastable to [B,Hq,Sq,Sk] (full Sk dim),
     deterministic dropout driven by ``seed`` ((1,) int32; see
-    ``dropout_keep_mask``). Returns out [B,Sq,Hq,D]."""
-    out, _ = _fa_fwd(q, k, v, bias, seed, causal, scale, dropout_rate,
-                     block_q, block_k, interpret)
+    ``dropout_keep_mask``), optional varlen packing via ``q_seg``/``k_seg``
+    ((B, Sq)/(B, Sk) int32 segment ids — attention is masked where the ids
+    differ, the TPU-native form of the reference's cu_seqlens contract,
+    flash_attn_kernel.cu:199). Returns out [B,Sq,Hq,D]."""
+    out, _ = _fa_fwd(q, k, v, bias, seed, q_seg, k_seg, causal, scale,
+                     dropout_rate, block_q, block_k, interpret)
     return out
 
 
-def _fa_fwd(q, k, v, bias, seed, causal, scale, dropout_rate, block_q,
-            block_k, interpret):
+def _fa_fwd(q, k, v, bias, seed, q_seg, k_seg, causal, scale, dropout_rate,
+            block_q, block_k, interpret):
     B, Sq, Hq, D = q.shape
     Sk, Hk = k.shape[1], k.shape[2]
     bq, bk = _pick_block(Sq, block_q), _pick_block(Sk, block_k)
@@ -680,12 +773,20 @@ def _fa_fwd(q, k, v, bias, seed, causal, scale, dropout_rate, block_q,
     q3 = _pad_seq(q.transpose(0, 2, 1, 3).reshape(B * Hq, Sq, D), bq)
     k3 = _pad_seq(k.transpose(0, 2, 1, 3).reshape(B * Hk, Sk, D), bk)
     v3 = _pad_seq(v.transpose(0, 2, 1, 3).reshape(B * Hk, Sk, D), bk)
+    qseg3, kseg3 = (_seg3(q_seg, k_seg, B, Hq, bq, bk)
+                    if q_seg is not None else (None, None))
+    seg_causal = causal and q_seg is not None
+    if seg_causal:
+        # per-segment diagonals (k_local - Lk <= q_local - Lq) ride in the
+        # seg words; the kernel's single global diagonal (and its block
+        # skip) would be wrong whenever q/k segment lengths differ
+        causal = False
 
     if bias is not None:
         bias3, maps = _prep_bias(bias, B, Hq, Sq, Sk, bq, bk)
     else:
         bias3, maps = None, {}
-    maps = dict(maps, rate=float(dropout_rate))
+    maps = dict(maps, rate=float(dropout_rate), seg_causal=seg_causal)
     if dropout_rate > 0.0:
         if seed is None:
             raise ValueError("flash_attention_ext: seed is required when "
@@ -695,14 +796,14 @@ def _fa_fwd(q, k, v, bias, seed, causal, scale, dropout_rate, block_q,
         seed_in = None
 
     out3, lse = _fwd(q3, k3, v3, bias3, seed_in, Hq, Hk, causal, scale,
-                     offset, Sk, bq, bk, maps, interpret)
+                     offset, Sk, bq, bk, maps, interpret, qseg3, kseg3)
     out = out3[:, :Sq].reshape(B, Hq, Sq, D).transpose(0, 2, 1, 3)
-    return out, (q, k, v, bias, seed, out, lse)
+    return out, (q, k, v, bias, seed, q_seg, k_seg, out, lse)
 
 
 def _fa_bwd(causal, scale, dropout_rate, block_q, block_k, interpret, res,
             dout):
-    q, k, v, bias, seed, out, lse = res
+    q, k, v, bias, seed, q_seg, k_seg, out, lse = res
     B, Sq, Hq, D = q.shape
     Sk, Hk = k.shape[1], k.shape[2]
     rep = Hq // Hk
@@ -718,6 +819,11 @@ def _fa_bwd(causal, scale, dropout_rate, block_q, block_k, interpret, res,
         v.transpose(0, 2, 1, 3)
     kx = _pad_seq(k4.reshape(B * Hq, Sk, D), bk)
     vx = _pad_seq(v4.reshape(B * Hq, Sk, D), bk)
+    qseg3, kseg3 = (_seg3(q_seg, k_seg, B, Hq, bq, bk)
+                    if q_seg is not None else (None, None))
+    seg_causal = causal and q_seg is not None
+    if seg_causal:
+        causal = False   # per-segment diagonals ride in the seg words
 
     # delta_i = rowsum(dO_i * O_i) — cheap elementwise, leave to XLA
     out3 = out.transpose(0, 2, 1, 3).reshape(B * Hq, Sq, D)
@@ -737,7 +843,7 @@ def _fa_bwd(causal, scale, dropout_rate, block_q, block_k, interpret, res,
         bias3, maps = _prep_bias(bias, B, Hq, Sq, Sk, bq, bk)
     else:
         bias3, maps = None, {}
-    maps = dict(maps, rate=float(dropout_rate))
+    maps = dict(maps, rate=float(dropout_rate), seg_causal=seg_causal)
     if dropout_rate > 0.0:
         if seed is None:
             raise ValueError("flash_attention_ext: seed is required when "
@@ -748,7 +854,7 @@ def _fa_bwd(causal, scale, dropout_rate, block_q, block_k, interpret, res,
 
     dq3, dk3, dv3, dbias_blocks = _bwd_impl(
         q3, kx, vx, do3, lse_p, delta, bias3, seed_in, causal, scale,
-        offset, Sk, bq, bk, maps, interpret)
+        offset, Sk, bq, bk, maps, interpret, qseg3, kseg3)
     dq = dq3[:, :Sq].reshape(B, Hq, Sq, D).transpose(0, 2, 1, 3)
     dk4 = dk3[:, :Sk].reshape(B, Hq, Sk, D)
     dv4 = dv3[:, :Sk].reshape(B, Hq, Sk, D)
@@ -768,11 +874,15 @@ def _fa_bwd(causal, scale, dropout_rate, block_q, block_k, interpret, res,
         # broadcast bias: memory-bounded sequential recompute
         db3 = _dbias_broadcast(q3, kx, vx, do3, lse_p, delta, bias3,
                                seed_in, maps, causal, scale, offset, Sk,
-                               Sq, Sk)
+                               Sq, Sk, qseg3, kseg3)
         dbias = db3[:, :maps["Sqb"]].reshape(
             jnp.asarray(bias).shape).astype(bias.dtype)
     dseed = np.zeros(np.shape(seed), jax.dtypes.float0)
-    return dq.astype(q.dtype), dk, dv, dbias, dseed
+    dqseg = (np.zeros(np.shape(q_seg), jax.dtypes.float0)
+             if q_seg is not None else None)
+    dkseg = (np.zeros(np.shape(k_seg), jax.dtypes.float0)
+             if k_seg is not None else None)
+    return dq.astype(q.dtype), dk, dv, dbias, dseed, dqseg, dkseg
 
 
 flash_attention_ext.defvjp(_fa_fwd, _fa_bwd)
@@ -782,8 +892,8 @@ def flash_attention_pallas(q, k, v, causal, scale, interpret,
                            block_q=128, block_k=128):
     """Bias-free, dropout-free fast path (back-compat signature)."""
     return flash_attention_ext(q, k, v, None, jnp.zeros((1,), jnp.int32),
-                               causal, scale, 0.0, block_q, block_k,
-                               interpret)
+                               None, None, causal, scale, 0.0, block_q,
+                               block_k, interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -822,8 +932,9 @@ def _attention_pallas(q, k, v, bias, causal, scale, dropout_p, dropout_key):
                                 float(scale), rate, interpret)
     if out is not None:   # autotune just measured the winner end-to-end
         return out
-    return flash_attention_ext(q, k, v, bias, seed, bool(causal),
-                               float(scale), rate, bq, bk, interpret)
+    return flash_attention_ext(q, k, v, bias, seed, None, None,
+                               bool(causal), float(scale), rate, bq, bk,
+                               interpret)
 
 
 # candidate (block_q, block_k) tilings; 128x128 is the safe default, the
@@ -852,8 +963,8 @@ def _tuned_blocks(q, k, v, bias, seed, causal, scale, rate, interpret):
         a, b = cands[name]
         out, vjp = jax.vjp(
             lambda q_, k_, v_: flash_attention_ext(
-                q_, k_, v_, bias, seed, causal, scale, rate, a, b,
-                interpret), q, k, v)
+                q_, k_, v_, bias, seed, None, None, causal, scale, rate,
+                a, b, interpret), q, k, v)
         grads = vjp(jnp.ones_like(out))
         # fetch one element per grad so the timed window really includes
         # the backward kernels (block_until_ready can return early on the

@@ -146,15 +146,15 @@ def test_bias_in_kernel(bshape):
     scale = 1.0 / math.sqrt(64)
     rng = np.random.RandomState(4)
     bias = jnp.asarray(rng.standard_normal(bshape), jnp.float32) * 0.5
-    out = flash_attention_ext(q, k, v, bias, _SEED0, True, scale, 0.0,
-                              128, 128, True)
+    out = flash_attention_ext(q, k, v, bias, _SEED0, None, None, True,
+                              scale, 0.0, 128, 128, True)
     ref = _dense_oracle(q, k, v, scale, bias=bias)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=3e-5, atol=3e-5)
     # grads incl. dbias reduced onto the broadcast shape
     g = jax.grad(lambda q, b: flash_attention_ext(
-        q, k, v, b, _SEED0, True, scale, 0.0, 128, 128, True).sum(),
-        (0, 1))(q, bias)
+        q, k, v, b, _SEED0, None, None, True, scale, 0.0, 128, 128,
+        True).sum(), (0, 1))(q, bias)
     ge = jax.grad(lambda q, b: _dense_oracle(
         q, k, v, scale, bias=b).sum(), (0, 1))(q, bias)
     np.testing.assert_allclose(np.asarray(g[0]), np.asarray(ge[0]),
@@ -178,15 +178,15 @@ def test_dropout_exact_mask_replay():
     # drop fraction matches the rate
     assert abs(float(keep.mean()) - (1.0 - rate)) < 0.01
 
-    out = flash_attention_ext(q, k, v, None, seed, True, scale, rate,
-                              128, 128, True)
+    out = flash_attention_ext(q, k, v, None, seed, None, None, True,
+                              scale, rate, 128, 128, True)
     ref = _dense_oracle(q, k, v, scale, keep=keep, rate=rate)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
     g = jax.grad(lambda q, k, v: flash_attention_ext(
-        q, k, v, None, seed, True, scale, rate, 128, 128, True).sum(),
-        (0, 1, 2))(q, k, v)
+        q, k, v, None, seed, None, None, True, scale, rate, 128, 128,
+        True).sum(), (0, 1, 2))(q, k, v)
     ge = jax.grad(lambda q, k, v: _dense_oracle(
         q, k, v, scale, keep=keep, rate=rate).sum(), (0, 1, 2))(q, k, v)
     for a, e in zip(g, ge):
@@ -202,8 +202,8 @@ def test_dropout_matches_xla_fallback():
     scale = 1.0 / math.sqrt(32)
     key = jax.random.key(7)
     ref = _attention_xla(q, k, v, None, True, scale, 0.1, key)
-    out = flash_attention_ext(q, k, v, None, seed_from_key(key), True,
-                              scale, 0.1, 128, 128, True)
+    out = flash_attention_ext(q, k, v, None, seed_from_key(key), None,
+                              None, True, scale, 0.1, 128, 128, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
@@ -215,7 +215,7 @@ def test_dropout_bias_jit_and_seed_sensitivity():
     bias = jnp.asarray(rng.standard_normal((1, 2, 128, 128)),
                        jnp.float32) * 0.5
     f = jax.jit(lambda q, k, v, b, s: flash_attention_ext(
-        q, k, v, b, s, False, scale, 0.2, 128, 128, True))
+        q, k, v, b, s, None, None, False, scale, 0.2, 128, 128, True))
     s1 = seed_from_key(jax.random.key(1))
     s2 = seed_from_key(jax.random.key(2))
     o1, o1b, o2 = f(q, k, v, bias, s1), f(q, k, v, bias, s1), \
@@ -275,3 +275,157 @@ def test_autotune_block_cache_populates_and_consults(tmp_path):
         at.disable_autotune()
         at.set_autotune_cache_file(None)
         at.clear_autotune_cache()
+
+
+class TestVarlenSegments:
+    """In-kernel segment-id masking (the TPU form of the reference's
+    cu_seqlens varlen contract, flash_attn_kernel.cu:199): packed ragged
+    sequences must attend only within themselves, fwd and bwd."""
+
+    LENS = [5, 9, 2]
+
+    def _packed(self, d=64, h=2, seed=11):
+        rng = np.random.RandomState(seed)
+        total = sum(self.LENS)
+        q = jnp.asarray(rng.standard_normal((1, total, h, d)),
+                        jnp.float32) * 0.3
+        k = jnp.asarray(rng.standard_normal((1, total, h, d)),
+                        jnp.float32) * 0.3
+        v = jnp.asarray(rng.standard_normal((1, total, h, d)),
+                        jnp.float32) * 0.3
+        cu = np.concatenate([[0], np.cumsum(self.LENS)]).astype(np.int32)
+        seg = np.repeat(np.arange(len(self.LENS), dtype=np.int32),
+                        self.LENS)[None, :]
+        return q, k, v, cu, jnp.asarray(seg)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_per_sequence_dense(self, causal):
+        d = 64
+        q, k, v, cu, seg = self._packed(d)
+        scale = 1.0 / math.sqrt(d)
+        out = flash_attention_ext(q, k, v, None, _SEED0, seg, seg, causal,
+                                  scale, 0.0, 128, 128, True)
+        for i in range(len(self.LENS)):
+            lo, hi = int(cu[i]), int(cu[i + 1])
+            ref = _dense_oracle(q[:, lo:hi], k[:, lo:hi], v[:, lo:hi],
+                                scale, causal=causal)
+            np.testing.assert_allclose(np.asarray(out[:, lo:hi]),
+                                       np.asarray(ref), rtol=3e-5,
+                                       atol=3e-5)
+
+    def test_grads_match_per_sequence(self):
+        d = 64
+        q, k, v, cu, seg = self._packed(d)
+        scale = 1.0 / math.sqrt(d)
+        g = jax.grad(lambda q, k, v: flash_attention_ext(
+            q, k, v, None, _SEED0, seg, seg, True, scale, 0.0, 128, 128,
+            True).sum(), (0, 1, 2))(q, k, v)
+        for i in range(len(self.LENS)):
+            lo, hi = int(cu[i]), int(cu[i + 1])
+            ge = jax.grad(lambda q, k, v: _dense_oracle(
+                q, k, v, scale, causal=True).sum(), (0, 1, 2))(
+                q[:, lo:hi], k[:, lo:hi], v[:, lo:hi])
+            for a, e in zip(g, ge):
+                np.testing.assert_allclose(np.asarray(a[:, lo:hi]),
+                                           np.asarray(e), rtol=3e-4,
+                                           atol=3e-4)
+
+    def test_flash_attn_unpadded_api(self):
+        """The packed public API: [total, H, D] + cu_seqlens."""
+        import paddle_tpu as paddle
+        from paddle_tpu.nn.functional.flash_attention import \
+            flash_attn_unpadded
+
+        d = 64
+        q, k, v, cu, seg = self._packed(d)
+        scale = 1.0 / math.sqrt(d)
+        _flags.set_flags({"pallas_force_interpret": True})
+        try:
+            out, _ = flash_attn_unpadded(
+                paddle.to_tensor(np.asarray(q[0])),
+                paddle.to_tensor(np.asarray(k[0])),
+                paddle.to_tensor(np.asarray(v[0])),
+                paddle.to_tensor(cu), paddle.to_tensor(cu),
+                max(self.LENS), max(self.LENS), scale, causal=True)
+        finally:
+            _flags.set_flags({"pallas_force_interpret": False})
+        out = np.asarray(out.numpy())
+        for i in range(len(self.LENS)):
+            lo, hi = int(cu[i]), int(cu[i + 1])
+            ref = _dense_oracle(q[:, lo:hi], k[:, lo:hi], v[:, lo:hi],
+                                scale, causal=True)
+            np.testing.assert_allclose(out[lo:hi], np.asarray(ref)[0],
+                                       rtol=3e-5, atol=3e-5)
+
+
+def test_varlen_causal_ragged_qk_lengths():
+    """Per-segment causal with DIFFERENT q/k lengths per segment (the
+    reference's cross-attention varlen case): each segment must use its
+    own (Lk - Lq)-offset diagonal, not one global diagonal."""
+    # per-segment (Lk - Lq) offsets 2 and 0; the single global diagonal
+    # would use offset (8-6)=2 for BOTH segments — visibly wrong for the
+    # second one. Lk >= Lq keeps every q row non-empty (rows with no
+    # visible key are a separate zero-output contract).
+    lens_q = [2, 4]
+    lens_k = [4, 4]
+    d, h = 64, 2
+    rng = np.random.RandomState(13)
+    tq, tk = sum(lens_q), sum(lens_k)
+    q = jnp.asarray(rng.standard_normal((1, tq, h, d)), jnp.float32) * 0.3
+    k = jnp.asarray(rng.standard_normal((1, tk, h, d)), jnp.float32) * 0.3
+    v = jnp.asarray(rng.standard_normal((1, tk, h, d)), jnp.float32) * 0.3
+    seg_q = jnp.asarray(np.repeat(np.arange(2, dtype=np.int32),
+                                  lens_q)[None, :])
+    seg_k = jnp.asarray(np.repeat(np.arange(2, dtype=np.int32),
+                                  lens_k)[None, :])
+    scale = 1.0 / math.sqrt(d)
+    out = flash_attention_ext(q, k, v, None, _SEED0, seg_q, seg_k, True,
+                              scale, 0.0, 128, 128, True)
+    cu_q = np.concatenate([[0], np.cumsum(lens_q)])
+    cu_k = np.concatenate([[0], np.cumsum(lens_k)])
+    for i in range(2):
+        qs, qe = int(cu_q[i]), int(cu_q[i + 1])
+        ks, ke = int(cu_k[i]), int(cu_k[i + 1])
+        ref = _dense_oracle(q[:, qs:qe], k[:, ks:ke], v[:, ks:ke], scale,
+                            causal=True)  # oracle uses the offset diagonal
+        np.testing.assert_allclose(np.asarray(out[:, qs:qe]),
+                                   np.asarray(ref), rtol=3e-5, atol=3e-5)
+
+    # grads too
+    g = jax.grad(lambda q, k, v: flash_attention_ext(
+        q, k, v, None, _SEED0, seg_q, seg_k, True, scale, 0.0, 128, 128,
+        True).sum(), (0, 1, 2))(q, k, v)
+    for i in range(2):
+        qs, qe = int(cu_q[i]), int(cu_q[i + 1])
+        ks, ke = int(cu_k[i]), int(cu_k[i + 1])
+        ge = jax.grad(lambda q_, k_, v_: _dense_oracle(
+            q_, k_, v_, scale, causal=True).sum(), (0, 1, 2))(
+            q[:, qs:qe], k[:, ks:ke], v[:, ks:ke])
+        np.testing.assert_allclose(np.asarray(g[0][:, qs:qe]),
+                                   np.asarray(ge[0]), rtol=3e-4, atol=3e-4)
+        np.testing.assert_allclose(np.asarray(g[1][:, ks:ke]),
+                                   np.asarray(ge[1]), rtol=3e-4, atol=3e-4)
+        np.testing.assert_allclose(np.asarray(g[2][:, ks:ke]),
+                                   np.asarray(ge[2]), rtol=3e-4, atol=3e-4)
+
+
+def test_flash_attn_unpadded_xla_fallback_no_nan():
+    """The CPU/XLA fallback must zero dead q rows (no visible key) instead
+    of emitting NaN, and must apply per-segment causal."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.functional.flash_attention import flash_attn_unpadded
+
+    rng = np.random.RandomState(14)
+    # 6 packed q tokens but only 4 covered by cu: the tail 2 are don't-cares
+    q = rng.standard_normal((6, 2, 32)).astype(np.float32)
+    k = rng.standard_normal((4, 2, 32)).astype(np.float32)
+    v = rng.standard_normal((4, 2, 32)).astype(np.float32)
+    cu_q = np.array([0, 2, 4], np.int32)
+    cu_k = np.array([0, 2, 4], np.int32)
+    out, _ = flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cu_q), paddle.to_tensor(cu_k), 2, 2,
+        1.0 / math.sqrt(32), causal=True)
+    out = np.asarray(out.numpy())
+    assert np.isfinite(out[:4]).all()
+    np.testing.assert_array_equal(out[4:], 0.0)   # dead rows zeroed
