@@ -1,3 +1,4 @@
 from ..process_mesh import ProcessMesh, Shard, Replicate, Partial  # noqa: F401
 from .api import (shard_tensor, reshard, shard_layer, shard_optimizer,  # noqa: F401
                   dtensor_from_fn, unshard_dtensor, local_value, DistAttr)
+from .engine import Engine  # noqa: F401

@@ -167,8 +167,10 @@ class DistModel:
         x = args[0]._data if isinstance(args[0], Tensor) else args[0]
         y = args[1] if len(args) > 1 else None
         y = y._data if isinstance(y, Tensor) else y
-        if self._eval_fn is None and self._params is None:
-            # eval-only DistModel still gets the auto-derived layout
+        if self._params is None and self._eval_placed is None:
+            # eval-only DistModel still gets the auto-derived layout; the
+            # cache is invalidated (set back to None) when new weights are
+            # loaded from a checkpoint
             self._auto_complete(x, y)
             from ...models.trainer import place_by_spec
             self._eval_placed = {
