@@ -23,7 +23,9 @@ __all__ = ["Engine"]
 
 def _batches(data, batch_size):
     """Accept a paddle_tpu.io.DataLoader-like iterable (yielding (x, y))
-    or an (x, y) array pair to slice into batches."""
+    or an (x, y) array pair to slice into FULL batches (drop-last: static
+    shapes keep one compiled program). batch_size > n is an error, not a
+    silent no-op."""
     if hasattr(data, "__iter__") and not isinstance(data, (tuple, list)):
         yield from data
         return
@@ -32,6 +34,9 @@ def _batches(data, batch_size):
     y = y._data if isinstance(y, Tensor) else np.asarray(y)
     n = x.shape[0]
     bs = batch_size or n
+    if bs > n:
+        raise ValueError(
+            f"batch_size={bs} exceeds the {n} samples provided")
     for i in range(0, n - bs + 1, bs):
         yield x[i:i + bs], y[i:i + bs]
 
@@ -97,27 +102,33 @@ class Engine:
     def evaluate(self, valid_data, batch_size=None, steps=None):
         """Mean loss (+ metric results) over ``valid_data``."""
         dist = self.prepare()
+        was_mode = dist._mode
         dist.eval()
         for m in self._metrics:
             if hasattr(m, "reset"):
                 m.reset()
         losses = []
-        for step, (x, y) in enumerate(_batches(valid_data, batch_size)):
-            if steps and step >= steps:
-                break
-            losses.append(float(dist(x, y)))
-            if self._metrics:
+        try:
+            for step, (x, y) in enumerate(
+                    _batches(valid_data, batch_size)):
+                if steps and step >= steps:
+                    break
+                # ONE forward per batch: loss and metrics both come from
+                # the same logits
                 out = self._predict_batch(x)
+                yt = Tensor(y._data if isinstance(y, Tensor)
+                            else np.asarray(y))
+                if self._loss is not None:
+                    losses.append(float(self._loss(Tensor(out), yt)))
                 for m in self._metrics:
-                    m.update(*m.compute(Tensor(out), Tensor(
-                        y._data if isinstance(y, Tensor)
-                        else np.asarray(y))))
+                    m.update(*m.compute(Tensor(out), yt))
+        finally:
+            dist._mode = was_mode
         result = {"loss": float(np.mean(losses)) if losses
                   else float("nan")}
         for m in self._metrics:
             result[m.name() if callable(getattr(m, "name", None))
                    else type(m).__name__] = m.accumulate()
-        dist.train()
         return result
 
     def _predict_batch(self, x):
@@ -135,8 +146,8 @@ class Engine:
         self.prepare()
         outs = []
         data = test_data
-        if not (hasattr(data, "__iter__")
-                and not isinstance(data, (tuple, list))):
+        if isinstance(data, (tuple, list, np.ndarray, Tensor)) or \
+                hasattr(data, "shape"):
             x = data[0] if isinstance(data, (tuple, list)) else data
             data = (x, x)   # _batches wants a pair; y is unused here
         for step, (x, _) in enumerate(_batches(data, batch_size)):
@@ -183,18 +194,26 @@ class Engine:
 
     def cost(self, mode="train"):
         """Analytic cost surface (parity: Engine.cost): projected per-chip
-        memory from the auto-tuner's model."""
+        memory from the auto-tuner's model, fed the REAL model dims when
+        the model exposes a config."""
         from ..auto_tuner.prune import estimate_memory_bytes
 
         del mode
-        n_axes = {a: s for a, s in zip(
-            self.prepare()._jmesh.axis_names,
-            self.prepare()._jmesh.devices.shape)}
+        jmesh = self.prepare()._jmesh
+        n_axes = dict(zip(jmesh.axis_names, jmesh.devices.shape))
         cfg = {"mp_degree": n_axes.get("tp", 1),
                "dp_degree": n_axes.get("dp", 1)}
         params = sum(int(np.prod(p.shape))
                      for p in self._model.parameters())
-        tuner_cfg = {"model_cfg": {
-            "hidden_size": 0, "num_layers": 0, "vocab_size": 0}}
-        est = estimate_memory_bytes(tuner_cfg, cfg)
+        mc = getattr(self._model, "cfg", None) or getattr(
+            self._model, "config", None)
+        model_cfg = {}
+        for field in ("hidden_size", "num_layers", "vocab_size",
+                      "intermediate_size", "num_heads",
+                      "max_position_embeddings"):
+            v = getattr(mc, field, None)
+            if v is not None:
+                model_cfg[field] = int(v)
+        est = (estimate_memory_bytes({"model_cfg": model_cfg}, cfg)
+               if model_cfg.get("hidden_size") else None)
         return {"params": params, "estimated_bytes": est}
