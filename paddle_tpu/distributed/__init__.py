@@ -36,6 +36,7 @@ from .extras import (spawn, scatter_object_list, broadcast_object_list,  # noqa:
 from . import io  # noqa: F401
 from . import utils  # noqa: F401
 from . import communication  # noqa: F401
+from . import ps  # noqa: F401
 
 alltoall = all_to_all
 alltoall_single = all_to_all_single

@@ -1,0 +1,32 @@
+"""TPU-native parameter-server mode.
+
+The reference's PS stack (paddle/fluid/distributed/ps/ ~55k LoC C++
+over brpc + python/paddle/distributed/ps/) is a parallel L4-L6 universe
+for sparse models whose embedding tables exceed device memory. This
+package is its TPU-native analog at the same capability points:
+
+- host-RAM sparse tables with server-side optimizer accessors
+  (SGD/Adagrad/Adam/CTR admission+eviction)   -> table.py, accessor.py
+- a sharded TCP service + shard-routing client -> service.py, client.py
+- sync / async(merge-queue) / geo-SGD(delta) communicators -> client.py
+- role runtime + SparseEmbedding pull/push layer -> runtime.py
+
+Design departure, on purpose: the reference splits dense math per-rank
+around the PS; here the dense model is one jitted XLA program on the
+TPU mesh and only the sparse edge crosses to the host — the same
+boundary its heter-PS (GPU-cache) variant draws.
+"""
+from .accessor import (AdagradAccessor, AdamAccessor, CtrAccessor,
+                       SGDAccessor, make_accessor)
+from .client import Communicator, PSClient
+from .runtime import (PSRuntime, SparseEmbedding, init_server, init_worker,
+                      run_server, stop_worker)
+from .service import PSServer
+from .table import DenseTable, SparseTable
+
+__all__ = [
+    "SGDAccessor", "AdagradAccessor", "AdamAccessor", "CtrAccessor",
+    "make_accessor", "SparseTable", "DenseTable", "PSServer", "PSClient",
+    "Communicator", "PSRuntime", "SparseEmbedding", "init_server",
+    "run_server", "init_worker", "stop_worker",
+]

@@ -1,0 +1,140 @@
+"""Row accessors: the per-feature optimizer applied on sparse push.
+
+The reference's PS applies the optimizer *on the server* when gradients
+are pushed (accessors in paddle/fluid/distributed/ps/table/
+sparse_accessor.h, ctr_accessor.cc — SGD/Adagrad/Adam rules plus CTR
+show/click statistics driving feature admission and eviction). The
+TPU-native analog keeps that contract: the dense model trains on-device
+inside one jitted step, while embedding rows too large for HBM live in
+host RAM and are updated here, vectorized over the pushed row block.
+
+All accessors operate on ``(rows, slots, grads)`` numpy blocks — one
+call per pushed batch, no per-row Python loops.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+__all__ = ["SGDAccessor", "AdagradAccessor", "AdamAccessor", "CtrAccessor",
+           "make_accessor"]
+
+
+class SGDAccessor:
+    """Plain SGD on pushed rows (reference sparse_sgd_rule.cc StdAdaGrad's
+    naive mode)."""
+
+    slot_names: Tuple[str, ...] = ()
+
+    def __init__(self, learning_rate: float = 0.05):
+        self.lr = float(learning_rate)
+
+    def init_slots(self, n: int, dim: int) -> Dict[str, np.ndarray]:
+        return {}
+
+    def update(self, rows: np.ndarray, slots: Dict[str, np.ndarray],
+               grads: np.ndarray) -> None:
+        rows -= self.lr * grads
+
+
+class AdagradAccessor:
+    """Per-element Adagrad (reference sparse_sgd_rule.cc SparseAdaGradSGDRule)."""
+
+    slot_names = ("g2sum",)
+
+    def __init__(self, learning_rate: float = 0.05, epsilon: float = 1e-8):
+        self.lr = float(learning_rate)
+        self.eps = float(epsilon)
+
+    def init_slots(self, n: int, dim: int) -> Dict[str, np.ndarray]:
+        return {"g2sum": np.zeros((n, dim), np.float32)}
+
+    def update(self, rows, slots, grads):
+        g2 = slots["g2sum"]
+        g2 += grads * grads
+        rows -= self.lr * grads / (np.sqrt(g2) + self.eps)
+
+
+class AdamAccessor:
+    """Adam with per-row step counts (reference sparse_sgd_rule.cc
+    SparseAdamSGDRule: beta1/beta2 powers tracked per feature)."""
+
+    slot_names = ("m", "v", "step")
+
+    def __init__(self, learning_rate: float = 0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8):
+        self.lr = float(learning_rate)
+        self.b1, self.b2 = float(beta1), float(beta2)
+        self.eps = float(epsilon)
+
+    def init_slots(self, n, dim):
+        return {"m": np.zeros((n, dim), np.float32),
+                "v": np.zeros((n, dim), np.float32),
+                "step": np.zeros((n, 1), np.float32)}
+
+    def update(self, rows, slots, grads):
+        m, v, step = slots["m"], slots["v"], slots["step"]
+        step += 1.0
+        m *= self.b1
+        m += (1 - self.b1) * grads
+        v *= self.b2
+        v += (1 - self.b2) * grads * grads
+        bc1 = 1.0 - self.b1 ** step
+        bc2 = 1.0 - self.b2 ** step
+        rows -= self.lr * (m / bc1) / (np.sqrt(v / bc2) + self.eps)
+
+
+class CtrAccessor:
+    """CTR-style accessor: wraps a base rule and keeps per-feature
+    show/click statistics with exponential decay, driving entry admission
+    (a feature earns its embedding only after enough shows) and eviction
+    of stale features (reference ctr_accessor.cc: show_click_decay_rate,
+    delete_threshold, delta_score).
+    """
+
+    def __init__(self, base=None, show_decay: float = 0.98,
+                 admit_threshold: float = 1.0,
+                 delete_threshold: float = 0.25):
+        self.base = base or AdagradAccessor()
+        self.slot_names = self.base.slot_names + ("show", "click")
+        self.show_decay = float(show_decay)
+        self.admit_threshold = float(admit_threshold)
+        self.delete_threshold = float(delete_threshold)
+
+    def init_slots(self, n, dim):
+        s = self.base.init_slots(n, dim)
+        s["show"] = np.zeros((n, 1), np.float32)
+        s["click"] = np.zeros((n, 1), np.float32)
+        return s
+
+    def update(self, rows, slots, grads):
+        base_slots = {k: slots[k] for k in self.base.slot_names}
+        self.base.update(rows, base_slots, grads)
+
+    def record_shows(self, slots, shows, clicks=None):
+        slots["show"] += np.asarray(shows, np.float32).reshape(-1, 1)
+        if clicks is not None:
+            slots["click"] += np.asarray(clicks, np.float32).reshape(-1, 1)
+
+    def decay(self, slots):
+        slots["show"] *= self.show_decay
+        slots["click"] *= self.show_decay
+
+    def should_evict(self, slots) -> np.ndarray:
+        """Boolean mask over rows whose decayed score dropped below the
+        delete threshold."""
+        score = slots["show"] + 2.0 * slots["click"]
+        return (score < self.delete_threshold).reshape(-1)
+
+
+_ACCESSORS = {"sgd": SGDAccessor, "adagrad": AdagradAccessor,
+              "adam": AdamAccessor, "ctr": CtrAccessor}
+
+
+def make_accessor(name: str, **kwargs):
+    try:
+        return _ACCESSORS[name.lower()](**kwargs)
+    except KeyError:
+        raise ValueError(
+            f"unknown accessor {name!r}; one of {sorted(_ACCESSORS)}")

@@ -1,0 +1,264 @@
+"""Parameter-server client + communicator (brpc_ps_client / Communicator
+analog).
+
+Routing: feature id -> server ``fid % n_servers`` (the reference shards
+by id hash across server instances — brpc_ps_client.cc::ShardNum). The
+communicator reproduces the reference's three training modes
+(paddle/fluid/distributed/ps/service/communicator/communicator.cc):
+
+- **sync**: every push is sent and applied before the next pull;
+- **async**: pushes land in a merge queue drained by a background
+  thread — duplicate ids in queued batches are pre-aggregated before
+  send (AsyncCommunicator::MergeSparseGrads);
+- **geo**: workers train on a local replica and periodically ship
+  weight *deltas* (GeoCommunicator) — the only mode where the server
+  applies raw diffs instead of running the optimizer.
+"""
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+import time
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from .service import recv_msg, send_msg
+from .table import SparseTable
+
+__all__ = ["PSClient", "Communicator"]
+
+
+class _Conn:
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)), timeout=60)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.lock = threading.Lock()
+
+    def call(self, meta: dict, arrays: Dict[str, np.ndarray]):
+        with self.lock:
+            send_msg(self.sock, meta, arrays)
+            return recv_msg(self.sock)
+
+
+class PSClient:
+    """Shard-routing client over one socket per server."""
+
+    def __init__(self, endpoints: Sequence[str], table_defaults=None):
+        self._conns = [_Conn(e) for e in endpoints]
+        self.n = len(self._conns)
+        self._defaults = dict(table_defaults or {})
+
+    def _meta(self, cmd: str, table: str, dim: int, **kw) -> dict:
+        m = {"cmd": cmd, "table": table, "dim": int(dim)}
+        m.update(self._defaults.get(table, {}))
+        m.update(kw)
+        return m
+
+    def _route(self, ids: np.ndarray):
+        shard = ids % self.n
+        return [np.nonzero(shard == s)[0] for s in range(self.n)]
+
+    # -- sparse --------------------------------------------------------------
+    def pull(self, table: str, ids, dim: int) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.empty((len(ids), dim), np.float32)
+        for s, sel in enumerate(self._route(ids)):
+            if not len(sel):
+                continue
+            _, arrs = self._conns[s].call(
+                self._meta("pull", table, dim), {"ids": ids[sel]})
+            out[sel] = arrs["rows"]
+        return out
+
+    def push(self, table: str, ids, grads, dim: int) -> None:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), dim)
+        for s, sel in enumerate(self._route(ids)):
+            if len(sel):
+                self._conns[s].call(self._meta("push", table, dim),
+                                    {"ids": ids[sel], "grads": grads[sel]})
+
+    def push_delta(self, table: str, ids, deltas, dim: int) -> None:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        deltas = np.asarray(deltas, np.float32).reshape(len(ids), dim)
+        for s, sel in enumerate(self._route(ids)):
+            if len(sel):
+                self._conns[s].call(
+                    self._meta("push_delta", table, dim),
+                    {"ids": ids[sel], "deltas": deltas[sel]})
+
+    # -- dense ---------------------------------------------------------------
+    def dense_set(self, params: Dict[str, np.ndarray], server: int = 0):
+        self._conns[server].call({"cmd": "dense_set"}, params)
+
+    def dense_add(self, deltas: Dict[str, np.ndarray], server: int = 0):
+        self._conns[server].call({"cmd": "dense_add"}, deltas)
+
+    def dense_get(self, names: List[str], server: int = 0):
+        _, arrs = self._conns[server].call(
+            {"cmd": "dense_get", "names": list(names)}, {})
+        return arrs
+
+    # -- maintenance ---------------------------------------------------------
+    def shrink(self) -> int:
+        return sum(c.call({"cmd": "shrink"}, {})[0].get("evicted", 0)
+                   for c in self._conns)
+
+    def save(self) -> List[Dict[str, np.ndarray]]:
+        return [c.call({"cmd": "save"}, {})[1] for c in self._conns]
+
+    def load(self, blobs: List[Dict[str, np.ndarray]]) -> None:
+        for c, b in zip(self._conns, blobs):
+            c.call({"cmd": "load"}, b)
+
+    def stats(self):
+        return [c.call({"cmd": "stats"}, {})[0] for c in self._conns]
+
+    def stop_servers(self):
+        for c in self._conns:
+            try:
+                c.call({"cmd": "stop"}, {})
+            except Exception:
+                pass
+
+    def close(self):
+        for c in self._conns:
+            try:
+                c.sock.close()
+            except Exception:
+                pass
+
+
+class Communicator:
+    """Training-mode driver over a PSClient.
+
+    sync: ``push`` forwards immediately. async: pushes are queued,
+    merged by id, and drained by a daemon thread every
+    ``send_interval_s`` (or when ``queue_cap`` batches pile up). geo:
+    ``local_step`` trains against a local ``SparseTable`` replica and
+    every ``geo_steps`` ships row deltas to the servers.
+    """
+
+    def __init__(self, client: PSClient, mode: str = "sync",
+                 send_interval_s: float = 0.05, queue_cap: int = 64,
+                 geo_steps: int = 8):
+        if mode not in ("sync", "async", "geo"):
+            raise ValueError(f"unknown communicator mode {mode!r}")
+        self.client = client
+        self.mode = mode
+        self.geo_steps = int(geo_steps)
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_cap)
+        self._interval = float(send_interval_s)
+        self._stop = threading.Event()
+        self._thread = None
+        self._local: Dict[str, SparseTable] = {}
+        self._base: Dict[str, Dict[int, np.ndarray]] = {}
+        self._steps: Dict[str, int] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if self.mode == "async":
+            self._thread = threading.Thread(target=self._drain_loop,
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self.flush()
+
+    # -- sync / async push ---------------------------------------------------
+    def push(self, table: str, ids, grads, dim: int) -> None:
+        if self.mode == "sync":
+            self.client.push(table, ids, grads, dim)
+        else:
+            self._q.put((table, np.asarray(ids, np.int64).reshape(-1),
+                         np.asarray(grads, np.float32), int(dim)))
+
+    def flush(self):
+        """Merge and send everything still queued (async mode)."""
+        pending: Dict[tuple, list] = {}
+        while True:
+            try:
+                table, ids, grads, dim = self._q.get_nowait()
+            except queue.Empty:
+                break
+            pending.setdefault((table, dim), []).append((ids, grads))
+        for (table, dim), items in pending.items():
+            ids = np.concatenate([i for i, _ in items])
+            grads = np.concatenate(
+                [g.reshape(len(i), dim) for i, g in items])
+            # merge duplicate ids before hitting the wire
+            uniq, inv = np.unique(ids, return_inverse=True)
+            agg = np.zeros((len(uniq), dim), np.float32)
+            np.add.at(agg, inv, grads)
+            self.client.push(table, uniq, agg, dim)
+
+    def _drain_loop(self):
+        while not self._stop.is_set():
+            time.sleep(self._interval)
+            try:
+                self.flush()
+            except Exception:
+                if self._stop.is_set():
+                    return
+
+    # -- geo mode ------------------------------------------------------------
+    def _local_table(self, table: str, dim: int) -> SparseTable:
+        if table not in self._local:
+            defaults = self.client._defaults.get(table, {})
+            self._local[table] = SparseTable(
+                dim=dim, accessor=defaults.get("accessor", "adagrad"),
+                initializer=defaults.get("initializer", "normal"),
+                init_scale=float(defaults.get("init_scale", 0.01)),
+                seed=int(defaults.get("seed", 0)))
+            self._base[table] = {}
+            self._steps[table] = 0
+        return self._local[table]
+
+    def geo_pull(self, table: str, ids, dim: int) -> np.ndarray:
+        """Pull from the local replica, faulting unseen ids in from the
+        servers and recording their base values for delta computation."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        local = self._local_table(table, dim)
+        base = self._base[table]
+        new = np.asarray([f for f in np.unique(ids) if int(f) not in base],
+                         np.int64)
+        if len(new):
+            rows = self.client.pull(table, new, dim)
+            local.set_rows(new, rows)
+            for f, r in zip(new, rows):
+                base[int(f)] = r.copy()
+        return local.pull(ids)
+
+    def geo_push(self, table: str, ids, grads, dim: int) -> None:
+        """Apply the optimizer locally; every ``geo_steps`` ship deltas."""
+        local = self._local_table(table, dim)
+        local.push(ids, grads)
+        self._steps[table] += 1
+        if self._steps[table] % self.geo_steps == 0:
+            self.geo_flush(table, dim)
+
+    def geo_flush(self, table: str, dim: int) -> None:
+        base = self._base.get(table)
+        if not base:
+            return
+        local = self._local_table(table, dim)
+        ids = np.asarray(sorted(base), np.int64)
+        cur = local.pull(ids)
+        prev = np.stack([base[int(f)] for f in ids])
+        deltas = cur - prev
+        sent = np.abs(deltas).sum(axis=1) > 0
+        if sent.any():
+            self.client.push_delta(table, ids[sent], deltas[sent], dim)
+        # refresh the replica from the servers (other workers' deltas)
+        rows = self.client.pull(table, ids, dim)
+        local.set_rows(ids, rows)
+        for f, r in zip(ids, rows):
+            base[int(f)] = r.copy()

@@ -1,0 +1,186 @@
+"""PS-mode runtime: the ``the_one_ps.py`` analog.
+
+Reference: python/paddle/distributed/ps/the_one_ps.py wires the fleet
+role (TRAINING_ROLE / PADDLE_PSERVERS_IP_PORT_LIST env contract) to
+brpc servers and rewrites embedding lookups into distributed
+pull/push pairs. The TPU-native runtime keeps the same user surface —
+``init_server()/run_server()`` on PSERVER nodes, ``init_worker()`` on
+trainers, a ``SparseEmbedding`` layer whose forward pulls host-side
+rows and whose backward pushes gradients — while the dense model
+around it stays an ordinary jitted-on-TPU module. The pull/push sits
+at the step edge, exactly where host<->device transfer has to happen
+anyway for host-RAM tables.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...autograd import PyLayer
+from ...core.tensor import Tensor
+from .client import Communicator, PSClient
+from .service import PSServer
+from .table import SparseTable
+
+__all__ = ["SparseEmbedding", "PSRuntime", "init_server", "run_server",
+           "init_worker", "stop_worker"]
+
+
+class _PullPush(PyLayer):
+    """Pull rows on forward; push row grads on backward. The float
+    ``hook`` input exists only so the tape records a backward edge —
+    integer ids carry no gradient."""
+
+    @staticmethod
+    def forward(ctx, ids: Tensor, hook: Tensor, layer=None):
+        flat = np.asarray(ids._data).reshape(-1)
+        rows = layer._pull(flat)
+        ctx.ids = flat
+        ctx.layer = layer
+        out = rows.reshape(tuple(ids.shape) + (layer.dim,))
+        return Tensor(jnp.asarray(out))
+
+    @staticmethod
+    def backward(ctx, grad: Tensor):
+        g = np.asarray(grad._data, np.float32).reshape(
+            len(ctx.ids), ctx.layer.dim)
+        ctx.layer._push(ctx.ids, g)
+        return None  # no grad for the hook scalar
+
+
+class SparseEmbedding:
+    """Distributed embedding over a PS table (reference:
+    paddle.static.nn.sparse_embedding / the fleet-rewritten
+    lookup_table). Backend is chosen by ``bind``: a local in-process
+    table (single host), or a PSClient/Communicator (sync, async, geo).
+    """
+
+    def __init__(self, name: str, dim: int, accessor: str = "adagrad",
+                 init_scale: float = 0.01, seed: int = 0, **accessor_kw):
+        self.name = name
+        self.dim = int(dim)
+        self.table_config = {"accessor": accessor,
+                             "init_scale": init_scale, "seed": seed}
+        self._accessor_kw = accessor_kw
+        self._local: Optional[SparseTable] = None
+        self._comm: Optional[Communicator] = None
+        # default backend: a private local table (works out of the box)
+        self._ensure_local()
+
+    def _ensure_local(self):
+        if self._local is None:
+            from .accessor import make_accessor
+            acc = make_accessor(self.table_config["accessor"],
+                                **self._accessor_kw)
+            self._local = SparseTable(
+                self.dim, accessor=acc,
+                init_scale=self.table_config["init_scale"],
+                seed=self.table_config["seed"])
+
+    def bind(self, comm: Communicator):
+        """Route pulls/pushes through a communicator (PS mode)."""
+        self._comm = comm
+        comm.client._defaults.setdefault(self.name, {}).update(
+            self.table_config)
+        return self
+
+    # -- table ops -----------------------------------------------------------
+    def _pull(self, flat_ids: np.ndarray) -> np.ndarray:
+        if self._comm is None:
+            return self._local.pull(flat_ids)
+        if self._comm.mode == "geo":
+            return self._comm.geo_pull(self.name, flat_ids, self.dim)
+        return self._comm.client.pull(self.name, flat_ids, self.dim)
+
+    def _push(self, flat_ids: np.ndarray, grads: np.ndarray) -> None:
+        if self._comm is None:
+            self._local.push(flat_ids, grads)
+        elif self._comm.mode == "geo":
+            self._comm.geo_push(self.name, flat_ids, grads, self.dim)
+        else:
+            self._comm.push(self.name, flat_ids, grads, self.dim)
+
+    def __call__(self, ids: Tensor) -> Tensor:
+        if not isinstance(ids, Tensor):
+            ids = Tensor(jnp.asarray(np.asarray(ids), jnp.int64))
+        hook = Tensor(jnp.zeros((), jnp.float32), stop_gradient=False)
+        return _PullPush.apply(ids, hook, layer=self)
+
+
+class PSRuntime:
+    """Role-aware entry points driven by the launch env contract
+    (PADDLE_PSERVERS_IP_PORT_LIST, TRAINING_ROLE, PADDLE_TRAINER_ID —
+    reference python/paddle/distributed/ps/the_one_ps.py + fleet env)."""
+
+    def __init__(self, endpoints: Optional[Sequence[str]] = None,
+                 role: Optional[str] = None):
+        env_eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self.endpoints = list(endpoints) if endpoints else \
+            [e for e in env_eps.split(",") if e]
+        self.role = (role or os.environ.get("TRAINING_ROLE",
+                                            "TRAINER")).upper()
+        self.server: Optional[PSServer] = None
+        self.client: Optional[PSClient] = None
+        self.communicator: Optional[Communicator] = None
+
+    # -- server side ---------------------------------------------------------
+    def init_server(self, index: Optional[int] = None) -> PSServer:
+        idx = index if index is not None else \
+            int(os.environ.get("PADDLE_PSERVER_ID", "0"))
+        host, port = self.endpoints[idx].rsplit(":", 1)
+        self.server = PSServer(host, int(port)).start()
+        return self.server
+
+    def run_server(self):
+        """Block until a client sends stop (reference fleet.run_server)."""
+        self.server._thread.join()
+
+    # -- worker side ---------------------------------------------------------
+    def init_worker(self, mode: str = "sync", **comm_kw) -> Communicator:
+        self.client = PSClient(self.endpoints)
+        self.communicator = Communicator(self.client, mode=mode,
+                                         **comm_kw).start()
+        return self.communicator
+
+    def stop_worker(self, stop_servers: bool = False):
+        if self.communicator is not None:
+            self.communicator.stop()
+        if self.client is not None:
+            if stop_servers:
+                self.client.stop_servers()
+            self.client.close()
+
+
+_runtime: Optional[PSRuntime] = None
+
+
+def _rt() -> PSRuntime:
+    global _runtime
+    if _runtime is None:
+        _runtime = PSRuntime()
+    return _runtime
+
+
+def init_server(endpoints=None, index=None):
+    global _runtime
+    if endpoints is not None:
+        _runtime = PSRuntime(endpoints=endpoints)
+    return _rt().init_server(index)
+
+
+def run_server():
+    _rt().run_server()
+
+
+def init_worker(endpoints=None, mode: str = "sync", **kw):
+    global _runtime
+    if endpoints is not None:
+        _runtime = PSRuntime(endpoints=endpoints)
+    return _rt().init_worker(mode=mode, **kw)
+
+
+def stop_worker(stop_servers: bool = False):
+    _rt().stop_worker(stop_servers)

@@ -1,0 +1,188 @@
+"""Parameter-server RPC service (brpc_ps_server analog).
+
+The reference serves tables over brpc with protobuf request/response
+(paddle/fluid/distributed/ps/service/brpc_ps_server.cc). Here the wire
+format is a length-framed JSON header plus an ``np.savez`` payload —
+no pickle on the wire, arrays deserialize through numpy's format only.
+One thread per connection; tables do their own locking, so concurrent
+trainers are safe (the reference's server is similarly reentrant per
+table shard).
+"""
+from __future__ import annotations
+
+import io
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict
+
+import numpy as np
+
+from .table import DenseTable, SparseTable
+
+__all__ = ["PSServer", "send_msg", "recv_msg"]
+
+_HDR = struct.Struct("!II")  # (json_len, npz_len)
+
+
+def send_msg(sock: socket.socket, meta: dict, arrays: Dict[str, np.ndarray]
+             ) -> None:
+    j = json.dumps(meta).encode()
+    buf = io.BytesIO()
+    if arrays:
+        np.savez(buf, **arrays)
+    payload = buf.getvalue()
+    sock.sendall(_HDR.pack(len(j), len(payload)) + j + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("peer closed")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket):
+    jlen, plen = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    meta = json.loads(_recv_exact(sock, jlen))
+    arrays = {}
+    if plen:
+        data = np.load(io.BytesIO(_recv_exact(sock, plen)),
+                       allow_pickle=False)
+        arrays = {k: data[k] for k in data.files}
+    return meta, arrays
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv: "PSServer" = self.server.ps  # type: ignore[attr-defined]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                meta, arrays = recv_msg(sock)
+                out_meta, out_arrays = srv.dispatch(meta, arrays)
+                send_msg(sock, out_meta, out_arrays)
+                if meta.get("cmd") == "stop":
+                    self.server.shutdown()
+                    return
+        except (ConnectionError, OSError):
+            return
+
+
+class _TCP(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class PSServer:
+    """One PS shard: owns its slice of every sparse table plus the dense
+    table, and serves pull/push/geo/save/load over TCP."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._tables: Dict[str, SparseTable] = {}
+        self._dense = DenseTable()
+        self._srv = _TCP((host, port), _Handler)
+        self._srv.ps = self  # type: ignore[attr-defined]
+        self.host, self.port = self._srv.server_address[:2]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        try:
+            self._srv.shutdown()
+            self._srv.server_close()
+        except Exception:
+            pass
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- dispatch ------------------------------------------------------------
+    def _table(self, meta) -> SparseTable:
+        name = meta["table"]
+        if name not in self._tables:
+            self._tables[name] = SparseTable(
+                dim=int(meta["dim"]),
+                accessor=meta.get("accessor", "adagrad"),
+                initializer=meta.get("initializer", "normal"),
+                init_scale=float(meta.get("init_scale", 0.01)),
+                seed=int(meta.get("seed", 0)))
+        return self._tables[name]
+
+    def dispatch(self, meta: dict, arrays: Dict[str, np.ndarray]):
+        cmd = meta.get("cmd")
+        if cmd == "pull":
+            rows = self._table(meta).pull(arrays["ids"])
+            return {"ok": True}, {"rows": rows}
+        if cmd == "push":
+            self._table(meta).push(arrays["ids"], arrays["grads"])
+            return {"ok": True}, {}
+        if cmd == "push_delta":
+            self._table(meta).add_to_rows(arrays["ids"], arrays["deltas"])
+            return {"ok": True}, {}
+        if cmd == "set_rows":
+            self._table(meta).set_rows(arrays["ids"], arrays["rows"])
+            return {"ok": True}, {}
+        if cmd == "record_shows":
+            self._table(meta).record_shows(
+                arrays["ids"], arrays.get("shows"), arrays.get("clicks"))
+            return {"ok": True}, {}
+        if cmd == "shrink":
+            n = sum(t.shrink() for t in self._tables.values())
+            return {"ok": True, "evicted": n}, {}
+        if cmd == "dense_set":
+            for k, v in arrays.items():
+                self._dense.set(k, v)
+            return {"ok": True}, {}
+        if cmd == "dense_add":
+            for k, v in arrays.items():
+                self._dense.add(k, v)
+            return {"ok": True}, {}
+        if cmd == "dense_get":
+            out = {}
+            for k in meta.get("names", []):
+                v = self._dense.get(k)
+                if v is not None:
+                    out[k] = v
+            return {"ok": True, "names": sorted(out)}, out
+        if cmd == "save":
+            blobs = {f"sparse_{n}": np.frombuffer(t.save(), np.uint8)
+                     for n, t in self._tables.items()}
+            blobs["dense"] = np.frombuffer(self._dense.save(), np.uint8)
+            return {"ok": True, "tables": sorted(self._tables)}, blobs
+        if cmd == "load":
+            for name, blob in arrays.items():
+                raw = blob.tobytes()
+                if name == "dense":
+                    self._dense.load(raw)
+                elif name.startswith("sparse_"):
+                    tname = name[len("sparse_"):]
+                    if tname not in self._tables:
+                        # recover dim from the checkpoint itself
+                        peek = np.load(io.BytesIO(raw))
+                        meta2 = dict(meta)
+                        meta2["table"] = tname
+                        meta2["dim"] = int(peek["rows"].shape[1])
+                        self._table(meta2)
+                    self._tables[tname].load(raw)
+            return {"ok": True}, {}
+        if cmd == "stats":
+            return {"ok": True,
+                    "tables": {n: len(t) for n, t in self._tables.items()},
+                    "dense": self._dense.names()}, {}
+        if cmd == "stop":
+            return {"ok": True}, {}
+        return {"ok": False, "error": f"unknown cmd {cmd!r}"}, {}

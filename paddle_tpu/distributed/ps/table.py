@@ -1,0 +1,256 @@
+"""Host-memory parameter tables for the TPU-native parameter-server mode.
+
+The reference's PS keeps giant sparse embedding tables server-side
+(paddle/fluid/distributed/ps/table/memory_sparse_table.cc: sharded hash
+maps of feature id -> embedding + optimizer slots) because they exceed
+any accelerator's memory. The same constraint holds on TPU — a
+100B-feature table cannot live in HBM — so the TPU-native design keeps
+the identical split: dense math stays in one jitted XLA program on
+device, and the sparse tables live here, in a growable numpy arena in
+host RAM, updated by vectorized accessors on push.
+
+Layout: open-addressed ``id -> row`` dict into one contiguous
+``(capacity, dim)`` float32 arena plus aligned optimizer-slot arenas —
+pulls and pushes are pure gather/scatter over the arena, no per-row
+Python objects (the reference's per-shard ``std::unordered_map`` of
+pointers trades the same way).
+"""
+from __future__ import annotations
+
+import io
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from .accessor import CtrAccessor, make_accessor
+
+__all__ = ["SparseTable", "DenseTable"]
+
+
+class SparseTable:
+    """One logical sparse table (or one shard of it, server-side).
+
+    ``pull`` initializes unseen features on demand (the reference's
+    ``pull_sparse`` create-on-miss path); ``push`` aggregates duplicate
+    ids then applies the accessor in one vectorized call.
+    """
+
+    def __init__(self, dim: int, accessor="adagrad",
+                 initializer: str = "normal", init_scale: float = 0.01,
+                 seed: int = 0, capacity: int = 1024):
+        self.dim = int(dim)
+        self.accessor = (accessor if not isinstance(accessor, str)
+                         else make_accessor(accessor))
+        self._initializer = initializer
+        self._scale = float(init_scale)
+        self._rng = np.random.RandomState(seed)
+        self._index: Dict[int, int] = {}
+        self._free: list[int] = []
+        self._next_row = 0  # arena high-water mark
+        self._rows = np.zeros((int(capacity), self.dim), np.float32)
+        self._slots = self.accessor.init_slots(int(capacity), self.dim)
+        self._lock = threading.Lock()
+
+    # -- internals -----------------------------------------------------------
+    def _grow(self, need: int):
+        cap = self._rows.shape[0]
+        new_cap = max(cap * 2, cap + need)
+        grown = np.zeros((new_cap, self.dim), np.float32)
+        grown[:cap] = self._rows
+        self._rows = grown
+        for k, v in self._slots.items():
+            g = np.zeros((new_cap,) + v.shape[1:], v.dtype)
+            g[:cap] = v
+            self._slots[k] = g
+
+    def _ensure(self, ids: np.ndarray) -> np.ndarray:
+        """Map ids -> arena row indices, initializing misses."""
+        idx = np.empty(len(ids), np.int64)
+        missing = []
+        for i, fid in enumerate(ids):
+            j = self._index.get(int(fid))
+            if j is None:
+                missing.append(i)
+                idx[i] = -1
+            else:
+                idx[i] = j
+        if missing:
+            need = max(0, len(missing) - len(self._free))
+            if self._next_row + need > self._rows.shape[0]:
+                self._grow(self._next_row + need - self._rows.shape[0])
+            for i in missing:
+                fid = int(ids[i])
+                j = self._index.get(fid)  # duplicate miss in this batch
+                if j is not None:
+                    idx[i] = j
+                    continue
+                # evicted rows are reused before the arena grows
+                if self._free:
+                    j = self._free.pop()
+                else:
+                    j = self._next_row
+                    self._next_row += 1
+                self._index[fid] = j
+                idx[i] = j
+                if self._initializer == "normal":
+                    self._rows[j] = self._rng.normal(
+                        0.0, self._scale, self.dim).astype(np.float32)
+                else:
+                    self._rows[j] = 0.0
+                for v in self._slots.values():
+                    v[j] = 0
+                if self._initializer == "normal":
+                    self._rows[j] = self._rng.normal(
+                        0.0, self._scale, self.dim).astype(np.float32)
+                else:
+                    self._rows[j] = 0.0
+        return idx
+
+    # -- public API ----------------------------------------------------------
+    def __len__(self):
+        return len(self._index)
+
+    def pull(self, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        with self._lock:
+            idx = self._ensure(ids)
+            return self._rows[idx].copy()
+
+    def push(self, ids, grads) -> None:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        agg = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(agg, inv, grads)
+        with self._lock:
+            idx = self._ensure(uniq)
+            rows = self._rows[idx]
+            slots = {k: v[idx] for k, v in self._slots.items()}
+            self.accessor.update(rows, slots, agg)
+            self._rows[idx] = rows
+            for k, v in self._slots.items():
+                v[idx] = slots[k]
+
+    def set_rows(self, ids, values) -> None:
+        """Direct assignment (checkpoint load / geo-SGD delta apply)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        values = np.asarray(values, np.float32).reshape(len(ids), self.dim)
+        with self._lock:
+            idx = self._ensure(ids)
+            self._rows[idx] = values
+
+    def add_to_rows(self, ids, deltas) -> None:
+        """Accumulate raw deltas (geo-SGD: workers send weight diffs, not
+        gradients — reference communicator GeoCommunicator::Send)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        deltas = np.asarray(deltas, np.float32).reshape(len(ids), self.dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        agg = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(agg, inv, deltas)
+        with self._lock:
+            idx = self._ensure(uniq)
+            self._rows[idx] += agg
+
+    def record_shows(self, ids, shows=None, clicks=None):
+        if not isinstance(self.accessor, CtrAccessor):
+            return
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        with self._lock:
+            idx = self._ensure(ids)
+            slots = {k: v[idx] for k, v in self._slots.items()}
+            self.accessor.record_shows(
+                slots, np.ones(len(ids)) if shows is None else shows, clicks)
+            for k, v in self._slots.items():
+                v[idx] = slots[k]
+
+    def shrink(self) -> int:
+        """Decay CTR stats and evict stale features; returns evicted count
+        (reference memory_sparse_table.cc::Shrink)."""
+        if not isinstance(self.accessor, CtrAccessor):
+            return 0
+        with self._lock:
+            if not self._index:
+                return 0
+            ids = np.fromiter(self._index.keys(), np.int64,
+                              len(self._index))
+            idx = np.fromiter(self._index.values(), np.int64,
+                              len(self._index))
+            slots = {k: v[idx] for k, v in self._slots.items()}
+            self.accessor.decay(slots)
+            evict = self.accessor.should_evict(slots)
+            for k, v in self._slots.items():
+                v[idx] = slots[k]
+            for fid, j in zip(ids[evict], idx[evict]):
+                del self._index[int(fid)]
+                self._free.append(int(j))
+            return int(evict.sum())
+
+    # -- checkpoint ----------------------------------------------------------
+    def save(self) -> bytes:
+        with self._lock:
+            ids = np.fromiter(self._index.keys(), np.int64,
+                              len(self._index))
+            idx = np.fromiter(self._index.values(), np.int64,
+                              len(self._index))
+            buf = io.BytesIO()
+            np.savez(buf, ids=ids, rows=self._rows[idx],
+                     **{f"slot_{k}": v[idx] for k, v in self._slots.items()})
+            return buf.getvalue()
+
+    def load(self, blob: bytes) -> None:
+        data = np.load(io.BytesIO(blob))
+        ids = data["ids"]
+        with self._lock:
+            self._index.clear()
+            self._free = []
+            n = len(ids)
+            if n > self._rows.shape[0]:
+                self._grow(n - self._rows.shape[0])
+            self._rows[:n] = data["rows"]
+            self._index.update({int(f): i for i, f in enumerate(ids)})
+            self._next_row = n
+            for k in self._slots:
+                self._slots[k][:n] = data[f"slot_{k}"]
+
+
+class DenseTable:
+    """Named dense blocks (the reference's dense tables hold non-sparse
+    params server-side in PS mode; here they are a host-side mirror used
+    by sync/geo communicators and PS checkpoints)."""
+
+    def __init__(self):
+        self._params: Dict[str, np.ndarray] = {}
+        self._lock = threading.Lock()
+
+    def set(self, name: str, value) -> None:
+        with self._lock:
+            self._params[name] = np.asarray(value, np.float32).copy()
+
+    def get(self, name: str) -> Optional[np.ndarray]:
+        with self._lock:
+            v = self._params.get(name)
+            return None if v is None else v.copy()
+
+    def add(self, name: str, delta) -> None:
+        with self._lock:
+            d = np.asarray(delta, np.float32)
+            if name in self._params:
+                self._params[name] = self._params[name] + d
+            else:
+                self._params[name] = d.copy()
+
+    def names(self):
+        with self._lock:
+            return sorted(self._params)
+
+    def save(self) -> bytes:
+        with self._lock:
+            buf = io.BytesIO()
+            np.savez(buf, **self._params)
+            return buf.getvalue()
+
+    def load(self, blob: bytes) -> None:
+        data = np.load(io.BytesIO(blob))
+        with self._lock:
+            self._params = {k: data[k].copy() for k in data.files}

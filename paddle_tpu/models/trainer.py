@@ -44,10 +44,16 @@ def _wd_mask(names):
                 and "ln_" not in n) for n in names}
 
 
-def create_train_step(model, optimizer, loss_fn=None):
+def create_train_step(model, optimizer, loss_fn=None, donate=False):
     """(params, opt_state, key, ids, labels, lr) -> (loss, params, opt_state).
     ``model.loss(ids, labels)`` is used unless ``loss_fn(model, ids, labels)``
-    is given."""
+    is given.
+
+    ``donate=True`` donates the params/opt-state buffers to XLA
+    (input-output aliasing): the update writes in place instead of
+    allocating a second copy of every parameter and moment, freeing
+    ~3x params bytes of HBM for bigger batches. The caller must then
+    treat the passed-in trees as consumed (use the returned ones)."""
     trainable0 = functional_state(model, trainable_only=True)
     all0 = functional_state(model)
     frozen = {k: v for k, v in all0.items() if k not in trainable0}
@@ -65,7 +71,6 @@ def create_train_step(model, optimizer, loss_fn=None):
                         out = model.loss(Tensor(ids), Tensor(labels))
             return out._data
 
-    @jax.jit
     def train_step(params, opt_state, key, ids, labels, lr):
         loss, grads = jax.value_and_grad(
             lambda p: _loss_call(p, ids, labels, key))(params)
@@ -73,6 +78,15 @@ def create_train_step(model, optimizer, loss_fn=None):
             params, grads, opt_state, lr, wd_mask=wd_mask)
         return loss, new_params, new_opt_state
 
+    train_step = jax.jit(train_step,
+                         donate_argnums=(0, 1) if donate else ())
+
+    if donate:
+        # hand back copies: trainable0 aliases the model's live parameter
+        # buffers, and donating those would delete the model's own weights
+        # on the first step (use-after-free on any later model(...) call)
+        trainable0 = {k: jnp.copy(v) for k, v in trainable0.items()}
+        opt_state0 = jax.tree_util.tree_map(jnp.copy, opt_state0)
     return train_step, trainable0, opt_state0
 
 
