@@ -74,7 +74,6 @@ def main():
     params0 = {k: (v.astype(jnp.bfloat16)
                    if jnp.issubdtype(v.dtype, jnp.floating) else v)
                for k, v in params0.items()}
-    frozen = {}
     all0 = functional_state(model)
     trainable = functional_state(model, trainable_only=True)
     frozen = {k: v for k, v in all0.items() if k not in trainable}

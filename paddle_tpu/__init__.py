@@ -53,6 +53,7 @@ from . import signal  # noqa: F401
 from . import geometric  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
+from . import decomposition  # noqa: F401
 from .hapi import Model, callbacks  # noqa: F401
 from .framework import save, load, in_dynamic_mode, is_compiled_with_cuda, is_compiled_with_xpu, is_compiled_with_rocm, is_compiled_with_custom_device  # noqa: F401
 from .framework import (iinfo, finfo, CPUPlace, CUDAPlace, CUDAPinnedPlace,  # noqa: F401

@@ -1,0 +1,103 @@
+"""Composite-op decomposition over the recorded static Program.
+
+Reference: python/paddle/decomposition/decomp.py rewrites composite ops
+in the PIR program into primitive-op sequences (the `paddle/fluid/
+primitive/primitive.yaml` set) so backends that only implement
+primitives — and program passes that reason at primitive granularity —
+can consume any program. In this framework XLA lowers everything, so
+decomposition exists for the *pass* use case: quantization, custom
+compilers, and SPMD completion can ask for a program where `softmax`
+is exp/sub/sum/div instead of one opaque node.
+
+``decompose(program)`` splices each registered composite node into the
+primitive nodes its rule emits (the rules call ordinary public ops on
+the node's symbolic operands, so everything re-enters the same
+recording funnel), then grafts the original output Variables onto the
+new producers so downstream operand references stay valid.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, Optional
+
+__all__ = ["register_decomp", "has_decomp", "registered_decomps",
+           "decompose"]
+
+_RULES: Dict[str, Callable] = {}
+
+
+def register_decomp(op_name: str):
+    """Decorator: register ``fn(node) -> Variable | tuple[Variable]`` as
+    the primitive expansion of ``op_name``. The rule receives the OpNode
+    (operands + attrs) and must build its result using public ops on the
+    node's operands."""
+    def deco(fn):
+        _RULES[op_name] = fn
+        return fn
+    return deco
+
+
+def has_decomp(op_name: str) -> bool:
+    return op_name in _RULES
+
+
+def registered_decomps():
+    return sorted(_RULES)
+
+
+def _shapes_agree(old, new) -> bool:
+    if len(old) != len(new):
+        return False
+    return all(o is None or n is None or o == n
+               for o, n in zip(old, new))
+
+
+def decompose(program, ops: Optional[Iterable[str]] = None,
+              blacklist: Iterable[str] = ()) -> int:
+    """Rewrite ``program`` in place, expanding every node with a
+    registered rule (optionally restricted to ``ops``, minus
+    ``blacklist``). Returns the number of nodes expanded. Must run in
+    static mode (the rules record through the dispatch funnel)."""
+    from ..static import in_static_mode
+
+    if not in_static_mode():
+        raise RuntimeError(
+            "decompose() requires static mode (paddle.enable_static()): "
+            "rules rebuild nodes through the recording funnel")
+    allowed = set(ops) if ops is not None else None
+    blocked = set(blacklist)
+
+    original = program.nodes
+    program.nodes = []
+    changed = 0
+    for node in original:
+        rule = _RULES.get(node.name)
+        if rule is None or node.name in blocked or \
+                (allowed is not None and node.name not in allowed):
+            program.nodes.append(node)
+            continue
+        mark = len(program.nodes)
+        outs = rule(node)
+        outs = (outs,) if not isinstance(outs, (tuple, list)) else tuple(outs)
+        if len(program.nodes) == mark:
+            raise RuntimeError(
+                f"decomp rule for '{node.name}' recorded no primitive ops")
+        if len(outs) != len(node.outputs):
+            raise RuntimeError(
+                f"decomp rule for '{node.name}' returned {len(outs)} "
+                f"outputs, composite has {len(node.outputs)}")
+        for old, new in zip(node.outputs, outs):
+            if not _shapes_agree(old.shape, new.shape) or \
+                    old.dtype != new.dtype:
+                raise RuntimeError(
+                    f"decomp rule for '{node.name}' changed output "
+                    f"{old.shape}/{old.dtype} -> {new.shape}/{new.dtype}")
+            # graft: downstream operand lists hold the ORIGINAL Variable
+            # objects, so point them at the new producer
+            producer = new.producer
+            producer.outputs[new.out_idx] = old
+            old.producer = producer
+            old.out_idx = new.out_idx
+        changed += 1
+    if changed:
+        program._version += 1
+    return changed

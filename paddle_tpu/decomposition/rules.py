@@ -1,0 +1,133 @@
+"""Built-in decomposition rules (the primitive set).
+
+Reference rule inventory: paddle/fluid/primitive/composite/composite.py
+(softmax_decomp, gelu_decomp, layer_norm_decomp, rms_norm_decomp,
+mean_decomp, silu_decomp, ...) — each composite written in terms of the
+primitive yaml ops. Here the primitives are this framework's own
+elementwise/reduction ops, which the recording funnel captures as
+individual OpNodes.
+"""
+from __future__ import annotations
+
+import math
+
+from .decomp import register_decomp
+
+
+def _t():
+    from .. import tensor
+    return tensor
+
+
+@register_decomp("softmax")
+def _softmax(node):
+    (x,) = node.operands
+    axis = node.attrs.get("axis", -1)
+    T = _t()
+    m = T.max(x, axis=axis, keepdim=True)
+    e = T.exp(x - m)
+    return e / T.sum(e, axis=axis, keepdim=True)
+
+
+@register_decomp("log_softmax")
+def _log_softmax(node):
+    (x,) = node.operands
+    axis = node.attrs.get("axis", -1)
+    T = _t()
+    m = T.max(x, axis=axis, keepdim=True)
+    shifted = x - m
+    return shifted - T.log(T.sum(T.exp(shifted), axis=axis, keepdim=True))
+
+
+@register_decomp("silu")
+def _silu(node):
+    (x,) = node.operands
+    from ..nn.functional import sigmoid
+    return x * sigmoid(x)
+
+
+@register_decomp("swish")
+def _swish(node):
+    return _silu(node)
+
+
+@register_decomp("gelu")
+def _gelu(node):
+    (x,) = node.operands
+    T = _t()
+    if node.attrs.get("approximate", False):
+        # tanh approximation: 0.5x(1+tanh(sqrt(2/pi)(x+0.044715 x^3)))
+        c = math.sqrt(2.0 / math.pi)
+        out = 0.5 * x * (T.tanh(c * (x + 0.044715 * x * x * x)) + 1.0)
+    else:
+        out = 0.5 * x * (T.erf(x * (1.0 / math.sqrt(2.0))) + 1.0)
+    return out.astype(x.dtype)  # scalar literals must not promote the dtype
+
+
+@register_decomp("mean")
+def _mean(node):
+    (x,) = node.operands
+    axis = node.attrs.get("axis")
+    keepdim = node.attrs.get("keepdim", False)
+    T = _t()
+    if axis is None:
+        n = 1
+        for d in x.shape:
+            n *= (d if d is not None else 1)
+    else:
+        axes = axis if isinstance(axis, (tuple, list)) else (axis,)
+        n = 1
+        for a in axes:
+            d = x.shape[a]
+            n *= (d if d is not None else 1)
+    return (T.sum(x, axis=axis, keepdim=keepdim)
+            * (1.0 / float(n))).astype(x.dtype)
+
+
+@register_decomp("rms_norm")
+def _rms_norm(node):
+    x = node.operands[0]
+    eps = node.attrs.get("epsilon", 1e-6)
+    T = _t()
+    x32 = x.astype("float32")
+    var = T.mean(x32 * x32, axis=-1, keepdim=True)
+    out = x32 * T.rsqrt(var + eps)
+    if node.attrs.get("has_weight", len(node.operands) > 1):
+        out = out * node.operands[1].astype("float32")
+    return out.astype(x.dtype)
+
+
+@register_decomp("layer_norm")
+def _layer_norm(node):
+    x = node.operands[0]
+    eps = node.attrs.get("epsilon", 1e-5)
+    begin = node.attrs.get("begin_norm_axis", -1)
+    T = _t()
+    ndim = len(x.shape)
+    axes = tuple(range(ndim + begin, ndim)) if begin < 0 else \
+        tuple(range(begin, ndim))
+    x32 = x.astype("float32")
+    mu = T.mean(x32, axis=axes, keepdim=True)
+    xc = x32 - mu
+    var = T.mean(xc * xc, axis=axes, keepdim=True)
+    out = xc * T.rsqrt(var + eps)
+    it = iter(node.operands[1:])
+    if node.attrs.get("has_weight", False):
+        out = out * next(it).astype("float32")
+    if node.attrs.get("has_bias", False):
+        out = out + next(it).astype("float32")
+    return out.astype(x.dtype)
+
+
+@register_decomp("swiglu")
+def _swiglu(node):
+    from ..nn.functional import sigmoid
+    if len(node.operands) == 2:
+        x, y = node.operands
+        return x * sigmoid(x) * y
+    (x,) = node.operands
+    T = _t()
+    half = x.shape[-1] // 2
+    a = T.slice(x, axes=[len(x.shape) - 1], starts=[0], ends=[half])
+    b = T.slice(x, axes=[len(x.shape) - 1], starts=[half], ends=[2 * half])
+    return a * sigmoid(a) * b

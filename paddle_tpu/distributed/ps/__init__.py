@@ -18,7 +18,7 @@ boundary its heter-PS (GPU-cache) variant draws.
 """
 from .accessor import (AdagradAccessor, AdamAccessor, CtrAccessor,
                        SGDAccessor, make_accessor)
-from .client import Communicator, PSClient
+from .client import Communicator, PSClient, PSError
 from .runtime import (PSRuntime, SparseEmbedding, init_server, init_worker,
                       run_server, stop_worker)
 from .service import PSServer
@@ -27,6 +27,6 @@ from .table import DenseTable, SparseTable
 __all__ = [
     "SGDAccessor", "AdagradAccessor", "AdamAccessor", "CtrAccessor",
     "make_accessor", "SparseTable", "DenseTable", "PSServer", "PSClient",
-    "Communicator", "PSRuntime", "SparseEmbedding", "init_server",
-    "run_server", "init_worker", "stop_worker",
+    "PSError", "Communicator", "PSRuntime", "SparseEmbedding",
+    "init_server", "run_server", "init_worker", "stop_worker",
 ]

@@ -30,6 +30,11 @@ class SGDAccessor:
     def __init__(self, learning_rate: float = 0.05):
         self.lr = float(learning_rate)
 
+    def config(self) -> dict:
+        """Constructor kwargs — persisted in checkpoints so a fresh server
+        rebuilds the accessor with the same hyperparameters."""
+        return {"learning_rate": self.lr}
+
     def init_slots(self, n: int, dim: int) -> Dict[str, np.ndarray]:
         return {}
 
@@ -46,6 +51,9 @@ class AdagradAccessor:
     def __init__(self, learning_rate: float = 0.05, epsilon: float = 1e-8):
         self.lr = float(learning_rate)
         self.eps = float(epsilon)
+
+    def config(self) -> dict:
+        return {"learning_rate": self.lr, "epsilon": self.eps}
 
     def init_slots(self, n: int, dim: int) -> Dict[str, np.ndarray]:
         return {"g2sum": np.zeros((n, dim), np.float32)}
@@ -67,6 +75,10 @@ class AdamAccessor:
         self.lr = float(learning_rate)
         self.b1, self.b2 = float(beta1), float(beta2)
         self.eps = float(epsilon)
+
+    def config(self) -> dict:
+        return {"learning_rate": self.lr, "beta1": self.b1,
+                "beta2": self.b2, "epsilon": self.eps}
 
     def init_slots(self, n, dim):
         return {"m": np.zeros((n, dim), np.float32),
@@ -101,6 +113,11 @@ class CtrAccessor:
         self.show_decay = float(show_decay)
         self.admit_threshold = float(admit_threshold)
         self.delete_threshold = float(delete_threshold)
+
+    def config(self) -> dict:
+        return {"show_decay": self.show_decay,
+                "admit_threshold": self.admit_threshold,
+                "delete_threshold": self.delete_threshold}
 
     def init_slots(self, n, dim):
         s = self.base.init_slots(n, dim)

@@ -16,6 +16,7 @@ communicator reproduces the reference's three training modes
 """
 from __future__ import annotations
 
+import logging
 import queue
 import socket
 import threading
@@ -24,10 +25,16 @@ from typing import Dict, List, Sequence
 
 import numpy as np
 
+_log = logging.getLogger(__name__)
+
 from .service import recv_msg, send_msg
 from .table import SparseTable
 
 __all__ = ["PSClient", "Communicator"]
+
+
+class PSError(RuntimeError):
+    """Server-side failure relayed through the reply channel."""
 
 
 class _Conn:
@@ -40,16 +47,34 @@ class _Conn:
     def call(self, meta: dict, arrays: Dict[str, np.ndarray]):
         with self.lock:
             send_msg(self.sock, meta, arrays)
-            return recv_msg(self.sock)
+            out_meta, out_arrays = recv_msg(self.sock)
+        if not out_meta.get("ok", False):
+            raise PSError(out_meta.get("error", "unknown server error"))
+        return out_meta, out_arrays
 
 
 class PSClient:
-    """Shard-routing client over one socket per server."""
+    """Shard-routing client over one socket per server. Per-shard RPCs of
+    one logical pull/push go out concurrently (the reference's brpc client
+    issues shard requests in parallel; serialized round trips would put
+    n_servers x RTT on the training hot path)."""
 
     def __init__(self, endpoints: Sequence[str], table_defaults=None):
+        from concurrent.futures import ThreadPoolExecutor
         self._conns = [_Conn(e) for e in endpoints]
         self.n = len(self._conns)
         self._defaults = dict(table_defaults or {})
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, self.n),
+            thread_name_prefix="ps-client") if self.n > 1 else None
+
+    def _fanout(self, calls):
+        """Run [(conn, meta, arrays), ...] concurrently; returns results
+        in order, raising the first failure after all complete."""
+        if self._pool is None or len(calls) <= 1:
+            return [c.call(m, a) for c, m, a in calls]
+        futs = [self._pool.submit(c.call, m, a) for c, m, a in calls]
+        return [f.result() for f in futs]
 
     def _meta(self, cmd: str, table: str, dim: int, **kw) -> dict:
         m = {"cmd": cmd, "table": table, "dim": int(dim)}
@@ -65,30 +90,30 @@ class PSClient:
     def pull(self, table: str, ids, dim: int) -> np.ndarray:
         ids = np.asarray(ids, np.int64).reshape(-1)
         out = np.empty((len(ids), dim), np.float32)
-        for s, sel in enumerate(self._route(ids)):
-            if not len(sel):
-                continue
-            _, arrs = self._conns[s].call(
-                self._meta("pull", table, dim), {"ids": ids[sel]})
+        routed = [(s, sel) for s, sel in enumerate(self._route(ids))
+                  if len(sel)]
+        results = self._fanout(
+            [(self._conns[s], self._meta("pull", table, dim),
+              {"ids": ids[sel]}) for s, sel in routed])
+        for (s, sel), (_, arrs) in zip(routed, results):
             out[sel] = arrs["rows"]
         return out
 
     def push(self, table: str, ids, grads, dim: int) -> None:
         ids = np.asarray(ids, np.int64).reshape(-1)
         grads = np.asarray(grads, np.float32).reshape(len(ids), dim)
-        for s, sel in enumerate(self._route(ids)):
-            if len(sel):
-                self._conns[s].call(self._meta("push", table, dim),
-                                    {"ids": ids[sel], "grads": grads[sel]})
+        self._fanout(
+            [(self._conns[s], self._meta("push", table, dim),
+              {"ids": ids[sel], "grads": grads[sel]})
+             for s, sel in enumerate(self._route(ids)) if len(sel)])
 
     def push_delta(self, table: str, ids, deltas, dim: int) -> None:
         ids = np.asarray(ids, np.int64).reshape(-1)
         deltas = np.asarray(deltas, np.float32).reshape(len(ids), dim)
-        for s, sel in enumerate(self._route(ids)):
-            if len(sel):
-                self._conns[s].call(
-                    self._meta("push_delta", table, dim),
-                    {"ids": ids[sel], "deltas": deltas[sel]})
+        self._fanout(
+            [(self._conns[s], self._meta("push_delta", table, dim),
+              {"ids": ids[sel], "deltas": deltas[sel]})
+             for s, sel in enumerate(self._route(ids)) if len(sel)])
 
     # -- dense ---------------------------------------------------------------
     def dense_set(self, params: Dict[str, np.ndarray], server: int = 0):
@@ -111,6 +136,13 @@ class PSClient:
         return [c.call({"cmd": "save"}, {})[1] for c in self._conns]
 
     def load(self, blobs: List[Dict[str, np.ndarray]]) -> None:
+        if len(blobs) != self.n:
+            # rows were saved under fid % n_saved routing: loading them
+            # into a different shard count would scatter them where pulls
+            # can never find them — fail loudly instead
+            raise ValueError(
+                f"snapshot has {len(blobs)} shards but this cluster has "
+                f"{self.n} servers; restore onto a matching server count")
         for c, b in zip(self._conns, blobs):
             c.call({"cmd": "load"}, b)
 
@@ -125,6 +157,8 @@ class PSClient:
                 pass
 
     def close(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
         for c in self._conns:
             try:
                 c.sock.close()
@@ -171,18 +205,30 @@ class Communicator:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
-        self.flush()
+        if self.mode == "geo":
+            # ship every table's outstanding local deltas — a worker that
+            # exits mid-window must not lose up to geo_steps-1 updates
+            for name, tbl in list(self._local.items()):
+                self.geo_flush(name, tbl.dim)
+        else:
+            self.flush()
 
     # -- sync / async push ---------------------------------------------------
     def push(self, table: str, ids, grads, dim: int) -> None:
         if self.mode == "sync":
             self.client.push(table, ids, grads, dim)
+        elif self.mode == "geo":
+            # generic entry point in geo mode: the local-train path (a
+            # bounded queue with no drain thread would deadlock instead)
+            self.geo_push(table, ids, grads, dim)
         else:
             self._q.put((table, np.asarray(ids, np.int64).reshape(-1),
                          np.asarray(grads, np.float32), int(dim)))
 
     def flush(self):
-        """Merge and send everything still queued (async mode)."""
+        """Merge and send everything still queued (async mode). On a send
+        failure the merged batch is re-queued (best effort) so a transient
+        server outage does not silently drop gradients."""
         pending: Dict[tuple, list] = {}
         while True:
             try:
@@ -190,31 +236,55 @@ class Communicator:
             except queue.Empty:
                 break
             pending.setdefault((table, dim), []).append((ids, grads))
+        first_err = None
         for (table, dim), items in pending.items():
             ids = np.concatenate([i for i, _ in items])
             grads = np.concatenate(
                 [g.reshape(len(i), dim) for i, g in items])
             # merge duplicate ids before hitting the wire
-            uniq, inv = np.unique(ids, return_inverse=True)
-            agg = np.zeros((len(uniq), dim), np.float32)
-            np.add.at(agg, inv, grads)
-            self.client.push(table, uniq, agg, dim)
+            from .table import merge_by_id
+            uniq, agg = merge_by_id(ids, grads)
+            # push shard by shard: a partial fan-out failure must re-queue
+            # ONLY the failed shard's slice — re-sending the whole merged
+            # batch would double-apply gradients on the healthy shards
+            for sel in [np.nonzero(uniq % self.client.n == s)[0]
+                        for s in range(self.client.n)]:
+                if not len(sel):
+                    continue
+                try:
+                    self.client.push(table, uniq[sel], agg[sel], dim)
+                except Exception as e:
+                    first_err = first_err or e
+                    try:  # keep this shard's slice for the next drain tick
+                        self._q.put_nowait(
+                            (table, uniq[sel], agg[sel], dim))
+                    except queue.Full:
+                        _log.warning(
+                            "ps: dropping %d merged grad rows for table %r"
+                            " (send failed and queue is full)",
+                            len(sel), table)
+        if first_err is not None:
+            raise first_err
 
     def _drain_loop(self):
         while not self._stop.is_set():
             time.sleep(self._interval)
             try:
                 self.flush()
-            except Exception:
+            except Exception as e:
                 if self._stop.is_set():
                     return
+                _log.warning("ps: async flush failed (will retry): %r", e)
 
     # -- geo mode ------------------------------------------------------------
     def _local_table(self, table: str, dim: int) -> SparseTable:
         if table not in self._local:
+            from .accessor import make_accessor
             defaults = self.client._defaults.get(table, {})
+            acc = make_accessor(defaults.get("accessor", "adagrad"),
+                                **defaults.get("accessor_kw", {}))
             self._local[table] = SparseTable(
-                dim=dim, accessor=defaults.get("accessor", "adagrad"),
+                dim=dim, accessor=acc,
                 initializer=defaults.get("initializer", "normal"),
                 init_scale=float(defaults.get("init_scale", 0.01)),
                 seed=int(defaults.get("seed", 0)))
@@ -239,6 +309,10 @@ class Communicator:
 
     def geo_push(self, table: str, ids, grads, dim: int) -> None:
         """Apply the optimizer locally; every ``geo_steps`` ship deltas."""
+        # fault ids into the base map first: deltas are diffs against the
+        # server's rows, and an id pushed without a prior geo_pull would
+        # otherwise never appear in any flush
+        self.geo_pull(table, ids, dim)
         local = self._local_table(table, dim)
         local.push(ids, grads)
         self._steps[table] += 1

@@ -62,8 +62,11 @@ class SparseEmbedding:
                  init_scale: float = 0.01, seed: int = 0, **accessor_kw):
         self.name = name
         self.dim = int(dim)
+        # accessor_kw rides along so PS-mode servers build the accessor
+        # with the user's hyperparameters, not the defaults
         self.table_config = {"accessor": accessor,
-                             "init_scale": init_scale, "seed": seed}
+                             "init_scale": init_scale, "seed": seed,
+                             "accessor_kw": dict(accessor_kw)}
         self._accessor_kw = accessor_kw
         self._local: Optional[SparseTable] = None
         self._comm: Optional[Communicator] = None

@@ -87,6 +87,7 @@ class PSServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._tables: Dict[str, SparseTable] = {}
+        self._tables_lock = threading.Lock()
         self._dense = DenseTable()
         self._srv = _TCP((host, port), _Handler)
         self._srv.ps = self  # type: ignore[attr-defined]
@@ -113,16 +114,27 @@ class PSServer:
     # -- dispatch ------------------------------------------------------------
     def _table(self, meta) -> SparseTable:
         name = meta["table"]
-        if name not in self._tables:
-            self._tables[name] = SparseTable(
-                dim=int(meta["dim"]),
-                accessor=meta.get("accessor", "adagrad"),
-                initializer=meta.get("initializer", "normal"),
-                init_scale=float(meta.get("init_scale", 0.01)),
-                seed=int(meta.get("seed", 0)))
-        return self._tables[name]
+        with self._tables_lock:  # check-then-create must be atomic across
+            if name not in self._tables:  # concurrent trainer handlers
+                from .accessor import make_accessor
+                acc = make_accessor(meta.get("accessor", "adagrad"),
+                                    **meta.get("accessor_kw", {}))
+                self._tables[name] = SparseTable(
+                    dim=int(meta["dim"]), accessor=acc,
+                    initializer=meta.get("initializer", "normal"),
+                    init_scale=float(meta.get("init_scale", 0.01)),
+                    seed=int(meta.get("seed", 0)))
+            return self._tables[name]
 
     def dispatch(self, meta: dict, arrays: Dict[str, np.ndarray]):
+        try:
+            return self._dispatch(meta, arrays)
+        except Exception as e:  # noqa: BLE001 — the error must reach the
+            # client as a reply, not as a dropped connection
+            return {"ok": False,
+                    "error": f"{type(e).__name__}: {e}"[:500]}, {}
+
+    def _dispatch(self, meta: dict, arrays: Dict[str, np.ndarray]):
         cmd = meta.get("cmd")
         if cmd == "pull":
             rows = self._table(meta).pull(arrays["ids"])
@@ -141,7 +153,9 @@ class PSServer:
                 arrays["ids"], arrays.get("shows"), arrays.get("clicks"))
             return {"ok": True}, {}
         if cmd == "shrink":
-            n = sum(t.shrink() for t in self._tables.values())
+            with self._tables_lock:
+                tables = list(self._tables.values())
+            n = sum(t.shrink() for t in tables)
             return {"ok": True, "evicted": n}, {}
         if cmd == "dense_set":
             for k, v in arrays.items():
@@ -159,10 +173,12 @@ class PSServer:
                     out[k] = v
             return {"ok": True, "names": sorted(out)}, out
         if cmd == "save":
+            with self._tables_lock:
+                tables = dict(self._tables)
             blobs = {f"sparse_{n}": np.frombuffer(t.save(), np.uint8)
-                     for n, t in self._tables.items()}
+                     for n, t in tables.items()}
             blobs["dense"] = np.frombuffer(self._dense.save(), np.uint8)
-            return {"ok": True, "tables": sorted(self._tables)}, blobs
+            return {"ok": True, "tables": sorted(tables)}, blobs
         if cmd == "load":
             for name, blob in arrays.items():
                 raw = blob.tobytes()
@@ -171,17 +187,20 @@ class PSServer:
                 elif name.startswith("sparse_"):
                     tname = name[len("sparse_"):]
                     if tname not in self._tables:
-                        # recover dim from the checkpoint itself
-                        peek = np.load(io.BytesIO(raw))
+                        # recover dim + accessor (kind AND hyperparameters)
+                        # from the checkpoint itself
+                        dim, acc, acc_kw = SparseTable.peek_meta(raw)
                         meta2 = dict(meta)
-                        meta2["table"] = tname
-                        meta2["dim"] = int(peek["rows"].shape[1])
+                        meta2.update(table=tname, dim=dim, accessor=acc,
+                                     accessor_kw=acc_kw)
                         self._table(meta2)
                     self._tables[tname].load(raw)
             return {"ok": True}, {}
         if cmd == "stats":
+            with self._tables_lock:
+                tables = dict(self._tables)
             return {"ok": True,
-                    "tables": {n: len(t) for n, t in self._tables.items()},
+                    "tables": {n: len(t) for n, t in tables.items()},
                     "dense": self._dense.names()}, {}
         if cmd == "stop":
             return {"ok": True}, {}

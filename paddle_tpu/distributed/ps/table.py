@@ -25,7 +25,17 @@ import numpy as np
 
 from .accessor import CtrAccessor, make_accessor
 
-__all__ = ["SparseTable", "DenseTable"]
+__all__ = ["SparseTable", "DenseTable", "merge_by_id"]
+
+
+def merge_by_id(ids: np.ndarray, vals: np.ndarray):
+    """Sum-aggregate rows of ``vals`` that share a feature id. Returns
+    (unique_ids, aggregated) — the one dedup idiom every push-style path
+    must share (duplicate ids per batch are the norm in CTR workloads)."""
+    uniq, inv = np.unique(ids, return_inverse=True)
+    agg = np.zeros((len(uniq),) + vals.shape[1:], np.float32)
+    np.add.at(agg, inv, vals)
+    return uniq, agg
 
 
 class SparseTable:
@@ -40,8 +50,18 @@ class SparseTable:
                  initializer: str = "normal", init_scale: float = 0.01,
                  seed: int = 0, capacity: int = 1024):
         self.dim = int(dim)
-        self.accessor = (accessor if not isinstance(accessor, str)
-                         else make_accessor(accessor))
+        if isinstance(accessor, str):
+            self.accessor_name = accessor
+            self.accessor = make_accessor(accessor)
+        else:
+            self.accessor = accessor
+            from . import accessor as _amod
+            self.accessor_name = next(
+                (k for k, cls in _amod._ACCESSORS.items()
+                 if type(accessor) is cls), "custom")
+        # CTR admission: un-admitted features accumulate shows here and
+        # only earn an embedding row past admit_threshold
+        self._pending_shows: Dict[int, float] = {}
         self._initializer = initializer
         self._scale = float(init_scale)
         self._rng = np.random.RandomState(seed)
@@ -100,30 +120,42 @@ class SparseTable:
                     self._rows[j] = 0.0
                 for v in self._slots.values():
                     v[j] = 0
-                if self._initializer == "normal":
-                    self._rows[j] = self._rng.normal(
-                        0.0, self._scale, self.dim).astype(np.float32)
-                else:
-                    self._rows[j] = 0.0
         return idx
 
     # -- public API ----------------------------------------------------------
     def __len__(self):
         return len(self._index)
 
+    def _gated(self) -> bool:
+        return isinstance(self.accessor, CtrAccessor)
+
     def pull(self, ids) -> np.ndarray:
         ids = np.asarray(ids, np.int64).reshape(-1)
         with self._lock:
+            if self._gated():
+                # CTR admission (reference ctr_accessor.cc): features not
+                # yet past admit_threshold read as zeros and get no row
+                out = np.zeros((len(ids), self.dim), np.float32)
+                known = [i for i, f in enumerate(ids)
+                         if int(f) in self._index]
+                if known:
+                    rows_idx = [self._index[int(ids[i])] for i in known]
+                    out[known] = self._rows[rows_idx]
+                return out
             idx = self._ensure(ids)
             return self._rows[idx].copy()
 
     def push(self, ids, grads) -> None:
         ids = np.asarray(ids, np.int64).reshape(-1)
         grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
-        uniq, inv = np.unique(ids, return_inverse=True)
-        agg = np.zeros((len(uniq), self.dim), np.float32)
-        np.add.at(agg, inv, grads)
         with self._lock:
+            if self._gated():
+                # drop gradients for un-admitted features (they have no row)
+                keep = np.asarray([int(f) in self._index for f in ids], bool)
+                if not keep.any():
+                    return
+                ids, grads = ids[keep], grads[keep]
+            uniq, agg = merge_by_id(ids, grads)
             idx = self._ensure(uniq)
             rows = self._rows[idx]
             slots = {k: v[idx] for k, v in self._slots.items()}
@@ -145,9 +177,7 @@ class SparseTable:
         gradients — reference communicator GeoCommunicator::Send)."""
         ids = np.asarray(ids, np.int64).reshape(-1)
         deltas = np.asarray(deltas, np.float32).reshape(len(ids), self.dim)
-        uniq, inv = np.unique(ids, return_inverse=True)
-        agg = np.zeros((len(uniq), self.dim), np.float32)
-        np.add.at(agg, inv, deltas)
+        uniq, agg = merge_by_id(ids, deltas)
         with self._lock:
             idx = self._ensure(uniq)
             self._rows[idx] += agg
@@ -156,11 +186,43 @@ class SparseTable:
         if not isinstance(self.accessor, CtrAccessor):
             return
         ids = np.asarray(ids, np.int64).reshape(-1)
+        shows = np.ones(len(ids), np.float32) if shows is None else \
+            np.asarray(shows, np.float32).reshape(-1)
+        clicks_a = None if clicks is None else \
+            np.asarray(clicks, np.float32).reshape(-1)
+        # duplicate ids per batch are the norm: aggregate first, or the
+        # gather-increment-scatter below would keep only the last copy
+        orig_ids = ids
+        ids, shows = merge_by_id(orig_ids, shows)
+        if clicks_a is not None:
+            _, clicks_a = merge_by_id(orig_ids, clicks_a)
         with self._lock:
-            idx = self._ensure(ids)
+            # admission: un-admitted features accumulate pending shows and
+            # only materialize a row once past admit_threshold
+            admitted_i, carried = [], {}
+            for i, f in enumerate(ids):
+                fid = int(f)
+                if fid in self._index:
+                    admitted_i.append(i)
+                    continue
+                tally = self._pending_shows.get(fid, 0.0) + float(shows[i])
+                if tally >= self.accessor.admit_threshold:
+                    self._pending_shows.pop(fid, None)
+                    admitted_i.append(i)  # _ensure below creates the row
+                    carried[i] = tally - float(shows[i])
+                else:
+                    self._pending_shows[fid] = tally
+            if not admitted_i:
+                return
+            sel = np.asarray(admitted_i, np.int64)
+            shows_eff = shows[sel].copy()
+            for pos, i in enumerate(admitted_i):
+                shows_eff[pos] += carried.get(i, 0.0)  # pre-admission shows
+            idx = self._ensure(ids[sel])
             slots = {k: v[idx] for k, v in self._slots.items()}
             self.accessor.record_shows(
-                slots, np.ones(len(ids)) if shows is None else shows, clicks)
+                slots, shows_eff,
+                None if clicks_a is None else clicks_a[sel])
             for k, v in self._slots.items():
                 v[idx] = slots[k]
 
@@ -193,17 +255,49 @@ class SparseTable:
                               len(self._index))
             idx = np.fromiter(self._index.values(), np.int64,
                               len(self._index))
+            import json as _json
+            acc_meta = _json.dumps(
+                {"name": self.accessor_name,
+                 "config": getattr(self.accessor, "config", dict)()})
             buf = io.BytesIO()
             np.savez(buf, ids=ids, rows=self._rows[idx],
+                     accessor=np.frombuffer(acc_meta.encode(), np.uint8),
                      **{f"slot_{k}": v[idx] for k, v in self._slots.items()})
             return buf.getvalue()
+
+    @staticmethod
+    def peek_meta(blob: bytes):
+        """(dim, accessor_name, accessor_config) of a checkpoint blob — a
+        fresh server must rebuild the accessor with the SAME kind and
+        hyperparameters it was saved with (code-review r3: a defaulted
+        accessor would KeyError on the slot set or silently change lr)."""
+        import json as _json
+        data = np.load(io.BytesIO(blob))
+        name, cfg = "adagrad", {}
+        if "accessor" in data:
+            raw = data["accessor"].tobytes().decode()
+            try:
+                meta = _json.loads(raw)
+                name, cfg = meta["name"], meta.get("config", {})
+            except ValueError:  # pre-config blobs stored the bare name
+                name = raw
+        return int(data["rows"].shape[1]), name, cfg
 
     def load(self, blob: bytes) -> None:
         data = np.load(io.BytesIO(blob))
         ids = data["ids"]
+        slot_keys = {k[len("slot_"):] for k in data.files
+                     if k.startswith("slot_")}
+        if slot_keys != set(self._slots):
+            raise ValueError(
+                f"checkpoint slots {sorted(slot_keys)} do not match this "
+                f"table's accessor '{self.accessor_name}' slots "
+                f"{sorted(self._slots)} — construct the table with the "
+                "accessor it was saved with")
         with self._lock:
             self._index.clear()
             self._free = []
+            self._pending_shows.clear()
             n = len(ids)
             if n > self._rows.shape[0]:
                 self._grow(n - self._rows.shape[0])

@@ -27,7 +27,8 @@ def relu6(x, name=None):
 
 
 def gelu(x, approximate=False, name=None):
-    return run_op("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), (x,))
+    return run_op("gelu", lambda a: jax.nn.gelu(a, approximate=approximate),
+                  (x,), attrs={"approximate": bool(approximate)})
 
 
 def silu(x, name=None):

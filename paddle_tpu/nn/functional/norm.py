@@ -53,7 +53,9 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
         w = next(it) if has_w else None
         b = next(it) if has_b else None
         return impl(a, w, b, epsilon, a.ndim + begin)
-    return run_op("layer_norm", fn, tuple(ops))
+    return run_op("layer_norm", fn, tuple(ops),
+                  attrs={"epsilon": float(epsilon), "begin_norm_axis": begin,
+                         "has_weight": has_w, "has_bias": has_b})
 
 
 @register_op_impl("rms_norm", "xla")
@@ -72,8 +74,11 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     Pallas impl registers under the same op name)."""
     impl = select_impl("rms_norm")
     if weight is not None:
-        return run_op("rms_norm", lambda a, w: impl(a, w, epsilon), (x, weight))
-    return run_op("rms_norm", lambda a: impl(a, None, epsilon), (x,))
+        return run_op("rms_norm", lambda a, w: impl(a, w, epsilon),
+                      (x, weight), attrs={"epsilon": float(epsilon),
+                                          "has_weight": True})
+    return run_op("rms_norm", lambda a: impl(a, None, epsilon), (x,),
+                  attrs={"epsilon": float(epsilon), "has_weight": False})
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,

@@ -183,10 +183,13 @@ def _fwd_kernel(*refs, scale, causal, offset, bq, bk, nk, sk_real, has_bias,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0].astype(jnp.float32) * scale                 # (bq, d)
-        k = k_ref[0].astype(jnp.float32)                         # (bk, d)
+        # inputs stay in storage dtype (bf16 on the training path): the MXU
+        # multiplies bf16 natively at 2x f32 rate, accumulating f32 via
+        # preferred_element_type; scale is applied to the f32 product
+        q = q_ref[0]                                             # (bq, d)
+        k = k_ref[0]                                             # (bk, d)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if has_bias:
             s = s + bias_ref[0].astype(jnp.float32)
         kidx = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -216,9 +219,11 @@ def _fwd_kernel(*refs, scale, causal, offset, bq, bk, nk, sk_real, has_bias,
             p_v = jnp.where(keep, p * np.float32(1.0 / (1.0 - rate)), 0.0)
         else:
             p_v = p
-        v = v_ref[0].astype(jnp.float32)                         # (bk, d)
+        v = v_ref[0]                                             # (bk, d)
+        # probabilities ride the MXU in v's storage dtype (bf16-safe: p in
+        # [0,1], the f32 accumulator keeps the sum exact enough)
         acc_ref[...] = acc_ref[...] * alpha[:, :1] + jax.lax.dot(
-            p_v, v, preferred_element_type=jnp.float32)
+            p_v.astype(v.dtype), v, preferred_element_type=jnp.float32)
         m_ref[...] = m_new
 
     @pl.when(ki == nk - 1)
@@ -400,14 +405,15 @@ def _dq_kernel(*refs, scale, causal, offset, bq, bk, nk, sk_real, has_bias,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # storage-dtype MXU inputs, f32 accumulation (see _fwd_kernel note)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]                                        # (bq, 1)
         lse_safe = jnp.where(lse == _NEG_INF, 0.0, lse)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if has_bias:
             s = s + bias_ref[0].astype(jnp.float32)
         kidx = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -428,7 +434,7 @@ def _dq_kernel(*refs, scale, causal, offset, bq, bk, nk, sk_real, has_bias,
         ds = p * (dp - delta_ref[0])                            # (bq, bk)
         if emit_dbias:
             dbias_ref[0] = ds.astype(dbias_ref.dtype)
-        dq_acc[...] += jax.lax.dot(ds, k,
+        dq_acc[...] += jax.lax.dot(ds.astype(k.dtype), k,
                                    preferred_element_type=jnp.float32) * scale
 
     @pl.when(ki == nk - 1)
@@ -466,14 +472,15 @@ def _dkv_kernel(*refs, scale, causal, offset, bq, bk, nq, sk_real, has_bias,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # storage-dtype MXU inputs, f32 accumulation (see _fwd_kernel note)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]                                        # (bq, 1)
         lse_safe = jnp.where(lse == _NEG_INF, 0.0, lse)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if has_bias:
             s = s + bias_ref[0].astype(jnp.float32)
         kidx = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -493,18 +500,18 @@ def _dkv_kernel(*refs, scale, causal, offset, bq, bk, nq, sk_real, has_bias,
         else:
             p_v = p
         dv_acc[...] += jax.lax.dot_general(
-            p_v, do, (((0,), (0,)), ((), ())),
+            p_v.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                  # (bk, d)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if rate > 0.0:
             dp = jnp.where(keep, dp * np.float32(1.0 / (1.0 - rate)), 0.0)
         ds = p * (dp - delta_ref[0])
-        # q was pre-scaled on load, so dk = ds^T @ (scale*q) needs no extra
-        # scale factor
+        # s = scale * (q . k) with q unscaled on load, so dk = scale *
+        # ds^T @ q carries the factor explicitly
         dk_acc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)                  # (bk, d)
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale          # (bk, d)
 
     @pl.when(qi == nq - 1)
     def _fin():

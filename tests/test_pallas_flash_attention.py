@@ -86,6 +86,33 @@ def test_bf16_forward_close():
                                rtol=2e-2, atol=2e-2)
 
 
+def test_bf16_grads_close():
+    """bf16 inputs now ride the MXU natively (storage-dtype dots with f32
+    accumulation); gradients must stay within bf16-class tolerance of the
+    f32 XLA oracle."""
+    rng = np.random.default_rng(7)
+    q, k, v = _mk(1, 128, 128, 2, 2, 32, dtype=jnp.bfloat16)
+    scale = 1.0 / math.sqrt(32)
+    ct = jnp.asarray(rng.standard_normal((1, 128, 2, 32)), jnp.float32)
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_attention_xla(q, k, v, None, True, scale, 0.0,
+                                      None).astype(jnp.float32) * ct)
+
+    def loss_pl(q, k, v):
+        return jnp.sum(flash_attention_pallas(q, k, v, True, scale, True)
+                       .astype(jnp.float32) * ct)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(qf, kf, vf)
+    gp = jax.grad(loss_pl, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gp, gr, "q k v".split()):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b_, np.float32),
+                                   rtol=6e-2, atol=6e-2,
+                                   err_msg=f"bf16 grad mismatch for {name}")
+
+
 def test_dispatch_uses_pallas_under_flag():
     """F.scaled_dot_product_attention routes to the Pallas kernel when the
     interpret flag is forced (CPU), and output still matches the oracle."""

@@ -67,17 +67,70 @@ def test_table_save_load_roundtrip():
     np.testing.assert_allclose(t2.pull(ids), t.pull(ids), rtol=1e-6)
 
 
+def test_ctr_admission_gates_unseen_features():
+    acc = CtrAccessor(admit_threshold=3.0)
+    t = SparseTable(2, accessor=acc, initializer="normal", init_scale=0.1)
+    # pull before admission: zeros, no row created
+    np.testing.assert_allclose(t.pull([7]), 0.0)
+    assert len(t) == 0
+    # pushes to un-admitted features are dropped
+    t.push([7], np.ones((1, 2), np.float32))
+    assert len(t) == 0
+    # shows accumulate until the threshold admits the feature
+    t.record_shows([7], shows=[2.0])
+    assert len(t) == 0
+    t.record_shows([7], shows=[2.0])  # tally 4.0 >= 3.0 -> admitted
+    assert len(t) == 1
+    # carried pre-admission shows land in the slot
+    j = t._index[7]
+    assert float(t._slots["show"][j, 0]) == 4.0
+
+
 def test_ctr_shrink_evicts_stale_features():
-    acc = CtrAccessor(show_decay=0.5, delete_threshold=0.9)
+    acc = CtrAccessor(show_decay=0.5, delete_threshold=0.9,
+                      admit_threshold=0.5)
     t = SparseTable(2, accessor=acc)
-    t.pull([1, 2])
-    t.record_shows([1], shows=[8.0])  # feature 1 is hot, 2 never shown
-    evicted = t.shrink()  # decays 8->4 (survives); 2's score 0 -> evicted
+    t.record_shows([1], shows=[8.0])  # hot feature
+    t.record_shows([2], shows=[0.6])  # barely admitted, goes stale
+    evicted = t.shrink()  # decays 8->4 (survives); 0.6->0.3 < 0.9 evicted
     assert evicted == 1
     assert 2 not in t._index and 1 in t._index and len(t) == 1
-    # evicted feature re-initializes fresh on next pull
-    rows = t.pull([2])
-    assert rows.shape == (1, 2)
+
+
+def test_fresh_server_restores_non_default_accessor(two_servers):
+    """A checkpoint saved from an 'sgd' table must restore into a brand-new
+    server process whose tables dict is empty (code-review r3: the default
+    accessor would KeyError on the checkpoint's slot set)."""
+    servers, client = two_servers
+    ids = np.arange(6, dtype=np.int64)
+    client.push("emb", ids, np.ones((6, 4), np.float32), 4)  # sgd table
+    before = client.pull("emb", ids, 4)
+    snapshot = client.save()
+
+    fresh = [PSServer().start() for _ in range(2)]
+    try:
+        c2 = PSClient([s.endpoint for s in fresh])
+        c2.load(snapshot)
+        np.testing.assert_allclose(c2.pull("emb", ids, 4), before,
+                                   rtol=1e-6)
+        c2.close()
+    finally:
+        for s in fresh:
+            s.stop()
+
+
+def test_geo_stop_flushes_outstanding_deltas(two_servers):
+    servers, client = two_servers
+    w = Communicator(client, mode="geo", geo_steps=100)  # window never hit
+    ids = np.array([3], np.int64)
+    w.geo_pull("emb", ids, 4)
+    # generic push() routes to the geo path (no deadlocking queue)
+    w.push("emb", ids, np.ones((1, 4), np.float32), 4)
+    w.stop()  # must ship the pending delta
+    c2 = PSClient([s.endpoint for s in servers],
+                  table_defaults=client._defaults)
+    assert (c2.pull("emb", ids, 4) != 0).any()
+    c2.close()
 
 
 # -- service + client --------------------------------------------------------
@@ -156,6 +209,77 @@ def test_geo_communicator_propagates_between_workers(two_servers):
     r1 = w2.geo_pull("emb", ids, 4)
     assert not np.allclose(r0, r1)  # worker 2 sees worker 1's delta
     w2.client.close()
+
+
+def test_record_shows_aggregates_duplicate_ids():
+    acc = CtrAccessor(admit_threshold=0.5)
+    t = SparseTable(2, accessor=acc)
+    t.record_shows([9, 9, 9])  # one batch, 3 shows for the same feature
+    j = t._index[9]
+    assert float(t._slots["show"][j, 0]) == 3.0
+
+
+def test_checkpoint_restores_accessor_hyperparams():
+    """A fresh server must rebuild the saved accessor with the SAME
+    hyperparameters, not the defaults (code-review r3)."""
+    t = SparseTable(2, accessor=SGDAccessor(learning_rate=1.0),
+                    initializer="zeros")
+    t.push([1], np.ones((1, 2), np.float32))
+    blob = t.save()
+    dim, name, cfg = SparseTable.peek_meta(blob)
+    assert (dim, name) == (2, "sgd") and cfg == {"learning_rate": 1.0}
+    srv = PSServer().start()
+    try:
+        client = PSClient([srv.endpoint])
+        client.load([{"sparse_emb2": np.frombuffer(blob, np.uint8)}])
+        client.push("emb2", [1], np.ones((1, 2), np.float32), 2)
+        # two lr=1.0 sgd steps on grad 1.0 from 0: row = -2.0
+        np.testing.assert_allclose(client.pull("emb2", [1], 2), -2.0,
+                                   rtol=1e-6)
+        client.close()
+    finally:
+        srv.stop()
+
+
+def test_load_rejects_shard_count_mismatch(two_servers):
+    _, client = two_servers
+    snapshot = client.save()
+    srv = PSServer().start()
+    try:
+        c1 = PSClient([srv.endpoint])
+        with pytest.raises(ValueError, match="shards"):
+            c1.load(snapshot)  # 2-shard snapshot into 1-server cluster
+        c1.close()
+    finally:
+        srv.stop()
+
+
+def test_server_errors_surface_as_psexception(two_servers):
+    from paddle_tpu.distributed.ps import PSError
+    _, client = two_servers
+    with pytest.raises(PSError, match="accessor"):
+        # unknown accessor name: the ValueError must come back through the
+        # reply channel, not as a dropped connection
+        client._conns[0].call({"cmd": "pull", "table": "bad", "dim": 2,
+                               "accessor": "nope"},
+                              {"ids": np.array([1], np.int64)})
+    # the connection survives the error and serves the next request
+    rows = client.pull("emb", [0], 4)
+    assert rows.shape == (1, 4)
+
+
+def test_accessor_kw_reaches_the_server(two_servers):
+    """bind() must ship accessor hyperparameters, not just the accessor
+    name (code-review r3: a silently-defaulted learning rate)."""
+    servers, client = two_servers
+    from paddle_tpu.distributed.ps import Communicator, SparseEmbedding
+    comm = Communicator(client, mode="sync").start()
+    emb = SparseEmbedding("tuned", dim=2, accessor="sgd",
+                          init_scale=0.0, learning_rate=1.0).bind(comm)
+    emb._push(np.array([11], np.int64), np.ones((1, 2), np.float32))
+    pulled = client.pull("tuned", [11], 2)
+    np.testing.assert_allclose(pulled, -1.0, rtol=1e-6)  # lr 1.0, not 0.05
+    comm.stop()
 
 
 # -- end-to-end sparse embedding training ------------------------------------

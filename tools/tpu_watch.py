@@ -26,8 +26,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "artifacts", "tpu_capture")
 PROBE_TIMEOUT = 120
-BENCH_TIMEOUT = 2400
-KERNEL_TIMEOUT = 3600   # block-size tuning adds compiles on first run
+BENCH_TIMEOUT = 1800
+KERNEL_TIMEOUT = 1800   # re-probe between steps keeps a dead tunnel cheap
 PROBE_INTERVAL = 150          # seconds between probes while tunnel is down
 RECAPTURE_INTERVAL = 2400     # refresh a successful capture every 40 min
 
@@ -108,6 +108,12 @@ def capture(device_info: str) -> bool:
         log(f"bench_gpt2 capture failed: "
             f"{(bench or {}).get('error', 'no/cpu result')}")
 
+    if probe() is None:
+        # the tunnel died mid-capture (a wedged bench child burns its
+        # whole timeout) — don't chain two more hung children behind it
+        log("tunnel dropped after bench_gpt2; aborting this capture pass")
+        return ok
+
     kscript = os.path.join(REPO, "bench_kernels.py")
     if os.path.exists(kscript):
         kern = run_json_child(kscript, KERNEL_TIMEOUT, "metric")
@@ -143,6 +149,10 @@ def capture(device_info: str) -> bool:
         else:
             log(f"bench_kernels capture failed: "
                 f"{(kern or {}).get('error', 'no/cpu result')}")
+
+    if probe() is None:
+        log("tunnel dropped after bench_kernels; aborting this capture pass")
+        return ok
 
     cscript = os.path.join(REPO, "bench_configs.py")
     if os.path.exists(cscript):
