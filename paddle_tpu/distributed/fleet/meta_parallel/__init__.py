@@ -1,6 +1,8 @@
 from .tensor_parallel import TensorParallel  # noqa: F401
 from .sharding_parallel import ShardingParallel  # noqa: F401
 from .segment_parallel import SegmentParallel  # noqa: F401
-from .pipeline_parallel import PipelineParallel, PipelineParallelWithInterleave  # noqa: F401
+from .pipeline_parallel import (PipelineParallel,  # noqa: F401
+                                PipelineParallelWithInterleave,
+                                PipelineParallelWithInterleaveFthenB)
 from .parallel_layers import (PipelineLayer, LayerDesc, SharedLayerDesc,  # noqa: F401
                               RNGStatesTracker, get_rng_state_tracker)

@@ -53,7 +53,8 @@ from ....core.tensor import Tensor
 from ....nn.layer.layers import _swapped_state
 from .parallel_layers import PipelineLayer
 
-__all__ = ["PipelineParallel", "PipelineParallelWithInterleave"]
+__all__ = ["PipelineParallel", "PipelineParallelWithInterleave",
+           "PipelineParallelWithInterleaveFthenB"]
 
 
 def _unwrap(x):
@@ -277,10 +278,12 @@ class PipelineParallel:
         return k
 
     @staticmethod
-    def _queue_1f1b(vs: int, n_vstages: int, m: int) -> deque:
-        """The per-(virtual-)stage 1F1B action order (reference
+    def _schedule_queue(vs: int, n_vstages: int, m: int) -> deque:
+        """The per-(virtual-)stage action order; subclasses override to
+        change the schedule. Default is 1F1B (reference
         pipeline_parallel.py:440): warmup forwards, steady F/B alternation,
-        cooldown backwards."""
+        cooldown backwards — stage s never stashes more than min(P-s, m)
+        microbatch inputs."""
         warmup = min(n_vstages - 1 - vs, m)
         q = [("F", i) for i in range(warmup)]
         for k in range(m - warmup):
@@ -319,7 +322,7 @@ class PipelineParallel:
         gout: Dict = {}
         stash: List[Dict] = [dict() for _ in range(nv)]
         grad_acc: List[Dict[str, jnp.ndarray]] = [dict() for _ in range(nv)]
-        queues = [self._queue_1f1b(vs, nv, m) for vs in range(nv)]
+        queues = [self._schedule_queue(vs, nv, m) for vs in range(nv)]
         self._peak_stash = [0] * nv
         losses = []
 
@@ -457,3 +460,18 @@ class PipelineParallelWithInterleave(PipelineParallel):
         self.num_virtual_stages = (
             num_virtual_stages
             or layers.get_num_chunks() // layers.get_num_stages())
+
+
+class PipelineParallelWithInterleaveFthenB(PipelineParallelWithInterleave):
+    """F-then-B interleaved schedule (reference pipeline_parallel.py:1489):
+    every microbatch's forward completes before any backward starts, with
+    backwards draining in reverse virtual-chunk order (the reference's
+    ``_get_virtual_pp_rank(..., forward=False)`` reversal falls out of the
+    dependency order here). Peak activation memory is the full ``m``
+    stashes per stage — the trade the reference makes for a schedule
+    whose collective-overlap windows are contiguous."""
+
+    @staticmethod
+    def _schedule_queue(vs: int, n_vstages: int, m: int) -> deque:
+        return deque([("F", i) for i in range(m)]
+                     + [("B", i) for i in range(m)])

@@ -508,6 +508,42 @@ class TestPipeline:
             np.testing.assert_allclose(float(loss_pp), float(loss_plain),
                                        rtol=1e-4)
 
+    def test_interleaved_f_then_b(self):
+        """FthenB (reference pipeline_parallel.py:1489): loss parity with
+        the plain model, and the schedule really runs all forwards before
+        any backward — every stage's peak stash is the full m."""
+        from paddle_tpu.distributed.fleet import PipelineLayer
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            PipelineParallelWithInterleaveFthenB)
+        paddle.seed(78)
+        loss_fn = paddle.nn.MSELoss()
+        pl = PipelineLayer(self._mlp_descs(8), num_stages=2, loss_fn=loss_fn,
+                           num_virtual_pipeline_stages=2)
+        paddle.seed(178)
+        plain = PipelineLayer(self._mlp_descs(8), num_stages=1,
+                              loss_fn=loss_fn)
+        plain.set_state_dict(pl.state_dict())
+
+        class _S:
+            pipeline_configs = {"accumulate_steps": 4, "micro_batch_size": 2}
+
+        engine = PipelineParallelWithInterleaveFthenB(pl, None, _S(),
+                                                      num_virtual_stages=2)
+        opt_pp = paddle.optimizer.SGD(0.05, parameters=pl.parameters())
+        opt_pl = paddle.optimizer.SGD(0.05, parameters=plain.parameters())
+        x = _t([8, 8], seed=2)
+        y = _t([8, 8], seed=3)
+        for _ in range(2):
+            loss_pp = engine.train_batch((x, y), opt_pp)
+            loss_plain = loss_fn(plain(x), y)
+            loss_plain.backward()
+            opt_pl.step()
+            opt_pl.clear_grad()
+            np.testing.assert_allclose(float(loss_pp), float(loss_plain),
+                                       rtol=1e-4)
+        # F-then-B memory profile: every chunk stashed all m microbatches
+        assert all(s == 4 for s in engine._peak_stash), engine._peak_stash
+
 
 class TestRecompute:
     def test_recompute_matches_normal(self):
