@@ -36,6 +36,10 @@ class GPTConfig:
     intermediate_size: int = 3072
     dropout: float = 0.1
     layer_norm_eps: float = 1e-5
+    # "plain": logits materialized, XLA fused softmax-CE; "blockwise":
+    # vocab-chunked streaming LM-head+CE (ops/fused_ce.py) — same math,
+    # O(tokens*vocab/8) peak residual, unlocks batch>=16 on one v5e
+    lm_ce: str = "plain"
 
 
 def gpt2_small():
@@ -97,6 +101,17 @@ class GPTForCausalLM(nn.Layer):
                       lambda a, w: jnp.matmul(a, w.T), (h, self.gpt.wte.weight))
 
     def loss(self, input_ids, labels):
+        if self.config.lm_ce == "blockwise":
+            h = self.gpt(input_ids)
+            b, s, d = h.shape
+            from ..core.dispatch import run_op
+            from ..ops.fused_ce import blockwise_linear_cross_entropy
+            return run_op(
+                "fused_lm_ce",
+                lambda hh, ww, yy: blockwise_linear_cross_entropy(
+                    hh.reshape(b * s, d), ww, yy.reshape(b * s),
+                    ignore_index=-100),
+                (h, self.gpt.wte.weight, labels))
         logits = self(input_ids)
         b, s, v = logits.shape
         return F.cross_entropy(logits.reshape([b * s, v]),
