@@ -102,16 +102,9 @@ class GPTForCausalLM(nn.Layer):
 
     def loss(self, input_ids, labels):
         if self.config.lm_ce == "blockwise":
-            h = self.gpt(input_ids)
-            b, s, d = h.shape
-            from ..core.dispatch import run_op
-            from ..ops.fused_ce import blockwise_linear_cross_entropy
-            return run_op(
-                "fused_lm_ce",
-                lambda hh, ww, yy: blockwise_linear_cross_entropy(
-                    hh.reshape(b * s, d), ww, yy.reshape(b * s),
-                    ignore_index=-100),
-                (h, self.gpt.wte.weight, labels))
+            from .llama import blockwise_lm_loss
+            return blockwise_lm_loss(self.gpt(input_ids),
+                                     self.gpt.wte.weight, labels)
         logits = self(input_ids)
         b, s, v = logits.shape
         return F.cross_entropy(logits.reshape([b * s, v]),

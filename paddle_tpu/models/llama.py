@@ -45,6 +45,10 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     dropout: float = 0.0
     use_recompute: bool = False
+    # "plain": full logits through lm_head + CE; "blockwise": vocab-chunked
+    # streaming LM-head+CE (ops/fused_ce.py) — same math, caps the logits
+    # residual at vocab/num_blocks columns (HBM headroom at 0.7B+ on v5e)
+    lm_ce: str = "plain"
 
 
 def llama_7b():
@@ -79,6 +83,24 @@ def causal_lm_loss(logits, labels):
     b, s, v = logits.shape
     return F.cross_entropy(logits.reshape([b * s, v]),
                            labels.reshape([b * s]))
+
+
+def blockwise_lm_loss(h, w, labels, transpose_w=False):
+    """Token-mean CE through the vocab-streamed LM-head
+    (ops/fused_ce.blockwise_linear_cross_entropy) — the one blockwise loss
+    body shared by the GPT (tied (V,H) embedding) and Llama (untied (H,V)
+    lm_head, ``transpose_w=True``) families, with the same
+    ignore_index=-100 semantics as ``causal_lm_loss``."""
+    from ..core.dispatch import run_op
+    from ..ops.fused_ce import blockwise_linear_cross_entropy
+    b, s, d = h.shape
+
+    def fn(hh, ww, yy):
+        if transpose_w:
+            ww = ww.T
+        return blockwise_linear_cross_entropy(
+            hh.reshape(b * s, d), ww, yy.reshape(b * s), ignore_index=-100)
+    return run_op("fused_lm_ce", fn, (h, w, labels))
 
 
 def apply_rotary_pos_emb(q_arr, k_arr, cos, sin):
@@ -207,6 +229,10 @@ class LlamaForCausalLM(nn.Layer):
         return self.lm_head(self.model(input_ids))
 
     def loss(self, input_ids, labels):
+        if self.cfg.lm_ce == "blockwise":
+            return blockwise_lm_loss(self.model(input_ids),
+                                     self.lm_head.weight, labels,
+                                     transpose_w=True)
         return causal_lm_loss(self(input_ids), labels)
 
 

@@ -53,7 +53,14 @@ def create_train_step(model, optimizer, loss_fn=None, donate=False):
     (input-output aliasing): the update writes in place instead of
     allocating a second copy of every parameter and moment, freeing
     ~3x params bytes of HBM for bigger batches. The caller must then
-    treat the passed-in trees as consumed (use the returned ones)."""
+    treat the passed-in trees as consumed (use the returned ones).
+
+    ``donate="consume"`` additionally skips the protective copies of the
+    returned trees — the returned params ALIAS the model's live weight
+    buffers, so the first step invalidates the stateful model. One-shot
+    benchmark/training-loop use only; it removes the transient 1x-params
+    + 1x-moments copy that pushes billion-param models past HBM at
+    setup time."""
     trainable0 = functional_state(model, trainable_only=True)
     all0 = functional_state(model)
     frozen = {k: v for k, v in all0.items() if k not in trainable0}
@@ -81,7 +88,7 @@ def create_train_step(model, optimizer, loss_fn=None, donate=False):
     train_step = jax.jit(train_step,
                          donate_argnums=(0, 1) if donate else ())
 
-    if donate:
+    if donate and donate != "consume":
         # hand back copies: trainable0 aliases the model's live parameter
         # buffers, and donating those would delete the model's own weights
         # on the first step (use-after-free on any later model(...) call)
@@ -91,13 +98,17 @@ def create_train_step(model, optimizer, loss_fn=None, donate=False):
 
 
 def create_sharded_train_step(model, optimizer, mesh, param_spec_fn,
-                              data_axis: str = "dp", loss_fn=None):
+                              data_axis: str = "dp", loss_fn=None,
+                              donate=False):
     """Hybrid-parallel variant: params/opt-state laid out by
     ``param_spec_fn(name) -> PartitionSpec`` over ``mesh``; batch sharded
-    over ``data_axis``. Returns (step, params, opt_state, shard_batch)."""
+    over ``data_axis``. Returns (step, params, opt_state, shard_batch).
+    ``donate=True`` aliases params/opt-state in place (see
+    create_train_step) — treat the passed-in trees as consumed."""
     from jax.sharding import NamedSharding, PartitionSpec
 
-    step, params, opt_state = create_train_step(model, optimizer, loss_fn)
+    step, params, opt_state = create_train_step(model, optimizer, loss_fn,
+                                                donate=donate)
 
     def place(name, arr):
         return place_by_spec(arr, param_spec_fn(name), mesh)

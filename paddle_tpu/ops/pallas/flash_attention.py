@@ -269,7 +269,7 @@ def _fwd(q3, k3, v3, bias3, seed, hq, hk, causal, scale, offset, sk_real,
             pl.BlockSpec((1, 1, bk), lambda bh, qi, ki: (bh, _Z, ki)))
         args += [qseg3, kseg3]
     if seed is not None:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        in_specs.append(pl.BlockSpec((1,), lambda bh, qi, ki: (_Z,), memory_space=pltpu.SMEM))
         args.append(seed)
 
     kernel = functools.partial(
@@ -557,7 +557,7 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, bias3, seed, causal, scale,
             pl.BlockSpec((1, 1, bk), lambda bh, qi, ki: (bh, _Z, ki)))
         args += [qseg3, kseg3]
     if rate > 0.0:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        in_specs.append(pl.BlockSpec((1,), lambda bh, qi, ki: (_Z,), memory_space=pltpu.SMEM))
         args.append(seed)
 
     dq_out_specs = [
@@ -610,7 +610,7 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, bias3, seed, causal, scale,
             pl.BlockSpec((1, 1, bk), lambda bh, ki, qi: (bh, _Z, ki)))
         kq_args += [qseg3, kseg3]
     if rate > 0.0:
-        kq_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        kq_specs.append(pl.BlockSpec((1,), lambda bh, qi, ki: (_Z,), memory_space=pltpu.SMEM))
         kq_args.append(seed)
 
     scratch2 = [pltpu.VMEM((bk, d), jnp.float32),

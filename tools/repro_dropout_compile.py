@@ -1,0 +1,49 @@
+"""Minimal on-chip repro for the fa dropout-kernel Mosaic compile failure
+seen in the r3 kernel capture (fa_s4k_dropout0.1: remote_compile HTTP 500).
+Prints the full exception chain at a small shape, then the capture shape.
+"""
+from __future__ import annotations
+
+import sys
+import traceback
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.pallas.flash_attention import (flash_attention_ext,
+                                                   seed_from_key)
+
+
+def try_case(B, S, Hq, Hk, D, bq, bk, rate):
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, S, Hq, D), jnp.bfloat16) * 0.1
+    k = jnp.asarray(rng.randn(B, S, Hk, D), jnp.bfloat16) * 0.1
+    v = jnp.asarray(rng.randn(B, S, Hk, D), jnp.bfloat16) * 0.1
+    seed = seed_from_key(jax.random.key(0))
+    scale = float(D) ** -0.5
+    tag = f"B{B} S{S} H{Hq}/{Hk} D{D} bq{bq} bk{bk} rate{rate}"
+    try:
+        out = flash_attention_ext(q, k, v, None, seed, None, None, True,
+                                  scale, rate, bq, bk, False)
+        jax.block_until_ready(out)
+        print(f"OK   {tag}", flush=True)
+        return True
+    except Exception:
+        print(f"FAIL {tag}", flush=True)
+        traceback.print_exc()
+        tb = traceback.format_exc()
+        sys.stderr.write(tb[-4000:] + "\n")
+        return False
+
+
+if __name__ == "__main__":
+    print("device:", jax.devices()[0], flush=True)
+    # no-dropout control at the same tile sizes
+    try_case(1, 256, 4, 4, 128, 128, 128, 0.0)
+    # smallest dropout case
+    ok_small = try_case(1, 256, 4, 4, 128, 128, 128, 0.1)
+    # capture-size dropout case with default tiles
+    if ok_small:
+        try_case(2, 4096, 16, 16, 128, 128, 128, 0.1)
+        try_case(2, 4096, 16, 16, 128, 256, 512, 0.1)
