@@ -40,6 +40,9 @@ class GPTConfig:
     # vocab-chunked streaming LM-head+CE (ops/fused_ce.py) — same math,
     # O(tokens*vocab/8) peak residual, unlocks batch>=16 on one v5e
     lm_ce: str = "plain"
+    # gradient-checkpoint each encoder layer (fleet recompute; active in
+    # train mode): ~1/L activation memory for one extra encoder forward
+    use_recompute: bool = False
 
 
 def gpt2_small():
@@ -70,6 +73,8 @@ class GPTModel(nn.Layer):
             activation="gelu", normalize_before=True,
             layer_norm_eps=config.layer_norm_eps)
         self.encoder = nn.TransformerEncoder(enc_layer, config.num_layers)
+        # per-layer gradient checkpointing (train mode; fleet recompute)
+        self.encoder.enable_recompute = config.use_recompute
         self.ln_f = nn.LayerNorm(config.hidden_size,
                                  epsilon=config.layer_norm_eps)
 

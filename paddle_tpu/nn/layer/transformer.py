@@ -159,9 +159,17 @@ class TransformerEncoder(Layer):
     def forward(self, src, src_mask=None, cache=None):
         output = src
         new_caches = []
+        # enable_recompute: per-layer gradient checkpointing (the
+        # reference nets' enable_recompute attribute); train-mode only,
+        # never under decode caches
+        recompute_on = (getattr(self, "enable_recompute", False)
+                        and self.training and cache is None)
+        if recompute_on:
+            from ...distributed.fleet.recompute import recompute
         for i, layer in enumerate(self.layers):
             if cache is None:
-                output = layer(output, src_mask)
+                output = (recompute(layer, output, src_mask)
+                          if recompute_on else layer(output, src_mask))
             else:
                 output, c = layer(output, src_mask, cache[i])
                 new_caches.append(c)

@@ -442,8 +442,11 @@ def _dq_kernel(*refs, scale, causal, offset, bq, bk, nk, sk_real, has_bias,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(*refs, scale, causal, offset, bq, bk, nq, sk_real, has_bias,
-                has_seg, seg_causal, rate):
+def _dkv_kernel(*refs, scale, causal, offset, bq, bk, nq, rep, sk_real,
+                has_bias, has_seg, seg_causal, rate):
+    """Grid (B*Hk, nk, rep*nq): one kv-head block accumulates dk/dv over
+    ALL rep q-heads of its group (GQA-native — no rep-expanded K/V in HBM
+    and no post-kernel sum over q-head groups). rep == 1 is plain MHA."""
     scale = np.float32(scale)  # strong f64 scalars poison Mosaic under x64
     it = iter(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (
@@ -455,12 +458,15 @@ def _dkv_kernel(*refs, scale, causal, offset, bq, bk, nq, sk_real, has_bias,
     dk_ref, dv_ref = next(it), next(it)
     dk_acc, dv_acc = next(it), next(it)
 
-    bh = pl.program_id(0)
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    j = pl.program_id(2)                  # j = r * nq + qi over the group
+    qi = j % np.int32(nq)
+    # global q-head row — the dropout mask replay is per q-head (fwd hashes
+    # with the q-head program index)
+    bh = pl.program_id(0) * np.int32(rep) + j // np.int32(nq)
     q_start, k_start = qi * bq, ki * bk
 
-    @pl.when(qi == 0)
+    @pl.when(j == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
@@ -513,7 +519,7 @@ def _dkv_kernel(*refs, scale, causal, offset, bq, bk, nq, sk_real, has_bias,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale          # (bk, d)
 
-    @pl.when(qi == nq - 1)
+    @pl.when(j == rep * nq - 1)
     def _fin():
         dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
@@ -521,12 +527,19 @@ def _dkv_kernel(*refs, scale, causal, offset, bq, bk, nq, sk_real, has_bias,
 
 def _bwd_impl(q3, kx, vx, do3, lse, delta, bias3, seed, causal, scale,
               offset, sk_real, bq, bk, bias_maps, interpret, qseg3=None,
-              kseg3=None):
-    """All inputs per-q-head flattened: q3/do3 (BHq, Sq, D); kx/vx already
-    expanded to (BHq, Sk, D). Returns (dq, dk, dv, dbias_blocks)."""
+              kseg3=None, hq=None, hk=None):
+    """q3/do3/lse/delta per-q-head flattened (BHq, ...); kx/vx per-KV-head
+    (BHk, Sk, D) — the dq kernel reads its group's kv block via the same
+    index map the forward uses, and the dkv kernel accumulates over the
+    group's q-heads in-grid, so GQA never expands K/V in HBM. hq == hk is
+    plain MHA. Returns (dq, dk (BHk), dv (BHk), dbias_blocks)."""
     bhq, sq, d = q3.shape
-    sk = kx.shape[1]
+    bhk, sk = kx.shape[0], kx.shape[1]
+    hq = hq if hq is not None else bhq
+    hk = hk if hk is not None else bhq
+    rep = hq // hk
     nq, nk = sq // bq, sk // bk
+    kv_map = functools.partial(_kv_index, hq=hq, hk=hk)
     lse3 = lse[..., None]                                   # (bhq, sq, 1)
     delta3 = delta[..., None]
     has_bias = bias3 is not None
@@ -539,8 +552,8 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, bias3, seed, causal, scale,
 
     base_specs = [
         pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _Z)),
-        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, _Z)),
-        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, _Z)),
+        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (kv_map(bh), ki, _Z)),
+        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (kv_map(bh), ki, _Z)),
         pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _Z)),
         pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, _Z)),
         pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, _Z)),
@@ -591,46 +604,60 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, bias3, seed, causal, scale,
     else:
         dq, dbias_blocks = dq_outs, None
 
+    # dkv grid: (kv-head, k-block, j) with j = r * nq + qi sweeping every
+    # (q-head-of-group, q-block); all i32 (index maps lower through Mosaic)
+    rep_i, nq_i = np.int32(rep), np.int32(nq)
+
+    def qrow(bh, j):
+        return bh * rep_i + j // nq_i
+
+    def qblk(j):
+        return j % nq_i
+
     kq_specs = [
-        pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, _Z)),
-        pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, _Z)),
-        pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, _Z)),
-        pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, _Z)),
-        pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, _Z)),
-        pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, _Z)),
+        pl.BlockSpec((1, bq, d), lambda bh, ki, j: (qrow(bh, j), qblk(j), _Z)),
+        pl.BlockSpec((1, bk, d), lambda bh, ki, j: (bh, ki, _Z)),
+        pl.BlockSpec((1, bk, d), lambda bh, ki, j: (bh, ki, _Z)),
+        pl.BlockSpec((1, bq, d), lambda bh, ki, j: (qrow(bh, j), qblk(j), _Z)),
+        pl.BlockSpec((1, bq, 1), lambda bh, ki, j: (qrow(bh, j), qblk(j), _Z)),
+        pl.BlockSpec((1, bq, 1), lambda bh, ki, j: (qrow(bh, j), qblk(j), _Z)),
     ]
     kq_args = [q3, kx, vx, do3, lse3, delta3]
     if has_bias:
+        # bias rows are per q-head: callers expand K/V for bias + GQA, so
+        # rep == 1 here and the kq-grid bias map sees the plain q-head index
         kq_specs.append(_bias_spec(bias_maps, bq, bk, kq_grid=True))
         kq_args.append(bias3)
     if has_seg:
         kq_specs.append(
-            pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, _Z)))
+            pl.BlockSpec((1, bq, 1),
+                         lambda bh, ki, j: (qrow(bh, j), qblk(j), _Z)))
         kq_specs.append(
-            pl.BlockSpec((1, 1, bk), lambda bh, ki, qi: (bh, _Z, ki)))
+            pl.BlockSpec((1, 1, bk),
+                         lambda bh, ki, j: (qrow(bh, j), _Z, ki)))
         kq_args += [qseg3, kseg3]
     if rate > 0.0:
-        kq_specs.append(pl.BlockSpec((1,), lambda bh, qi, ki: (_Z,), memory_space=pltpu.SMEM))
+        kq_specs.append(pl.BlockSpec((1,), lambda bh, ki, j: (_Z,), memory_space=pltpu.SMEM))
         kq_args.append(seed)
 
     scratch2 = [pltpu.VMEM((bk, d), jnp.float32),
                 pltpu.VMEM((bk, d), jnp.float32)]
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          offset=offset, bq=bq, bk=bk, nq=nq,
+                          offset=offset, bq=bq, bk=bk, nq=nq, rep=rep,
                           sk_real=sk_real, has_bias=has_bias,
                           has_seg=has_seg,
                           seg_causal=bias_maps.get("seg_causal", False),
                           rate=rate),
-        grid=(bhq, nk, nq),
+        grid=(bhk, nk, rep * nq),
         in_specs=kq_specs,
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, _Z)),
-            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, _Z)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, j: (bh, ki, _Z)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, j: (bh, ki, _Z)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bhq, sk, d), q3.dtype),
-            jax.ShapeDtypeStruct((bhq, sk, d), q3.dtype),
+            jax.ShapeDtypeStruct((bhk, sk, d), q3.dtype),
+            jax.ShapeDtypeStruct((bhk, sk, d), q3.dtype),
         ],
         scratch_shapes=scratch2,
         compiler_params=pltpu.CompilerParams(
@@ -819,13 +846,21 @@ def _fa_bwd(causal, scale, dropout_rate, block_q, block_k, interpret, res,
 
     q3 = _pad_seq(q.transpose(0, 2, 1, 3).reshape(B * Hq, Sq, D), bq)
     do3 = _pad_seq(dout.transpose(0, 2, 1, 3).reshape(B * Hq, Sq, D), bq)
-    # expand kv to per-q-head for the backward kernels (GQA)
-    k4 = jnp.repeat(k.transpose(0, 2, 1, 3), rep, axis=1) if rep > 1 else \
-        k.transpose(0, 2, 1, 3)
-    v4 = jnp.repeat(v.transpose(0, 2, 1, 3), rep, axis=1) if rep > 1 else \
-        v.transpose(0, 2, 1, 3)
-    kx = _pad_seq(k4.reshape(B * Hq, Sk, D), bk)
-    vx = _pad_seq(v4.reshape(B * Hq, Sk, D), bk)
+    # GQA-native: K/V stay per-kv-head — the dq kernel indexes its group's
+    # kv block (the forward's kv_map) and the dkv kernel accumulates over
+    # the group's q-heads in-grid. The one exception is bias + GQA (the
+    # per-q-head dbias tiling assumes q-head rows): expand there only.
+    expand_kv = rep > 1 and bias is not None
+    if expand_kv:
+        k4 = jnp.repeat(k.transpose(0, 2, 1, 3), rep, axis=1)
+        v4 = jnp.repeat(v.transpose(0, 2, 1, 3), rep, axis=1)
+        kx = _pad_seq(k4.reshape(B * Hq, Sk, D), bk)
+        vx = _pad_seq(v4.reshape(B * Hq, Sk, D), bk)
+        hq_eff = hk_eff = Hq
+    else:
+        kx = _pad_seq(k.transpose(0, 2, 1, 3).reshape(B * Hk, Sk, D), bk)
+        vx = _pad_seq(v.transpose(0, 2, 1, 3).reshape(B * Hk, Sk, D), bk)
+        hq_eff, hk_eff = Hq, Hk
     qseg3, kseg3 = (_seg3(q_seg, k_seg, B, Hq, bq, bk)
                     if q_seg is not None else (None, None))
     seg_causal = causal and q_seg is not None
@@ -861,13 +896,15 @@ def _fa_bwd(causal, scale, dropout_rate, block_q, block_k, interpret, res,
 
     dq3, dk3, dv3, dbias_blocks = _bwd_impl(
         q3, kx, vx, do3, lse_p, delta, bias3, seed_in, causal, scale,
-        offset, Sk, bq, bk, maps, interpret, qseg3, kseg3)
+        offset, Sk, bq, bk, maps, interpret, qseg3, kseg3,
+        hq=hq_eff, hk=hk_eff)
     dq = dq3[:, :Sq].reshape(B, Hq, Sq, D).transpose(0, 2, 1, 3)
-    dk4 = dk3[:, :Sk].reshape(B, Hq, Sk, D)
-    dv4 = dv3[:, :Sk].reshape(B, Hq, Sk, D)
-    if rep > 1:  # sum q-head groups back onto their kv head
-        dk4 = dk4.reshape(B, Hk, rep, Sk, D).sum(axis=2)
-        dv4 = dv4.reshape(B, Hk, rep, Sk, D).sum(axis=2)
+    if expand_kv:  # per-q-head dk/dv: sum q-head groups onto their kv head
+        dk4 = dk3[:, :Sk].reshape(B, Hk, rep, Sk, D).sum(axis=2)
+        dv4 = dv3[:, :Sk].reshape(B, Hk, rep, Sk, D).sum(axis=2)
+    else:          # GQA-native: already per-kv-head
+        dk4 = dk3[:, :Sk].reshape(B, Hk, Sk, D)
+        dv4 = dv3[:, :Sk].reshape(B, Hk, Sk, D)
     dk = dk4.transpose(0, 2, 1, 3).astype(k.dtype)
     dv = dv4.transpose(0, 2, 1, 3).astype(v.dtype)
 

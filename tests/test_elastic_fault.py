@@ -34,7 +34,7 @@ def _worker(port, node_id, stop_after_epoch):
     bump, write a rendezvous marker (the re-launch handshake) and exit."""
     store = TCPStore("127.0.0.1", port, is_master=False)
     mgr = ElasticManager(store, node_id, np_target=3,
-                         heartbeat_interval=0.1, heartbeat_timeout=1.0)
+                         heartbeat_interval=0.1, heartbeat_timeout=3.0)
     mgr.start()
     epoch0 = mgr.current_epoch()
     try:
@@ -55,7 +55,7 @@ def test_kill_worker_triggers_restart_and_rejoin():
     nodes = ["n0", "n1", "n2"]
     watcher = ElasticManager(master, "watcher", np_target=3,
                              heartbeat_interval=0.1,
-                             heartbeat_timeout=1.0)
+                             heartbeat_timeout=3.0)
     watcher.register_nodes(nodes)
 
     procs = {n: ctx.Process(target=_worker, args=(port, n, 1))
@@ -119,7 +119,7 @@ def test_clean_membership_is_hold():
     try:
         mgr = ElasticManager(master, "a", np_target=1,
                              heartbeat_interval=0.1,
-                             heartbeat_timeout=1.0)
+                             heartbeat_timeout=3.0)
         mgr.register_nodes(["a"])
         mgr.start()
         time.sleep(0.5)

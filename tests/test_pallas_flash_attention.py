@@ -340,22 +340,35 @@ class TestVarlenSegments:
                                        np.asarray(ref), rtol=3e-5,
                                        atol=3e-5)
 
-    def test_grads_match_per_sequence(self):
+    @pytest.mark.parametrize("hq,hk", [(2, 2), (4, 2)])
+    def test_grads_match_per_sequence(self, hq, hk):
+        """Varlen backward, MHA and GQA (the GQA-native dkv path routes
+        segment words through qrow-indexed specs — hq != hk covers it)."""
         d = 64
-        q, k, v, cu, seg = self._packed(d)
+        q, k, v, cu, seg = self._packed(d, h=hq)
+        k, v = k[:, :, :hk], v[:, :, :hk]
+        rep = hq // hk
         scale = 1.0 / math.sqrt(d)
         g = jax.grad(lambda q, k, v: flash_attention_ext(
             q, k, v, None, _SEED0, seg, seg, True, scale, 0.0, 128, 128,
             True).sum(), (0, 1, 2))(q, k, v)
         for i in range(len(self.LENS)):
             lo, hi = int(cu[i]), int(cu[i + 1])
-            ge = jax.grad(lambda q, k, v: _dense_oracle(
-                q, k, v, scale, causal=True).sum(), (0, 1, 2))(
-                q[:, lo:hi], k[:, lo:hi], v[:, lo:hi])
-            for a, e in zip(g, ge):
-                np.testing.assert_allclose(np.asarray(a[:, lo:hi]),
-                                           np.asarray(e), rtol=3e-4,
-                                           atol=3e-4)
+            kx = jnp.repeat(k[:, lo:hi], rep, axis=2)
+            vx = jnp.repeat(v[:, lo:hi], rep, axis=2)
+            ge = jax.grad(lambda q, kx, vx: _dense_oracle(
+                q, kx, vx, scale, causal=True).sum(), (0, 1, 2))(
+                q[:, lo:hi], kx, vx)
+            L = hi - lo
+            dk_ref = np.asarray(ge[1]).reshape(1, L, hk, rep, d).sum(3)
+            dv_ref = np.asarray(ge[2]).reshape(1, L, hk, rep, d).sum(3)
+            np.testing.assert_allclose(np.asarray(g[0][:, lo:hi]),
+                                       np.asarray(ge[0]), rtol=3e-4,
+                                       atol=3e-4)
+            np.testing.assert_allclose(np.asarray(g[1][:, lo:hi]), dk_ref,
+                                       rtol=3e-4, atol=3e-4)
+            np.testing.assert_allclose(np.asarray(g[2][:, lo:hi]), dv_ref,
+                                       rtol=3e-4, atol=3e-4)
 
     def test_flash_attn_unpadded_api(self):
         """The packed public API: [total, H, D] + cu_seqlens."""
