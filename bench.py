@@ -295,7 +295,10 @@ def main():
                   "loss_start": round(loss_start, 4),
                   "loss_end": round(loss_end, 4),
                   "params": n_params, "device": str(dev),
-                  "batch": batch, "lm_ce": lm_ce_mode, "seq": seq,
+                  "batch": batch, "mode": lm_ce_mode,
+                  "lm_ce": ("blockwise" if "blockwise" in lm_ce_mode
+                            else "plain"),
+                  "use_recompute": "remat" in lm_ce_mode, "seq": seq,
                   "platform": dev.platform,
                   "batch_sweep": {f"b{b}/{m}": round(r[0], 1)
                                   for (b, m), r in by_cand.items()},

@@ -97,6 +97,12 @@ def main():
                     return model.gpt(Tensor(ids_))._data
 
     res = {}
+    # 0. per-dispatch floor (remote tunnel ~10ms/execute): every component
+    # number below carries it additively, so DIFFERENCES between rows are
+    # floor-free; absolute rows are (compute + floor)
+    res["dispatch_floor_ms"] = timed(
+        jax.jit(lambda p: p["gpt.ln_f.weight"].sum()), params0)
+
     # 1. full step
     res["full_step_ms"] = timed(
         lambda p, o: step(p, o, key, x, y, 3e-4), params0,
@@ -136,12 +142,18 @@ def main():
             g.astype(jnp.float32).sum()
             for g in jax.grad(ce_block, argnums=(0, 1))(a, b))), h, w)
 
-    # fused alternative
-    from paddle_tpu.ops.fused_ce import fused_linear_cross_entropy
+    # fused / blockwise alternatives
+    from paddle_tpu.ops.fused_ce import (blockwise_linear_cross_entropy,
+                                         fused_linear_cross_entropy)
     res["ce_fused_fwd_bwd_ms"] = timed(
         jax.jit(lambda a, b: sum(
             g.astype(jnp.float32).sum()
             for g in jax.grad(lambda p, q: fused_linear_cross_entropy(
+                p, q, labels_flat), argnums=(0, 1))(a, b))), h, w)
+    res["ce_blockwise_fwd_bwd_ms"] = timed(
+        jax.jit(lambda a, b: sum(
+            g.astype(jnp.float32).sum()
+            for g in jax.grad(lambda p, q: blockwise_linear_cross_entropy(
                 p, q, labels_flat), argnums=(0, 1))(a, b))), h, w)
 
     # 6. optimizer sweep alone

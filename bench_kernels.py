@@ -204,10 +204,12 @@ def main():
             lambda lg, lb: -jnp.take_along_axis(
                 jax.nn.log_softmax(lg, -1), lb[:, None], 1)[:, 0],
             (logits, labels), results, diff_argnums=(0,), chain=12,
-            # CE returns per-row losses, not a logits-shaped carry: feed a
-            # 1e-30-scaled broadcast back so every chained call has a real
-            # data dependency (values unchanged in f32; not DCE-foldable)
-            feedback=lambda out, lg: lg + out[:, None] * np.float32(1e-30))
+            # CE returns per-row losses, not a logits-shaped carry: inject
+            # the dependency into ONE column (values unchanged in f32, not
+            # DCE-foldable) — a full-buffer elementwise feedback would add
+            # a logits-sized HBM pass per link and distort the absolutes
+            feedback=lambda out, lg: lg.at[:, :1].add(
+                out[:, None] * np.float32(1e-30)))
 
     # ---- norms at transformer activation shapes -------------------------
     for name, rows, hidden in (("rms_8k_4k", 8192, 4096),
