@@ -185,6 +185,20 @@ def capture(device_info: str) -> bool:
             log(f"bench_configs capture failed: "
                 f"{(cfg or {}).get('error', 'no/cpu result')}")
 
+    if probe() is not None:
+        bscript = os.path.join(REPO, "bench_breakdown.py")
+        if os.path.exists(bscript):
+            # step-time attribution (perf diagnosis; not scored)
+            br = run_json_child(bscript, 900, "metric")
+            if br is not None and br.get("platform") == "tpu":
+                with open(os.path.join(OUT, "bench_breakdown.json"),
+                          "w") as f:
+                    json.dump(br, f, indent=1)
+                log("captured bench_breakdown")
+            else:
+                log(f"bench_breakdown capture failed: "
+                    f"{(br or {}).get('error', 'no/cpu result')}")
+
     if ok:
         with open(os.path.join(OUT, "meta.json"), "w") as f:
             json.dump({"captured_at_unix": time.time(),
