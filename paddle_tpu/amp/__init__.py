@@ -10,6 +10,7 @@ python/paddle/amp/grad_scaler.py:201.
 from __future__ import annotations
 
 import contextlib
+from enum import Enum
 
 import jax.numpy as jnp
 
@@ -17,8 +18,16 @@ from ..core import amp_state
 from ..core.dtype import convert_dtype
 from ..core.tensor import Tensor
 
-__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate", "amp_decorate",
-           "debugging"]
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler",
+           "OptimizerState", "decorate", "amp_decorate", "debugging"]
+
+
+class OptimizerState(Enum):
+    """Per-optimizer scaler bookkeeping states (parity:
+    amp/grad_scaler.py OptimizerState)."""
+    INIT = 0
+    UNSCALED = 1
+    STEPPED = 2
 
 
 @contextlib.contextmanager
@@ -170,5 +179,27 @@ def is_bfloat16_supported(device=None):
     MXU dtype."""
     return True
 
+def white_list():
+    """Per-dtype/per-level white lists (parity: amp_lists.py:105). Each
+    slot is an independent set — callers may customize one level."""
+    return {dt: {lv: set(amp_state.WHITE_LIST)
+                 for lv in ("OD", "O1", "O2")}
+            for dt in ("float16", "bfloat16")}
+
+
+def black_list():
+    """Per-dtype/per-level black lists (parity: amp_lists.py:121)."""
+    return {dt: {"OD": set(), "O1": set(amp_state.BLACK_LIST),
+                 "O2": set()}
+            for dt in ("float16", "bfloat16")}
+
+
+# legacy alias (parity: paddle.amp.AmpScaler is the base-layer scaler the
+# public GradScaler subclasses) — before the submodule imports below,
+# which re-export it
+AmpScaler = GradScaler
+
 from . import debugging  # noqa: E402,F401
 from . import _op_stats  # noqa: E402,F401
+from . import accuracy_compare  # noqa: E402,F401
+from . import grad_scaler  # noqa: E402,F401

@@ -1,0 +1,26 @@
+"""amp.accuracy_compare (parity: python/paddle/amp/accuracy_compare.py —
+utilities that compare FP32-vs-low-precision op logs produced by the
+debugging tracer). The workbook writer of the reference needs openpyxl
+(not in-image); the comparison core maps onto amp.debugging's op-stat
+collection, re-exported here with the reference's helper names.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .debugging import compare_accuracy  # noqa: F401
+
+__all__ = ["is_infinite", "is_allclose", "compare_accuracy"]
+
+
+def is_infinite(value, dtype=np.float16):
+    """True if casting ``value`` to ``dtype`` overflows to inf/nan
+    (reference accuracy_compare.py:21)."""
+    arr = np.asarray(value)
+    return bool(np.any(~np.isfinite(arr.astype(dtype))))
+
+
+def is_allclose(actual, expected, atol=1e-2, rtol=1e-2):
+    """(reference accuracy_compare.py:28)"""
+    return bool(np.allclose(np.asarray(actual), np.asarray(expected),
+                            atol=atol, rtol=rtol))

@@ -1,0 +1,6 @@
+"""amp.grad_scaler submodule (parity: python/paddle/amp/grad_scaler.py —
+the scaler classes live in the package root here; this module is the
+path-faithful access point)."""
+from . import AmpScaler, GradScaler, OptimizerState  # noqa: F401
+
+__all__ = ["GradScaler", "AmpScaler", "OptimizerState"]

@@ -1,4 +1,6 @@
 from ..process_mesh import ProcessMesh, Shard, Replicate, Partial  # noqa: F401
-from .api import (shard_tensor, reshard, shard_layer, shard_optimizer,  # noqa: F401
-                  dtensor_from_fn, unshard_dtensor, local_value, DistAttr)
+from ..process_mesh import get_current_process_mesh  # noqa: F401
+from .api import (shard_tensor, reshard, shard_layer, shard_op,  # noqa: F401
+                  shard_optimizer, dtensor_from_fn, unshard_dtensor,
+                  local_value, DistAttr)
 from .engine import Engine  # noqa: F401

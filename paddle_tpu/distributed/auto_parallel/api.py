@@ -34,9 +34,9 @@ from ..process_mesh import (Partial, Placement, ProcessMesh, Replicate, Shard,
                             placements_to_spec)
 
 __all__ = ["DistAttr", "shard_tensor", "reshard", "shard_layer",
-           "shard_optimizer", "dtensor_from_fn", "unshard_dtensor",
-           "local_value", "ShardingStage0", "ShardingStage1",
-           "ShardingStage2", "ShardingStage3"]
+           "shard_op", "shard_optimizer", "dtensor_from_fn",
+           "unshard_dtensor", "local_value", "ShardingStage0",
+           "ShardingStage1", "ShardingStage2", "ShardingStage3"]
 
 
 @dataclass
@@ -97,6 +97,64 @@ def _spec_with_partial_stack(mesh: ProcessMesh,
 
 def _is_dist(x: Tensor) -> bool:
     return isinstance(x, Tensor) and x.dist_attr is not None
+
+
+def _shard_spec_placements(shard_spec, mesh: ProcessMesh):
+    """['x', None, 'y']-style per-tensor-dim mesh-axis names (the
+    reference's shard_spec form, interface.py:122) -> placements list."""
+    placements = [Replicate()] * mesh.ndim
+    if shard_spec is not None:
+        names = mesh.dim_names
+        for tdim, axis in enumerate(shard_spec):
+            if axis is None:
+                continue
+            if axis not in names:
+                raise ValueError(
+                    f"shard_spec axis '{axis}' not in mesh dims {names}")
+            idx = names.index(axis)
+            if placements[idx].is_shard():
+                raise ValueError(
+                    f"shard_spec {shard_spec} maps mesh axis '{axis}' to "
+                    "two tensor dims")
+            placements[idx] = Shard(tdim)
+    return placements
+
+
+def shard_op(op, process_mesh=None, in_shard_specs=None,
+             out_shard_specs=None, **kwargs):
+    """Wrap a callable so its inputs/outputs are annotated+placed on
+    ``process_mesh`` per the given shard specs (parity:
+    auto_parallel/interface.py:122 shard_op; specs are per-tensor lists of
+    mesh dim names, None = replicated). With no mesh argument the
+    innermost ``with mesh:`` context is used."""
+    from ..process_mesh import get_current_process_mesh
+    mesh = process_mesh if process_mesh is not None \
+        else get_current_process_mesh()
+    if mesh is None:
+        raise AssertionError(
+            "Specify the process mesh argument or use the ProcessMesh "
+            "context manager first.")
+
+    def _place(x, spec):
+        if not isinstance(x, Tensor) or spec is None:
+            return x
+        return shard_tensor(x, mesh, _shard_spec_placements(spec, mesh))
+
+    def wrapped(*args, **kw):
+        if in_shard_specs is not None:
+            args = tuple(
+                _place(a, in_shard_specs[i]) if i < len(in_shard_specs)
+                else a for i, a in enumerate(args))
+        outs = op(*args, **kw)
+        if out_shard_specs is None:
+            return outs
+        if isinstance(outs, (tuple, list)):
+            placed = [ _place(o, out_shard_specs[i])
+                       if i < len(out_shard_specs) else o
+                       for i, o in enumerate(outs)]
+            return type(outs)(placed)
+        return _place(outs, out_shard_specs[0])
+    return wrapped
 
 
 def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],

@@ -21,7 +21,16 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
-           "get_mesh", "set_mesh", "init_mesh"]
+           "get_mesh", "set_mesh", "init_mesh",
+           "get_current_process_mesh"]
+
+# `with mesh:` context stack (reference process_mesh.py)
+_MESH_STACK: list = []
+
+
+def get_current_process_mesh():
+    """Innermost mesh entered with ``with mesh:`` or None."""
+    return _MESH_STACK[-1] if _MESH_STACK else None
 
 
 class Placement:
@@ -106,6 +115,16 @@ class ProcessMesh:
         self._mesh_array = arr
         self._dim_names = list(dim_names)
         self._jax_mesh = None
+
+    # -- current-mesh context (reference process_mesh.py: `with mesh:`
+    # sets the mesh shard_op/shard_tensor default) ------------------------
+    def __enter__(self):
+        _MESH_STACK.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _MESH_STACK.pop()
+        return False
 
     @property
     def shape(self):

@@ -8,3 +8,4 @@ from .parameter import Parameter, ParamAttr, create_parameter  # noqa: F401
 from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
 from . import utils  # noqa: F401
 from . import quant  # noqa: F401
+from .utils import spectral_norm  # noqa: F401

@@ -10,3 +10,5 @@ from .flash_attention import (  # noqa: F401
     sdp_kernel,
 )
 from ..decode import gather_tree  # noqa: F401
+from ...tensor.creation import diag_embed  # noqa: F401
+from ...tensor.math import pdist  # noqa: F401

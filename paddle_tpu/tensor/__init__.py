@@ -8,7 +8,21 @@ the Tensor wrapper class.
 from __future__ import annotations
 
 from ..core.tensor import Tensor, to_tensor  # noqa: F401
+from .array import array_length, array_read, array_write, create_array  # noqa: F401
 from .creation import *  # noqa: F401,F403
+from .creation import create_tensor, fill_constant  # noqa: F401
+from .math import mod as floor_mod  # noqa: F401
+from .linalg import inv as inverse  # noqa: F401
+from ..signal import istft, stft  # noqa: F401
+from ..framework import set_printoptions  # noqa: F401
+
+
+def create_parameter(*args, **kwargs):
+    """(parity: paddle.tensor.create_parameter) — lazy delegate to
+    nn.parameter: tensor is imported before nn during package init, so a
+    top-level import here would invert the layering."""
+    from ..nn.parameter import create_parameter as _cp
+    return _cp(*args, **kwargs)
 from .math import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403

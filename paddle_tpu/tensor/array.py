@@ -1,0 +1,70 @@
+"""Tensor-array ops (parity: python/paddle/tensor/array.py — the dygraph
+semantics: the array is a Python list of Tensors; ``i`` is a shape-[1]
+index Tensor). The reference's static-graph LOD_TENSOR_ARRAY variant maps
+onto the same list semantics here because this framework's static mode
+records its op DAG under ordinary Python control flow — a Python list of
+recorded Variables IS the tensor array at graph-build time (XLA has no
+runtime growable-array object; loops that need one are expressed with
+``lax.scan`` stacking instead, the TPU-native form).
+"""
+from __future__ import annotations
+
+from .creation import to_tensor
+
+__all__ = ["array_length", "array_read", "array_write", "create_array"]
+
+
+def _index(i):
+    """Coerce the reference's shape-[1] index Tensor (or an int) to int."""
+    if isinstance(i, int):
+        return i
+    shape = tuple(getattr(i, "shape", ()))
+    if shape not in ((), (1,)):
+        raise AssertionError(
+            "The shape of index 'i' should be [1] in dygraph mode, got "
+            f"{list(shape)}")
+    return int(i.item(0) if shape == (1,) else i.item())
+
+
+def create_array(dtype, initialized_list=None):
+    """New tensor array (a list). ``initialized_list`` seeds it (parity:
+    create_array(dtype, initialized_list))."""
+    if initialized_list is None:
+        return []
+    if not isinstance(initialized_list, (list, tuple)):
+        raise TypeError(
+            "Require type(initialized_list) should be list/tuple, but "
+            f"received {type(initialized_list)}")
+    return [x if hasattr(x, "_data") else to_tensor(x, dtype=dtype)
+            for x in initialized_list]
+
+
+def array_length(array):
+    """Length of the array as an int (dygraph semantics)."""
+    assert isinstance(array, list), \
+        "The 'array' in array_length must be a list in dygraph mode"
+    return len(array)
+
+
+def array_read(array, i):
+    """Read ``array[i]``."""
+    assert isinstance(array, list), \
+        "The 'array' in array_read must be list in dygraph mode"
+    return array[_index(i)]
+
+
+def array_write(x, i, array=None):
+    """Write ``x`` at position ``i`` (append when ``i == len(array)``);
+    returns the array."""
+    idx = _index(i)
+    if array is None:
+        array = []
+    assert isinstance(array, list), \
+        "The 'array' in array_write must be a list in dygraph mode"
+    assert idx <= len(array), \
+        "The index 'i' should not be greater than the length of 'array'"
+    if idx < len(array):
+        array[idx] = x
+    else:
+        array.append(x)
+    return array

@@ -188,3 +188,21 @@ def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
             out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
         return out
     return run_op("diag_embed", fn, (input,))
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    """Constant-filled tensor (parity: paddle.tensor.fill_constant — the
+    base-layers primitive behind full(); force_cpu is a no-op placement
+    hint on the XLA substrate)."""
+    t = full(shape, value, dtype=dtype)
+    if out is not None:
+        out._data = t._data
+        return out
+    return t
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """Empty 1-d placeholder tensor of ``dtype`` (parity:
+    paddle.tensor.create_tensor — dygraph returns an empty tensor the
+    caller assigns into, e.g. via paddle.assign(x, output=t))."""
+    return Tensor(jnp.zeros((0,), _dt(dtype)))

@@ -720,3 +720,59 @@ class TestSpmdRuleObservability:
                 _flags.set_flags({"spmd_strict": False})
         finally:
             SPMD_RULES["matmul"] = orig
+
+
+class TestShardOp:
+    """dist.shard_op + ProcessMesh context (reference
+    auto_parallel/interface.py:122): shard-spec lists of mesh dim names
+    place inputs/outputs; the innermost `with mesh:` supplies the default
+    mesh."""
+
+    def _mesh(self):
+        import paddle_tpu.distributed as dist
+        return dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                                dim_names=["x", "y"])
+
+    def test_specs_place_inputs_and_outputs(self):
+        import paddle_tpu.distributed as dist
+        mesh = self._mesh()
+        x = paddle.ones([4, 8])
+        y = paddle.zeros([4, 8])
+        dist_add = dist.shard_op(paddle.add, mesh,
+                                 in_shard_specs=[["x", "y"], ["x", None]],
+                                 out_shard_specs=[["x", None]])
+        out = dist_add(x, y)
+        np.testing.assert_array_equal(np.asarray(out._data), 1.0)
+        assert out.dist_attr is not None
+        p = out.dist_attr.placements
+        assert p[0].is_shard() and p[0].get_dim() == 0 and p[1].is_replicate()
+
+    def test_mesh_context_supplies_default(self):
+        import paddle_tpu.distributed as dist
+        mesh = self._mesh()
+        assert dist.get_current_process_mesh() is None
+        with mesh:
+            assert dist.get_current_process_mesh() is mesh
+            f = dist.shard_op(paddle.multiply,
+                              in_shard_specs=[["x", None], None],
+                              out_shard_specs=[[None, "y"]])
+            out = f(paddle.ones([4, 8]), paddle.full([4, 8], 2.0))
+            assert float(out.sum()) == 64.0
+            # the CONTEXT mesh placed the output per its spec
+            assert out.dist_attr is not None
+            assert out.dist_attr.process_mesh is mesh
+            p = out.dist_attr.placements
+            assert p[1].is_shard() and p[1].get_dim() == 1
+        assert dist.get_current_process_mesh() is None
+
+    def test_no_mesh_raises(self):
+        import paddle_tpu.distributed as dist
+        with pytest.raises(AssertionError, match="process mesh"):
+            dist.shard_op(paddle.add)
+
+    def test_bad_axis_raises(self):
+        import paddle_tpu.distributed as dist
+        f = dist.shard_op(paddle.add, self._mesh(),
+                          in_shard_specs=[["zz", None], None])
+        with pytest.raises(ValueError, match="zz"):
+            f(paddle.ones([4, 8]), paddle.ones([4, 8]))
