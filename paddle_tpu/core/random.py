@@ -78,6 +78,32 @@ class Generator:
     def peek_state(self):
         return (self._seed, self._offset)
 
+    # -- indexed state registry (parity: incubate/framework/random.py —
+    # register/switch whole generator states by index, the recompute
+    # RNG-bank mechanism) -------------------------------------------------
+    def _registry(self):
+        if not hasattr(self, "_state_registry"):
+            # slot 0 always exists: the state at first registry use
+            self._state_registry = [self.get_state()]
+            self._state_index = 0
+        return self._state_registry
+
+    def register_state_index(self, state=None) -> int:
+        reg = self._registry()
+        reg.append(tuple(state) if state is not None else self.get_state())
+        return len(reg) - 1
+
+    def get_state_index(self) -> int:
+        self._registry()
+        return self._state_index
+
+    def set_state_index(self, idx: int):
+        reg = self._registry()
+        # bank the live state into the current slot before switching
+        reg[self._state_index] = self.get_state()
+        self.set_state(reg[idx])
+        self._state_index = int(idx)
+
 
 default_generator = Generator()
 

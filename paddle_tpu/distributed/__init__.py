@@ -1,13 +1,13 @@
 """paddle_tpu.distributed (parity: python/paddle/distributed/)."""
 from .process_mesh import (ProcessMesh, Shard, Replicate, Partial,  # noqa: F401
-                           Placement, get_mesh, set_mesh, init_mesh)
+                           Placement, get_mesh, set_mesh, init_mesh,
+                           get_current_process_mesh)
 from .auto_parallel.static_mode import DistModel, to_static  # noqa: F401
 from .auto_parallel.api import (shard_tensor, reshard, shard_layer,  # noqa: F401
                                 shard_op, shard_optimizer, dtensor_from_fn,
                                 unshard_dtensor, local_value, DistAttr,
                                 ShardingStage0, ShardingStage1,
                                 ShardingStage2, ShardingStage3)
-from .process_mesh import get_current_process_mesh  # noqa: F401
 from .sharding import (group_sharded_parallel,  # noqa: F401
                        save_group_sharded_model)
 from . import rpc  # noqa: F401

@@ -152,6 +152,8 @@ def shard_op(op, process_mesh=None, in_shard_specs=None,
             placed = [ _place(o, out_shard_specs[i])
                        if i < len(out_shard_specs) else o
                        for i, o in enumerate(outs)]
+            if isinstance(outs, tuple) and hasattr(outs, "_fields"):
+                return type(outs)(*placed)   # namedtuple
             return type(outs)(placed)
         return _place(outs, out_shard_specs[0])
     return wrapped

@@ -9,6 +9,12 @@ from .meta_parallel import (PipelineLayer, LayerDesc, SharedLayerDesc,  # noqa: 
                             get_rng_state_tracker)
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from . import elastic  # noqa: F401
+from .dataset import (DatasetBase, InMemoryDataset, QueueDataset,  # noqa: F401
+                      FileInstantDataset, BoxPSDataset)
+from . import metrics  # noqa: F401
+from .scaler import distributed_scaler  # noqa: F401
+from .. import auto_parallel as auto  # noqa: F401
+from .utils import log_util  # noqa: F401
 from .role_makers import (Role, PaddleCloudRoleMaker,  # noqa: E402,F401
                            UserDefinedRoleMaker, UtilBase,
                            MultiSlotDataGenerator,

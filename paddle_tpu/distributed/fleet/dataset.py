@@ -1,0 +1,205 @@
+"""Fleet datasets — the PS-mode data pipeline (parity:
+python/paddle/distributed/fleet/dataset/dataset.py over the C++ MultiSlot
+dataset core).
+
+The reference streams text files through a ``pipe_command`` into per-slot
+records consumed by downstream trainers. Here the engine is
+Python/NumPy: files are piped through the same ``pipe_command`` contract
+(a shell command reading the file on stdin, emitting MultiSlot text on
+stdout), parsed into per-slot NumPy arrays, and iterated as feed dicts —
+the form both the static Executor and the eager PS loop consume.
+
+MultiSlot text format (the reference's MultiSlotDataFeed): each line is
+one example; for each slot in ``use_var`` order it carries
+``<n> v_1 ... v_n``. int64 slots hold sparse feature ids, float32 slots
+hold dense values.
+"""
+from __future__ import annotations
+
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset",
+           "FileInstantDataset", "BoxPSDataset"]
+
+
+class DatasetBase:
+    """Common init/filelist plumbing (reference dataset.py:24)."""
+
+    def __init__(self):
+        self.proto_desc = {"pipe_command": "cat", "batch_size": 1,
+                           "thread_num": 1}
+        self.filelist: List[str] = []
+        self.use_var: list = []
+        self._slot_dtypes: List[str] = []
+        self._slot_names: List[str] = []
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command="cat", input_type=0, fs_name="", fs_ugi="",
+             download_cmd="cat", **kwargs):
+        self._set_batch_size(batch_size)
+        self._set_thread(thread_num)
+        self._set_pipe_command(pipe_command)
+        if use_var is not None:
+            self._set_use_var(use_var)
+
+    def _set_pipe_command(self, pipe_command):
+        self.proto_desc["pipe_command"] = pipe_command
+
+    def _set_batch_size(self, batch_size):
+        self.proto_desc["batch_size"] = int(batch_size)
+
+    def _set_thread(self, thread_num):
+        self.proto_desc["thread_num"] = max(int(thread_num), 1)
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def _set_use_var(self, var_list):
+        """Slots, in feed order. Accepts static Variables, Tensors, or
+        (name, dtype) pairs."""
+        self.use_var = list(var_list)
+        self._slot_names, self._slot_dtypes = [], []
+        for v in self.use_var:
+            if isinstance(v, tuple):
+                name, dt = v
+            else:
+                name = getattr(v, "name", str(v))
+                dt = str(getattr(v, "dtype", "int64"))
+            dt = dt.split(".")[-1]
+            self._slot_names.append(name)
+            self._slot_dtypes.append("float32" if "float" in dt else "int64")
+
+    # -- engine ------------------------------------------------------------
+    def _read_file(self, path: str):
+        """Run ``pipe_command`` over one file, parse MultiSlot lines into
+        per-example slot lists."""
+        cmd = self.proto_desc["pipe_command"]
+        with open(path, "rb") as f:
+            out = subprocess.run(cmd, shell=True, stdin=f,
+                                 capture_output=True, check=True).stdout
+        records = []
+        for line in out.decode().splitlines():
+            toks = line.split()
+            if not toks:
+                continue
+            rec, i = [], 0
+            for dt in self._slot_dtypes:
+                n = int(toks[i]); i += 1
+                vals = toks[i:i + n]; i += n
+                rec.append(np.asarray(
+                    vals, np.float32 if dt == "float32" else np.int64))
+            records.append(rec)
+        return records
+
+    def _batches_from(self, records):
+        bs = self.proto_desc["batch_size"]
+        for lo in range(0, len(records) - bs + 1, bs):
+            chunk = records[lo:lo + bs]
+            feed = {}
+            for si, name in enumerate(self._slot_names):
+                rows = [r[si] for r in chunk]
+                width = max(len(r) for r in rows)
+                dt = rows[0].dtype
+                arr = np.zeros((len(rows), width), dt)
+                for ri, r in enumerate(rows):
+                    arr[ri, :len(r)] = r
+                feed[name] = arr
+            yield feed
+
+    def _finish_to_run(self):
+        pass
+
+
+class InMemoryDataset(DatasetBase):
+    """Load-then-shuffle dataset (reference dataset.py:352)."""
+
+    def __init__(self):
+        super().__init__()
+        self._memory: list = []
+        self._preload_thread: Optional[threading.Thread] = None
+        self._epoch_seed = 0
+
+    def init(self, **kwargs):
+        super().init(**kwargs)
+
+    def update_settings(self, **kwargs):
+        super().init(**kwargs)
+
+    def load_into_memory(self, is_shuffle=False):
+        self._memory = []
+        for path in self.filelist:
+            self._memory.extend(self._read_file(path))
+        if is_shuffle:
+            self.local_shuffle()
+
+    def preload_into_memory(self, thread_num=None):
+        """Async load (reference preload_into_memory/wait_preload_done)."""
+        self._preload_thread = threading.Thread(
+            target=self.load_into_memory, daemon=True)
+        self._preload_thread.start()
+
+    def wait_preload_done(self):
+        if self._preload_thread is not None:
+            self._preload_thread.join()
+            self._preload_thread = None
+
+    def local_shuffle(self):
+        rng = np.random.RandomState(self._epoch_seed)
+        self._epoch_seed += 1
+        rng.shuffle(self._memory)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        """Across-trainer shuffle. Single-controller substrate: every rank
+        sees the global array store, so a seeded permutation IS the global
+        shuffle; with a fleet handle the seed is agreed via its util
+        barrier (reference exchanges examples over the PS network)."""
+        if fleet is not None and hasattr(fleet, "barrier_worker"):
+            fleet.barrier_worker()
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._memory = []
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._memory)
+
+    def get_shuffle_data_size(self, fleet=None):
+        return len(self._memory)
+
+    def __iter__(self):
+        return self._batches_from(self._memory)
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: files are parsed on the fly, nothing is
+    retained (reference dataset.py:1295)."""
+
+    def __iter__(self):
+        for path in self.filelist:
+            yield from self._batches_from(self._read_file(path))
+
+
+class FileInstantDataset(QueueDataset):
+    """(reference dataset.py:1340 — QueueDataset variant whose reader
+    consumes whole files per instant; same streaming semantics here)"""
+
+
+class BoxPSDataset(InMemoryDataset):
+    """(reference dataset.py:1365 — InMemoryDataset + BoxPS accelerator
+    hooks; the pass begin/end hooks are no-ops on this substrate)"""
+
+    def begin_pass(self):
+        pass
+
+    def end_pass(self, need_save_delta=False):
+        pass
+
+    def wait_feed_pass_done(self):
+        pass
+
+    def slots_shuffle(self, slots):
+        self.local_shuffle()

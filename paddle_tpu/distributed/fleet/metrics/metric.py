@@ -1,0 +1,94 @@
+"""Fleet global metrics (parity: fleet/metrics/metric.py — numpy-in,
+numpy-out aggregation across trainers). Aggregation rides the fleet
+util's object collectives when a parallel env with >1 ranks is up;
+single-process (and the single-controller global-array substrate, where
+every rank computes on the global batch already) is the identity."""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+
+__all__ = ["sum", "max", "min", "auc", "mae", "rmse", "mse", "acc"]
+
+
+def _coerce(x):
+    if hasattr(x, "numpy"):
+        x = x.numpy()
+    return np.asarray(x)
+
+
+def _all_reduce(arr: np.ndarray, mode: str, util=None) -> np.ndarray:
+    if util is not None and hasattr(util, "all_reduce"):
+        return np.asarray(util.all_reduce(arr, mode)).reshape(arr.shape)
+    from ... import parallel as _par
+    if getattr(_par, "get_world_size", lambda: 1)() > 1:
+        from ...communication_impl import all_gather_object
+        try:
+            parts: list = []
+            all_gather_object(parts, arr)
+            stack = np.stack([np.asarray(p) for p in parts])
+            op = {"sum": np.sum, "max": np.amax, "min": np.amin}[mode]
+            return op(stack, axis=0)
+        except Exception:  # no live comm group: local value is global
+            pass
+    return arr
+
+
+def sum(input, scope=None, util=None):
+    """Distributed sum (reference metric.py:26)."""
+    a = _coerce(input)
+    return _all_reduce(a, "sum", util)
+
+
+def max(input, scope=None, util=None):
+    a = _coerce(input)
+    return _all_reduce(a, "max", util)
+
+
+def min(input, scope=None, util=None):
+    a = _coerce(input)
+    return _all_reduce(a, "min", util)
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Global AUC from positive/negative prediction-bucket stats
+    (reference metric.py:149: the distributed streaming-AUC buckets)."""
+    pos = _all_reduce(_coerce(stat_pos).astype(np.float64), "sum", util)
+    neg = _all_reduce(_coerce(stat_neg).astype(np.float64), "sum", util)
+    pos, neg = pos.reshape(-1), neg.reshape(-1)
+    # walk buckets from highest score down, accumulating the ROC integral
+    tot_pos = tot_neg = 0.0
+    area = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_pos = tot_pos + pos[i]
+        new_neg = tot_neg + neg[i]
+        area += neg[i] * (tot_pos + new_pos) / 2.0
+        tot_pos, tot_neg = new_pos, new_neg
+    if tot_pos == 0.0 or tot_neg == 0.0:
+        return 0.5
+    return float(area / (tot_pos * tot_neg))
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    e = float(np.sum(_all_reduce(_coerce(abserr), "sum", util)))
+    n = float(np.sum(_all_reduce(_coerce(total_ins_num), "sum", util)))
+    return e / builtins.max(n, 1.0)
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    e = float(np.sum(_all_reduce(_coerce(sqrerr), "sum", util)))
+    n = float(np.sum(_all_reduce(_coerce(total_ins_num), "sum", util)))
+    return (e / builtins.max(n, 1.0)) ** 0.5
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None):
+    e = float(np.sum(_all_reduce(_coerce(sqrerr), "sum", util)))
+    n = float(np.sum(_all_reduce(_coerce(total_ins_num), "sum", util)))
+    return e / builtins.max(n, 1.0)
+
+
+def acc(correct, total, scope=None, util=None):
+    c = float(np.sum(_all_reduce(_coerce(correct), "sum", util)))
+    t = float(np.sum(_all_reduce(_coerce(total), "sum", util)))
+    return c / builtins.max(t, 1.0)

@@ -1,0 +1,35 @@
+"""distributed_scaler (parity: fleet/scaler.py:28): wrap a GradScaler so
+found-inf detection is agreed ACROSS the hybrid-parallel group before the
+skip/step decision — a rank seeing inf must make every rank skip, or the
+replicas diverge.
+
+Single-controller note: gradients here are global jax arrays, so a local
+finite-check already sees every shard's values; the cross-rank max is a
+semantic no-op but is still routed through the comm group when one is
+alive (keeping the reference's behavior observable under tests)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["distributed_scaler"]
+
+
+def distributed_scaler(scaler):
+    inner_unscale = scaler.unscale_
+
+    def unscale_(optimizer):
+        inner_unscale(optimizer)
+        found = bool(getattr(scaler, "_found_inf", False))
+        from .. import parallel as _par
+        if getattr(_par, "get_world_size", lambda: 1)() > 1:
+            from ..communication_impl import all_gather_object
+            try:
+                parts: list = []
+                all_gather_object(parts, np.asarray(found))
+                found = bool(np.any(np.stack(parts)))
+            except Exception:
+                pass
+        scaler._found_inf = found
+
+    scaler.unscale_ = unscale_
+    return scaler

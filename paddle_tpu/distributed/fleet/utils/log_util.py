@@ -1,0 +1,36 @@
+"""Fleet logging helpers (parity: fleet/utils/log_util.py)."""
+from __future__ import annotations
+
+import logging
+
+__all__ = ["logger", "set_log_level", "get_log_level_code",
+           "get_log_level_name", "layer_to_str"]
+
+logger = logging.getLogger("paddle_tpu.distributed.fleet")
+if not logger.handlers:
+    h = logging.StreamHandler()
+    h.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+    logger.addHandler(h)
+logger.setLevel(logging.INFO)
+
+
+def set_log_level(level):
+    if isinstance(level, int):
+        logger.setLevel(level)
+    else:
+        logger.setLevel(str(level).upper())
+
+
+def get_log_level_code():
+    return logger.getEffectiveLevel()
+
+
+def get_log_level_name():
+    return logging.getLevelName(get_log_level_code())
+
+
+def layer_to_str(base, *args, **kwargs):
+    parts = [str(a) for a in args]
+    parts += [f"{k}={v}" for k, v in kwargs.items()]
+    return f"{base}({', '.join(parts)})"

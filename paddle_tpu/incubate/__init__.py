@@ -212,3 +212,42 @@ from . import autograd  # noqa: E402,F401
 from . import autotune  # noqa: E402,F401
 from . import multiprocessing  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401
+
+
+# -- indexed RNG-state management (parity: incubate/framework/random.py) --
+def get_rng_state(device=None, use_index=False):
+    """All generator states of the device — or their registry indices with
+    ``use_index=True`` (reference incubate/framework/random.py:34)."""
+    from ..core import random as _random
+    if use_index:
+        return [_random.default_generator.get_state_index()]
+    return [_random.default_generator.get_state()]
+
+
+def set_rng_state(state_list, device=None, use_index=False):
+    """(reference incubate/framework/random.py:77)"""
+    from ..core import random as _random
+    if not isinstance(state_list, (list, tuple)) or len(state_list) != 1:
+        raise ValueError("Length of state list should be equal to 1")
+    if use_index:
+        _random.default_generator.set_state_index(int(state_list[0]))
+    else:
+        _random.default_generator.set_state(state_list[0])
+
+
+def register_rng_state_as_index(state_list=None, device=None):
+    """Bank generator states into the indexed registry; returns the new
+    indices (reference incubate/framework/random.py:159)."""
+    from ..core import random as _random
+    if state_list is None:
+        state_list = get_rng_state(device)
+    return [_random.default_generator.register_state_index(s)
+            for s in state_list]
+
+
+# DistributedFusedLamb (reference incubate/optimizer/distributed_fused_lamb.py):
+# the CUDA fused multi-tensor LAMB. On the XLA substrate the jitted update
+# sweep already fuses across parameters, so the semantics ARE Lamb's; the
+# distributed sharding of optimizer states maps onto shard_optimizer.
+from ..optimizer.optimizer import Lamb as DistributedFusedLamb  # noqa: E402,F401
+from ..distributed import fleet  # noqa: E402,F401

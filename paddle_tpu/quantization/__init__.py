@@ -440,3 +440,10 @@ def quanter(name):
         setattr(mod, name, factory)
         return cls
     return deco
+
+from .imperative import (BaseQuantizer, AbsmaxQuantizer,  # noqa: E402,F401
+                         PerChannelAbsmaxQuantizer, HistQuantizer,
+                         KLQuantizer, PTQConfig, default_ptq_config,
+                         ImperativePTQ, ImperativeQuantAware,
+                         SUPPORT_ACT_QUANTIZERS, SUPPORT_WT_QUANTIZERS,
+                         PTQRegistry)

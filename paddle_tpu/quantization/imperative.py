@@ -1,0 +1,288 @@
+"""Legacy imperative PTQ/QAT surface (parity:
+python/paddle/quantization/imperative/ — ImperativePTQ + the PTQ
+quantizer zoo). Built over this package's observer machinery; thresholds
+are computed in NumPy on host (calibration is a host-side pass in the
+reference too).
+"""
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+from .. import nn as _nn
+
+__all__ = ["BaseQuantizer", "AbsmaxQuantizer", "PerChannelAbsmaxQuantizer",
+           "HistQuantizer", "KLQuantizer", "PTQConfig", "default_ptq_config",
+           "ImperativePTQ", "ImperativeQuantAware",
+           "SUPPORT_ACT_QUANTIZERS", "SUPPORT_WT_QUANTIZERS",
+           "PTQRegistry"]
+
+
+def abs_max_value(tensor):
+    return float(np.max(np.abs(np.asarray(
+        tensor._data if hasattr(tensor, "_data") else tensor))))
+
+
+class BaseQuantizer(metaclass=abc.ABCMeta):
+    """(reference ptq_quantizer.py:95) — sample values during
+    calibration, then cal_thresholds() fixes the quant threshold."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self.thresholds: list = []
+
+    @abc.abstractmethod
+    def sample_data(self, layer, tensors):
+        ...
+
+    @abc.abstractmethod
+    def cal_thresholds(self):
+        ...
+
+
+class AbsmaxQuantizer(BaseQuantizer):
+    """Running abs-max over calibration batches (ptq_quantizer.py:119)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self.abs_max_vals: list = []
+
+    def sample_data(self, layer, tensors):
+        if not isinstance(tensors, (list, tuple)):
+            tensors = (tensors,)
+        vals = [abs_max_value(t) for t in tensors]
+        if not self.abs_max_vals:
+            self.abs_max_vals = vals
+        else:
+            self.abs_max_vals = [max(o, n) for o, n in
+                                 zip(self.abs_max_vals, vals)]
+
+    def cal_thresholds(self):
+        self.thresholds = list(self.abs_max_vals)
+
+
+class PerChannelAbsmaxQuantizer(BaseQuantizer):
+    """Per-output-channel abs-max for weights (ptq_quantizer.py:137)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self.abs_max_vals: list = []
+
+    def sample_data(self, layer, tensors):
+        if not isinstance(tensors, (list, tuple)):
+            tensors = (tensors,)
+        vals = []
+        for t in tensors:
+            arr = np.asarray(t._data if hasattr(t, "_data") else t)
+            # Linear weights are (in, out): channel axis is the last
+            flat = np.abs(arr.reshape(-1, arr.shape[-1]))
+            vals.append(flat.max(axis=0).tolist())
+        self.abs_max_vals = vals
+
+    def cal_thresholds(self):
+        self.thresholds = list(self.abs_max_vals)
+
+
+class BaseHistQuantizer(BaseQuantizer, metaclass=abc.ABCMeta):
+    def __init__(self, quant_bits=8, bins=1024):
+        super().__init__(quant_bits)
+        self.bins = bins
+        self.hists: list = []
+        self.abs_max_vals: list = []
+
+    def sample_data(self, layer, tensors):
+        if not isinstance(tensors, (list, tuple)):
+            tensors = (tensors,)
+        for i, t in enumerate(tensors):
+            arr = np.abs(np.asarray(
+                t._data if hasattr(t, "_data") else t)).ravel()
+            amax = float(arr.max()) if arr.size else 0.0
+            if len(self.hists) <= i:
+                self.abs_max_vals.append(max(amax, 1e-8))
+                h, _ = np.histogram(arr, bins=self.bins,
+                                    range=(0, self.abs_max_vals[i]))
+                self.hists.append(h.astype(np.float64))
+            else:
+                if amax > self.abs_max_vals[i]:
+                    # re-bin the old histogram onto the wider range
+                    ratio = self.abs_max_vals[i] / amax
+                    old = self.hists[i]
+                    new = np.zeros_like(old)
+                    idx = (np.arange(self.bins) * ratio).astype(int)
+                    np.add.at(new, np.clip(idx, 0, self.bins - 1), old)
+                    self.hists[i] = new
+                    self.abs_max_vals[i] = amax
+                h, _ = np.histogram(arr, bins=self.bins,
+                                    range=(0, self.abs_max_vals[i]))
+                self.hists[i] += h
+
+
+class HistQuantizer(BaseHistQuantizer):
+    """Percentile-of-histogram threshold (ptq_quantizer.py:218)."""
+
+    def __init__(self, quant_bits=8, bins=1024, upsample_bins=64,
+                 hist_percent=0.99999):
+        super().__init__(quant_bits, bins)
+        self.hist_percent = hist_percent
+
+    def cal_thresholds(self):
+        self.thresholds = []
+        for h, amax in zip(self.hists, self.abs_max_vals):
+            total = h.sum()
+            if total == 0:
+                self.thresholds.append(amax)
+                continue
+            cum = np.cumsum(h) / total
+            idx = int(np.searchsorted(cum, self.hist_percent))
+            self.thresholds.append(
+                (idx + 0.5) * amax / self.bins)
+
+
+class KLQuantizer(BaseHistQuantizer):
+    """KL-divergence-optimal threshold (ptq_quantizer.py:245 — the
+    TensorRT-style calibration): pick the clip bin whose quantized
+    distribution diverges least from the observed one."""
+
+    def cal_thresholds(self):
+        self.thresholds = []
+        levels = 2 ** (self.quant_bits - 1)
+        for h, amax in zip(self.hists, self.abs_max_vals):
+            if h.sum() == 0:
+                self.thresholds.append(amax)
+                continue
+            best_kl, best_i = float("inf"), self.bins - 1
+            for i in range(levels, self.bins):
+                p = h[:i].copy()
+                p[-1] += h[i:].sum()          # clip tail into last bin
+                p /= p.sum()
+                # quantize the i bins down to `levels` buckets
+                factor = i / levels
+                q = np.zeros(i)
+                for b in range(levels):
+                    lo, hi = int(b * factor), max(int((b + 1) * factor), 1)
+                    seg = h[lo:hi]
+                    nz = (seg > 0).sum()
+                    if nz:
+                        q[lo:hi] = np.where(seg > 0, seg.sum() / nz, 0)
+                if q.sum() == 0:
+                    continue
+                q /= q.sum()
+                mask = p > 0
+                kl = float(np.sum(p[mask] * np.log(
+                    p[mask] / np.maximum(q[mask], 1e-12))))
+                if kl < best_kl:
+                    best_kl, best_i = kl, i
+            self.thresholds.append((best_i + 0.5) * amax / self.bins)
+
+
+SUPPORT_ACT_QUANTIZERS = [AbsmaxQuantizer, HistQuantizer, KLQuantizer]
+SUPPORT_WT_QUANTIZERS = [AbsmaxQuantizer, PerChannelAbsmaxQuantizer]
+
+
+class PTQConfig:
+    """(reference ptq_config.py:25)"""
+
+    def __init__(self, activation_quantizer=None, weight_quantizer=None):
+        act = activation_quantizer or KLQuantizer()
+        wt = weight_quantizer or PerChannelAbsmaxQuantizer()
+        if not isinstance(act, tuple(SUPPORT_ACT_QUANTIZERS)):
+            raise ValueError(
+                f"activation_quantizer {type(act).__name__} not supported")
+        if not isinstance(wt, tuple(SUPPORT_WT_QUANTIZERS)):
+            raise ValueError(
+                f"weight_quantizer {type(wt).__name__} not supported")
+        self.in_act_quantizer = act
+        self.wt_quantizer = wt
+
+
+def default_ptq_config():
+    return PTQConfig(KLQuantizer(), PerChannelAbsmaxQuantizer())
+
+
+class PTQRegistry:
+    """Quantizable-layer registry (reference ptq_registry.py); Linear is
+    the quantized surface on this substrate."""
+
+    @classmethod
+    def is_supported_layer(cls, layer):
+        return isinstance(layer, _nn.Linear)
+
+
+class _CalibratedLinear(_nn.Layer):
+    def __init__(self, linear, cfg: PTQConfig):
+        super().__init__()
+        self.linear = linear
+        import copy
+        self.act_quantizer = copy.deepcopy(cfg.in_act_quantizer)
+        self.wt_quantizer = copy.deepcopy(cfg.wt_quantizer)
+        self.wt_quantizer.sample_data(linear, (linear.weight,))
+
+    def forward(self, x):
+        self.act_quantizer.sample_data(self.linear, (x,))
+        return self.linear(x)
+
+
+class ImperativePTQ:
+    """(reference imperative/ptq.py:42): quantize() inserts calibration
+    wrappers; after running calibration batches, save_quantized_model
+    fixes thresholds and exports through jit.save."""
+
+    def __init__(self, quant_config=None):
+        if callable(quant_config) and not isinstance(quant_config,
+                                                     PTQConfig):
+            quant_config = quant_config()
+        self._config = quant_config or default_ptq_config()
+
+    def quantize(self, model, inplace=False, fuse=False, fuse_list=None):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        self._insert(model)
+        return model
+
+    def _insert(self, layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if PTQRegistry.is_supported_layer(sub):
+                layer.add_sublayer(name, _CalibratedLinear(sub,
+                                                           self._config))
+            else:
+                self._insert(sub)
+
+    def save_quantized_model(self, model, path, input_spec=None, **config):
+        # fix thresholds, unwrap to frozen fake-quant layers, export
+        from . import _FrozenQuantLinear
+        self._freeze(model)
+        from ..jit import save as jit_save
+        jit_save(model, path, input_spec=input_spec)
+        return model
+
+    def _freeze(self, layer):
+        from . import _FrozenQuantLinear
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, _CalibratedLinear):
+                sub.act_quantizer.cal_thresholds()
+                thr = (sub.act_quantizer.thresholds or [1.0])[0]
+                layer.add_sublayer(
+                    name, _FrozenQuantLinear(sub.linear, float(thr)))
+            else:
+                self._freeze(sub)
+
+
+class ImperativeQuantAware:
+    """(reference imperative/qat.py ImperativeQuantAware): insert fake
+    quant/dequant into Linear layers for QAT, export via jit.save."""
+
+    def __init__(self, quantizable_layer_type=("Linear",),
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, **kwargs):
+        from . import QAT, QuantConfig
+        self._qat = QAT(QuantConfig(activation=None, weight=None))
+
+    def quantize(self, model):
+        return self._qat.quantize(model, inplace=True)
+
+    def save_quantized_model(self, layer, path, input_spec=None, **config):
+        from ..jit import save as jit_save
+        jit_save(layer, path, input_spec=input_spec)

@@ -30,7 +30,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["Variable", "Program", "Executor", "data", "program_guard",
+__all__ = ["Variable", "Program", "Executor", "Operator", "Parameter",
+           "Scope", "data", "program_guard",
            "default_main_program", "default_startup_program",
            "enable_static", "disable_static", "in_static_mode", "scope_guard",
            "global_scope", "name_scope", "InputSpec"]
@@ -451,9 +452,61 @@ def _reachable(targets):
 
 # -- misc parity shims -------------------------------------------------------
 
-class _Scope(dict):
-    pass
+class _TensorSlot:
+    """Live view of one scope entry: reads always see the current value,
+    ``set`` writes back — the reference's
+    ``scope.var(name).get_tensor().set(arr, place)`` idiom."""
 
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def set(self, value, place=None):
+        self._scope[self._name] = np.asarray(value)
+
+    def value(self):
+        return self._scope.get(self._name)
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._scope.get(self._name))
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def shape(self):
+        v = self._scope.get(self._name)
+        return list(np.shape(v)) if v is not None else []
+
+
+class _Scope(dict):
+    """Variable-name -> value scope (parity: paddle.static.Scope — the
+    C++ scope tree collapses to one dict level per scope; var/find_var
+    hand out LIVE holders, never snapshots)."""
+
+    class _Var:
+        def __init__(self, scope, name):
+            self._scope = scope
+            self._name = name
+
+        @property
+        def name(self):
+            return self._name
+
+        def get_tensor(self):
+            return _TensorSlot(self._scope, self._name)
+
+    def var(self, name):
+        self.setdefault(name, None)
+        return self._Var(self, name)
+
+    def find_var(self, name):
+        if name not in self:
+            return None
+        return self._Var(self, name)
+
+    def new_scope(self):
+        return _Scope()
+
+
+Scope = _Scope
 
 _SCOPE = [_Scope()]
 
@@ -498,5 +551,13 @@ from .extras import (append_backward, gradients, BuildStrategy,  # noqa: E402,F4
                      normalize_program, load_program_state,
                      set_program_state, cpu_places, cuda_places,
                      xpu_places, create_global_var, create_parameter,
-                     accuracy, auc, device_guard, ctr_metric_bundle)
+                     accuracy, auc, device_guard, ctr_metric_bundle,
+                     save_vars, load_vars, is_persistable)
 from . import nn  # noqa: E402,F401
+
+# path-faithful aliases: the recorded OpNode IS the reference's Operator
+# (one OpDesc), and static Parameters are the nn Parameter objects the
+# recorder captures (base/framework.py Operator/Parameter)
+Operator = OpNode
+from ..nn.parameter import Parameter  # noqa: E402,F401
+from .. import amp  # noqa: E402,F401  (static.amp: same decorate/GradScaler surface)

@@ -24,7 +24,8 @@ __all__ = [
     "deserialize_persistables", "load_from_file", "normalize_program",
     "load_program_state", "set_program_state", "cpu_places", "cuda_places",
     "xpu_places", "create_global_var", "create_parameter", "accuracy",
-    "auc", "device_guard", "ctr_metric_bundle",
+    "auc", "device_guard", "ctr_metric_bundle", "save_vars", "load_vars",
+    "is_persistable",
 ]
 
 
@@ -595,3 +596,79 @@ def ctr_metric_bundle(input, label):
             jnp.asarray([pr.shape[0]], jnp.float32)
     return run_op("ctr_metric_bundle", fn, (input, label),
                   out_stop_gradient=True)
+
+
+# -- var-level save/load (parity: static.save_vars/load_vars/
+# is_persistable, base/framework Operator/Parameter surface) --------------
+
+def is_persistable(var):
+    """True for vars that outlive a step: captured parameters/buffers
+    (reference io_utils.py is_persistable checks var.persistable)."""
+    if getattr(var, "persistable", None) is not None:
+        return bool(var.persistable)
+    # recorded-program vars: parameters are the captured concrete tensors
+    from ..core.tensor import Tensor
+    return isinstance(var, Tensor)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Save selected program vars (reference static/io.py save_vars):
+    ``vars`` explicitly, else every program parameter passing
+    ``predicate``."""
+    import os
+    import pickle
+    prog = main_program or _prog()
+    if vars is None:
+        vars = [p for p in prog.parameters()
+                if predicate is None or predicate(p)]
+    state = {}
+    for i, t in enumerate(vars):
+        if not getattr(t, "name", None):
+            t.name = f"__static_v{i}"
+        state[t.name] = np.asarray(t._data)
+    os.makedirs(dirname, exist_ok=True)
+    if filename is not None:
+        with open(os.path.join(dirname, filename), "wb") as f:
+            pickle.dump(state, f)
+    else:
+        for name, arr in state.items():
+            with open(os.path.join(dirname, name), "wb") as f:
+                pickle.dump({name: arr}, f)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Load vars saved by save_vars back into the program's captured
+    tensors (matched by name)."""
+    import os
+    import pickle
+    import jax.numpy as jnp
+    prog = main_program or _prog()
+    if vars is None:
+        vars = [p for p in prog.parameters()
+                if predicate is None or predicate(p)]
+    # mirror save_vars' fallback naming so a fresh process (params not yet
+    # named) matches what was saved
+    for i, t in enumerate(vars):
+        if not getattr(t, "name", None):
+            t.name = f"__static_v{i}"
+    if filename is not None:
+        with open(os.path.join(dirname, filename), "rb") as f:
+            state = pickle.load(f)
+    else:
+        state = {}
+        for t in vars:
+            path = os.path.join(dirname, t.name)
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"load_vars: no saved file for var '{t.name}' under "
+                    f"{dirname}")
+            with open(path, "rb") as f:
+                state.update(pickle.load(f))
+    missing = [t.name for t in vars if t.name not in state]
+    if missing:
+        raise KeyError(
+            f"load_vars: saved state has no entry for vars {missing}")
+    for t in vars:
+        t._data = jnp.asarray(state[t.name])

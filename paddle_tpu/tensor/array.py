@@ -61,8 +61,8 @@ def array_write(x, i, array=None):
         array = []
     assert isinstance(array, list), \
         "The 'array' in array_write must be a list in dygraph mode"
-    assert idx <= len(array), \
-        "The index 'i' should not be greater than the length of 'array'"
+    assert 0 <= idx <= len(array), \
+        "The index 'i' should be in [0, len(array)] in array_write"
     if idx < len(array):
         array[idx] = x
     else:

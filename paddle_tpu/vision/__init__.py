@@ -4,8 +4,10 @@ from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import ops  # noqa: F401
 from .models import (ResNet, resnet18, resnet34, resnet50,  # noqa: F401
-                     resnet101, resnet152, LeNet, VGG, vgg16,
+                     resnet101, resnet152, LeNet, VGG, vgg11, vgg13,
+                     vgg16, vgg19, wide_resnet50_2, wide_resnet101_2,
                      MobileNetV2, mobilenet_v2)
+from .. import nn  # noqa: F401 (the reference re-exports paddle.nn here)
 from .models import (AlexNet, DenseNet, GoogLeNet, InceptionV3,  # noqa: F401
                      MobileNetV1, MobileNetV3Large, MobileNetV3Small,
                      ShuffleNetV2, SqueezeNet, alexnet, densenet121,
@@ -19,6 +21,20 @@ from .models import (AlexNet, DenseNet, GoogLeNet, InceptionV3,  # noqa: F401
                      shufflenet_v2_x0_33, shufflenet_v2_x1_0,
                      shufflenet_v2_x1_5, shufflenet_v2_x2_0,
                      squeezenet1_0, squeezenet1_1)
+# top-level re-exports (the reference flattens datasets + transforms into
+# paddle.vision, vision/__init__.py:23,91)
+from .datasets import (Cifar10, Cifar100, DatasetFolder,  # noqa: F401
+                       FashionMNIST, Flowers, ImageFolder, MNIST, VOC2012)
+from .transforms import (BaseTransform, BrightnessTransform,  # noqa: F401
+                         CenterCrop, ColorJitter, Compose,
+                         ContrastTransform, Grayscale, HueTransform,
+                         Normalize, Pad, RandomCrop, RandomErasing,
+                         RandomHorizontalFlip, RandomResizedCrop,
+                         RandomRotation, RandomVerticalFlip, Resize,
+                         SaturationTransform, ToTensor, Transpose,
+                         adjust_brightness, adjust_contrast, adjust_hue,
+                         center_crop, crop, hflip, normalize, pad, resize,
+                         rotate, to_grayscale, to_tensor, vflip)
 
 
 

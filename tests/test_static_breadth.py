@@ -476,3 +476,46 @@ class TestReviewRegressions:
         out = [None]
         paddle.distributed.scatter_object_list(out, [1, 2, 3], src=0)
         assert out == [1, 2, 3]
+
+
+class TestScopeAndVarIO:
+    """static.Scope live holders + save_vars/load_vars (r3 review: holders
+    must read live values and support the get_tensor().set() idiom)."""
+
+    def test_scope_live_read_and_set(self):
+        from paddle_tpu.static import Scope
+        sc = Scope()
+        slot = sc.var("w").get_tensor()
+        slot.set(np.ones((2, 2)))
+        np.testing.assert_array_equal(np.array(sc.find_var("w").get_tensor()),
+                                      1.0)
+        sc["w"] = np.full((2, 2), 7.0)  # live: holder sees the new value
+        np.testing.assert_array_equal(np.array(slot), 7.0)
+        assert sc.find_var("nope") is None
+
+    def test_save_load_vars_roundtrip_and_errors(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu import static
+        paddle.enable_static()
+        try:
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data('x', [None, 4], 'float32')
+                paddle.seed(0)
+                lin = paddle.nn.Linear(4, 2)
+                lin(x)
+            exe = static.Executor()
+            exe.run(startup)
+            static.save_vars(exe, str(tmp_path), main, filename="all.pk")
+            orig = [np.asarray(p._data).copy() for p in main.parameters()]
+            for p in main.parameters():
+                p._data = p._data * 0
+            static.load_vars(exe, str(tmp_path), main, filename="all.pk")
+            for p, o in zip(main.parameters(), orig):
+                np.testing.assert_array_equal(np.asarray(p._data), o)
+            assert static.is_persistable(main.parameters()[0])
+            # missing per-var file raises instead of silently skipping
+            with pytest.raises(FileNotFoundError):
+                static.load_vars(exe, str(tmp_path / "nope"), main)
+        finally:
+            paddle.disable_static()
