@@ -281,64 +281,13 @@ class ProbabilityEntry(_EntryAttr):
         return f"probability_entry:{self._probability}"
 
 
-class QueueDataset:
-    """Streaming file-fed dataset (parity: paddle.distributed.QueueDataset
-    — the reference feeds an async C++ pipeline; here a generator over
-    files consumed by the DataLoader)."""
-
-    def __init__(self):
-        self._filelist = []
-        self._pipe_command = None
-        self._batch_size = 1
-        self._thread_num = 1
-
-    def init(self, batch_size=1, thread_num=1, pipe_command=None,
-             use_var=None, **kwargs):
-        self._batch_size = batch_size
-        self._thread_num = thread_num
-        self._pipe_command = pipe_command
-
-    def set_filelist(self, filelist):
-        self._filelist = list(filelist)
-
-    def _iter_lines(self):
-        for path in self._filelist:
-            with open(path) as f:
-                for line in f:
-                    yield line.rstrip("\n")
-
-    def __iter__(self):
-        return self._iter_lines()
-
-
-class InMemoryDataset(QueueDataset):
-    """(parity: paddle.distributed.InMemoryDataset — loads into memory,
-    supports shuffle before feeding)."""
-
-    def __init__(self):
-        super().__init__()
-        self._samples = []
-
-    def load_into_memory(self):
-        self._samples = list(self._iter_lines())
-
-    def local_shuffle(self):
-        rng = np.random.default_rng(0)
-        rng.shuffle(self._samples)
-
-    def global_shuffle(self, fleet=None, thread_num=12):
-        self.local_shuffle()
-
-    def release_memory(self):
-        self._samples = []
-
-    def get_memory_data_size(self, fleet=None):
-        return len(self._samples)
-
-    def __iter__(self):
-        if self._samples:
-            return iter(self._samples)
-        return self._iter_lines()
+# QueueDataset / InMemoryDataset / friends: ONE implementation — the
+# fleet MultiSlot engine (fleet/dataset.py) backs both the
+# paddle.distributed and paddle.distributed.fleet export paths (it
+# degrades to raw-line streaming when init() gets no use_var).
+from .fleet.dataset import (DatasetBase, InMemoryDataset,  # noqa: E402,F401
+                            QueueDataset, FileInstantDataset,
+                            BoxPSDataset)
 
 
 def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,

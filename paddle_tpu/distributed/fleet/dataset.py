@@ -75,17 +75,25 @@ class DatasetBase:
 
     # -- engine ------------------------------------------------------------
     def _read_file(self, path: str):
-        """Run ``pipe_command`` over one file, parse MultiSlot lines into
-        per-example slot lists."""
+        """Run ``pipe_command`` over one file. With ``use_var`` set, parse
+        MultiSlot lines into per-example slot lists; without it, records
+        are the raw lines (the line-stream mode downstream DataLoaders
+        consume). A filter pipe matching nothing (exit 1, empty output —
+        grep's contract) yields zero records; other failures raise."""
         cmd = self.proto_desc["pipe_command"]
         with open(path, "rb") as f:
-            out = subprocess.run(cmd, shell=True, stdin=f,
-                                 capture_output=True, check=True).stdout
+            r = subprocess.run(cmd, shell=True, stdin=f,
+                               capture_output=True)
+        if r.returncode != 0 and not (r.returncode == 1 and not r.stdout):
+            raise RuntimeError(
+                f"pipe_command {cmd!r} failed (exit {r.returncode}) on "
+                f"{path}: {r.stderr.decode(errors='replace')[-300:]}")
+        lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+        if not self._slot_names:
+            return lines
         records = []
-        for line in out.decode().splitlines():
+        for line in lines:
             toks = line.split()
-            if not toks:
-                continue
             rec, i = [], 0
             for dt in self._slot_dtypes:
                 n = int(toks[i]); i += 1
@@ -96,6 +104,9 @@ class DatasetBase:
         return records
 
     def _batches_from(self, records):
+        if not self._slot_names:   # raw-line mode: yield lines directly
+            yield from records
+            return
         bs = self.proto_desc["batch_size"]
         for lo in range(0, len(records) - bs + 1, bs):
             chunk = records[lo:lo + bs]

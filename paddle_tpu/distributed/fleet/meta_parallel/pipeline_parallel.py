@@ -62,7 +62,14 @@ def _unwrap(x):
 
 
 class PipelineParallel:
-    def __init__(self, layers, hcg=None, strategy=None, devices=None):
+    def __init__(self, layers, hcg=None, strategy=None, devices=None,
+                 stage_mesh_axes=None, batch_axis=None):
+        """``stage_mesh_axes``: optional named shape for each stage's
+        sub-mesh, e.g. ``{"dp": 2, "tp": 2}`` — the hybrid pp x tp x dp
+        topology of the reference's HybridCommunicateGroup (§3.3 north
+        star). Stage params pre-sharded over those axes keep their layout;
+        ``batch_axis`` names the axis microbatch activations shard over
+        (data parallelism within each stage)."""
         if not isinstance(layers, PipelineLayer):
             raise TypeError("PipelineParallel requires a PipelineLayer")
         self._layers = layers
@@ -78,6 +85,12 @@ class PipelineParallel:
         self._batch_count = 0
         self._programs: Dict = {}  # (chunk, kind, train) -> jitted fn
         self._peak_stash: List[int] = [0] * self.num_chunks
+        self._stage_mesh_axes = dict(stage_mesh_axes or {})
+        self._batch_axis = batch_axis
+        if batch_axis is not None and batch_axis not in self._stage_mesh_axes:
+            raise ValueError(
+                f"batch_axis '{batch_axis}' not in stage_mesh_axes "
+                f"{list(self._stage_mesh_axes)}")
         self._build_meshes(devices)
         self._collect_chunk_params()
         self._place_params()
@@ -89,12 +102,24 @@ class PipelineParallel:
         devs = list(devices) if devices is not None else list(jax.devices())
         p = self.num_stages
         per = len(devs) // p
+        axes = self._stage_mesh_axes
+        if axes:
+            size = int(np.prod(list(axes.values())))
+            if per != size:
+                raise ValueError(
+                    f"stage_mesh_axes {axes} needs {size} devices/stage, "
+                    f"have {per} ({len(devs)} over {p} stages)")
         self._stage_meshes = []
         for s in range(p):
             sub = (devs[s * per:(s + 1) * per] if per >= 1
                    else [devs[s % len(devs)]])
-            self._stage_meshes.append(
-                Mesh(np.array(sub), ("stage_data",)))
+            if axes:
+                self._stage_meshes.append(Mesh(
+                    np.array(sub).reshape(tuple(axes.values())),
+                    tuple(axes)))
+            else:
+                self._stage_meshes.append(
+                    Mesh(np.array(sub), ("stage_data",)))
         self._stage_shardings = [
             NamedSharding(m, PartitionSpec()) for m in self._stage_meshes]
         # expose placements so the stateful PipelineLayer.forward can hop
@@ -294,8 +319,18 @@ class PipelineParallel:
 
     def _transfer(self, arr, chunk: int):
         """Activation / activation-grad hop onto ``chunk``'s sub-mesh — the
-        p2p edge of the pipeline (reference p2p_communication.py:313)."""
-        sh = self._chunk_sharding(chunk)
+        p2p edge of the pipeline (reference p2p_communication.py:313).
+        With ``batch_axis`` the microbatch rows shard over that stage axis
+        (dp within the stage); otherwise activations replicate."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = self._stage_meshes[self._chunk_mesh_idx(chunk)]
+        ba = self._batch_axis
+        if (ba is not None and getattr(arr, "ndim", 0) >= 1
+                and arr.shape[0] % self._stage_mesh_axes[ba] == 0):
+            sh = NamedSharding(mesh, PartitionSpec(
+                ba, *([None] * (arr.ndim - 1))))
+        else:
+            sh = self._chunk_sharding(chunk)
         if getattr(arr, "sharding", None) == sh:
             return arr
         return jax.device_put(arr, sh)

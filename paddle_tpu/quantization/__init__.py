@@ -260,10 +260,20 @@ class _FrozenQuantLinear(_nn.Layer):
     """Inference-time int8 simulation: activations quant-dequant with the
     frozen observed scale; weights per-out-channel int8."""
 
-    def __init__(self, linear, act_scale: float):
+    def __init__(self, linear, act_scale: float, w_scales=None):
         super().__init__()
         self.act_scale = float(act_scale)
-        qw, scales = weight_quantize(linear.weight)
+        if w_scales is None:
+            qw, scales = weight_quantize(linear.weight)
+        else:
+            # calibrated per-out-channel (or broadcast per-tensor) scales
+            # from the PTQ weight quantizer
+            arr = linear.weight._data
+            scales = Tensor(jnp.broadcast_to(
+                jnp.maximum(jnp.asarray(w_scales, jnp.float32), 1e-8)
+                / 127.0, (arr.shape[-1],)))
+            qw = Tensor(jnp.clip(jnp.round(arr / scales._data[None, :]),
+                                 -128, 127).astype(jnp.int8))
         self.register_buffer("qweight", qw)
         self.register_buffer("wscales", scales)
         self.bias = linear.bias

@@ -251,7 +251,6 @@ class ImperativePTQ:
 
     def save_quantized_model(self, model, path, input_spec=None, **config):
         # fix thresholds, unwrap to frozen fake-quant layers, export
-        from . import _FrozenQuantLinear
         self._freeze(model)
         from ..jit import save as jit_save
         jit_save(model, path, input_spec=input_spec)
@@ -262,23 +261,38 @@ class ImperativePTQ:
         for name, sub in list(layer._sub_layers.items()):
             if isinstance(sub, _CalibratedLinear):
                 sub.act_quantizer.cal_thresholds()
+                sub.wt_quantizer.cal_thresholds()
                 thr = (sub.act_quantizer.thresholds or [1.0])[0]
+                wt = (sub.wt_quantizer.thresholds or [None])[0]
                 layer.add_sublayer(
-                    name, _FrozenQuantLinear(sub.linear, float(thr)))
+                    name, _FrozenQuantLinear(sub.linear, float(thr),
+                                             w_scales=wt))
             else:
                 self._freeze(sub)
 
 
 class ImperativeQuantAware:
     """(reference imperative/qat.py ImperativeQuantAware): insert fake
-    quant/dequant into Linear layers for QAT, export via jit.save."""
+    quant/dequant into Linear layers for QAT, export via jit.save.
+    ``weight_bits``/``activation_bits`` size the fake-quant ranges;
+    'moving_average_abs_max' activations use the running-scale quanter,
+    'abs_max' re-measures per batch (moving_rate 0)."""
 
     def __init__(self, quantizable_layer_type=("Linear",),
                  weight_quantize_type="abs_max",
                  activation_quantize_type="moving_average_abs_max",
-                 weight_bits=8, activation_bits=8, **kwargs):
-        from . import QAT, QuantConfig
-        self._qat = QAT(QuantConfig(activation=None, weight=None))
+                 weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 **kwargs):
+        from . import FakeQuanterWithAbsMax, QAT, QuantConfig
+        act_rate = (moving_rate
+                    if activation_quantize_type == "moving_average_abs_max"
+                    else 0.0)
+        cfg = QuantConfig(
+            activation=lambda: FakeQuanterWithAbsMax(
+                bit_length=activation_bits, moving_rate=act_rate),
+            weight=lambda: FakeQuanterWithAbsMax(
+                bit_length=weight_bits, moving_rate=0.0))
+        self._qat = QAT(cfg)
 
     def quantize(self, model):
         return self._qat.quantize(model, inplace=True)

@@ -602,13 +602,10 @@ def ctr_metric_bundle(input, label):
 # is_persistable, base/framework Operator/Parameter surface) --------------
 
 def is_persistable(var):
-    """True for vars that outlive a step: captured parameters/buffers
-    (reference io_utils.py is_persistable checks var.persistable)."""
-    if getattr(var, "persistable", None) is not None:
-        return bool(var.persistable)
-    # recorded-program vars: parameters are the captured concrete tensors
-    from ..core.tensor import Tensor
-    return isinstance(var, Tensor)
+    """True for vars that outlive a step (reference io_utils.py checks
+    var.persistable): nn Parameters carry persistable=True, plain tensors
+    and symbolic Variables default False."""
+    return bool(getattr(var, "persistable", False))
 
 
 def save_vars(executor, dirname, main_program=None, vars=None,

@@ -776,3 +776,73 @@ class TestShardOp:
                           in_shard_specs=[["zz", None], None])
         with pytest.raises(ValueError, match="zz"):
             f(paddle.ones([4, 8]), paddle.ones([4, 8]))
+
+
+class TestHybridPipelineTPDP:
+    """pp(2) x tp(2) x dp(2) on 8 devices — the reference's north-star
+    hybrid topology (SURVEY §3.3): pipeline stages on disjoint 2x2
+    sub-meshes, stage params TP-sharded, microbatch rows dp-sharded.
+    Oracle: loss parity with the plain unsharded model."""
+
+    def test_3d_hybrid_parity(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            PipelineParallel)
+        paddle.seed(91)
+        loss_fn = paddle.nn.MSELoss()
+        descs = []
+        for _ in range(4):
+            descs.append(LayerDesc(paddle.nn.Linear, 8, 8))
+            descs.append(LayerDesc(paddle.nn.Tanh))
+        pl = PipelineLayer(descs, num_stages=2, loss_fn=loss_fn)
+        paddle.seed(191)
+        plain = PipelineLayer(descs, num_stages=1, loss_fn=loss_fn)
+        plain.set_state_dict(pl.state_dict())
+
+        class _S:
+            pipeline_configs = {"accumulate_steps": 2, "micro_batch_size": 4}
+
+        engine = PipelineParallel(pl, None, _S(),
+                                  stage_mesh_axes={"dp": 2, "tp": 2},
+                                  batch_axis="dp")
+        # each stage's 2-D params become column-parallel over its tp axis
+        for s in range(2):
+            mesh = engine._stage_meshes[s]
+            assert dict(zip(mesh.axis_names, mesh.devices.shape)) == \
+                {"dp": 2, "tp": 2}
+            for lyr in pl.stage_layers(s):
+                for p in lyr.parameters():
+                    if p._data.ndim == 2:
+                        p._data = jax.device_put(
+                            p._data,
+                            NamedSharding(mesh, PartitionSpec(None, "tp")))
+        opt_pp = paddle.optimizer.SGD(0.05, parameters=pl.parameters())
+        opt_pl = paddle.optimizer.SGD(0.05, parameters=plain.parameters())
+        x = _t([8, 8], seed=4)
+        y = _t([8, 8], seed=5)
+        for _ in range(2):
+            loss_pp = engine.train_batch((x, y), opt_pp)
+            loss_plain = loss_fn(plain(x), y)
+            loss_plain.backward()
+            opt_pl.step()
+            opt_pl.clear_grad()
+            np.testing.assert_allclose(float(loss_pp), float(loss_plain),
+                                       rtol=1e-4)
+        # stage sub-meshes stay disjoint under the 2-D topology
+        s0 = {d.id for d in engine._stage_meshes[0].devices.flat}
+        s1 = {d.id for d in engine._stage_meshes[1].devices.flat}
+        assert s0.isdisjoint(s1) and len(s0) == len(s1) == 4
+
+    def test_bad_axes_product_raises(self):
+        from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            PipelineParallel)
+        pl = PipelineLayer([LayerDesc(paddle.nn.Linear, 4, 4)],
+                           num_stages=1, loss_fn=paddle.nn.MSELoss())
+        with pytest.raises(ValueError, match="devices/stage"):
+            PipelineParallel(pl, stage_mesh_axes={"dp": 3, "tp": 2})
+        with pytest.raises(ValueError, match="batch_axis"):
+            PipelineParallel(pl, stage_mesh_axes={"dp": 2, "tp": 4},
+                             batch_axis="zz")
