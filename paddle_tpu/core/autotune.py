@@ -23,7 +23,19 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 
 __all__ = ["enable_autotune", "disable_autotune", "autotune_status",
-           "set_autotune_cache_file", "clear_autotune_cache"]
+           "set_autotune_cache_file", "clear_autotune_cache",
+           "use_artifacts_cache"]
+
+
+def use_artifacts_cache(repo_root: str) -> str:
+    """Enable autotune against the repo's shared on-chip tile cache
+    (<root>/artifacts/autotune_tpu.json) — the one file bench_kernels.py
+    writes and bench.py consults. Returns the path."""
+    import os
+    path = os.path.join(repo_root, "artifacts", "autotune_tpu.json")
+    enable_autotune()
+    set_autotune_cache_file(path)
+    return path
 
 _CACHE: Dict[str, str] = {}
 _CACHE_FILE: Optional[str] = None
@@ -101,23 +113,27 @@ def _measure(fn, args, warmup: int = 1, iters: int = 3):
     return best, out
 
 
-def pick_impl(name: str, impls: Dict[str, Any], arrays, call):
+def pick_impl(name: str, impls: Dict[str, Any], arrays, call,
+              key_arrays=None):
     """Return ``(winner_name, winner_output)`` for this call, measuring
     candidates on a cache miss (concrete arrays only). ``call(impl_name)``
     must run the op with the given impl and return its outputs. Returns
     ``(None, None)`` when autotuning does not apply (disabled, single
     impl, or tracing with an empty cache); a cache hit returns
-    ``(name, None)`` — the caller runs the winner itself."""
+    ``(name, None)`` — the caller runs the winner itself.
+    ``key_arrays``: optional shape surrogates for the cache key when the
+    op's optimum is invariant to a dim of the real arrays (e.g. flash
+    attention tiles vs batch); tracer detection always uses ``arrays``."""
     if not _flag_on() or len(impls) < 2:
         return None, None
     if any(isinstance(a, jax.core.Tracer) for a in arrays):
         # traced call (jit or inside jax.vjp): consult-only
-        k = _key(name, arrays)
+        k = _key(name, key_arrays if key_arrays is not None else arrays)
         choice = _CACHE.get(k)
         if choice is not None:
             _STATS["hits"] += 1
         return choice, None
-    k = _key(name, arrays)
+    k = _key(name, key_arrays if key_arrays is not None else arrays)
     if k in _CACHE:
         _STATS["hits"] += 1
         return _CACHE[k], None

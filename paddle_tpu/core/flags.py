@@ -71,9 +71,13 @@ define_flag("spmd_strict", False,
             "(fallbacks are always counted in dispatch.spmd_rule_stats)")
 define_flag("use_fused_optimizer", True,
             "eager optimizer.step as one jitted multi-tensor XLA program")
-define_flag("pallas_flash_min_seq", 2048,
+define_flag("pallas_flash_min_seq", 1024,
             "kv length at which the pallas flash-attention kernel takes "
-            "over from XLA's fused attention (measured crossover on v5e)")
+            "over from XLA's fused attention. The r2 crossover (2048) was "
+            "measured per-dispatch over the remote tunnel, whose ~10ms "
+            "execute floor swamped the s=1024 case; with the floor "
+            "cancelled the s1k pallas kernel wins ~1.6x fwd and bwd "
+            "(bench_kernels r3), so the default admits s>=1024")
 define_flag("pallas_prefer_ce", False,
             "prefer the pallas fused softmax-CE over XLA's on TPU")
 define_flag("pallas_force_interpret", False,

@@ -1017,7 +1017,12 @@ def _tuned_blocks(q, k, v, bias, seed, causal, scale, rate, interpret):
             jax.device_get(g.ravel()[0])
         return out
 
-    choice, out = _autotune.pick_impl(tag, cands, (q, k), call)
+    # tile optimum is (seq, heads, head-dim)-determined, not batch: key on
+    # batch-1 surrogates so a b8-tuned entry serves the b16/b32 sweep
+    key_arrays = (jax.ShapeDtypeStruct((1,) + tuple(q.shape[1:]), q.dtype),
+                  jax.ShapeDtypeStruct((1,) + tuple(k.shape[1:]), k.dtype))
+    choice, out = _autotune.pick_impl(tag, cands, (q, k), call,
+                                      key_arrays=key_arrays)
     if choice is None or choice not in cands:
         # choice unknown: autotune off / stale persisted entry from an
         # older candidate list — degrade to the safe default, never crash

@@ -503,6 +503,46 @@ class TestAutotuneCache:
             autotune.disable_autotune()
             autotune.clear_autotune_cache()
 
+    def test_tile_key_is_batch_agnostic(self):
+        """flash-attn TILE keys ignore batch (the tile optimum is
+        (seq, heads, head-dim)-determined), so a b1-tuned entry serves
+        larger batches; drives _tuned_blocks for real in interpret mode
+        at a shape with >=2 candidate tilings."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.core import autotune, flags
+        from paddle_tpu.ops.pallas.flash_attention import _tuned_blocks
+
+        autotune.clear_autotune_cache()
+        autotune.enable_autotune()
+        flags.set_flags({"pallas_force_interpret": True})
+        try:
+            rng = np.random.RandomState(0)
+
+            def qkv(b):
+                mk = lambda: jnp.asarray(  # noqa: E731
+                    rng.randn(b, 256, 2, 32), jnp.float32) * 0.1
+                return mk(), mk(), mk()
+
+            seed = jnp.zeros((1,), jnp.int32)
+            q1, k1, v1 = qkv(1)
+            _tuned_blocks(q1, k1, v1, None, seed, True, 0.18, 0.0, True)
+            tiles = sorted(k for k in autotune._CACHE
+                           if k.startswith("flash_attention_blocks"))
+            assert len(tiles) == 1, tiles      # a real measurement ran
+            assert "(1, 256, 2, 32)" in tiles[0]  # batch-1 surrogate key
+            misses = autotune.autotune_status()["misses"]
+            q4, k4, v4 = qkv(4)
+            _tuned_blocks(q4, k4, v4, None, seed, True, 0.18, 0.0, True)
+            assert autotune.autotune_status()["misses"] == misses, \
+                "batch-4 call re-measured: tile key not batch-agnostic"
+            assert sorted(k for k in autotune._CACHE
+                          if k.startswith("flash_attention_blocks")) == tiles
+        finally:
+            flags.set_flags({"pallas_force_interpret": False})
+            autotune.disable_autotune()
+            autotune.clear_autotune_cache()
+
     def test_cache_file_roundtrip(self, tmp_path):
         from paddle_tpu.core import autotune
         autotune.clear_autotune_cache()
