@@ -102,17 +102,91 @@ class PyLayer(metaclass=PyLayerMeta):
 
 
 def jacobian(ys, xs, batch_axis=None):
-    """Dense Jacobian via jax.jacrev on the captured graph is not available
-    on the tape; compute row-by-row with grad() (parity surface of
-    paddle.autograd.jacobian for small problems)."""
-    raise NotImplementedError(
-        "use jax.jacfwd/jacrev on a functional model (paddle_tpu.jit) — "
-        "tape-level dense jacobian is not provided")
+    """Dense Jacobian of taped ``ys`` w.r.t. ``xs`` (parity:
+    paddle.autograd.jacobian): one VJP per output element through the
+    recorded tape — O(numel(ys)) backward passes, the right tool for the
+    small problems this API serves (the functional
+    ``incubate.autograd.Jacobian`` is the vectorized jax.jacobian path).
+    ``batch_axis=0`` returns the per-sample block diagonal
+    J[b] = d ys[b] / d xs[b]."""
+    import numpy as np
+
+    from ..core import autograd as _ag
+
+    multi_x = isinstance(xs, (list, tuple))
+    xs_list = list(xs) if multi_x else [xs]
+    if isinstance(ys, (list, tuple)):
+        raise ValueError("jacobian expects a single ys tensor "
+                         "(stack multiple outputs first)")
+
+    if batch_axis not in (None, 0):
+        raise ValueError(
+            f"jacobian: batch_axis must be None or 0, got {batch_axis}")
+    y_shape = tuple(ys.shape)
+    if batch_axis == 0:
+        if not y_shape:
+            raise ValueError("batch_axis=0 needs a batched (>=1-d) ys")
+        for x in xs_list:
+            if tuple(x.shape)[:1] != y_shape[:1]:
+                raise ValueError(
+                    f"batch_axis=0: xs batch dim {tuple(x.shape)[:1]} != "
+                    f"ys batch dim {y_shape[:1]}")
+    n = int(np.prod(y_shape)) if y_shape else 1
+    rows = []
+    for i in range(n):
+        seed = jnp.zeros((n,), ys._data.dtype).at[i].set(1.0)
+        gouts = [Tensor(seed.reshape(y_shape))]
+        grads = _ag.grad([ys], xs_list, grad_outputs=gouts,
+                         retain_graph=True, allow_unused=True)
+        rows.append([
+            (g._data if g is not None
+             else jnp.zeros(tuple(x.shape), ys._data.dtype))
+            for g, x in zip(grads, xs_list)])
+    jacs = []
+    for k, x in enumerate(xs_list):
+        full = jnp.stack([r[k] for r in rows]).reshape(
+            y_shape + tuple(x.shape))
+        if batch_axis == 0:
+            # per-sample block diagonal J[b] = d ys[b] / d xs[b]:
+            # full[b] is y_shape[1:] + x_shape; x's batch axis sits at
+            # position len(y_shape) - 1 inside it
+            b = y_shape[0]
+            full = jnp.stack([
+                jnp.take(full[bi], bi, axis=len(y_shape) - 1)
+                for bi in range(b)])
+        jacs.append(Tensor(full))
+    return jacs if multi_x else jacs[0]
 
 
-def hessian(func, xs, batch_axis=None):
-    raise NotImplementedError(
-        "use jax.hessian on a functional model (paddle_tpu.jit)")
+def hessian(ys, xs, batch_axis=None):
+    """Dense Hessian of a scalar taped ``ys`` (parity:
+    paddle.autograd.hessian): grad-of-grad through the tape's
+    double-backward, one VJP per first-grad element. With a list of
+    inputs the FULL block matrix is returned — H[i][j] = d2ys/dx_i dx_j —
+    including the cross blocks; an input unused by ys yields zero
+    blocks."""
+
+    from ..core import autograd as _ag
+
+    multi_x = isinstance(xs, (list, tuple))
+    xs_list = list(xs) if multi_x else [xs]
+    if tuple(ys.shape) not in ((), (1,)):
+        raise ValueError("hessian expects a scalar ys")
+    firsts = _ag.grad([ys], xs_list, retain_graph=True, create_graph=True,
+                      allow_unused=True)
+    blocks = []
+    for gi, xi in zip(firsts, xs_list):
+        row = []
+        for xj in xs_list:
+            if gi is None:
+                row.append(Tensor(jnp.zeros(
+                    tuple(xi.shape) + tuple(xj.shape), ys._data.dtype)))
+            else:
+                row.append(jacobian(gi, xj))
+        blocks.append(row)
+    if not multi_x:
+        return blocks[0][0]
+    return [list(r) for r in blocks]
 
 
 class saved_tensors_hooks:

@@ -385,10 +385,22 @@ def fused_multi_head_attention(
             "fused_multi_head_attention cache_kv decode is not supported "
             "yet; use masked_multihead_attention for decode")
     if transpose_qkv_wb:
-        raise NotImplementedError(
-            "fused_multi_head_attention transpose_qkv_wb=True (2-D qkv "
-            "weight layout) is not supported yet; pass the (3, H, D, E) "
-            "layout")
+        # 2-D layout (dim_embed, 3*num_head*dim_head) — reshape to the
+        # (3, H, D, E) layout the fused path consumes (reference
+        # fused_transformer.py transpose_qkv_wb contract)
+        if num_heads <= 0:
+            raise ValueError(
+                "transpose_qkv_wb=True requires num_heads > 0")
+        e = int(qkv_weight.shape[0])
+        hd3 = int(qkv_weight.shape[1])
+        d = hd3 // 3 // num_heads
+        if 3 * num_heads * d != hd3:
+            raise ValueError(
+                f"qkv_weight {tuple(qkv_weight.shape)} not divisible into "
+                f"3 x {num_heads} heads")
+        qkv_weight = qkv_weight.t().reshape([3, num_heads, d, e])
+        if qkv_bias is not None:
+            qkv_bias = qkv_bias.reshape([3, num_heads, d])
     from ....core.dispatch import run_op
     from ....nn import functional as F
     residual = x

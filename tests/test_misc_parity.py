@@ -52,6 +52,31 @@ class TestFusedFunctionals:
         (out ** 2).mean().backward()
         assert np.isfinite(qkvw.grad.numpy()).all()
 
+    def test_fused_mha_transpose_qkv_wb_matches_4d(self):
+        """2-D (E, 3HD) qkv layout == the (3, H, D, E) layout it reshapes
+        into (r3: transpose_qkv_wb was NotImplementedError)."""
+        x = t(rng.randn(2, 6, 16).astype(np.float32))
+        w4 = rng.randn(3, 4, 4, 16).astype(np.float32) * 0.1
+        b4 = rng.randn(3, 4, 4).astype(np.float32) * 0.1
+        lw = t(rng.randn(16, 16).astype(np.float32) * 0.1)
+        kw = dict(pre_layer_norm=True,
+                  pre_ln_scale=t(np.ones(16, np.float32)),
+                  pre_ln_bias=t(np.zeros(16, np.float32)),
+                  ln_scale=t(np.ones(16, np.float32)),
+                  ln_bias=t(np.zeros(16, np.float32)),
+                  dropout_rate=0.0, attn_dropout_rate=0.0, training=False)
+        ref = self.F.fused_multi_head_attention(
+            x, t(w4), lw, qkv_bias=t(b4), **kw)
+        # (3, H, D, E) -> (E, 3HD); bias (3, H, D) -> (3HD,)
+        w2d = w4.reshape(3 * 4 * 4, 16).T.copy()
+        out = self.F.fused_multi_head_attention(
+            x, t(w2d), lw, qkv_bias=t(b4.reshape(-1)), num_heads=4,
+            transpose_qkv_wb=True, **kw)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+        with pytest.raises(ValueError, match="num_heads"):
+            self.F.fused_multi_head_attention(
+                x, t(w2d), lw, transpose_qkv_wb=True, **kw)
+
     def test_fused_feedforward(self):
         x = t(rng.randn(2, 4, 8).astype(np.float32))
         w1 = t(rng.randn(8, 16).astype(np.float32) * 0.1)
