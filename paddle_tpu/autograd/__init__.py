@@ -131,58 +131,90 @@ def jacobian(ys, xs, batch_axis=None):
                 raise ValueError(
                     f"batch_axis=0: xs batch dim {tuple(x.shape)[:1]} != "
                     f"ys batch dim {y_shape[:1]}")
-    n = int(np.prod(y_shape)) if y_shape else 1
-    rows = []
-    for i in range(n):
-        seed = jnp.zeros((n,), ys._data.dtype).at[i].set(1.0)
+    def _vjp_row(seed):
         gouts = [Tensor(seed.reshape(y_shape))]
         grads = _ag.grad([ys], xs_list, grad_outputs=gouts,
                          retain_graph=True, allow_unused=True)
-        rows.append([
-            (g._data if g is not None
-             else jnp.zeros(tuple(x.shape), ys._data.dtype))
-            for g, x in zip(grads, xs_list)])
+        return [(g._data if g is not None
+                 else jnp.zeros(tuple(x.shape), ys._data.dtype))
+                for g, x in zip(grads, xs_list)]
+
     jacs = []
-    for k, x in enumerate(xs_list):
-        full = jnp.stack([r[k] for r in rows]).reshape(
-            y_shape + tuple(x.shape))
-        if batch_axis == 0:
-            # per-sample block diagonal J[b] = d ys[b] / d xs[b]:
-            # full[b] is y_shape[1:] + x_shape; x's batch axis sits at
-            # position len(y_shape) - 1 inside it
-            b = y_shape[0]
-            full = jnp.stack([
-                jnp.take(full[bi], bi, axis=len(y_shape) - 1)
-                for bi in range(b)])
-        jacs.append(Tensor(full))
+    if batch_axis == 0:
+        # per-sample blocks J[b] = d ys[b] / d xs[b] in M passes, not B*M:
+        # one seed lights intra-sample index m in EVERY sample at once —
+        # the batch semantics (like the reference's) assume samples are
+        # independent, so the summed cotangents separate per sample
+        b = y_shape[0]
+        m = int(np.prod(y_shape[1:]))
+        rows = []
+        for im in range(m):
+            seed = jnp.zeros((b, m), ys._data.dtype).at[:, im].set(1.0)
+            rows.append(_vjp_row(seed))
+        for k, x in enumerate(xs_list):
+            nx = int(np.prod(tuple(x.shape)[1:]))
+            stacked = (jnp.stack([r[k].reshape(b, nx) for r in rows])
+                       if rows else
+                       jnp.zeros((m, b, nx), ys._data.dtype))  # (M, B, N)
+            jacs.append(Tensor(stacked.transpose(1, 0, 2)))  # [B, M, N]
+    else:
+        n = int(np.prod(y_shape))
+        rows = []
+        for i in range(n):
+            seed = jnp.zeros((n,), ys._data.dtype).at[i].set(1.0)
+            rows.append(_vjp_row(seed))
+        for k, x in enumerate(xs_list):
+            nx = int(np.prod(tuple(x.shape)))
+            jacs.append(Tensor(
+                jnp.stack([r[k].reshape(nx) for r in rows])
+                if rows else jnp.zeros((n, nx), ys._data.dtype)))  # [M, N]
     return jacs if multi_x else jacs[0]
 
 
 def hessian(ys, xs, batch_axis=None):
     """Dense Hessian of a scalar taped ``ys`` (parity:
     paddle.autograd.hessian): grad-of-grad through the tape's
-    double-backward, one VJP per first-grad element. With a list of
-    inputs the FULL block matrix is returned — H[i][j] = d2ys/dx_i dx_j —
-    including the cross blocks; an input unused by ys yields zero
-    blocks."""
+    double-backward. With a list of inputs the FULL block matrix is
+    returned — H[i][j] = d2ys/dx_i dx_j, each block flattened to
+    [n_i, n_j] (or [B, n_i, n_j] with ``batch_axis=0`` and per-sample
+    scalar ys of shape [B] / [B, 1]); an input unused by ys yields zero
+    blocks. Each row of blocks costs ONE jacobian sweep over all xs."""
+    import numpy as np
 
     from ..core import autograd as _ag
 
+    if batch_axis not in (None, 0):
+        raise ValueError(
+            f"hessian: batch_axis must be None or 0, got {batch_axis}")
     multi_x = isinstance(xs, (list, tuple))
     xs_list = list(xs) if multi_x else [xs]
-    if tuple(ys.shape) not in ((), (1,)):
+    if batch_axis is None and tuple(ys.shape) not in ((), (1,)):
         raise ValueError("hessian expects a scalar ys")
+    if batch_axis == 0 and not (
+            len(tuple(ys.shape)) == 1
+            or tuple(ys.shape)[1:] in ((), (1,))):
+        raise ValueError(
+            "hessian with batch_axis=0 expects per-sample scalar ys of "
+            f"shape [B] or [B, 1], got {tuple(ys.shape)}")
     firsts = _ag.grad([ys], xs_list, retain_graph=True, create_graph=True,
                       allow_unused=True)
     blocks = []
     for gi, xi in zip(firsts, xs_list):
-        row = []
-        for xj in xs_list:
-            if gi is None:
-                row.append(Tensor(jnp.zeros(
-                    tuple(xi.shape) + tuple(xj.shape), ys._data.dtype)))
-            else:
-                row.append(jacobian(gi, xj))
+        if gi is None:
+            row = []
+            for xj in xs_list:
+                if batch_axis == 0:
+                    b = tuple(ys.shape)[0]
+                    shape = (b,
+                             int(np.prod(tuple(xi.shape)[1:])),
+                             int(np.prod(tuple(xj.shape)[1:])))
+                else:
+                    shape = (int(np.prod(tuple(xi.shape))),
+                             int(np.prod(tuple(xj.shape))))
+                row.append(Tensor(jnp.zeros(shape, ys._data.dtype)))
+        else:
+            # one jacobian sweep yields the whole row of blocks
+            row = jacobian(gi, xs_list, batch_axis=batch_axis)
         blocks.append(row)
     if not multi_x:
         return blocks[0][0]

@@ -239,8 +239,20 @@ class TestDenseJacobianHessian:
         x = paddle.to_tensor(np.arange(3, dtype=np.float32))
         x.stop_gradient = False
         J = paddle.autograd.jacobian((x * x).sum(), x)
+        # the [M, N] contract: scalar ys -> M = 1
         np.testing.assert_allclose(np.asarray(J._data),
-                                   2 * np.arange(3), rtol=1e-6)
+                                   (2 * np.arange(3))[None, :], rtol=1e-6)
+
+    def test_jacobian_flattens_multi_dim(self):
+        """ys (2,3) / xs (4,) -> [M=6, N=4] (reference autograd.py:469)."""
+        A = np.random.RandomState(5).randn(6, 4).astype("float32")
+        x = paddle.to_tensor(np.random.RandomState(6).randn(4)
+                             .astype("float32"))
+        x.stop_gradient = False
+        y = paddle.matmul(paddle.to_tensor(A), x).reshape([2, 3])
+        J = paddle.autograd.jacobian(y, x)
+        assert J.shape == [6, 4]
+        np.testing.assert_allclose(np.asarray(J._data), A, rtol=1e-5)
 
     def test_hessian_full_block_matrix(self):
         """Multi-input hessian returns ALL blocks incl. cross terms
