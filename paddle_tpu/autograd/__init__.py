@@ -107,8 +107,13 @@ def jacobian(ys, xs, batch_axis=None):
     recorded tape — O(numel(ys)) backward passes, the right tool for the
     small problems this API serves (the functional
     ``incubate.autograd.Jacobian`` is the vectorized jax.jacobian path).
-    ``batch_axis=0`` returns the per-sample block diagonal
-    J[b] = d ys[b] / d xs[b]."""
+    ``batch_axis=0`` returns the per-sample blocks
+    J[b] = d ys[b] / d xs[b] under the batch contract's independence
+    assumption (samples must not mix inside the graph — the reference's
+    batched Jacobian carries the same caveat): each of the M seeds
+    lights intra-sample index m in every sample at once, so a
+    cross-sample op (e.g. batch norm) folds the coupled cotangents into
+    the blocks."""
     import numpy as np
 
     from ..core import autograd as _ag
@@ -191,8 +196,8 @@ def hessian(ys, xs, batch_axis=None):
     if batch_axis is None and tuple(ys.shape) not in ((), (1,)):
         raise ValueError("hessian expects a scalar ys")
     if batch_axis == 0 and not (
-            len(tuple(ys.shape)) == 1
-            or tuple(ys.shape)[1:] in ((), (1,))):
+            len(tuple(ys.shape)) in (1, 2)
+            and tuple(ys.shape)[1:] in ((), (1,))):
         raise ValueError(
             "hessian with batch_axis=0 expects per-sample scalar ys of "
             f"shape [B] or [B, 1], got {tuple(ys.shape)}")

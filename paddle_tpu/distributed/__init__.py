@@ -37,6 +37,8 @@ from .extras import (spawn, scatter_object_list, broadcast_object_list,  # noqa:
                      shard_dataloader, ReduceType, Strategy,
                      CountFilterEntry, ShowClickEntry, ProbabilityEntry,
                      QueueDataset, InMemoryDataset)
+from .fleet.dataset import BoxPSDataset, FileInstantDataset  # noqa: F401
+from . import cloud_utils  # noqa: F401
 from . import io  # noqa: F401
 from . import utils  # noqa: F401
 from . import communication  # noqa: F401

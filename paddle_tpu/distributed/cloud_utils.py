@@ -1,0 +1,87 @@
+"""Cloud cluster helpers (parity: python/paddle/distributed/cloud_utils.py
+— resolve the trainer cluster from PaddleCloud-style environment
+variables; used by launchers running under a cloud scheduler). The
+Cluster/Pod/Trainer shapes mirror the reference's launch_utils
+structures (rank/addr/port/devices), self-contained here.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = ["Cluster", "Pod", "Trainer", "get_cloud_cluster",
+           "get_cluster_and_pod"]
+
+
+@dataclass
+class Trainer:
+    endpoint: str = ""
+    rank: int = 0
+    gpus: List[int] = field(default_factory=list)
+
+
+@dataclass
+class Pod:
+    rank: int = 0
+    addr: str = ""
+    port: int = 0
+    devices: List[int] = field(default_factory=list)
+    trainers: List[Trainer] = field(default_factory=list)
+
+    def endpoint(self) -> str:
+        return f"{self.addr}:{self.port}"
+
+
+@dataclass
+class Cluster:
+    hdfs: Optional[object] = None
+    pods: List[Pod] = field(default_factory=list)
+
+    def trainers_endpoints(self) -> List[str]:
+        return [t.endpoint for p in self.pods for t in p.trainers]
+
+    def world_size(self) -> int:
+        return sum(len(p.trainers) for p in self.pods)
+
+
+def _get_trainers_num():
+    return int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+
+
+def get_cloud_cluster(args_node_ips=None, args_node_ip=None, args_port=None,
+                      selected_devices=None):
+    """Build the (cluster, pod) pair from the cloud env contract
+    (PADDLE_TRAINERS / POD_IP / PADDLE_PORT), falling back to the CLI
+    args (reference cloud_utils.py:27)."""
+    node_ips = os.getenv("PADDLE_TRAINERS")
+    node_ips = (node_ips.split(",") if node_ips
+                else (args_node_ips.split(",")
+                      if isinstance(args_node_ips, str) else
+                      list(args_node_ips or ["127.0.0.1"])))
+    node_ip = os.getenv("POD_IP", args_node_ip or node_ips[0])
+    port = int(os.getenv("PADDLE_PORT", args_port or 6170))
+    devices = [int(d) for d in (selected_devices or [0])]
+
+    cluster = Cluster()
+    this_pod = None
+    rank_base = 0
+    for rank, ip in enumerate(node_ips):
+        pod = Pod(rank=rank, addr=ip, port=port, devices=list(devices))
+        for i, d in enumerate(devices):
+            pod.trainers.append(Trainer(
+                endpoint=f"{ip}:{port + i}", rank=rank_base + i, gpus=[d]))
+        rank_base += len(devices)
+        cluster.pods.append(pod)
+        if ip == node_ip:
+            this_pod = pod
+    return cluster, this_pod or cluster.pods[0]
+
+
+def get_cluster_and_pod(args):
+    """(reference cloud_utils.py:114)"""
+    return get_cloud_cluster(
+        getattr(args, "cluster_node_ips", None),
+        getattr(args, "node_ip", None),
+        getattr(args, "started_port", None),
+        getattr(args, "selected_devices", None))

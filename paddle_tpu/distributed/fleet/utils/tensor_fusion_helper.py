@@ -98,7 +98,9 @@ class FusedCommBuffer:
         self._scale_after_comm = scale_after_comm
         self._sizes = [int(np.prod(p.shape)) for p in self._params]
         self._offsets = np.cumsum([0] + self._sizes).tolist()
-        self._pending = set(builtins.id(p) for p in self._params)
+        self._index = {builtins.id(p): i
+                       for i, p in enumerate(self._params)}
+        self._pending = set(self._index)
         self.param_storage, self.grad_storage = flatten_dense_tensors(
             self._params, use_main_grad=bool(use_main_grad),
             fuse_param=fuse_param)
@@ -110,24 +112,32 @@ class FusedCommBuffer:
     def add_grad(self, param, use_comm=True):
         """Record ``param``'s grad into its slice; when the bucket is
         complete, run the fused collective and scatter results back."""
-        if builtins.id(param) not in self._pending:
+        pid = builtins.id(param)
+        if pid not in self._index:
+            raise ValueError(
+                "param does not belong to this FusedCommBuffer bucket")
+        if pid not in self._pending:
             raise ValueError("param already added this step")
-        # identity lookup: list.index would run Tensor.__eq__ elementwise
-        i = next(j for j, p in enumerate(self._params) if p is param)
+        i = self._index[pid]
         lo, hi = self._offsets[i], self._offsets[i + 1]
         # ACCUMULATE into the slice: micro-steps before the sync step add
         # up (the reference's grad-accumulation contract)
         g = param.grad._data.reshape(-1).astype(self.grad_storage._data.dtype)
         self.grad_storage._data = self.grad_storage._data.at[lo:hi].add(g)
-        self._pending.discard(builtins.id(param))
+        self._pending.discard(pid)
         if not self._pending:
             if use_comm:
+                if not self._scale_after_comm and self._acc_steps > 1:
+                    # reference contract: scale_after_comm=False means
+                    # scale BEFORE the collective, never "don't scale"
+                    self.grad_storage._data = (
+                        self.grad_storage._data / self._acc_steps)
                 self.comm_grads()
                 self.scale_and_split_grads()
             else:
                 # non-sync micro-step: re-arm for the next accumulation
                 # round, keep the accumulated buffer
-                self._pending = set(builtins.id(p) for p in self._params)
+                self._pending = set(self._index)
 
     def comm_grads(self):
         from ... import parallel as _par
@@ -159,7 +169,7 @@ class FusedCommBuffer:
             p.grad._data = buf[lo:hi].reshape(p.shape).astype(
                 p.grad._data.dtype)
         # re-arm and clear the accumulator for the next round
-        self._pending = set(builtins.id(p) for p in self._params)
+        self._pending = set(self._index)
         self.grad_storage._data = jnp.zeros_like(self.grad_storage._data)
 
 

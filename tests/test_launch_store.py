@@ -354,3 +354,31 @@ class TestElasticScale:
         finally:
             m1.stop()
             m2.stop()
+
+
+class TestCloudUtils:
+    """distributed.cloud_utils (reference cloud_utils.py:27): cluster
+    resolution from the PaddleCloud env contract."""
+
+    def test_cluster_from_env(self, monkeypatch):
+        import paddle_tpu.distributed as dist
+        monkeypatch.setenv("PADDLE_TRAINERS", "10.0.0.1,10.0.0.2")
+        monkeypatch.setenv("POD_IP", "10.0.0.2")
+        monkeypatch.setenv("PADDLE_PORT", "7000")
+        cluster, pod = dist.cloud_utils.get_cloud_cluster(
+            selected_devices=[0, 1])
+        assert [p.addr for p in cluster.pods] == ["10.0.0.1", "10.0.0.2"]
+        assert pod.rank == 1 and pod.endpoint() == "10.0.0.2:7000"
+        assert cluster.world_size() == 4
+        # global trainer ranks are contiguous across pods
+        assert [t.rank for p in cluster.pods for t in p.trainers] == \
+            [0, 1, 2, 3]
+
+    def test_args_fallback(self, monkeypatch):
+        import paddle_tpu.distributed as dist
+        monkeypatch.delenv("PADDLE_TRAINERS", raising=False)
+        monkeypatch.delenv("POD_IP", raising=False)
+        monkeypatch.delenv("PADDLE_PORT", raising=False)
+        cluster, pod = dist.cloud_utils.get_cloud_cluster(
+            args_node_ips="1.1.1.1", args_port=6180)
+        assert pod.addr == "1.1.1.1" and pod.port == 6180
