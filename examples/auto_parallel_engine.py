@@ -6,6 +6,17 @@ Run:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/auto_parallel_engine.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # a site-installed jax may arrive pre-configured for an accelerator
+    # plugin; the env var must win for the documented CPU run commands
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 
 import paddle_tpu as paddle

@@ -5,9 +5,21 @@ Run:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/pipeline_1f1b.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # a site-installed jax may arrive pre-configured for an accelerator
+    # plugin; the env var must win for the documented CPU run commands
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 
 import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
 from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
 from paddle_tpu.distributed.fleet.meta_parallel import PipelineParallel
 
@@ -20,10 +32,10 @@ def main():
         descs.append(LayerDesc(paddle.nn.Tanh))
     pipe = PipelineLayer(descs, num_stages=2, loss_fn=paddle.nn.MSELoss())
 
-    class Strategy:
-        pipeline_configs = {"accumulate_steps": 4, "micro_batch_size": 2}
-
-    engine = PipelineParallel(pipe, None, Strategy(),
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.pipeline_configs = {"accumulate_steps": 4,
+                                 "micro_batch_size": 2}
+    engine = PipelineParallel(pipe, None, strategy,
                               stage_mesh_axes={"dp": 2, "tp": 2},
                               batch_axis="dp")
     opt = paddle.optimizer.SGD(0.05, parameters=pipe.parameters())

@@ -7,6 +7,17 @@
 Run (any backend; sizes here are CPU-friendly):
     JAX_PLATFORMS=cpu python examples/train_gpt2.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # a site-installed jax may arrive pre-configured for an accelerator
+    # plugin; the env var must win for the documented CPU run commands
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 
 import paddle_tpu as paddle

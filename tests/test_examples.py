@@ -18,7 +18,7 @@ def _run(name, extra_env):
     env = dict(os.environ)
     env.update(extra_env)
     env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = ROOT
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
     r = subprocess.run(
         [sys.executable, os.path.join(ROOT, "examples", name)],
         capture_output=True, text=True, timeout=420, env=env, cwd=ROOT)
