@@ -382,6 +382,14 @@ def _load_session_capture():
             with open(cfg_p) as f:
                 result.setdefault("extra", {})["baseline_configs"] = \
                     json.load(f)
+        man_p = os.path.join(base, "manual_runs.json")
+        if os.path.exists(man_p):
+            # interactively-driven on-chip runs from the same session —
+            # they post-date (and where marked, supersede) daemon captures
+            # the tunnel died before refreshing
+            with open(man_p) as f:
+                result.setdefault("extra", {})["manual_on_chip_runs"] = \
+                    json.load(f)
         return result
     except Exception:
         return None

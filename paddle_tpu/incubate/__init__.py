@@ -251,3 +251,4 @@ def register_rng_state_as_index(state_list=None, device=None):
 # distributed sharding of optimizer states maps onto shard_optimizer.
 from ..optimizer.optimizer import Lamb as DistributedFusedLamb  # noqa: E402,F401
 from ..distributed import fleet  # noqa: E402,F401
+from . import distributed  # noqa: E402,F401

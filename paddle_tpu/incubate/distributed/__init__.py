@@ -1,0 +1,4 @@
+"""paddle.incubate.distributed (parity: python/paddle/incubate/distributed
+— the MoE model family + distributed save/load utilities)."""
+from . import models  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,3 @@
+"""paddle.incubate.distributed.models (parity): the MoE family lives in
+incubate.moe on this build; this is the path-faithful access point."""
+from ... import moe  # noqa: F401

@@ -1,0 +1,46 @@
+"""Distributed save/load for hybrid-parallel state (parity:
+incubate/distributed/utils/io — gather sharded/TP state to one rank
+and save; load with redistribution). On the global-array substrate
+every process addresses the global value, so gather-then-save maps to
+materializing the global arrays; reshard-on-load is the distributed
+checkpoint machinery."""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+__all__ = ["save", "load", "save_for_auto_inference"]
+
+
+def _gather_state(obj):
+    """state_dict -> {name: global numpy array} (the gather step)."""
+    out = {}
+    for k, v in obj.items():
+        arr = v._data if hasattr(v, "_data") else v
+        out[k] = np.asarray(arr)
+    return out
+
+
+def save(state_dict, path, **configs):
+    """Save a (possibly TP/sharded) state dict as GLOBAL values
+    (reference dist_save.save: gather_to=rank then save)."""
+    with open(path, "wb") as f:
+        pickle.dump(_gather_state(state_dict), f)
+
+
+def load(path, **configs):
+    """Load a state dict saved by ``save`` (reference dist_load.load);
+    placement/re-sharding is the caller's set_state_dict /
+    distributed.checkpoint layer."""
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def save_for_auto_inference(path_prefix, dist_model, cvt2cpu=False):
+    """Save a distributed model's GLOBAL params for single-card
+    inference (reference dist_save.save_for_auto_inference)."""
+    state = dist_model.state_dict() if hasattr(dist_model, "state_dict") \
+        else dist_model
+    save(state, path_prefix + ".pdparams")
+    return path_prefix + ".pdparams"
