@@ -252,3 +252,4 @@ def register_rng_state_as_index(state_list=None, device=None):
 from ..optimizer.optimizer import Lamb as DistributedFusedLamb  # noqa: E402,F401
 from ..distributed import fleet  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
+from . import layers  # noqa: E402,F401

@@ -14,12 +14,19 @@ __all__ = ["save", "load", "save_for_auto_inference"]
 
 
 def _gather_state(obj):
-    """state_dict -> {name: global numpy array} (the gather step)."""
-    out = {}
-    for k, v in obj.items():
-        arr = v._data if hasattr(v, "_data") else v
-        out[k] = np.asarray(arr)
-    return out
+    """state_dict -> global host values (the gather step). Tensors and
+    arrays materialize as numpy; dicts recurse; scalars/str and other
+    metadata (optimizer 'LR_Scheduler' blocks, step counters) pass
+    through untouched."""
+    if isinstance(obj, dict):
+        return {k: _gather_state(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_gather_state(v) for v in obj)
+    if hasattr(obj, "_data"):
+        return np.asarray(obj._data)
+    if hasattr(obj, "shape") and hasattr(obj, "dtype"):
+        return np.asarray(obj)
+    return obj
 
 
 def save(state_dict, path, **configs):

@@ -1,0 +1,204 @@
+"""paddle.incubate.layers — the generic subset of the reference's legacy
+incubate layer zoo (python/paddle/incubate/layers/nn.py). The
+Baidu-infrastructure-bound ops (pyramid hash, TDM tree samplers, BoxPS
+pulls, correlation/bilateral-slice CUDA ops) are out of scope on this
+substrate; the portable ops below are implemented TPU-native.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor
+from ...core import random as _random
+
+__all__ = ["shuffle_batch", "partial_concat", "partial_sum", "batch_fc",
+           "fused_bn_add_act", "pow2_decay_with_linear_warmup",
+           "fused_embedding_seq_pool"]
+
+# Parameters these legacy graph-builder ops create, keyed by the user's
+# ParamAttr name (the reference's LayerHelper dedupes program vars the
+# same way): a NAMED attr makes repeated dygraph calls reuse one
+# trainable parameter; unnamed attrs create fresh ones per call — fine
+# at graph-build time (static mode / a jitted step traces once), wrong
+# in a dygraph loop, hence named attrs are the dygraph contract.
+_PARAM_CACHE: dict = {}
+
+
+def _named_parameter(op, shape, attr, default_initializer=None):
+    from ... import nn
+    name = getattr(attr, "name", None) if attr is not None else None
+    if name:
+        k = (op, name, tuple(shape))
+        if k not in _PARAM_CACHE:
+            _PARAM_CACHE[k] = nn.create_parameter(
+                list(shape), dtype="float32", attr=attr,
+                default_initializer=default_initializer)
+        return _PARAM_CACHE[k]
+    return nn.create_parameter(list(shape), dtype="float32", attr=attr,
+                               default_initializer=default_initializer)
+
+
+def shuffle_batch(x, seed=None):
+    """Shuffle the leading dims' rows of ``x`` (last dim kept intact) —
+    reference nn.py:447. Default seed comes from the framework generator
+    so paddle.seed() makes it reproducible."""
+    if seed is None:
+        key = _random.default_generator.next_key()
+    else:
+        key = jax.random.key(int(seed) & 0xFFFFFFFF)
+
+    def fn(a):
+        lead = int(np.prod(a.shape[:-1]))
+        flat = a.reshape(lead, a.shape[-1])
+        perm = jax.random.permutation(key, lead)
+        return flat[perm].reshape(a.shape)
+    return run_op("shuffle_batch", fn, (x,))
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """Concat 2-D inputs' column slices [start_index : start_index+length]
+    along dim 1 (reference nn.py:511)."""
+    if not isinstance(input, (list, tuple)):
+        input = [input]
+
+    def fn(*arrs):
+        outs = []
+        for a in arrs:
+            n = a.shape[1]
+            s = start_index if start_index >= 0 else n + start_index
+            e = n if length < 0 else s + length
+            outs.append(a[:, s:e])
+        return jnp.concatenate(outs, axis=1)
+    return run_op("partial_concat", fn, tuple(input))
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """Sum 2-D inputs' column slices elementwise (reference nn.py:589)."""
+    if not isinstance(input, (list, tuple)):
+        input = [input]
+
+    def fn(*arrs):
+        acc = None
+        for a in arrs:
+            n = a.shape[1]
+            s = start_index if start_index >= 0 else n + start_index
+            e = n if length < 0 else s + length
+            piece = a[:, s:e]
+            acc = piece if acc is None else acc + piece
+        return acc
+    return run_op("partial_sum", fn, tuple(input))
+
+
+def batch_fc(input, param_size, param_attr, bias_size, bias_attr, act=None):
+    """Per-batch-slot FC: input (B, S, In) x w (B, In, Out) + b (B, Out)
+    (reference nn.py:1028 — a batched matmul with bias and activation).
+    Pass NAMED ParamAttrs to reuse the parameters across dygraph calls
+    (see _named_parameter)."""
+    from ...nn.initializer import XavierNormal
+    w = _named_parameter("batch_fc_w", param_size, param_attr,
+                         XavierNormal())
+    b = _named_parameter("batch_fc_b", bias_size, bias_attr,
+                         XavierNormal())
+
+    def fn(a, ww, bb):
+        out = jnp.einsum("bsi,bio->bso", a, ww) + bb[:, None, :]
+        if act == "relu":
+            out = jnp.maximum(out, 0)
+        elif act is not None:
+            raise ValueError(f"batch_fc act '{act}' not supported")
+        return out
+    return run_op("batch_fc", fn, (input, w, b))
+
+
+def fused_bn_add_act(x, y, momentum=0.9, epsilon=1e-5, param_attr=None,
+                     bias_attr=None, moving_mean_name=None,
+                     moving_variance_name=None, act="relu", name=None):
+    """batch_norm(x) + y, then activation (reference nn.py:1297 — the
+    cuDNN-fused residual BN; XLA fuses the same chain on TPU). Input is
+    channel-LAST (the reference's NHWC contract) at any rank >= 2.
+    ``moving_mean_name`` keys the BN layer so repeated dygraph calls
+    share parameters and running stats."""
+    from ... import nn
+    c = int(x.shape[-1])
+    key = ("fused_bn_add_act", moving_mean_name or name, c)
+    bn = _PARAM_CACHE.get(key) if key[1] else None
+    if bn is None:
+        # channel-last at every rank: normalize over all axes but the
+        # last via the NHWC-format base (4-D) or a rank-agnostic swap
+        bn = nn.BatchNorm(c, momentum=momentum, epsilon=epsilon,
+                          data_format="NHWC")
+        if key[1]:
+            _PARAM_CACHE[key] = bn
+    if len(x.shape) == 4:
+        out = bn(x)
+    else:
+        # move channels to axis 1 for the NCHW kernel, then back
+        perm = [0, len(x.shape) - 1] + list(range(1, len(x.shape) - 1))
+        inv = np.argsort(perm).tolist()
+        bn._data_format = "NCHW"
+        out = bn(x.transpose(perm)).transpose(inv)
+        bn._data_format = "NHWC"
+    out = out + y
+    if act == "relu":
+        from ...nn import functional as F
+        out = F.relu(out)
+    elif act is not None:
+        raise ValueError(f"fused_bn_add_act act '{act}' not supported")
+    return out
+
+
+def pow2_decay_with_linear_warmup(warmup_steps, total_steps, base_lr,
+                                  end_lr, dtype="float32", name=None):
+    """LR schedule: linear warmup to base_lr then pow2 decay to end_lr
+    (reference nn.py:1502 — exposed here as an LRScheduler usable in both
+    modes instead of a static-only global-var op)."""
+    from ...optimizer.lr import LRScheduler
+
+    assert warmup_steps <= total_steps, \
+        "warmup_steps cannot be larger than total_steps"
+
+    class Pow2DecayWithLinearWarmup(LRScheduler):
+        def get_lr(self):
+            step = self.last_epoch
+            if step < warmup_steps:
+                return base_lr * float(step + 1) / warmup_steps
+            factor = 1.0 - min(step - warmup_steps,
+                               total_steps - warmup_steps) / float(
+                max(total_steps - warmup_steps, 1))
+            return (base_lr - end_lr) * factor * factor + end_lr
+
+    return Pow2DecayWithLinearWarmup(learning_rate=base_lr)
+
+
+def fused_embedding_seq_pool(input, size, is_sparse=False,
+                             padding_idx=None, combiner="sum",
+                             param_attr=None, dtype="float32"):
+    """Embedding lookup + sequence-pool in one op (reference nn.py:37):
+    input (B, L) int ids -> pooled (B, D). padding_idx rows (negative
+    normalized to size+padding_idx, Paddle semantics) contribute zero;
+    out-of-range ids raise; combiner 'sum' (the reference's only mode).
+    A NAMED param_attr reuses one table across dygraph calls."""
+    if combiner != "sum":
+        raise ValueError("fused_embedding_seq_pool supports combiner='sum'")
+    table = _named_parameter("fused_embedding_seq_pool", list(size),
+                             param_attr)
+    pad = (padding_idx if padding_idx is None or padding_idx >= 0
+           else int(size[0]) + int(padding_idx))
+    ids_np = np.asarray(input._data if isinstance(input, Tensor) else input)
+    if ids_np.size and (ids_np.min() < 0 or ids_np.max() >= int(size[0])):
+        raise ValueError(
+            f"fused_embedding_seq_pool: ids out of range [0, {size[0]}) "
+            f"(got min {ids_np.min()}, max {ids_np.max()})")
+
+    def fn(ids, tab):
+        ids = ids.astype(jnp.int32)
+        if ids.ndim == 3 and ids.shape[-1] == 1:
+            ids = ids[..., 0]
+        vecs = tab[ids]
+        if pad is not None:
+            vecs = jnp.where((ids == pad)[..., None], 0.0, vecs)
+        return vecs.sum(axis=1)
+    return run_op("fused_embedding_seq_pool", fn, (input, table))

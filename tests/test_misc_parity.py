@@ -297,3 +297,85 @@ class TestIncubateAutogradASP:
         assert core_at.autotune_status()["use_autotune"]
         paddle.incubate.autotune.set_config({"kernel": {"enable": False}})
         assert not core_at.autotune_status()["use_autotune"]
+
+
+class TestIncubateLayers:
+    """paddle.incubate.layers generic subset (reference
+    incubate/layers/nn.py — shuffle_batch:447, partial_concat:511,
+    partial_sum:589, batch_fc:1028, fused_bn_add_act:1297,
+    pow2_decay_with_linear_warmup:1502, fused_embedding_seq_pool:37)."""
+
+    def test_shuffle_batch_permutes_rows(self):
+        from paddle_tpu.incubate import layers as L
+        x = t(np.arange(8, dtype=np.float32).reshape(4, 2))
+        s = L.shuffle_batch(x, seed=7)
+        assert sorted(map(tuple, s.numpy().tolist())) == \
+            sorted(map(tuple, x.numpy().tolist()))
+
+    def test_partial_concat_and_sum(self):
+        from paddle_tpu.incubate import layers as L
+        a = t(np.arange(6, dtype=np.float32).reshape(2, 3))
+        b = t(np.arange(6, 12, dtype=np.float32).reshape(2, 3))
+        pc = L.partial_concat([a, b], start_index=1, length=2)
+        np.testing.assert_array_equal(
+            pc.numpy(), np.concatenate([a.numpy()[:, 1:3],
+                                        b.numpy()[:, 1:3]], 1))
+        ps = L.partial_sum([a, b], start_index=0, length=2)
+        np.testing.assert_array_equal(
+            ps.numpy(), a.numpy()[:, :2] + b.numpy()[:, :2])
+
+    def test_batch_fc_shapes_and_grad(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate import layers as L
+        paddle.seed(0)
+        x = t(np.ones((2, 3, 4), np.float32), stop_gradient=False)
+        out = L.batch_fc(x, [2, 4, 5], None, [2, 5], None, act="relu")
+        assert out.shape == [2, 3, 5]
+        (out ** 2).mean().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+    def test_pow2_decay_with_linear_warmup(self):
+        from paddle_tpu.incubate import layers as L
+        sched = L.pow2_decay_with_linear_warmup(10, 100, 0.1, 0.001)
+        lrs = []
+        for _ in range(100):
+            lrs.append(sched.get_lr())
+            sched.step()
+        assert abs(lrs[9] - 0.1) < 1e-9          # warmup tops out at base
+        assert lrs[0] < lrs[5] < lrs[9]          # linear ramp
+        assert lrs[10] > lrs[50] > lrs[-1] >= 0.001  # pow2 decay to end
+
+    def test_fused_embedding_seq_pool_padding(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate import layers as L
+        paddle.seed(1)
+        ids = t(np.array([[1, 2, 0], [3, 0, 0]], np.int64))
+        pooled = L.fused_embedding_seq_pool(ids, (10, 4), padding_idx=0)
+        assert pooled.shape == [2, 4]
+        # named attr -> ONE shared table: padded row [3,0,0] pools to
+        # exactly the same vector as [3] alone
+        attr = paddle.ParamAttr(name="fesp_shared")
+        mixed = L.fused_embedding_seq_pool(
+            t(np.array([[3, 0, 0]], np.int64)), (10, 4), padding_idx=0,
+            param_attr=attr)
+        only3 = L.fused_embedding_seq_pool(
+            t(np.array([[3]], np.int64)), (10, 4), param_attr=attr)
+        np.testing.assert_allclose(mixed.numpy(), only3.numpy(), rtol=1e-6)
+        # all-padding pools to exactly zero; OOB ids raise; negative
+        # padding_idx normalizes to size+padding_idx
+        allpad = L.fused_embedding_seq_pool(
+            t(np.array([[0, 0]], np.int64)), (10, 4), padding_idx=0)
+        np.testing.assert_array_equal(allpad.numpy(), 0.0)
+        with pytest.raises(ValueError, match="out of range"):
+            L.fused_embedding_seq_pool(t(np.array([[10]], np.int64)),
+                                       (10, 4))
+        neg = L.fused_embedding_seq_pool(
+            t(np.array([[9, 9]], np.int64)), (10, 4), padding_idx=-1)
+        np.testing.assert_array_equal(neg.numpy(), 0.0)
+
+    def test_fused_bn_add_act(self):
+        from paddle_tpu.incubate import layers as L
+        x = t(np.random.RandomState(0).randn(4, 8).astype("float32"))
+        y = t(np.zeros((4, 8), np.float32))
+        out = L.fused_bn_add_act(x, y)
+        assert out.shape == [4, 8] and float(out.min()) >= 0
