@@ -380,10 +380,9 @@ def fused_multi_head_attention(
     incubate.nn.functional.fused_multi_head_attention,
     fused_attention_op.cu semantics: (pre-)LN -> fused qkv -> attention
     -> out proj -> dropout -> residual (+ post-LN))."""
-    if cache_kv is not None:
-        raise NotImplementedError(
-            "fused_multi_head_attention cache_kv decode is not supported "
-            "yet; use masked_multihead_attention for decode")
+    # cache_kv (2, B, H, T_cache, D): generation decode — current step's
+    # k/v are appended and attention runs over the grown cache; returns
+    # (out, cache_kv_out) (reference fused_transformer.py:592,841)
     if transpose_qkv_wb:
         # 2-D layout (dim_embed, 3*num_head*dim_head) — reshape to the
         # (3, H, D, E) layout the fused path consumes (reference
@@ -417,6 +416,18 @@ def fused_multi_head_attention(
         return out[0], out[1], out[2]
     ops = (h, qkv_weight) + ((qkv_bias,) if qkv_bias is not None else ())
     q, k, v = run_op("fused_qkv", qkv_fn, ops)
+    cache_kv_out = None
+    if cache_kv is not None:
+        def grow(kk, vv, ck):
+            kh = jnp.moveaxis(kk, 2, 1)           # (B, H, S, D)
+            vh = jnp.moveaxis(vv, 2, 1)
+            k_all = jnp.concatenate([ck[0], kh], axis=2)
+            v_all = jnp.concatenate([ck[1], vh], axis=2)
+            return (jnp.stack([k_all, v_all]),    # (2, B, H, T+S, D)
+                    jnp.moveaxis(k_all, 1, 2),    # (B, T+S, H, D)
+                    jnp.moveaxis(v_all, 1, 2))
+        cache_kv_out, k, v = run_op("fused_mha_cache_grow", grow,
+                                    (k, v, cache_kv))
     out = F.scaled_dot_product_attention(
         q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
         training=training)
@@ -429,6 +440,8 @@ def fused_multi_head_attention(
     if not pre_layer_norm:
         out = F.layer_norm(out, [out.shape[-1]], weight=ln_scale,
                            bias=ln_bias, epsilon=ln_epsilon)
+    if cache_kv_out is not None:
+        return out, cache_kv_out
     return out
 
 

@@ -128,41 +128,20 @@ class FusedMultiHeadAttention(Layer):
 
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
-        if cache is not None:
-            raise NotImplementedError(
-                "FusedMultiHeadAttention incremental-decode cache is not "
-                "supported yet; use incubate.nn.functional"
-                ".masked_multihead_attention for decode")
-        from ....core.dispatch import run_op
-        x = query
-        residual = x
-        if self.normalize_before:
-            x = F.layer_norm(x, [self.embed_dim], weight=self.pre_ln_scale,
-                             bias=self.pre_ln_bias, epsilon=self.epsilon)
-        h = self.head_dim
-        nh = self.num_heads
-
-        def qkv(a, w, *bb):
-            # a: (B, S, E); w: (3, H, D, E) -> (3, B, S, H, D)
-            out = jnp.einsum("bse,khde->kbshd", a, w)
-            if bb:
-                out = out + bb[0][:, None, None]
-            return out[0], out[1], out[2]
-        ops = (x, self.qkv_weight) + (
-            (self.qkv_bias,) if self.qkv_bias is not None else ())
-        q, k, v = run_op("fused_qkv", qkv, ops)
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask,
-            dropout_p=self.attn_dropout_rate, training=self.training)
-        b, s = out.shape[0], out.shape[1]
-        out = out.reshape([b, s, self.embed_dim])
-        out = F.linear(out, self.linear_weight, self.linear_bias)
-        out = F.dropout(out, p=self.dropout_rate, training=self.training)
-        out = residual + out
-        if not self.normalize_before:
-            out = F.layer_norm(out, [self.embed_dim], weight=self.ln_scale,
-                               bias=self.ln_bias, epsilon=self.epsilon)
-        return out
+        """Delegates to the functional (ONE implementation of the fused
+        block, incl. cache_kv incremental decode — returns (out, cache)
+        when ``cache`` is given, the reference Cache contract)."""
+        from .. import functional as IF
+        return IF.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self.epsilon, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
 
 
 class FusedFeedForward(Layer):

@@ -161,24 +161,36 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
     framework RNG stream; a non-negative seed is deterministic."""
     from ..core.dispatch import run_op
 
-    if threshold is not None or k or mode != "truncated" or return_top:
+    if k or mode != "truncated" or return_top:
         raise NotImplementedError(
-            "top_p_sampling: threshold/k/mode/return_top are not "
-            "supported yet; only the default truncated nucleus sampler")
-    key = _key() if seed in (None, -1) else jax.random.key(seed)
+            "top_p_sampling: k/mode/return_top are not supported yet; "
+            "the (x, ps, threshold, topp_seed/seed) contract "
+            "(tensor/search.py:1235) is fully implemented")
+    if topp_seed is not None:
+        sv = np.asarray(topp_seed._data if hasattr(topp_seed, "_data")
+                        else topp_seed).reshape(-1)
+        key = jax.random.key(int(sv[0]))
+    elif seed in (None, -1):
+        key = _key()
+    else:
+        key = jax.random.key(seed)
 
-    def fn(logits, p_):
+    def fn(logits, p_, *thr):
         sorted_idx = jnp.argsort(-logits, axis=-1)
         sorted_logits = jnp.take_along_axis(logits, sorted_idx, axis=-1)
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
-        keep = cum - probs < p_[..., None]  # always keep the top token
+        keep = cum - probs < p_[..., None]
+        if thr:  # absolute per-row probability floor, simultaneous with ps
+            keep = keep & (probs >= thr[0][..., None])
+        # the top token always stays samplable (the kernel's guarantee)
+        keep = keep.at[..., 0].set(True)
         masked = jnp.where(keep, sorted_logits, -jnp.inf)
         g = jax.random.gumbel(key, masked.shape)
         choice = jnp.argmax(masked + g, axis=-1)
         ids = jnp.take_along_axis(sorted_idx, choice[..., None], axis=-1)
         vals = jnp.take_along_axis(logits, ids, axis=-1)
         return vals, ids.astype(jnp.int64)
-    vals, ids = run_op("top_p_sampling", fn, (x, ps),
-                       num_nondiff_outputs=1)
+    ops = (x, ps) + ((threshold,) if threshold is not None else ())
+    vals, ids = run_op("top_p_sampling", fn, ops, num_nondiff_outputs=1)
     return vals, ids

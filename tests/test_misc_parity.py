@@ -101,6 +101,32 @@ class TestFusedFunctionals:
                 t(np.zeros((1, 2, 8), np.float32)), [], [], [], [], [],
                 [], [], [], [], [], [], [], cache_kvs=[1])
 
+    def test_fused_mha_cached_decode_matches_full_pass(self):
+        """cache_kv decode (reference fused_transformer.py:592,841):
+        feeding tokens one at a time through the growing (2,B,H,T,D)
+        cache must reproduce the full causal pass exactly."""
+        E, H, D, S = 16, 4, 4, 5
+        w4 = t(rng.randn(3, H, D, E).astype(np.float32) * 0.1)
+        lw = t(rng.randn(E, E).astype(np.float32) * 0.1)
+        kw = dict(pre_layer_norm=True,
+                  pre_ln_scale=t(np.ones(E, np.float32)),
+                  pre_ln_bias=t(np.zeros(E, np.float32)),
+                  dropout_rate=0.0, attn_dropout_rate=0.0, training=False)
+        x = t(rng.randn(2, S, E).astype(np.float32))
+        mask = np.where(np.tril(np.ones((S, S))), 0.0,
+                        -1e9).astype(np.float32)
+        full = self.F.fused_multi_head_attention(
+            x, w4, lw, attn_mask=t(mask[None, None]), **kw)
+        cache = t(np.zeros((2, 2, H, 0, D), np.float32))
+        outs = []
+        for step in range(S):
+            o, cache = self.F.fused_multi_head_attention(
+                x[:, step:step + 1], w4, lw, cache_kv=cache, **kw)
+            outs.append(o.numpy())
+        np.testing.assert_allclose(np.concatenate(outs, axis=1),
+                                   full.numpy(), atol=1e-5)
+        assert list(cache.shape) == [2, 2, H, S, D]
+
 
 class TestDistributionTransforms:
     def test_stickbreaking_matches_torch(self):
@@ -379,3 +405,25 @@ class TestIncubateLayers:
         y = t(np.zeros((4, 8), np.float32))
         out = L.fused_bn_add_act(x, y)
         assert out.shape == [4, 8] and float(out.min()) >= 0
+
+
+class TestTopPSamplingThreshold:
+    def test_threshold_floors_low_prob_tokens(self):
+        """(x, ps, threshold, seed) contract (reference search.py:1235):
+        threshold is an absolute per-row probability floor applied
+        simultaneously with ps."""
+        import paddle_tpu as paddle
+        paddle.seed(0)
+        x = t(np.array([[5.0, 3.0, -2.0, -2.0]], np.float32))
+        ps = t(np.array([0.99], np.float32))
+        thr = t(np.array([0.5], np.float32))
+        seen = set()
+        for _ in range(20):
+            _, idx = paddle.tensor.top_p_sampling(x, ps, threshold=thr)
+            seen.add(int(idx.numpy()[0, 0]))
+        assert seen == {0}
+        seen2 = set()
+        for _ in range(50):
+            _, idx = paddle.tensor.top_p_sampling(x, ps)
+            seen2.add(int(idx.numpy()[0, 0]))
+        assert {0, 1} <= seen2
