@@ -482,16 +482,22 @@ def fused_multi_transformer(
         trans_qkvw=True, ring_id=-1, name=None):
     """Stacked fused transformer layers (parity:
     incubate.nn.functional.fused_multi_transformer). Per-layer weight
-    lists; decode caches are not supported yet (use
-    masked_multihead_attention for decode)."""
-    if cache_kvs is not None or time_step is not None:
+    lists; generation decode via per-layer ``cache_kvs`` — each layer's
+    (2, B, H, T, D) cache GROWS and the call returns
+    (out, cache_kv_outs). The reference's other decode mode — a
+    preallocated max-length cache written at ``time_step`` — is not
+    supported: attention over the padded tail would be silently wrong,
+    so it raises."""
+    if time_step is not None:
         raise NotImplementedError(
-            "fused_multi_transformer decode caches are not supported "
-            "yet; use masked_multihead_attention for decode")
+            "fused_multi_transformer: preallocated-cache decode with "
+            "time_step is not supported; pass growing cache_kvs "
+            "(T grows by S each call) instead")
     h = x
     n_layers = len(qkv_weights)
+    cache_outs = [] if cache_kvs is not None else None
     for i in range(n_layers):
-        h = fused_multi_head_attention(
+        attn_out = fused_multi_head_attention(
             h, qkv_weights[i], linear_weights[i],
             pre_layer_norm=pre_layer_norm, pre_ln_scale=ln_scales[i],
             pre_ln_bias=ln_biases[i] if ln_biases else None,
@@ -499,8 +505,14 @@ def fused_multi_transformer(
             ln_bias=ln_biases[i] if ln_biases else None,
             qkv_bias=qkv_biases[i] if qkv_biases else None,
             linear_bias=linear_biases[i] if linear_biases else None,
+            cache_kv=cache_kvs[i] if cache_kvs is not None else None,
             attn_mask=attn_mask, dropout_rate=dropout_rate,
             attn_dropout_rate=dropout_rate, training=training, mode=mode)
+        if cache_kvs is not None:
+            h, cache_i = attn_out
+            cache_outs.append(cache_i)
+        else:
+            h = attn_out
         h = fused_feedforward(
             h, ffn1_weights[i], ffn2_weights[i],
             linear1_bias=ffn1_biases[i] if ffn1_biases else None,
@@ -512,6 +524,8 @@ def fused_multi_transformer(
             dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
             activation=activation, pre_layer_norm=pre_layer_norm,
             training=training, mode=mode)
+    if cache_outs is not None:
+        return h, cache_outs
     return h
 
 

@@ -221,6 +221,10 @@ class FusedTransformerEncoderLayer(Layer):
             normalize_before=normalize_before)
 
     def forward(self, src, src_mask=None, cache=None):
+        if cache is not None:
+            attn, cache_out = self.fused_attn(src, attn_mask=src_mask,
+                                              cache=cache)
+            return self.ffn(attn), cache_out
         return self.ffn(self.fused_attn(src, attn_mask=src_mask))
 
 
@@ -250,12 +254,25 @@ class FusedMultiTransformer(Layer):
             for _ in range(num_layers)])
 
     def forward(self, src, attn_mask=None, caches=None, **kw):
-        if caches is not None or kw.get("time_step") is not None:
+        """Generation decode: per-layer ``caches`` of (2, B, H, T, D)
+        grow each step; returns (out, cache_outs) when given (the
+        reference's decode contract, fused_transformer.py:1025).
+        Preallocated-cache time_step decode is not supported (raises)."""
+        if kw.get("time_step") is not None:
             raise NotImplementedError(
-                "FusedMultiTransformer incremental-decode caches are not "
-                "supported yet; use incubate.nn.functional"
-                ".masked_multihead_attention for decode")
+                "FusedMultiTransformer: preallocated-cache decode with "
+                "time_step is not supported; pass growing caches instead")
         h = src
+        if caches is not None:
+            if len(caches) != len(self.layers):
+                raise ValueError(
+                    f"caches has {len(caches)} entries for "
+                    f"{len(self.layers)} layers")
+            outs = []
+            for lyr, cache in zip(self.layers, caches):
+                h, c = lyr(h, src_mask=attn_mask, cache=cache)
+                outs.append(c)
+            return h, outs
         for lyr in self.layers:
             h = lyr(h, src_mask=attn_mask)
         return h

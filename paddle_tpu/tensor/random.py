@@ -166,31 +166,42 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
             "top_p_sampling: k/mode/return_top are not supported yet; "
             "the (x, ps, threshold, topp_seed/seed) contract "
             "(tensor/search.py:1235) is fully implemented")
-    if topp_seed is not None:
-        sv = np.asarray(topp_seed._data if hasattr(topp_seed, "_data")
-                        else topp_seed).reshape(-1)
-        key = jax.random.key(int(sv[0]))
-    elif seed in (None, -1):
+    if seed in (None, -1):
         key = _key()
     else:
         key = jax.random.key(seed)
 
-    def fn(logits, p_, *thr):
+    def fn(logits, p_, *extras):
+        it = iter(extras)
+        thr = next(it) if threshold is not None else None
+        seeds = next(it) if topp_seed is not None else None
         sorted_idx = jnp.argsort(-logits, axis=-1)
         sorted_logits = jnp.take_along_axis(logits, sorted_idx, axis=-1)
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         keep = cum - probs < p_[..., None]
-        if thr:  # absolute per-row probability floor, simultaneous with ps
-            keep = keep & (probs >= thr[0][..., None])
+        if thr is not None:  # absolute per-row floor, simultaneous with ps
+            keep = keep & (probs >= thr[..., None])
         # the top token always stays samplable (the kernel's guarantee)
         keep = keep.at[..., 0].set(True)
         masked = jnp.where(keep, sorted_logits, -jnp.inf)
-        g = jax.random.gumbel(key, masked.shape)
+        if seeds is not None:
+            # per-ROW seed tensor (the reference's [B, 1] topp_seed):
+            # each row draws from its own deterministic stream
+            srows = jnp.broadcast_to(
+                seeds.reshape(-1).astype(jnp.uint32),
+                (masked.shape[0],))
+            row_keys = jax.vmap(jax.random.key)(srows)
+            g = jax.vmap(
+                lambda kk: jax.random.gumbel(kk, masked.shape[1:]))(
+                row_keys)
+        else:
+            g = jax.random.gumbel(key, masked.shape)
         choice = jnp.argmax(masked + g, axis=-1)
         ids = jnp.take_along_axis(sorted_idx, choice[..., None], axis=-1)
         vals = jnp.take_along_axis(logits, ids, axis=-1)
         return vals, ids.astype(jnp.int64)
-    ops = (x, ps) + ((threshold,) if threshold is not None else ())
+    ops = (x, ps) + ((threshold,) if threshold is not None else ()) \
+        + ((topp_seed,) if topp_seed is not None else ())
     vals, ids = run_op("top_p_sampling", fn, ops, num_nondiff_outputs=1)
     return vals, ids

@@ -95,11 +95,29 @@ class TestFusedFunctionals:
         assert np.abs(out.numpy()[1, :, 3:]).max() == 0.0
         assert np.abs(out.numpy()[0]).max() > 0.0
 
-    def test_fused_multi_transformer_rejects_cache(self):
-        with pytest.raises(NotImplementedError):
-            self.F.fused_multi_transformer(
-                t(np.zeros((1, 2, 8), np.float32)), [], [], [], [], [],
-                [], [], [], [], [], [], [], cache_kvs=[1])
+    def test_fused_multi_transformer_cached_decode(self):
+        """Per-layer cache_kvs decode == the full causal pass (reference
+        fused_transformer decode contract)."""
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        paddle.seed(3)
+        E, H, S, L = 16, 4, 4, 2
+        net = FusedMultiTransformer(E, H, 32, dropout_rate=0.0,
+                                    normalize_before=True, num_layers=L)
+        net.eval()
+        x = t(rng.randn(2, S, E).astype(np.float32))
+        mask = np.where(np.tril(np.ones((S, S))), 0.0,
+                        -1e9).astype(np.float32)
+        full = net(x, attn_mask=t(mask[None, None]))
+        caches = [t(np.zeros((2, 2, H, 0, E // H), np.float32))
+                  for _ in range(L)]
+        outs = []
+        for step in range(S):
+            o, caches = net(x[:, step:step + 1], caches=caches)
+            outs.append(o.numpy())
+        np.testing.assert_allclose(np.concatenate(outs, axis=1),
+                                   full.numpy(), atol=1e-5)
+        assert all(list(c.shape) == [2, 2, H, S, E // H] for c in caches)
 
     def test_fused_mha_cached_decode_matches_full_pass(self):
         """cache_kv decode (reference fused_transformer.py:592,841):
