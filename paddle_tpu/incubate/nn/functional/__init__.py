@@ -493,6 +493,12 @@ def fused_multi_transformer(
             "fused_multi_transformer: preallocated-cache decode with "
             "time_step is not supported; pass growing cache_kvs "
             "(T grows by S each call) instead")
+    if rotary_embs is not None or pre_caches is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer: rotary_embs/pre_caches are not "
+            "supported — dropping them silently would corrupt rotary "
+            "models' attention; apply rotary embeddings in the model "
+            "(incubate.nn.functional.fused_rotary_position_embedding)")
     h = x
     n_layers = len(qkv_weights)
     cache_outs = [] if cache_kvs is not None else None

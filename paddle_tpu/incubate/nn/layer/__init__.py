@@ -258,10 +258,13 @@ class FusedMultiTransformer(Layer):
         grow each step; returns (out, cache_outs) when given (the
         reference's decode contract, fused_transformer.py:1025).
         Preallocated-cache time_step decode is not supported (raises)."""
-        if kw.get("time_step") is not None:
+        extra = {k: v for k, v in kw.items() if v is not None}
+        if extra:
             raise NotImplementedError(
-                "FusedMultiTransformer: preallocated-cache decode with "
-                "time_step is not supported; pass growing caches instead")
+                "FusedMultiTransformer.forward: unsupported kwargs "
+                f"{sorted(extra)} — silently dropping decode parameters "
+                "(time_step/rotary_embs/pre_caches/seq_lens) would give "
+                "wrong outputs; only growing `caches` decode is supported")
         h = src
         if caches is not None:
             if len(caches) != len(self.layers):

@@ -195,25 +195,91 @@ def _svd_on_host(*operands) -> bool:
     SVD-family ops (svd/pinv/lstsq) on the host in eager mode there —
     the reference keeps CPU fallback kernels for exactly this class
     (paddle/phi/core/kernel_factory.h CPU-fallback path). Differentiable
-    jnp path is kept on CPU (tests) and under tracing. When the caller
-    needs gradients the silent host detach would zero them — raise
-    instead so the failure is visible."""
+    jnp path is kept on CPU (tests) and under tracing; on TPU, grads ride
+    the host tape node with the analytic SVD vjp (_svd_host_node)."""
     if jax.default_backend() == "cpu":
         return False
-    from ..core import autograd as _ag
-    if _ag.is_tape_active() and any(
-            isinstance(o, Tensor) and not o.stop_gradient for o in operands):
-        raise NotImplementedError(
-            "svd/pinv/lstsq gradients are unavailable on the TPU backend "
-            "(the platform compiler cannot lower SVD; the op runs on the "
-            "host without a tape). Compute this op under paddle.no_grad() "
-            "or on the CPU backend.")
     return True
+
+
+def _needs_grad(*operands) -> bool:
+    from ..core import autograd as _ag
+    return _ag.is_tape_active() and any(
+        isinstance(o, Tensor) and not o.stop_gradient for o in operands)
+
+
+def _svd_vjp_host(u, s, vh, dus, dss, dvhs):
+    """Analytic thin-SVD vjp in numpy (the standard U/S/V cotangent
+    formula, batched over leading dims). u (..., m, k), s (..., k),
+    vh (..., k, n); cotangents may be None."""
+    m, k = u.shape[-2], u.shape[-1]
+    n = vh.shape[-1]
+    v = np.swapaxes(vh, -1, -2)
+    s2 = s[..., None, :] ** 2 - s[..., :, None] ** 2
+    eye = np.eye(k, dtype=bool)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        F = np.where(eye, 0.0, 1.0 / np.where(eye, 1.0, s2))
+    sinv = np.where(s > 0, 1.0 / np.maximum(s, 1e-38), 0.0)
+
+    mid = np.zeros(u.shape[:-2] + (k, k), u.dtype)
+    if dss is not None:
+        idx = np.arange(k)
+        mid[..., idx, idx] = dss
+    da_extra = 0.0
+    if dus is not None:
+        utdu = np.swapaxes(u, -1, -2) @ dus
+        J = F * (utdu - np.swapaxes(utdu, -1, -2))
+        mid = mid + J * s[..., None, :]
+        # component of dU outside span(U): (I - U U^T) dU S^{-1} V^T
+        proj = dus - u @ utdu
+        da_extra = da_extra + proj * sinv[..., None, :] @ vh
+    if dvhs is not None:
+        dv = np.swapaxes(dvhs, -1, -2)
+        vtdv = np.swapaxes(v, -1, -2) @ dv
+        K = F * (vtdv - np.swapaxes(vtdv, -1, -2))
+        mid = mid + s[..., :, None] * K
+        projv = dv - v @ vtdv
+        da_extra = da_extra + u * sinv[..., None, :] @ np.swapaxes(projv, -1, -2)
+    return u @ mid @ vh + da_extra
+
+
+def _svd_host_node(x):
+    """Host np SVD with a tape node whose vjp is the analytic formula —
+    the TPU path for differentiable svd (full_matrices=False only, like
+    jax's own svd JVP rule)."""
+    from ..core import autograd as _ag
+    a_np = np.asarray(x._data)
+    u, s, vh = np.linalg.svd(a_np, full_matrices=False)
+    outs = (jnp.asarray(u), jnp.asarray(s), jnp.asarray(vh))
+
+    a_dtype = a_np.dtype  # don't pin the input copy in the closure
+
+    def vjp_fn(cts):
+        du, ds, dvh = [None if c is None else np.asarray(c) for c in cts]
+        da = _svd_vjp_host(u, s, vh, du, ds, dvh)
+        return (jnp.asarray(da.astype(a_dtype)),)
+
+    node = _ag.TapeNode(
+        "svd_host", [x], vjp_fn,
+        [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs])
+    wrapped = []
+    for i, o in enumerate(outs):
+        t = Tensor(o, stop_gradient=False)
+        t._node = node
+        t._out_idx = i
+        wrapped.append(t)
+    return tuple(wrapped)
 
 
 def svd(x, full_matrices=False, name=None):
     a = x._data if isinstance(x, Tensor) else x
     if not isinstance(a, jax.core.Tracer) and _svd_on_host(x):
+        if _needs_grad(x):
+            if full_matrices:
+                raise NotImplementedError(
+                    "svd gradients need full_matrices=False (jax's own "
+                    "constraint)")
+            return _svd_host_node(x)
         u, s, vh = np.linalg.svd(np.asarray(a), full_matrices=full_matrices)
         return (Tensor(jnp.asarray(u)), Tensor(jnp.asarray(s)),
                 Tensor(jnp.asarray(vh)))
@@ -225,6 +291,26 @@ def pinv(x, rcond=1e-15, hermitian=False, name=None):
     a = x._data if isinstance(x, Tensor) else x
     if not isinstance(a, jax.core.Tracer) and not hermitian \
             and _svd_on_host(x):
+        if _needs_grad(x):
+            # compose from the differentiable host svd: grads flow
+            # through the analytic svd vjp (2-D only, like the svd node)
+            if len(a.shape) != 2:
+                raise NotImplementedError(
+                    "pinv gradients on the host-fallback path support 2-D "
+                    "inputs only; batch with a Python loop")
+            from . import manipulation as M
+            from . import math as Tm
+            dt = np.asarray(a).dtype
+            u, s, vh = svd(x, full_matrices=False)
+            cutoff = float(rcond) * float(np.max(np.asarray(s._data)))
+            sinv_np = np.where(np.asarray(s._data) > cutoff,
+                               1.0 / np.asarray(s._data), 0.0)
+            mask = Tensor(jnp.asarray((sinv_np > 0).astype(dt)))
+            sinv = mask / Tm.maximum(s, Tensor(jnp.asarray(
+                dt.type(max(cutoff, 1e-38)))))
+            vt = M.transpose(vh, [1, 0])
+            ut = M.transpose(u, [1, 0])
+            return matmul(vt * M.reshape(sinv, [1, -1]), ut)
         return Tensor(jnp.asarray(np.linalg.pinv(np.asarray(a), rcond=rcond)))
     return run_op("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), (x,))
 
@@ -248,9 +334,31 @@ def lstsq(x, y, rcond=None, driver=None, name=None):
     a0 = x._data if isinstance(x, Tensor) else x
     if not isinstance(a0, jax.core.Tracer) and _svd_on_host(x, y):
         b0 = y._data if isinstance(y, Tensor) else y
-        sol, res, rank, sv = np.linalg.lstsq(
-            np.asarray(a0), np.asarray(b0), rcond=rcond)
-        return (Tensor(jnp.asarray(sol)), Tensor(jnp.asarray(res)),
+        a_np, b_np = np.asarray(a0), np.asarray(b0)
+        if _needs_grad(x, y):
+            # differentiable solution via the composed host pinv (the
+            # minimum-norm least-squares solution IS pinv(A) @ b) with
+            # numpy's effective rcond (None -> eps * max(m, n)) so the
+            # forward matches the no-grad path; rank/sv come from a
+            # values-only svd pass and res from the solution itself (no
+            # duplicate full lstsq solve)
+            m, n = a_np.shape[-2], a_np.shape[-1]
+            rcond_eff = (float(rcond) if rcond is not None
+                         else np.finfo(a_np.dtype).eps * max(m, n))
+            sol = matmul(pinv(x, rcond=rcond_eff), y)
+            sv = np.linalg.svd(a_np, compute_uv=False)
+            rank = int(np.sum(sv > rcond_eff * (sv.max() if sv.size
+                                                else 0.0)))
+            if rank == n and m > n:
+                diff = a_np @ np.asarray(sol._data) - b_np
+                res = np.atleast_1d(np.sum(diff * diff, axis=0))
+            else:
+                res = np.zeros((0,), a_np.dtype)
+            return (sol, Tensor(jnp.asarray(res)),
+                    Tensor(jnp.asarray(np.int32(rank))),
+                    Tensor(jnp.asarray(sv)))
+        sol_np, res, rank, sv = np.linalg.lstsq(a_np, b_np, rcond=rcond)
+        return (Tensor(jnp.asarray(sol_np)), Tensor(jnp.asarray(res)),
                 Tensor(jnp.asarray(np.int32(rank))),
                 Tensor(jnp.asarray(sv)))
 

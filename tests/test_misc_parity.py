@@ -445,3 +445,26 @@ class TestTopPSamplingThreshold:
             _, idx = paddle.tensor.top_p_sampling(x, ps)
             seen2.add(int(idx.numpy()[0, 0]))
         assert {0, 1} <= seen2
+
+    def test_per_row_topp_seed(self):
+        """topp_seed is a [B, 1] PER-ROW seed tensor: same seed -> same
+        draw per row; changing one row's seed leaves other rows fixed."""
+        import paddle_tpu as paddle
+        x = t(np.random.RandomState(2).randn(3, 32).astype(np.float32))
+        ps = t(np.full(3, 0.95, np.float32))
+        s1 = t(np.array([[1], [2], [3]], np.int64))
+        s2 = t(np.array([[1], [999], [3]], np.int64))
+        _, a = paddle.tensor.top_p_sampling(x, ps, topp_seed=s1)
+        _, b = paddle.tensor.top_p_sampling(x, ps, topp_seed=s1)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        _, c = paddle.tensor.top_p_sampling(x, ps, topp_seed=s2)
+        assert a.numpy()[0, 0] == c.numpy()[0, 0]
+        assert a.numpy()[2, 0] == c.numpy()[2, 0]
+        diffs = 0
+        for v in range(5):
+            xs = t(np.random.RandomState(10 + v)
+                   .randn(3, 32).astype(np.float32))
+            _, d1 = paddle.tensor.top_p_sampling(xs, ps, topp_seed=s1)
+            _, d2 = paddle.tensor.top_p_sampling(xs, ps, topp_seed=s2)
+            diffs += int(d1.numpy()[1, 0] != d2.numpy()[1, 0])
+        assert diffs > 0, "row-1 seed has no effect"
