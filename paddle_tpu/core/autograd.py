@@ -240,6 +240,12 @@ def _run_backward(
         if g is None:
             aval = jax.ShapeDtypeStruct(t._data.shape, t._data.dtype)
             g = _ones(aval)
+        elif (create_graph and isinstance(g, Tensor)
+                and not g.stop_gradient):
+            # differentiable seed cotangent: keep the Tensor so the
+            # re-taped backward ops record it as an input (the
+            # vjp-of-vjp forward-mode trick depends on this)
+            pass
         else:
             g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
         if t._node is None:

@@ -109,17 +109,24 @@ class Hessian:
     def __init__(self, func, xs, is_batched=False):
         self._xs = xs if isinstance(xs, (list, tuple)) else [xs]
         arrays = [_unwrap(x) for x in self._xs]
-        if len(arrays) > 1:
-            raise NotImplementedError(
-                "Hessian over multiple inputs is not supported yet; "
-                "concatenate the inputs into one tensor")
 
         def scalar(*a):
             out = _functional(func)(*a)
             return out.reshape(()) if hasattr(out, "reshape") else out
-        h = jax.hessian(scalar)(*arrays)
-        n = arrays[0].size
-        self._mat = h.reshape(n, n)
+        argnums = tuple(range(len(arrays)))
+        h = jax.hessian(scalar, argnums=argnums)(*arrays)
+        if len(arrays) == 1:
+            n = arrays[0].size
+            self._mat = jnp.reshape(h if not isinstance(h, tuple)
+                                    else h[0][0], (n, n))
+        else:
+            # full block matrix over all inputs, flattened to (N, N)
+            sizes = [a.size for a in arrays]
+            rows = []
+            for i in range(len(arrays)):
+                rows.append([jnp.reshape(h[i][j], (sizes[i], sizes[j]))
+                             for j in range(len(arrays))])
+            self._mat = jnp.block(rows)
 
     def __getitem__(self, idx):
         return Tensor(self._mat[idx])
@@ -130,12 +137,53 @@ class Hessian:
 
 
 def forward_grad(outputs, inputs, grad_inputs=None):
-    """Forward-mode grads d outputs / d inputs (parity:
-    incubate.autograd.forward_grad; requires functional use via jvp)."""
-    raise NotImplementedError(
-        "forward_grad over recorded graphs is not supported; use "
-        "incubate.autograd.jvp(func, xs) — forward-mode AD on this "
-        "substrate is a functional transform")
+    """Forward-mode grads J·v of taped ``outputs`` w.r.t. ``inputs``
+    (parity: incubate.autograd.forward_grad). Implemented as
+    vjp-of-vjp on the tape's double-backward: with dummy differentiable
+    cotangents u, s(u) = <vjp_x(u), v> is linear in u, so grad_u s = J·v
+    — forward-mode without a jvp rule per op."""
+    from ...core import autograd as _ag
+    from ...core.tensor import Tensor
+
+    multi = isinstance(outputs, (list, tuple))
+    outs = list(outputs) if multi else [outputs]
+    ins = (list(inputs) if isinstance(inputs, (list, tuple))
+           else [inputs])
+    if grad_inputs is None:
+        vs = [Tensor(jnp.ones(tuple(t.shape), t._data.dtype))
+              for t in ins]
+    else:
+        gi = (grad_inputs if isinstance(grad_inputs, (list, tuple))
+              else [grad_inputs])
+        if len(gi) != len(ins):
+            raise ValueError(
+                f"forward_grad: {len(gi)} tangents for {len(ins)} inputs")
+        vs = [g if isinstance(g, Tensor) else Tensor(jnp.asarray(g))
+              for g in gi]
+        for v, t in zip(vs, ins):
+            if tuple(v.shape) != tuple(t.shape):
+                raise ValueError(
+                    f"forward_grad: tangent shape {tuple(v.shape)} != "
+                    f"input shape {tuple(t.shape)}")
+    us = [Tensor(jnp.zeros(tuple(o.shape), o._data.dtype),
+                 stop_gradient=False) for o in outs]
+    gx = _ag.grad(outs, ins, grad_outputs=us, retain_graph=True,
+                  create_graph=True, allow_unused=True)
+    s = None
+    for g, v in zip(gx, vs):
+        if g is None:
+            continue
+        term = (g * v).sum()
+        s = term if s is None else s + term
+    if s is None:   # outputs independent of inputs
+        jvps = [None] * len(us)
+    else:
+        # retain_graph: the re-taped grad nodes reference the ORIGINAL
+        # forward tape; freeing it here would break a later backward()
+        jvps = _ag.grad([s], us, retain_graph=True, allow_unused=True)
+    res = [Tensor(jnp.zeros(tuple(o.shape), o._data.dtype))
+           if j is None else j for j, o in zip(jvps, outs)]
+    return res if multi else res[0]
 
 
 def grad(outputs, inputs, grad_outputs=None):

@@ -342,13 +342,25 @@ def lstsq(x, y, rcond=None, driver=None, name=None):
             # forward matches the no-grad path; rank/sv come from a
             # values-only svd pass and res from the solution itself (no
             # duplicate full lstsq solve)
+            from . import manipulation as M
+            from . import math as Tm
             m, n = a_np.shape[-2], a_np.shape[-1]
             rcond_eff = (float(rcond) if rcond is not None
                          else np.finfo(a_np.dtype).eps * max(m, n))
-            sol = matmul(pinv(x, rcond=rcond_eff), y)
-            sv = np.linalg.svd(a_np, compute_uv=False)
-            rank = int(np.sum(sv > rcond_eff * (sv.max() if sv.size
-                                                else 0.0)))
+            # ONE host SVD: the differentiable factors give the pinv
+            # composition, their values give rank/sv
+            u_t, s_t, vh_t = svd(x, full_matrices=False)
+            sv = np.asarray(s_t._data)
+            cutoff = rcond_eff * (sv.max() if sv.size else 0.0)
+            dt = a_np.dtype
+            mask = Tensor(jnp.asarray((sv > cutoff).astype(dt)))
+            sinv = mask / Tm.maximum(s_t, Tensor(jnp.asarray(
+                dt.type(max(cutoff, 1e-38)))))
+            pinv_x = matmul(M.transpose(vh_t, [1, 0])
+                            * M.reshape(sinv, [1, -1]),
+                            M.transpose(u_t, [1, 0]))
+            sol = matmul(pinv_x, y)
+            rank = int(np.sum(sv > cutoff))
             if rank == n and m > n:
                 diff = a_np @ np.asarray(sol._data) - b_np
                 res = np.atleast_1d(np.sum(diff * diff, axis=0))

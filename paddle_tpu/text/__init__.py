@@ -150,14 +150,37 @@ class UCIHousing(Dataset):
 
 
 class Conll05st(Dataset):
-    """Parity stub for the SRL dataset: local-file only."""
+    """CoNLL-2005 SRL dataset over local files (parity:
+    paddle.text.Conll05st; the parsing engine is dataset/conll05.py's
+    bracketed-span -> BIO pipeline). Items are the reference's 9-tuple
+    (word_ids, 5x ctx ids, predicate ids, mark, label_ids)."""
 
-    def __init__(self, data_file=None, **kwargs):
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None,
+                 download=False, **kwargs):
         super().__init__()
         _need_file(data_file, "Conll05st")
-        raise NotImplementedError(
-            "Conll05st parsing is not ported yet; the class exists for "
-            "API-surface parity")
+        _need_file(word_dict_file, "Conll05st word dict")
+        _need_file(verb_dict_file, "Conll05st verb dict")
+        _need_file(target_dict_file, "Conll05st target dict")
+        from ..dataset import conll05 as C
+        self.word_dict = C.load_dict(word_dict_file)
+        self.predicate_dict = C.load_dict(verb_dict_file)
+        self.label_dict = C.load_label_dict(target_dict_file)
+        reader = C.reader_creator(C.corpus_reader(data_file),
+                                  self.word_dict, self.predicate_dict,
+                                  self.label_dict)
+        self._items = [tuple(np.asarray(col, np.int64) for col in row)
+                       for row in reader()]
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def __getitem__(self, i):
+        return self._items[i]
+
+    def __len__(self):
+        return len(self._items)
 
 
 class Imikolov(Dataset):

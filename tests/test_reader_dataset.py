@@ -406,3 +406,47 @@ class TestParsersWave2:
         chw = image.simple_transform(im, 16, 12, is_train=False,
                                      mean=[1.0, 2.0, 3.0])
         assert chw.shape == (3, 12, 12) and chw.dtype == np.float32
+
+
+class TestTextConll05st:
+    def test_text_conll05_over_synthetic_fixture(self, tmp_path):
+        """paddle.text.Conll05st (r3: parsing was a stub) delegates to
+        the dataset/conll05 pipeline: 9-tuple features from an
+        official-format tarball + dict files."""
+        import gzip as _gzip
+        import io as _io
+        import tarfile
+        from paddle_tpu.dataset import conll05
+        import paddle_tpu as paddle
+
+        tp = tmp_path / "conll05st-tests.tar.gz"
+        words = "The\ncat\nsat\n\n"
+        props = "-\t*\n-\t(A0*)\nsat\t(V*)\n\n".replace("\t", " ")
+        wz, pz = _io.BytesIO(), _io.BytesIO()
+        with _gzip.GzipFile(fileobj=wz, mode="wb") as f:
+            f.write(words.encode())
+        with _gzip.GzipFile(fileobj=pz, mode="wb") as f:
+            f.write(props.encode())
+        with tarfile.open(tp, "w:gz") as tf:
+            for name, blob in [(conll05.WORDS_NAME, wz.getvalue()),
+                               (conll05.PROPS_NAME, pz.getvalue())]:
+                info = tarfile.TarInfo(name)
+                info.size = len(blob)
+                tf.addfile(info, _io.BytesIO(blob))
+        wd = tmp_path / "words.dict"
+        wd.write_text("The\ncat\nsat\n")
+        vd = tmp_path / "verbs.dict"
+        vd.write_text("sat\n")
+        td = tmp_path / "targets.dict"
+        td.write_text("O\nB-A0\nB-V\n")
+        ds = paddle.text.Conll05st(
+            data_file=str(tp), word_dict_file=str(wd),
+            verb_dict_file=str(vd), target_dict_file=str(td))
+        assert len(ds) == 1
+        w, n2, n1, c0, p1, p2, pred, mark, lbl = ds[0]
+        np.testing.assert_array_equal(w, [0, 1, 2])
+        np.testing.assert_array_equal(mark, [1, 1, 1])
+        # load_label_dict order: B-A0=0 I-A0=1 B-V=2 I-V=3 O=4
+        np.testing.assert_array_equal(lbl, [4, 0, 2])
+        wd_, pd_, ld_ = ds.get_dict()
+        assert wd_["cat"] == 1 and pd_["sat"] == 0
