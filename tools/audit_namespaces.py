@@ -1,0 +1,96 @@
+"""Repeatable API-surface audit: for each mapped namespace, diff the
+NAMES THE REFERENCE IMPORTS (stricter than its __all__ lists — every
+`from x import y` in the reference's __init__) against this package's
+attributes. Prints one line per namespace and exits non-zero if any
+user-facing name is missing.
+
+Run:  JAX_PLATFORMS=cpu python tools/audit_namespaces.py [--ref /root/reference]
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import importlib
+import os
+import sys
+
+# (reference __init__ relative path, importable module name,
+#  known-internal names excluded from the user-facing diff)
+NAMESPACES = [
+    ("python/paddle/tensor/__init__.py", "paddle_tpu.tensor", ()),
+    ("python/paddle/nn/__init__.py", "paddle_tpu.nn", ()),
+    ("python/paddle/nn/functional/__init__.py", "paddle_tpu.nn.functional",
+     ()),
+    ("python/paddle/linalg.py", "paddle_tpu.linalg", ()),
+    ("python/paddle/distributed/__init__.py", "paddle_tpu.distributed", ()),
+    ("python/paddle/distributed/fleet/__init__.py",
+     "paddle_tpu.distributed.fleet", ()),
+    ("python/paddle/optimizer/__init__.py", "paddle_tpu.optimizer", ()),
+    ("python/paddle/io/__init__.py", "paddle_tpu.io", ()),
+    ("python/paddle/amp/__init__.py", "paddle_tpu.amp",
+     ("core",)),                     # paddle.base.core C extension
+    ("python/paddle/jit/__init__.py", "paddle_tpu.jit", ()),
+    ("python/paddle/autograd/__init__.py", "paddle_tpu.autograd",
+     ("backward_mode", "ir_backward")),  # PIR-internal modules
+    ("python/paddle/metric/__init__.py", "paddle_tpu.metric", ()),
+    ("python/paddle/vision/__init__.py", "paddle_tpu.vision", ()),
+    ("python/paddle/vision/transforms/__init__.py",
+     "paddle_tpu.vision.transforms", ()),
+    ("python/paddle/vision/models/__init__.py",
+     "paddle_tpu.vision.models", ()),
+    ("python/paddle/sparse/__init__.py", "paddle_tpu.sparse", ()),
+    ("python/paddle/distribution/__init__.py", "paddle_tpu.distribution",
+     ()),
+    ("python/paddle/text/__init__.py", "paddle_tpu.text", ()),
+    ("python/paddle/audio/__init__.py", "paddle_tpu.audio", ()),
+    ("python/paddle/quantization/__init__.py", "paddle_tpu.quantization",
+     ()),
+    ("python/paddle/static/__init__.py", "paddle_tpu.static",
+     ("setitem",)),                  # PIR setitem utility
+    ("python/paddle/incubate/__init__.py", "paddle_tpu.incubate",
+     # LayerHelper: framework-internal; auto_checkpoint: HDFS-bound;
+     # fuse_resnet_unit_pass: CUDA pass; xpu: Kunlun-only
+     ("LayerHelper", "auto_checkpoint", "fuse_resnet_unit_pass", "xpu")),
+    ("python/paddle/signal.py", "paddle_tpu.signal",
+     # jax owns the fft primitives; helpers are framework-internal
+     ("LayerHelper", "check_variable_and_dtype", "fft_c2c", "fft_c2r",
+      "fft_r2c", "in_dynamic_mode", "is_complex")),
+]
+
+
+def ref_imported_names(path: str) -> set:
+    names = set()
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                names.add(a.asname or a.name)
+    return names
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ref", default="/root/reference")
+    args = ap.parse_args()
+
+    failures = 0
+    for rel, mod_name, internal in NAMESPACES:
+        ref_path = os.path.join(args.ref, rel)
+        if not os.path.exists(ref_path):
+            print(f"{mod_name:40s} SKIP (no reference file)")
+            continue
+        mod = importlib.import_module(mod_name)
+        want = ref_imported_names(ref_path)
+        have = set(dir(mod))
+        missing = sorted(n for n in want
+                         if n not in have and not n.startswith("_")
+                         and n not in internal)
+        status = "OK" if not missing else f"MISSING {missing}"
+        print(f"{mod_name:40s} {len(want):4d} ref names  {status}")
+        failures += bool(missing)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
