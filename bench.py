@@ -270,11 +270,18 @@ def main():
         return (batch * seq * iters / best_dt, best_dt / iters * 1e3,
                 l0, l1)
 
-    # sweep: keep the best-throughput (batch, lm_ce) that fits (larger
-    # batches raise MXU utilization until HBM runs out; OOMs are skipped)
+    # sweep: keep the best-throughput (batch, mode) that fits (larger
+    # batches raise MXU utilization until HBM runs out; OOMs are skipped).
+    # Time-budgeted: a cold tunnel can take minutes per compile, and a
+    # child killed at its hard timeout reports NOTHING — better to stop
+    # sweeping and report the best measured so far.
+    sweep_deadline = time.monotonic() + 1000
     by_cand, sweep_err = {}, {}
     for b, mode in candidates:
         tag = f"b{b}/{mode}"
+        if by_cand and time.monotonic() > sweep_deadline:
+            sweep_err[tag] = "skipped: sweep time budget exhausted"
+            continue
         try:
             by_cand[(b, mode)] = measure(b, mode)
         except Exception as e:  # noqa: BLE001 — e.g. RESOURCE_EXHAUSTED
