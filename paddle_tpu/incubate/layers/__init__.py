@@ -16,7 +16,7 @@ from ...core import random as _random
 
 __all__ = ["shuffle_batch", "partial_concat", "partial_sum", "batch_fc",
            "fused_bn_add_act", "pow2_decay_with_linear_warmup",
-           "fused_embedding_seq_pool"]
+           "fused_embedding_seq_pool", "multiclass_nms2"]
 
 # Parameters these legacy graph-builder ops create, keyed by the user's
 # ParamAttr name (the reference's LayerHelper dedupes program vars the
@@ -202,3 +202,46 @@ def fused_embedding_seq_pool(input, size, is_sparse=False,
             vecs = jnp.where((ids == pad)[..., None], 0.0, vecs)
         return vecs.sum(axis=1)
     return run_op("fused_embedding_seq_pool", fn, (input, table))
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                    background_label=0, return_index=False,
+                    return_rois_num=False, name=None):
+    """Multi-class hard NMS (reference nn.py:195). bboxes (N, M, 4),
+    scores (N, C, M); per image and class: score filter -> top nms_top_k
+    (-1 = all) -> greedy NMS at nms_threshold evaluated against the
+    CURRENT adaptive threshold (nms_eta shrinks it after each kept box
+    while it exceeds 0.5, the reference NMSFast contract; ``normalized``
+    selects the pixel-coordinate IoU) -> cross-class keep_top_k. Returns
+    out rows [label, score, x1, y1, x2, y2] (reference arity: plus
+    global indices when return_index; per-image counts — the LoD analog
+    — only when return_rois_num)."""
+    from ...vision.ops import _batched_class_nms, _iou_matrix
+
+    bb = np.asarray(bboxes._data if isinstance(bboxes, Tensor) else bboxes)
+    sc = np.asarray(scores._data if isinstance(scores, Tensor) else scores)
+
+    def hard_nms(boxes_c, s_c):
+        iou = _iou_matrix(boxes_c, normalized=normalized)
+        thresh = float(nms_threshold)
+        kept = []
+        for i in range(len(s_c)):   # score-descending order already
+            # evaluate against the CURRENT threshold (adaptive NMS)
+            if any(iou[i, j] > thresh for j in kept):
+                continue
+            kept.append(i)
+            if nms_eta < 1.0 and thresh > 0.5:
+                thresh *= nms_eta
+        return [s_c[i] for i in kept], kept
+
+    dets, idxs, rois = _batched_class_nms(
+        bb, sc, score_threshold, nms_top_k, keep_top_k, background_label,
+        hard_nms)
+    out = Tensor(jnp.asarray(dets))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(idxs)))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(rois)))
+    return tuple(res) if len(res) > 1 else out

@@ -29,15 +29,58 @@ def _arr(x):
 
 # -- NMS family (host-side: output count is data-dependent) ----------------
 
-def _iou_matrix(boxes):
+def _iou_matrix(boxes, normalized=True):
+    """Pairwise IoU. ``normalized=False`` adds +1 to widths/heights — the
+    reference JaccardOverlap's pixel-coordinate convention."""
+    off = 0.0 if normalized else 1.0
     x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
-    area = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    area = np.maximum(x2 - x1 + off, 0) * np.maximum(y2 - y1 + off, 0)
     xx1 = np.maximum(x1[:, None], x1[None, :])
     yy1 = np.maximum(y1[:, None], y1[None, :])
     xx2 = np.minimum(x2[:, None], x2[None, :])
     yy2 = np.minimum(y2[:, None], y2[None, :])
-    inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+    inter = (np.maximum(xx2 - xx1 + off, 0)
+             * np.maximum(yy2 - yy1 + off, 0))
     return inter / np.maximum(area[:, None] + area[None, :] - inter, 1e-10)
+
+
+def _batched_class_nms(bb, sc, score_threshold, nms_top_k, keep_top_k,
+                       background_label, per_class_fn):
+    """Shared per-image/per-class NMS scaffold (used by matrix_nms and
+    incubate.layers.multiclass_nms2): score filter -> per-class top
+    nms_top_k (-1 = all) -> ``per_class_fn(boxes, scores) -> (scores,
+    local_keep_idx)`` -> cross-class keep_top_k -> (dets, index,
+    rois_num) per image, concatenated."""
+    N, C, M = sc.shape
+    all_out, all_idx, rois_num = [], [], []
+    for n in range(N):
+        dets, idxs = [], []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            sel = np.nonzero(s > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            sel = sel[np.argsort(-s[sel])]
+            if nms_top_k is not None and nms_top_k > -1:
+                sel = sel[:nms_top_k]
+            kept_scores, kept_local = per_class_fn(bb[n, sel], s[sel])
+            for ss, j in zip(kept_scores, kept_local):
+                dets.append([c, ss, *bb[n, sel[j]]])
+                idxs.append(n * M + sel[j])
+        dets = np.asarray(dets, np.float32) if dets else \
+            np.zeros((0, 6), np.float32)
+        idxs = np.asarray(idxs, np.int64) if idxs else \
+            np.zeros((0,), np.int64)
+        if len(dets) > keep_top_k >= 0:
+            order = np.argsort(-dets[:, 1])[:keep_top_k]
+            dets, idxs = dets[order], idxs[order]
+        all_out.append(dets)
+        all_idx.append(idxs)
+        rois_num.append(len(dets))
+    return (np.concatenate(all_out, 0), np.concatenate(all_idx, 0),
+            np.asarray(rois_num, np.int32))
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
@@ -77,57 +120,34 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
     matrix NMS). Host-side."""
     bb = np.asarray(_arr(bboxes))  # (N, M, 4)
     sc = np.asarray(_arr(scores))  # (N, C, M)
-    all_out, all_idx, rois_num = [], [], []
-    N, C, M = sc.shape
-    for n in range(N):
-        dets, idxs = [], []
-        for c in range(C):
-            if c == background_label:
-                continue
-            s = sc[n, c]
-            sel = np.nonzero(s > score_threshold)[0]
-            if sel.size == 0:
-                continue
-            sel = sel[np.argsort(-s[sel])][:nms_top_k]
-            boxes_c = bb[n, sel]
-            s_c = s[sel]
-            iou = _iou_matrix(boxes_c)
-            iou = np.triu(iou, k=1)
-            # compensate IoU: for suppressor i, its own max overlap with
-            # any higher-scored box (row-wise broadcast — SOLOv2 eq. 5)
-            iou_cmax = iou.max(0) if iou.size else np.zeros(len(sel))
-            if use_gaussian:
-                decay = np.exp((iou_cmax[:, None] ** 2 - iou ** 2)
-                               / gaussian_sigma).min(0) \
-                    if iou.size else np.ones(len(sel))
-            else:
-                decay = ((1 - iou)
-                         / np.maximum(1 - iou_cmax[:, None], 1e-10)).min(0) \
-                    if iou.size else np.ones(len(sel))
-            s_dec = s_c * decay
-            ok = s_dec >= post_threshold
-            for j in np.nonzero(ok)[0]:
-                dets.append([c, s_dec[j], *boxes_c[j]])
-                idxs.append(n * M + sel[j])
-        dets = np.asarray(dets, np.float32) if dets else \
-            np.zeros((0, 6), np.float32)
-        idxs = np.asarray(idxs, np.int64) if idxs else \
-            np.zeros((0,), np.int64)
-        if len(dets) > keep_top_k:
-            ordr = np.argsort(-dets[:, 1])[:keep_top_k]
-            dets, idxs = dets[ordr], idxs[ordr]
-        all_out.append(dets)
-        all_idx.append(idxs)
-        rois_num.append(len(dets))
-    out = Tensor(jnp.asarray(np.concatenate(all_out, 0)))
-    index = Tensor(jnp.asarray(np.concatenate(all_idx, 0)))
-    rn = Tensor(jnp.asarray(np.asarray(rois_num, np.int32)))
-    res = [out]
+
+    def soft_decay(boxes_c, s_c):
+        iou = _iou_matrix(boxes_c, normalized=normalized)
+        iou = np.triu(iou, k=1)
+        # compensate IoU: for suppressor i, its own max overlap with
+        # any higher-scored box (row-wise broadcast — SOLOv2 eq. 5)
+        iou_cmax = iou.max(0) if iou.size else np.zeros(len(s_c))
+        if use_gaussian:
+            decay = np.exp((iou_cmax[:, None] ** 2 - iou ** 2)
+                           / gaussian_sigma).min(0) \
+                if iou.size else np.ones(len(s_c))
+        else:
+            decay = ((1 - iou)
+                     / np.maximum(1 - iou_cmax[:, None], 1e-10)).min(0) \
+                if iou.size else np.ones(len(s_c))
+        s_dec = s_c * decay
+        kept = np.nonzero(s_dec >= post_threshold)[0]
+        return [s_dec[j] for j in kept], list(kept)
+
+    dets, idxs, rois = _batched_class_nms(
+        bb, sc, score_threshold, nms_top_k, keep_top_k, background_label,
+        soft_decay)
+    res = [Tensor(jnp.asarray(dets))]
     if return_index:
-        res.append(index)
+        res.append(Tensor(jnp.asarray(idxs)))
     if return_rois_num:
-        res.append(rn)
-    return tuple(res) if len(res) > 1 else out
+        res.append(Tensor(jnp.asarray(rois)))
+    return tuple(res) if len(res) > 1 else res[0]
 
 
 # -- RoI ops (XLA: fixed output shapes) ------------------------------------

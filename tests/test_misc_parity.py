@@ -424,6 +424,48 @@ class TestIncubateLayers:
         out = L.fused_bn_add_act(x, y)
         assert out.shape == [4, 8] and float(out.min()) >= 0
 
+    def test_multiclass_nms2(self):
+        from paddle_tpu.incubate import layers as L
+        bb = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                        [50, 50, 60, 60]]], np.float32)
+        sc = np.zeros((1, 2, 3), np.float32)
+        sc[0, 1] = [0.9, 0.8, 0.7]
+        out, idx, rn = L.multiclass_nms2(
+            t(bb), t(sc), score_threshold=0.1, nms_top_k=10,
+            keep_top_k=10, nms_threshold=0.5, return_index=True,
+            return_rois_num=True)
+        o = np.asarray(out._data)
+        assert o.shape == (2, 6) and int(rn.numpy()[0]) == 2
+        np.testing.assert_allclose(sorted(o[:, 1]), [0.7, 0.9])
+        assert set(np.asarray(idx._data).tolist()) == {0, 2}
+        # reference arity: bare call returns the tensor alone
+        out_only = L.multiclass_nms2(
+            t(bb), t(sc), score_threshold=0.1, nms_top_k=10,
+            keep_top_k=1, nms_threshold=0.5)
+        assert np.asarray(out_only._data).shape == (1, 6)
+        assert float(np.asarray(out_only._data)[0, 1]) == np.float32(0.9)
+        # nms_top_k=-1 keeps every candidate above threshold
+        sc3 = np.zeros((1, 2, 3), np.float32)
+        sc3[0, 1] = [0.9, 0.8, 0.7]
+        bb3 = np.array([[[0, 0, 1, 1], [10, 10, 11, 11],
+                         [20, 20, 21, 21]]], np.float32)
+        all3 = L.multiclass_nms2(t(bb3), t(sc3), score_threshold=0.1,
+                                 nms_top_k=-1, keep_top_k=-1,
+                                 nms_threshold=0.5)
+        assert np.asarray(all3._data).shape == (3, 6)
+        # adaptive nms_eta: threshold shrinks AFTER the first kept box,
+        # so a 0.6-IoU pair is suppressed at eta<1 but kept at eta=1
+        bbA = np.array([[[0, 0, 10, 4.0], [0, 0, 10, 6.65],
+                         [50, 50, 60, 60]]], np.float32)
+        scA = np.zeros((1, 2, 3), np.float32)
+        scA[0, 1] = [0.9, 0.8, 0.7]
+        keep_eta1 = L.multiclass_nms2(t(bbA), t(scA), 0.1, -1, -1,
+                                      nms_threshold=0.7, nms_eta=1.0)
+        keep_eta = L.multiclass_nms2(t(bbA), t(scA), 0.1, -1, -1,
+                                     nms_threshold=0.7, nms_eta=0.8)
+        assert np.asarray(keep_eta1._data).shape[0] == 3
+        assert np.asarray(keep_eta._data).shape[0] == 2
+
 
 class TestTopPSamplingThreshold:
     def test_threshold_floors_low_prob_tokens(self):
