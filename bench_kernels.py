@@ -194,6 +194,28 @@ def main():
             q, k, v, None, True, _s, 0.1, dkey),
         (q, k, v), results, iters=2, chain=4)
 
+    # ---- blockwise (vocab-streamed) LM-head+CE vs the unfused block:
+    # the sweep candidate bench.py relies on for batch>=16 --------------
+    from paddle_tpu.ops.fused_ce import blockwise_linear_cross_entropy
+    h_lm = jnp.asarray(rng.randn(8192, 768), jnp.bfloat16) * 0.02
+    w_lm = jnp.asarray(rng.randn(50304, 768), jnp.bfloat16) * 0.02
+    lab_lm = jnp.asarray(rng.randint(0, 50304, (8192,)), jnp.int32)
+
+    def unfused_lm(hh, ww):
+        logits = jnp.matmul(hh, ww.T, preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, lab_lm[:, None], 1)[:, 0]
+        return jnp.mean(lse - tgt)
+
+    bench_pair(
+        "lmce_8k_50k_blockwise_vs_plain",
+        lambda hh, ww: blockwise_linear_cross_entropy(hh, ww, lab_lm),
+        unfused_lm,
+        (h_lm, w_lm), results, chain=2,
+        # scalar loss: nudge the carry through one element per link
+        feedback=lambda out, hh: hh.at[:1, :1].add(
+            (out * np.float32(1e-30)).astype(hh.dtype)))
+
     # ---- fused cross-entropy at LM-head shapes --------------------------
     for name, rows, vocab in (("ce_4k_50k", 4096, 50304),
                               ("ce_8k_50k", 8192, 50304)):
