@@ -1,0 +1,130 @@
+// Native MultiSlot text parser — the hot loop of the PS-mode data
+// pipeline (reference analog: paddle/fluid/framework/data_feed.cc
+// MultiSlotDataFeed::ParseOneInstance, which parses the same
+// "<n> v_1 ... v_n" per-slot wire format in C++ worker threads).
+//
+// One call parses a whole pipe_command output buffer into pooled value
+// arrays plus per-(record, slot) offsets/lengths; Python wraps the pools
+// as numpy views and slices per record (zero re-tokenization in Python).
+//
+// Build: handled by paddle_tpu/core/native.py (g++ -O2 -shared -fPIC).
+#include <cstdlib>
+#include <cstring>
+#include <cstdio>
+#include <vector>
+
+extern "C" {
+
+typedef struct {
+  long n_records;
+  long n_slots;
+  long* lengths;        // n_records * n_slots
+  long long* ivals;     // int64 pool (slot dtype 0)
+  float* fvals;         // f32 pool  (slot dtype 1)
+  long n_ivals;
+  long n_fvals;
+  char err[256];        // non-empty on parse error
+} MSResult;
+
+static int skip_ws(const char* p, long n, long* i) {
+  while (*i < n && (p[*i] == ' ' || p[*i] == '\t' || p[*i] == '\r')) (*i)++;
+  return *i < n;
+}
+
+// Parse MultiSlot text: n_slots per line, dtypes[s] 0=int64 1=float32.
+// Returns a heap MSResult; caller frees with multislot_free. On parse
+// error, n_records is -1 and err describes the failure.
+MSResult* multislot_parse(const char* buf, long n, int n_slots,
+                          const int* dtypes) {
+  MSResult* r = (MSResult*)calloc(1, sizeof(MSResult));
+  r->n_slots = n_slots;
+  std::vector<long> lengths;
+  std::vector<long long> ivals;
+  std::vector<float> fvals;
+  long i = 0, line_no = 1;
+  while (i < n) {
+    // skip blank lines
+    long start = i;
+    while (i < n && buf[i] != '\n') i++;
+    long end = i;            // [start, end) is the line
+    if (i < n) i++;          // past '\n'
+    long j = start;
+    if (!skip_ws(buf, end, &j) || j >= end) { line_no++; continue; }
+    for (int s = 0; s < n_slots; s++) {
+      if (!skip_ws(buf, end, &j) || j >= end) {
+        snprintf(r->err, sizeof(r->err),
+                 "line %ld: missing count for slot %d", line_no, s);
+        r->n_records = -1;
+        return r;
+      }
+      char* endp = nullptr;
+      long cnt = strtol(buf + j, &endp, 10);
+      if (endp == buf + j || cnt < 0) {
+        snprintf(r->err, sizeof(r->err),
+                 "line %ld: bad count for slot %d", line_no, s);
+        r->n_records = -1;
+        return r;
+      }
+      j = endp - buf;
+      lengths.push_back(cnt);
+      for (long v = 0; v < cnt; v++) {
+        if (!skip_ws(buf, end, &j) || j >= end) {
+          snprintf(r->err, sizeof(r->err),
+                   "line %ld: slot %d expects %ld values, got %ld",
+                   line_no, s, cnt, v);
+          r->n_records = -1;
+          return r;
+        }
+        if (dtypes[s] == 0) {
+          long long val = strtoll(buf + j, &endp, 10);
+          if (endp == buf + j) {
+            snprintf(r->err, sizeof(r->err),
+                     "line %ld: bad int in slot %d", line_no, s);
+            r->n_records = -1;
+            return r;
+          }
+          ivals.push_back(val);
+        } else {
+          float val = strtof(buf + j, &endp);
+          if (endp == buf + j) {
+            snprintf(r->err, sizeof(r->err),
+                     "line %ld: bad float in slot %d", line_no, s);
+            r->n_records = -1;
+            return r;
+          }
+          fvals.push_back(val);
+        }
+        j = endp - buf;
+      }
+    }
+    skip_ws(buf, end, &j);
+    if (j < end && buf[j] != '\n') {
+      snprintf(r->err, sizeof(r->err),
+               "line %ld: trailing tokens after %d slots", line_no,
+               n_slots);
+      r->n_records = -1;
+      return r;
+    }
+    r->n_records++;
+    line_no++;
+  }
+  r->lengths = (long*)malloc(sizeof(long) * lengths.size());
+  memcpy(r->lengths, lengths.data(), sizeof(long) * lengths.size());
+  r->n_ivals = (long)ivals.size();
+  r->ivals = (long long*)malloc(sizeof(long long) * (ivals.size() + 1));
+  memcpy(r->ivals, ivals.data(), sizeof(long long) * ivals.size());
+  r->n_fvals = (long)fvals.size();
+  r->fvals = (float*)malloc(sizeof(float) * (fvals.size() + 1));
+  memcpy(r->fvals, fvals.data(), sizeof(float) * fvals.size());
+  return r;
+}
+
+void multislot_free(MSResult* r) {
+  if (!r) return;
+  free(r->lengths);
+  free(r->ivals);
+  free(r->fvals);
+  free(r);
+}
+
+}  // extern "C"

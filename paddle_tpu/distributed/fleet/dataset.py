@@ -26,6 +26,120 @@ __all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset",
            "FileInstantDataset", "BoxPSDataset"]
 
 
+def _parse_multislot_py(text: str, slot_dtypes, path: str = "mem"):
+    """Pure-Python MultiSlot parser (fallback when the native build is
+    unavailable) — validates exactly like csrc/multislot.cpp so the same
+    malformed input raises the same error regardless of toolchain."""
+    records = []
+    for line_no, line in enumerate(text.splitlines(), 1):
+        toks = line.split()
+        if not toks:
+            continue
+        rec, i = [], 0
+        for s, dt in enumerate(slot_dtypes):
+            if i >= len(toks):
+                raise ValueError(
+                    f"MultiSlot parse error in {path}: line {line_no}: "
+                    f"missing count for slot {s}")
+            try:
+                n = int(toks[i])
+            except ValueError:
+                raise ValueError(
+                    f"MultiSlot parse error in {path}: line {line_no}: "
+                    f"bad count for slot {s}") from None
+            if n < 0:
+                raise ValueError(
+                    f"MultiSlot parse error in {path}: line {line_no}: "
+                    f"bad count for slot {s}")
+            i += 1
+            vals = toks[i:i + n]
+            if len(vals) != n:
+                raise ValueError(
+                    f"MultiSlot parse error in {path}: line {line_no}: "
+                    f"slot {s} expects {n} values, got {len(vals)}")
+            i += n
+            try:
+                rec.append(np.asarray(
+                    vals, np.float32 if dt == "float32" else np.int64))
+            except ValueError:
+                raise ValueError(
+                    f"MultiSlot parse error in {path}: line {line_no}: "
+                    f"bad {'float' if dt == 'float32' else 'int'} in "
+                    f"slot {s}") from None
+        if i != len(toks):
+            raise ValueError(
+                f"MultiSlot parse error in {path}: line {line_no}: "
+                f"trailing tokens after {len(slot_dtypes)} slots")
+        records.append(rec)
+    return records
+
+
+def _parse_multislot(raw: bytes, slot_dtypes, path: str):
+    """Parse MultiSlot bytes with the native C++ tokenizer (the reference
+    keeps this loop in C++ worker threads, data_feed.cc); falls back to
+    Python if the toolchain is unavailable."""
+    import ctypes
+
+    try:
+        from ...core.native import load_native
+        lib = load_native("multislot")
+    except Exception:
+        return _parse_multislot_py(raw.decode(), slot_dtypes, path)
+
+    class _MSResult(ctypes.Structure):
+        _fields_ = [("n_records", ctypes.c_long),
+                    ("n_slots", ctypes.c_long),
+                    ("lengths", ctypes.POINTER(ctypes.c_long)),
+                    ("ivals", ctypes.POINTER(ctypes.c_longlong)),
+                    ("fvals", ctypes.POINTER(ctypes.c_float)),
+                    ("n_ivals", ctypes.c_long),
+                    ("n_fvals", ctypes.c_long),
+                    ("err", ctypes.c_char * 256)]
+
+    lib.multislot_parse.restype = ctypes.POINTER(_MSResult)
+    lib.multislot_parse.argtypes = [ctypes.c_char_p, ctypes.c_long,
+                                    ctypes.c_int,
+                                    ctypes.POINTER(ctypes.c_int)]
+    lib.multislot_free.argtypes = [ctypes.POINTER(_MSResult)]
+
+    ns = len(slot_dtypes)
+    dts = (ctypes.c_int * ns)(*[1 if d == "float32" else 0
+                                for d in slot_dtypes])
+    res = lib.multislot_parse(raw, len(raw), ns, dts)
+    try:
+        rr = res.contents
+        if rr.n_records < 0:
+            raise ValueError(
+                f"MultiSlot parse error in {path}: "
+                f"{rr.err.decode(errors='replace')}")
+        n_rec = int(rr.n_records)
+        lens = np.ctypeslib.as_array(rr.lengths,
+                                     shape=(n_rec * ns,)).copy() \
+            if n_rec else np.zeros((0,), np.int64)
+        ipool = np.ctypeslib.as_array(rr.ivals,
+                                      shape=(max(int(rr.n_ivals), 1),)
+                                      ).copy()[:int(rr.n_ivals)]
+        fpool = np.ctypeslib.as_array(rr.fvals,
+                                      shape=(max(int(rr.n_fvals), 1),)
+                                      ).copy()[:int(rr.n_fvals)]
+        records = []
+        io = fo = 0
+        for rec_i in range(n_rec):
+            rec = []
+            for s, dt in enumerate(slot_dtypes):
+                ln = int(lens[rec_i * ns + s])
+                if dt == "float32":
+                    rec.append(fpool[fo:fo + ln])
+                    fo += ln
+                else:   # ipool is already int64 (c_longlong): slice view
+                    rec.append(ipool[io:io + ln])
+                    io += ln
+            records.append(rec)
+        return records
+    finally:
+        lib.multislot_free(res)
+
+
 class DatasetBase:
     """Common init/filelist plumbing (reference dataset.py:24)."""
 
@@ -88,19 +202,10 @@ class DatasetBase:
             raise RuntimeError(
                 f"pipe_command {cmd!r} failed (exit {r.returncode}) on "
                 f"{path}: {r.stderr.decode(errors='replace')[-300:]}")
-        lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
         if not self._slot_names:
-            return lines
-        records = []
-        for line in lines:
-            toks = line.split()
-            rec, i = [], 0
-            for dt in self._slot_dtypes:
-                n = int(toks[i]); i += 1
-                vals = toks[i:i + n]; i += n
-                rec.append(np.asarray(
-                    vals, np.float32 if dt == "float32" else np.int64))
-            records.append(rec)
+            return [ln for ln in r.stdout.decode().splitlines()
+                    if ln.strip()]
+        records = _parse_multislot(r.stdout, self._slot_dtypes, path)
         return records
 
     def _batches_from(self, records):

@@ -485,6 +485,31 @@ class TestFleetDatasetAndMetrics:
         q.set_filelist([f])
         assert len(list(q)) == 4
 
+    def test_native_multislot_parser_matches_python(self):
+        """csrc/multislot.cpp (the data_feed.cc analog) and the Python
+        fallback parse identically; parse errors carry line info."""
+        from paddle_tpu.distributed.fleet.dataset import (
+            _parse_multislot, _parse_multislot_py)
+        raw = b"3 1 2 3 1 0.5\n2 7 8 1 1.5\n\n1 9 1 2.5\n"
+        dts = ["int64", "float32"]
+        rc = _parse_multislot(raw, dts, "mem")
+        rp = _parse_multislot_py(raw.decode(), dts)
+        # BOTH parsers validate identically (toolchain-independent errors)
+        for parse in (lambda b: _parse_multislot(b, dts, "mem"),
+                      lambda b: _parse_multislot_py(b.decode(), dts)):
+            with pytest.raises(ValueError, match="line 1"):
+                parse(b"2 1\n")
+            with pytest.raises(ValueError, match="trailing"):
+                parse(b"1 5 1 0.5 99\n")
+            with pytest.raises(ValueError, match="line 1"):
+                parse(b"-1 5 1 0.5\n")
+        assert len(rc) == len(rp) == 3
+        for a_rec, b_rec in zip(rc, rp):
+            for a, b in zip(a_rec, b_rec):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+                assert a.dtype == b.dtype
+
+
     def test_pipe_command_runs(self, tmp_path):
         """pipe_command is a real shell stage (reference contract): grep
         filters examples before parsing."""
