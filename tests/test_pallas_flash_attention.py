@@ -289,14 +289,15 @@ def test_autotune_block_cache_populates_and_consults(tmp_path):
     at.enable_autotune()
     at.set_autotune_cache_file(str(tmp_path / "cache.json"))
     try:
-        bq, bk, out = _tuned_blocks(q, k, v, None, seed0, True,
-                                    128.0 ** -0.5, 0.0, True)
+        imp, bq, bk, out = _tuned_blocks(q, k, v, None, seed0, True,
+                                         128.0 ** -0.5, 0.0, True)
+        assert imp == "pallas"
         assert (bq, bk) in {(128, 128), (256, 256)}
         assert out is not None            # miss: winner's output returned
         assert at.autotune_status()["cache_size"] >= 1
-        bq2, bk2, out2 = _tuned_blocks(q, k, v, None, seed0, True,
-                                       128.0 ** -0.5, 0.0, True)
-        assert (bq2, bk2) == (bq, bk)
+        imp2, bq2, bk2, out2 = _tuned_blocks(q, k, v, None, seed0, True,
+                                             128.0 ** -0.5, 0.0, True)
+        assert (imp2, bq2, bk2) == (imp, bq, bk)
         assert out2 is None               # hit: no re-measurement
     finally:
         at.disable_autotune()

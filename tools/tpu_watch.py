@@ -146,6 +146,20 @@ def capture(device_info: str) -> bool:
             else:
                 log(f"captured bench_kernels ({n} ratios)")
             ok = True
+            # kernel-perf regression gate (VERDICT r3 #7): validate the
+            # fresh capture against the stored baseline right away so a
+            # shipped-impl loss or >10% regression is CI-visible the
+            # moment it is measured
+            try:
+                g = subprocess.run(
+                    [sys.executable, "-m", "pytest", "-q",
+                     os.path.join(REPO, "tests", "test_kernel_gate.py")],
+                    capture_output=True, text=True, timeout=120, cwd=REPO)
+                tail = (g.stdout or "").strip().splitlines()[-1:]
+                log(f"kernel gate: exit {g.returncode} "
+                    f"{tail[0] if tail else ''}")
+            except Exception as e:  # noqa: BLE001
+                log(f"kernel gate run failed: {e!r}")
         else:
             log(f"bench_kernels capture failed: "
                 f"{(kern or {}).get('error', 'no/cpu result')}")
