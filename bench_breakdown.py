@@ -4,8 +4,9 @@ Times each component of the jitted train step in isolation so the gap
 between measured MFU and the 45% target can be attributed: full step,
 fwd+bwd (no optimizer), fwd only, the LM-head+CE block, the encoder
 stack, the embedding+final-norm shell, and the AdamW sweep. Prints one
-JSON line. Run on TPU when the tunnel is free (not part of the scored
-bench; tools/tpu_watch.py does not run it).
+JSON line. tools/tpu_watch.py captures it (artifacts/tpu_capture/
+bench_breakdown.json) whenever the tunnel is up, after the scored benches
+(VERDICT r3 #1: the MFU gap must be attributable).
 """
 from __future__ import annotations
 
@@ -163,7 +164,43 @@ def main():
         lambda p, s: opt_step(p, grads, s), params0,
         jax.tree_util.tree_map(jnp.copy, opt_state0))
 
-    res = {k: round(v, 3) for k, v in res.items()}
+    # 7. full step at the big-batch blockwise candidate (where the batch
+    # sweep's winner is expected to land): per-token comparison against
+    # row 1 shows what batch scaling + the streamed LM-head+CE buy
+    if on_tpu:
+        try:
+            import dataclasses
+
+            from paddle_tpu.models import write_back
+            del step, params0, opt_state0   # free b8 state before b32
+            paddle.seed(0)
+            model_b = GPTForCausalLM(dataclasses.replace(
+                cfg, lm_ce="blockwise"))
+            model_b.eval()
+            opt_b = paddle.optimizer.AdamW(
+                learning_rate=3e-4, weight_decay=0.01,
+                parameters=model_b.parameters())
+            step_b, params_b, opt_state_b = create_train_step(
+                model_b, opt_b, donate=True)
+            params_b = {k: (v.astype(jnp.bfloat16)
+                            if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                        for k, v in params_b.items()}
+            write_back(model_b, params_b)
+            bb = 32
+            ids_b = jnp.asarray(
+                rng.randint(0, cfg.vocab_size, (bb, seq + 1)), jnp.int32)
+            res["full_step_b32_blockwise_ms"] = timed(
+                lambda p, o: step_b(p, o, key, ids_b[:, :-1],
+                                    ids_b[:, 1:], 3e-4),
+                {k: jnp.copy(v) for k, v in params_b.items()},
+                jax.tree_util.tree_map(jnp.copy, opt_state_b), iters=5)
+            res["tokens_per_sec_b32_blockwise"] = round(
+                bb * seq / (res["full_step_b32_blockwise_ms"] / 1e3), 1)
+        except Exception as e:  # noqa: BLE001 — diagnostic row, not fatal
+            res["full_step_b32_blockwise_error"] = repr(e)[:160]
+
+    res = {k: (round(v, 3) if isinstance(v, (int, float)) else v)
+           for k, v in res.items()}
     res["derived"] = {
         "optimizer_overhead_ms": round(
             res["full_step_ms"] - res["fwd_bwd_ms"], 3),

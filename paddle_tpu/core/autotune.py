@@ -99,6 +99,19 @@ def _save() -> None:
             pass
 
 
+def record_meta(name: str, key_arrays, meta: str) -> None:
+    """Attach a side note to a cache key (stored under ``<key>__meta``).
+    Used e.g. to record the REAL batch size behind a batch-stripped
+    surrogate key, so a later sweep can spot and re-measure entries whose
+    serving batch drifted far from the measured one."""
+    _CACHE[_key(name, key_arrays) + "__meta"] = str(meta)
+    _save()
+
+
+def get_meta(name: str, key_arrays):
+    return _CACHE.get(_key(name, key_arrays) + "__meta")
+
+
 def _measure(fn, args, warmup: int = 1, iters: int = 3):
     out = fn(*args)
     jax.tree_util.tree_map(

@@ -200,7 +200,13 @@ class Engine:
 
         del mode
         jmesh = self.prepare()._jmesh
-        n_axes = dict(zip(jmesh.axis_names, jmesh.devices.shape))
+        if jmesh is None:
+            # no mesh given and the degree planner has not seen a batch
+            # yet: cost over all visible devices as one dp axis
+            import jax
+            n_axes = {"dp": len(jax.devices())}
+        else:
+            n_axes = dict(zip(jmesh.axis_names, jmesh.devices.shape))
         cfg = {"mp_degree": n_axes.get("tp", 1),
                "dp_degree": n_axes.get("dp", 1)}
         params = sum(int(np.prod(p.shape))

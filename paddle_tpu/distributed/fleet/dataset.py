@@ -213,6 +213,26 @@ class DatasetBase:
             yield from records
             return
         bs = self.proto_desc["batch_size"]
+        if not records:
+            import logging
+            logging.getLogger(__name__).error(
+                "MultiSlotDataset: file yielded ZERO records — the "
+                "pipeline will look empty; check parsers/pipe_command")
+            return
+        tail = len(records) % bs
+        if tail:
+            # drop-last is the PS trainer contract (fixed batch shapes for
+            # the jitted step), but a silent drop made a misconfigured
+            # pipeline look empty (advisor r3): log it, loudly when it is
+            # EVERYTHING
+            import logging
+            (logging.getLogger(__name__).warning if len(records) >= bs
+             else logging.getLogger(__name__).error)(
+                "MultiSlotDataset: dropping %d tail record(s) not filling "
+                "a batch of %d (%d record(s) total)%s", tail, bs,
+                len(records),
+                "" if len(records) >= bs else " — ZERO batches will be "
+                "yielded; check batch_size vs file size")
         for lo in range(0, len(records) - bs + 1, bs):
             chunk = records[lo:lo + bs]
             feed = {}

@@ -124,6 +124,15 @@ class FusedCommBuffer:
         # up (the reference's grad-accumulation contract)
         g = param.grad._data.reshape(-1).astype(self.grad_storage._data.dtype)
         self.grad_storage._data = self.grad_storage._data.at[lo:hi].add(g)
+        # bank-and-clear: this framework's backward() ACCUMULATES into
+        # param.grad (core/autograd.py _accumulate_grad), so leaving the
+        # banked value in place would double-count it when the next
+        # micro-step's backward adds on top and add_grad banks the running
+        # sum again (2*g1+g2 after two micro-steps). The reference never
+        # hits this because its grads are views INTO the fused buffer;
+        # here the buffer owns the running sum, so the param-side slot is
+        # zeroed once banked and every micro-step contributes its delta.
+        param.grad._data = jnp.zeros_like(param.grad._data)
         self._pending.discard(pid)
         if not self._pending:
             if use_comm:

@@ -187,11 +187,18 @@ def fused_embedding_seq_pool(input, size, is_sparse=False,
                              param_attr)
     pad = (padding_idx if padding_idx is None or padding_idx >= 0
            else int(size[0]) + int(padding_idx))
-    ids_np = np.asarray(input._data if isinstance(input, Tensor) else input)
-    if ids_np.size and (ids_np.min() < 0 or ids_np.max() >= int(size[0])):
-        raise ValueError(
-            f"fused_embedding_seq_pool: ids out of range [0, {size[0]}) "
-            f"(got min {ids_np.min()}, max {ids_np.max()})")
+    ids_arr = input._data if isinstance(input, Tensor) else input
+    if not isinstance(ids_arr, jax.core.Tracer):
+        # eager-only range check: under jit tracing a host materialization
+        # would raise TracerArrayConversionError (and a host sync is wrong
+        # inside a traced program anyway) — traced ids rely on the jnp
+        # gather's clip semantics like the other run_op ops here
+        ids_np = np.asarray(ids_arr)
+        if ids_np.size and (ids_np.min() < 0
+                            or ids_np.max() >= int(size[0])):
+            raise ValueError(
+                f"fused_embedding_seq_pool: ids out of range [0, {size[0]})"
+                f" (got min {ids_np.min()}, max {ids_np.max()})")
 
     def fn(ids, tab):
         ids = ids.astype(jnp.int32)

@@ -27,6 +27,49 @@ class PrecisionType:
     Int8 = "int8"
 
 
+class PassStrategy:
+    """Analysis-pass pipeline analog (reference AnalysisPredictor's
+    Argument -> AnalysisPass chain, inference/api/analysis_predictor.cc +
+    analysis/passes/). On the XLA substrate most of the reference's 121
+    graph passes ARE the compiler (fusion, constant folding, layout,
+    memory planning), so the pipeline here is short and every named pass
+    maps to a real mechanism:
+
+    - ``ir_graph_build_pass`` / ``ir_analysis_pass``: deserialize the
+      StableHLO artifact and hand it to XLA — jit.load + compile (these
+      markers exist so delete_pass/ordering semantics behave like the
+      reference's builder).
+    - ``convert_to_mixed_precision_pass``: cast stored params to the
+      configured precision at load (inference/convert.py mechanism,
+      applied in-memory).
+    - ``memory_optimize_pass``: release host-side input staging buffers
+      after each run (device buffer assignment itself is XLA's).
+    """
+
+    def __init__(self, passes):
+        self._passes = list(passes)
+
+    def all_passes(self):
+        return list(self._passes)
+
+    def delete_pass(self, name: str):
+        self._passes = [p for p in self._passes if p != name]
+
+    def append_pass(self, name: str):
+        if name not in self._passes:
+            self._passes.append(name)
+
+    def insert_pass(self, idx: int, name: str):
+        if name not in self._passes:
+            self._passes.insert(idx, name)
+
+    def __contains__(self, name: str):
+        return name in self._passes
+
+
+_DEFAULT_PASSES = ["ir_graph_build_pass", "ir_analysis_pass"]
+
+
 class Config:
     """Parity: paddle.inference.Config(prog_file, params_file) — here one
     prefix, the path given to paddle.jit.save."""
@@ -39,6 +82,7 @@ class Config:
         self.params_path = params_path
         self._precision = PrecisionType.Float32
         self._memory_pool_mb = None
+        self._pass_builder = PassStrategy(_DEFAULT_PASSES)
 
     def set_prog_file(self, path: str):
         self.model_path = path[:-len(".pdmodel")] \
@@ -47,14 +91,31 @@ class Config:
     def prog_file(self):
         return self.model_path
 
+    def pass_builder(self) -> PassStrategy:
+        """Parity: config.pass_builder() — mutate the analysis pipeline
+        (AppendPass/DeletePass, paddle_pass_builder.h)."""
+        return self._pass_builder
+
+    def delete_pass(self, name: str):
+        self._pass_builder.delete_pass(name)
+
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
         pass  # accelerator selection is the runtime's (libtpu) job
 
     def disable_gpu(self):
         pass
 
+    def enable_mixed_precision(self, precision=PrecisionType.Bfloat16):
+        """Store/load params in reduced precision (the in-memory form of
+        convert_to_mixed_precision; analysis pass analog of
+        convert_to_mixed_precision.cc)."""
+        self._precision = precision
+        self._pass_builder.append_pass("convert_to_mixed_precision_pass")
+
     def enable_memory_optim(self):
-        pass  # XLA owns buffer assignment
+        # XLA owns device buffer assignment; the pass frees HOST staging
+        # copies after each run (see PassStrategy docstring)
+        self._pass_builder.append_pass("memory_optimize_pass")
 
     def switch_ir_optim(self, flag=True):
         pass  # XLA owns graph optimization
@@ -97,8 +158,22 @@ class Predictor:
         from ..jit import load
         if config.model_path is None:
             raise ValueError("Config has no model path")
+        # the analysis pipeline (PassStrategy): ir_graph_build/-analysis
+        # ARE jit.load + XLA compile; the optional passes apply here
         self._layer = load(config.model_path)
         self._config = config
+        passes = config.pass_builder()
+        if "convert_to_mixed_precision_pass" in passes \
+                and config._precision != PrecisionType.Float32:
+            import ml_dtypes
+            dt = {PrecisionType.Bfloat16: ml_dtypes.bfloat16,
+                  PrecisionType.Half: np.float16}.get(config._precision)
+            if dt is None:
+                raise ValueError(
+                    f"unsupported inference precision "
+                    f"{config._precision!r}")
+            self._layer.convert_params(dt)
+        self._release_staging = "memory_optimize_pass" in passes
         n_in = len(self._layer.input_spec) or 1
         self._input_names = [f"x{i}" for i in range(n_in)]
         self._inputs: Dict[str, np.ndarray] = {}
@@ -123,6 +198,15 @@ class Predictor:
         if inputs is not None:
             args = [np.asarray(a) for a in inputs]
         else:
+            missing = [n for n in self._input_names
+                       if n not in self._inputs]
+            if missing:
+                extra = (" (input staging was freed by "
+                         "memory_optimize_pass after the previous run; "
+                         "copy_from_cpu again or pass inputs positionally)"
+                         if self._release_staging else "")
+                raise RuntimeError(
+                    f"Predictor.run: inputs {missing} not set{extra}")
             args = [self._inputs[n] for n in self._input_names]
         outs = self._layer(*args)
         outs = outs if isinstance(outs, (list, tuple)) else [outs]
@@ -130,6 +214,8 @@ class Predictor:
         self._outputs = {
             n: np.asarray(o.numpy() if hasattr(o, "numpy") else o)
             for n, o in zip(self._output_names, outs)}
+        if self._release_staging:
+            self._inputs.clear()   # memory_optimize_pass: free host copies
         if inputs is not None:
             return [self._outputs[n] for n in self._output_names]
         return True

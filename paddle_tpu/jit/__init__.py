@@ -19,6 +19,7 @@ import os
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.autograd import is_tape_active, tape_paused
@@ -221,13 +222,40 @@ class TranslatedLayer:
     def __call__(self, *args):
         from ..core import random as _random
         arrays = [_unwrap(a) for a in args]
+        state = self._state
+        orig = getattr(self, "_orig_dtypes", None)
+        if orig:
+            # params stored reduced (convert_params): cast back to the
+            # program's baked dtypes at the call boundary
+            state = {k: (jnp.asarray(v).astype(orig[k]) if k in orig
+                         else v) for k, v in state.items()}
         out = self._exported.call(
-            self._state, _random.default_generator.next_key(), *arrays)
+            state, _random.default_generator.next_key(), *arrays)
         if isinstance(out, (tuple, list)):
             return tuple(Tensor(o, stop_gradient=True) for o in out)
         return Tensor(out, stop_gradient=True)
 
     forward = __call__
+
+    def convert_params(self, dtype, black_list=None):
+        """Store floating params in ``dtype`` (halving their steady HBM/
+        host footprint), casting back to the program's baked dtypes at
+        call time — the in-memory form of
+        inference.convert_to_mixed_precision (the re-export path there is
+        the on-disk form). ``black_list`` names params kept at full
+        precision."""
+        bl = set(black_list or ())
+        self._orig_dtypes = dict(getattr(self, "_orig_dtypes", {}))
+        new_state = dict(self._state)
+        for k, v in self._state.items():
+            arr = jnp.asarray(v)
+            if k in bl or not jnp.issubdtype(arr.dtype, jnp.floating) \
+                    or arr.dtype == jnp.dtype(dtype):
+                continue
+            self._orig_dtypes.setdefault(k, arr.dtype)
+            new_state[k] = arr.astype(dtype)
+        self._state = new_state
+        return self
 
     def eval(self):
         self.training = False

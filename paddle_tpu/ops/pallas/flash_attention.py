@@ -1051,6 +1051,12 @@ def _tuned_blocks(q, k, v, bias, seed, causal, scale, rate, interpret,
                   jax.ShapeDtypeStruct((1,) + tuple(k.shape[1:]), k.dtype))
     choice, out = _autotune.pick_impl(tag, cands, (q, k), call,
                                       key_arrays=key_arrays)
+    if out is not None:
+        # fresh measurement: note the batch it ran at — the key is batch-
+        # stripped (tile optima are seq/head-determined), and the note
+        # lets a future sweep re-measure entries whose serving batch
+        # drifted far from the measured one (advisor r3)
+        _autotune.record_meta(tag, key_arrays, f"measured_batch={B}")
     if choice == "xla" and "xla" in cands:
         # the cache key is batch-stripped (tile optima are batch-invariant)
         # but the xla-vs-pallas choice is NOT: "xla" only returns when THIS

@@ -1,0 +1,213 @@
+"""String-tensor op family (SURVEY §2.1/§2.2 last uncovered subdir).
+
+Reference: ``paddle/phi/core/string_tensor.h:33`` (StringTensor as a
+TensorBase subclass holding pstring cells) and
+``paddle/phi/kernels/strings/`` (strings_empty / strings_empty_like /
+strings_lower / strings_upper with ASCII + UTF-8 variants,
+``strings_lower_upper_kernel.h``, ``case_utils.h``, ``unicode.h``; op
+schema ``paddle/phi/api/yaml/strings_ops.yaml``).
+
+TPU-native design: variable-length host strings are packed into a
+fixed-width ``uint8`` byte matrix ``[*shape, width]`` plus a length
+vector — the layout XLA can actually vectorize. The ASCII case-convert
+kernels are pure elementwise arithmetic on that matrix and run as jitted
+XLA programs (on TPU when available); the UTF-8 variants route through
+host unicode tables exactly like the reference's CPU pstring kernels
+(``use_utf8_encoding=True`` -> ``case_utils.h`` analog). ``strip`` and
+``split`` complete the family over the same packed layout.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["StringTensor", "to_string_tensor", "empty", "empty_like",
+           "lower", "upper", "strip", "split"]
+
+
+class StringTensor:
+    """Fixed-width packed string tensor: ``bytes_`` is ``[*shape, width]``
+    uint8, ``lengths`` is ``[*shape]`` int32 (bytes beyond the length are
+    zero padding). The analog of the reference's StringTensor
+    (string_tensor.h:33) on an accelerator-friendly layout."""
+
+    def __init__(self, bytes_, lengths):
+        self.bytes = jnp.asarray(bytes_, jnp.uint8)
+        self.lengths = jnp.asarray(lengths, jnp.int32)
+        if self.bytes.shape[:-1] != self.lengths.shape:
+            raise ValueError(
+                f"bytes {self.bytes.shape} / lengths {self.lengths.shape} "
+                "mismatch: bytes must be lengths.shape + (width,)")
+
+    # -- tensor-ish surface -------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.lengths.shape)
+
+    @property
+    def width(self) -> int:
+        return int(self.bytes.shape[-1])
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def numel(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def __repr__(self):
+        return (f"StringTensor(shape={self.shape}, width={self.width}, "
+                f"data={self.to_list()!r})")
+
+    def __eq__(self, other):
+        if not isinstance(other, StringTensor):
+            return NotImplemented
+        return self.to_list() == other.to_list()
+
+    # -- host conversion ----------------------------------------------------
+    def to_list(self):
+        """Nested python lists of ``str`` (invalid UTF-8 kept via
+        surrogateescape, mirroring pstring's byte-transparency)."""
+        b = np.asarray(self.bytes)
+        ln = np.asarray(self.lengths)
+        flat_b = b.reshape(-1, b.shape[-1])
+        flat_l = ln.reshape(-1)
+        items = [bytes(row[:n]).decode("utf-8", "surrogateescape")
+                 for row, n in zip(flat_b, flat_l)]
+        return _unflatten(items, self.shape)
+
+    def numpy(self):
+        return np.asarray(self.to_list(), dtype=object).reshape(self.shape)
+
+
+def _unflatten(items: List[str], shape):
+    if not shape:
+        return items[0]
+    if len(shape) == 1:
+        return list(items)
+    sub = int(np.prod(shape[1:]))
+    return [_unflatten(items[i * sub:(i + 1) * sub], shape[1:])
+            for i in range(shape[0])]
+
+
+def _flatten_strs(data) -> List[str]:
+    if isinstance(data, (str, bytes)):
+        return [data if isinstance(data, str)
+                else data.decode("utf-8", "surrogateescape")]
+    out: List[str] = []
+    for d in data:
+        out.extend(_flatten_strs(d))
+    return out
+
+
+def _shape_of(data):
+    if isinstance(data, (str, bytes)):
+        return ()
+    if isinstance(data, np.ndarray):
+        return tuple(data.shape)
+    if not isinstance(data, (list, tuple)):
+        return ()
+    if not data:
+        return (0,)
+    return (len(data),) + _shape_of(data[0])
+
+
+def to_string_tensor(data, width: Optional[int] = None) -> StringTensor:
+    """Pack python/numpy strings into a StringTensor; ``width`` defaults to
+    the longest UTF-8 encoding present (min 1)."""
+    if isinstance(data, StringTensor):
+        return data
+    if isinstance(data, np.ndarray):
+        shape = tuple(data.shape)
+        strs = [str(s) for s in data.reshape(-1)]
+    else:
+        shape = _shape_of(data)
+        strs = _flatten_strs(data)
+    raw = [s.encode("utf-8", "surrogateescape") for s in strs]
+    w = width or max([len(r) for r in raw] + [1])
+    buf = np.zeros((len(raw), w), np.uint8)
+    lens = np.zeros((len(raw),), np.int32)
+    for i, r in enumerate(raw):
+        if len(r) > w:
+            raise ValueError(f"string of {len(r)} bytes exceeds width {w}")
+        buf[i, :len(r)] = np.frombuffer(r, np.uint8)
+        lens[i] = len(r)
+    return StringTensor(buf.reshape(shape + (w,)), lens.reshape(shape))
+
+
+# -- creation ops (strings_ops.yaml: empty / empty_like) --------------------
+
+def empty(shape: Sequence[int], width: int = 1) -> StringTensor:
+    """All-empty strings of ``shape`` (reference strings_empty_kernel)."""
+    shape = tuple(int(d) for d in shape)
+    return StringTensor(np.zeros(shape + (width,), np.uint8),
+                        np.zeros(shape, np.int32))
+
+
+def empty_like(x: StringTensor) -> StringTensor:
+    """(reference strings_empty_like_kernel)"""
+    return empty(x.shape, x.width)
+
+
+# -- case conversion (strings_lower_upper_kernel.h) -------------------------
+
+@jax.jit
+def _ascii_lower(b):
+    up = (b >= ord("A")) & (b <= ord("Z"))
+    return jnp.where(up, b + 32, b).astype(jnp.uint8)
+
+
+@jax.jit
+def _ascii_upper(b):
+    lo = (b >= ord("a")) & (b <= ord("z"))
+    return jnp.where(lo, b - 32, b).astype(jnp.uint8)
+
+
+def _utf8_case(x: StringTensor, fn) -> StringTensor:
+    items = _flatten_strs(x.to_list()) if x.shape else [x.to_list()]
+    out = [fn(s) for s in items]
+    return to_string_tensor(_unflatten(out, x.shape) if x.shape else out[0])
+
+
+def lower(x: Union[StringTensor, list, np.ndarray],
+          use_utf8_encoding: bool = False) -> StringTensor:
+    """(reference strings_lower, strings_ops.yaml). ASCII mode is a jitted
+    elementwise XLA kernel over the packed bytes (non-ASCII bytes pass
+    through untouched, matching AsciiToLower in case_utils.h); UTF-8 mode
+    applies full unicode case mapping on host (UTF8ToLower analog)."""
+    x = to_string_tensor(x)
+    if use_utf8_encoding:
+        return _utf8_case(x, str.lower)
+    return StringTensor(_ascii_lower(x.bytes), x.lengths)
+
+
+def upper(x: Union[StringTensor, list, np.ndarray],
+          use_utf8_encoding: bool = False) -> StringTensor:
+    """(reference strings_upper, strings_ops.yaml)"""
+    x = to_string_tensor(x)
+    if use_utf8_encoding:
+        return _utf8_case(x, str.upper)
+    return StringTensor(_ascii_upper(x.bytes), x.lengths)
+
+
+# -- strip / split over the packed layout -----------------------------------
+
+def strip(x: Union[StringTensor, list, np.ndarray],
+          chars: Optional[str] = None) -> StringTensor:
+    """Per-element ``str.strip`` (completes the family the reference
+    scopes to case ops; layout preserved)."""
+    x = to_string_tensor(x)
+    return _utf8_case(x, lambda s: s.strip(chars))
+
+
+def split(x: Union[StringTensor, list, np.ndarray],
+          sep: Optional[str] = None, maxsplit: int = -1):
+    """Per-element ``str.split``; returns nested python lists (ragged
+    results cannot be a fixed-shape tensor)."""
+    x = to_string_tensor(x)
+    items = _flatten_strs(x.to_list()) if x.shape else [x.to_list()]
+    out = [s.split(sep, maxsplit) for s in items]
+    return _unflatten(out, x.shape) if x.shape else out[0]

@@ -249,6 +249,13 @@ def _svd_host_node(x):
     jax's own svd JVP rule)."""
     from ..core import autograd as _ag
     a_np = np.asarray(x._data)
+    if np.iscomplexobj(a_np):
+        # _svd_vjp_host implements the REAL-valued cotangent formula (no
+        # conjugation terms); silently wrong complex grads must not ship
+        raise NotImplementedError(
+            "differentiable svd on the host tape path supports real "
+            "dtypes only (the analytic vjp lacks the conjugate terms); "
+            "run complex svd under stop_gradient or on the CPU backend")
     u, s, vh = np.linalg.svd(a_np, full_matrices=False)
     outs = (jnp.asarray(u), jnp.asarray(s), jnp.asarray(vh))
 

@@ -289,6 +289,15 @@ class TestTensorFusion:
         buf = FusedCommBuffer(0, ps, None, acc_steps=2)
         for p in ps:                      # micro-step 1: no comm
             buf.add_grad(p, use_comm=False)
+        # bank-and-clear: the banked value left param.grad (advisor r3:
+        # backward() accumulates into .grad, so a retained bank would
+        # double-count on the next micro-step)
+        for p in ps:
+            np.testing.assert_allclose(p.grad.numpy(), 0.0)
+            # the next backward() accumulates into the zeroed slot; with
+            # the old retain-the-bank behavior this running sum would have
+            # banked 2*g1+g2
+            p.grad = paddle.to_tensor(np.ones(p.shape, np.float32))
         for p in ps:                      # micro-step 2: sync
             buf.add_grad(p)
         # (1 + 1) / acc_steps == 1

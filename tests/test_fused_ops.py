@@ -527,17 +527,23 @@ class TestAutotuneCache:
             seed = jnp.zeros((1,), jnp.int32)
             q1, k1, v1 = qkv(1)
             _tuned_blocks(q1, k1, v1, None, seed, True, 0.18, 0.0, True)
-            tiles = sorted(k for k in autotune._CACHE
-                           if k.startswith("flash_attention_blocks"))
+            def tile_keys():
+                return sorted(k for k in autotune._CACHE
+                              if k.startswith("flash_attention_blocks")
+                              and not k.endswith("__meta"))
+            tiles = tile_keys()
             assert len(tiles) == 1, tiles      # a real measurement ran
             assert "(1, 256, 2, 32)" in tiles[0]  # batch-1 surrogate key
+            # the measured batch rides in a side note so a future sweep
+            # can spot serving-batch drift (advisor r3)
+            assert autotune._CACHE.get(tiles[0] + "__meta") == \
+                "measured_batch=1"
             misses = autotune.autotune_status()["misses"]
             q4, k4, v4 = qkv(4)
             _tuned_blocks(q4, k4, v4, None, seed, True, 0.18, 0.0, True)
             assert autotune.autotune_status()["misses"] == misses, \
                 "batch-4 call re-measured: tile key not batch-agnostic"
-            assert sorted(k for k in autotune._CACHE
-                          if k.startswith("flash_attention_blocks")) == tiles
+            assert tile_keys() == tiles
         finally:
             flags.set_flags({"pallas_force_interpret": False})
             autotune.disable_autotune()

@@ -70,3 +70,62 @@ def test_convert_black_list_keeps_f32(tmp_path):
     others = [k for k in npz.files if k not in keep
               and np.issubdtype(npz0[k].dtype, np.floating)]
     assert others and all(npz[k].dtype == np.float16 for k in others)
+
+
+class TestAnalysisPassPipeline:
+    """Analysis-pass pipeline analog (reference AnalysisPredictor,
+    inference/api/analysis_predictor.cc + analysis/passes/): a short
+    PassStrategy whose named passes map onto real mechanisms — load/
+    compile, in-memory mixed-precision, staging-buffer release."""
+
+    def test_default_pipeline_and_builder_ops(self, tmp_path):
+        from paddle_tpu.inference import PassStrategy
+        cfg = Config("x")
+        pb = cfg.pass_builder()
+        assert isinstance(pb, PassStrategy)
+        assert pb.all_passes() == ["ir_graph_build_pass",
+                                   "ir_analysis_pass"]
+        pb.append_pass("memory_optimize_pass")
+        pb.insert_pass(0, "my_pass")
+        assert pb.all_passes()[0] == "my_pass"
+        pb.delete_pass("my_pass")
+        assert "my_pass" not in pb.all_passes()
+
+    def test_mixed_precision_pass_halves_live_params(self, tmp_path):
+        import ml_dtypes
+        prefix, mcfg = _save_tiny(tmp_path)
+        ids = np.random.RandomState(0).randint(
+            0, mcfg.vocab_size, (2, 16)).astype("int64")
+        o_ref = create_predictor(Config(prefix)).run([ids])[0]
+
+        cfg = Config(prefix)
+        cfg.enable_mixed_precision()          # appends the convert pass
+        pred = create_predictor(cfg)
+        st = pred._layer._state
+        float_keys = [k for k in st
+                      if np.asarray(st[k]).dtype == ml_dtypes.bfloat16]
+        assert float_keys, "no param was converted to bf16"
+        o_mixed = pred.run([ids])[0]
+        err = np.abs(o_ref - o_mixed).max() / (np.abs(o_ref).max() + 1e-9)
+        assert err < 0.05, f"mixed-precision pass drifted: {err}"
+
+    def test_deleting_convert_pass_disables_it(self, tmp_path):
+        prefix, _ = _save_tiny(tmp_path)
+        cfg = Config(prefix)
+        cfg.enable_mixed_precision()
+        cfg.delete_pass("convert_to_mixed_precision_pass")
+        pred = create_predictor(cfg)
+        assert all(np.asarray(v).dtype != "bfloat16"
+                   for v in pred._layer._state.values())
+
+    def test_memory_optimize_pass_releases_staging(self, tmp_path):
+        prefix, mcfg = _save_tiny(tmp_path)
+        cfg = Config(prefix)
+        cfg.enable_memory_optim()
+        pred = create_predictor(cfg)
+        ids = np.random.RandomState(0).randint(
+            0, mcfg.vocab_size, (2, 16)).astype("int64")
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.copy_from_cpu(ids)
+        assert pred.run() is True
+        assert pred._inputs == {}   # staging freed by the pass

@@ -1,0 +1,75 @@
+"""String-tensor family (reference paddle/phi/kernels/strings/ — empty,
+empty_like, lower, upper w/ ASCII + UTF-8 variants; strings_ops.yaml)."""
+import numpy as np
+import pytest
+
+from paddle_tpu import strings as S
+
+
+class TestStringTensor:
+    def test_pack_roundtrip_shapes(self):
+        data = [["abc", "Q"], ["", "héllo"]]
+        t = S.to_string_tensor(data)
+        assert t.shape == (2, 2)
+        assert t.to_list() == data
+        assert t.width == len("héllo".encode())
+
+    def test_scalar_and_numpy(self):
+        t = S.to_string_tensor("Hi")
+        assert t.shape == () and t.to_list() == "Hi"
+        t2 = S.to_string_tensor(np.array(["a", "bb"]))
+        assert t2.to_list() == ["a", "bb"]
+
+    def test_width_overflow_raises(self):
+        with pytest.raises(ValueError, match="exceeds width"):
+            S.to_string_tensor(["toolong"], width=3)
+
+    def test_empty_and_empty_like(self):
+        e = S.empty((2, 3))
+        assert e.shape == (2, 3)
+        assert e.to_list() == [[""] * 3] * 2
+        t = S.to_string_tensor([["xy", "z"]])
+        el = S.empty_like(t)
+        assert el.shape == t.shape and el.width == t.width
+        assert el.to_list() == [["", ""]]
+
+
+class TestCaseOps:
+    def test_ascii_lower_upper(self):
+        t = S.to_string_tensor(["MiXeD 123!", "ABC", "already"])
+        assert S.lower(t).to_list() == ["mixed 123!", "abc", "already"]
+        assert S.upper(t).to_list() == ["MIXED 123!", "ABC", "ALREADY"]
+
+    def test_ascii_mode_passes_non_ascii_through(self):
+        # case_utils.h AsciiToLower touches only [A-Z]/[a-z] bytes
+        t = S.to_string_tensor(["Ü-Boot"])
+        assert S.lower(t, use_utf8_encoding=False).to_list() == ["Ü-boot"]
+
+    def test_utf8_mode_full_unicode(self):
+        t = S.to_string_tensor(["Ü-Boot", "ΣΟΦΙΑ"])
+        assert S.lower(t, use_utf8_encoding=True).to_list() == \
+            ["ü-boot", "σοφια"]
+        assert S.upper(S.to_string_tensor(["straße"]),
+                       use_utf8_encoding=True).to_list() == ["STRASSE"]
+
+    def test_case_preserves_shape_2d(self):
+        t = S.to_string_tensor([["Aa", "Bb"], ["Cc", "Dd"]])
+        low = S.lower(t)
+        assert low.shape == (2, 2)
+        assert low.to_list() == [["aa", "bb"], ["cc", "dd"]]
+
+    def test_accepts_raw_lists(self):
+        assert S.upper(["ok"]).to_list() == ["OK"]
+
+
+class TestStripSplit:
+    def test_strip(self):
+        t = S.to_string_tensor(["  pad  ", "xxhixx"])
+        assert S.strip(t).to_list() == ["pad", "xxhixx"]
+        assert S.strip(t, "x").to_list() == ["  pad  ", "hi"]
+
+    def test_split(self):
+        t = S.to_string_tensor(["a,b,c", "one two"])
+        assert S.split(t, ",") == [["a", "b", "c"], ["one two"]]
+        assert S.split(t) == [["a,b,c"], ["one", "two"]]
+        assert S.split(S.to_string_tensor("x-y-z"), "-", 1) == ["x", "y-z"]
