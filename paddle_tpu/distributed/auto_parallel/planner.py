@@ -50,16 +50,23 @@ def _model_cfg_of(layer) -> Dict:
 
 def plan_parallel_layout(layer, sample_feed, devices=None, loss_fn=None,
                          hbm_bytes: Optional[float] = None,
-                         data_axis: str = "dp", model_axis: str = "tp"):
+                         data_axis: str = "dp", model_axis: str = "tp",
+                         profile_runner: Optional[Callable] = None):
     """Plan degrees + placements for ``layer`` over ``devices``.
 
     sample_feed: (x, y) arrays or ShapeDtypeStructs fixing the feed shapes
     (x.shape[0] is the global batch the dp axis must divide).
 
+    ``profile_runner(mesh, spec_fn) -> seconds``: optional measured-trial
+    hook (the auto_tuner's profile mode, tuner.py:21) — when given, the
+    surviving candidates are ranked by one timed real step each instead
+    of by the analytic cost alone; a candidate whose trial raises (e.g.
+    OOM) is skipped, exactly like a failed tuner trial.
+
     Returns ``(mesh, spec_fn, info)``: a ``jax.sharding.Mesh`` with axes
     (data_axis, model_axis), a ``name -> PartitionSpec`` function for
     every parameter, and a dict describing the search (candidates,
-    per-candidate costs, prune reasons, chosen degrees).
+    per-candidate costs, prune reasons, profile timings, chosen degrees).
     """
     import jax
     from jax.sharding import Mesh, PartitionSpec
@@ -83,6 +90,7 @@ def plan_parallel_layout(layer, sample_feed, devices=None, loss_fn=None,
 
     info: Dict = {"num_devices": n, "candidates": {}, "pruned": {}}
     best = None          # (cost, dp, tp, specs)
+    survivors = []       # (dp, tp, specs, cost) for the profile pass
     tp = 1
     while tp <= n:
         dp = n // tp
@@ -123,9 +131,37 @@ def plan_parallel_layout(layer, sample_feed, devices=None, loss_fn=None,
                     local_bytes += nbytes / (tp if sharded else 1)
                 cost = cost + 2.0 * (dp - 1) / max(dp, 1) * local_bytes
                 info["candidates"][tag] = round(float(cost), 1)
-                if np.isfinite(cost) and (best is None or cost < best[0]):
-                    best = (cost, dp, tp, specs)
+                if np.isfinite(cost):
+                    survivors.append((dp, tp, specs, cost))
+                    if best is None or cost < best[0]:
+                        best = (cost, dp, tp, specs)
         tp *= 2
+
+    if profile_runner is not None and len(survivors) > 1:
+        # measured trials override the analytic ranking (auto_tuner
+        # profile mode): one real step per candidate, failures skipped
+        info["profiled_s"] = {}
+        timed_best = None
+        for dp, tp, specs, cost in survivors:
+            tag = f"dp{dp}xtp{tp}"
+            mesh = Mesh(np.array(devices).reshape(dp, tp),
+                        (data_axis, model_axis))
+            try:
+                t = float(profile_runner(
+                    mesh, lambda name, _s=specs: _s.get(
+                        name, PartitionSpec())))
+            except Exception as e:  # noqa: BLE001 — a failed trial loses
+                info["profiled_s"][tag] = f"trial failed: {e!r}"[:120]
+                continue
+            info["profiled_s"][tag] = round(t, 4)
+            if timed_best is None or t < timed_best[0]:
+                # keep the winner's ANALYTIC cost in slot 0 so
+                # info["chosen"]["cost"] stays unit-consistent with
+                # info["candidates"]; the measured time rides separately
+                timed_best = (t, (cost, dp, tp, specs))
+        if timed_best is not None:
+            best = timed_best[1]
+            info["chosen_trial_s"] = round(timed_best[0], 4)
 
     if best is None:
         # nothing survived (e.g. odd device count with indivisible heads):

@@ -31,7 +31,8 @@ class DistModel:
                  strategy=None, metrics=None, mesh: ProcessMesh = None,
                  param_spec_fn: Optional[Callable] = None,
                  data_axis: str = "dp"):
-        del strategy, metrics
+        del metrics
+        self._strategy = strategy
         self._layer = layer
         self._loader = loader
         self._loss = loss
@@ -104,14 +105,55 @@ class DistModel:
                 "from: pass mesh=, dist.set_mesh(...), or run a batch")
         from .planner import plan_parallel_layout
         xs, ys = self._feed_structs(x, y)
+        tuning = getattr(self._strategy, "tuning", None)
+        profile_runner = None
+        if (getattr(tuning, "enable", False)
+                and getattr(tuning, "profile", False)
+                and self._optimizer is not None and y is not None):
+            profile_runner = self._make_profile_runner(x, y)
         mesh, spec_fn, info = plan_parallel_layout(
             self._layer, (xs, ys),
             loss_fn=self._loss if ys is not None else None,
-            data_axis=self._data_axis, model_axis=self._model_axis)
+            data_axis=self._data_axis, model_axis=self._model_axis,
+            profile_runner=profile_runner)
         self._jmesh = mesh
         self._planned_info = info
         if not self._explicit_spec_fn:
             self._spec_fn = spec_fn
+
+    def _make_profile_runner(self, x, y):
+        """One timed real train step per candidate mesh (the auto_tuner's
+        profile trial, tuner.py:21, run in-process on this mesh's devices
+        instead of via subprocess launches)."""
+        import time
+
+        import jax
+
+        x0 = np.asarray(x._data if isinstance(x, Tensor) else x)
+        y0 = np.asarray(y._data if isinstance(y, Tensor) else y)
+
+        def runner(mesh, spec_fn):
+            from ...models.trainer import create_sharded_train_step
+            loss_fn = None
+            if self._loss is not None:
+                def loss_fn(model, xx, yy, _lf=self._loss):
+                    return _lf(model(xx), yy)
+            step, params, opt_state, shard_batch = \
+                create_sharded_train_step(
+                    self._layer, self._optimizer, mesh, spec_fn,
+                    data_axis=self._data_axis, loss_fn=loss_fn)
+            xs, ys = shard_batch(x0), shard_batch(y0)
+            key = jax.random.key(0)
+            loss, params, opt_state = step(params, opt_state, key, xs, ys,
+                                           1e-3)      # compile + run
+            jax.device_get(loss)
+            t0 = time.perf_counter()
+            loss, params, opt_state = step(params, opt_state, key, xs, ys,
+                                           1e-3)
+            jax.device_get(loss)                      # closes the window
+            return time.perf_counter() - t0
+
+        return runner
 
     @staticmethod
     def _feed_structs(x, y):

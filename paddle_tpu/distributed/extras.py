@@ -236,6 +236,13 @@ class Strategy:
             cfg.get("amp"))
         self.recompute = Strategy._Config(
             dict(enable=False), cfg.get("recompute"))
+        # degree-planner tuning (reference: Strategy's tuning config +
+        # auto_tuner profile trials, auto_tuner/tuner.py:21): with
+        # profile=True the planner times ONE real sharded step per
+        # surviving (dp, tp) candidate and ranks by measurement instead of
+        # the analytic cost alone
+        self.tuning = Strategy._Config(
+            dict(enable=False, profile=False), cfg.get("tuning"))
 
 
 # -- dataset entry configs (PS-stack metadata; inventoried for parity) -----

@@ -43,6 +43,10 @@ class GPTConfig:
     # gradient-checkpoint each encoder layer (fleet recompute; active in
     # train mode): ~1/L activation memory for one extra encoder forward
     use_recompute: bool = False
+    # what remat saves: "full" (reference behavior: replay everything) or
+    # "dots_saveable"/"selective" (keep matmul outputs, recompute only
+    # elementwise — near-zero extra FLOPs at higher residual memory)
+    recompute_policy: str = "full"
 
 
 def gpt2_small():
@@ -75,6 +79,7 @@ class GPTModel(nn.Layer):
         self.encoder = nn.TransformerEncoder(enc_layer, config.num_layers)
         # per-layer gradient checkpointing (train mode; fleet recompute)
         self.encoder.enable_recompute = config.use_recompute
+        self.encoder.recompute_policy = config.recompute_policy
         self.ln_f = nn.LayerNorm(config.hidden_size,
                                  epsilon=config.layer_norm_eps)
 

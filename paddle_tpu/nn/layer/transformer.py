@@ -168,7 +168,9 @@ class TransformerEncoder(Layer):
             from ...distributed.fleet.recompute import recompute
         for i, layer in enumerate(self.layers):
             if cache is None:
-                output = (recompute(layer, output, src_mask)
+                output = (recompute(layer, output, src_mask,
+                                    policy=getattr(self,
+                                                   "recompute_policy", None))
                           if recompute_on else layer(output, src_mask))
             else:
                 output, c = layer(output, src_mask, cache[i])

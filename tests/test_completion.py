@@ -331,3 +331,24 @@ class TestPlanner:
         assert info["chosen"]["dp_degree"] * info["chosen"]["mp_degree"] \
             == jax.device_count()
         assert hist["loss"][-1] < hist["loss"][0]
+
+    def test_profile_trial_planning(self):
+        """tuning.profile=True: the planner ranks surviving candidates by
+        a timed real step (the auto_tuner profile mode, tuner.py:21)."""
+        from paddle_tpu.distributed import Strategy
+        from paddle_tpu.distributed.auto_parallel.engine import Engine
+        from paddle_tpu.models.llama import causal_lm_loss
+        model, cfg = self._llama()
+        opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+        strat = Strategy({"tuning": {"enable": True, "profile": True}})
+        eng = Engine(model, loss=causal_lm_loss, optimizer=opt,
+                     strategy=strat)
+        rng = np.random.RandomState(0)
+        data = rng.randint(0, cfg.vocab_size, (8, 17)).astype(np.int64)
+        hist = eng.fit((data[:, :-1], data[:, 1:]), epochs=1, batch_size=8)
+        info = eng.prepare()._planned_info
+        assert "profiled_s" in info
+        timed = [v for v in info["profiled_s"].values()
+                 if isinstance(v, float)]
+        assert timed, info["profiled_s"]
+        assert np.isfinite(hist["loss"][0])
