@@ -137,6 +137,11 @@ def plan_parallel_layout(layer, sample_feed, devices=None, loss_fn=None,
                         best = (cost, dp, tp, specs)
         tp *= 2
 
+    if profile_runner is not None and len(survivors) <= 1:
+        # profiling requested but nothing to compare: keep the info
+        # contract (the key always exists when profile mode was asked)
+        info["profiled_s"] = {"skipped": f"{len(survivors)} survivor(s); "
+                              "nothing to rank"}
     if profile_runner is not None and len(survivors) > 1:
         # measured trials override the analytic ranking (auto_tuner
         # profile mode): one real step per candidate, failures skipped
