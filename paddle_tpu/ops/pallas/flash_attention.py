@@ -1028,7 +1028,11 @@ def _tuned_blocks(q, k, v, bias, seed, causal, scale, rate, interpret,
                                        or dropout_key is not None):
         cands["xla"] = None
     bias_sig = "x".join(map(str, bias.shape)) if bias is not None else "0"
-    tag = (f"flash_attention_blocks_c{int(causal)}_r{int(rate > 0)}"
+    # v2: the candidate set gained the whole-op "xla" entry and the GQA
+    # routing default (r4) — r3-persisted winners (incl. the GQA 128x128
+    # tile measured before the per-direction work) must MISS, not pin the
+    # old behavior
+    tag = (f"flash_attention_blocks_v2_c{int(causal)}_r{int(rate > 0)}"
            f"_b{bias_sig}")
 
     from .select import vjp_probe
