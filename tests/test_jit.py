@@ -130,10 +130,22 @@ class TestDistToStatic:
         out = dm(x)
         np.testing.assert_allclose(out.numpy(), net(x).numpy(), rtol=1e-5)
 
-    def test_requires_mesh(self):
+    def test_no_mesh_defers_to_planner(self):
+        """r4 contract change: NO mesh no longer raises at construction —
+        the degree planner derives one from the first batch's shapes
+        (auto_parallel/planner.py); using the model before any batch is
+        the error."""
         dist.set_mesh(None)
-        with pytest.raises(ValueError, match="mesh"):
-            dist.to_static(_net())
+        dm = dist.to_static(_net())          # defers planning
+        assert dm._jmesh is None
+        with pytest.raises(ValueError, match="no mesh and no sample"):
+            dm._plan_mesh(None, None)        # nothing to plan from
+        # first batch plans a mesh and runs
+        x = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+        out = dm(x)
+        assert dm._jmesh is not None
+        assert dm._planned_info and "chosen" in dm._planned_info
+        assert out.shape[0] == 8
 
 
 class TestDistModelRetraceGuard:
