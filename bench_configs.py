@@ -44,6 +44,9 @@ def _mfu_llama(cfg, seq, tokens_per_sec, peak):
 
 
 def bench_llama(dev, on_tpu, zero3=False):
+    import dataclasses
+    import gc
+
     import jax
     import jax.numpy as jnp
 
@@ -63,65 +66,103 @@ def bench_llama(dev, on_tpu, zero3=False):
                           num_heads=16, num_kv_heads=16,
                           max_position_embeddings=2048, dropout=0.0,
                           lm_ce="blockwise")
-        batch, seq, iters, windows = 4, 2048, 10, 2
+        seq, iters, windows = 2048, 10, 2
+        # (batch, remat): b4 no-remat is the known-fitting r3 config and
+        # is measured FIRST; b8 with selective remat (keep matmul outputs,
+        # recompute elementwise) chases MXU utilization — an OOM there is
+        # recorded, never fatal
+        cands = ((4, False), (8, True)) if not zero3 else ((4, False),)
     else:
         cfg = LlamaConfig(vocab_size=256, hidden_size=64,
                           intermediate_size=128, num_layers=2, num_heads=4,
                           num_kv_heads=4, max_position_embeddings=128)
-        batch, seq, iters, windows = 2, 64, 3, 2
+        seq, iters, windows = 64, 3, 2
+        cands = ((2, False),)
 
-    # HBM budget at 0.7B on one v5e (15.75 GB): f32 init params 2.8 GB +
-    # f32 AdamW moments 5.5 GB must never coexist with protective donate
-    # copies (r3: setup peak 16.5 GB -> ResourceExhausted). donate="consume"
-    # skips the copies (one-shot bench; the stateful model is invalidated
-    # by the first step), and writing the bf16 cast back into the model
-    # frees the f32 originals before the first step runs.
-    paddle.seed(0)
-    model = LlamaForCausalLM(cfg)
-    model.eval()
-    opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters())
-    if zero3:
-        from jax.sharding import Mesh
-        mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "tp"))
-        named = {k: tuple(v.shape) for k, v in model.named_parameters()}
-        spec = lambda name: llama_fsdp_spec(  # noqa: E731
-            name, named.get(name, (1,)), 1)
-        step, params, opt_state, shard_batch = create_sharded_train_step(
-            model, opt, mesh, spec, donate="consume")
-    else:
-        step, params, opt_state = create_train_step(model, opt,
-                                                    donate="consume")
-        shard_batch = lambda a: jnp.asarray(a)  # noqa: E731
+    def run_candidate(batch, remat):
+        # HBM budget at 0.7B on one v5e (15.75 GB): f32 init params
+        # 2.8 GB + f32 AdamW moments 5.5 GB must never coexist with
+        # protective donate copies (r3: setup peak 16.5 GB ->
+        # ResourceExhausted). donate="consume" skips the copies (the
+        # stateful model is invalidated by the first step), and writing
+        # the bf16 cast back frees the f32 originals pre-step.
+        paddle.seed(0)
+        ccfg = dataclasses.replace(cfg, use_recompute=remat,
+                                   recompute_policy="dots_saveable")
+        model = LlamaForCausalLM(ccfg)
+        model.train() if remat else model.eval()
+        opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters())
+        if zero3:
+            from jax.sharding import Mesh
+            mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
+                        ("dp", "tp"))
+            named = {k: tuple(v.shape)
+                     for k, v in model.named_parameters()}
+            spec = lambda name: llama_fsdp_spec(  # noqa: E731
+                name, named.get(name, (1,)), 1)
+            step, params, opt_state, shard_batch = \
+                create_sharded_train_step(model, opt, mesh, spec,
+                                          donate="consume")
+        else:
+            step, params, opt_state = create_train_step(
+                model, opt, donate="consume")
+            shard_batch = lambda a: jnp.asarray(a)  # noqa: E731
 
-    params = {k: (v.astype(jnp.bfloat16)
-                  if jnp.issubdtype(v.dtype, jnp.floating) else v)
-              for k, v in params.items()}
-    write_back(model, params)  # drop the last refs to the f32 originals
-    rng = np.random.RandomState(0)
-    ids = rng.randint(0, cfg.vocab_size, (batch, seq + 1))
-    x = shard_batch(ids[:, :-1].astype(np.int32))
-    y = shard_batch(ids[:, 1:].astype(np.int32))
-    key = jax.random.key(0)
+        params = {k: (v.astype(jnp.bfloat16)
+                      if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                  for k, v in params.items()}
+        write_back(model, params)  # drop last refs to the f32 originals
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (batch, seq + 1))
+        x = shard_batch(ids[:, :-1].astype(np.int32))
+        y = shard_batch(ids[:, 1:].astype(np.int32))
+        key = jax.random.key(0)
 
-    loss, params, opt_state = step(params, opt_state, key, x, y, 3e-4)
-    loss0 = float(jax.device_get(loss))
-    best = float("inf")
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        for i in range(iters):
-            loss, params, opt_state = step(params, opt_state,
-                                           jax.random.fold_in(key, i),
-                                           x, y, 3e-4)
-        loss_end = float(jax.device_get(loss))  # closes the window
-        best = min(best, time.perf_counter() - t0)
-    tps = batch * seq * iters / best
-    mfu = _mfu_llama(cfg, seq, tps, peak_flops_per_chip(dev))
-    n_params = sum(int(np.prod(v.shape)) for v in params.values())
-    return {"tokens_per_sec": round(tps, 1), "mfu": round(mfu, 4),
-            "params": n_params, "batch": batch, "seq": seq,
-            "loss_start": round(loss0, 4), "loss_end": round(loss_end, 4),
-            "loss_finite_and_moving": bool(
-                np.isfinite(loss_end) and loss_end != loss0)}
+        loss, params, opt_state = step(params, opt_state, key, x, y, 3e-4)
+        loss0 = float(jax.device_get(loss))
+        best = float("inf")
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for i in range(iters):
+                loss, params, opt_state = step(params, opt_state,
+                                               jax.random.fold_in(key, i),
+                                               x, y, 3e-4)
+            loss_end = float(jax.device_get(loss))  # closes the window
+            best = min(best, time.perf_counter() - t0)
+        tps = batch * seq * iters / best
+        n_params = sum(int(np.prod(v.shape)) for v in params.values())
+        return {"tokens_per_sec": round(tps, 1),
+                "mfu": round(_mfu_llama(cfg, seq, tps,
+                                        peak_flops_per_chip(dev)), 4),
+                "params": n_params, "batch": batch, "seq": seq,
+                "remat": remat,
+                "loss_start": round(loss0, 4),
+                "loss_end": round(loss_end, 4),
+                "loss_finite_and_moving": bool(
+                    np.isfinite(loss_end) and loss_end != loss0)}
+
+    result, sweep = None, {}
+    for batch, remat in cands:
+        tag = f"b{batch}{'+remat_dots' if remat else ''}"
+        r = None
+        try:
+            r = run_candidate(batch, remat)
+        except Exception as e:  # noqa: BLE001 — e.g. RESOURCE_EXHAUSTED
+            sweep[tag] = f"{type(e).__name__}: {e}"[:120]
+        if r is not None:
+            sweep[tag] = r["tokens_per_sec"]
+            if result is None \
+                    or r["tokens_per_sec"] > result["tokens_per_sec"]:
+                result = r
+        # free this candidate's buffers before the next one builds:
+        # OUTSIDE the except block, where the exception's traceback no
+        # longer pins the failed candidate's frame (and its ~8 GB of
+        # device buffers) against collection
+        gc.collect()
+    if result is None:
+        raise RuntimeError(f"every llama candidate failed: {sweep}")
+    result["batch_sweep"] = sweep
+    return result
 
 
 def bench_bert_1f1b(on_tpu):

@@ -37,9 +37,12 @@ _POLICIES = {
     # selective remat: save matmul/dot outputs, recompute only cheap
     # elementwise work — ~0 extra matmul FLOPs vs full remat's +1 forward
     # (the fwd FLOPs are ~2/6 of a train step, so full per-layer remat
-    # costs ~33% throughput; selective costs ~0 at higher memory)
+    # costs ~33% throughput; selective costs ~0 at higher memory).
+    # "selective" is an alias of dots_saveable — NOT the
+    # no-batch-dims variant, which re-runs every batched matmul
+    # (attention BMMs) and forfeits exactly the FLOPs this exists to keep
     "dots_saveable": "dots_saveable",
-    "selective": "dots_with_no_batch_dims_saveable",
+    "selective": "dots_saveable",
     "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
     "everything_saveable": "everything_saveable",
 }

@@ -45,6 +45,10 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     dropout: float = 0.0
     use_recompute: bool = False
+    # jax.checkpoint saveable policy for use_recompute: "full" replays the
+    # whole layer; "dots_saveable"/"selective" keep matmul outputs and
+    # recompute only elementwise (near-zero extra FLOPs, more memory)
+    recompute_policy: str = "full"
     # "plain": full logits through lm_head + CE; "blockwise": vocab-chunked
     # streaming LM-head+CE (ops/fused_ce.py) — same math, caps the logits
     # residual at vocab/num_blocks columns (HBM headroom at 0.7B+ on v5e)
@@ -208,7 +212,8 @@ class LlamaModel(nn.Layer):
         from ..distributed.fleet.recompute import recompute
         for layer in self.layers:
             if self.cfg.use_recompute and self.training:
-                h = recompute(layer, h, self._cos_sin)
+                h = recompute(layer, h, self._cos_sin,
+                              policy=self.cfg.recompute_policy)
             else:
                 h = layer(h, self._cos_sin)
         return self.norm(h)
@@ -269,7 +274,8 @@ class LlamaDecoderLayerPipe(LlamaDecoderLayer):
     def forward(self, h):
         if self.cfg.use_recompute and self.training:
             from ..distributed.fleet.recompute import recompute
-            return recompute(super().forward, h, self._cos_sin)
+            return recompute(super().forward, h, self._cos_sin,
+                             policy=self.cfg.recompute_policy)
         return super().forward(h, self._cos_sin)
 
 
