@@ -549,9 +549,9 @@ if __name__ == "__main__":
         captured = _load_session_capture()
         if captured is not None:
             note = ("live tunnel down at report time "
-                    f"({tpu_error}); result captured on-TPU earlier this "
-                    f"session at {captured['extra'].get('captured_at', '?')} "
-                    "by tools/tpu_watch.py")
+                    f"({tpu_error}); result is the freshest on-TPU "
+                    "capture by tools/tpu_watch.py, taken at "
+                    f"{captured['extra'].get('captured_at', '?')}")
             print(_compact_line(captured, note=note))
             sys.exit(0)
     if result is None:
