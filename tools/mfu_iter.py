@@ -1,0 +1,137 @@
+"""Interactive MFU iteration on the live chip (round-4 pass/fail line:
+scored GPT-2 MFU >= 0.35, VERDICT r3 #1).
+
+The daemon (tpu_watch.py) captures the fixed bench.py candidate sweep;
+this tool is for the HUMAN-in-the-loop window when the tunnel is up:
+it times one GPT-2 train-step config per invocation (batch / lm_ce /
+remat policy / CE preference all switchable from the command line) and
+appends the measurement to artifacts/tpu_capture/manual_runs.json, which
+bench.py folds into the scored report.
+
+Usage (each run is one config; keep runs short — the tunnel dies):
+    python tools/mfu_iter.py --batch 32 --lm-ce blockwise
+    python tools/mfu_iter.py --batch 48 --lm-ce blockwise --remat dots_saveable
+    python tools/mfu_iter.py --batch 8 --lm-ce plain --prefer-pallas-ce
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MANUAL = os.path.join(REPO, "artifacts", "tpu_capture", "manual_runs.json")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--lm-ce", default="blockwise",
+                    choices=["plain", "blockwise"])
+    ap.add_argument("--remat", default="none",
+                    help="none | full | dots_saveable")
+    ap.add_argument("--prefer-pallas-ce", action="store_true")
+    ap.add_argument("--prefer-pallas-norms", action="store_true")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--windows", type=int, default=2)
+    ap.add_argument("--note", default="")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from bench import peak_flops_per_chip
+    from paddle_tpu.core import autotune as _at
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   create_train_step, write_back)
+
+    dev = jax.devices()[0]
+    assert dev.platform != "cpu", "mfu_iter needs the live TPU"
+    _at.use_artifacts_cache(REPO)
+    if args.prefer_pallas_ce:
+        _flags.set_flags({"pallas_prefer_ce": True})
+    if args.prefer_pallas_norms:
+        _flags.set_flags({"pallas_prefer_norms": True})
+
+    cfg = GPTConfig(vocab_size=50304, max_position_embeddings=1024,
+                    hidden_size=768, num_layers=12, num_heads=12,
+                    intermediate_size=3072, dropout=0.0,
+                    lm_ce=args.lm_ce,
+                    use_recompute=args.remat != "none",
+                    recompute_policy=("full" if args.remat in ("none",
+                                                              "full")
+                                      else args.remat))
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.train() if cfg.use_recompute else model.eval()
+    opt = paddle.optimizer.AdamW(learning_rate=3e-4, weight_decay=0.01,
+                                 parameters=model.parameters())
+    step, params, opt_state = create_train_step(model, opt,
+                                                donate="consume")
+    params = {k: (v.astype(jnp.bfloat16)
+                  if jnp.issubdtype(v.dtype, jnp.floating) else v)
+              for k, v in params.items()}
+    write_back(model, params)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size,
+                                  (args.batch, args.seq + 1)), jnp.int32)
+    x, y = ids[:, :-1], ids[:, 1:]
+    key = jax.random.key(0)
+
+    t_compile = time.perf_counter()
+    loss, params, opt_state = step(params, opt_state, key, x, y, 3e-4)
+    l0 = float(jax.device_get(loss))
+    t_compile = time.perf_counter() - t_compile
+    best = float("inf")
+    si = 0
+    for _ in range(args.windows):
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            loss, params, opt_state = step(
+                params, opt_state, jax.random.fold_in(key, si), x, y, 3e-4)
+            si += 1
+        l1 = float(jax.device_get(loss))
+        best = min(best, time.perf_counter() - t0)
+    tps = args.batch * args.seq * args.iters / best
+    H, L, I, V = (cfg.hidden_size, cfg.num_layers, cfg.intermediate_size,
+                  cfg.vocab_size)
+    flops_per_tok = 6 * (L * (4 * H * H + 2 * H * I) + V * H) \
+        + 3 * L * args.seq * H
+    mfu = tps * flops_per_tok / peak_flops_per_chip(dev)
+    entry = {
+        "what": (f"mfu_iter gpt2s b{args.batch} {args.lm_ce} "
+                 f"remat={args.remat}"
+                 + (" +pallas_ce" if args.prefer_pallas_ce else "")
+                 + (" +pallas_norms" if args.prefer_pallas_norms else "")
+                 + (f" [{args.note}]" if args.note else "")),
+        "tokens_per_sec": round(tps, 1), "mfu": round(mfu, 4),
+        "ms_per_step": round(best / args.iters * 1e3, 3),
+        "compile_s": round(t_compile, 1),
+        "loss_start": round(l0, 4), "loss_end": round(l1, 4),
+        "device": str(dev),
+        "captured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+    }
+    print(json.dumps(entry))
+
+    os.makedirs(os.path.dirname(MANUAL), exist_ok=True)
+    doc = {"note": "manual on-chip runs (tools/mfu_iter.py)", "runs": []}
+    if os.path.exists(MANUAL):
+        try:
+            with open(MANUAL) as f:
+                doc = json.load(f)
+        except Exception:
+            pass
+    doc.setdefault("runs", []).append(entry)
+    with open(MANUAL, "w") as f:
+        json.dump(doc, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
