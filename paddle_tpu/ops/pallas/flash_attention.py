@@ -17,11 +17,14 @@ Design (online-softmax, Dao et al. 2022, re-derived for the MXU):
   (acc, m, l) across k blocks; causal blocks above the diagonal are skipped
   with pl.when.
 - backward: one kernel for dq (+ dbias when bias is given), one for dk/dv
-  (grid (batch*heads, k_blocks, q_blocks)); recomputes p from q,k and the
-  saved lse instead of storing the S×S probability matrix.
+  (grid (batch*kv_heads, k_blocks, group_heads, q_blocks) — the last two
+  dims sweep the kv head's q-head group with affine index maps);
+  recomputes p from q,k and the saved lse instead of storing the S×S
+  probability matrix.
 - GQA is expressed in the BlockSpec index maps (kv block index derived from
-  the q head index), so kv tensors are never materialised per-q-head in the
-  forward; backward produces per-q-head dk/dv then sums the head groups.
+  the q head index), so kv tensors are never materialised per-q-head in
+  the forward; the dkv kernel accumulates dk/dv over the group's q-heads
+  in-grid (no per-q-head dk/dv in HBM, no post-kernel group sum).
 - dropout: the keep-mask is a murmur3-finalizer hash of the global (row,
   col) element index mixed with a per-(batch*head) seed — plain int32
   vector ops, so the identical mask is produced by the compiled Mosaic
@@ -353,14 +356,20 @@ def _bias_row(maps, bh):
         (h if Hb > 1 else np.int32(0))
 
 
-def _bias_spec(maps, bq, bk, kq_grid=False):
-    """Bias block spec; ``kq_grid`` flips the (qi, ki) grid-arg order for
-    the dkv kernel's (bh, ki, qi) grid."""
+def _bias_spec(maps, bq, bk, kq4_grid=False):
+    """Bias block spec for the fwd/dq (bh, qi, ki) grid; ``kq4_grid``
+    adapts to the dkv kernel's 4-D (bh, ki, r, qi) grid (bias + GQA
+    expands kv, so r is always 0 and the q-head row is bh itself)."""
     Sqb = maps["Sqb"]
     bq_eff = 1 if Sqb == 1 else bq
 
-    def idx(bh, a, b):
-        qi, ki = (b, a) if kq_grid else (a, b)
+    if kq4_grid:
+        def idx4(bh, ki, r, qi):
+            return (_bias_row(maps, bh),
+                    np.int32(0) if Sqb == 1 else qi, ki)
+        return pl.BlockSpec((1, bq_eff, bk), idx4)
+
+    def idx(bh, qi, ki):
         return (_bias_row(maps, bh),
                 np.int32(0) if Sqb == 1 else qi, ki)
     return pl.BlockSpec((1, bq_eff, bk), idx)
@@ -444,9 +453,13 @@ def _dq_kernel(*refs, scale, causal, offset, bq, bk, nk, sk_real, has_bias,
 
 def _dkv_kernel(*refs, scale, causal, offset, bq, bk, nq, rep, sk_real,
                 has_bias, has_seg, seg_causal, rate):
-    """Grid (B*Hk, nk, rep*nq): one kv-head block accumulates dk/dv over
+    """Grid (B*Hk, nk, rep, nq): one kv-head block accumulates dk/dv over
     ALL rep q-heads of its group (GQA-native — no rep-expanded K/V in HBM
-    and no post-kernel sum over q-head groups). rep == 1 is plain MHA."""
+    and no post-kernel sum over q-head groups). rep == 1 is plain MHA.
+    The (r, qi) sweep rides two AFFINE grid dims — the earlier folded
+    j = r*nq + qi form put div/mod into every q-side index map, which
+    blocks Mosaic's cross-iteration DMA pipelining (suspected cause of
+    the r3 GQA fwd_bwd 0.837; on-chip recapture verifies)."""
     scale = np.float32(scale)  # strong f64 scalars poison Mosaic under x64
     it = iter(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (
@@ -459,14 +472,14 @@ def _dkv_kernel(*refs, scale, causal, offset, bq, bk, nq, rep, sk_real,
     dk_acc, dv_acc = next(it), next(it)
 
     ki = pl.program_id(1)
-    j = pl.program_id(2)                  # j = r * nq + qi over the group
-    qi = j % np.int32(nq)
+    r = pl.program_id(2)                  # q-head within the kv group
+    qi = pl.program_id(3)                 # q block
     # global q-head row — the dropout mask replay is per q-head (fwd hashes
     # with the q-head program index)
-    bh = pl.program_id(0) * np.int32(rep) + j // np.int32(nq)
+    bh = pl.program_id(0) * np.int32(rep) + r
     q_start, k_start = qi * bq, ki * bk
 
-    @pl.when(j == 0)
+    @pl.when(jnp.logical_and(r == 0, qi == 0))
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
@@ -519,7 +532,8 @@ def _dkv_kernel(*refs, scale, causal, offset, bq, bk, nq, rep, sk_real,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale          # (bk, d)
 
-    @pl.when(j == rep * nq - 1)
+    @pl.when(jnp.logical_and(r == np.int32(rep - 1),
+                             qi == np.int32(nq - 1)))
     def _fin():
         dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
@@ -604,40 +618,44 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, bias3, seed, causal, scale,
     else:
         dq, dbias_blocks = dq_outs, None
 
-    # dkv grid: (kv-head, k-block, j) with j = r * nq + qi sweeping every
-    # (q-head-of-group, q-block); all i32 (index maps lower through Mosaic)
-    rep_i, nq_i = np.int32(rep), np.int32(nq)
+    # dkv grid: (kv-head, k-block, r, qi) — the (q-head-of-group, q-block)
+    # sweep as two AFFINE dims; all i32 (index maps lower through Mosaic).
+    # The earlier folded j = r*nq + qi form needed div/mod in every q-side
+    # index map, defeating Mosaic's cross-iteration DMA pipelining.
+    rep_i = np.int32(rep)
 
-    def qrow(bh, j):
-        return bh * rep_i + j // nq_i
-
-    def qblk(j):
-        return j % nq_i
+    def qrow(bh, r):
+        return bh * rep_i + r
 
     kq_specs = [
-        pl.BlockSpec((1, bq, d), lambda bh, ki, j: (qrow(bh, j), qblk(j), _Z)),
-        pl.BlockSpec((1, bk, d), lambda bh, ki, j: (bh, ki, _Z)),
-        pl.BlockSpec((1, bk, d), lambda bh, ki, j: (bh, ki, _Z)),
-        pl.BlockSpec((1, bq, d), lambda bh, ki, j: (qrow(bh, j), qblk(j), _Z)),
-        pl.BlockSpec((1, bq, 1), lambda bh, ki, j: (qrow(bh, j), qblk(j), _Z)),
-        pl.BlockSpec((1, bq, 1), lambda bh, ki, j: (qrow(bh, j), qblk(j), _Z)),
+        pl.BlockSpec((1, bq, d),
+                     lambda bh, ki, r, qi: (qrow(bh, r), qi, _Z)),
+        pl.BlockSpec((1, bk, d), lambda bh, ki, r, qi: (bh, ki, _Z)),
+        pl.BlockSpec((1, bk, d), lambda bh, ki, r, qi: (bh, ki, _Z)),
+        pl.BlockSpec((1, bq, d),
+                     lambda bh, ki, r, qi: (qrow(bh, r), qi, _Z)),
+        pl.BlockSpec((1, bq, 1),
+                     lambda bh, ki, r, qi: (qrow(bh, r), qi, _Z)),
+        pl.BlockSpec((1, bq, 1),
+                     lambda bh, ki, r, qi: (qrow(bh, r), qi, _Z)),
     ]
     kq_args = [q3, kx, vx, do3, lse3, delta3]
     if has_bias:
         # bias rows are per q-head: callers expand K/V for bias + GQA, so
-        # rep == 1 here and the kq-grid bias map sees the plain q-head index
-        kq_specs.append(_bias_spec(bias_maps, bq, bk, kq_grid=True))
+        # rep == 1 here and the bias map sees the plain q-head index
+        kq_specs.append(_bias_spec(bias_maps, bq, bk, kq4_grid=True))
         kq_args.append(bias3)
     if has_seg:
         kq_specs.append(
             pl.BlockSpec((1, bq, 1),
-                         lambda bh, ki, j: (qrow(bh, j), qblk(j), _Z)))
+                         lambda bh, ki, r, qi: (qrow(bh, r), qi, _Z)))
         kq_specs.append(
             pl.BlockSpec((1, 1, bk),
-                         lambda bh, ki, j: (qrow(bh, j), _Z, ki)))
+                         lambda bh, ki, r, qi: (qrow(bh, r), _Z, ki)))
         kq_args += [qseg3, kseg3]
     if rate > 0.0:
-        kq_specs.append(pl.BlockSpec((1,), lambda bh, ki, j: (_Z,), memory_space=pltpu.SMEM))
+        kq_specs.append(pl.BlockSpec(
+            (1,), lambda bh, ki, r, qi: (_Z,), memory_space=pltpu.SMEM))
         kq_args.append(seed)
 
     scratch2 = [pltpu.VMEM((bk, d), jnp.float32),
@@ -649,11 +667,11 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, bias3, seed, causal, scale,
                           has_seg=has_seg,
                           seg_causal=bias_maps.get("seg_causal", False),
                           rate=rate),
-        grid=(bhk, nk, rep * nq),
+        grid=(bhk, nk, rep, nq),
         in_specs=kq_specs,
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda bh, ki, j: (bh, ki, _Z)),
-            pl.BlockSpec((1, bk, d), lambda bh, ki, j: (bh, ki, _Z)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, r, qi: (bh, ki, _Z)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, r, qi: (bh, ki, _Z)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bhk, sk, d), q3.dtype),
@@ -661,7 +679,8 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, bias3, seed, causal, scale,
         ],
         scratch_shapes=scratch2,
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary",
+                                 "arbitrary")),
         interpret=interpret,
     )(*kq_args)
     return dq, dk, dv, dbias_blocks
