@@ -27,8 +27,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "artifacts", "tpu_capture")
 _START = time.time()    # captures older than this are a previous session's
 PROBE_TIMEOUT = 120
-BENCH_TIMEOUT = 1800
-KERNEL_TIMEOUT = 1800   # re-probe between steps keeps a dead tunnel cheap
+# r4: the bench sweep grew to 8 candidates (blockwise/remat at b16-b64)
+# and bench_kernels times a third (shipped) variant per case + the
+# whole-op xla tune candidate — both need headroom over their r3 runtimes
+# (~6 / ~16 min) or a near-complete capture dies at the kill and reports
+# NOTHING
+BENCH_TIMEOUT = 2700
+KERNEL_TIMEOUT = 2700   # re-probe between steps keeps a dead tunnel cheap
 PROBE_INTERVAL = 150          # seconds between probes while tunnel is down
 RECAPTURE_INTERVAL = 2400     # refresh a successful capture every 40 min
 
