@@ -384,6 +384,66 @@ def _probe_accelerator(timeout_s: int = 90) -> bool:
         return False
 
 
+# Fix registry for replayed captures (VERDICT r4 #2): when the live tunnel
+# is down, bench.py replays the freshest on-chip capture — but several
+# per-config defects captured on 2026-07-31 03:43 were fixed in-tree AFTER
+# that capture. Without per-config annotation a reader cannot tell
+# fixed-but-stale from currently-broken. ``fixed_at_unix`` is the committer
+# timestamp of the fixing commit; a capture older than it gets flagged.
+KNOWN_CONFIG_FIXES = {
+    "llama_tp_chip": {
+        "fixed_at_unix": 1785471390,
+        "fix_commit": "e6f53f8",
+        "note": "HTTP-500/ResourceExhausted fixed (donate='consume' + "
+                "blockwise LM-head CE + write_back)",
+        "superseded_by": "manual run 2026-07-31 04:09 UTC: 12706 tok/s "
+                         "MFU 0.27 (artifacts/tpu_capture/"
+                         "manual_runs_r3.json)",
+    },
+    "llama_zero3_layout": {
+        "fixed_at_unix": 1785471390,
+        "fix_commit": "e6f53f8",
+        "note": "HTTP-500/ResourceExhausted fixed (same commit as "
+                "llama_tp_chip)",
+        "superseded_by": "manual run 2026-07-31 04:10 UTC: 12645 tok/s "
+                         "MFU 0.2688, loss parity with TP-analog",
+    },
+    "bert_1f1b": {
+        "fixed_at_unix": 1785511563,
+        "fix_commit": "28e3f53",
+        "note": "host_schedule_overhead 0.02 was a timing artifact "
+                "(unpipelined oracle timed per-microbatch dispatch); "
+                "impossible-ratio guard added, never re-measured",
+    },
+    "resnet50": {
+        "fixed_at_unix": 1785471390,
+        "fix_commit": "e6f53f8",
+        "note": "loss_dropping false was lr divergence in the 10-step "
+                "window; lr 0.1->0.02 fix landed, never re-measured",
+    },
+}
+
+
+def _annotate_stale_configs(result: dict) -> dict:
+    """Flag every replayed per-config entry whose known fix postdates the
+    capture with ``stale: true`` + the fixing commit, so BENCH_rNN can never
+    present a fixed defect as current behavior (VERDICT r4 next-round #2)."""
+    extra = result.get("extra", {})
+    captured = extra.get("captured_at_unix")
+    cfgs = (extra.get("baseline_configs") or {}).get("configs")
+    if not captured or not isinstance(cfgs, dict):
+        return result
+    for name, fix in KNOWN_CONFIG_FIXES.items():
+        c = cfgs.get(name)
+        if isinstance(c, dict) and captured < fix["fixed_at_unix"]:
+            c["stale"] = True
+            c["stale_fix_commit"] = fix["fix_commit"]
+            c["stale_note"] = fix["note"]
+            if "superseded_by" in fix:
+                c["superseded_by"] = fix["superseded_by"]
+    return result
+
+
 def _load_session_capture():
     """Load the freshest on-TPU result persisted by tools/tpu_watch.py this
     session, folding the kernel-microbench capture into extra. Returns the
@@ -406,6 +466,8 @@ def _load_session_capture():
                 meta = json.load(f)
             result.setdefault("extra", {})["captured_at"] = \
                 meta.get("captured_at")
+            result["extra"]["captured_at_unix"] = \
+                meta.get("captured_at_unix")
         kern_p = os.path.join(base, "bench_kernels.json")
         if os.path.exists(kern_p):
             with open(kern_p) as f:
@@ -468,7 +530,9 @@ def _compact_line(result: dict, note: str = None) -> str:
                    for k, v in c.items() if k in (
                 "mfu", "tokens_per_sec", "images_per_sec",
                 "host_schedule_overhead", "theoretical_bubble_fraction",
-                "loss_dropping", "loss_finite_and_moving", "error")}
+                "loss_dropping", "loss_finite_and_moving", "error",
+                "stale", "stale_fix_commit", "stale_note",
+                "superseded_by")}
             for name, c in cfgs.items()}
     man = extra.get("manual_on_chip_runs")
     if isinstance(man, dict):
@@ -566,6 +630,7 @@ if __name__ == "__main__":
         # a meaningless CPU number, honestly annotated with its capture time.
         captured = _load_session_capture()
         if captured is not None:
+            captured = _annotate_stale_configs(captured)
             note = ("live tunnel down at report time "
                     f"({tpu_error}); result is the freshest on-TPU "
                     "capture by tools/tpu_watch.py, taken at "

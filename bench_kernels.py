@@ -298,6 +298,9 @@ def main():
     out = {
         "metric": "pallas_vs_xla_kernel_ratios",
         "platform": dev.platform,
+        # the gate compares this against the baseline's seed time to refuse
+        # stale evidence (tests/test_kernel_gate.py staleness check)
+        "captured_at_unix": time.time(),
         "device": str(dev),
         "device_kind": getattr(dev, "device_kind", "?"),
         "dispatch_floor_ms": dispatch_floor_ms(),

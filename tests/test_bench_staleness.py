@@ -1,0 +1,121 @@
+"""Replayed-capture staleness annotation (VERDICT r4 next-round #2).
+
+When the TPU tunnel is down at report time, bench.py replays the freshest
+on-chip capture. Any per-config defect in that capture whose fix landed
+AFTER the capture must be flagged ``stale: true`` with the fixing commit,
+so the scored record can never again present 0.02 1F1B overhead or
+``loss_dropping: false`` as current behavior.
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _r3_shaped_result(captured_at_unix):
+    """A result dict shaped like the 2026-07-31 03:43 capture replay."""
+    return {
+        "metric": "gpt2s_train_tokens_per_sec_per_chip",
+        "value": 75322.2, "unit": "tokens/s", "vs_baseline": 0.654,
+        "extra": {
+            "platform": "tpu",
+            "captured_at_unix": captured_at_unix,
+            "baseline_configs": {"configs": {
+                "llama_tp_chip": {"error": "HTTP 500: tpu_compile_helper"},
+                "llama_zero3_layout": {"error": "HTTP 500"},
+                "bert_1f1b": {"host_schedule_overhead": 0.02,
+                              "loss_1f1b": 8.9},
+                "resnet50": {"images_per_sec": 903.4,
+                             "loss_dropping": False},
+            }},
+        },
+    }
+
+
+def test_stale_configs_flagged_on_old_capture(bench):
+    res = bench._annotate_stale_configs(_r3_shaped_result(1785469391))
+    cfgs = res["extra"]["baseline_configs"]["configs"]
+    for name in ("llama_tp_chip", "llama_zero3_layout", "bert_1f1b",
+                 "resnet50"):
+        assert cfgs[name].get("stale") is True, name
+        assert cfgs[name].get("stale_fix_commit"), name
+        assert cfgs[name].get("stale_note"), name
+    # the llama configs point at the superseding manual on-chip runs
+    assert "12706" in cfgs["llama_tp_chip"]["superseded_by"]
+    assert "12645" in cfgs["llama_zero3_layout"]["superseded_by"]
+    # registry commits are real: every fix commit must resolve in this repo
+    import subprocess
+    for fix in bench.KNOWN_CONFIG_FIXES.values():
+        r = subprocess.run(
+            ["git", "-C", REPO, "cat-file", "-e",
+             fix["fix_commit"] + "^{commit}"], capture_output=True)
+        assert r.returncode == 0, f"unknown fix commit {fix['fix_commit']}"
+
+
+def test_fresh_capture_not_flagged(bench):
+    newest_fix = max(f["fixed_at_unix"]
+                     for f in bench.KNOWN_CONFIG_FIXES.values())
+    res = bench._annotate_stale_configs(_r3_shaped_result(newest_fix + 1))
+    cfgs = res["extra"]["baseline_configs"]["configs"]
+    assert not any("stale" in c for c in cfgs.values())
+
+
+def test_capture_without_timestamp_untouched(bench):
+    res = _r3_shaped_result(None)
+    out = bench._annotate_stale_configs(res)
+    cfgs = out["extra"]["baseline_configs"]["configs"]
+    assert not any("stale" in c for c in cfgs.values())
+
+
+def test_compact_line_carries_stale_flags(bench, monkeypatch):
+    # full-report write is a side effect we don't want in tests: force the
+    # fallback path where the compact line still prints
+    def _raise(*a, **k):
+        raise OSError("no writes in tests")
+    monkeypatch.setattr(os, "makedirs", _raise)
+    res = bench._annotate_stale_configs(_r3_shaped_result(1785469391))
+    line = bench._compact_line(res, note="replay test")
+    obj = json.loads(line)
+    summary = obj["extra"]["configs_summary"]
+    assert summary["bert_1f1b"]["stale"] is True
+    assert summary["bert_1f1b"]["stale_fix_commit"] == "28e3f53"
+    assert summary["resnet50"]["stale"] is True
+    assert summary["llama_tp_chip"]["superseded_by"].startswith("manual run")
+    # one driver-parseable line
+    assert "\n" not in line
+
+
+def test_real_capture_on_disk_gets_flagged_when_stale(bench):
+    """If the shipped artifacts still hold a pre-fix capture, the live
+    replay path must flag it (this is the actual defense while the tunnel
+    stays dead)."""
+    meta_p = os.path.join(REPO, "artifacts", "tpu_capture", "meta.json")
+    cfg_p = os.path.join(REPO, "artifacts", "tpu_capture",
+                         "bench_configs.json")
+    if not (os.path.exists(meta_p) and os.path.exists(cfg_p)):
+        pytest.skip("no capture on disk")
+    captured = bench._load_session_capture()
+    if captured is None:
+        pytest.skip("capture on disk not loadable as a bench result")
+    out = bench._annotate_stale_configs(captured)
+    cfgs = (out["extra"].get("baseline_configs") or {}).get("configs") or {}
+    ts = out["extra"].get("captured_at_unix")
+    if ts is None:
+        pytest.skip("capture has no unix timestamp")
+    for name, fix in bench.KNOWN_CONFIG_FIXES.items():
+        if name in cfgs and ts < fix["fixed_at_unix"]:
+            assert cfgs[name].get("stale") is True, name

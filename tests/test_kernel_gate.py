@@ -19,6 +19,7 @@ whenever the tunnel is up.
 """
 from __future__ import annotations
 
+import importlib.util
 import json
 import os
 
@@ -32,6 +33,18 @@ BASELINE = os.path.join(REPO, "artifacts", "kernel_baseline.json")
 SHIPPED_FLOOR = 0.95      # >=1.0 contract minus timing noise
 REGRESSION_TOLERANCE = 0.90  # fresh raw ratio must be >= 90% of baseline
 
+_spec = importlib.util.spec_from_file_location(
+    "kernel_baseline", os.path.join(REPO, "tools", "kernel_baseline.py"))
+kb = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(kb)
+
+
+def _load_baseline():
+    if not os.path.exists(BASELINE):
+        return None
+    with open(BASELINE) as f:
+        return json.load(f)
+
 
 def _load_capture():
     if not os.path.exists(CAPTURE):
@@ -41,6 +54,16 @@ def _load_capture():
         cap = json.load(f)
     if cap.get("platform") != "tpu":
         pytest.skip(f"capture platform is {cap.get('platform')!r}, not tpu")
+    base = _load_baseline()
+    if base is not None and kb.is_stale(cap, base, CAPTURE):
+        # FAIL, not skip (VERDICT r4 #7): once the baseline is seeded from
+        # a fresh shipped-ratio capture, a replayed older file is stale
+        # evidence and must never validate green
+        pytest.fail(
+            "capture predates the kernel-baseline seed "
+            f"(capture {kb.capture_time(cap, CAPTURE):.0f} < seed "
+            f"{base.get('seeded_at_unix', 0):.0f}): replayed stale "
+            "evidence — recapture on a live tunnel")
     if not any("shipped_ratio" in row
                for entry in (cap.get("results") or {}).values()
                for row in entry.values()):
@@ -80,13 +103,15 @@ def test_shipped_impl_never_loses_to_xla():
 
 def test_no_regression_vs_baseline():
     cap = _load_capture()
-    if not os.path.exists(BASELINE):
+    base = _load_baseline()
+    if base is None:
         pytest.skip("no stored kernel baseline")
-    with open(BASELINE) as f:
-        base = json.load(f)
-    fresh = {f"{name}.{tag}": row["ratio"]
+    # a shipped-kind baseline (post-r5 reseed) floors what dispatch actually
+    # routes; the legacy raw baseline floors the raw pallas ratios
+    field = "shipped_ratio" if base.get("kind") == "shipped" else "ratio"
+    fresh = {f"{name}.{tag}": row[field]
              for name, entry in (cap.get("results") or {}).items()
-             for tag, row in entry.items() if "ratio" in row}
+             for tag, row in entry.items() if field in row}
     regressions = []
     for key, b in (base.get("ratios") or {}).items():
         r = fresh.get(key)
