@@ -69,6 +69,10 @@ define_flag("spmd_strict", False,
             "raise instead of falling back to GSPMD when a registered "
             "SPMD rule rejects a call or a sharding constraint fails "
             "(fallbacks are always counted in dispatch.spmd_rule_stats)")
+define_flag("planner_strict", False,
+            "raise instead of falling back to pure data-parallel when "
+            "every planner candidate is pruned (fallbacks are always "
+            "counted in planner.planner_stats)")
 define_flag("use_fused_optimizer", True,
             "eager optimizer.step as one jitted multi-tensor XLA program")
 define_flag("pallas_flash_min_seq", 1024,

@@ -82,11 +82,18 @@ class Completer:
     """
 
     def __init__(self, axis_sizes: Dict[str, int], data_axis: str = "dp",
-                 model_axis: str = "tp"):
+                 model_axis: str = "tp",
+                 axis_bandwidth: Optional[Dict[str, float]] = None):
         self.axis_sizes = dict(axis_sizes)
         self.axis_names = list(axis_sizes)
         self.data_axis = data_axis
         self.model_axis = model_axis
+        # relative bandwidth per mesh axis (VERDICT r4 #4): 1.0 = the
+        # ICI-class reference; an axis laid over DCN gets e.g. 0.04, so
+        # collectives riding it cost 25x the bytes. The reference encodes
+        # the same hierarchy in its Cluster beta/alpha tables
+        # (auto_parallel/static/cluster.py + cost/comm_op_cost.py).
+        self.axis_bandwidth = dict(axis_bandwidth or {})
         self._tp_idx = (self.axis_names.index(model_axis)
                         if model_axis in self.axis_names else -1)
         self._dp_idx = (self.axis_names.index(data_axis)
@@ -97,6 +104,14 @@ class Completer:
         if idx < 0 or idx >= len(self.axis_names):
             return 1
         return self.axis_sizes[self.axis_names[idx]]
+
+    def _axis_cost_scale(self, idx: int) -> float:
+        """1/bandwidth for the axis: comm bytes over a slow link cost
+        proportionally more."""
+        if idx < 0 or idx >= len(self.axis_names):
+            return 1.0
+        return 1.0 / max(self.axis_bandwidth.get(self.axis_names[idx],
+                                                 1.0), 1e-9)
 
     def _local_bytes(self, spec: DistTensorSpec) -> float:
         denom = 1
@@ -112,14 +127,16 @@ class Completer:
         cost = 0.0
         for ax in cur.partial_dims - want.partial_dims:
             n = self._axis_size(ax)
-            cost += 2.0 * (n - 1) / n * _bytes(cur.shape)
+            cost += 2.0 * (n - 1) / n * _bytes(cur.shape) \
+                * self._axis_cost_scale(ax)
         for d, (c, w) in enumerate(zip(cur.dims_mapping, want.dims_mapping)):
             if c == w:
                 continue
             if c == -1 and w != -1:
                 continue  # slice locally: free
             n = self._axis_size(c)
-            cost += (n - 1) / n * _bytes(cur.shape)
+            cost += (n - 1) / n * _bytes(cur.shape) \
+                * self._axis_cost_scale(c)
         return cost
 
     def _clear_partial(self, spec: DistTensorSpec) -> Tuple[DistTensorSpec,
@@ -129,7 +146,8 @@ class Completer:
         cost = 0.0
         for ax in spec.partial_dims:
             n = self._axis_size(ax)
-            cost += 2.0 * (n - 1) / n * _bytes(spec.shape)
+            cost += 2.0 * (n - 1) / n * _bytes(spec.shape) \
+                * self._axis_cost_scale(ax)
         return DistTensorSpec(spec.shape, spec.dims_mapping), cost
 
     def _flops_cost(self, op_name: str, out_specs, in_specs) -> float:
@@ -354,7 +372,7 @@ class Completer:
 
 def derive_param_specs(layer, mesh, sample_feed, loss_fn=None,
                        data_axis: str = "dp", model_axis: str = "tp",
-                       return_cost: bool = False):
+                       return_cost: bool = False, axis_bandwidth=None):
     """Record ``layer``'s forward (+ loss) as a static Program and complete
     it: returns {param_name: PartitionSpec} with NO user placements needed
     (the reference's Completer+Planner step of dist.to_static,
@@ -405,7 +423,8 @@ def derive_param_specs(layer, mesh, sample_feed, loss_fn=None,
 
     param_names = {id(p): n for n, p in layer.named_parameters()}
     completer = Completer(axis_sizes, data_axis=data_axis,
-                          model_axis=model_axis)
+                          model_axis=model_axis,
+                          axis_bandwidth=axis_bandwidth)
     seeds = {}
     for name, v in prog.inputs.items():
         m = [-1] * len(v.shape)

@@ -44,6 +44,11 @@ class DistributedStrategy:
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1}
         self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        # long-context attention strategy over the sep axis (SURVEY §5.7):
+        # "ring" (flash kernel per ring step), "ulysses" (all_to_all head
+        # swap), or "gather" (replicate sequence, local kernel — the
+        # reference's only mode, segment_parallel.py)
+        self.sep_configs = {"attention": "ring"}
         self.tensor_parallel_configs = {}
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True

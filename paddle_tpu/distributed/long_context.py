@@ -37,14 +37,17 @@ try:  # jax >= 0.8 top-level shard_map
     from jax import shard_map as _shard_map
 
     def shard_map(f, mesh, in_specs, out_specs):
+        # check_vma=False: pallas_call outputs carry no varying-mesh-axes
+        # metadata, so the vma checker rejects any kernel launched inside
+        # the shard (both the ring chunk kernels and Ulysses' local flash)
         return _shard_map(f, mesh=mesh, in_specs=in_specs,
-                          out_specs=out_specs)
+                          out_specs=out_specs, check_vma=False)
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _old_shard_map
 
     def shard_map(f, mesh, in_specs, out_specs):
         return _old_shard_map(f, mesh=mesh, in_specs=in_specs,
-                              out_specs=out_specs)
+                              out_specs=out_specs, check_rep=False)
 
 from ..core.dispatch import run_op
 
@@ -88,13 +91,238 @@ def _repeat_kv(k, hq):
     return k
 
 
+def _vary(xs, axis_name):
+    """Mark replicated-constant scan carries device-varying over the mesh
+    axis (required before they meet ppermute'd values in the carry)."""
+    if hasattr(jax.lax, "pcast"):
+        return tuple(jax.lax.pcast(x, (axis_name,), to="varying")
+                     for x in xs)
+    if hasattr(jax.lax, "pvary"):  # older jax
+        return tuple(jax.lax.pvary(x, (axis_name,)) for x in xs)
+    return tuple(xs)
+
+
+# ---------------------------------------------------------------------------
+# Pallas-backed ring attention (VERDICT r4 #5): each ring step runs the
+# flash block kernel (ops/pallas/flash_attention.py) on the resident k/v
+# chunk, and per-chunk (out, lse) pairs merge by log-sum-exp — the online-
+# softmax carry at inter-chip granularity, with the intra-chip tiling done
+# by the same kernel the single-chip path ships. The backward is a second
+# ring pass: dk/dv accumulators rotate WITH their chunk while each device
+# adds its queries' contribution via the Pallas backward fed the global
+# lse/delta (with the global lse, per-chunk gradients sum exactly).
+# ---------------------------------------------------------------------------
+
+def _merge_lse(out_acc, lse_acc, o, lse):
+    """Merge a new chunk's normalized (o, lse) into the running pair."""
+    lse_new = jnp.logaddexp(lse_acc, lse)
+    safe = jnp.where(lse_new == _NEG_INF, 0.0, lse_new)
+    wa = jnp.where(lse_acc == _NEG_INF, 0.0, jnp.exp(lse_acc - safe))
+    wb = jnp.where(lse == _NEG_INF, 0.0, jnp.exp(lse - safe))
+
+    def tr(w):  # (B, H, S) weights onto (B, S, H, 1) activations
+        return w.transpose(0, 2, 1)[..., None]
+
+    return out_acc * tr(wa) + o.astype(jnp.float32) * tr(wb), lse_new
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, axis_name, axis_size, causal, scale, interpret):
+    """Ring attention with Pallas per-chunk compute; call inside shard_map
+    with q/k/v sequence-sharded [B, S/N, H(k), D]. GQA-native: kv chunks
+    rotate un-expanded (Hk heads of ICI traffic)."""
+    out, _ = _ring_flash_fwd(q, k, v, axis_name, axis_size, causal, scale,
+                             interpret)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, axis_size, causal, scale,
+                    interpret):
+    from ..ops.pallas.flash_attention import flash_chunk_fwd
+    B, sc, H, D = q.shape
+    idx = jax.lax.axis_index(axis_name)
+    perm = [((r + 1) % axis_size, r) for r in range(axis_size)]
+    out0 = jnp.zeros((B, sc, H, D), jnp.float32)
+    lse0 = jnp.full((B, H, sc), _NEG_INF, jnp.float32)
+    out0, lse0 = _vary((out0, lse0), axis_name)
+
+    def full(kc, vc):
+        return flash_chunk_fwd(q, kc, vc, False, scale, interpret=interpret)
+
+    def diag(kc, vc):
+        return flash_chunk_fwd(q, kc, vc, True, scale, interpret=interpret)
+
+    def skip(kc, vc):
+        return (jnp.zeros((B, sc, H, D), q.dtype),
+                jnp.full((B, H, sc), _NEG_INF, jnp.float32))
+
+    def body(carry, t):
+        kc, vc, out_acc, lse_acc = carry
+        j = (idx + t) % axis_size
+        if causal:
+            # j < idx: chunk fully visible; j == idx: the diagonal chunk
+            # (in-kernel causal mask); j > idx: fully masked — skip the
+            # compute entirely (lax.switch runs one branch at runtime)
+            br = jnp.where(j == idx, 1, jnp.where(j < idx, 0, 2))
+            o, lse = jax.lax.switch(br, (full, diag, skip), kc, vc)
+        else:
+            o, lse = full(kc, vc)
+        out_acc, lse_acc = _merge_lse(out_acc, lse_acc, o, lse)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (kc, vc, out_acc, lse_acc), None
+
+    (_, _, out_acc, lse), _ = jax.lax.scan(
+        body, (k, v, out0, lse0), jnp.arange(axis_size))
+    out = out_acc.astype(q.dtype)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, axis_size, causal, scale, interpret, res,
+                    do):
+    from ..ops.pallas.flash_attention import flash_chunk_bwd
+    q, k, v, out, lse = res
+    B, sc, H, D = q.shape
+    idx = jax.lax.axis_index(axis_name)
+    perm = [((r + 1) % axis_size, r) for r in range(axis_size)]
+    # delta_i = rowsum(dO_i * O_i), shared by every chunk's backward
+    delta = jnp.einsum("bshd,bshd->bhs", do.astype(jnp.float32),
+                       out.astype(jnp.float32))
+    dq0 = jnp.zeros((B, sc, H, D), jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+    dq0, dk0, dv0 = _vary((dq0, dk0, dv0), axis_name)
+
+    def full(kc, vc):
+        return flash_chunk_bwd(q, kc, vc, do, lse, delta, False, scale,
+                               interpret=interpret)
+
+    def diag(kc, vc):
+        return flash_chunk_bwd(q, kc, vc, do, lse, delta, True, scale,
+                               interpret=interpret)
+
+    def skip(kc, vc):
+        return (jnp.zeros((B, sc, H, D), q.dtype),
+                jnp.zeros(k.shape, q.dtype), jnp.zeros(v.shape, q.dtype))
+
+    def body(carry, t):
+        kc, vc, dkc, dvc, dq_acc = carry
+        j = (idx + t) % axis_size
+        if causal:
+            br = jnp.where(j == idx, 1, jnp.where(j < idx, 0, 2))
+            dq_c, dk_c, dv_c = jax.lax.switch(br, (full, diag, skip),
+                                              kc, vc)
+        else:
+            dq_c, dk_c, dv_c = full(kc, vc)
+        dq_acc = dq_acc + dq_c.astype(jnp.float32)
+        # dk/dv accumulators rotate WITH their chunk: after axis_size
+        # steps every chunk is home carrying all devices' contributions
+        dkc = dkc + dk_c.astype(jnp.float32)
+        dvc = dvc + dv_c.astype(jnp.float32)
+        kc, vc, dkc, dvc = (jax.lax.ppermute(x, axis_name, perm)
+                            for x in (kc, vc, dkc, dvc))
+        return (kc, vc, dkc, dvc, dq_acc), None
+
+    (_, _, dkc, dvc, dq_acc), _ = jax.lax.scan(
+        body, (k, v, dk0, dv0, dq0), jnp.arange(axis_size))
+    return (dq_acc.astype(q.dtype), dkc.astype(k.dtype),
+            dvc.astype(v.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_chunked_single(q, k, v, n_chunks, causal, scale, interpret):
+    """Single-chip model of the per-device ring compute: q/k/v [B,S,H(k),D]
+    split into ``n_chunks`` sequence chunks, flash block kernel per (qi,
+    kj) chunk pair, log-sum-exp merge — exactly what each ring device
+    executes, minus the ppermute. This is the chunk-level bench surface
+    (bench_kernels.py ring_chunks_*): its time vs the monolithic kernel
+    is the ring's single-chip compute overhead."""
+    out, _ = _ring_chunked_fwd(q, k, v, n_chunks, causal, scale, interpret)
+    return out
+
+
+def _ring_chunked_fwd(q, k, v, n_chunks, causal, scale, interpret):
+    from ..ops.pallas.flash_attention import flash_chunk_fwd
+    B, S, H, D = q.shape
+    if S % n_chunks:
+        raise ValueError(
+            f"ring_chunked_single: sequence {S} not divisible by "
+            f"n_chunks {n_chunks}")
+    sc = S // n_chunks
+    outs, lses = [], []
+    for i in range(n_chunks):
+        qi = q[:, i * sc:(i + 1) * sc]
+        out_acc = jnp.zeros((B, sc, H, D), jnp.float32)
+        lse_acc = jnp.full((B, H, sc), _NEG_INF, jnp.float32)
+        for j in range(i + 1 if causal else n_chunks):
+            kc = k[:, j * sc:(j + 1) * sc]
+            vc = v[:, j * sc:(j + 1) * sc]
+            o, lse = flash_chunk_fwd(qi, kc, vc, causal and j == i, scale,
+                                     interpret=interpret)
+            out_acc, lse_acc = _merge_lse(out_acc, lse_acc, o, lse)
+        outs.append(out_acc.astype(q.dtype))
+        lses.append(lse_acc)
+    out = jnp.concatenate(outs, axis=1)
+    lse = jnp.concatenate(lses, axis=2)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_chunked_bwd(n_chunks, causal, scale, interpret, res, do):
+    from ..ops.pallas.flash_attention import flash_chunk_bwd
+    q, k, v, out, lse = res
+    B, S, H, D = q.shape
+    sc = S // n_chunks
+    delta = jnp.einsum("bshd,bshd->bhs", do.astype(jnp.float32),
+                       out.astype(jnp.float32))
+    dqs = []
+    dks = [jnp.zeros((B, sc) + v.shape[2:], jnp.float32)
+           for _ in range(n_chunks)]
+    dvs = [jnp.zeros((B, sc) + v.shape[2:], jnp.float32)
+           for _ in range(n_chunks)]
+    for i in range(n_chunks):
+        qi = q[:, i * sc:(i + 1) * sc]
+        doi = do[:, i * sc:(i + 1) * sc]
+        lsei = lse[:, :, i * sc:(i + 1) * sc]
+        deltai = delta[:, :, i * sc:(i + 1) * sc]
+        dq_acc = jnp.zeros((B, sc, H, D), jnp.float32)
+        for j in range(i + 1 if causal else n_chunks):
+            kc = k[:, j * sc:(j + 1) * sc]
+            vc = v[:, j * sc:(j + 1) * sc]
+            dq_c, dk_c, dv_c = flash_chunk_bwd(
+                qi, kc, vc, doi, lsei, deltai, causal and j == i, scale,
+                interpret=interpret)
+            dq_acc = dq_acc + dq_c.astype(jnp.float32)
+            dks[j] = dks[j] + dk_c.astype(jnp.float32)
+            dvs[j] = dvs[j] + dv_c.astype(jnp.float32)
+        dqs.append(dq_acc.astype(q.dtype))
+    return (jnp.concatenate(dqs, axis=1),
+            jnp.concatenate(dks, axis=1).astype(k.dtype),
+            jnp.concatenate(dvs, axis=1).astype(v.dtype))
+
+
+ring_chunked_single.defvjp(_ring_chunked_fwd, _ring_chunked_bwd)
+
+
 def ring_attention_local(q, k, v, axis_name, axis_size, causal=True,
-                         scale=None):
+                         scale=None, impl=None):
     """Per-shard body: call inside shard_map with q/k/v sequence-sharded
-    [B, S/N, H, D]. Returns the local output chunk [B, S/N, H, D]."""
+    [B, S/N, H, D]. Returns the local output chunk [B, S/N, H, D].
+
+    ``impl``: "pallas" runs the flash block kernel inside each ring step
+    (the TPU path — interpret-mode on CPU when forced); "xla" is the
+    pure-jnp online-softmax reference; None picks by backend."""
     B, sc, H, D = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(D)
+    if impl is None:
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        interpret = jax.default_backend() != "tpu"
+        return _ring_flash(q, k, v, axis_name, axis_size, causal,
+                           float(scale), interpret)
     # GQA kv chunks rotate un-expanded (Hk heads of ICI traffic, not H)
     idx = jax.lax.axis_index(axis_name)
     qf = q.astype(jnp.float32) * scale
@@ -102,11 +330,7 @@ def ring_attention_local(q, k, v, axis_name, axis_size, causal=True,
     m = jnp.full((B, H, sc, 1), _NEG_INF, jnp.float32)
     l = jnp.zeros((B, H, sc, 1), jnp.float32)
     # the scan carry must be device-varying over the mesh axis from step 0
-    if hasattr(jax.lax, "pcast"):
-        acc, m, l = (jax.lax.pcast(x, (axis_name,), to="varying")
-                     for x in (acc, m, l))
-    elif hasattr(jax.lax, "pvary"):  # older jax
-        acc, m, l = (jax.lax.pvary(x, (axis_name,)) for x in (acc, m, l))
+    acc, m, l = _vary((acc, m, l), axis_name)
     # neighbor ring: each step every device hands its current k/v chunk to
     # the previous rank, so device i sees chunk (i + t) mod N at step t
     perm = [((r + 1) % axis_size, r) for r in range(axis_size)]
@@ -171,15 +395,18 @@ def _as_mesh(mesh):
 
 
 def ring_attention(q, k, v, mesh=None, seq_axis="sep", causal=True,
-                   scale=None):
+                   scale=None, impl=None):
     """User API: q/k/v Tensors/arrays [B, S, H, D]; runs ring attention with
     the sequence dim sharded over ``seq_axis`` of ``mesh``. Differentiable
-    through the tape (run_op -> jax.vjp through shard_map)."""
+    through the tape (run_op -> jax.vjp through shard_map). ``impl``:
+    "pallas" (flash block kernel per ring step), "xla" (pure-jnp), or None
+    to pick by backend."""
     jmesh = _as_mesh(mesh)
     n = int(jmesh.shape[seq_axis])
     spec = P(None, seq_axis, None, None)
     body = functools.partial(ring_attention_local, axis_name=seq_axis,
-                             axis_size=n, causal=causal, scale=scale)
+                             axis_size=n, causal=causal, scale=scale,
+                             impl=impl)
     fn = shard_map(lambda a, b, c: body(a, b, c), jmesh,
                    in_specs=(spec, spec, spec), out_specs=spec)
     return run_op("ring_attention", fn, (q, k, v))

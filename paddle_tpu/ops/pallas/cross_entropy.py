@@ -167,9 +167,12 @@ def _softmax_xent_pallas_impl(logits, labels):
     pallas_variant = "pallas" if bwd == "pallas" else "pallas_xbwd"
     default = (pallas_variant if interpret
                or _flags.get_flag("pallas_prefer_ce") else "xla")
+    from ...core import autotune as _at
+    class_key = _at.ce_class_key(logits.shape[0], logits.shape[-1],
+                                 logits.dtype)
     choice, out = pick_grad_impl("softmax_xent_dir", variants,
                                  (logits, labels), default,
-                                 diff_argnums=(0,))
+                                 diff_argnums=(0,), class_key=class_key)
     if out is not None:
         return out
     return variants[choice](logits, labels)

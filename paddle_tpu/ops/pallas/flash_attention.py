@@ -54,6 +54,7 @@ from .common import _Z
 
 
 __all__ = ["flash_attention_pallas", "flash_attention_ext",
+           "flash_chunk_fwd", "flash_chunk_bwd",
            "dropout_keep_mask", "seed_from_key"]
 
 _NEG_INF = float("-inf")
@@ -960,6 +961,64 @@ def flash_attention_pallas(q, k, v, causal, scale, interpret,
 
 
 # ---------------------------------------------------------------------------
+# chunk-level entry points: the building blocks ring attention runs inside
+# each ring step (distributed/long_context.py). No custom_vjp here — the
+# ring owns the backward (a second ring pass with rotating dk/dv), these
+# just expose the Pallas forward with its lse and the Pallas backward fed
+# a GLOBAL lse/delta. GQA-native: Hk may divide Hq, K/V never expand.
+# ---------------------------------------------------------------------------
+
+def flash_chunk_fwd(q, k, v, causal, scale, block_q=128, block_k=128,
+                    interpret=False):
+    """Partial attention of q [B,Sq,Hq,D] against one k/v chunk
+    [B,Sc,Hk,D]. Returns (out [B,Sq,Hq,D], lse [B,Hq,Sq]) — normalized
+    over THIS chunk only; callers merge chunks by log-sum-exp. ``causal``
+    masks the q/k diagonal (same global offset, the ring's j == idx
+    chunk); fully-visible chunks pass causal=False."""
+    B, Sq, Hq, D = q.shape
+    Sk, Hk = k.shape[1], k.shape[2]
+    bq, bk = _pick_block(Sq, block_q), _pick_block(Sk, block_k)
+    q3 = _pad_seq(q.transpose(0, 2, 1, 3).reshape(B * Hq, Sq, D), bq)
+    k3 = _pad_seq(k.transpose(0, 2, 1, 3).reshape(B * Hk, Sk, D), bk)
+    v3 = _pad_seq(v.transpose(0, 2, 1, 3).reshape(B * Hk, Sk, D), bk)
+    out3, lse = _fwd(q3, k3, v3, None, None, Hq, Hk, causal, scale,
+                     Sk - Sq, Sk, bq, bk, {"rate": 0.0}, interpret)
+    out = out3[:, :Sq].reshape(B, Hq, Sq, D).transpose(0, 2, 1, 3)
+    return out, lse[:, :Sq].reshape(B, Hq, Sq)
+
+
+def flash_chunk_bwd(q, k, v, do, lse, delta, causal, scale, block_q=128,
+                    block_k=128, interpret=False):
+    """(dq, dk, dv) of one chunk's contribution, given the GLOBAL (all
+    chunks merged) lse and delta = rowsum(do * out), both [B,Hq,Sq].
+    With the global lse, p = exp(s - lse) is each chunk's true posterior
+    slice, so per-chunk (dq, dk, dv) sum exactly to the full gradients —
+    the flash-attention backward identity at ring granularity."""
+    B, Sq, Hq, D = q.shape
+    Sk, Hk = k.shape[1], k.shape[2]
+    bq, bk = _pick_block(Sq, block_q), _pick_block(Sk, block_k)
+    q3 = _pad_seq(q.transpose(0, 2, 1, 3).reshape(B * Hq, Sq, D), bq)
+    kx = _pad_seq(k.transpose(0, 2, 1, 3).reshape(B * Hk, Sk, D), bk)
+    vx = _pad_seq(v.transpose(0, 2, 1, 3).reshape(B * Hk, Sk, D), bk)
+    do3 = _pad_seq(do.transpose(0, 2, 1, 3).reshape(B * Hq, Sq, D), bq)
+    pad_q = (-Sq) % bq
+    lse2 = lse.reshape(B * Hq, Sq)
+    delta2 = delta.reshape(B * Hq, Sq)
+    if pad_q:
+        # padded query rows: lse = +inf => p = 0, no dk/dv contribution
+        lse2 = jnp.pad(lse2, ((0, 0), (0, pad_q)),
+                       constant_values=float("inf"))
+        delta2 = jnp.pad(delta2, ((0, 0), (0, pad_q)))
+    dq3, dk3, dv3, _ = _bwd_impl(
+        q3, kx, vx, do3, lse2, delta2, None, None, causal, scale,
+        Sk - Sq, Sk, bq, bk, {"rate": 0.0}, interpret, hq=Hq, hk=Hk)
+    dq = dq3[:, :Sq].reshape(B, Hq, Sq, D).transpose(0, 2, 1, 3)
+    dk = dk3[:, :Sk].reshape(B, Hk, Sk, D).transpose(0, 2, 1, 3)
+    dv = dv3[:, :Sk].reshape(B, Hk, Sk, D).transpose(0, 2, 1, 3)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
 # registry wiring
 # ---------------------------------------------------------------------------
 
@@ -1072,8 +1131,16 @@ def _tuned_blocks(q, k, v, bias, seed, causal, scale, rate, interpret,
     # batch-1 surrogates so a b8-tuned entry serves the b16/b32 sweep
     key_arrays = (jax.ShapeDtypeStruct((1,) + tuple(q.shape[1:]), q.dtype),
                   jax.ShapeDtypeStruct((1,) + tuple(k.shape[1:]), k.dtype))
+    # shape-CLASS key for the measured-defaults table (VERDICT r4 #6):
+    # power-of-two seq buckets; an unseen exact shape inside a captured
+    # class still gets the measured winner under jit. A class-default
+    # "xla" can never route a call whose own score matrix exceeds the HBM
+    # budget: "xla" is only in this call's candidate set when it fits.
+    class_key = _autotune.flash_class_key(tag, sq, sk, rep > 1,
+                                          q.shape[-1], q.dtype)
     choice, out = _autotune.pick_impl(tag, cands, (q, k), call,
-                                      key_arrays=key_arrays)
+                                      key_arrays=key_arrays,
+                                      class_key=class_key)
     if out is not None:
         # fresh measurement: note the batch it ran at — the key is batch-
         # stripped (tile optima are seq/head-determined), and the note

@@ -144,8 +144,12 @@ def _rms_norm_pallas_impl(a, w, eps):
     }
     default = ("pallas" if interpret
                or _flags.get_flag("pallas_prefer_norms") else "xla")
+    from ...core import autotune as _at
+    rows = int(np.prod(a.shape[:-1])) if a.ndim > 1 else 1
+    class_key = _at.norm_class_key("rms_norm_dir", rows, a.shape[-1],
+                                   a.dtype)
     choice, out = pick_grad_impl("rms_norm_dir", variants, (a, w), default,
-                                 diff_argnums=(0, 1))
+                                 diff_argnums=(0, 1), class_key=class_key)
     if out is not None:
         return out
     return variants[choice](a, w)
@@ -244,8 +248,13 @@ def _layer_norm_pallas_impl(a, w, b, eps, begin_axis):
     }
     default = ("pallas" if interpret
                or _flags.get_flag("pallas_prefer_norms") else "xla")
+    from ...core import autotune as _at
+    rows = int(np.prod(a.shape[:-1])) if a.ndim > 1 else 1
+    class_key = _at.norm_class_key("layer_norm_dir", rows, a.shape[-1],
+                                   a.dtype)
     choice, out = pick_grad_impl("layer_norm_dir", variants, (a, w, b),
-                                 default, diff_argnums=(0, 1, 2))
+                                 default, diff_argnums=(0, 1, 2),
+                                 class_key=class_key)
     if out is not None:
         return out
     return variants[choice](a, w, b)

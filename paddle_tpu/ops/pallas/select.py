@@ -43,7 +43,7 @@ def vjp_probe(fn, args, diff_argnums):
 
 
 def pick_grad_impl(tag, variants, args, default, diff_argnums=(0,),
-                   key_arrays=None):
+                   key_arrays=None, class_key=None):
     """Return ``(choice, out)`` where ``choice`` is a key of ``variants``
     and ``out`` is the already-computed forward output when the measurement
     just ran the winner (eager cache miss), else None.
@@ -52,6 +52,9 @@ def pick_grad_impl(tag, variants, args, default, diff_argnums=(0,),
     ``default``: heuristic choice when autotune is off / cache is cold.
     ``diff_argnums``: which args the measured vjp differentiates — the
     measurement must include every backward kernel the training step runs.
+    ``class_key``: shape-class key into the measured-defaults table
+    (core/autotune.py) — a traced cold-cache call takes the class winner
+    from a prior capture before degrading to ``default``.
     """
     from ...core import autotune as _at
 
@@ -59,7 +62,8 @@ def pick_grad_impl(tag, variants, args, default, diff_argnums=(0,),
         return vjp_probe(variants[name], args, diff_argnums)
 
     choice, out = _at.pick_impl(tag, variants, args, call,
-                                key_arrays=key_arrays)
+                                key_arrays=key_arrays,
+                                class_key=class_key)
     if choice is None or choice not in variants:
         return default, None
     return choice, out

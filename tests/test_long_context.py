@@ -92,6 +92,109 @@ def test_ulysses_gqa_expand():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("shape", [
+    # (B, S, Hq, Hk, D, N, causal)
+    (2, 256, 4, 4, 32, 4, True),
+    (1, 384, 4, 2, 32, 8, True),   # GQA + uneven chunks (sc=48)
+    (1, 256, 4, 4, 32, 4, False),
+])
+def test_ring_pallas_impl_parity(shape):
+    """The Pallas-chunk ring (VERDICT r4 #5): per-step flash block kernel
+    (interpret mode on CPU) must match the dense oracle in forward AND all
+    three input grads, elementwise, at S >= 256 with causal boundaries
+    that don't align to the kernel's 128 block."""
+    B, S, Hq, Hk, D, N, causal = shape
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.standard_normal((B, S, Hq, D)), jnp.float32) * 0.3
+    k = jnp.asarray(rng.standard_normal((B, S, Hk, D)), jnp.float32) * 0.3
+    v = jnp.asarray(rng.standard_normal((B, S, Hk, D)), jnp.float32) * 0.3
+    scale = 1.0 / math.sqrt(D)
+    ct = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed.long_context import (ring_attention_local,
+                                                     shard_map)
+    spec = P(None, "sep", None, None)
+    fn = shard_map(
+        lambda a, b, c: ring_attention_local(a, b, c, "sep", N, causal,
+                                             scale, impl="pallas"),
+        _mesh(N), in_specs=(spec, spec, spec), out_specs=spec)
+
+    out = jax.jit(fn)(q, k, v)
+    ref = _attention_xla(q, k, v, None, causal, scale, 0.0, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    g_ring = jax.jit(jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v) * ct),
+                              argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(
+            _attention_xla(q, k, v, None, causal, scale, 0.0, None) * ct),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_ring_chunked_single_parity():
+    """Single-chip chunked-ring compute (the bench surface) matches the
+    dense oracle fwd + grads, causal and full."""
+    from paddle_tpu.distributed.long_context import ring_chunked_single
+    rng = np.random.RandomState(9)
+    B, S, H, D, C = 1, 256, 2, 32, 4
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32) * 0.3
+    k = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32) * 0.3
+    v = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32) * 0.3
+    scale = 1.0 / math.sqrt(D)
+    ct = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+    for causal in (True, False):
+        out = jax.jit(lambda a, b, c: ring_chunked_single(
+            a, b, c, C, causal, scale, True))(q, k, v)
+        ref = _attention_xla(q, k, v, None, causal, scale, 0.0, None)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        g1 = jax.grad(lambda a, b, c: jnp.sum(ring_chunked_single(
+            a, b, c, C, causal, scale, True) * ct),
+            argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda a, b, c: jnp.sum(_attention_xla(
+            a, b, c, None, causal, scale, 0.0, None) * ct),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g1, g2, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-4,
+                                       err_msg=f"d{name} causal={causal}")
+
+
+def test_sep_attention_strategy_selection():
+    """fleet sep-axis API (VERDICT r4 #5): ring/ulysses/gather selectable
+    via DistributedStrategy.sep_configs, all matching the local oracle."""
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.distributed.fleet.meta_parallel.segment_parallel import (
+        sep_attention)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": 4,
+                               "order": ["dp", "pp", "sharding", "sep",
+                                         "mp"]}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_sep_parallel_world_size() == 4
+
+    q, k, v = _mk(1, 64, 4, 16, seed=8)
+    scale = 1.0 / math.sqrt(16)
+    ref = np.asarray(_attention_xla(q, k, v, None, True, scale, 0.0, None))
+    for mode in ("ring", "ulysses", "gather"):
+        strategy.sep_configs = {"attention": mode}
+        out = sep_attention(q, k, v, hcg, strategy=strategy, causal=True)
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                                   rtol=2e-5, atol=2e-5,
+                                   err_msg=f"mode {mode}")
+    strategy.sep_configs = {"attention": "nope"}
+    with pytest.raises(ValueError, match="unknown sep attention"):
+        sep_attention(q, k, v, hcg, strategy=strategy)
+
+
 def test_ring_through_tape():
     """Tensor-level API: gradients flow through the tape into q/k/v."""
     q, k, v = _mk(1, 32, 2, 8, seed=6)

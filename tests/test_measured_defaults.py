@@ -1,0 +1,209 @@
+"""Measured-defaults table kills the cold-cache cliff (VERDICT r4 #6).
+
+Jitted calls consult the autotune cache but cannot measure; without a
+same-session eager pre-tune they used to fall straight to hand
+heuristics. Now a shape-CLASS defaults table (seeded from captures by
+tools/seed_defaults.py) answers traced cold-cache lookups first.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.core import autotune as _at
+from paddle_tpu.core import flags as _flags
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "seed_defaults", os.path.join(REPO, "tools", "seed_defaults.py"))
+sd = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(sd)
+
+
+@pytest.fixture
+def clean_autotune():
+    was_on = _flags.get_flag("use_autotune")
+    cache_file_backup = _at._CACHE_FILE
+    _at.set_autotune_cache_file(None)
+    _at.clear_autotune_cache()
+    yield
+    _at.clear_autotune_cache()
+    _at._CACHE_FILE = cache_file_backup
+    _flags.set_flags({"use_autotune": was_on})
+
+
+class TestSeeder:
+    def test_flash_v2_keys_classify_and_majority(self):
+        cache = {
+            # two exact shapes in the same class (seq 3000/4096 -> 4096
+            # bucket), 2:1 majority for b256x512
+            "flash_attention_blocks_v2_c1_r0_b0|(1, 4096, 16, 128)"
+            ":bfloat16|(1, 4096, 16, 128):bfloat16": "b256x512",
+            "flash_attention_blocks_v2_c1_r0_b0|(1, 3000, 16, 128)"
+            ":bfloat16|(1, 3000, 16, 128):bfloat16": "b256x512",
+            "flash_attention_blocks_v2_c1_r0_b0|(1, 2100, 16, 128)"
+            ":bfloat16|(1, 2100, 16, 128):bfloat16": "b128x128",
+            # GQA shape -> its own class (g1)
+            "flash_attention_blocks_v2_c1_r0_b0|(1, 4096, 32, 128)"
+            ":bfloat16|(1, 4096, 8, 128):bfloat16": "xla",
+            # v1 keys (pre-r4 candidate set) are ignored
+            "flash_attention_blocks_c1_r0_b0|(8, 1024, 16, 128)"
+            ":bfloat16|(8, 1024, 16, 128):bfloat16": "b256x512",
+            # meta side notes are ignored
+            "flash_attention_blocks_v2_c1_r0_b0|(1, 4096, 16, 128)"
+            ":bfloat16|(1, 4096, 16, 128):bfloat16__meta": "batch=8",
+        }
+        d = sd.build_defaults(cache)
+        mha = ("flash_attention_blocks_v2_c1_r0_b0_class_g0_d128"
+               "_sq4096_sk4096_bfloat16")
+        gqa = ("flash_attention_blocks_v2_c1_r0_b0_class_g1_d128"
+               "_sq4096_sk4096_bfloat16")
+        assert d[mha] == "b256x512"          # 2:1 majority
+        assert d[gqa] == "xla"
+        assert len(d) == 2                   # v1 + meta dropped
+
+    def test_ce_and_norm_keys_classify(self):
+        cache = {
+            "softmax_xent_dir|(8192, 50304):float32|(8192,):int32":
+                "pallas_xbwd",
+            "rms_norm_dir|(8192, 4096):float32|(4096,):float32": "xla",
+            "layer_norm_dir|(16, 512, 768):float32|(768,):float32|"
+            "(768,):float32": "pallas",
+        }
+        d = sd.build_defaults(cache)
+        assert d["softmax_xent_dir_class_r8192_v65536_float32"] == \
+            "pallas_xbwd"
+        assert d["rms_norm_dir_class_r8192_c4096_float32"] == "xla"
+        # rows = 16*512 = 8192
+        assert d["layer_norm_dir_class_r8192_c768_float32"] == "pallas"
+
+    def test_classifier_matches_call_sites(self):
+        """The seeder's class keys must equal what the call sites compute,
+        or defaults can never hit. Pin the flash one end-to-end."""
+        key = ("flash_attention_blocks_v2_c1_r0_b0|(1, 4096, 32, 128)"
+               ":bfloat16|(1, 4096, 8, 128):bfloat16")
+        ck = sd.classify(key)
+        # what ops/pallas/flash_attention.py builds for this call
+        expect = (f"flash_attention_blocks_v2_c1_r0_b0_class_g1_d128"
+                  f"_sq{_at.shape_bucket(4096)}_sk{_at.shape_bucket(4096)}"
+                  f"_bfloat16")
+        assert ck == expect
+
+
+class TestConsultPath:
+    def test_traced_cold_cache_takes_class_default(self, clean_autotune):
+        _at.enable_autotune()
+        _at.set_measured_defaults({"myop_class_k": "fancy"})
+        seen = []
+
+        def f(x):
+            choice, _ = _at.pick_impl(
+                "myop", {"plain": None, "fancy": None}, (x,),
+                call=None, class_key="myop_class_k")
+            seen.append(choice)
+            return x
+
+        jax.jit(f)(jnp.ones((4,), jnp.float32))
+        assert seen == ["fancy"]
+        assert _at.autotune_status()["class_hits"] == 1
+
+    def test_exact_cache_wins_over_class_default(self, clean_autotune):
+        _at.enable_autotune()
+        _at.set_measured_defaults({"myop_class_k": "fancy"})
+        x = jnp.ones((4,), jnp.float32)
+        _at._CACHE[_at._key("myop", (x,))] = "plain"
+        seen = []
+
+        def f(x):
+            choice, _ = _at.pick_impl(
+                "myop", {"plain": None, "fancy": None}, (x,),
+                call=None, class_key="myop_class_k")
+            seen.append(choice)
+            return x
+
+        jax.jit(f)(x)
+        assert seen == ["plain"]
+
+    def test_no_default_no_class_hit(self, clean_autotune):
+        _at.enable_autotune()
+        seen = []
+
+        def f(x):
+            choice, _ = _at.pick_impl(
+                "myop", {"plain": None, "fancy": None}, (x,),
+                call=None, class_key="myop_class_other")
+            seen.append(choice)
+            return x
+
+        jax.jit(f)(jnp.ones((4,), jnp.float32))
+        assert seen == [None]
+        assert _at.autotune_status()["class_hits"] == 0
+
+
+class TestGQARouting:
+    """VERDICT r4 #6 done-criterion: a cold cache on a GQA shape routes to
+    XLA iff the score matrix fits flash_gqa_xla_max_bytes."""
+
+    def _tuned(self, B, S, Hq, Hk, D):
+        from paddle_tpu.ops.pallas.flash_attention import _tuned_blocks
+        q = jax.ShapeDtypeStruct((B, S, Hq, D), jnp.bfloat16)
+        k = jax.ShapeDtypeStruct((B, S, Hk, D), jnp.bfloat16)
+        got = {}
+
+        def f(q, k, v):
+            impl, bq, bk, _ = _tuned_blocks(
+                q, k, v, None, None, True, float(D) ** -0.5, 0.0,
+                interpret=False)
+            got["impl"] = impl
+            return q
+
+        jax.eval_shape(f, q, k, jax.ShapeDtypeStruct(k.shape, k.dtype))
+        return got["impl"]
+
+    def test_gqa_routes_to_xla_iff_scores_fit(self, clean_autotune):
+        _at.enable_autotune()   # cold cache, no defaults: heuristic rules
+        B, S, Hq, Hk, D = 2, 4096, 32, 8, 128
+        score_bytes = B * Hq * S * S * 4
+        old = _flags.get_flag("flash_gqa_xla_max_bytes")
+        try:
+            _flags.set_flags({"flash_gqa_xla_max_bytes": score_bytes})
+            assert self._tuned(B, S, Hq, Hk, D) == "xla"
+            _flags.set_flags({"flash_gqa_xla_max_bytes": score_bytes - 1})
+            assert self._tuned(B, S, Hq, Hk, D) == "pallas"
+            # MHA never takes the GQA->XLA default
+            _flags.set_flags({"flash_gqa_xla_max_bytes": score_bytes})
+            assert self._tuned(B, S, Hq, Hq, D) == "pallas"
+        finally:
+            _flags.set_flags({"flash_gqa_xla_max_bytes": old})
+
+    def test_class_default_xla_never_oversubscribes_hbm(
+            self, clean_autotune):
+        """A class-default "xla" from a small-batch capture must not route
+        a call whose own score matrix exceeds the budget."""
+        _at.enable_autotune()
+        B, S, Hq, Hk, D = 2, 4096, 32, 8, 128
+        ck = (f"flash_attention_blocks_v2_c1_r0_b0_class_g1_d{D}"
+              f"_sq{_at.shape_bucket(S)}_sk{_at.shape_bucket(S)}"
+              f"_bfloat16")
+        _at.set_measured_defaults({ck: "xla"})
+        score_bytes = B * Hq * S * S * 4
+        old = _flags.get_flag("flash_gqa_xla_max_bytes")
+        try:
+            _flags.set_flags({"flash_gqa_xla_max_bytes": score_bytes})
+            assert self._tuned(B, S, Hq, Hk, D) == "xla"   # fits: honored
+            # and it was the CLASS DEFAULT that answered, not the cold-
+            # cache heuristic coincidentally agreeing: the drift-detector
+            # for the shared class-key format (review r5)
+            assert _at.autotune_status()["class_hits"] == 1
+            _flags.set_flags({"flash_gqa_xla_max_bytes": score_bytes - 1})
+            # does not fit: "xla" is not in this call's candidate set, so
+            # the class default is ignored and the heuristic (pallas,
+            # since xla doesn't fit) ships
+            assert self._tuned(B, S, Hq, Hk, D) == "pallas"
+        finally:
+            _flags.set_flags({"flash_gqa_xla_max_bytes": old})

@@ -204,6 +204,14 @@ def capture(device_info: str) -> bool:
                     log("kernel baseline re-seeded from shipped ratios")
             except Exception as e:  # noqa: BLE001
                 log(f"baseline reseed failed: {e!r}")
+            # refresh the shape-class measured-defaults table from the
+            # autotune winners this capture just measured (VERDICT r4 #6)
+            try:
+                import seed_defaults as _sd
+                _sd.main()
+                log("measured defaults re-seeded from autotune cache")
+            except Exception as e:  # noqa: BLE001
+                log(f"defaults seeding failed: {e!r}")
         else:
             log(f"bench_kernels capture failed: "
                 f"{(kern or {}).get('error', 'no/cpu result')}")
