@@ -46,14 +46,43 @@ def _aux_loss(probs, mask):
     return jnp.sum(density * density_proxy) * e
 
 
+def _dense_from_route(idx, pos, gates, kept, e, capacity):
+    """Materialize the dense GShard (T,E,C) dispatch/combine tensors from
+    a ragged routing table. Out-of-range pos one-hots to zeros, so dropped
+    (t, k) slots vanish even before the ``kept`` mask. Accumulated one k
+    at a time so peak memory stays O(T*E*C), not O(T*K*E*C)."""
+    k = idx.shape[1]
+    disp = comb = None
+    for i in range(k):
+        d_i = (_one_hot(idx[:, i], e)[:, :, None]
+               * _one_hot(pos[:, i], capacity)[:, None, :]
+               * kept[:, i, None, None])                    # (T, E, C)
+        c_i = d_i * gates[:, i, None, None]
+        disp = d_i if disp is None else jnp.maximum(disp, d_i)
+        comb = c_i if comb is None else comb + c_i
+    return disp, comb
+
+
 class _GateBase:
-    """Gates are lightweight strategy objects: __call__(logits, capacity) ->
-    (dispatch (T,E,C), combine (T,E,C), aux_loss scalar)."""
+    """Gates are lightweight strategy objects. ``route(logits, capacity)``
+    is the primitive: a RAGGED routing table
+    (idx (T,K) i32, pos (T,K) i32, gates (T,K) f32 — zeroed where dropped,
+    kept (T,K) f32, aux scalar) with K = top_k. ``__call__`` derives the
+    dense (T,E,C) dispatch/combine tensors from it (the einsum path);
+    MoELayer's scatter path consumes the table directly so dispatch
+    memory stays O(T*K + E*C*d) where sep x ep meshes make (T,E,C)
+    explode (VERDICT r4 #8)."""
 
     top_k = 1
 
-    def __call__(self, logits, capacity):
+    def route(self, logits, capacity):
         raise NotImplementedError
+
+    def __call__(self, logits, capacity):
+        idx, pos, gates, kept, aux = self.route(logits, capacity)
+        disp, comb = _dense_from_route(idx, pos, gates, kept,
+                                       logits.shape[1], capacity)
+        return disp, comb, aux
 
 
 class SwitchGate(_GateBase):
@@ -62,20 +91,18 @@ class SwitchGate(_GateBase):
 
     top_k = 1
 
-    def __call__(self, logits, capacity):
+    def route(self, logits, capacity):
         t, e = logits.shape
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
         idx1 = jnp.argmax(probs, axis=-1)
         mask1 = _one_hot(idx1, e)
         aux = _aux_loss(probs, mask1)
-        pos1 = _positions_in_expert(mask1) * mask1
-        keep1 = (jnp.sum(pos1, axis=1) < capacity).astype(jnp.float32)
-        mask1 = mask1 * keep1[:, None]
-        gate1 = jnp.sum(probs * mask1, axis=1)
-        disp = mask1[:, :, None] * _one_hot(
-            jnp.sum(pos1, axis=1).astype(jnp.int32), capacity)[:, None, :]
-        comb = disp * gate1[:, None, None]
-        return disp, comb, aux
+        pos1 = jnp.sum(_positions_in_expert(mask1) * mask1, axis=1)
+        keep1 = (pos1 < capacity).astype(jnp.float32)
+        gate1 = jnp.sum(probs * mask1, axis=1) * keep1
+        return (idx1[:, None].astype(jnp.int32),
+                pos1[:, None].astype(jnp.int32),
+                gate1[:, None], keep1[:, None], aux)
 
 
 class GShardGate(_GateBase):
@@ -83,7 +110,7 @@ class GShardGate(_GateBase):
 
     top_k = 2
 
-    def __call__(self, logits, capacity):
+    def route(self, logits, capacity):
         t, e = logits.shape
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
         idx1 = jnp.argmax(probs, axis=-1)
@@ -96,24 +123,21 @@ class GShardGate(_GateBase):
 
         pos1 = jnp.sum(_positions_in_expert(mask1) * mask1, axis=1)
         count1 = jnp.sum(mask1, axis=0, keepdims=True)          # (1, E)
-        pos2 = jnp.sum((_positions_in_expert(mask2) + count1) * mask2, axis=1)
+        pos2 = jnp.sum((_positions_in_expert(mask2) + count1) * mask2,
+                       axis=1)
         keep1 = (pos1 < capacity).astype(jnp.float32)
         keep2 = (pos2 < capacity).astype(jnp.float32)
-        mask1 = mask1 * keep1[:, None]
-        mask2 = mask2 * keep2[:, None]
 
-        g1 = jnp.sum(probs * mask1, axis=1)
-        g2 = jnp.sum(probs * mask2, axis=1)
+        g1 = jnp.sum(probs * mask1, axis=1) * keep1
+        g2 = jnp.sum(probs * mask2, axis=1) * keep2
         denom = jnp.maximum(g1 + g2, 1e-9)
         g1, g2 = g1 / denom, g2 / denom
 
-        disp1 = mask1[:, :, None] * _one_hot(pos1.astype(jnp.int32),
-                                             capacity)[:, None, :]
-        disp2 = mask2[:, :, None] * _one_hot(pos2.astype(jnp.int32),
-                                             capacity)[:, None, :]
-        disp = jnp.maximum(disp1, disp2)
-        comb = disp1 * g1[:, None, None] + disp2 * g2[:, None, None]
-        return disp, comb, aux
+        idx = jnp.stack([idx1, idx2], axis=1).astype(jnp.int32)
+        pos = jnp.stack([pos1, pos2], axis=1).astype(jnp.int32)
+        gates = jnp.stack([g1, g2], axis=1)
+        kept = jnp.stack([keep1, keep2], axis=1)
+        return idx, pos, gates, kept, aux
 
 
 class NaiveGate(_GateBase):
@@ -125,25 +149,26 @@ class NaiveGate(_GateBase):
     def __init__(self, top_k=2):
         self.top_k = top_k
 
-    def __call__(self, logits, capacity):
+    def route(self, logits, capacity):
         t, e = logits.shape
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        disp = jnp.zeros((t, e, capacity), jnp.float32)
-        comb = jnp.zeros((t, e, capacity), jnp.float32)
         remaining = probs
         count = jnp.zeros((1, e), jnp.float32)
         aux = _aux_loss(probs, _one_hot(jnp.argmax(probs, axis=-1), e))
+        idxs, poss, gs, keeps = [], [], [], []
         for _ in range(self.top_k):
             idx = jnp.argmax(remaining, axis=-1)
             mask = _one_hot(idx, e)
-            pos = jnp.sum((_positions_in_expert(mask) + count) * mask, axis=1)
+            pos = jnp.sum((_positions_in_expert(mask) + count) * mask,
+                          axis=1)
             keep = (pos < capacity).astype(jnp.float32)
-            mask_k = mask * keep[:, None]
-            g = jnp.sum(probs * mask_k, axis=1)
-            d = mask_k[:, :, None] * _one_hot(pos.astype(jnp.int32),
-                                              capacity)[:, None, :]
-            disp = jnp.maximum(disp, d)
-            comb = comb + d * g[:, None, None]
+            g = jnp.sum(probs * mask, axis=1) * keep
+            idxs.append(idx)
+            poss.append(pos)
+            gs.append(g)
+            keeps.append(keep)
             count = count + jnp.sum(mask, axis=0, keepdims=True)
             remaining = remaining * (1.0 - mask)
-        return disp, comb, aux
+        return (jnp.stack(idxs, axis=1).astype(jnp.int32),
+                jnp.stack(poss, axis=1).astype(jnp.int32),
+                jnp.stack(gs, axis=1), jnp.stack(keeps, axis=1), aux)

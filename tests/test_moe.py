@@ -4,6 +4,7 @@ with expert-parallel parity on the 8-device CPU mesh."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import Mesh
 
 import paddle_tpu as paddle
@@ -86,6 +87,98 @@ def test_moe_single_expert_equals_mlp():
     ref = (h @ w2 + b2).reshape(1, 4, 8)
     np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-4,
                                atol=1e-4)
+
+
+def _mk_layer(gate, dispatch_mode, capacity_factor, seed=3, e=4,
+              d_model=16, d_hidden=32):
+    paddle.seed(seed)
+    layer = MoELayer(d_model=d_model, d_hidden=d_hidden, num_experts=e,
+                     gate=gate, capacity_factor=capacity_factor,
+                     dispatch_mode=dispatch_mode)
+    rng = np.random.RandomState(seed)
+    layer.gate_weight._data = jnp.asarray(
+        rng.standard_normal((d_model, e)).astype(np.float32))
+    return layer
+
+
+@pytest.mark.parametrize("gate", ["gshard", "switch", "naive"])
+@pytest.mark.parametrize("cf", [2.0, 0.5])
+def test_scatter_dispatch_matches_einsum(gate, cf):
+    """VERDICT r4 #8: the ragged scatter dispatch is numerically the dense
+    one-hot einsum path, with and without capacity pressure."""
+    rng = np.random.RandomState(11)
+    x = rng.standard_normal((4, 8, 16)).astype(np.float32)
+    outs, drops = {}, {}
+    for mode in ("einsum", "scatter"):
+        layer = _mk_layer(gate, mode, cf)
+        out = layer(paddle.to_tensor(x))
+        outs[mode] = np.asarray(out.numpy())
+        drops[mode] = float(layer.drop_rate)
+    np.testing.assert_allclose(outs["scatter"], outs["einsum"],
+                               rtol=2e-5, atol=2e-5)
+    assert abs(drops["scatter"] - drops["einsum"]) < 1e-7
+
+
+def test_capacity_pressure_drop_accounting():
+    """capacity_factor < 1 must DROP tokens, and the bookkeeping must
+    agree with the capacity arithmetic."""
+    from paddle_tpu.incubate.moe.gate import compute_capacity
+    t, e = 32, 4
+    rng = np.random.RandomState(2)
+    x = rng.standard_normal((1, t, 16)).astype(np.float32)
+    for gate, top_k in (("gshard", 2), ("switch", 1)):
+        layer = _mk_layer(gate, "scatter", 0.5, e=e)
+        layer(paddle.to_tensor(x))
+        drop = float(layer.drop_rate)
+        cap = compute_capacity(t, e, top_k, 0.5)
+        # at most e*cap slots can be kept out of t*top_k requested
+        floor = max(0.0, 1.0 - e * cap / (t * top_k))
+        assert drop >= floor - 1e-6, (gate, drop, floor)
+        assert drop > 0.0, f"{gate}: capacity 0.5 dropped nothing"
+        assert drop < 1.0
+    # ample capacity: nothing drops
+    layer = _mk_layer("gshard", "scatter", float(e), e=e)
+    layer(paddle.to_tensor(x))
+    assert float(layer.drop_rate) == 0.0
+
+
+@pytest.mark.parametrize("gate", ["gshard", "switch"])
+def test_aux_loss_grad_flows_under_pressure(gate):
+    """The load-balance aux loss must carry gradient back to the gate
+    weight even when capacity drops tokens."""
+    rng = np.random.RandomState(4)
+    x = rng.standard_normal((2, 16, 16)).astype(np.float32)
+    layer = _mk_layer(gate, "scatter", 0.5)
+    layer.gate_weight.stop_gradient = False
+    out = layer(paddle.to_tensor(x))
+    loss = out.sum() + 0.01 * layer.aux_loss
+    loss.backward()
+    g = np.asarray(layer.gate_weight.grad.numpy())
+    assert np.isfinite(g).all()
+    assert np.abs(g).max() > 0.0, "aux loss carried no gradient"
+
+
+def test_scatter_dispatch_memory_bounded():
+    """The scatter path must never materialize a (T, E, C)-shaped
+    intermediate — that is the whole point (VERDICT r4 #8: dense
+    dispatch explodes on sep x ep meshes)."""
+    import jax
+
+    t, e, d = 64, 8, 16
+    layer = _mk_layer("gshard", "scatter", 1.0, e=e, d_model=d)
+    from paddle_tpu.incubate.moe.gate import compute_capacity
+    cap = compute_capacity(t, e, 2, 1.0)
+
+    x = jnp.zeros((t, d), jnp.float32)
+    jaxpr = jax.make_jaxpr(
+        lambda xt: layer(paddle.to_tensor(xt))._data)(x)
+    banned = {(t, e, cap), (t, 2, e, cap)}
+    for eqn in jaxpr.jaxpr.eqns:
+        for v in list(eqn.invars) + list(eqn.outvars):
+            shape = tuple(getattr(getattr(v, "aval", None), "shape", ()))
+            assert shape not in banned, (
+                f"dense (T,E,C) tensor {shape} in scatter-mode jaxpr "
+                f"({eqn.primitive})")
 
 
 def test_moe_expert_parallel_parity():
