@@ -217,7 +217,8 @@ def main():
         candidates = ((8, "plain"), (16, "plain"), (16, "blockwise"),
                       (32, "blockwise"), (32, "blockwise+remat_dots"),
                       (64, "blockwise+remat_dots"),
-                      (32, "blockwise+remat"), (64, "blockwise+remat"))
+                      (32, "blockwise+remat"), (64, "blockwise+remat"),
+                      (128, "blockwise+remat"))
         seq, iters, windows = 1024, 20, 3
     else:  # CI fallback so bench never hard-fails
         cfg = GPTConfig(vocab_size=1024, max_position_embeddings=128,

@@ -1,0 +1,36 @@
+"""MoE expert parallelism with capacity pressure and ragged dispatch.
+
+capacity_factor < 1 drops overflow tokens (Switch-style); the dropped
+fraction is exposed as layer.drop_rate. dispatch_mode="scatter" routes
+through a ragged scatter-add/gather (the TPU form of the reference's
+global_scatter/global_gather NCCL all-to-all) instead of dense
+(tokens, experts, capacity) one-hot tensors.
+
+Run:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/moe_capacity.py
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.moe import MoELayer
+
+mesh = Mesh(np.array(jax.devices()[:8]), ("ep",))
+rng = np.random.RandomState(0)
+x = paddle.to_tensor(rng.standard_normal((2, 16, 32)).astype("float32"))
+
+for cf in (2.0, 0.5):
+    paddle.seed(0)
+    layer = MoELayer(d_model=32, d_hidden=64, num_experts=8,
+                     gate="gshard", capacity_factor=cf, mesh=mesh,
+                     expert_axis="ep", dispatch_mode="scatter")
+    layer.gate_weight._data = jnp.asarray(
+        rng.standard_normal((32, 8)).astype(np.float32))
+    out = layer(x)
+    loss = out.sum() + 0.01 * layer.aux_loss
+    loss.backward()
+    print(f"capacity_factor={cf}: loss {float(loss):.4f} "
+          f"drop_rate {float(layer.drop_rate):.3f} "
+          f"aux {float(layer.aux_loss):.4f}")

@@ -9,7 +9,8 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 MESH_EXAMPLES = ["fleet_hybrid.py", "pipeline_1f1b.py",
-                 "auto_parallel_engine.py", "degree_planner.py"]
+                 "auto_parallel_engine.py", "degree_planner.py",
+                 "long_context_ring.py", "moe_capacity.py"]
 PLAIN_EXAMPLES = ["train_gpt2.py", "inference_predictor.py",
                   "parameter_server.py"]
 
