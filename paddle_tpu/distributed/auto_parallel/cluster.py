@@ -1,0 +1,58 @@
+"""Cluster topology model: which mesh axes ride ICI vs DCN.
+
+The reference resolves rank -> device -> link class through its Cluster
+description + process-group mapper
+(/root/reference/python/paddle/distributed/auto_parallel/static/cluster.py,
+mapper.py) and prices collectives per link class in the cost model
+(static/cost/comm_op_cost.py alpha/beta tables). The TPU analog is
+simpler and derivable at runtime: devices within one process (host)
+reach each other over ICI; a mesh axis whose neighbor hops cross a
+process boundary communicates over DCN. This module infers a per-axis
+relative-bandwidth map from any device mesh, which the planner and the
+Completer's comm terms consume (``axis_bandwidth``).
+"""
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import numpy as np
+
+__all__ = ["ICI_BANDWIDTH", "DCN_BANDWIDTH", "infer_axis_bandwidth"]
+
+# relative link bandwidths (ICI-normalized). v5e ICI ~ 400 GB/s/link vs
+# ~ 10-25 GB/s/host DCN: a DCN-crossing collective costs ~25x the bytes.
+ICI_BANDWIDTH = 1.0
+DCN_BANDWIDTH = 0.04
+
+
+def _process_of(dev) -> int:
+    return int(getattr(dev, "process_index", 0))
+
+
+def infer_axis_bandwidth(devices, axis_names: Sequence[str]
+                         ) -> Dict[str, float]:
+    """Per-axis relative bandwidth for a device mesh.
+
+    ``devices``: an ndarray of device objects shaped like the mesh (a
+    ``jax.sharding.Mesh.devices`` array, or any object array exposing
+    ``process_index``); ``axis_names``: one name per mesh dim. An axis
+    where ANY neighbor hop crosses a process boundary is priced at DCN
+    bandwidth — one slow hop gates the whole ring collective.
+    """
+    devs = np.asarray(devices, dtype=object)
+    if devs.ndim != len(axis_names):
+        raise ValueError(
+            f"device mesh rank {devs.ndim} != {len(axis_names)} axis "
+            f"names {tuple(axis_names)}")
+    out: Dict[str, float] = {}
+    for i, name in enumerate(axis_names):
+        crosses = False
+        for j in range(devs.shape[i] - 1):
+            a = np.take(devs, j, axis=i).ravel()
+            b = np.take(devs, j + 1, axis=i).ravel()
+            if any(_process_of(x) != _process_of(y)
+                   for x, y in zip(a, b)):
+                crosses = True
+                break
+        out[name] = DCN_BANDWIDTH if crosses else ICI_BANDWIDTH
+    return out

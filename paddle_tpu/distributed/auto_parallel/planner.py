@@ -158,10 +158,19 @@ def plan_parallel_layout(layer, sample_feed, devices=None, loss_fn=None,
             continue
         mesh = Mesh(np.array(devices).reshape(dp, tp),
                     (data_axis, model_axis))
+        # topology-aware by default (reference cluster.py/mapper.py): an
+        # axis whose neighbor hops cross hosts rides DCN. Per-candidate:
+        # the same devices reshape differently per (dp, tp), moving which
+        # axis crosses the host boundary
+        if axis_bandwidth is None:
+            from .cluster import infer_axis_bandwidth
+            bw_map = infer_axis_bandwidth(mesh.devices, mesh.axis_names)
+        else:
+            bw_map = axis_bandwidth
         specs, cost = derive_param_specs(
             layer, mesh, sample_feed, loss_fn=loss_fn,
             data_axis=data_axis, model_axis=model_axis,
-            return_cost=True, axis_bandwidth=axis_bandwidth)
+            return_cost=True, axis_bandwidth=bw_map)
         # dp gradient sync: ring all-reduce of every grad once per
         # step — 2(dp-1)/dp x the LOCAL grad bytes (the per-op
         # plan never charges it; it happens between steps).
@@ -177,7 +186,7 @@ def plan_parallel_layout(layer, sample_feed, devices=None, loss_fn=None,
             sharded = spec is not None and any(
                 e == model_axis for e in tuple(spec))
             local_bytes += nbytes / (tp if sharded else 1)
-        dp_bw = (axis_bandwidth or {}).get(data_axis, 1.0)
+        dp_bw = bw_map.get(data_axis, 1.0)
         cost = cost + 2.0 * (dp - 1) / max(dp, 1) * local_bytes \
             / max(dp_bw, 1e-9)
         info["candidates"][tag] = round(float(cost), 1)
@@ -362,10 +371,15 @@ def plan_parallel_config(layer, sample_feed, devices=None, loss_fn=None,
         from jax.sharding import Mesh
         mesh = Mesh(np.array(devices[:dp * tp]).reshape(dp, tp),
                     (data_axis, model_axis))
+        if axis_bandwidth is None:
+            from .cluster import infer_axis_bandwidth
+            sub_bw = infer_axis_bandwidth(mesh.devices, mesh.axis_names)
+        else:
+            sub_bw = axis_bandwidth
         specs, cost = derive_param_specs(
             layer, mesh, sample_feed, loss_fn=loss_fn,
             data_axis=data_axis, model_axis=model_axis,
-            return_cost=True, axis_bandwidth=axis_bandwidth)
+            return_cost=True, axis_bandwidth=sub_bw)
         local_bytes = 0.0
         for name, nbytes in param_sizes.items():
             spec = specs.get(name)
@@ -375,7 +389,18 @@ def plan_parallel_config(layer, sample_feed, devices=None, loss_fn=None,
         plan_cache[(dp, tp)] = (specs, float(cost), local_bytes)
         return plan_cache[(dp, tp)]
 
-    bw = axis_bandwidth or {}
+    def candidate_bw(dp, tp, pp, sh):
+        """Per-candidate link classes from the rank->device mapping: the
+        full factorization reshapes the same device list, moving which
+        axis crosses the host boundary (reference cluster.py/mapper.py).
+        tp innermost = fastest-varying, the ICI-first convention."""
+        if axis_bandwidth is not None:
+            return axis_bandwidth
+        from .cluster import infer_axis_bandwidth
+        full = np.array(devices, dtype=object).reshape(pp, sh, dp, tp)
+        return infer_axis_bandwidth(
+            full, ("pp", "sharding", data_axis, model_axis))
+
     best = None   # (cost, cfg, bounds, specs)
     rc_tag = {None: "none", "dots_saveable": "dots", "full": "full"}
     for pp in _divisors(n):
@@ -417,12 +442,16 @@ def plan_parallel_config(layer, sample_feed, devices=None, loss_fn=None,
                         bubble = (acc + pp - 1) / acc
                         compute = (base / pp) * imb * bubble \
                             * _RECOMPUTE_FLOP_MULT[rc]
-                        # grad sync rides the fused dp x sharding group;
+                        bw = candidate_bw(dp, tp, pp, sh)
+                        # grad sync rides the fused dp x sharding group —
+                        # the slowest participating link gates the ring;
                         # ZeRO adds the fwd/bwd param all-gathers (~1.5x)
                         ds = dp * sh
+                        sync_bw = min(bw.get(data_axis, 1.0),
+                                      bw.get("sharding", 1.0))
                         sync = 2.0 * (ds - 1) / max(ds, 1) * local_bytes \
                             / pp * (1.5 if sh > 1 else 1.0) \
-                            / max(bw.get(data_axis, 1.0), 1e-9)
+                            / max(sync_bw, 1e-9)
                         # pp p2p: boundary activations fwd+bwd per
                         # microbatch (bf16 = 2 bytes)
                         p2p = 0.0

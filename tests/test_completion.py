@@ -417,6 +417,38 @@ class TestCostModelValidation:
         best_skewed = min(skewed, key=skewed.get)
         assert best_honest != best_skewed, (best_honest, best_skewed)
 
+    def test_infer_axis_bandwidth_topology(self):
+        """Cluster inference (reference cluster.py/mapper.py analog): a
+        mesh axis whose neighbor hops cross hosts rides DCN."""
+        import types
+
+        from paddle_tpu.distributed.auto_parallel.cluster import (
+            DCN_BANDWIDTH, ICI_BANDWIDTH, infer_axis_bandwidth)
+
+        def dev(p):
+            return types.SimpleNamespace(process_index=p)
+
+        # 2 hosts x 4 chips, chips innermost: dp crosses hosts, tp stays
+        devs = np.array([[dev(0)] * 4, [dev(1)] * 4], dtype=object)
+        bw = infer_axis_bandwidth(devs, ("dp", "tp"))
+        assert bw == {"dp": DCN_BANDWIDTH, "tp": ICI_BANDWIDTH}
+        # transpose: the host-crossing moves to the second axis
+        bw_t = infer_axis_bandwidth(devs.T, ("tp", "dp"))
+        assert bw_t == {"tp": ICI_BANDWIDTH, "dp": DCN_BANDWIDTH}
+        # one host: everything ICI
+        one = np.array([[dev(0)] * 4, [dev(0)] * 4], dtype=object)
+        assert infer_axis_bandwidth(one, ("dp", "tp")) == {
+            "dp": ICI_BANDWIDTH, "tp": ICI_BANDWIDTH}
+        # 4-D factorization (the config planner's rank->device mapping):
+        # 2 hosts x 8 chips as (pp2, sh1, dp2, tp4) — pp crosses hosts
+        flat = np.array([dev(i // 8) for i in range(16)], dtype=object)
+        bw4 = infer_axis_bandwidth(flat.reshape(2, 1, 2, 4),
+                                   ("pp", "sharding", "dp", "tp"))
+        assert bw4["pp"] == DCN_BANDWIDTH
+        assert bw4["dp"] == bw4["tp"] == ICI_BANDWIDTH
+        with pytest.raises(ValueError, match="axis names"):
+            infer_axis_bandwidth(devs, ("only_one",))
+
     def test_completer_bandwidth_scales_comm_cost(self):
         from paddle_tpu.distributed.auto_parallel.completion import (
             Completer, DistTensorSpec)
