@@ -199,26 +199,6 @@ def main():
             shipped_fn=lambda q, k, v, _s=scale: _attention_pallas(
                 q, k, v, None, True, _s, 0.0, None))
 
-    # ---- ring-attention chunk compute at s8k (VERDICT r4 #5): the per-
-    # device ring step — 4 chunks of 2048, flash block kernel per pair,
-    # lse merge — vs the monolithic whole-sequence kernel. The "ratio"
-    # here is monolithic_ms / chunked_ms: single-chip ring compute
-    # overhead (expected < 1.0; diagnostic, not gated — no shipped_fn)
-    from paddle_tpu.distributed.long_context import ring_chunked_single
-    B, S, Hq, D = 1, 8192, 16, 128
-    q = jnp.asarray(rng.randn(B, S, Hq, D), jnp.bfloat16) * 0.1
-    k = jnp.asarray(rng.randn(B, S, Hq, D), jnp.bfloat16) * 0.1
-    v = jnp.asarray(rng.randn(B, S, Hq, D), jnp.bfloat16) * 0.1
-    scale = float(D) ** -0.5
-    bench_pair(
-        "ring_chunks_s8k_c4",
-        lambda q, k, v, _s=scale: ring_chunked_single(
-            q, k, v, 4, True, _s, False),
-        lambda q, k, v, _s=scale: flash_attention_ext(
-            q, k, v, None, zero_seed, None, None, True, _s, 0.0, 128, 128,
-            False),
-        (q, k, v), results, iters=2, chain=2)
-
     # ---- flash attention with in-kernel dropout (VERDICT r2 #3: the
     # dropout training config must keep the fast path) --------------------
     B, S, Hq, Hk, D = 2, 4096, 16, 16, 128
@@ -306,6 +286,28 @@ def main():
         (x, w, b), results, chain=12,
         shipped_fn=lambda x, w, b: _layer_norm_pallas_impl(
             x, w, b, 1e-6, 1))
+
+    # ---- ring-attention chunk compute at s8k (VERDICT r4 #5): the per-
+    # device ring step — 4 chunks of 2048, flash block kernel per pair,
+    # lse merge — vs the monolithic whole-sequence kernel. The "ratio"
+    # here is monolithic_ms / chunked_ms: single-chip ring compute
+    # overhead (expected < 1.0; diagnostic, not gated — no shipped_fn).
+    # LAST on purpose: its 10-kernel unrolled compile is the longest shot
+    # in this file, and a blowup here must not cost the gated cases above
+    from paddle_tpu.distributed.long_context import ring_chunked_single
+    B, S, Hq, D = 1, 8192, 16, 128
+    q = jnp.asarray(rng.randn(B, S, Hq, D), jnp.bfloat16) * 0.1
+    k = jnp.asarray(rng.randn(B, S, Hq, D), jnp.bfloat16) * 0.1
+    v = jnp.asarray(rng.randn(B, S, Hq, D), jnp.bfloat16) * 0.1
+    scale = float(D) ** -0.5
+    bench_pair(
+        "ring_chunks_s8k_c4",
+        lambda q, k, v, _s=scale: ring_chunked_single(
+            q, k, v, 4, True, _s, False),
+        lambda q, k, v, _s=scale: flash_attention_ext(
+            q, k, v, None, zero_seed, None, None, True, _s, 0.0, 128, 128,
+            False),
+        (q, k, v), results, iters=2, chain=2)
 
     ratios = [e[tag]["ratio"] for e in results.values()
               for tag in ("fwd", "fwd_bwd") if "ratio" in e[tag]]
