@@ -113,6 +113,12 @@ def _vary(xs, axis_name):
 # lse/delta (with the global lse, per-chunk gradients sum exactly).
 # ---------------------------------------------------------------------------
 
+def _bwd_delta(do, out):
+    """delta_i = rowsum(dO_i * O_i) — shared by every chunk's backward."""
+    return jnp.einsum("bshd,bshd->bhs", do.astype(jnp.float32),
+                      out.astype(jnp.float32))
+
+
 def _merge_lse(out_acc, lse_acc, o, lse):
     """Merge a new chunk's normalized (o, lse) into the running pair."""
     lse_new = jnp.logaddexp(lse_acc, lse)
@@ -185,9 +191,7 @@ def _ring_flash_bwd(axis_name, axis_size, causal, scale, interpret, res,
     B, sc, H, D = q.shape
     idx = jax.lax.axis_index(axis_name)
     perm = [((r + 1) % axis_size, r) for r in range(axis_size)]
-    # delta_i = rowsum(dO_i * O_i), shared by every chunk's backward
-    delta = jnp.einsum("bshd,bshd->bhs", do.astype(jnp.float32),
-                       out.astype(jnp.float32))
+    delta = _bwd_delta(do, out)
     dq0 = jnp.zeros((B, sc, H, D), jnp.float32)
     dk0 = jnp.zeros(k.shape, jnp.float32)
     dv0 = jnp.zeros(v.shape, jnp.float32)
@@ -275,10 +279,9 @@ def _ring_chunked_bwd(n_chunks, causal, scale, interpret, res, do):
     q, k, v, out, lse = res
     B, S, H, D = q.shape
     sc = S // n_chunks
-    delta = jnp.einsum("bshd,bshd->bhs", do.astype(jnp.float32),
-                       out.astype(jnp.float32))
+    delta = _bwd_delta(do, out)
     dqs = []
-    dks = [jnp.zeros((B, sc) + v.shape[2:], jnp.float32)
+    dks = [jnp.zeros((B, sc) + k.shape[2:], jnp.float32)
            for _ in range(n_chunks)]
     dvs = [jnp.zeros((B, sc) + v.shape[2:], jnp.float32)
            for _ in range(n_chunks)]
