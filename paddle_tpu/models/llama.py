@@ -89,6 +89,21 @@ def causal_lm_loss(logits, labels):
                            labels.reshape([b * s]))
 
 
+def _auto_num_blocks(tokens: int, vocab: int,
+                     target_elems: int = 64 * 1024 * 1024) -> int:
+    """Vocab-chunk count so one streamed (tokens, vocab/nb) f32 block
+    stays ~<= 256 MB regardless of batch: a fixed nb=8 scales the chunk
+    residual WITH tokens — at b64/s1024 that is ~1.6 GB per chunk and the
+    b128 sweep candidate would OOM on exactly the memory this loss exists
+    to save. Doubles nb (while vocab stays divisible, up to 128) until
+    the chunk fits."""
+    nb = 8
+    while (tokens * (vocab // nb) > target_elems and nb < 128
+           and vocab % (nb * 2) == 0):
+        nb *= 2
+    return nb
+
+
 def blockwise_lm_loss(h, w, labels, transpose_w=False):
     """Token-mean CE through the vocab-streamed LM-head
     (ops/fused_ce.blockwise_linear_cross_entropy) — the one blockwise loss
@@ -98,12 +113,15 @@ def blockwise_lm_loss(h, w, labels, transpose_w=False):
     from ..core.dispatch import run_op
     from ..ops.fused_ce import blockwise_linear_cross_entropy
     b, s, d = h.shape
+    vocab = w.shape[0] if not transpose_w else w.shape[1]
+    nb = _auto_num_blocks(b * s, vocab)
 
     def fn(hh, ww, yy):
         if transpose_w:
             ww = ww.T
         return blockwise_linear_cross_entropy(
-            hh.reshape(b * s, d), ww, yy.reshape(b * s), ignore_index=-100)
+            hh.reshape(b * s, d), ww, yy.reshape(b * s), num_blocks=nb,
+            ignore_index=-100)
     return run_op("fused_lm_ce", fn, (h, w, labels))
 
 

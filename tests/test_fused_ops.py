@@ -652,3 +652,18 @@ class TestPerDirectionSelection:
                 rtol=1e-5, atol=1e-5)
         finally:
             _flags.set_flags({"pallas_force_interpret": False})
+
+
+def test_auto_num_blocks_bounds_chunk_size():
+    """The vocab-chunk count adapts to tokens so a streamed block never
+    scales past the budget (b128 sweep candidates must not OOM on the
+    chunk residual)."""
+    from paddle_tpu.models.llama import _auto_num_blocks
+    V = 50304  # divisible by 8..128 (= 128 * 393)
+    assert _auto_num_blocks(8 * 1024, V) == 8        # b8: unchanged
+    assert _auto_num_blocks(64 * 1024, V) == 64      # b64: chunk <= budget
+    nb = _auto_num_blocks(128 * 1024, V)
+    assert nb == 128
+    assert 128 * 1024 * (V // nb) <= 64 * 1024 * 1024
+    # an odd vocab that only divides by 8 never over-divides
+    assert _auto_num_blocks(10 ** 9, 8 * 9973) == 8
