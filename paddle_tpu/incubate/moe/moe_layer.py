@@ -24,7 +24,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...core.dispatch import run_op
-from ...core.tensor import Tensor
 from ...nn.layer.layers import Layer
 from .gate import GShardGate, NaiveGate, SwitchGate, compute_capacity
 
