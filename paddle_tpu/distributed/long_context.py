@@ -360,13 +360,16 @@ def ring_attention_local(q, k, v, axis_name, axis_size, causal=True,
 def ulysses_attention_local(q, k, v, axis_name, axis_size, causal=True,
                             scale=None):
     """Per-shard body: all_to_all seq-shard -> head-shard, local full-seq
-    attention, swap back. q/k/v [B, S/N, H, D]; needs H % N == 0 (kv heads
-    too: GQA is expanded before the swap when Hk < N)."""
+    attention, swap back. q/k/v [B, S/N, H, D]; needs H % N == 0. GQA kv
+    heads swap UN-expanded when Hk % N == 0 (Hk/H of the all_to_all
+    bytes — the local flash kernel is GQA-native); only Hk < N forces
+    the expansion."""
     B, sc, H, D = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(D)
-    k = _repeat_kv(k, H)
-    v = _repeat_kv(v, H)
+    if k.shape[2] % axis_size:
+        k = _repeat_kv(k, H)
+        v = _repeat_kv(v, H)
 
     def swap_in(x):   # [B, S/N, H, D] -> [B, S, H/N, D]
         return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,

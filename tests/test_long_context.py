@@ -92,6 +92,36 @@ def test_ulysses_gqa_expand():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_ulysses_gqa_unexpanded_swap():
+    # 4 kv heads over 4 devices: kv rides the all_to_all UN-expanded
+    # (Hk/H of the bytes); the GQA-native local kernel closes the gap
+    q, k, v = _mk(1, 64, 8, 16, hk=4, seed=12)
+    scale = 1.0 / math.sqrt(16)
+    ref = _attention_xla(q, k, v, None, True, scale, 0.0, None)
+    out = dist.ulysses_attention(q, k, v, mesh=_mesh(), causal=True)
+    np.testing.assert_allclose(np.asarray(out.numpy()), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # grads flow through the unexpanded path too
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed.long_context import (
+        shard_map, ulysses_attention_local)
+    spec = P(None, "sep", None, None)
+    fn = shard_map(
+        lambda a, b, c: ulysses_attention_local(a, b, c, "sep", 4, True,
+                                                scale),
+        _mesh(), in_specs=(spec, spec, spec), out_specs=spec)
+    g = jax.grad(lambda a, b, c: jnp.sum(fn(a, b, c)),
+                 argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda a, b, c: jnp.sum(
+            _attention_xla(a, b, c, None, True, scale, 0.0, None)),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4,
+                                   err_msg=f"d{name}")
+
+
 @pytest.mark.parametrize("shape", [
     # (B, S, Hq, Hk, D, N, causal)
     (2, 256, 4, 4, 32, 4, True),
