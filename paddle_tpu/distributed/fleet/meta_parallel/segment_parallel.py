@@ -85,6 +85,14 @@ def sep_attention(q, k, v, hcg, strategy=None, causal=True, scale=None,
         raise ValueError(
             f"unknown sep attention strategy {mode!r}: expected "
             "'ring' | 'ulysses' | 'gather'")
+    layout = "contiguous"
+    if strategy is not None:
+        layout = getattr(strategy, "sep_configs", {}).get(
+            "ring_layout", "contiguous")
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(
+            f"unknown sep ring_layout {layout!r}: expected "
+            "'contiguous' | 'zigzag'")
     n = hcg.get_sep_parallel_world_size()
     mesh = hcg.topology.mesh
     if scale is None:
@@ -102,7 +110,8 @@ def sep_attention(q, k, v, hcg, strategy=None, causal=True, scale=None,
         return split_sequence(out, hcg) if n > 1 else out
     if mode == "ring":
         return ring_attention(q, k, v, mesh=mesh, seq_axis="sep",
-                              causal=causal, scale=scale, impl=impl)
+                              causal=causal, scale=scale, impl=impl,
+                              layout=layout if causal else "contiguous")
     return ulysses_attention(q, k, v, mesh=mesh, seq_axis="sep",
                              causal=causal, scale=scale)
 

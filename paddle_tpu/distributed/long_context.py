@@ -17,6 +17,9 @@ strategies, designed for the ICI torus:
   sequence-sharded to head-sharded, runs the full-sequence local kernel
   (the Pallas flash kernel on TPU), and swaps back. Cheaper for moderate
   sequence lengths; requires num_heads % axis_size == 0.
+- ``ring_attention(..., layout="zigzag")``: the causal ring's load
+  balance fix — device d holds sub-chunks (c_d, c_{2N-1-d}), making every
+  step near-equal work instead of the last device gating the ring.
 
 Both are pure-jnp + lax collectives, so jax.vjp differentiates through
 them (the scan body is rematerialized instead of storing per-step score
@@ -307,6 +310,213 @@ def _ring_chunked_bwd(n_chunks, causal, scale, interpret, res, do):
 ring_chunked_single.defvjp(_ring_chunked_fwd, _ring_chunked_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Zigzag ring attention: causal load balancing. With contiguous chunks,
+# device 0's queries see only their own chunk (idle N-1 of N steps) while
+# device N-1 computes against every chunk — the causal ring's wall time is
+# the LAST device's. The zigzag layout gives device d sub-chunks
+# (c_d, c_{2N-1-d}) of the 2N-way split; at every step each device runs
+# exactly one always-visible pair (q_hi x k_lo) plus one pair that is
+# full/diag/skip complementarily across devices — near-perfect balance,
+# ~2x causal ring throughput at scale. (Same trick as the public zigzag /
+# striped ring-attention formulations; built here from the identical
+# flash_chunk primitives + lse merges the contiguous ring uses.)
+# ---------------------------------------------------------------------------
+
+def _zigzag_perm(S: int, N: int):
+    """new-position -> old-position index map: device d's shard is
+    (c_d, c_{2N-1-d}) of the 2N-way chunk split. (Reference layout for
+    tests; the runtime exchange is the structured ppermute pair in
+    ``_zz_shard_exchange`` — never a global gather.)"""
+    import numpy as _np
+    if S % (2 * N):
+        raise ValueError(
+            f"zigzag ring needs seq {S} divisible by 2*axis_size {2 * N}")
+    scc = S // (2 * N)
+    idx = []
+    for d in range(N):
+        idx.extend(range(d * scc, (d + 1) * scc))
+        j = 2 * N - 1 - d
+        idx.extend(range(j * scc, (j + 1) * scc))
+    return _np.asarray(idx, dtype=_np.int32)
+
+
+def _zz_shard_exchange(lo, hi, axis_name, axis_size, inverse=False):
+    """Contiguous <-> zigzag shard layout in TWO ppermutes (each sub-chunk
+    travels once over ICI; a global take across the sharded axis would
+    all-gather the sequence and forfeit the O(S/N) memory property).
+
+    Forward: device d holds contiguous (c_{2d}, c_{2d+1}) and ends with
+    zigzag (c_d, c_{2N-1-d}). Each stream's source->target map is a
+    device permutation; receivers select by their own parity (device t's
+    zig-lo c_t arrives on the even-chunk stream iff t is even)."""
+    n = axis_size
+    idx = jax.lax.axis_index(axis_name)
+    even = (idx % 2 == 0)
+    if not inverse:
+        # stream 0 carries c_{2d} (even chunks), stream 1 carries
+        # c_{2d+1} (odd chunks); chunk c_j lands on device j if j < n
+        # else 2n-1-j
+        perm0 = [(d, 2 * d if 2 * d < n else 2 * n - 1 - 2 * d)
+                 for d in range(n)]
+        perm1 = [(d, 2 * d + 1 if 2 * d + 1 < n else 2 * n - 2 - 2 * d)
+                 for d in range(n)]
+        r0 = jax.lax.ppermute(lo, axis_name, perm0)
+        r1 = jax.lax.ppermute(hi, axis_name, perm1)
+        return jnp.where(even, r0, r1), jnp.where(even, r1, r0)
+    # inverse: device d holds (c_d, c_{2n-1-d}); exactly one of the two is
+    # an even chunk (parity of d decides which) — send it on the even
+    # stream toward device j//2, likewise the odd chunk
+    send_even = jnp.where(even, lo, hi)
+    send_odd = jnp.where(even, hi, lo)
+    perm_e = [(d, (d if d % 2 == 0 else 2 * n - 1 - d) // 2)
+              for d in range(n)]
+    perm_o = [(d, (d if d % 2 == 1 else 2 * n - 1 - d) // 2)
+              for d in range(n)]
+    return (jax.lax.ppermute(send_even, axis_name, perm_e),
+            jax.lax.ppermute(send_odd, axis_name, perm_o))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _zigzag_ring_flash(q, k, v, axis_name, axis_size, scale, interpret):
+    """Causal-only, zigzag-sharded per-device body: q/k/v
+    [B, 2*scc, H(k), D] holding (c_d, c_{2N-1-d}). Call inside shard_map
+    over the zigzag-permuted sequence."""
+    out, _ = _zz_fwd(q, k, v, axis_name, axis_size, scale, interpret)
+    return out
+
+
+def _zz_split(x):
+    scc = x.shape[1] // 2
+    return x[:, :scc], x[:, scc:]
+
+
+def _zz_fwd(q, k, v, axis_name, axis_size, scale, interpret):
+    from ..ops.pallas.flash_attention import flash_chunk_fwd
+    B, sc2, H, D = q.shape
+    scc = sc2 // 2
+    idx = jax.lax.axis_index(axis_name)
+    perm = [((r + 1) % axis_size, r) for r in range(axis_size)]
+    q_lo, q_hi = _zz_split(q)
+
+    def acc0():
+        return (jnp.zeros((B, scc, H, D), jnp.float32),
+                jnp.full((B, H, scc), _NEG_INF, jnp.float32))
+
+    o_lo, l_lo = _vary(acc0(), axis_name)
+    o_hi, l_hi = _vary(acc0(), axis_name)
+
+    def pair(qc, causal):
+        def run(kc, vc):
+            return flash_chunk_fwd(qc, kc, vc, causal, scale,
+                                   interpret=interpret)
+        return run
+
+    def skip(kc, vc):
+        return (jnp.zeros((B, scc, H, D), q.dtype),
+                jnp.full((B, H, scc), _NEG_INF, jnp.float32))
+
+    def body(carry, t):
+        kc2, vc2, o_lo, l_lo, o_hi, l_hi = carry
+        j = (idx + t) % axis_size
+        br = jnp.where(j == idx, 1, jnp.where(j < idx, 0, 2))
+        k_lo, k_hi = _zz_split(kc2)
+        v_lo, v_hi = _zz_split(vc2)
+        # pair3 (q_hi x k_lo): c_{2N-1-idx} always AFTER c_j — every
+        # branch computes it, so it stays outside the switch
+        o3, l3 = flash_chunk_fwd(q_hi, k_lo, v_lo, False, scale,
+                                 interpret=interpret)
+        o_hi, l_hi = _merge_lse(o_hi, l_hi, o3, l3)
+        # pair1 (q_lo x k_lo): full when j < idx, diag at j == idx,
+        # fully-masked after
+        o1, l1 = jax.lax.switch(
+            br, (pair(q_lo, False), pair(q_lo, True), skip), k_lo, v_lo)
+        o_lo, l_lo = _merge_lse(o_lo, l_lo, o1, l1)
+        # pair4 (q_hi x k_hi): the complement — masked when j < idx,
+        # diag at j == idx, full after (c_{2N-1-j} < c_{2N-1-idx})
+        o4, l4 = jax.lax.switch(
+            br, (skip, pair(q_hi, True), pair(q_hi, False)), k_hi, v_hi)
+        o_hi, l_hi = _merge_lse(o_hi, l_hi, o4, l4)
+        kc2 = jax.lax.ppermute(kc2, axis_name, perm)
+        vc2 = jax.lax.ppermute(vc2, axis_name, perm)
+        return (kc2, vc2, o_lo, l_lo, o_hi, l_hi), None
+
+    (_, _, o_lo, l_lo, o_hi, l_hi), _ = jax.lax.scan(
+        body, (k, v, o_lo, l_lo, o_hi, l_hi), jnp.arange(axis_size))
+    out = jnp.concatenate([o_lo, o_hi], axis=1).astype(q.dtype)
+    lse = jnp.concatenate([l_lo, l_hi], axis=2)
+    return out, (q, k, v, out, lse)
+
+
+def _zz_bwd(axis_name, axis_size, scale, interpret, res, do):
+    from ..ops.pallas.flash_attention import flash_chunk_bwd
+    q, k, v, out, lse = res
+    B, sc2, H, D = q.shape
+    scc = sc2 // 2
+    idx = jax.lax.axis_index(axis_name)
+    perm = [((r + 1) % axis_size, r) for r in range(axis_size)]
+    delta = _bwd_delta(do, out)
+    q_lo, q_hi = _zz_split(q)
+    do_lo, do_hi = _zz_split(do)
+    l_lo, l_hi = lse[:, :, :scc], lse[:, :, scc:]
+    d_lo, d_hi = delta[:, :, :scc], delta[:, :, scc:]
+
+    kv_shape = (B, scc) + k.shape[2:]
+
+    def bwd_pair(qc, doc, lc, dc, causal):
+        def run(kc, vc):
+            return flash_chunk_bwd(qc, kc, vc, doc, lc, dc, causal,
+                                   scale, interpret=interpret)
+        return run
+
+    def skip(kc, vc):
+        return (jnp.zeros((B, scc, H, D), q.dtype),
+                jnp.zeros(kv_shape, q.dtype),
+                jnp.zeros(kv_shape, q.dtype))
+
+    dq0 = jnp.zeros((B, sc2, H, D), jnp.float32)
+    dkv0 = jnp.zeros((B, sc2) + k.shape[2:], jnp.float32)
+    dq0, dk0, dv0 = _vary((dq0, dkv0, dkv0), axis_name)
+
+    def body(carry, t):
+        kc2, vc2, dkc2, dvc2, dq = carry
+        j = (idx + t) % axis_size
+        br = jnp.where(j == idx, 1, jnp.where(j < idx, 0, 2))
+        k_lo, k_hi = _zz_split(kc2)
+        v_lo, v_hi = _zz_split(vc2)
+        # pair3: q_hi x k_lo, always visible
+        dq3, dk3, dv3 = flash_chunk_bwd(q_hi, k_lo, v_lo, do_hi, l_hi,
+                                        d_hi, False, scale,
+                                        interpret=interpret)
+        # pair1: q_lo x k_lo (full / diag / masked)
+        dq1, dk1, dv1 = jax.lax.switch(
+            br, (bwd_pair(q_lo, do_lo, l_lo, d_lo, False),
+                 bwd_pair(q_lo, do_lo, l_lo, d_lo, True), skip),
+            k_lo, v_lo)
+        # pair4: q_hi x k_hi (masked / diag / full)
+        dq4, dk4, dv4 = jax.lax.switch(
+            br, (skip, bwd_pair(q_hi, do_hi, l_hi, d_hi, True),
+                 bwd_pair(q_hi, do_hi, l_hi, d_hi, False)),
+            k_hi, v_hi)
+        f32 = jnp.float32
+        dq = dq.at[:, :scc].add(dq1.astype(f32))
+        dq = dq.at[:, scc:].add(dq3.astype(f32) + dq4.astype(f32))
+        dkc2 = dkc2.at[:, :scc].add(dk1.astype(f32) + dk3.astype(f32))
+        dkc2 = dkc2.at[:, scc:].add(dk4.astype(f32))
+        dvc2 = dvc2.at[:, :scc].add(dv1.astype(f32) + dv3.astype(f32))
+        dvc2 = dvc2.at[:, scc:].add(dv4.astype(f32))
+        kc2, vc2, dkc2, dvc2 = (jax.lax.ppermute(x, axis_name, perm)
+                                for x in (kc2, vc2, dkc2, dvc2))
+        return (kc2, vc2, dkc2, dvc2, dq), None
+
+    (_, _, dkc2, dvc2, dq), _ = jax.lax.scan(
+        body, (k, v, dk0, dv0, dq0), jnp.arange(axis_size))
+    return dq.astype(q.dtype), dkc2.astype(k.dtype), dvc2.astype(v.dtype)
+
+
+_zigzag_ring_flash.defvjp(_zz_fwd, _zz_bwd)
+
+
 def ring_attention_local(q, k, v, axis_name, axis_size, causal=True,
                          scale=None, impl=None):
     """Per-shard body: call inside shard_map with q/k/v sequence-sharded
@@ -399,15 +609,49 @@ def _as_mesh(mesh):
 
 
 def ring_attention(q, k, v, mesh=None, seq_axis="sep", causal=True,
-                   scale=None, impl=None):
+                   scale=None, impl=None, layout="contiguous"):
     """User API: q/k/v Tensors/arrays [B, S, H, D]; runs ring attention with
     the sequence dim sharded over ``seq_axis`` of ``mesh``. Differentiable
     through the tape (run_op -> jax.vjp through shard_map). ``impl``:
     "pallas" (flash block kernel per ring step), "xla" (pure-jnp), or None
-    to pick by backend."""
+    to pick by backend. ``layout="zigzag"`` (causal only) load-balances
+    the ring: device d holds sub-chunks (c_d, c_{2N-1-d}) so every step
+    does near-equal work instead of the last device gating the ring."""
     jmesh = _as_mesh(mesh)
     n = int(jmesh.shape[seq_axis])
     spec = P(None, seq_axis, None, None)
+    if layout == "zigzag":
+        if not causal:
+            raise ValueError("zigzag layout only balances the CAUSAL "
+                             "ring; use layout='contiguous'")
+        if impl == "xla":
+            raise ValueError("zigzag ring is built from the Pallas chunk "
+                             "kernels; impl='xla' is only available with "
+                             "layout='contiguous'")
+        if scale is None:
+            scale = 1.0 / math.sqrt(int(q.shape[-1]))
+        interpret = jax.default_backend() != "tpu"
+        _zigzag_perm(int(q.shape[1]), n)  # validate divisibility early
+
+        def shard_body(a, b, c):
+            # contiguous -> zigzag in-shard (two ppermutes), ring, back
+            def to_zz(x):
+                l, h = _zz_split(x)
+                l, h = _zz_shard_exchange(l, h, seq_axis, n)
+                return jnp.concatenate([l, h], axis=1)
+
+            o = _zigzag_ring_flash(to_zz(a), to_zz(b), to_zz(c),
+                                   seq_axis, n, float(scale), interpret)
+            ol, oh = _zz_split(o)
+            rl, rh = _zz_shard_exchange(ol, oh, seq_axis, n, inverse=True)
+            return jnp.concatenate([rl, rh], axis=1)
+
+        fn = shard_map(shard_body, jmesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+        return run_op("ring_attention_zigzag", fn, (q, k, v))
+    if layout != "contiguous":
+        raise ValueError(f"unknown ring layout {layout!r}: expected "
+                         "'contiguous' | 'zigzag'")
     body = functools.partial(ring_attention_local, axis_name=seq_axis,
                              axis_size=n, causal=causal, scale=scale,
                              impl=impl)

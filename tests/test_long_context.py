@@ -166,6 +166,53 @@ def test_ring_pallas_impl_parity(shape):
                                    err_msg=f"d{name} mismatch")
 
 
+@pytest.mark.parametrize("shape", [
+    (2, 256, 4, 4, 32, 4),
+    (1, 384, 4, 2, 32, 8),   # GQA + sub-chunks of 24 rows
+])
+def test_zigzag_ring_parity(shape):
+    """Causal load-balanced ring (device d holds (c_d, c_{2N-1-d})):
+    forward + all grads must match the dense oracle elementwise through
+    the tape API, including the zigzag permutation round-trip."""
+    B, S, Hq, Hk, D, N = shape
+    rng = np.random.RandomState(17)
+    q = jnp.asarray(rng.standard_normal((B, S, Hq, D)), jnp.float32) * 0.3
+    k = jnp.asarray(rng.standard_normal((B, S, Hk, D)), jnp.float32) * 0.3
+    v = jnp.asarray(rng.standard_normal((B, S, Hk, D)), jnp.float32) * 0.3
+    scale = 1.0 / math.sqrt(D)
+    qt, kt, vt = (paddle.to_tensor(np.asarray(x), stop_gradient=False)
+                  for x in (q, k, v))
+    out = dist.ring_attention(qt, kt, vt, mesh=_mesh(N), causal=True,
+                              layout="zigzag")
+    ref = _attention_xla(q, k, v, None, True, scale, 0.0, None)
+    np.testing.assert_allclose(np.asarray(out.numpy()), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    out.sum().backward()
+    g_ref = jax.grad(lambda a, b, c: jnp.sum(_attention_xla(
+        a, b, c, None, True, scale, 0.0, None)),
+        argnums=(0, 1, 2))(q, k, v)
+    for t, r, name in zip((qt, kt, vt), g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(t.grad.numpy()),
+                                   np.asarray(r), rtol=5e-4, atol=5e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_zigzag_rejects_noncausal_and_indivisible():
+    q, k, v = _mk(1, 64, 4, 16, seed=3)
+    with pytest.raises(ValueError, match="CAUSAL"):
+        dist.ring_attention(q, k, v, mesh=_mesh(), causal=False,
+                            layout="zigzag")
+    with pytest.raises(ValueError, match="unknown ring layout"):
+        dist.ring_attention(q, k, v, mesh=_mesh(), layout="nope")
+    from paddle_tpu.distributed.long_context import _zigzag_perm
+    with pytest.raises(ValueError, match="divisible"):
+        _zigzag_perm(100, 8)
+    # the permutation is a bijection with the documented shard layout
+    p = _zigzag_perm(32, 4)
+    assert sorted(p.tolist()) == list(range(32))
+    assert p[:8].tolist() == [0, 1, 2, 3, 28, 29, 30, 31]  # (c_0, c_7)
+
+
 def test_ring_chunked_single_parity():
     """Single-chip chunked-ring compute (the bench surface) matches the
     dense oracle fwd + grads, causal and full."""
@@ -223,6 +270,17 @@ def test_sep_attention_strategy_selection():
     strategy.sep_configs = {"attention": "nope"}
     with pytest.raises(ValueError, match="unknown sep attention"):
         sep_attention(q, k, v, hcg, strategy=strategy)
+    # ring_layout is validated up front too (typos must not silently run
+    # the unbalanced contiguous ring)
+    strategy.sep_configs = {"attention": "ring", "ring_layout": "zig-zag"}
+    with pytest.raises(ValueError, match="unknown sep ring_layout"):
+        sep_attention(q, k, v, hcg, strategy=strategy)
+    # the zigzag layout routes through the balanced ring and still
+    # matches the oracle
+    strategy.sep_configs = {"attention": "ring", "ring_layout": "zigzag"}
+    out = sep_attention(q, k, v, hcg, strategy=strategy, causal=True)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                               rtol=2e-5, atol=2e-5)
 
 
 def test_ring_through_tape():
