@@ -4,7 +4,8 @@ from .gpt import (GPTConfig, GPTModel, GPTForCausalLM, create_train_step,
 from .llama import (LlamaConfig, LlamaForCausalLM, llama_7b, llama_13b,  # noqa: F401
                     llama_tiny, llama_param_spec, llama_fsdp_spec,
                     llama_pipeline_model)
-from .trainer import create_sharded_train_step  # noqa: F401
+from .trainer import (create_multistep_train_step,  # noqa: F401
+                      create_sharded_train_step)
 from .bert import (BertConfig, BertModel, BertForPretraining,  # noqa: F401
                    BertForSequenceClassification, bert_base, bert_large,
                    bert_tiny, bert_pipeline_model, bert_param_spec)

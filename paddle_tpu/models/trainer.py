@@ -16,8 +16,8 @@ from ..core.autograd import tape_paused
 from ..core.tensor import Tensor
 from ..nn.layer.layers import _swapped_state, functional_state
 
-__all__ = ["create_train_step", "create_sharded_train_step",
-           "place_by_spec", "write_back"]
+__all__ = ["create_train_step", "create_multistep_train_step",
+           "create_sharded_train_step", "place_by_spec", "write_back"]
 
 
 def place_by_spec(arr, spec, mesh):
@@ -44,6 +44,41 @@ def _wd_mask(names):
                 and "ln_" not in n) for n in names}
 
 
+def _functional_pieces(model, optimizer, loss_fn):
+    """Shared setup for the step factories: the functional loss call over
+    swapped-in params, the initial trainable/optimizer trees, and the
+    weight-decay mask."""
+    trainable0 = functional_state(model, trainable_only=True)
+    all0 = functional_state(model)
+    frozen = {k: v for k, v in all0.items() if k not in trainable0}
+    opt_state0 = optimizer.init_state_tree(trainable0)
+    wd_mask = _wd_mask(trainable0)
+
+    def loss_call(params, ids, labels, key):
+        with _random.key_context(key):
+            merged = {**params, **frozen}
+            with _swapped_state(model, merged):
+                with tape_paused():
+                    if loss_fn is not None:
+                        out = loss_fn(model, Tensor(ids), Tensor(labels))
+                    else:
+                        out = model.loss(Tensor(ids), Tensor(labels))
+            return out._data
+
+    return loss_call, trainable0, opt_state0, wd_mask
+
+
+def _protective_copies(donate, trainable0, opt_state0):
+    """Copies handed back under plain donation: trainable0 aliases the
+    model's live parameter buffers, and donating those would delete the
+    model's own weights on the first step (use-after-free on any later
+    model(...) call). donate="consume" skips this deliberately."""
+    if donate and donate != "consume":
+        trainable0 = {k: jnp.copy(v) for k, v in trainable0.items()}
+        opt_state0 = jax.tree_util.tree_map(jnp.copy, opt_state0)
+    return trainable0, opt_state0
+
+
 def create_train_step(model, optimizer, loss_fn=None, donate=False):
     """(params, opt_state, key, ids, labels, lr) -> (loss, params, opt_state).
     ``model.loss(ids, labels)`` is used unless ``loss_fn(model, ids, labels)``
@@ -61,22 +96,8 @@ def create_train_step(model, optimizer, loss_fn=None, donate=False):
     benchmark/training-loop use only; it removes the transient 1x-params
     + 1x-moments copy that pushes billion-param models past HBM at
     setup time."""
-    trainable0 = functional_state(model, trainable_only=True)
-    all0 = functional_state(model)
-    frozen = {k: v for k, v in all0.items() if k not in trainable0}
-    opt_state0 = optimizer.init_state_tree(trainable0)
-    wd_mask = _wd_mask(trainable0)
-
-    def _loss_call(params, ids, labels, key):
-        with _random.key_context(key):
-            merged = {**params, **frozen}
-            with _swapped_state(model, merged):
-                with tape_paused():
-                    if loss_fn is not None:
-                        out = loss_fn(model, Tensor(ids), Tensor(labels))
-                    else:
-                        out = model.loss(Tensor(ids), Tensor(labels))
-            return out._data
+    _loss_call, trainable0, opt_state0, wd_mask = _functional_pieces(
+        model, optimizer, loss_fn)
 
     def train_step(params, opt_state, key, ids, labels, lr):
         loss, grads = jax.value_and_grad(
@@ -87,14 +108,48 @@ def create_train_step(model, optimizer, loss_fn=None, donate=False):
 
     train_step = jax.jit(train_step,
                          donate_argnums=(0, 1) if donate else ())
-
-    if donate and donate != "consume":
-        # hand back copies: trainable0 aliases the model's live parameter
-        # buffers, and donating those would delete the model's own weights
-        # on the first step (use-after-free on any later model(...) call)
-        trainable0 = {k: jnp.copy(v) for k, v in trainable0.items()}
-        opt_state0 = jax.tree_util.tree_map(jnp.copy, opt_state0)
+    trainable0, opt_state0 = _protective_copies(donate, trainable0,
+                                                opt_state0)
     return train_step, trainable0, opt_state0
+
+
+def create_multistep_train_step(model, optimizer, loss_fn=None,
+                                donate=False, steps=8):
+    """``steps`` optimizer steps inside ONE jitted program via
+    ``lax.scan`` — the production-JAX training-loop shape: the host
+    dispatches once per K steps, so per-execute dispatch cost (remote
+    tunnels pay 30-50 ms; even local hosts pay ~0.1 ms × python loop
+    overhead) amortizes to dispatch/K and the device runs back-to-back.
+
+    Returns ``(step_K, params0, opt_state0)`` where
+    ``step_K(params, opt_state, key, ids, labels, lr)`` takes stacked
+    batches ``ids, labels: [K, B, S]`` and returns
+    ``(losses[K], params, opt_state)``. Per-step RNG is
+    ``fold_in(key, i)``, matching ``create_train_step`` semantics for
+    the same fold sequence. ``donate`` as in ``create_train_step``."""
+    _loss_call, trainable0, opt_state0, wd_mask = _functional_pieces(
+        model, optimizer, loss_fn)
+
+    def step_k(params, opt_state, key, ids, labels, lr):
+        def body(carry, xs):
+            p, s = carry
+            i, ids_i, labels_i = xs
+            loss, grads = jax.value_and_grad(
+                lambda q: _loss_call(q, ids_i, labels_i,
+                                     jax.random.fold_in(key, i)))(p)
+            p, s = optimizer.apply_gradients(p, grads, s, lr,
+                                             wd_mask=wd_mask)
+            return (p, s), loss
+        n = ids.shape[0]
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state),
+            (jnp.arange(n), ids, labels))
+        return losses, params, opt_state
+
+    step_k = jax.jit(step_k, donate_argnums=(0, 1) if donate else ())
+    trainable0, opt_state0 = _protective_copies(donate, trainable0,
+                                                opt_state0)
+    return step_k, trainable0, opt_state0
 
 
 def create_sharded_train_step(model, optimizer, mesh, param_spec_fn,

@@ -19,7 +19,11 @@ def _run(name, extra_env):
     env = dict(os.environ)
     env.update(extra_env)
     env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    # ROOT only: inheriting the ambient PYTHONPATH would pull in the axon
+    # sitecustomize, which force-registers the TPU-tunnel backend even
+    # under JAX_PLATFORMS=cpu — and blocks forever when the tunnel is in
+    # its accepting-but-wedged state
+    env["PYTHONPATH"] = ROOT
     r = subprocess.run(
         [sys.executable, os.path.join(ROOT, "examples", name)],
         capture_output=True, text=True, timeout=420, env=env, cwd=ROOT)

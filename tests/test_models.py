@@ -206,3 +206,61 @@ def test_recompute_engages_jax_checkpoint_under_jit():
         np.testing.assert_allclose(np.asarray(params_rc[k]),
                                    np.asarray(params_plain[k]),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_multistep_scan_matches_single_step_loop():
+    """create_multistep_train_step(K) == K create_train_step calls on the
+    same fold sequence — the scan-of-K execute bench.py scores on TPU must
+    be the same math as the single-step loop, not a different trainer."""
+    from paddle_tpu.models import create_multistep_train_step
+
+    K = 4
+    data = RNG.randint(0, 256, (2, 9))
+    key = jax.random.key(7)
+
+    paddle.seed(3)
+    cfg = gpt2_tiny()
+    m1 = GPTForCausalLM(cfg)
+    m1.eval()
+    opt1 = paddle.optimizer.AdamW(1e-2, parameters=m1.parameters())
+    step, p, s = create_train_step(m1, opt1)
+    losses = []
+    for i in range(K):
+        loss, p, s = step(p, s, jax.random.fold_in(key, i),
+                          data[:, :-1], data[:, 1:], 5e-3)
+        losses.append(float(loss))
+
+    paddle.seed(3)
+    m2 = GPTForCausalLM(cfg)
+    m2.eval()
+    opt2 = paddle.optimizer.AdamW(1e-2, parameters=m2.parameters())
+    step_k, pk, sk = create_multistep_train_step(m2, opt2, steps=K)
+    xs = jnp.tile(jnp.asarray(data[:, :-1])[None], (K, 1, 1))
+    ys = jnp.tile(jnp.asarray(data[:, 1:])[None], (K, 1, 1))
+    losses_k, pk, sk = step_k(pk, sk, key, xs, ys, 5e-3)
+
+    np.testing.assert_allclose(np.asarray(losses_k), np.asarray(losses),
+                               rtol=1e-5, atol=1e-6)
+    for name in p:
+        np.testing.assert_allclose(np.asarray(pk[name]),
+                                   np.asarray(p[name]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_multistep_scan_donate_consume():
+    from paddle_tpu.models import create_multistep_train_step
+
+    paddle.seed(4)
+    cfg = gpt2_tiny()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+    step_k, p, s = create_multistep_train_step(model, opt,
+                                               donate="consume", steps=3)
+    data = RNG.randint(0, 256, (2, 9))
+    xs = jnp.tile(jnp.asarray(data[:, :-1])[None], (3, 1, 1))
+    ys = jnp.tile(jnp.asarray(data[:, 1:])[None], (3, 1, 1))
+    losses, p, s = step_k(p, s, jax.random.key(0), xs, ys, 5e-3)
+    losses2, p, s = step_k(p, s, jax.random.key(1), xs, ys, 5e-3)
+    assert np.all(np.isfinite(np.asarray(losses2)))
+    assert float(losses2[-1]) < float(losses[0])

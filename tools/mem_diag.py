@@ -1,0 +1,99 @@
+"""On-chip HBM diagnosis for the bench sweep OOMs (r5).
+
+Every sweep candidate above b8/plain OOM'd on the live capture — including
+the blockwise-CE + remat configs designed to fit. Two hypotheses:
+  (a) the tunnel device exposes much less HBM than a v5e's 16 GB;
+  (b) the step's compiled peak is far above the analytic estimate.
+
+This probe answers both without burning bench time:
+  1. device.memory_stats() -> bytes_limit (the real ceiling);
+  2. AOT lower+compile each candidate's train step and read
+     compiled.memory_analysis() -> argument/output/temp/peak bytes.
+No training iterations run; compile only.
+
+Run only when no bench child is on the chip (tools/tpu_watch.py idle gap).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def fmt_gb(n):
+    return round(n / 2**30, 3)
+
+
+def main():
+    dev = jax.devices()[0]
+    out = {"device": str(dev), "platform": dev.platform}
+    try:
+        stats = dev.memory_stats() or {}
+        out["memory_stats"] = {k: v for k, v in stats.items()
+                               if "bytes" in k or "limit" in k}
+        if "bytes_limit" in stats:
+            out["hbm_limit_gb"] = fmt_gb(stats["bytes_limit"])
+    except Exception as e:  # noqa: BLE001
+        out["memory_stats_error"] = repr(e)
+    print(json.dumps(out), flush=True)
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   create_train_step, write_back)
+
+    cfg = GPTConfig(vocab_size=50304, max_position_embeddings=1024,
+                    hidden_size=768, num_layers=12, num_heads=12,
+                    intermediate_size=3072, dropout=0.0)
+    seq = 1024
+
+    cand = [(8, "plain"), (16, "blockwise"), (32, "blockwise+remat")]
+    if len(sys.argv) > 1:
+        cand = []
+        for tok in sys.argv[1:]:
+            b, mode = tok.split("/")
+            cand.append((int(b.lstrip("b")), mode))
+
+    for b, mode in cand:
+        row = {"cand": f"b{b}/{mode}"}
+        try:
+            paddle.seed(0)
+            remat = "remat" in mode
+            policy = "dots_saveable" if "remat_dots" in mode else "full"
+            model = GPTForCausalLM(dataclasses.replace(
+                cfg, lm_ce="blockwise" if "blockwise" in mode else "plain",
+                use_recompute=remat, recompute_policy=policy))
+            model.train() if remat else model.eval()
+            opt = paddle.optimizer.AdamW(learning_rate=3e-4,
+                                         weight_decay=0.01,
+                                         parameters=model.parameters())
+            step, params0, opt_state0 = create_train_step(model, opt,
+                                                          donate=True)
+            params0 = {k: (v.astype(jnp.bfloat16)
+                           if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                       for k, v in params0.items()}
+            write_back(model, params0)
+            key = jax.random.key(0)
+            ids = jnp.zeros((b, seq + 1), jnp.int32)
+            x, y = ids[:, :-1], ids[:, 1:]
+            lowered = step.lower(params0, opt_state0, key, x, y, 3e-4)
+            compiled = lowered.compile()
+            ma = compiled.memory_analysis()
+            for field in ("argument_size_in_bytes", "output_size_in_bytes",
+                          "temp_size_in_bytes", "generated_code_size_in_bytes",
+                          "alias_size_in_bytes", "peak_memory_in_bytes"):
+                v = getattr(ma, field, None)
+                if v is not None:
+                    row[field.replace("_in_bytes", "_gb")] = fmt_gb(v)
+        except Exception as e:  # noqa: BLE001
+            row["error"] = f"{type(e).__name__}: {e}"[:300]
+        print(json.dumps(row), flush=True)
+        # drop this candidate's buffers before the next build
+        del model, opt, step, params0, opt_state0
+
+
+if __name__ == "__main__":
+    main()
