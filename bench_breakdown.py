@@ -199,6 +199,38 @@ def main():
         except Exception as e:  # noqa: BLE001 — diagnostic row, not fatal
             res["full_step_b32_blockwise_error"] = repr(e)[:160]
 
+    # the achievable-matmul ceiling of THIS device grant: the axon tunnel
+    # hands out a v5e subslice (~7.5 GB of 16 GB HBM measured r5), so the
+    # 197 TF/s full-chip spec the MFU denominator uses may overstate what
+    # any program can reach here. chain-of-32 8192^3 bf16 matmuls inside
+    # one execute, best-of-3: the closest measurable proxy for peak.
+    try:
+        if not on_tpu:
+            raise RuntimeError("matmul ceiling probe is TPU-only "
+                               "(1.4e14 FLOPs: minutes of CPU wall time)")
+        n, links = 8192, 32
+        a = jnp.ones((n, n), jnp.bfloat16)
+        bmat = jnp.ones((n, n), jnp.bfloat16)
+
+        @jax.jit
+        def mm_chain(a, b):
+            c = a
+            for _ in range(links):
+                c = c @ b
+            return c.astype(jnp.float32).sum()
+
+        float(jax.device_get(mm_chain(a, bmat)))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(jax.device_get(mm_chain(a, bmat)))
+            best = min(best, time.perf_counter() - t0)
+        res["measured_matmul_tflops"] = round(
+            links * 2 * n ** 3 / best / 1e12, 1)
+        del a, bmat
+    except Exception as e:  # noqa: BLE001 — diagnostic row, not fatal
+        res["measured_matmul_tflops_error"] = repr(e)[:160]
+
     res = {k: (round(v, 3) if isinstance(v, (int, float)) else v)
            for k, v in res.items()}
     res["derived"] = {

@@ -43,6 +43,41 @@ def _mfu_llama(cfg, seq, tokens_per_sec, peak):
     return tokens_per_sec * flops_per_tok / peak
 
 
+def _measure_steps(step, params, opt_state, key, xs, ys, lr, iters,
+                   windows, scan_k):
+    """Warmup + best-of-windows timing for a train step, in both shapes:
+    ``scan_k=True`` — ``step`` is a scan-of-iters program, one execute
+    per window (xs/ys carry the stacked [iters, ...] batches);
+    ``scan_k=False`` — a single-step program looped ``iters`` times.
+    Every window is closed by a device_get that data-depends on the
+    window's full chain. Returns (best_window_s, loss0, loss_end)."""
+    import jax
+
+    def once(k):
+        nonlocal params, opt_state
+        if scan_k:
+            losses, params, opt_state = step(params, opt_state, k, xs, ys,
+                                             lr)
+            return float(jax.device_get(losses)[0]), \
+                float(jax.device_get(losses)[-1])
+        first = loss = None
+        for i in range(iters):
+            loss, params, opt_state = step(
+                params, opt_state, jax.random.fold_in(k, i), xs, ys, lr)
+            if first is None:
+                first = loss
+        return (float(jax.device_get(first)),
+                float(jax.device_get(loss)))
+
+    loss0, _ = once(key)
+    best, loss_end = float("inf"), loss0
+    for w in range(windows):
+        t0 = time.perf_counter()
+        _, loss_end = once(jax.random.fold_in(key, 1000 + w))
+        best = min(best, time.perf_counter() - t0)
+    return best, loss0, loss_end
+
+
 def bench_llama(dev, on_tpu, zero3=False):
     import dataclasses
     import gc
@@ -92,6 +127,7 @@ def bench_llama(dev, on_tpu, zero3=False):
         model = LlamaForCausalLM(ccfg)
         model.train() if remat else model.eval()
         opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters())
+        scan_k = on_tpu and not zero3
         if zero3:
             from jax.sharding import Mesh
             mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
@@ -103,6 +139,14 @@ def bench_llama(dev, on_tpu, zero3=False):
             step, params, opt_state, shard_batch = \
                 create_sharded_train_step(model, opt, mesh, spec,
                                           donate="consume")
+        elif scan_k:
+            # scan-of-iters: one execute per timed window, so the
+            # tunnel's per-execute overhead amortizes (same trainer math
+            # as the loop — tests/test_models.py pins scan == loop)
+            from paddle_tpu.models import create_multistep_train_step
+            step, params, opt_state = create_multistep_train_step(
+                model, opt, donate="consume", steps=iters)
+            shard_batch = lambda a: jnp.asarray(a)  # noqa: E731
         else:
             step, params, opt_state = create_train_step(
                 model, opt, donate="consume")
@@ -118,17 +162,12 @@ def bench_llama(dev, on_tpu, zero3=False):
         y = shard_batch(ids[:, 1:].astype(np.int32))
         key = jax.random.key(0)
 
-        loss, params, opt_state = step(params, opt_state, key, x, y, 3e-4)
-        loss0 = float(jax.device_get(loss))
-        best = float("inf")
-        for _ in range(windows):
-            t0 = time.perf_counter()
-            for i in range(iters):
-                loss, params, opt_state = step(params, opt_state,
-                                               jax.random.fold_in(key, i),
-                                               x, y, 3e-4)
-            loss_end = float(jax.device_get(loss))  # closes the window
-            best = min(best, time.perf_counter() - t0)
+        if scan_k:
+            x = jnp.tile(x[None], (iters, 1, 1))
+            y = jnp.tile(y[None], (iters, 1, 1))
+        best, loss0, loss_end = _measure_steps(
+            step, params, opt_state, key, x, y, 3e-4, iters, windows,
+            scan_k)
         tps = batch * seq * iters / best
         n_params = sum(int(np.prod(v.shape)) for v in params.values())
         return {"tokens_per_sec": round(tps, 1),
@@ -136,6 +175,7 @@ def bench_llama(dev, on_tpu, zero3=False):
                                         peak_flops_per_chip(dev)), 4),
                 "params": n_params, "batch": batch, "seq": seq,
                 "remat": remat,
+                "timing": f"scan{iters}" if scan_k else f"loop{iters}",
                 "loss_start": round(loss0, 4),
                 "loss_end": round(loss_end, 4),
                 "loss_finite_and_moving": bool(
@@ -284,25 +324,28 @@ def bench_resnet50(dev, on_tpu):
     def loss_fn(m, images, labels):
         return F.cross_entropy(m(images), labels)
 
-    step, params, opt_state = create_train_step(model, opt, loss_fn=loss_fn)
     rng = np.random.RandomState(0)
     images = jnp.asarray(rng.randn(batch, 3, hw, hw), jnp.float32)
     labels = jnp.asarray(rng.randint(0, 1000, (batch,)), jnp.int32)
     key = jax.random.key(0)
 
-    loss, params, opt_state = step(params, opt_state, key, images, labels,
-                                   lr)
-    loss0 = float(jax.device_get(loss))
-    best = float("inf")
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        for i in range(iters):
-            loss, params, opt_state = step(params, opt_state, key, images,
-                                           labels, lr)
-        loss_end = float(jax.device_get(loss))
-        best = min(best, time.perf_counter() - t0)
+    if on_tpu:
+        # scan-of-iters execute (same trainer math as the loop; the tiled
+        # batch keeps the loss trajectory comparable)
+        from paddle_tpu.models import create_multistep_train_step
+        step, params, opt_state = create_multistep_train_step(
+            model, opt, loss_fn=loss_fn, steps=iters)
+        images = jnp.tile(images[None], (iters, 1, 1, 1, 1))
+        labels = jnp.tile(labels[None], (iters, 1))
+    else:
+        step, params, opt_state = create_train_step(model, opt,
+                                                    loss_fn=loss_fn)
+    best, loss0, loss_end = _measure_steps(
+        step, params, opt_state, key, images, labels, lr, iters, windows,
+        scan_k=on_tpu)
     return {"images_per_sec": round(batch * iters / best, 1),
             "batch": batch, "image_size": hw,
+            "timing": f"scan{iters}" if on_tpu else f"loop{iters}",
             "loss_start": round(loss0, 4), "loss_end": round(loss_end, 4),
             "loss_dropping": bool(loss_end < loss0)}
 

@@ -264,3 +264,46 @@ def test_multistep_scan_donate_consume():
     losses2, p, s = step_k(p, s, jax.random.key(1), xs, ys, 5e-3)
     assert np.all(np.isfinite(np.asarray(losses2)))
     assert float(losses2[-1]) < float(losses[0])
+
+
+def test_multistep_scan_with_loss_fn_momentum_batchnorm():
+    """The config-bench ResNet path: loss_fn + Momentum + BatchNorm model
+    through create_multistep_train_step must match the single-step loop."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.models import create_multistep_train_step
+
+    def build():
+        paddle.seed(9)
+        m = nn.Sequential(
+            nn.Conv2D(3, 4, 3, padding=1), nn.BatchNorm2D(4), nn.ReLU(),
+            nn.Flatten(), nn.Linear(4 * 8 * 8, 5))
+        m.train()
+        opt = paddle.optimizer.Momentum(0.05, momentum=0.9,
+                                        parameters=m.parameters())
+        return m, opt
+
+    def loss_fn(m, images, labels):
+        return F.cross_entropy(m(images), labels)
+
+    K = 3
+    images = RNG.randn(2, 3, 8, 8).astype(np.float32)
+    labels = RNG.randint(0, 5, (2,))
+    key = jax.random.key(1)
+
+    m1, opt1 = build()
+    step, p, s = create_train_step(m1, opt1, loss_fn=loss_fn)
+    losses = []
+    for i in range(K):
+        loss, p, s = step(p, s, jax.random.fold_in(key, i),
+                          images, labels, 0.05)
+        losses.append(float(loss))
+
+    m2, opt2 = build()
+    step_k, pk, sk = create_multistep_train_step(m2, opt2,
+                                                 loss_fn=loss_fn, steps=K)
+    imk = jnp.tile(jnp.asarray(images)[None], (K, 1, 1, 1, 1))
+    lbk = jnp.tile(jnp.asarray(labels)[None], (K, 1))
+    losses_k, pk, sk = step_k(pk, sk, key, imk, lbk, 0.05)
+    np.testing.assert_allclose(np.asarray(losses_k), np.asarray(losses),
+                               rtol=1e-5, atol=1e-6)
