@@ -242,6 +242,7 @@ def main():
     }
     print(json.dumps({"metric": "gpt2s_step_breakdown",
                       "platform": dev.platform, "device": str(dev),
+                      "captured_at_unix": time.time(),
                       "batch": batch, "seq": seq, **res}))
 
 

@@ -256,16 +256,18 @@ def bench_bert_1f1b(on_tpu):
         run_batch(eng_, opt_)         # warmup: compiles every chunk program
         cache0 = {k: v._cache_size() for k, v in eng_._programs.items()}
         best, last = float("inf"), None
+        n0 = eng_._program_executes
         for _ in range(windows):
             t0 = time.perf_counter()
             last = run_batch(eng_, opt_)
             best = min(best, time.perf_counter() - t0)
         retraced = sum(v._cache_size() - cache0.get(k, 0)
                        for k, v in eng_._programs.items())
-        return best, last, retraced
+        n_per_batch = (eng_._program_executes - n0) / windows
+        return best, last, retraced, n_per_batch
 
-    t_unpip, l_unpip, re_unpip = best_of(engine1, opt1)
-    t_1f1b, loss, re_1f1b = best_of(engine, opt)
+    t_unpip, l_unpip, re_unpip, n_unpip = best_of(engine1, opt1)
+    t_1f1b, loss, re_1f1b, n_1f1b = best_of(engine, opt)
 
     theo_bubble = (pp - 1) / (acc + pp - 1)
     overhead = t_1f1b / max(t_unpip, 1e-9)
@@ -280,12 +282,54 @@ def bench_bert_1f1b(on_tpu):
              # per-dispatch floor inflates this — read it next to
              # bench_kernels' dispatch_floor_ms.
              "host_schedule_overhead": round(overhead, 3),
+             "program_executes_per_batch": {"unpipelined": round(n_unpip),
+                                            "1f1b": round(n_1f1b)},
              "theoretical_bubble_fraction": round(theo_bubble, 4),
              "retraced_programs": {"unpipelined": re_unpip,
                                    "1f1b": re_1f1b},
              "peak_stash_bound_ok": bool(all(
                  engine._peak_stash[s] <= min(pp - s, acc)
                  for s in range(pp)))}
+    # per-dispatch floor correction: the 1F1B side dispatches ~7x more
+    # (smaller) programs than the single-stage side, and on the remote
+    # tunnel each dispatch pays a measured floor (bench_kernels
+    # dispatch_floor_ms). Subtracting floor x executes from both sides
+    # isolates what the schedule itself costs — reported ALONGSIDE the
+    # raw ratio, never replacing it. TPU-only (a CPU run pays no tunnel
+    # floor), same-device + fresh capture only (floors vary 7-50 ms
+    # across tunnel sessions), and the corrected ratio obeys the same
+    # impossible-ratio refusal as the raw one: a schedule cannot speed
+    # up serial hardware, so an over-subtracted < 0.9 is dropped with a
+    # note instead of recorded as clean.
+    if on_tpu:
+        try:
+            import os as _osp
+
+            import jax as _jax
+            kpath = _osp.join(
+                _osp.dirname(_osp.abspath(__file__)), "artifacts",
+                "tpu_capture", "bench_kernels.json")
+            with open(kpath) as f:
+                kcap = json.load(f)
+            fresh = (time.time() - float(kcap.get("captured_at_unix", 0))
+                     < 86400)
+            same_dev = kcap.get("device") == str(_jax.devices()[0])
+            if fresh and same_dev:
+                floor_s = float(kcap["dispatch_floor_ms"]) / 1e3
+                c_1f1b = t_1f1b - n_1f1b * floor_s
+                c_unpip = t_unpip - n_unpip * floor_s
+                if c_1f1b > 0 and c_unpip > 0:
+                    ratio = c_1f1b / c_unpip
+                    entry["dispatch_floor_ms_used"] = round(
+                        floor_s * 1e3, 3)
+                    if ratio >= 0.9:
+                        entry["floor_corrected_overhead"] = round(ratio, 3)
+                    else:
+                        entry["floor_corrected_overhead_note"] = (
+                            f"dropped impossible corrected ratio "
+                            f"{ratio:.3f} < 0.9 (floor over-subtraction)")
+        except Exception:  # noqa: BLE001 — no capture, no correction
+            pass
     if overhead < 0.9:
         # a schedule cannot speed up serial hardware: refuse to record an
         # impossible ratio as a clean result (r3's 0.02 artifact)

@@ -61,6 +61,25 @@ def _unwrap(x):
     return x._data if isinstance(x, Tensor) else jnp.asarray(x)
 
 
+class _CountingProgram:
+    """Thin wrapper over a jitted chunk program that counts executions on
+    the owning engine (``_program_executes``) — schedule-efficiency
+    benches use the count to price the per-dispatch floor separately from
+    real schedule cost. Passes ``_cache_size`` through for the retrace
+    accounting."""
+
+    def __init__(self, fn, owner):
+        self._fn = fn
+        self._owner = owner
+
+    def __call__(self, *args, **kwargs):
+        self._owner._program_executes += 1
+        return self._fn(*args, **kwargs)
+
+    def _cache_size(self):
+        return self._fn._cache_size()
+
+
 class PipelineParallel:
     def __init__(self, layers, hcg=None, strategy=None, devices=None,
                  stage_mesh_axes=None, batch_axis=None):
@@ -84,6 +103,10 @@ class PipelineParallel:
         self.training = True
         self._batch_count = 0
         self._programs: Dict = {}  # (chunk, kind, train) -> jitted fn
+        # device-program executions since construction: the schedule's
+        # dispatch count, used by benches to separate per-dispatch floor
+        # (remote tunnels: ~7 ms/program) from real schedule cost
+        self._program_executes = 0
         self._peak_stash: List[int] = [0] * self.num_chunks
         self._stage_mesh_axes = dict(stage_mesh_axes or {})
         self._batch_axis = batch_axis
@@ -250,6 +273,7 @@ class PipelineParallel:
             prog = jax.jit(loss_bwd)
         else:
             raise ValueError(kind)
+        prog = _CountingProgram(prog, self)
         self._programs[key] = prog
         return prog
 

@@ -37,6 +37,9 @@ def main():
     ap.add_argument("--prefer-pallas-norms", action="store_true")
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--windows", type=int, default=2)
+    ap.add_argument("--scan", action="store_true",
+                    help="time a scan-of-iters program (one execute per "
+                         "window) instead of an iters-long step loop")
     ap.add_argument("--note", default="")
     args = ap.parse_args()
 
@@ -72,8 +75,13 @@ def main():
     model.train() if cfg.use_recompute else model.eval()
     opt = paddle.optimizer.AdamW(learning_rate=3e-4, weight_decay=0.01,
                                  parameters=model.parameters())
-    step, params, opt_state = create_train_step(model, opt,
-                                                donate="consume")
+    if args.scan:
+        from paddle_tpu.models import create_multistep_train_step
+        step, params, opt_state = create_multistep_train_step(
+            model, opt, donate="consume", steps=args.iters)
+    else:
+        step, params, opt_state = create_train_step(model, opt,
+                                                    donate="consume")
     params = {k: (v.astype(jnp.bfloat16)
                   if jnp.issubdtype(v.dtype, jnp.floating) else v)
               for k, v in params.items()}
@@ -85,19 +93,29 @@ def main():
     x, y = ids[:, :-1], ids[:, 1:]
     key = jax.random.key(0)
 
+    if args.scan:
+        x = jnp.tile(x[None], (args.iters, 1, 1))
+        y = jnp.tile(y[None], (args.iters, 1, 1))
     t_compile = time.perf_counter()
     loss, params, opt_state = step(params, opt_state, key, x, y, 3e-4)
-    l0 = float(jax.device_get(loss))
+    l0 = float(jax.device_get(loss if not args.scan else loss[0]))
     t_compile = time.perf_counter() - t_compile
     best = float("inf")
     si = 0
-    for _ in range(args.windows):
+    for w in range(args.windows):
         t0 = time.perf_counter()
-        for _ in range(args.iters):
+        if args.scan:
             loss, params, opt_state = step(
-                params, opt_state, jax.random.fold_in(key, si), x, y, 3e-4)
-            si += 1
-        l1 = float(jax.device_get(loss))
+                params, opt_state, jax.random.fold_in(key, 1000 + w),
+                x, y, 3e-4)
+            l1 = float(jax.device_get(loss)[-1])
+        else:
+            for _ in range(args.iters):
+                loss, params, opt_state = step(
+                    params, opt_state, jax.random.fold_in(key, si), x, y,
+                    3e-4)
+                si += 1
+            l1 = float(jax.device_get(loss))
         best = min(best, time.perf_counter() - t0)
     tps = args.batch * args.seq * args.iters / best
     H, L, I, V = (cfg.hidden_size, cfg.num_layers, cfg.intermediate_size,
@@ -108,6 +126,7 @@ def main():
     entry = {
         "what": (f"mfu_iter gpt2s b{args.batch} {args.lm_ce} "
                  f"remat={args.remat}"
+                 + (f" scan{args.iters}" if args.scan else "")
                  + (" +pallas_ce" if args.prefer_pallas_ce else "")
                  + (" +pallas_norms" if args.prefer_pallas_norms else "")
                  + (f" [{args.note}]" if args.note else "")),
