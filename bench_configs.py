@@ -102,19 +102,21 @@ def bench_llama(dev, on_tpu, zero3=False):
                           max_position_embeddings=2048, dropout=0.0,
                           lm_ce="blockwise")
         seq, iters, windows = 2048, 10, 2
-        # (batch, remat): b4 no-remat is the known-fitting r3 config and
-        # is measured FIRST; b8 with selective remat (keep matmul outputs,
-        # recompute elementwise) chases MXU utilization — an OOM there is
-        # recorded, never fatal
-        cands = ((4, False), (8, True)) if not zero3 else ((4, False),)
+        # (batch, remat, bf16_moments): b4/f32 is the known-fitting r3
+        # config and is measured FIRST (a later candidate's OOM can then
+        # only lose itself); bf16 moment storage frees ~2.75 GB of the
+        # 5.5 GB AdamW state at 0.7B — on the ~7.5 GB grant that is what
+        # lets b8/b16 fit. An OOM is recorded, never fatal.
+        cands = ((4, False, False), (8, False, True),
+                 (16, False, True)) if not zero3 else ((4, False, False),)
     else:
         cfg = LlamaConfig(vocab_size=256, hidden_size=64,
                           intermediate_size=128, num_layers=2, num_heads=4,
                           num_kv_heads=4, max_position_embeddings=128)
         seq, iters, windows = 64, 3, 2
-        cands = ((2, False),)
+        cands = ((2, False, False),)
 
-    def run_candidate(batch, remat):
+    def run_candidate(batch, remat, bf16_moments=False):
         # HBM budget at 0.7B on one v5e (15.75 GB): f32 init params
         # 2.8 GB + f32 AdamW moments 5.5 GB must never coexist with
         # protective donate copies (r3: setup peak 16.5 GB ->
@@ -126,7 +128,9 @@ def bench_llama(dev, on_tpu, zero3=False):
                                    recompute_policy="dots_saveable")
         model = LlamaForCausalLM(ccfg)
         model.train() if remat else model.eval()
-        opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters())
+        opt = paddle.optimizer.AdamW(
+            3e-4, parameters=model.parameters(),
+            moment_dtype=jnp.bfloat16 if bf16_moments else None)
         scan_k = on_tpu and not zero3
         if zero3:
             from jax.sharding import Mesh
@@ -175,6 +179,7 @@ def bench_llama(dev, on_tpu, zero3=False):
                                         peak_flops_per_chip(dev)), 4),
                 "params": n_params, "batch": batch, "seq": seq,
                 "remat": remat,
+                "moments": "bf16" if bf16_moments else "f32",
                 "timing": f"scan{iters}" if scan_k else f"loop{iters}",
                 "loss_start": round(loss0, 4),
                 "loss_end": round(loss_end, 4),
@@ -182,11 +187,12 @@ def bench_llama(dev, on_tpu, zero3=False):
                     np.isfinite(loss_end) and loss_end != loss0)}
 
     result, sweep = None, {}
-    for batch, remat in cands:
-        tag = f"b{batch}{'+remat_dots' if remat else ''}"
+    for batch, remat, bf16_mom in cands:
+        tag = (f"b{batch}{'+remat_dots' if remat else ''}"
+               f"{'+m_bf16' if bf16_mom else ''}")
         r = None
         try:
-            r = run_candidate(batch, remat)
+            r = run_candidate(batch, remat, bf16_mom)
         except Exception as e:  # noqa: BLE001 — e.g. RESOURCE_EXHAUSTED
             sweep[tag] = f"{type(e).__name__}: {e}"[:120]
         if r is not None:

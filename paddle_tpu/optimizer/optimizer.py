@@ -359,27 +359,40 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 use_multi_tensor=False, amsgrad=False, name=None):
+                 use_multi_tensor=False, amsgrad=False, moment_dtype=None,
+                 name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
         self._amsgrad = amsgrad
+        # STORAGE dtype of moment1/moment2 (beta pows stay f32, and all
+        # moment arithmetic runs in f32 regardless): bf16 halves the
+        # optimizer-state HBM — the dominant static cost at billions of
+        # params (8 bytes/param f32 -> 4). Parity: the reference's
+        # master-weight/multi_precision family trades precision of the
+        # stored copy for memory the same way.
+        self._moment_dtype = moment_dtype or jnp.float32
 
     def _init_state(self, p):
-        s = {"moment1": jnp.zeros_like(p._data, dtype=jnp.float32),
-             "moment2": jnp.zeros_like(p._data, dtype=jnp.float32),
+        s = {"moment1": jnp.zeros_like(p._data, dtype=self._moment_dtype),
+             "moment2": jnp.zeros_like(p._data, dtype=self._moment_dtype),
              "beta1_pow": jnp.ones((), jnp.float32),
              "beta2_pow": jnp.ones((), jnp.float32)}
         if self._amsgrad:
+            # f32 regardless of moment_dtype: re-quantizing the running
+            # max to bf16 can round DOWN below the true max, breaking
+            # AMSGrad's monotone-denominator guarantee
             s["moment2_max"] = jnp.zeros_like(p._data, dtype=jnp.float32)
         return s
 
     def _update(self, param, grad, state, lr, wd=0.0):
         b1, b2 = self._beta1, self._beta2
-        m1 = b1 * state["moment1"] + (1 - b1) * grad
-        m2 = b2 * state["moment2"] + (1 - b2) * jnp.square(grad)
+        md = self._moment_dtype
+        m1 = b1 * state["moment1"].astype(jnp.float32) + (1 - b1) * grad
+        m2 = (b2 * state["moment2"].astype(jnp.float32)
+              + (1 - b2) * jnp.square(grad))
         b1p = state["beta1_pow"] * b1
         b2p = state["beta2_pow"] * b2
         mhat = m1 / (1 - b1p)
@@ -391,7 +404,8 @@ class Adam(Optimizer):
         if wd:
             param = param * (1.0 - lr * wd)
         new_param = param - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
-        out = {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+        out = {"moment1": m1.astype(md), "moment2": m2.astype(md),
+               "beta1_pow": b1p, "beta2_pow": b2p}
         if self._amsgrad:
             out["moment2_max"] = m2max
         return new_param, out
@@ -405,10 +419,11 @@ class AdamW(Adam):
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, amsgrad=False,
-                 name=None):
+                 moment_dtype=None, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, lazy_mode, multi_precision,
-                         amsgrad=amsgrad, name=name)
+                         amsgrad=amsgrad, moment_dtype=moment_dtype,
+                         name=name)
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def _decoupled_weight_decay(self):

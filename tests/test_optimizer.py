@@ -185,3 +185,41 @@ def test_multi_precision_master_weights():
         opt.step()
     assert w.dtype == jnp.bfloat16
     assert id(w) in opt._master_weights
+
+
+def test_adamw_bf16_moment_storage():
+    """moment_dtype=bfloat16 halves optimizer-state bytes; arithmetic
+    stays f32 (states cast up before the update, down on store), so a
+    short training trajectory tracks the f32-moment one closely."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import GPTForCausalLM, create_train_step, gpt2_tiny
+
+    def run(moment_dtype):
+        paddle.seed(11)
+        model = GPTForCausalLM(gpt2_tiny())
+        model.eval()
+        opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters(),
+                                     moment_dtype=moment_dtype)
+        step, params, state = create_train_step(model, opt)
+        rng = np.random.RandomState(3)
+        ids = jnp.asarray(rng.randint(0, 256, (2, 9)), jnp.int32)
+        losses = []
+        for i in range(6):
+            loss, params, state = step(params, state,
+                                       jax.random.fold_in(jax.random.key(0), i),
+                                       ids[:, :-1], ids[:, 1:], 5e-3)
+            losses.append(float(loss))
+        return losses, state
+
+    l32, s32 = run(None)
+    lb16, sb16 = run(jnp.bfloat16)
+    name = next(iter(sb16))
+    assert sb16[name]["moment1"].dtype == jnp.bfloat16
+    assert sb16[name]["moment2"].dtype == jnp.bfloat16
+    assert sb16[name]["beta1_pow"].dtype == jnp.float32
+    assert s32[name]["moment1"].dtype == jnp.float32
+    # same descent, small numeric drift only
+    assert lb16[-1] < lb16[0]
+    np.testing.assert_allclose(lb16, l32, rtol=0.05, atol=0.05)
