@@ -235,11 +235,14 @@ def main():
         # b16/blockwise 6.80, b32/blockwise+remat 2.95): the front of the
         # list must hold the plausible winners because the sweep budget
         # can skip the tail
+        # +m_bf16 = bf16 AdamW moment storage (~0.5 GB freed at GPT-2
+        # scale); the slowest measured r5 candidates (b64+remat_dots,
+        # b128+remat) gave up their slots for them
         candidates = ((8, "plain"), (16, "blockwise"),
-                      (32, "blockwise+remat_dots"), (16, "plain"),
-                      (32, "blockwise"), (64, "blockwise+remat_dots"),
-                      (32, "blockwise+remat"), (64, "blockwise+remat"),
-                      (128, "blockwise+remat"))
+                      (16, "plain+m_bf16"), (32, "blockwise+m_bf16"),
+                      (16, "plain"), (32, "blockwise"),
+                      (32, "blockwise+remat_dots"),
+                      (32, "blockwise+remat"), (64, "blockwise+remat"))
         seq, iters, windows = 1024, 20, 3
     else:  # CI fallback so bench never hard-fails
         cfg = GPTConfig(vocab_size=1024, max_position_embeddings=128,
@@ -284,8 +287,10 @@ def main():
         # recompute only engages in train mode; dropout=0.0 makes
         # train/eval semantics identical, so the candidates stay comparable
         model.train() if remat else model.eval()
-        opt = paddle.optimizer.AdamW(learning_rate=3e-4, weight_decay=0.01,
-                                     parameters=model.parameters())
+        opt = paddle.optimizer.AdamW(
+            learning_rate=3e-4, weight_decay=0.01,
+            parameters=model.parameters(),
+            moment_dtype=jnp.bfloat16 if "m_bf16" in mode else None)
         # donate=True: params + opt state are aliased in place by XLA,
         # freeing ~1.3 GB of HBM at GPT-2-small scale
         if scan_steps:
