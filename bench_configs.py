@@ -131,7 +131,7 @@ def bench_llama(dev, on_tpu, zero3=False):
         opt = paddle.optimizer.AdamW(
             3e-4, parameters=model.parameters(),
             moment_dtype=jnp.bfloat16 if bf16_moments else None)
-        scan_k = on_tpu and not zero3
+        scan_k = on_tpu
         if zero3:
             from jax.sharding import Mesh
             mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
@@ -141,8 +141,9 @@ def bench_llama(dev, on_tpu, zero3=False):
             spec = lambda name: llama_fsdp_spec(  # noqa: E731
                 name, named.get(name, (1,)), 1)
             step, params, opt_state, shard_batch = \
-                create_sharded_train_step(model, opt, mesh, spec,
-                                          donate="consume")
+                create_sharded_train_step(
+                    model, opt, mesh, spec, donate="consume",
+                    steps=iters if scan_k else None)
         elif scan_k:
             # scan-of-iters: one execute per timed window, so the
             # tunnel's per-execute overhead amortizes (same trainer math
@@ -162,13 +163,16 @@ def bench_llama(dev, on_tpu, zero3=False):
         write_back(model, params)  # drop last refs to the f32 originals
         rng = np.random.RandomState(0)
         ids = rng.randint(0, cfg.vocab_size, (batch, seq + 1))
-        x = shard_batch(ids[:, :-1].astype(np.int32))
-        y = shard_batch(ids[:, 1:].astype(np.int32))
+        x_np = ids[:, :-1].astype(np.int32)
+        y_np = ids[:, 1:].astype(np.int32)
+        if scan_k:
+            # tile BEFORE sharding: with steps=K, shard_batch places the
+            # per-step batch (dim 1) over the data axis
+            x_np = np.tile(x_np[None], (iters, 1, 1))
+            y_np = np.tile(y_np[None], (iters, 1, 1))
+        x, y = shard_batch(x_np), shard_batch(y_np)
         key = jax.random.key(0)
 
-        if scan_k:
-            x = jnp.tile(x[None], (iters, 1, 1))
-            y = jnp.tile(y[None], (iters, 1, 1))
         best, loss0, loss_end = _measure_steps(
             step, params, opt_state, key, x, y, 3e-4, iters, windows,
             scan_k)

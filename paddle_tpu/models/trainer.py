@@ -154,16 +154,23 @@ def create_multistep_train_step(model, optimizer, loss_fn=None,
 
 def create_sharded_train_step(model, optimizer, mesh, param_spec_fn,
                               data_axis: str = "dp", loss_fn=None,
-                              donate=False):
+                              donate=False, steps=None):
     """Hybrid-parallel variant: params/opt-state laid out by
     ``param_spec_fn(name) -> PartitionSpec`` over ``mesh``; batch sharded
     over ``data_axis``. Returns (step, params, opt_state, shard_batch).
     ``donate=True`` aliases params/opt-state in place (see
-    create_train_step) — treat the passed-in trees as consumed."""
+    create_train_step) — treat the passed-in trees as consumed.
+    ``steps=K`` wraps the scan-of-K trainer instead (ids/labels stacked
+    to [K, B, ...]; ``shard_batch`` then shards dim 1, the per-step
+    batch, over ``data_axis``)."""
     from jax.sharding import NamedSharding, PartitionSpec
 
-    step, params, opt_state = create_train_step(model, optimizer, loss_fn,
-                                                donate=donate)
+    if steps:
+        step, params, opt_state = create_multistep_train_step(
+            model, optimizer, loss_fn, donate=donate, steps=steps)
+    else:
+        step, params, opt_state = create_train_step(
+            model, optimizer, loss_fn, donate=donate)
 
     def place(name, arr):
         return place_by_spec(arr, param_spec_fn(name), mesh)
@@ -179,9 +186,14 @@ def create_sharded_train_step(model, optimizer, mesh, param_spec_fn,
 
     def shard_batch(arr):
         arr = jnp.asarray(arr)
-        # leading (batch) dim over the data axis, rest replicated — spec
-        # trimmed to the array's rank (labels are often rank-1)
-        spec = PartitionSpec(data_axis, *([None] * (arr.ndim - 1)))
+        # batch dim over the data axis, rest replicated — spec trimmed to
+        # the array's rank (labels are often rank-1). With steps=K the
+        # leading dim is the scan axis; the per-step batch is dim 1.
+        if steps:
+            spec = PartitionSpec(None, data_axis,
+                                 *([None] * (arr.ndim - 2)))
+        else:
+            spec = PartitionSpec(data_axis, *([None] * (arr.ndim - 1)))
         return jax.device_put(arr, NamedSharding(mesh, spec))
 
     def sharded_step(params, opt_state, key, ids, labels, lr):
