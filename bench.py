@@ -687,6 +687,7 @@ def _compact_line(result: dict, note: str = None) -> str:
         "mfu", "ms_per_step", "batch", "mode", "lm_ce", "use_recompute",
         "seq", "params", "platform", "device", "captured_at",
         "loss_start", "loss_end", "capture_note", "tpu_error",
+        "timing", "measured_matmul_tflops", "mfu_vs_measured_ceiling",
         "batch_sweep") if k in extra}
     kern = extra.get("kernels_vs_xla")
     if isinstance(kern, dict) and kern.get("summary"):
@@ -697,7 +698,9 @@ def _compact_line(result: dict, note: str = None) -> str:
             name: {k: (str(v)[:120] if k == "error" else v)
                    for k, v in c.items() if k in (
                 "mfu", "tokens_per_sec", "images_per_sec",
-                "host_schedule_overhead", "theoretical_bubble_fraction",
+                "host_schedule_overhead", "floor_corrected_overhead",
+                "program_executes_per_batch",
+                "theoretical_bubble_fraction", "timing", "moments",
                 "loss_dropping", "loss_finite_and_moving", "error",
                 "stale", "stale_fix_commit", "stale_note",
                 "superseded_by")}

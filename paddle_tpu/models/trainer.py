@@ -188,10 +188,13 @@ def create_sharded_train_step(model, optimizer, mesh, param_spec_fn,
         arr = jnp.asarray(arr)
         # batch dim over the data axis, rest replicated — spec trimmed to
         # the array's rank (labels are often rank-1). With steps=K the
-        # leading dim is the scan axis; the per-step batch is dim 1.
+        # leading dim is the scan axis and the per-step batch is dim 1;
+        # a rank-1 [K] array (scalar per step) has no batch dim to shard
+        # and stays replicated over the scan axis.
         if steps:
-            spec = PartitionSpec(None, data_axis,
-                                 *([None] * (arr.ndim - 2)))
+            spec = (PartitionSpec(None) if arr.ndim == 1 else
+                    PartitionSpec(None, data_axis,
+                                  *([None] * (arr.ndim - 2))))
         else:
             spec = PartitionSpec(data_axis, *([None] * (arr.ndim - 1)))
         return jax.device_put(arr, NamedSharding(mesh, spec))
