@@ -101,6 +101,25 @@ def capture(device_info: str) -> bool:
     os.makedirs(OUT, exist_ok=True)
     ok = False
 
+    # quick scan-mode probe FIRST (~3-4 min): the full bench child needs
+    # ~25 min before its first result persists, and r3's whole tunnel
+    # window was 28 min — a short window must still land a scan-timed
+    # headline number (mfu_iter appends to manual_runs.json, which the
+    # bench replay path summarizes)
+    try:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "mfu_iter.py"),
+             "--scan", "--batch", "8", "--lm-ce", "plain",
+             "--note", "daemon-early-scan"],
+            capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+        tail = (r.stdout or "").strip().splitlines()[-1:]
+        log(f"early scan probe: exit {r.returncode} "
+            f"{tail[0][:160] if tail else ''}")
+    except Exception as e:  # noqa: BLE001 — insurance only, never fatal
+        log(f"early scan probe failed: {e!r}")
+
     bench = run_json_child(os.path.join(REPO, "bench.py"), BENCH_TIMEOUT,
                            "metric")
     if bench is not None and bench.get("extra", {}).get("platform") == "tpu" \
