@@ -711,11 +711,14 @@ def _compact_line(result: dict, note: str = None) -> str:
     if isinstance(man, dict):
         runs = man.get("runs")
         if isinstance(runs, list):
+            best_first = sorted(
+                (r for r in runs if isinstance(r, dict)),
+                key=lambda r: -(r.get("tokens_per_sec") or 0))
             keep["manual_runs_summary"] = [
                 {k: (str(v)[:100] if isinstance(v, str) else v)
                  for k, v in r.items() if k in (
                      "what", "mfu", "tokens_per_sec", "outcome")}
-                for r in runs if isinstance(r, dict)][:8]
+                for r in best_first][:8]
         else:
             keep["manual_runs_summary"] = str(man)[:160]
     if note:

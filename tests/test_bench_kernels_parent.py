@@ -1,0 +1,111 @@
+"""Per-case subprocess orchestration in bench_kernels (r5): the parent
+must merge whatever its case children measure and degrade per-case — a
+child that OOMs, times out, or prints garbage costs only its own row.
+This is the critical path for the next on-chip capture, so the merge
+logic is pinned here with a faked subprocess layer (no TPU needed)."""
+import importlib.util
+import json
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bk():
+    spec = importlib.util.spec_from_file_location(
+        "bench_kernels_under_test", os.path.join(REPO, "bench_kernels.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _FakeDev:
+    platform = "tpu"
+    device_kind = "TPU v5 lite"
+
+    def __str__(self):
+        return "TPU v5 lite0"
+
+
+class _R:
+    def __init__(self, stdout="", returncode=0, stderr=""):
+        self.stdout = stdout
+        self.returncode = returncode
+        self.stderr = stderr
+
+
+def _child_line(case, ratio=1.2, shipped=1.1):
+    return json.dumps({
+        "case": case,
+        "results": {case: {"fwd": {"pallas_ms": 1.0, "xla_ms": ratio,
+                                   "shipped_ms": 1.0, "ratio": ratio,
+                                   "shipped_ratio": shipped},
+                           "fwd_bwd": {"pallas_ms": 2.0, "xla_ms": 2.4,
+                                       "shipped_ms": 2.2, "ratio": 1.2,
+                                       "shipped_ratio": 1.09}}},
+        "tuning": {"blocks": {case: [128, 128]}, "errors": {}},
+    })
+
+
+def _run_parent(bk, monkeypatch, capsys, behaviors):
+    """behaviors: case -> _R | Exception; defaults to a clean child."""
+    def fake_run(argv, **kwargs):
+        case = kwargs["env"]["PADDLE_TPU_KBENCH_CASE"]
+        b = behaviors.get(case)
+        if isinstance(b, Exception):
+            raise b
+        if b is not None:
+            return b
+        return _R(stdout="noise\n" + _child_line(case))
+    monkeypatch.setattr(bk.subprocess if hasattr(bk, "subprocess")
+                        else subprocess, "run", fake_run)
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    bk._parent(_FakeDev())
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    return json.loads(out)
+
+
+def test_parent_merges_all_clean_children(bk, monkeypatch, capsys):
+    got = _run_parent(bk, monkeypatch, capsys, {})
+    assert got["platform"] == "tpu"
+    assert set(got["results"]) == set(bk.ALL_CASES)
+    # 2 directions per case, all carrying ratios
+    assert got["summary"]["n_measured"] == 2 * len(bk.ALL_CASES)
+    assert got["summary"]["n_shipped"] == 2 * len(bk.ALL_CASES)
+    assert "error" not in got
+    assert got["captured_at_unix"] > 0
+
+
+def test_parent_degrades_per_case(bk, monkeypatch, capsys):
+    bad_oom = bk.ALL_CASES[2]      # child crashed: JSON never printed
+    bad_hang = bk.ALL_CASES[5]     # child hit its timeout
+    bad_junk = bk.ALL_CASES[7]     # child printed garbage only
+    got = _run_parent(bk, monkeypatch, capsys, {
+        bad_oom: _R(stdout="", returncode=1,
+                    stderr="RESOURCE_EXHAUSTED: boom"),
+        bad_hang: subprocess.TimeoutExpired(cmd="x", timeout=420),
+        bad_junk: _R(stdout="not json at all"),
+    })
+    lost = {bad_oom, bad_hang, bad_junk}
+    assert set(got["results"]) == set(bk.ALL_CASES) - lost
+    assert got["summary"]["n_measured"] == 2 * (len(bk.ALL_CASES) - 3)
+    # every failure is named in the error field, none lost silently
+    for case in lost:
+        assert case in got["error"]
+
+
+def test_parent_timeout_is_clipped_to_remaining_budget(bk, monkeypatch,
+                                                       capsys):
+    seen = []
+
+    def fake_run(argv, **kwargs):
+        seen.append(kwargs["timeout"])
+        case = kwargs["env"]["PADDLE_TPU_KBENCH_CASE"]
+        return _R(stdout=_child_line(case))
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    bk._parent(_FakeDev())
+    capsys.readouterr()
+    assert seen and all(120 <= t <= 420 for t in seen)

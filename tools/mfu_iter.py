@@ -140,16 +140,27 @@ def main():
     print(json.dumps(entry))
 
     os.makedirs(os.path.dirname(MANUAL), exist_ok=True)
-    doc = {"note": "manual on-chip runs (tools/mfu_iter.py)", "runs": []}
-    if os.path.exists(MANUAL):
-        try:
-            with open(MANUAL) as f:
-                doc = json.load(f)
-        except Exception:
-            pass
-    doc.setdefault("runs", []).append(entry)
-    with open(MANUAL, "w") as f:
-        json.dump(doc, f, indent=1)
+    # exclusive lock around the read-modify-write: the capture daemon's
+    # early-scan probe and a human-driven run can land in the same
+    # tunnel window, and an unlocked append would silently erase
+    # whichever finished first
+    import fcntl
+    lock_path = MANUAL + ".lock"
+    with open(lock_path, "w") as lk:
+        fcntl.flock(lk, fcntl.LOCK_EX)
+        doc = {"note": "manual on-chip runs (tools/mfu_iter.py)",
+               "runs": []}
+        if os.path.exists(MANUAL):
+            try:
+                with open(MANUAL) as f:
+                    doc = json.load(f)
+            except Exception:
+                pass
+        doc.setdefault("runs", []).append(entry)
+        tmp = MANUAL + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, MANUAL)
 
 
 if __name__ == "__main__":

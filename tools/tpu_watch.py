@@ -64,15 +64,20 @@ def probe() -> str | None:
     return None
 
 
-def run_json_child(script: str, timeout_s: int, metric_key: str):
+def run_json_child(script: str, timeout_s: int, metric_key: str,
+                   argv_extra=None, env_extra=None):
     """Run a bench child and return the last stdout JSON line containing
-    metric_key, or None."""
+    metric_key, or None. ``argv_extra``/``env_extra`` extend the command
+    line and environment (one spawn/log/parse path for every child)."""
     env = dict(os.environ)
     env["PADDLE_TPU_BENCH_CHILD"] = "1"
+    if env_extra:
+        env.update(env_extra)
     # JAX_PLATFORMS=axon stays inherited: it routes the child to the TPU
     # tunnel and prevents a silent CPU fallback (sitecustomize contract)
     try:
-        r = subprocess.run([sys.executable, script], capture_output=True,
+        r = subprocess.run([sys.executable, script] + list(argv_extra or ()),
+                           capture_output=True,
                            text=True, timeout=timeout_s, env=env, cwd=REPO)
     except subprocess.TimeoutExpired:
         log(f"{os.path.basename(script)} exceeded {timeout_s}s; killed")
@@ -97,6 +102,11 @@ def run_json_child(script: str, timeout_s: int, metric_key: str):
     return None
 
 
+# truthy after the first successful early-scan probe of this daemon
+# session (list, not bool: mutated from capture())
+_EARLY_SCAN_DONE = []
+
+
 def capture(device_info: str) -> bool:
     os.makedirs(OUT, exist_ok=True)
     ok = False
@@ -105,20 +115,23 @@ def capture(device_info: str) -> bool:
     # ~25 min before its first result persists, and r3's whole tunnel
     # window was 28 min — a short window must still land a scan-timed
     # headline number (mfu_iter appends to manual_runs.json, which the
-    # bench replay path summarizes)
-    try:
-        env = dict(os.environ)
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        r = subprocess.run(
-            [sys.executable, os.path.join(REPO, "tools", "mfu_iter.py"),
-             "--scan", "--batch", "8", "--lm-ce", "plain",
-             "--note", "daemon-early-scan"],
-            capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
-        tail = (r.stdout or "").strip().splitlines()[-1:]
-        log(f"early scan probe: exit {r.returncode} "
-            f"{tail[0][:160] if tail else ''}")
-    except Exception as e:  # noqa: BLE001 — insurance only, never fatal
-        log(f"early scan probe failed: {e!r}")
+    # bench replay path summarizes). Once per daemon session: re-running
+    # it every pass would burn tunnel time and flood the manual-runs
+    # summary with duplicates.
+    if not _EARLY_SCAN_DONE:
+        got = run_json_child(
+            os.path.join(REPO, "tools", "mfu_iter.py"), 420,
+            "tokens_per_sec",
+            argv_extra=("--scan", "--batch", "8", "--lm-ce", "plain",
+                        "--note", "daemon-early-scan"),
+            env_extra={"PYTHONPATH": REPO + os.pathsep
+                       + os.environ.get("PYTHONPATH", "")})
+        if got is not None:
+            _EARLY_SCAN_DONE.append(True)
+            log(f"early scan probe: {got.get('tokens_per_sec')} tok/s "
+                f"mfu={got.get('mfu')}")
+        else:
+            log("early scan probe returned nothing (see child lines)")
 
     bench = run_json_child(os.path.join(REPO, "bench.py"), BENCH_TIMEOUT,
                            "metric")
