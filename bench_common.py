@@ -1,0 +1,46 @@
+"""Shared child-process plumbing for the bench-suite parents.
+
+bench.py, bench_kernels.py, and bench_configs.py all isolate their
+measurement units in subprocesses (r5: one OOM must only lose itself).
+The spawn/parse half of that pattern lives here so the parsers cannot
+drift — the guard set (dict-only JSON lines, stderr tail on failure)
+exists exactly once.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+
+def spawn_json_child(script: str, env_key: str, name: str, timeout_s: int,
+                     match_key: str):
+    """Run ``python script`` with ``env[env_key] = name``; return
+    ``(obj, err)`` where ``obj`` is the last stdout line that parses to a
+    dict carrying ``obj[match_key] == name`` (else None + a diagnostic
+    string with the child's stderr tail)."""
+    env = dict(os.environ)
+    env[env_key] = name
+    try:
+        r = subprocess.run([sys.executable, script], capture_output=True,
+                           text=True, timeout=int(timeout_s), env=env,
+                           cwd=os.path.dirname(os.path.abspath(script)))
+    except subprocess.TimeoutExpired:
+        return None, f"child exceeded its {int(timeout_s)}s timeout"
+    except Exception as e:  # noqa: BLE001
+        return None, repr(e)[:200]
+    for line in reversed((r.stdout or "").strip().splitlines()):
+        if not line.startswith("{"):
+            # a bare number / null / stray debug print is valid JSON but
+            # not a child result; json.loads would hand back a non-dict
+            # and .get() on it would crash the whole parent
+            continue
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and d.get(match_key) == name:
+            return d, None
+    tail = " | ".join((r.stderr or "").strip().splitlines()[-3:])
+    return None, f"child rc={r.returncode}: {tail}"[:300]

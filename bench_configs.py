@@ -404,21 +404,85 @@ def bench_resnet50(dev, on_tpu):
             "loss_dropping": bool(loss_end < loss0)}
 
 
+CONFIG_NAMES = ("llama_tp_chip", "llama_zero3_layout", "bert_1f1b",
+                "resnet50")
+
+
+def _run_config(name, dev, on_tpu):
+    fns = {
+        "llama_tp_chip": lambda: bench_llama(dev, on_tpu, zero3=False),
+        "llama_zero3_layout": lambda: bench_llama(dev, on_tpu, zero3=True),
+        "bert_1f1b": lambda: bench_bert_1f1b(on_tpu),
+        "resnet50": lambda: bench_resnet50(dev, on_tpu),
+    }
+    return fns[name]()
+
+
+def _parent(dev):
+    """One subprocess per config on TPU: an OOM inside one config (e.g. a
+    llama batch candidate) poisons the rest of an in-process run — the
+    r5 sweep failure class — so each config's fit is kept independent."""
+    import os
+
+    from bench_common import spawn_json_child
+    out = {"metric": "baseline_configs_2_to_5", "platform": dev.platform,
+           "device": str(dev), "configs": {}}
+    here = os.path.abspath(__file__)
+    deadline = time.monotonic() + 2200
+    for name in CONFIG_NAMES:
+        remaining = deadline - time.monotonic()
+        got_any = any(isinstance(c, dict) and "error" not in c
+                      for c in out["configs"].values())
+        if remaining <= (60 if got_any else -120):
+            out["configs"][name] = {"error": "skipped: parent time budget"}
+            continue
+        got, err = spawn_json_child(
+            here, "PADDLE_TPU_CFGBENCH", name,
+            min(900, max(180, remaining)), "config")
+        if got is None:
+            out["configs"][name] = {"error": err}
+        elif got.get("platform") != dev.platform:
+            # the tunnel dropped mid-pass and this child's jax fell back
+            # to CPU: its numbers must never merge into a TPU capture
+            out["configs"][name] = {
+                "error": f"child measured on platform="
+                         f"{got.get('platform')!r}, parent on "
+                         f"{dev.platform!r} (tunnel dropped mid-pass?)"}
+        else:
+            out["configs"][name] = got["result"]
+    errs = [n for n, c in out["configs"].items() if "error" in c]
+    if errs:
+        out["error"] = "configs failed: " + ", ".join(errs)
+    print(json.dumps(out))
+
+
 def main():
+    import os
+
     import jax
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
+    want = os.environ.get("PADDLE_TPU_CFGBENCH")
+    if want:
+        # single-config subprocess: raw result for the parent, stamped
+        # with the platform THIS process measured on (the parent refuses
+        # a CPU-fallback child inside a TPU capture)
+        try:
+            print(json.dumps({"config": want, "platform": dev.platform,
+                              "result": _run_config(want, dev, on_tpu)}))
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"config": want, "platform": dev.platform,
+                              "result": {
+                "error": f"{type(e).__name__}: {e}"[:300]}}))
+        return
+    if on_tpu:
+        return _parent(dev)
     out = {"metric": "baseline_configs_2_to_5", "platform": dev.platform,
            "device": str(dev), "configs": {}}
-    for name, fn in (
-        ("llama_tp_chip", lambda: bench_llama(dev, on_tpu, zero3=False)),
-        ("llama_zero3_layout", lambda: bench_llama(dev, on_tpu, zero3=True)),
-        ("bert_1f1b", lambda: bench_bert_1f1b(on_tpu)),
-        ("resnet50", lambda: bench_resnet50(dev, on_tpu)),
-    ):
+    for name in CONFIG_NAMES:
         try:
-            out["configs"][name] = fn()
+            out["configs"][name] = _run_config(name, dev, on_tpu)
         except Exception as e:  # noqa: BLE001 — report per-config, keep going
             out["configs"][name] = {"error": f"{type(e).__name__}: {e}"[:300]}
     errs = [n for n, c in out["configs"].items() if "error" in c]

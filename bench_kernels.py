@@ -180,7 +180,8 @@ def _parent(dev):
     """Spawn one subprocess per case; merge their measurements. A case
     that OOMs, times out, or crashes costs only its own row."""
     import os
-    import subprocess
+
+    from bench_common import spawn_json_child
     results, tuning = {}, {"blocks": {}, "errors": {}}
     child_failures = []
     here = os.path.abspath(__file__)
@@ -196,32 +197,16 @@ def _parent(dev):
         if remaining <= (60 if results else -120):
             child_failures.append(f"{case}: skipped, parent time budget")
             continue
-        env = dict(os.environ)
-        env["PADDLE_TPU_KBENCH_CASE"] = case
-        try:
-            r = subprocess.run([sys.executable, here], capture_output=True,
-                               text=True,
-                               timeout=int(min(420, max(120, remaining))),
-                               env=env, cwd=os.path.dirname(here))
-        except subprocess.TimeoutExpired:
-            child_failures.append(f"{case}: child exceeded its timeout")
-            continue
-        except Exception as e:  # noqa: BLE001
-            child_failures.append(f"{case}: {e!r}"[:160])
-            continue
-        got = None
-        for line in reversed((r.stdout or "").strip().splitlines()):
-            try:
-                d = json.loads(line)
-            except ValueError:
-                continue
-            if d.get("case") == case:
-                got = d
-                break
+        got, err = spawn_json_child(
+            here, "PADDLE_TPU_KBENCH_CASE", case,
+            min(420, max(120, remaining)), "case")
         if got is None:
-            tail = " | ".join((r.stderr or "").strip().splitlines()[-2:])
+            child_failures.append(f"{case}: {err}"[:300])
+            continue
+        if got.get("platform") != dev.platform:
             child_failures.append(
-                f"{case}: child rc={r.returncode}: {tail}"[:200])
+                f"{case}: child measured on platform="
+                f"{got.get('platform')!r} (tunnel dropped mid-pass?)")
             continue
         results.update(got.get("results") or {})
         tuning["blocks"].update((got.get("tuning") or {}).get("blocks", {}))
@@ -438,9 +423,11 @@ def main():
             (q, k, v), results, iters=2, chain=2)
 
     if WANT:
-        # single-case subprocess: hand the raw rows to the parent
-        print(json.dumps({"case": WANT, "results": results,
-                          "tuning": tuning}))
+        # single-case subprocess: hand the raw rows to the parent, stamped
+        # with the platform THIS process measured on (the parent refuses a
+        # CPU-fallback child inside a TPU capture)
+        print(json.dumps({"case": WANT, "platform": dev.platform,
+                          "results": results, "tuning": tuning}))
         return
     print(json.dumps(_assemble(dev, results, tuning,
                                at_status=_at.autotune_status())))

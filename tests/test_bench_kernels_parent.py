@@ -40,6 +40,7 @@ class _R:
 def _child_line(case, ratio=1.2, shipped=1.1):
     return json.dumps({
         "case": case,
+        "platform": "tpu",
         "results": {case: {"fwd": {"pallas_ms": 1.0, "xla_ms": ratio,
                                    "shipped_ms": 1.0, "ratio": ratio,
                                    "shipped_ratio": shipped},
@@ -109,3 +110,69 @@ def test_parent_timeout_is_clipped_to_remaining_budget(bk, monkeypatch,
     bk._parent(_FakeDev())
     capsys.readouterr()
     assert seen and all(120 <= t <= 420 for t in seen)
+
+
+# ---- bench_configs per-config parent (same isolation pattern) ----------
+
+@pytest.fixture()
+def bc():
+    spec = importlib.util.spec_from_file_location(
+        "bench_configs_under_test", os.path.join(REPO, "bench_configs.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_configs_parent_merges_and_degrades(bc, monkeypatch, capsys):
+    def fake_run(argv, **kwargs):
+        name = kwargs["env"]["PADDLE_TPU_CFGBENCH"]
+        if name == "bert_1f1b":
+            raise subprocess.TimeoutExpired(cmd="x", timeout=900)
+        if name == "resnet50":
+            return _R(stdout="", returncode=1, stderr="boom")
+        return _R(stdout=json.dumps(
+            {"config": name, "platform": "tpu",
+             "result": {"tokens_per_sec": 123.0}}))
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    bc._parent(_FakeDev())
+    got = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert got["configs"]["llama_tp_chip"] == {"tokens_per_sec": 123.0}
+    assert got["configs"]["llama_zero3_layout"] == {"tokens_per_sec": 123.0}
+    assert "timeout" in got["configs"]["bert_1f1b"]["error"]
+    assert "rc=1" in got["configs"]["resnet50"]["error"]
+    assert "bert_1f1b" in got["error"] and "resnet50" in got["error"]
+
+
+def test_parents_reject_cpu_fallback_children(bk, bc, monkeypatch, capsys):
+    """A child whose jax fell back to CPU mid-pass must be recorded as a
+    failure, never merged into a TPU capture."""
+    def fake_kernels(argv, **kwargs):
+        case = kwargs["env"]["PADDLE_TPU_KBENCH_CASE"]
+        d = json.loads(_child_line(case))
+        d["platform"] = "cpu"
+        return _R(stdout=json.dumps(d))
+    monkeypatch.setattr(subprocess, "run", fake_kernels)
+    bk._parent(_FakeDev())
+    got = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert got["results"] == {}
+    assert "platform='cpu'" in got["error"]
+
+    def fake_cfg(argv, **kwargs):
+        name = kwargs["env"]["PADDLE_TPU_CFGBENCH"]
+        return _R(stdout=json.dumps({"config": name, "platform": "cpu",
+                                     "result": {"tokens_per_sec": 1.0}}))
+    monkeypatch.setattr(subprocess, "run", fake_cfg)
+    bc._parent(_FakeDev())
+    got = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert all("error" in c for c in got["configs"].values())
+
+
+def test_spawn_json_child_ignores_non_dict_json_lines(tmp_path):
+    from bench_common import spawn_json_child
+    script = tmp_path / "fake_child.py"
+    script.write_text(
+        "import os, json\n"
+        "print(42)\nprint('null')\nprint('not json')\n"
+        "print(json.dumps({'case': os.environ['K'], 'x': 1}))\n")
+    got, err = spawn_json_child(str(script), "K", "c1", 60, "case")
+    assert err is None and got == {"case": "c1", "x": 1}
