@@ -396,31 +396,14 @@ def main():
     def spawn_candidate(b, mode, timeout_s=480):
         """One candidate in its own process: jax init + compile + measure.
         Returns the child's JSON dict (or a cand_error dict)."""
-        import subprocess
+        from bench_common import spawn_json_child
         tag = f"b{b}/{mode}"
-        env = dict(_os.environ)
-        env["PADDLE_TPU_BENCH_CANDIDATE"] = tag
-        env["PADDLE_TPU_BENCH_CHILD"] = "1"
-        here = _os.path.abspath(__file__)
-        try:
-            r = subprocess.run([sys.executable, here], capture_output=True,
-                               text=True, timeout=timeout_s, env=env,
-                               cwd=_os.path.dirname(here))
-        except subprocess.TimeoutExpired:
-            return {"cand": tag,
-                    "cand_error": f"candidate child exceeded {timeout_s}s"}
-        except Exception as e:  # noqa: BLE001
-            return {"cand": tag, "cand_error": repr(e)[:160]}
-        for line in reversed((r.stdout or "").strip().splitlines()):
-            try:
-                d = json.loads(line)
-            except ValueError:
-                continue
-            if d.get("cand") == tag:
-                return d
-        tail = " | ".join((r.stderr or "").strip().splitlines()[-3:])
-        return {"cand": tag,
-                "cand_error": f"child rc={r.returncode}: {tail}"[:200]}
+        got, err = spawn_json_child(
+            _os.path.abspath(__file__), "PADDLE_TPU_BENCH_CANDIDATE", tag,
+            timeout_s, "cand", env_extra={"PADDLE_TPU_BENCH_CHILD": "1"})
+        if got is None:
+            return {"cand": tag, "cand_error": err[:200]}
+        return got
 
     # per-candidate subprocesses need compile + init headroom; the budget
     # still fits tpu_watch's BENCH_TIMEOUT with parent startup + report.

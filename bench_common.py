@@ -15,13 +15,15 @@ import sys
 
 
 def spawn_json_child(script: str, env_key: str, name: str, timeout_s: int,
-                     match_key: str):
+                     match_key: str, env_extra=None):
     """Run ``python script`` with ``env[env_key] = name``; return
     ``(obj, err)`` where ``obj`` is the last stdout line that parses to a
     dict carrying ``obj[match_key] == name`` (else None + a diagnostic
     string with the child's stderr tail)."""
     env = dict(os.environ)
     env[env_key] = name
+    if env_extra:
+        env.update(env_extra)
     try:
         r = subprocess.run([sys.executable, script], capture_output=True,
                            text=True, timeout=int(timeout_s), env=env,
