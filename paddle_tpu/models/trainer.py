@@ -114,7 +114,7 @@ def create_train_step(model, optimizer, loss_fn=None, donate=False):
 
 
 def create_multistep_train_step(model, optimizer, loss_fn=None,
-                                donate=False, steps=8):
+                                donate=False, steps=8, accumulate=1):
     """``steps`` optimizer steps inside ONE jitted program via
     ``lax.scan`` — the production-JAX training-loop shape: the host
     dispatches once per K steps, so per-execute dispatch cost (remote
@@ -126,17 +126,54 @@ def create_multistep_train_step(model, optimizer, loss_fn=None,
     batches ``ids, labels: [K, B, S]`` and returns
     ``(losses[K], params, opt_state)``. Per-step RNG is
     ``fold_in(key, i)``, matching ``create_train_step`` semantics for
-    the same fold sequence. ``donate`` as in ``create_train_step``."""
+    the same fold sequence. ``donate`` as in ``create_train_step``.
+
+    ``accumulate=M`` > 1 turns each scan step into M gradient-
+    accumulation microbatches (inputs stacked to [K, M, B, S]): grads
+    sum in f32 and average before one optimizer apply — the functional
+    analog of the fleet stack's ``accumulate_steps``, for effective
+    batches that don't fit HBM in one forward. Per-microbatch RNG is
+    ``fold_in(key, i * M + j)``; the returned per-step loss is the
+    microbatch mean."""
     _loss_call, trainable0, opt_state0, wd_mask = _functional_pieces(
         model, optimizer, loss_fn)
 
     def step_k(params, opt_state, key, ids, labels, lr):
+        if accumulate > 1 and ids.shape[1] != accumulate:
+            # the fori_loop index lowers to dynamic_slice, whose OOB
+            # clamping would silently repeat the last microbatch — catch
+            # the mis-stacked input at trace time instead
+            raise ValueError(
+                f"accumulate={accumulate} expects inputs stacked "
+                f"[steps, {accumulate}, batch, ...]; got microbatch dim "
+                f"{ids.shape[1]} in {tuple(ids.shape)}")
+
         def body(carry, xs):
             p, s = carry
             i, ids_i, labels_i = xs
-            loss, grads = jax.value_and_grad(
-                lambda q: _loss_call(q, ids_i, labels_i,
-                                     jax.random.fold_in(key, i)))(p)
+            if accumulate == 1:
+                loss, grads = jax.value_and_grad(
+                    lambda q: _loss_call(q, ids_i, labels_i,
+                                         jax.random.fold_in(key, i)))(p)
+            else:
+                def micro(j, acc):
+                    gsum, lsum = acc
+                    lj, gj = jax.value_and_grad(
+                        lambda q: _loss_call(
+                            q, ids_i[j], labels_i[j],
+                            jax.random.fold_in(key, i * accumulate + j))
+                    )(p)
+                    gsum = jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(jnp.float32), gsum, gj)
+                    return gsum, lsum + lj
+                zeros = jax.tree_util.tree_map(
+                    lambda v: jnp.zeros(v.shape, jnp.float32), p)
+                gsum, lsum = jax.lax.fori_loop(
+                    0, accumulate, micro,
+                    (zeros, jnp.zeros((), jnp.float32)))
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / accumulate, gsum)
+                loss = lsum / accumulate
             p, s = optimizer.apply_gradients(p, grads, s, lr,
                                              wd_mask=wd_mask)
             return (p, s), loss
@@ -154,7 +191,7 @@ def create_multistep_train_step(model, optimizer, loss_fn=None,
 
 def create_sharded_train_step(model, optimizer, mesh, param_spec_fn,
                               data_axis: str = "dp", loss_fn=None,
-                              donate=False, steps=None):
+                              donate=False, steps=None, accumulate=1):
     """Hybrid-parallel variant: params/opt-state laid out by
     ``param_spec_fn(name) -> PartitionSpec`` over ``mesh``; batch sharded
     over ``data_axis``. Returns (step, params, opt_state, shard_batch).
@@ -162,13 +199,19 @@ def create_sharded_train_step(model, optimizer, mesh, param_spec_fn,
     create_train_step) — treat the passed-in trees as consumed.
     ``steps=K`` wraps the scan-of-K trainer instead (ids/labels stacked
     to [K, B, ...]; ``shard_batch`` then shards dim 1, the per-step
-    batch, over ``data_axis``)."""
+    batch, over ``data_axis``). ``accumulate=M`` composes with steps
+    (inputs [K, M, B, ...]; the batch moves to dim 2 and shard_batch
+    follows it)."""
     from jax.sharding import NamedSharding, PartitionSpec
 
     if steps:
         step, params, opt_state = create_multistep_train_step(
-            model, optimizer, loss_fn, donate=donate, steps=steps)
+            model, optimizer, loss_fn, donate=donate, steps=steps,
+            accumulate=accumulate)
     else:
+        if accumulate != 1:
+            raise ValueError("accumulate requires steps=K (the scan "
+                             "trainer owns the microbatch loop)")
         step, params, opt_state = create_train_step(
             model, optimizer, loss_fn, donate=donate)
 
@@ -189,12 +232,17 @@ def create_sharded_train_step(model, optimizer, mesh, param_spec_fn,
         # batch dim over the data axis, rest replicated — spec trimmed to
         # the array's rank (labels are often rank-1). With steps=K the
         # leading dim is the scan axis and the per-step batch is dim 1;
-        # a rank-1 [K] array (scalar per step) has no batch dim to shard
-        # and stays replicated over the scan axis.
+        # with accumulate=M the microbatch axis sits at dim 1 and the
+        # batch moves to dim 2. Arrays too small to carry a batch dim
+        # (per-step scalars/vectors) stay replicated.
         if steps:
-            spec = (PartitionSpec(None) if arr.ndim == 1 else
-                    PartitionSpec(None, data_axis,
-                                  *([None] * (arr.ndim - 2))))
+            batch_dim = 2 if accumulate > 1 else 1
+            if arr.ndim <= batch_dim:
+                spec = PartitionSpec(*([None] * arr.ndim))
+            else:
+                spec = PartitionSpec(
+                    *([None] * batch_dim), data_axis,
+                    *([None] * (arr.ndim - batch_dim - 1)))
         else:
             spec = PartitionSpec(data_axis, *([None] * (arr.ndim - 1)))
         return jax.device_put(arr, NamedSharding(mesh, spec))

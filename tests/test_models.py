@@ -347,3 +347,142 @@ def test_sharded_multistep_scan_matches_plain_multistep():
     np.testing.assert_allclose(np.asarray(losses_sh),
                                np.asarray(losses_plain),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_multistep_scan_matches_loop_with_dropout():
+    """With dropout active the per-step RNG must still line up: scan's
+    fold_in(key, traced_i) has to draw the same masks as the eager
+    loop's fold_in(key, i)."""
+    import dataclasses
+
+    from paddle_tpu.models import GPTConfig, create_multistep_train_step
+
+    cfg = dataclasses.replace(gpt2_tiny(), dropout=0.3)
+    K = 3
+    data = RNG.randint(0, 256, (2, 9))
+    key = jax.random.key(21)
+
+    def build():
+        paddle.seed(17)
+        m = GPTForCausalLM(cfg)
+        m.train()   # dropout active
+        opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+        return m, opt
+
+    m1, opt1 = build()
+    step, p, s = create_train_step(m1, opt1)
+    losses = []
+    for i in range(K):
+        loss, p, s = step(p, s, jax.random.fold_in(key, i),
+                          data[:, :-1], data[:, 1:], 5e-3)
+        losses.append(float(loss))
+
+    m2, opt2 = build()
+    step_k, pk, sk = create_multistep_train_step(m2, opt2, steps=K)
+    xs = jnp.tile(jnp.asarray(data[:, :-1])[None], (K, 1, 1))
+    ys = jnp.tile(jnp.asarray(data[:, 1:])[None], (K, 1, 1))
+    losses_k, pk, sk = step_k(pk, sk, key, xs, ys, 5e-3)
+    np.testing.assert_allclose(np.asarray(losses_k), np.asarray(losses),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_multistep_accumulation_matches_concat_batch():
+    """accumulate=M: mean-of-microbatch-grads must equal the grad of the
+    concatenated batch (token-mean CE with equal microbatch shapes), so
+    per-step losses and final params match the no-accumulation trainer
+    fed the [M*B] batch."""
+    from paddle_tpu.models import create_multistep_train_step
+
+    K, M = 2, 2
+    cfg = gpt2_tiny()
+    data = RNG.randint(0, 256, (4, 9))   # two microbatches of 2
+    key = jax.random.key(8)
+
+    def build():
+        paddle.seed(23)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        # SGD: the update is linear in the gradient, so mean-of-microbatch
+        # grads vs concat-batch grad stays within f32 rounding (Adam's
+        # rsqrt amplifies reduction-order noise ~20x at early steps)
+        opt = paddle.optimizer.SGD(0.05, parameters=m.parameters())
+        return m, opt
+
+    # concat path: one optimizer step per [4, 8] batch
+    m1, opt1 = build()
+    step_k, p, s = create_multistep_train_step(m1, opt1, steps=K)
+    xs = jnp.tile(jnp.asarray(data[:, :-1])[None], (K, 1, 1))
+    ys = jnp.tile(jnp.asarray(data[:, 1:])[None], (K, 1, 1))
+    losses_cat, p, s = step_k(p, s, key, xs, ys, 5e-3)
+
+    # accumulation path: same tokens split into M microbatches per step
+    m2, opt2 = build()
+    step_a, pa, sa = create_multistep_train_step(m2, opt2, steps=K,
+                                                 accumulate=M)
+    xm = jnp.asarray(data[:, :-1]).reshape(M, 2, 8)
+    ym = jnp.asarray(data[:, 1:]).reshape(M, 2, 8)
+    xsm = jnp.tile(xm[None], (K, 1, 1, 1))
+    ysm = jnp.tile(ym[None], (K, 1, 1, 1))
+    losses_acc, pa, sa = step_a(pa, sa, key, xsm, ysm, 5e-3)
+
+    np.testing.assert_allclose(np.asarray(losses_acc),
+                               np.asarray(losses_cat),
+                               rtol=1e-5, atol=1e-6)
+    for name in p:
+        np.testing.assert_allclose(np.asarray(pa[name]),
+                                   np.asarray(p[name]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 devices")
+def test_sharded_multistep_with_accumulation():
+    """steps=K + accumulate=M on the mesh: batch dim moves to dim 2 and
+    shard_batch follows it; losses match the unsharded accumulate run."""
+    from jax.sharding import Mesh
+
+    from paddle_tpu.models import create_multistep_train_step
+
+    K, M = 2, 2
+    cfg = llama_tiny()
+    data = RNG.randint(0, cfg.vocab_size, (4, 9))
+    key = jax.random.key(9)
+    xm = np.tile(data[:, :-1].reshape(M, 2, 8)[None], (K, 1, 1, 1))
+    ym = np.tile(data[:, 1:].reshape(M, 2, 8)[None], (K, 1, 1, 1))
+
+    paddle.seed(31)
+    m1 = LlamaForCausalLM(cfg)
+    m1.eval()
+    opt1 = paddle.optimizer.SGD(0.05, parameters=m1.parameters())
+    step_p, p, s = create_multistep_train_step(m1, opt1, steps=K,
+                                               accumulate=M)
+    losses_plain, p, s = step_p(p, s, key, jnp.asarray(xm),
+                                jnp.asarray(ym), 0.05)
+
+    paddle.seed(31)
+    m2 = LlamaForCausalLM(cfg)
+    m2.eval()
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "tp"))
+    opt2 = paddle.optimizer.SGD(0.05, parameters=m2.parameters())
+    step_sh, ps, ss, shard_batch = create_sharded_train_step(
+        m2, opt2, mesh, llama_param_spec, steps=K, accumulate=M)
+    xk, yk = shard_batch(xm), shard_batch(ym)
+    assert xk.sharding.spec[2] == "dp"
+    assert xk.sharding.spec[0] is None and xk.sharding.spec[1] is None
+    losses_sh, ps, ss = step_sh(ps, ss, key, xk, yk, 0.05)
+    np.testing.assert_allclose(np.asarray(losses_sh),
+                               np.asarray(losses_plain),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_multistep_accumulate_rejects_mis_stacked_input():
+    from paddle_tpu.models import create_multistep_train_step
+
+    paddle.seed(12)
+    m = GPTForCausalLM(gpt2_tiny())
+    m.eval()
+    opt = paddle.optimizer.SGD(0.05, parameters=m.parameters())
+    step_a, p, s = create_multistep_train_step(m, opt, steps=2,
+                                               accumulate=4)
+    bad = jnp.zeros((2, 2, 2, 8), jnp.int32)   # microbatch dim 2 != 4
+    with pytest.raises(ValueError, match="accumulate=4"):
+        step_a(p, s, jax.random.key(0), bad, bad, 0.05)
