@@ -108,7 +108,8 @@ def bench_llama(dev, on_tpu, zero3=False):
         # 5.5 GB AdamW state at 0.7B — on the ~7.5 GB grant that is what
         # lets b8/b16 fit. An OOM is recorded, never fatal.
         cands = ((4, False, False), (8, False, True),
-                 (16, False, True)) if not zero3 else ((4, False, False),)
+                 (16, False, True)) if not zero3 \
+            else ((4, False, False), (8, False, True))
     else:
         cfg = LlamaConfig(vocab_size=256, hidden_size=64,
                           intermediate_size=128, num_layers=2, num_heads=4,
