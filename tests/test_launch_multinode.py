@@ -48,18 +48,18 @@ assert os.environ["PADDLE_NNODES"] == "2"
 assert int(os.environ["PADDLE_LOCAL_RANK"]) == rank % 2
 
 host, port = os.environ["PADDLE_MASTER"].rsplit(":", 1)
-store = TCPStore(host, int(port), world_size=world, timeout=240)
+store = TCPStore(host, int(port), world_size=world, timeout=600)
 
 # broadcast: rank 0 publishes, everyone blocks until visible
 if rank == 0:
     store.set("bcast/meta", "job=%s world=%d" %
               (os.environ["PADDLE_JOB_ID"], world))
-store.wait("bcast/meta", timeout=240)
+store.wait("bcast/meta", timeout=600)
 bcast = store.get("bcast/meta").decode()
 
 # KV all-gather + 4-way barrier spanning both pods
 store.set("ag/%d" % rank, str(rank * 10))
-store.barrier("work", timeout=300)
+store.barrier("work", timeout=600)
 vals = [int(store.get("ag/%d" % r).decode()) for r in range(world)]
 assert vals == [r * 10 for r in range(world)], vals
 
@@ -68,7 +68,7 @@ with open(os.path.join(marker_dir, "done_%d" % rank), "w") as f:
 
 # no store traffic after this barrier: pod 0 may exit (and take the
 # master server with it) the moment its own ranks return
-store.barrier("exit", timeout=300)
+store.barrier("exit", timeout=600)
 """
 
 
@@ -103,8 +103,8 @@ def _run_job(tmp_path, pod1_env=None, max_restart=0):
     pod1 = _launch_pod(1, master, script, tmp_path, extra_env=pod1_env,
                        max_restart=max_restart)
     try:
-        out0, _ = pod0.communicate(timeout=420)
-        out1, _ = pod1.communicate(timeout=420)
+        out0, _ = pod0.communicate(timeout=900)
+        out1, _ = pod1.communicate(timeout=900)
     finally:
         for p in (pod0, pod1):
             if p.poll() is None:
