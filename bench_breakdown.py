@@ -209,8 +209,13 @@ def main():
             raise RuntimeError("matmul ceiling probe is TPU-only "
                                "(1.4e14 FLOPs: minutes of CPU wall time)")
         n, links = 8192, 32
-        a = jnp.ones((n, n), jnp.bfloat16)
-        bmat = jnp.ones((n, n), jnp.bfloat16)
+        # magnitude-preserving chain: with all-(1/n) operands every link
+        # maps a constant-(1/n) matrix to itself (row dot = n * 1/n * 1/n
+        # = 1/n, exact in bf16 — powers of two), so link 10 no longer
+        # overflows to inf the way the all-ones chain did (values n^k)
+        # and the synchronizing f32 sum stays finite at n^2 * 1/n = n
+        a = jnp.full((n, n), 1.0 / n, jnp.bfloat16)
+        bmat = jnp.full((n, n), 1.0 / n, jnp.bfloat16)
 
         @jax.jit
         def mm_chain(a, b):

@@ -405,8 +405,70 @@ def bench_resnet50(dev, on_tpu):
             "loss_dropping": bool(loss_end < loss0)}
 
 
+def bench_serving(dev, on_tpu):
+    """paddle_tpu.serving throughput: requests/sec and p50/p99 latency at
+    max_batch_size 1/8/32 on the tiny llama, mixed 64-token requests from
+    8 concurrent client threads. The trajectory later PRs improve: rps
+    should scale with batch size until the executor saturates, with
+    compile_count pinned at 1 per configuration (bucketed cache)."""
+    import threading
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import StaticFunction
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import Server
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny())
+    model.eval()
+    sf = StaticFunction(model)
+    seq = 64
+    n_requests = 256 if on_tpu else 96
+    n_clients = 8
+    rng = np.random.RandomState(0)
+    examples = [rng.randint(0, 250, (seq,)).astype(np.int64)
+                for _ in range(n_requests)]
+
+    entry = {"seq": seq, "n_requests": n_requests,
+             "n_clients": n_clients, "configs": {}}
+    for mbs in (1, 8, 32):
+        srv = Server(sf, max_batch_size=mbs, batch_buckets=[mbs],
+                     seq_buckets=[seq], batch_timeout_ms=1.0,
+                     max_queue_size=n_requests + n_clients)
+        try:
+            srv.warmup(examples[0])
+            futs = [None] * n_requests
+
+            def client(c):
+                for i in range(c, n_requests, n_clients):
+                    futs[i] = srv.submit(examples[i])
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in range(n_clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for f in futs:
+                f.result(timeout=300)
+            wall = time.perf_counter() - t0
+            st = srv.stats()
+            entry["configs"][f"b{mbs}"] = {
+                "requests_per_sec": round(n_requests / wall, 1),
+                "p50_latency_ms": round(st["latency_ms"]["p50"], 2),
+                "p99_latency_ms": round(st["latency_ms"]["p99"], 2),
+                "mean_batch": round(st["batch_size"]["mean"], 2),
+                "batches": st["batches"],
+                "compiles": st["compile_count"],
+                "pad_waste": round(st["pad_waste"]["mean"], 3)}
+        finally:
+            srv.shutdown()
+    return entry
+
+
 CONFIG_NAMES = ("llama_tp_chip", "llama_zero3_layout", "bert_1f1b",
-                "resnet50")
+                "resnet50", "serving_throughput")
 
 
 def _run_config(name, dev, on_tpu):
@@ -415,6 +477,7 @@ def _run_config(name, dev, on_tpu):
         "llama_zero3_layout": lambda: bench_llama(dev, on_tpu, zero3=True),
         "bert_1f1b": lambda: bench_bert_1f1b(on_tpu),
         "resnet50": lambda: bench_resnet50(dev, on_tpu),
+        "serving_throughput": lambda: bench_serving(dev, on_tpu),
     }
     return fns[name]()
 

@@ -41,6 +41,7 @@ from . import sparse  # noqa: F401
 from . import audio  # noqa: F401
 from . import text  # noqa: F401
 from . import inference  # noqa: F401
+from . import serving  # noqa: F401
 from . import utils  # noqa: F401
 from . import quantization  # noqa: F401
 from . import incubate  # noqa: F401

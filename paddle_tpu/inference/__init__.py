@@ -83,6 +83,7 @@ class Config:
         self._precision = PrecisionType.Float32
         self._memory_pool_mb = None
         self._pass_builder = PassStrategy(_DEFAULT_PASSES)
+        self._serving_opts = None
 
     def set_prog_file(self, path: str):
         self.model_path = path[:-len(".pdmodel")] \
@@ -127,6 +128,22 @@ class Config:
         raise NotImplementedError(
             "TensorRT has no TPU analog; XLA compiles the exported "
             "StableHLO directly")
+
+    def enable_serving(self, batch_timeout_ms: float = 2.0,
+                       max_queue_size: int = 128,
+                       default_deadline_ms: Optional[float] = None):
+        """Attach a dynamic-batching server (paddle_tpu.serving) to the
+        predictor: ``Predictor.submit()`` then coalesces concurrent
+        single-example requests up to the exported program's batch dim,
+        with a bounded queue (ServerOverloaded shedding) and optional
+        per-request deadlines. The exported batch size is the one shape
+        bucket, so serving adds zero extra XLA compiles."""
+        self._serving_opts = {
+            "batch_timeout_ms": batch_timeout_ms,
+            "max_queue_size": max_queue_size,
+            "default_deadline_ms": default_deadline_ms,
+        }
+        return self
 
 
 class _IOHandle:
@@ -179,6 +196,10 @@ class Predictor:
         self._inputs: Dict[str, np.ndarray] = {}
         self._outputs: Dict[str, np.ndarray] = {}
         self._output_names: List[str] = []
+        self._server = None   # built lazily on first submit()
+        self._serving_final = None   # last shutdown's metrics snapshot
+        import threading
+        self._server_lock = threading.Lock()
 
     def get_input_names(self) -> List[str]:
         return list(self._input_names)
@@ -219,6 +240,57 @@ class Predictor:
         if inputs is not None:
             return [self._outputs[n] for n in self._output_names]
         return True
+
+    # -- serving path (config.enable_serving()) ---------------------------
+    def _serving_server(self):
+        if self._config._serving_opts is None:
+            raise RuntimeError(
+                "serving is not enabled: call config.enable_serving() "
+                "before create_predictor")
+        with self._server_lock:   # first submits race in from N clients
+            if self._server is None:
+                from ..serving import Server
+                self._server = Server(self._layer, name=None,
+                                      **self._config._serving_opts)
+            return self._server
+
+    def submit(self, inputs: List[np.ndarray],
+               deadline_ms: Optional[float] = None):
+        """Dynamic-batching entry: each element of ``inputs`` is ONE
+        example WITHOUT the batch dim (the exported program's leading
+        dim); concurrent submits coalesce into one padded execute.
+        Returns a serving Future; ``.result(timeout)`` yields the
+        per-request output rows."""
+        srv = self._serving_server()
+        return srv.submit(*inputs, deadline_ms=deadline_ms)
+
+    def serving_stats(self) -> dict:
+        """Metrics snapshot of the attached server (also via
+        ``paddle_tpu.profiler.serving_stats()``). Read-only: never
+        constructs a server — after shutdown_serving() it returns the
+        final snapshot; before any submit() it raises."""
+        with self._server_lock:
+            if self._server is not None:
+                return self._server.stats()
+            if self._serving_final is not None:
+                return self._serving_final
+        raise RuntimeError(
+            "no serving activity yet: serving_stats() is available after "
+            "the first submit() (and returns the final snapshot after "
+            "shutdown_serving())")
+
+    def shutdown_serving(self, drain: bool = True) -> Optional[dict]:
+        """Stop the attached server (draining queued work by default).
+        Returns the final metrics snapshot, or None if serving was never
+        used. A later submit() starts a fresh server."""
+        with self._server_lock:   # racing shutdowns/readers: one winner
+            server, self._server = self._server, None
+        if server is None:
+            return self._serving_final
+        server.shutdown(drain=drain)
+        with self._server_lock:
+            self._serving_final = server.stats()
+            return self._serving_final
 
 
 def create_predictor(config: Config) -> Predictor:

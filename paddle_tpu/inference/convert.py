@@ -87,16 +87,22 @@ def convert_to_mixed_precision(model_file: str, params_file: str,
     low_state = {k: to_low(k, v) for k, v in state.items()}
     orig_dtypes = {k: v.dtype for k, v in state.items()}
 
-    def wrapped(low_params, key, *args):
+    # the re-export takes the key as raw uint32 bits (typed key dtypes
+    # don't serialize — see jit.save); a pre-raw-format source program
+    # still wants a typed key, so re-wrap at the boundary for those
+    src_raw = meta.get("key_format") == "raw_uint32"
+
+    def wrapped(low_params, raw_key, *args):
         full = {k: (v.astype(orig_dtypes[k])
                     if v.dtype != orig_dtypes[k] else v)
                 for k, v in low_params.items()}
+        key = raw_key if src_raw else jax.random.wrap_key_data(raw_key)
         return exported.call(full, key, *args)
 
     low_sds = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                for k, v in low_state.items()}
-    key0 = jax.random.key(0)
-    key_sds = jax.ShapeDtypeStruct(key0.shape, key0.dtype)
+    raw0 = jax.random.key_data(jax.random.key(0))
+    key_sds = jax.ShapeDtypeStruct(raw0.shape, raw0.dtype)
     in_sds = [jax.ShapeDtypeStruct(tuple(m["shape"]), np.dtype(m["dtype"]))
               for m in meta.get("inputs", [])]
     if not in_sds:
@@ -126,5 +132,6 @@ def convert_to_mixed_precision(model_file: str, params_file: str,
     with open(dst + _META_SUFFIX, "w") as f:
         json.dump(dict(meta, mixed_precision=str(np.dtype(low)),
                        black_list=sorted(black),
-                       param_dtypes=param_dtypes), f)
+                       param_dtypes=param_dtypes,
+                       key_format="raw_uint32"), f)
     return dst

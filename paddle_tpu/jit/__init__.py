@@ -19,6 +19,7 @@ import os
 from typing import Optional, Sequence
 
 import jax
+import jax.export  # jax>=0.4.30 lazy submodule: save/load need it imported
 import jax.numpy as jnp
 import numpy as np
 
@@ -128,6 +129,43 @@ class StaticFunction:
             return tuple(Tensor(o, stop_gradient=True) for o in out)
         return Tensor(out, stop_gradient=True)
 
+    # -- AOT path (serving) ----------------------------------------------
+    def compile_for(self, *arg_specs):
+        """AOT-compile the no-grad fast path for ONE concrete input
+        signature and return the compiled executable: call it as
+        ``compiled(state, key, *arrays)`` with ``state = self._state()``
+        at call time (weight updates between calls are picked up; shapes/
+        dtypes must match the compiled signature).
+
+        This is the signature-reuse integration for ``paddle_tpu.serving``:
+        the server's executable cache holds one of these per shape bucket,
+        so the number of XLA compiles is exactly the bucket count, and the
+        same traced function backs both the live ``__call__`` cache and
+        the AOT executables.
+        """
+        sds = []
+        for s in arg_specs:
+            if isinstance(s, InputSpec):
+                sds.append(s.to_sds())
+            elif isinstance(s, jax.ShapeDtypeStruct):
+                sds.append(s)
+            else:
+                arr = _unwrap(s) if isinstance(s, Tensor) else np.asarray(s)
+                sds.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+        state = self._state()
+        state_sds = {k: jax.ShapeDtypeStruct(np.shape(v),
+                                             jnp.asarray(v).dtype)
+                     for k, v in state.items()}
+        key0 = jax.random.key(0)
+        key_sds = jax.ShapeDtypeStruct(key0.shape, key0.dtype)
+        return self._build().lower(state_sds, key_sds, *sds).compile()
+
+    def cache_size(self) -> int:
+        """Number of signatures traced by the live jit cache."""
+        if self._jitted is None:
+            return 0
+        return self._jitted._cache_size()
+
     # Layer-protocol passthrough so to_static(layer) drops into model code
     def __getattr__(self, name):
         target = object.__getattribute__(self, "_layer")
@@ -190,9 +228,18 @@ def save(layer, path, input_spec=None, **configs):
     state = sf._state()
     state_sds = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                  for k, v in state.items()}
-    key0 = jax.random.key(0)
-    key_sds = jax.ShapeDtypeStruct(key0.shape, key0.dtype)
-    exported = jax.export.export(sf._build())(state_sds, key_sds, *sds)
+    # export takes the RNG key as RAW uint32 bits, not a typed key array:
+    # typed key dtypes (key<fry>) are not serializable by jax.export, and
+    # raw bits keep the artifact loadable across jax versions
+    base = sf._build()
+
+    def _export_fn(st, raw_key, *arrays):
+        return base(st, jax.random.wrap_key_data(raw_key), *arrays)
+
+    raw0 = jax.random.key_data(jax.random.key(0))
+    key_sds = jax.ShapeDtypeStruct(raw0.shape, raw0.dtype)
+    exported = jax.export.export(jax.jit(_export_fn))(state_sds, key_sds,
+                                                      *sds)
 
     d = os.path.dirname(path)
     if d:
@@ -206,6 +253,7 @@ def save(layer, path, input_spec=None, **configs):
             "inputs": [{"shape": list(s.shape), "dtype": str(s.dtype)}
                        for s in sds],
             "state_keys": sorted(state.keys()),
+            "key_format": "raw_uint32",
         }, f)
 
 
@@ -229,8 +277,10 @@ class TranslatedLayer:
             # program's baked dtypes at the call boundary
             state = {k: (jnp.asarray(v).astype(orig[k]) if k in orig
                          else v) for k, v in state.items()}
-        out = self._exported.call(
-            state, _random.default_generator.next_key(), *arrays)
+        key = _random.default_generator.next_key()
+        if self._meta.get("key_format") == "raw_uint32":
+            key = jax.random.key_data(key)
+        out = self._exported.call(state, key, *arrays)
         if isinstance(out, (tuple, list)):
             return tuple(Tensor(o, stop_gradient=True) for o in out)
         return Tensor(out, stop_gradient=True)

@@ -139,6 +139,15 @@ def create_multistep_train_step(model, optimizer, loss_fn=None,
         model, optimizer, loss_fn)
 
     def step_k(params, opt_state, key, ids, labels, lr):
+        if ids.shape[0] != steps:
+            # scan would silently run ids.shape[0] optimizer steps, not
+            # the K the caller sized schedules/logging around — catch the
+            # mis-stacked input at trace time (mirrors the accumulate
+            # check below)
+            raise ValueError(
+                f"steps={steps} expects inputs stacked [{steps}, "
+                f"batch, ...]; got leading dim {ids.shape[0]} in "
+                f"{tuple(ids.shape)}")
         if accumulate > 1 and ids.shape[1] != accumulate:
             # the fori_loop index lowers to dynamic_slice, whose OOB
             # clamping would silently repeat the last microbatch — catch

@@ -359,8 +359,11 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 use_multi_tensor=False, amsgrad=False, moment_dtype=None,
-                 name=None):
+                 use_multi_tensor=False, amsgrad=False, name=None, *,
+                 moment_dtype=None):
+        # moment_dtype is keyword-only: it is this framework's extension,
+        # and inserting it positionally would shift ``name`` off its
+        # reference-API position
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
         self._beta1 = beta1
@@ -419,7 +422,7 @@ class AdamW(Adam):
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, amsgrad=False,
-                 moment_dtype=None, name=None):
+                 name=None, *, moment_dtype=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, lazy_mode, multi_precision,
                          amsgrad=amsgrad, moment_dtype=moment_dtype,

@@ -15,12 +15,14 @@ import json
 import os
 import threading
 import time
+import weakref
 from enum import Enum
 from typing import Callable, Iterable, List, Optional
 
 __all__ = ["ProfilerState", "ProfilerTarget", "make_scheduler",
            "export_chrome_tracing", "RecordEvent", "Profiler",
-           "load_profiler_result", "SummaryView"]
+           "load_profiler_result", "SummaryView", "serving_stats",
+           "register_serving_source", "unregister_serving_source"]
 
 
 class ProfilerState(Enum):
@@ -311,6 +313,57 @@ class Profiler:
     @property
     def events(self):
         return list(self._all_events)
+
+
+# -- serving observability ---------------------------------------------------
+# paddle_tpu.serving registers each live Server's metrics here so serving
+# counters and latency histograms are retrievable through the profiler API
+# (the framework's one observability surface) without holding servers alive:
+# entries are weak references, pruned on read.
+_serving_sources: "dict[str, weakref.ref]" = {}
+_serving_lock = threading.Lock()
+
+
+def register_serving_source(name: str, metrics) -> None:
+    """Register a serving metrics source (an object with .snapshot()).
+    Called by serving.Server on construction."""
+    with _serving_lock:
+        _serving_sources[name] = weakref.ref(metrics)
+
+
+def unregister_serving_source(name: str, metrics=None) -> None:
+    """Remove a source. When ``metrics`` is given, only remove if the
+    registry still points at THAT object — a later server that reused the
+    name must not lose its metrics to the older server's shutdown."""
+    with _serving_lock:
+        ref = _serving_sources.get(name)
+        if ref is None:
+            return
+        if metrics is not None and ref() is not None \
+                and ref() is not metrics:
+            return
+        del _serving_sources[name]
+
+
+def serving_stats(name: Optional[str] = None):
+    """Snapshot of serving metrics: queue depth, batch-size histogram,
+    compile count, queue-wait/latency p50/p99 — per registered server.
+
+    Returns ``{server_name: snapshot_dict}``, or one snapshot when
+    ``name`` is given (KeyError when that server is gone)."""
+    with _serving_lock:
+        live = {}
+        for n, ref in list(_serving_sources.items()):
+            m = ref()
+            if m is None:
+                del _serving_sources[n]
+            else:
+                live[n] = m
+    if name is not None:
+        if name not in live:
+            raise KeyError(f"no live serving source named {name!r}")
+        return live[name].snapshot()
+    return {n: m.snapshot() for n, m in live.items()}
 
 
 class SummaryView(Enum):

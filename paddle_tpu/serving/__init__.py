@@ -1,0 +1,33 @@
+"""paddle_tpu.serving — in-process dynamic-batching inference server.
+
+The reference framework deploys through AnalysisPredictor behind Paddle
+Serving; the TPU-native analog keeps XLA as the engine and closes the
+throughput gap in-process: a thread-safe request queue with per-request
+deadlines, a micro-batcher that coalesces requests into bucketed padded
+shapes (bounded executable count), an LRU executable cache over AOT
+compiles, and backpressure (bounded queue + ServerOverloaded shedding +
+graceful drain). Metrics surface through ``paddle_tpu.profiler``
+(``profiler.serving_stats()``).
+
+Quick start::
+
+    import paddle_tpu as paddle
+    from paddle_tpu import serving
+
+    layer = paddle.jit.load("exported/model")        # or an eval Layer
+    with serving.Server(layer) as srv:
+        fut = srv.submit(ids)                        # ONE example
+        logits = fut.result(timeout=5.0)
+
+See also ``inference.Config.enable_serving()`` for the predictor-side
+entry point.
+"""
+from .batcher import Future, Request, RequestQueue  # noqa: F401
+from .bucketing import next_bucket, pow2_buckets  # noqa: F401
+from .metrics import Histogram, ServingMetrics  # noqa: F401
+from .server import (DeadlineExceeded, Server, ServerClosed,  # noqa: F401
+                     ServerOverloaded, ServingError)
+
+__all__ = ["Server", "ServingError", "ServerOverloaded", "DeadlineExceeded",
+           "ServerClosed", "Future", "ServingMetrics", "Histogram",
+           "pow2_buckets", "next_bucket"]

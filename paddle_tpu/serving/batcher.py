@@ -1,0 +1,192 @@
+"""Request queue + micro-batcher for the serving subsystem.
+
+Admission control lives here: the queue is bounded (submit past the bound
+raises ServerOverloaded — load shedding, never an unbounded backlog or a
+silent hang), every request can carry an absolute deadline (expired
+requests are dropped at batch-formation time with DeadlineExceeded), and
+close() flips the queue to reject-new while the worker drains.
+
+Batch formation groups requests by compiled signature (the bucketed
+example shapes + dtypes): the worker takes the signature whose head
+request is oldest, collects up to ``max_batch`` requests of that
+signature, and waits at most ``timeout_s`` for stragglers — requests for
+other signatures keep queuing meanwhile. One signature per executable
+dispatch is what lets the executable cache stay small and hot.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["ServingError", "ServerOverloaded", "DeadlineExceeded",
+           "ServerClosed", "Future", "Request", "RequestQueue"]
+
+
+class ServingError(RuntimeError):
+    """Base class for serving-path failures."""
+
+
+class ServerOverloaded(ServingError):
+    """Typed rejection: the bounded request queue is full. Callers should
+    back off and retry; the server sheds load instead of queueing
+    unboundedly."""
+
+
+class DeadlineExceeded(ServingError, TimeoutError):
+    """The request's deadline passed before a result was produced."""
+
+
+class ServerClosed(ServingError):
+    """submit() after shutdown began (or the request was aborted by a
+    non-draining shutdown)."""
+
+
+class Future:
+    """Minimal thread-safe result slot (concurrent.futures-shaped)."""
+
+    __slots__ = ("_event", "_result", "_exc")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._exc: Optional[BaseException] = None
+
+    def set_result(self, value):
+        self._result = value
+        self._event.set()
+
+    def set_exception(self, exc: BaseException):
+        self._exc = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise DeadlineExceeded(
+                f"no result within {timeout}s (request still queued or "
+                "executing)")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def exception(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise DeadlineExceeded(f"no result within {timeout}s")
+        return self._exc
+
+
+_seq = itertools.count()
+
+
+class Request:
+    """One queued inference request: per-example input arrays plus the
+    bucketed signature they will execute under."""
+
+    __slots__ = ("args", "key", "future", "deadline", "t_submit", "seq",
+                 "real_len", "padded_len")
+
+    def __init__(self, args, key, deadline: Optional[float]):
+        self.args = args                  # tuple of np arrays, ONE example
+        self.key = key                    # ((shape, dtype), ...) signature
+        self.future = Future()
+        self.deadline = deadline          # absolute monotonic time or None
+        self.t_submit = time.monotonic()
+        self.seq = next(_seq)
+        # axis-0 length of arg0 before/after sequence bucketing (output
+        # unpadding needs both)
+        self.real_len = None
+        self.padded_len = None
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (now if now is not None else time.monotonic())
+                > self.deadline)
+
+
+class RequestQueue:
+    """Bounded multi-signature FIFO with coalescing pop."""
+
+    def __init__(self, max_depth: int):
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        self.max_depth = max_depth
+        self._cond = threading.Condition()
+        self._by_key: Dict[tuple, deque] = {}
+        self._depth = 0
+        self._closed = False
+
+    def qsize(self) -> int:
+        with self._cond:
+            return self._depth
+
+    def put(self, req: Request):
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("server is shutting down")
+            if self._depth >= self.max_depth:
+                raise ServerOverloaded(
+                    f"request queue full ({self._depth}/{self.max_depth}); "
+                    "retry with backoff")
+            self._by_key.setdefault(req.key, deque()).append(req)
+            self._depth += 1
+            self._cond.notify_all()
+
+    def close(self):
+        """Stop admitting; queued requests stay for the drain."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def flush(self) -> List[Request]:
+        """Remove and return everything still queued (abort path)."""
+        with self._cond:
+            out = [r for q in self._by_key.values() for r in q]
+            self._by_key.clear()
+            self._depth = 0
+            return out
+
+    def _oldest_key(self):
+        best_key, best_seq = None, None
+        for k, q in self._by_key.items():
+            if q and (best_seq is None or q[0].seq < best_seq):
+                best_key, best_seq = k, q[0].seq
+        return best_key
+
+    def next_batch(self, max_batch: int, timeout_s: float,
+                   stop: threading.Event, poll_s: float = 0.05
+                   ) -> Tuple[Optional[List[Request]], List[Request]]:
+        """Block until a request is available (or ``stop`` is set while
+        idle), then coalesce same-signature requests: return up to
+        ``max_batch`` of them, waiting at most ``timeout_s`` for the batch
+        to fill. Returns (batch, expired); batch is None when idle and
+        stopping."""
+        with self._cond:
+            while self._depth == 0:
+                if stop.is_set():
+                    return None, []
+                self._cond.wait(poll_s)
+            key = self._oldest_key()
+            batch: List[Request] = []
+            expired: List[Request] = []
+            t_end = time.monotonic() + max(0.0, timeout_s)
+            while True:
+                q = self._by_key.get(key)
+                now = time.monotonic()
+                while q and len(batch) < max_batch:
+                    r = q.popleft()
+                    self._depth -= 1
+                    (expired if r.expired(now) else batch).append(r)
+                if q is not None and not q:
+                    del self._by_key[key]
+                if len(batch) >= max_batch or stop.is_set():
+                    break
+                remaining = t_end - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(min(remaining, poll_s))
+            return batch, expired

@@ -247,6 +247,23 @@ def test_multistep_scan_matches_single_step_loop():
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_multistep_rejects_mismatched_steps_stack():
+    """ISSUE 2 satellite: steps=K with inputs stacked [K', B, S] must fail
+    at trace time instead of silently scanning K' optimizer steps."""
+    from paddle_tpu.models import create_multistep_train_step
+
+    paddle.seed(5)
+    m = GPTForCausalLM(gpt2_tiny())
+    m.eval()
+    opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+    step_k, p, s = create_multistep_train_step(m, opt, steps=4)
+    data = RNG.randint(0, 256, (3, 2, 9))   # 3 != steps=4
+    xs = jnp.asarray(data[:, :, :-1])
+    ys = jnp.asarray(data[:, :, 1:])
+    with pytest.raises(ValueError, match="steps=4"):
+        step_k(p, s, jax.random.key(0), xs, ys, 5e-3)
+
+
 def test_multistep_scan_donate_consume():
     from paddle_tpu.models import create_multistep_train_step
 

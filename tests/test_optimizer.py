@@ -56,6 +56,36 @@ def test_adamw_decoupled_decay():
     np.testing.assert_allclose(w.numpy(), [1.0 * (1 - 0.05)], rtol=1e-5)
 
 
+def test_adam_name_positional_moment_dtype_kw_only():
+    """Regression (ISSUE 2 satellite): moment_dtype was inserted
+    positionally before ``name``, shifting the reference positional
+    signature — a caller passing name positionally silently got a string
+    as the moment STORAGE dtype. Now moment_dtype is keyword-only."""
+    import jax.numpy as jnp
+
+    w = paddle.nn.Parameter(np.array([1.0], np.float32))
+    # reference positional order: ..., use_multi_tensor, amsgrad, name
+    opt = paddle.optimizer.Adam(0.1, 0.9, 0.999, 1e-8, [w], None, None,
+                                False, False, False, False, "my_adam")
+    assert opt._moment_dtype == jnp.float32   # name did NOT land here
+    w.grad = paddle.to_tensor([0.5])
+    opt.step()                                # states build in f32
+
+    w2 = paddle.nn.Parameter(np.array([1.0], np.float32))
+    opt2 = paddle.optimizer.AdamW(0.1, 0.9, 0.999, 1e-8, [w2], 0.01,
+                                  None, None, None, False, False, False,
+                                  "my_adamw")
+    assert opt2._moment_dtype == jnp.float32
+    with pytest.raises(TypeError):            # 13th positional: rejected
+        paddle.optimizer.Adam(0.1, 0.9, 0.999, 1e-8, [w], None, None,
+                              False, False, False, False, "nm",
+                              jnp.bfloat16)
+    # the documented spelling still works
+    opt3 = paddle.optimizer.Adam(0.1, parameters=[w],
+                                 moment_dtype=jnp.bfloat16)
+    assert opt3._moment_dtype == jnp.bfloat16
+
+
 def test_apply_decay_param_fun():
     w = paddle.nn.Parameter(np.array([1.0], np.float32), name="layer.bias")
     opt = paddle.optimizer.AdamW(

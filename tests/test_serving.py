@@ -1,0 +1,371 @@
+"""paddle_tpu.serving — dynamic batching, bucketed shapes, executable
+cache, backpressure (ISSUE 2 acceptance: >=64 concurrent mixed-shape
+requests with <=4 XLA compiles; batched outputs bitwise-match
+single-request Predictor.run; queue-full submits get ServerOverloaded)."""
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler, serving
+from paddle_tpu.jit import InputSpec, StaticFunction
+from paddle_tpu.serving import (DeadlineExceeded, Server, ServerClosed,
+                                ServerOverloaded)
+from paddle_tpu.serving.bucketing import next_bucket, pow2_buckets
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(0)
+
+
+def _mlp():
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.Tanh(), paddle.nn.Linear(16, 4))
+    net.eval()
+    return net
+
+
+def _submit_all(srv, examples, deadline_ms=None):
+    """Submit every example from its own thread (the concurrent-client
+    shape the batcher must coalesce); returns futures in order."""
+    futs = [None] * len(examples)
+    errs = []
+
+    def one(i):
+        try:
+            futs[i] = srv.submit(examples[i], deadline_ms=deadline_ms)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(len(examples))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    return futs
+
+
+class TestBucketing:
+    def test_pow2_buckets_include_max(self):
+        assert pow2_buckets(8) == [1, 2, 4, 8]
+        assert pow2_buckets(12) == [1, 2, 4, 8, 12]
+
+    def test_next_bucket(self):
+        assert next_bucket(3, [1, 2, 4, 8]) == 4
+        assert next_bucket(8, [1, 2, 4, 8]) == 8
+        assert next_bucket(9, [1, 2, 4, 8]) is None
+
+
+class TestCoalescingAndCorrectness:
+    def test_concurrent_submitters_coalesce_and_match_reference(self):
+        net = _mlp()
+        rng = np.random.RandomState(0)
+        examples = [rng.randn(8).astype(np.float32) for _ in range(32)]
+        sf = StaticFunction(net)
+        refs = [net(paddle.to_tensor(x[None])).numpy()[0]
+                for x in examples]
+        with Server(sf, max_batch_size=8, batch_timeout_ms=20,
+                    max_queue_size=64) as srv:
+            srv.warmup(examples[0])
+            futs = _submit_all(srv, examples)
+            outs = [f.result(timeout=30) for f in futs]
+            st = srv.stats()
+        for got, ref in zip(outs, refs):
+            np.testing.assert_allclose(got, ref, rtol=1e-6)
+        assert st["completed"] == 32
+        # coalescing actually happened: fewer dispatches than requests,
+        # and at least one batch had more than one request in it
+        assert st["batches"] < 32
+        assert st["batch_size"]["max"] > 1
+
+    def test_batch_padding_is_bitwise_vs_single_request(self):
+        net = _mlp()
+        sf = StaticFunction(net)
+        rng = np.random.RandomState(1)
+        x = rng.randn(8).astype(np.float32)
+        # unpadded reference at batch 1, straight through the jit path
+        ref = np.asarray(sf._build()(
+            sf._state(), jax.random.key(0), x[None]))[0]
+        with Server(sf, max_batch_size=8, batch_buckets=[8],
+                    batch_timeout_ms=1) as srv:
+            got = srv.run(x, timeout=30)   # padded 1 -> 8 inside
+            assert srv.stats()["batch_size"]["max"] == 1
+        np.testing.assert_array_equal(got, ref)
+
+
+class TestExecutableCache:
+    def test_mixed_shapes_64_requests_bounded_compiles(self):
+        """Acceptance: >=64 concurrent mixed-shape requests, <=4 distinct
+        XLA compiles, outputs equal the per-request references."""
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+        model = LlamaForCausalLM(llama_tiny())
+        model.eval()
+        sf = StaticFunction(model)
+        rng = np.random.RandomState(2)
+        # mixed lengths from both buckets' ranges; a small set of DISTINCT
+        # lengths keeps the per-request reference loop below to ~4 jit
+        # signatures so the test stays well inside the tier-1 budget
+        lens = rng.choice([4, 16, 17, 32], size=64)
+        examples = [rng.randint(0, 250, (int(s),)).astype(np.int64)
+                    for s in lens]
+        with Server(sf, max_batch_size=8, batch_buckets=[8],
+                    seq_buckets=[16, 32], batch_timeout_ms=10,
+                    max_queue_size=128) as srv:
+            # warmup compiles both buckets up front...
+            srv.warmup(examples[0][:16])
+            srv.warmup(np.resize(examples[0], 32).astype(np.int64))
+            futs = _submit_all(srv, examples)
+            outs = [f.result(timeout=120) for f in futs]
+            st = srv.stats()
+        # ...and the workload adds none: the cache absorbed every request
+        assert st["compile_count"] <= 4, st
+        assert st["completed"] == 64
+        assert st["cache_hits"] >= st["batches"] - st["compile_count"]
+        key0 = jax.random.key(0)
+        state = sf._state()
+        jitted = sf._build()
+        for x, got in zip(examples, outs):
+            assert got.shape == (len(x), 256)
+            ref = np.asarray(jitted(state, key0, x[None]))[0]
+            if len(x) in (16, 32):
+                # bucket-aligned: batch padding alone is bitwise
+                np.testing.assert_array_equal(got, ref)
+            else:
+                # sequence padding reassociates the attention softmax
+                # reductions — identical math, last-ulp noise only
+                np.testing.assert_allclose(got, ref, rtol=1e-4,
+                                           atol=1e-6)
+
+    def test_lru_eviction_bounds_cache(self):
+        net = _mlp()
+        with Server(StaticFunction(net), max_batch_size=1,
+                    batch_buckets=[1], batch_timeout_ms=1,
+                    executable_cache_size=2) as srv:
+            rng = np.random.RandomState(3)
+            for d in (2, 3, 4, 2, 3, 4):   # 3 signatures, cache of 2
+                srv.run(rng.randn(d, 8).astype(np.float32), timeout=30)
+            st = srv.stats()
+        # first pass compiles 3; the revisits re-compile (evicted) — the
+        # cache bound held and evictions were accounted
+        assert st["compile_count"] == 6
+        assert st["cache_evictions"] >= 4
+
+
+class TestPredictorServing:
+    def test_predictor_submit_bitwise_matches_single_run(self, tmp_path):
+        from paddle_tpu import jit
+        from paddle_tpu.inference import Config, create_predictor
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+        model = LlamaForCausalLM(llama_tiny())
+        model.eval()
+        served = str(tmp_path / "served")    # batch-4 artifact to serve
+        single = str(tmp_path / "single")    # batch-1 reference artifact
+        jit.save(model, served, input_spec=[InputSpec([4, 16], "int64")])
+        jit.save(model, single, input_spec=[InputSpec([1, 16], "int64")])
+
+        cfg = Config(served)
+        cfg.enable_serving(batch_timeout_ms=20, max_queue_size=64)
+        pred = create_predictor(cfg)
+        ref_pred = create_predictor(Config(single))
+
+        rng = np.random.RandomState(4)
+        examples = [rng.randint(0, 250, (16,)).astype(np.int64)
+                    for _ in range(12)]
+        futs = _submit_all_predictor(pred, examples)
+        outs = [f.result(timeout=60) for f in futs]
+        assert pred.serving_stats()["submitted"] == 12
+        st = pred.shutdown_serving()   # drains; returns final snapshot
+        # read-only after shutdown: the final snapshot, no resurrection
+        assert pred.serving_stats() is st and pred._server is None
+        # the exported batch-4 program is the single executable
+        assert st["compile_count"] == 1
+        assert st["completed"] == 12
+        for x, got in zip(examples, outs):
+            ref = ref_pred.run([x[None]])[0][0]
+            np.testing.assert_array_equal(got, ref)
+
+    def test_submit_without_enable_serving_raises(self, tmp_path):
+        from paddle_tpu import jit
+        from paddle_tpu.inference import Config, create_predictor
+
+        net = _mlp()
+        prefix = str(tmp_path / "m")
+        jit.save(net, prefix, input_spec=[InputSpec([2, 8], "float32")])
+        pred = create_predictor(Config(prefix))
+        with pytest.raises(RuntimeError, match="enable_serving"):
+            pred.submit([np.zeros(8, np.float32)])
+
+
+def _submit_all_predictor(pred, examples):
+    futs = [None] * len(examples)
+    errs = []
+
+    def one(i):
+        try:
+            futs[i] = pred.submit([examples[i]])
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(len(examples))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    return futs
+
+
+class _Gate:
+    """A callable 'model' whose first call parks until released — makes
+    queue-full and deadline scenarios deterministic."""
+
+    def __init__(self):
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def __call__(self, x):
+        self.entered.set()
+        assert self.release.wait(30), "gate never released"
+        return x * 2.0
+
+
+class TestBackpressure:
+    def test_queue_full_sheds_load_with_typed_error(self):
+        gate = _Gate()
+        srv = Server(gate, max_batch_size=1, batch_buckets=[1],
+                     batch_timeout_ms=1, max_queue_size=3)
+        try:
+            x = np.ones(4, np.float32)
+            first = srv.submit(x)            # worker picks this up, parks
+            assert gate.entered.wait(10)
+            backlog = [srv.submit(x) for _ in range(3)]   # fills the queue
+            with pytest.raises(ServerOverloaded):
+                srv.submit(x)                # bounded: rejected, no hang
+            assert srv.stats()["rejected_overload"] == 1
+            gate.release.set()
+            for f in [first] + backlog:
+                np.testing.assert_array_equal(f.result(timeout=30), x * 2.0)
+        finally:
+            gate.release.set()
+            srv.shutdown()
+
+    def test_deadline_expiry_returns_timeout_error(self):
+        gate = _Gate()
+        srv = Server(gate, max_batch_size=1, batch_buckets=[1],
+                     batch_timeout_ms=1, max_queue_size=8)
+        try:
+            x = np.ones(4, np.float32)
+            first = srv.submit(x)            # parks the worker
+            assert gate.entered.wait(10)
+            doomed = srv.submit(x, deadline_ms=20)
+            time.sleep(0.08)                 # deadline passes in-queue
+            gate.release.set()
+            np.testing.assert_array_equal(first.result(timeout=30), x * 2.0)
+            with pytest.raises(DeadlineExceeded):
+                doomed.result(timeout=30)
+            assert srv.stats()["expired"] == 1
+        finally:
+            gate.release.set()
+            srv.shutdown()
+
+    def test_future_result_timeout_is_typed(self):
+        gate = _Gate()
+        srv = Server(gate, max_batch_size=1, batch_buckets=[1],
+                     batch_timeout_ms=1, max_queue_size=8)
+        try:
+            fut = srv.submit(np.ones(2, np.float32))
+            with pytest.raises(DeadlineExceeded):
+                fut.result(timeout=0.05)     # still parked: typed timeout
+        finally:
+            gate.release.set()
+            srv.shutdown()
+
+
+class TestShutdown:
+    def test_drain_completes_queued_work(self):
+        net = _mlp()
+        rng = np.random.RandomState(5)
+        examples = [rng.randn(8).astype(np.float32) for _ in range(16)]
+        srv = Server(StaticFunction(net), max_batch_size=4,
+                     batch_timeout_ms=5, max_queue_size=64)
+        futs = _submit_all(srv, examples)
+        srv.shutdown(drain=True)             # completes everything queued
+        assert all(f.done() for f in futs)
+        for x, f in zip(examples, futs):
+            ref = net(paddle.to_tensor(x[None])).numpy()[0]
+            np.testing.assert_allclose(f.result(0), ref, rtol=1e-6)
+        with pytest.raises(ServerClosed):
+            srv.submit(examples[0])
+
+    def test_abort_fails_queued_requests(self):
+        gate = _Gate()
+        srv = Server(gate, max_batch_size=1, batch_buckets=[1],
+                     batch_timeout_ms=1, max_queue_size=8)
+        x = np.ones(4, np.float32)
+        first = srv.submit(x)
+        assert gate.entered.wait(10)
+        queued = [srv.submit(x) for _ in range(3)]
+        t = threading.Thread(target=srv.shutdown,
+                             kwargs={"drain": False})
+        t.start()
+        gate.release.set()
+        t.join(30)
+        assert not t.is_alive()
+        for f in queued:
+            assert isinstance(f.exception(timeout=10), ServerClosed)
+        np.testing.assert_array_equal(first.result(timeout=10), x * 2.0)
+
+    def test_shutdown_idempotent(self):
+        srv = Server(_mlp(), max_batch_size=2)
+        srv.shutdown()
+        srv.shutdown()
+
+
+class TestMetricsViaProfiler:
+    def test_serving_stats_exposes_counters_and_percentiles(self):
+        net = _mlp()
+        rng = np.random.RandomState(6)
+        examples = [rng.randn(8).astype(np.float32) for _ in range(16)]
+        with Server(StaticFunction(net), max_batch_size=4,
+                    batch_timeout_ms=5, name="metrics_probe") as srv:
+            futs = _submit_all(srv, examples)
+            [f.result(timeout=30) for f in futs]
+            srv.drain(timeout=30)   # counters settle after the last result
+            all_stats = profiler.serving_stats()
+            assert "metrics_probe" in all_stats
+            st = profiler.serving_stats("metrics_probe")
+            assert st == srv.stats() or st["completed"] == 16
+        assert st["submitted"] == 16 and st["completed"] == 16
+        assert st["compile_count"] >= 1
+        assert st["queue_depth"] == 0
+        # batch-size histogram + latency percentiles are live
+        assert st["batch_size"]["count"] == st["batches"] > 0
+        assert 1 <= st["batch_size"]["max"] <= 4
+        for hist in ("latency_ms", "queue_wait_ms"):
+            assert st[hist]["p50"] <= st[hist]["p99"] <= st[hist]["max"] \
+                or st[hist]["count"] == 0
+            assert st[hist]["count"] == 16
+        assert 0.0 <= st["pad_waste"]["mean"] <= 1.0
+        # a shut-down server unregisters from the profiler view
+        assert "metrics_probe" not in profiler.serving_stats()
+
+    def test_record_events_emitted_under_profiler(self):
+        net = _mlp()
+        x = np.zeros(8, np.float32)
+        with profiler.Profiler(targets=[profiler.ProfilerTarget.CPU]) as p:
+            with Server(StaticFunction(net), max_batch_size=2,
+                        batch_timeout_ms=1) as srv:
+                srv.run(x, timeout=30)
+            p.stop()
+        names = {e.name for e in p.events}
+        assert any(n.startswith("serving::execute") for n in names)
+        assert "serving::compile" in names
