@@ -197,9 +197,11 @@ class Predictor:
         self._outputs: Dict[str, np.ndarray] = {}
         self._output_names: List[str] = []
         self._server = None   # built lazily on first submit()
+        self._serving_draining = None   # mid-shutdown, stats still live
         self._serving_final = None   # last shutdown's metrics snapshot
         import threading
         self._server_lock = threading.Lock()
+        self._shutdown_lock = threading.Lock()   # serializes shutdowns
 
     def get_input_names(self) -> List[str]:
         return list(self._input_names)
@@ -270,8 +272,11 @@ class Predictor:
         constructs a server — after shutdown_serving() it returns the
         final snapshot; before any submit() it raises."""
         with self._server_lock:
-            if self._server is not None:
-                return self._server.stats()
+            # a server mid-shutdown still answers stats: monitoring must
+            # not see "no serving activity" during the drain window
+            srv = self._server or self._serving_draining
+            if srv is not None:
+                return srv.stats()
             if self._serving_final is not None:
                 return self._serving_final
         raise RuntimeError(
@@ -282,15 +287,21 @@ class Predictor:
     def shutdown_serving(self, drain: bool = True) -> Optional[dict]:
         """Stop the attached server (draining queued work by default).
         Returns the final metrics snapshot, or None if serving was never
-        used. A later submit() starts a fresh server."""
-        with self._server_lock:   # racing shutdowns/readers: one winner
-            server, self._server = self._server, None
-        if server is None:
-            return self._serving_final
-        server.shutdown(drain=drain)
-        with self._server_lock:
-            self._serving_final = server.stats()
-            return self._serving_final
+        used. A later submit() starts a fresh server. Racing shutdowns
+        serialize: the loser waits out the drain and gets the same final
+        snapshot instead of a stale/None one."""
+        with self._shutdown_lock:
+            with self._server_lock:
+                server, self._server = self._server, None
+                if server is not None:
+                    self._serving_draining = server
+            if server is None:
+                return self._serving_final
+            server.shutdown(drain=drain)
+            with self._server_lock:
+                self._serving_final = server.stats()
+                self._serving_draining = None
+                return self._serving_final
 
 
 def create_predictor(config: Config) -> Predictor:

@@ -24,7 +24,11 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "Subset", "random_split", "Sampler",
            "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
            "BatchSampler", "DistributedBatchSampler", "DataLoader",
-           "default_collate_fn", "get_worker_info"]
+           "default_collate_fn", "get_worker_info", "prefetch_to_device",
+           "DevicePrefetcher", "PipelineMetrics"]
+
+from .prefetch import (DevicePrefetcher, PipelineMetrics,  # noqa: E402,F401
+                       prefetch_to_device)
 
 
 class Dataset:

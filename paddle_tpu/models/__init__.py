@@ -5,7 +5,7 @@ from .llama import (LlamaConfig, LlamaForCausalLM, llama_7b, llama_13b,  # noqa:
                     llama_tiny, llama_param_spec, llama_fsdp_spec,
                     llama_pipeline_model)
 from .trainer import (create_multistep_train_step,  # noqa: F401
-                      create_sharded_train_step)
+                      create_sharded_train_step, place_by_spec, run_steps)
 from .bert import (BertConfig, BertModel, BertForPretraining,  # noqa: F401
                    BertForSequenceClassification, bert_base, bert_large,
                    bert_tiny, bert_pipeline_model, bert_param_spec)

@@ -17,16 +17,23 @@ from ..core.tensor import Tensor
 from ..nn.layer.layers import _swapped_state, functional_state
 
 __all__ = ["create_train_step", "create_multistep_train_step",
-           "create_sharded_train_step", "place_by_spec", "write_back"]
+           "create_sharded_train_step", "place_by_spec", "run_steps",
+           "write_back"]
 
 
-def place_by_spec(arr, spec, mesh):
+def place_by_spec(arr, spec, mesh, name=None):
     """device_put ``arr`` with ``spec`` over ``mesh``, replicating instead
-    when the spec doesn't divide the array evenly."""
+    when the spec doesn't divide the array evenly. The fallback is never
+    silent: each one is recorded (with a one-line reason) in
+    ``profiler.pipeline_stats()["placement_fallbacks"]`` and warned once
+    per call site's reason — a renamed/reshaped param that quietly
+    de-shards costs HBM and bandwidth, not correctness, so it only
+    surfaces through observability."""
     from jax.sharding import NamedSharding, PartitionSpec
 
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     ok = True
+    bad = None
     for i, s in enumerate(spec):
         if s is None:
             continue
@@ -34,7 +41,17 @@ def place_by_spec(arr, spec, mesh):
         size = int(np.prod([sizes[a] for a in axes]))
         if i >= arr.ndim or arr.shape[i] % size:
             ok = False
+            bad = (i, s, size)
     if not ok:
+        import warnings
+
+        from .. import profiler
+        i, s, size = bad
+        reason = (f"place_by_spec: {name or 'array'} shape "
+                  f"{tuple(arr.shape)} dim {i} does not divide by "
+                  f"{s!r}={size} — replicating (spec was {spec})")
+        profiler.record_placement_fallback(reason)
+        warnings.warn(reason, RuntimeWarning, stacklevel=2)
         spec = PartitionSpec()
     return jax.device_put(arr, NamedSharding(mesh, spec))
 
@@ -225,7 +242,7 @@ def create_sharded_train_step(model, optimizer, mesh, param_spec_fn,
             model, optimizer, loss_fn, donate=donate)
 
     def place(name, arr):
-        return place_by_spec(arr, param_spec_fn(name), mesh)
+        return place_by_spec(arr, param_spec_fn(name), mesh, name=name)
 
     params = {k: place(k, v) for k, v in params.items()}
     new_state = {}
@@ -263,9 +280,110 @@ def create_sharded_train_step(model, optimizer, mesh, param_spec_fn,
     return sharded_step, params, opt_state, shard_batch
 
 
-def write_back(model, params):
-    """Write functional params back into the stateful layer."""
+def run_steps(step, params, opt_state, feed, *, key=None, lr=1e-3,
+              log_every=0, on_log=None, name=None):
+    """Overlap-aware loop runner: drive ``step`` over every ``(ids,
+    labels)`` batch in ``feed`` WITHOUT ever blocking on the current
+    step's loss. JAX dispatch is async — the returned loss is a future —
+    so metrics are fetched one step behind: while the device runs step
+    ``i``, the host ``device_get``s step ``i-1``'s loss and pulls batch
+    ``i+1``. With ``feed`` wrapped in ``io.prefetch_to_device``, host
+    batch prep, H2D transfer, and device compute fully overlap.
+
+    ``step`` is a ``create_train_step``/``create_multistep_train_step``/
+    ``create_sharded_train_step`` product; per-step RNG is
+    ``fold_in(key, i)``, matching the synchronous loop those factories
+    document. ``lr`` is a float or a ``callable(i) -> float`` schedule.
+    ``log_every=N`` calls ``on_log(step_index, fetched_loss)`` every N
+    fetched steps (the index lags the dispatched step by one — async
+    logging, never a sync point beyond the lagged fetch).
+
+    Returns ``(params, opt_state, losses)`` — ``losses`` holds every
+    fetched per-step metric in order (scalars for the single-step
+    trainer, ``[K]`` arrays for the multistep one).
+
+    Wait-time accounting lands in ``profiler.pipeline_stats()``: time
+    blocked on ``feed`` counts as host_blocked (input-bound), time
+    blocked inside the lagged ``device_get`` as device_blocked
+    (compute-bound). When ``feed`` is a ``DevicePrefetcher`` its own
+    metrics object is reused (one snapshot answers for the whole
+    pipeline); otherwise a fresh source named ``name`` (default
+    ``"run_steps"``) is registered for the duration of the run.
+    """
+    import time
+
+    from ..io.prefetch import DevicePrefetcher, PipelineMetrics
+
+    if key is None:
+        key = jax.random.key(0)
+    lr_fn = lr if callable(lr) else (lambda i: lr)
+
+    owns_metrics = not isinstance(feed, DevicePrefetcher)
+    if owns_metrics:
+        from .. import profiler
+        metrics = PipelineMetrics(name or "run_steps")
+        profiler.register_pipeline_source(metrics.name, metrics)
+    else:
+        metrics = feed.metrics
+
+    losses = []
+    pending = None
+
+    def fetch(val, i):
+        t0 = time.perf_counter()
+        got = jax.device_get(val)
+        metrics.add_time("device_blocked_s", time.perf_counter() - t0)
+        losses.append(got)
+        if log_every and on_log is not None and i % log_every == 0:
+            on_log(i, got)
+
+    try:
+        it = iter(feed)
+        i = 0
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            if owns_metrics:
+                metrics.add_time("host_blocked_s",
+                                 time.perf_counter() - t0)
+                metrics.inc("batches_out")
+            ids, labels = batch
+            loss, params, opt_state = step(
+                params, opt_state, jax.random.fold_in(key, i), ids,
+                labels, lr_fn(i))
+            if pending is not None:
+                fetch(pending, i - 1)
+            pending = loss
+            i += 1
+        if pending is not None:
+            fetch(pending, i - 1)
+    finally:
+        if owns_metrics:
+            from .. import profiler
+            profiler.unregister_pipeline_source(metrics.name, metrics)
+    return params, opt_state, losses
+
+
+def write_back(model, params, strict=False):
+    """Write functional params back into the stateful layer.
+
+    Params whose names aren't on the model are NOT silently dropped: a
+    sharded-rename bug (e.g. a spec_fn keyed to old names) would
+    otherwise train a tree the model never sees. Unknown names warn by
+    default and raise ``KeyError`` with ``strict=True``."""
     entries = dict(model.named_parameters())
+    unknown = [k for k in params if k not in entries]
+    if unknown:
+        msg = (f"write_back: {len(unknown)} param(s) not on the model, "
+               f"dropped: {sorted(unknown)[:5]}"
+               f"{'...' if len(unknown) > 5 else ''}")
+        if strict:
+            raise KeyError(msg)
+        import warnings
+        warnings.warn(msg, RuntimeWarning, stacklevel=2)
     for k, v in params.items():
         if k in entries:
             entries[k]._data = v

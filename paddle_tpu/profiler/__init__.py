@@ -11,6 +11,7 @@ machine and chrome-trace export keep the reference API.
 """
 from __future__ import annotations
 
+import collections
 import json
 import os
 import threading
@@ -22,7 +23,9 @@ from typing import Callable, Iterable, List, Optional
 __all__ = ["ProfilerState", "ProfilerTarget", "make_scheduler",
            "export_chrome_tracing", "RecordEvent", "Profiler",
            "load_profiler_result", "SummaryView", "serving_stats",
-           "register_serving_source", "unregister_serving_source"]
+           "register_serving_source", "unregister_serving_source",
+           "pipeline_stats", "register_pipeline_source",
+           "unregister_pipeline_source", "record_placement_fallback"]
 
 
 class ProfilerState(Enum):
@@ -315,34 +318,68 @@ class Profiler:
         return list(self._all_events)
 
 
-# -- serving observability ---------------------------------------------------
-# paddle_tpu.serving registers each live Server's metrics here so serving
-# counters and latency histograms are retrievable through the profiler API
-# (the framework's one observability surface) without holding servers alive:
-# entries are weak references, pruned on read.
-_serving_sources: "dict[str, weakref.ref]" = {}
-_serving_lock = threading.Lock()
+# -- metrics-source registries -----------------------------------------------
+# Subsystems (serving servers, input-pipeline prefetchers/runners) register
+# their live metrics objects here so counters and latency histograms are
+# retrievable through the profiler API (the framework's one observability
+# surface) without holding the owners alive: entries are weak references,
+# pruned on read.
+class _SourceRegistry:
+    """name -> weakref(metrics object with .snapshot())."""
+
+    def __init__(self, kind: str):
+        self._kind = kind
+        self._sources: "dict[str, weakref.ref]" = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, metrics) -> None:
+        with self._lock:
+            self._sources[name] = weakref.ref(metrics)
+
+    def unregister(self, name: str, metrics=None) -> None:
+        # when ``metrics`` is given, only remove if the registry still
+        # points at THAT object — a later owner that reused the name must
+        # not lose its metrics to the older owner's shutdown
+        with self._lock:
+            ref = self._sources.get(name)
+            if ref is None:
+                return
+            if metrics is not None and ref() is not None \
+                    and ref() is not metrics:
+                return
+            del self._sources[name]
+
+    def stats(self, name: Optional[str] = None):
+        with self._lock:
+            live = {}
+            for n, ref in list(self._sources.items()):
+                m = ref()
+                if m is None:
+                    del self._sources[n]
+                else:
+                    live[n] = m
+        if name is not None:
+            if name not in live:
+                raise KeyError(
+                    f"no live {self._kind} source named {name!r}")
+            return live[name].snapshot()
+        return {n: m.snapshot() for n, m in live.items()}
+
+
+_serving_registry = _SourceRegistry("serving")
+_pipeline_registry = _SourceRegistry("pipeline")
 
 
 def register_serving_source(name: str, metrics) -> None:
     """Register a serving metrics source (an object with .snapshot()).
     Called by serving.Server on construction."""
-    with _serving_lock:
-        _serving_sources[name] = weakref.ref(metrics)
+    _serving_registry.register(name, metrics)
 
 
 def unregister_serving_source(name: str, metrics=None) -> None:
-    """Remove a source. When ``metrics`` is given, only remove if the
-    registry still points at THAT object — a later server that reused the
-    name must not lose its metrics to the older server's shutdown."""
-    with _serving_lock:
-        ref = _serving_sources.get(name)
-        if ref is None:
-            return
-        if metrics is not None and ref() is not None \
-                and ref() is not metrics:
-            return
-        del _serving_sources[name]
+    """Remove a source (only if it still points at ``metrics``, when
+    given). Called by serving.Server on shutdown."""
+    _serving_registry.unregister(name, metrics)
 
 
 def serving_stats(name: Optional[str] = None):
@@ -351,19 +388,55 @@ def serving_stats(name: Optional[str] = None):
 
     Returns ``{server_name: snapshot_dict}``, or one snapshot when
     ``name`` is given (KeyError when that server is gone)."""
-    with _serving_lock:
-        live = {}
-        for n, ref in list(_serving_sources.items()):
-            m = ref()
-            if m is None:
-                del _serving_sources[n]
-            else:
-                live[n] = m
+    return _serving_registry.stats(name)
+
+
+def register_pipeline_source(name: str, metrics) -> None:
+    """Register an input-pipeline metrics source (an object with
+    .snapshot()). Called by io.prefetch.DevicePrefetcher and
+    models.trainer.run_steps on construction."""
+    _pipeline_registry.register(name, metrics)
+
+
+def unregister_pipeline_source(name: str, metrics=None) -> None:
+    """Remove a pipeline source (only if it still points at ``metrics``,
+    when given)."""
+    _pipeline_registry.unregister(name, metrics)
+
+
+# place_by_spec replication fallbacks: silent de-sharding is a real bug
+# class (a renamed param whose spec no longer divides quietly replicates
+# and eats HBM/bandwidth), so every fallback is recorded here with a
+# one-line reason and surfaced through pipeline_stats(). Bounded deque —
+# a long run cannot accumulate unbounded state.
+_placement_fallbacks = collections.deque(maxlen=100)
+_placement_lock = threading.Lock()
+
+
+def record_placement_fallback(reason: str) -> None:
+    """Record a one-line reason for a sharding->replication fallback
+    (called by models.trainer.place_by_spec)."""
+    with _placement_lock:
+        _placement_fallbacks.append(str(reason))
+
+
+def pipeline_stats(name: Optional[str] = None):
+    """Snapshot of input-pipeline metrics: queue-depth gauge/histogram,
+    per-batch transfer latency, and the host-blocked vs device-blocked
+    time split ("am I input-bound or compute-bound?") — per registered
+    prefetcher/runner (mirrors ``serving_stats``).
+
+    Returns ``{pipeline_name: snapshot_dict}`` plus a
+    ``"placement_fallbacks"`` entry listing recent
+    ``place_by_spec`` sharding->replication fallback reasons, or one
+    snapshot when ``name`` is given (KeyError when that source is
+    gone)."""
     if name is not None:
-        if name not in live:
-            raise KeyError(f"no live serving source named {name!r}")
-        return live[name].snapshot()
-    return {n: m.snapshot() for n, m in live.items()}
+        return _pipeline_registry.stats(name)
+    out = _pipeline_registry.stats()
+    with _placement_lock:
+        out["placement_fallbacks"] = list(_placement_fallbacks)
+    return out
 
 
 class SummaryView(Enum):

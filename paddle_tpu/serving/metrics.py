@@ -6,55 +6,19 @@ one observability surface — reference parity: the predictor's
 memory/latency stats also surface through the profiler tables). Batch
 executions additionally emit host RecordEvents when a Profiler is
 recording, so serving work shows up in chrome traces next to op events.
+
+The thread-safe scaffolding (Histogram, counters/gauge plumbing) lives
+in ``paddle_tpu.profiler.metrics``, shared with the input-pipeline
+metrics in ``paddle_tpu.io.prefetch``.
 """
 from __future__ import annotations
 
-import threading
-from typing import Callable, Dict, Optional
+from ..profiler.metrics import Histogram, MetricsBase
 
 __all__ = ["Histogram", "ServingMetrics"]
 
 
-class Histogram:
-    """Streaming histogram: exact count/mean/max plus percentiles from a
-    bounded reservoir of the most recent samples (serving cares about
-    recent p50/p99, and a bounded buffer keeps a week-long server from
-    accumulating unbounded state)."""
-
-    def __init__(self, max_samples: int = 4096):
-        self._max = max_samples
-        self._ring = [0.0] * 0
-        self._next = 0
-        self.count = 0
-        self.total = 0.0
-        self.max = 0.0
-
-    def observe(self, v: float):
-        v = float(v)
-        self.count += 1
-        self.total += v
-        if v > self.max:
-            self.max = v
-        if len(self._ring) < self._max:
-            self._ring.append(v)
-        else:
-            self._ring[self._next] = v
-            self._next = (self._next + 1) % self._max
-
-    def percentile(self, p: float) -> float:
-        if not self._ring:
-            return 0.0
-        s = sorted(self._ring)
-        idx = min(len(s) - 1, max(0, int(round((p / 100.0) * (len(s) - 1)))))
-        return s[idx]
-
-    def snapshot(self) -> Dict[str, float]:
-        mean = self.total / self.count if self.count else 0.0
-        return {"count": self.count, "mean": mean, "max": self.max,
-                "p50": self.percentile(50), "p99": self.percentile(99)}
-
-
-class ServingMetrics:
+class ServingMetrics(MetricsBase):
     """Thread-safe counters/histograms for one Server.
 
     Counters: submitted, completed, rejected_overload, expired, failed,
@@ -67,33 +31,7 @@ class ServingMetrics:
     COUNTERS = ("submitted", "completed", "rejected_overload", "expired",
                 "failed", "batches", "compile_count", "cache_hits",
                 "cache_evictions")
-
-    def __init__(self, name: str):
-        self.name = name
-        self._lock = threading.Lock()
-        self._counters: Dict[str, int] = {k: 0 for k in self.COUNTERS}
-        self._hists: Dict[str, Histogram] = {
-            "batch_size": Histogram(),
-            "queue_wait_ms": Histogram(),
-            "latency_ms": Histogram(),
-            "pad_waste": Histogram(),
-        }
-        self._depth_fn: Optional[Callable[[], int]] = None
-
-    def inc(self, counter: str, n: int = 1):
-        with self._lock:
-            self._counters[counter] = self._counters.get(counter, 0) + n
-
-    def observe(self, hist: str, v: float):
-        with self._lock:
-            self._hists[hist].observe(v)
-
-    def set_depth_gauge(self, fn: Callable[[], int]):
-        self._depth_fn = fn
-
-    def __getitem__(self, counter: str) -> int:
-        with self._lock:
-            return self._counters.get(counter, 0)
+    HISTS = ("batch_size", "queue_wait_ms", "latency_ms", "pad_waste")
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -101,11 +39,5 @@ class ServingMetrics:
             out["name"] = self.name
             for k, h in self._hists.items():
                 out[k] = h.snapshot()
-        depth = 0
-        if self._depth_fn is not None:
-            try:
-                depth = int(self._depth_fn())
-            except Exception:
-                depth = -1
-        out["queue_depth"] = depth
+        out["queue_depth"] = self._read_gauge()
         return out

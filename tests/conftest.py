@@ -29,3 +29,11 @@ try:
     _xb._backend_factories.pop("axon", None)
 except Exception:
     pass
+
+
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow' inside an 870 s budget; anything
+    # sleep/loop-heavy (>5 s) must carry this marker
+    # (tools/check_slow_markers.py lints for unmarked offenders)
+    config.addinivalue_line(
+        "markers", "slow: takes >5s; excluded from the tier-1 budget run")

@@ -1,0 +1,103 @@
+"""tools/check_slow_markers.py lint (ISSUE 3 satellite): sleep/loop-heavy
+tests must carry @pytest.mark.slow so tier-1's 870 s budget holds."""
+import importlib.util
+import os
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tool():
+    spec = importlib.util.spec_from_file_location(
+        "check_slow_markers",
+        os.path.join(REPO, "tools", "check_slow_markers.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_real_tests_dir_is_clean():
+    """The shipped suite must pass its own lint — every estimated-slow
+    test carries the marker."""
+    tool = _tool()
+    violations = tool.check_dirs([os.path.join(REPO, "tests")])
+    assert violations == [], violations
+
+
+def test_flags_unmarked_sleep_heavy_function(tmp_path):
+    (tmp_path / "test_bad.py").write_text(textwrap.dedent("""
+        import time
+        def test_sleepy():
+            for _ in range(100):
+                time.sleep(0.1)
+    """))
+    tool = _tool()
+    vios = tool.check_dirs([str(tmp_path)])
+    assert len(vios) == 1
+    assert vios[0][2] == "test_sleepy" and vios[0][3] >= 10.0
+    assert tool.main([str(tmp_path)]) == 1
+
+
+def test_marker_on_function_or_class_suppresses(tmp_path):
+    (tmp_path / "test_marked.py").write_text(textwrap.dedent("""
+        import time
+        import pytest
+
+        @pytest.mark.slow
+        def test_sleepy():
+            time.sleep(30)
+
+        @pytest.mark.slow
+        class TestSlowGroup:
+            def test_also_sleepy(self):
+                time.sleep(30)
+    """))
+    tool = _tool()
+    assert tool.check_dirs([str(tmp_path)]) == []
+    assert tool.main([str(tmp_path)]) == 0
+
+
+def test_module_level_helper_calls_are_followed(tmp_path):
+    """A test that hides its poll loop in a module-level helper is still
+    seen (direct call); a mere reference (Process(target=helper)) is
+    not — the callee runs outside this test's budget."""
+    (tmp_path / "test_helper.py").write_text(textwrap.dedent("""
+        import time
+        import multiprocessing
+
+        def _poll_until_ready():
+            for _ in range(60):
+                time.sleep(1)
+
+        def test_hidden_sleeper():
+            _poll_until_ready()
+
+        def test_only_references_helper():
+            p = multiprocessing.Process(target=_poll_until_ready)
+            p.start(); p.terminate()
+    """))
+    tool = _tool()
+    vios = tool.check_dirs([str(tmp_path)])
+    assert [v[2] for v in vios] == ["test_hidden_sleeper"]
+    assert vios[0][3] >= 60.0
+
+
+def test_lambda_waiters_and_small_sleeps_pass(tmp_path):
+    """Lambdas are callbacks the code under test interrupts (the
+    comm-watchdog pattern); short constant sleeps stay under threshold;
+    nested producer defs ARE counted."""
+    (tmp_path / "test_ok.py").write_text(textwrap.dedent("""
+        import time
+        def test_watchdog_style(run):
+            run(waiter=lambda: time.sleep(60))
+            time.sleep(0.3)
+
+        def test_nested_producer_counted():
+            def producer():
+                for _ in range(200):
+                    time.sleep(0.1)
+            producer()
+    """))
+    tool = _tool()
+    vios = tool.check_dirs([str(tmp_path)])
+    assert [v[2] for v in vios] == ["test_nested_producer_counted"]

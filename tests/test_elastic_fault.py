@@ -47,6 +47,7 @@ def _worker(port, node_id, stop_after_epoch):
         mgr.stop()
 
 
+@pytest.mark.slow   # ~9 s real time: 3 s heartbeat timeout + poll loops
 def test_kill_worker_triggers_restart_and_rejoin():
     port = _free_port()
     master = TCPStore("127.0.0.1", port, is_master=True, world_size=1)

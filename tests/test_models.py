@@ -491,6 +491,29 @@ def test_sharded_multistep_with_accumulation():
                                rtol=2e-4, atol=2e-5)
 
 
+def test_write_back_surfaces_unknown_param_names():
+    """ISSUE 3 satellite: write_back used to silently drop params whose
+    names aren't on the model — a sharded-rename bug class. Unknown names
+    now warn (and raise with strict=True); known names still write."""
+    paddle.seed(13)
+    model = GPTForCausalLM(gpt2_tiny())
+    live = dict(model.named_parameters())
+    name = next(iter(live))
+    params = {name: jnp.zeros_like(live[name]._data),
+              "renamed.by.a.spec_fn": jnp.zeros((3,), jnp.float32)}
+    with pytest.warns(RuntimeWarning, match="renamed.by.a.spec_fn"):
+        write_back(model, params)
+    # the known name was still written through
+    assert float(jnp.abs(live[name]._data).sum()) == 0.0
+    with pytest.raises(KeyError, match="renamed.by.a.spec_fn"):
+        write_back(model, params, strict=True)
+    # all-known write stays silent
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        write_back(model, {name: live[name]._data})
+
+
 def test_multistep_accumulate_rejects_mis_stacked_input():
     from paddle_tpu.models import create_multistep_train_step
 
