@@ -41,42 +41,52 @@ class Generator:
         self._offset = 0
 
     def manual_seed(self, seed: int):
-        self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
-        self._offset = 0
+        # the whole (seed, key, offset) triple is guarded by _lock:
+        # reseeding concurrently with a next_key() (serving worker,
+        # prefetch producer) must never publish a torn pair — e.g. the
+        # new key with the old offset (graft_lint GL201)
+        with self._lock:
+            self._seed = int(seed)
+            self._key = jax.random.key(self._seed)
+            self._offset = 0
         return self
 
-    def _ensure_key(self):
+    def _ensure_key_locked(self):
         if self._key is None:
             self._key = jax.random.key(self._seed)
 
     def seed(self):
-        return self._seed
+        with self._lock:
+            return self._seed
 
     def initial_seed(self):
-        return self._seed
+        with self._lock:
+            return self._seed
 
     def get_state(self):
-        return (self._seed, self._offset)
+        with self._lock:
+            return (self._seed, self._offset)
 
     def set_state(self, state):
         seed, offset = state
-        self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
-        self._offset = int(offset)
+        with self._lock:
+            self._seed = int(seed)
+            self._key = jax.random.key(self._seed)
+            self._offset = int(offset)
 
     def next_key(self):
         """Return a fresh subkey; advances the offset (the (seed, offset)
         pair is the replayable RNG state, mirroring the reference's
         IncrementOffset contract used by dropout/flash-attn)."""
         with self._lock:
-            self._ensure_key()
+            self._ensure_key_locked()
             sub = jax.random.fold_in(self._key, self._offset)
             self._offset += 1
             return sub
 
     def peek_state(self):
-        return (self._seed, self._offset)
+        with self._lock:
+            return (self._seed, self._offset)
 
     # -- indexed state registry (parity: incubate/framework/random.py —
     # register/switch whole generator states by index, the recompute

@@ -280,6 +280,9 @@ class InMemoryDataset(DatasetBase):
 
     def wait_preload_done(self):
         if self._preload_thread is not None:
+            # graft-lint: disable=GL302 -- this API's contract IS the
+            # indefinite wait (reference wait_preload_done blocks until
+            # the preload finishes; the loader thread is daemon)
             self._preload_thread.join()
             self._preload_thread = None
 

@@ -186,15 +186,23 @@ class PSServer:
                     self._dense.load(raw)
                 elif name.startswith("sparse_"):
                     tname = name[len("sparse_"):]
-                    if tname not in self._tables:
+                    # the server handles requests on concurrent threads
+                    # (daemon_threads TCP): the existence check and the
+                    # final lookup must go through the lock like every
+                    # other _tables access, or a racing pull/push handler
+                    # creating the same table tears this check-then-act
+                    # (graft_lint GL202)
+                    with self._tables_lock:
+                        table = self._tables.get(tname)
+                    if table is None:
                         # recover dim + accessor (kind AND hyperparameters)
                         # from the checkpoint itself
                         dim, acc, acc_kw = SparseTable.peek_meta(raw)
                         meta2 = dict(meta)
                         meta2.update(table=tname, dim=dim, accessor=acc,
                                      accessor_kw=acc_kw)
-                        self._table(meta2)
-                    self._tables[tname].load(raw)
+                        table = self._table(meta2)
+                    table.load(raw)
             return {"ok": True}, {}
         if cmd == "stats":
             with self._tables_lock:

@@ -73,7 +73,9 @@ class SparseTable:
         self._lock = threading.Lock()
 
     # -- internals -----------------------------------------------------------
-    def _grow(self, need: int):
+    def _grow_locked(self, need: int):
+        # _locked suffix: caller must hold self._lock (graft_lint
+        # lock-discipline convention)
         cap = self._rows.shape[0]
         new_cap = max(cap * 2, cap + need)
         grown = np.zeros((new_cap, self.dim), np.float32)
@@ -84,7 +86,7 @@ class SparseTable:
             g[:cap] = v
             self._slots[k] = g
 
-    def _ensure(self, ids: np.ndarray) -> np.ndarray:
+    def _ensure_locked(self, ids: np.ndarray) -> np.ndarray:
         """Map ids -> arena row indices, initializing misses."""
         idx = np.empty(len(ids), np.int64)
         missing = []
@@ -98,7 +100,7 @@ class SparseTable:
         if missing:
             need = max(0, len(missing) - len(self._free))
             if self._next_row + need > self._rows.shape[0]:
-                self._grow(self._next_row + need - self._rows.shape[0])
+                self._grow_locked(self._next_row + need - self._rows.shape[0])
             for i in missing:
                 fid = int(ids[i])
                 j = self._index.get(fid)  # duplicate miss in this batch
@@ -124,7 +126,8 @@ class SparseTable:
 
     # -- public API ----------------------------------------------------------
     def __len__(self):
-        return len(self._index)
+        with self._lock:
+            return len(self._index)
 
     def _gated(self) -> bool:
         return isinstance(self.accessor, CtrAccessor)
@@ -142,7 +145,7 @@ class SparseTable:
                     rows_idx = [self._index[int(ids[i])] for i in known]
                     out[known] = self._rows[rows_idx]
                 return out
-            idx = self._ensure(ids)
+            idx = self._ensure_locked(ids)
             return self._rows[idx].copy()
 
     def push(self, ids, grads) -> None:
@@ -156,7 +159,7 @@ class SparseTable:
                     return
                 ids, grads = ids[keep], grads[keep]
             uniq, agg = merge_by_id(ids, grads)
-            idx = self._ensure(uniq)
+            idx = self._ensure_locked(uniq)
             rows = self._rows[idx]
             slots = {k: v[idx] for k, v in self._slots.items()}
             self.accessor.update(rows, slots, agg)
@@ -169,7 +172,7 @@ class SparseTable:
         ids = np.asarray(ids, np.int64).reshape(-1)
         values = np.asarray(values, np.float32).reshape(len(ids), self.dim)
         with self._lock:
-            idx = self._ensure(ids)
+            idx = self._ensure_locked(ids)
             self._rows[idx] = values
 
     def add_to_rows(self, ids, deltas) -> None:
@@ -179,7 +182,7 @@ class SparseTable:
         deltas = np.asarray(deltas, np.float32).reshape(len(ids), self.dim)
         uniq, agg = merge_by_id(ids, deltas)
         with self._lock:
-            idx = self._ensure(uniq)
+            idx = self._ensure_locked(uniq)
             self._rows[idx] += agg
 
     def record_shows(self, ids, shows=None, clicks=None):
@@ -218,7 +221,7 @@ class SparseTable:
             shows_eff = shows[sel].copy()
             for pos, i in enumerate(admitted_i):
                 shows_eff[pos] += carried.get(i, 0.0)  # pre-admission shows
-            idx = self._ensure(ids[sel])
+            idx = self._ensure_locked(ids[sel])
             slots = {k: v[idx] for k, v in self._slots.items()}
             self.accessor.record_shows(
                 slots, shows_eff,
@@ -288,19 +291,19 @@ class SparseTable:
         ids = data["ids"]
         slot_keys = {k[len("slot_"):] for k in data.files
                      if k.startswith("slot_")}
-        if slot_keys != set(self._slots):
-            raise ValueError(
-                f"checkpoint slots {sorted(slot_keys)} do not match this "
-                f"table's accessor '{self.accessor_name}' slots "
-                f"{sorted(self._slots)} — construct the table with the "
-                "accessor it was saved with")
         with self._lock:
+            if slot_keys != set(self._slots):
+                raise ValueError(
+                    f"checkpoint slots {sorted(slot_keys)} do not match "
+                    f"this table's accessor '{self.accessor_name}' slots "
+                    f"{sorted(self._slots)} — construct the table with "
+                    "the accessor it was saved with")
             self._index.clear()
             self._free = []
             self._pending_shows.clear()
             n = len(ids)
             if n > self._rows.shape[0]:
-                self._grow(n - self._rows.shape[0])
+                self._grow_locked(n - self._rows.shape[0])
             self._rows[:n] = data["rows"]
             self._index.update({int(f): i for i, f in enumerate(ids)})
             self._next_row = n

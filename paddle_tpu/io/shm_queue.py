@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import ctypes
 import struct
+import threading
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -81,11 +83,22 @@ def decode_batch(buf: memoryview) -> Optional[List[np.ndarray]]:
 
 
 class ShmQueue:
-    """One producer-side or consumer-side handle on a named ring."""
+    """One producer-side or consumer-side handle on a named ring.
+
+    Thread-safety of teardown: ``shmq_close`` munmaps and frees the
+    native Handle with no synchronization of its own, so a ``close()``
+    racing an in-flight ``pop()``/``push()`` on another thread (the
+    loader's GC-``__del__``-vs-consumer shape) would be a use-after-
+    free. Every native call therefore enters through an in-flight
+    refcount; ``close()`` NULLs the handle (new calls see "closed"),
+    marks the ring closed so natives blocked in pop/push wake up, waits
+    for in-flight calls to drain, and only then unmaps."""
 
     def __init__(self, name: str, capacity: int = 0, create: bool = False):
         self._lib = _lib()
         self.name = name
+        self._mu = threading.Lock()    # guards _h / _inflight handoff
+        self._inflight = 0
         if create:
             self._h = self._lib.shmq_create(name.encode(), capacity)
         else:
@@ -95,9 +108,27 @@ class ShmQueue:
                 f"ShmQueue: cannot {'create' if create else 'open'} {name}")
         self._buf = ctypes.create_string_buffer(1 << 20)
 
+    def _enter(self):
+        """Claim the handle for one native call; None when closed."""
+        with self._mu:
+            if not self._h:
+                return None
+            self._inflight += 1
+            return self._h
+
+    def _exit(self):
+        with self._mu:
+            self._inflight -= 1
+
     def push(self, payload: bytes, timeout_s: float = 0) -> None:
-        r = self._lib.shmq_push(self._h, payload, len(payload),
-                                int(timeout_s * 1000))
+        h = self._enter()
+        if h is None:   # close() raced us: never hand NULL to native
+            raise BrokenPipeError("ShmQueue closed")
+        try:
+            r = self._lib.shmq_push(h, payload, len(payload),
+                                    int(timeout_s * 1000))
+        finally:
+            self._exit()
         if r == -1:
             raise TimeoutError(f"ShmQueue.push timed out after {timeout_s}s")
         if r == -2:
@@ -112,31 +143,60 @@ class ShmQueue:
         buffer grows to fit (a too-small buffer never loses the record:
         the native side returns -4 without consuming)."""
         while True:
-            n = self._lib.shmq_pop(self._h, self._buf, len(self._buf),
-                                   int(timeout_s * 1000))
+            h = self._enter()
+            if h is None:   # close() raced us: closed-and-drained
+                return None
+            try:
+                n = self._lib.shmq_pop(h, self._buf, len(self._buf),
+                                       int(timeout_s * 1000))
+                if n == -4:
+                    need = self._lib.shmq_peek_size(h, 1000)
+                    if need > 0:
+                        self._buf = ctypes.create_string_buffer(int(need))
+                    continue
+            finally:
+                self._exit()
             if n == -1:
                 raise TimeoutError(
                     f"ShmQueue.pop timed out after {timeout_s}s")
             if n == -2:
                 return None
-            if n == -4:
-                need = self._lib.shmq_peek_size(self._h, 1000)
-                if need > 0:
-                    self._buf = ctypes.create_string_buffer(int(need))
-                continue
             return self._buf.raw[:n]
 
     def size(self) -> int:
-        return int(self._lib.shmq_size(self._h)) if self._h else 0
+        h = self._enter()
+        if h is None:
+            return 0
+        try:
+            return int(self._lib.shmq_size(h))
+        finally:
+            self._exit()
 
     def mark_closed(self) -> None:
-        if self._h:
-            self._lib.shmq_mark_closed(self._h)
+        h = self._enter()
+        if h is None:
+            return
+        try:
+            self._lib.shmq_mark_closed(h)
+        finally:
+            self._exit()
 
     def close(self) -> None:
-        if self._h:
-            self._lib.shmq_close(self._h)
-            self._h = None
+        with self._mu:
+            h, self._h = self._h, None
+        if not h:
+            return
+        # wake any native call blocked in pop/push (they re-check the
+        # closed flag under the ring mutex and return), then wait for
+        # in-flight calls to leave the mapping before freeing it
+        self._lib.shmq_mark_closed(h)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with self._mu:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.005)
+        self._lib.shmq_close(h)
 
     def __del__(self):
         try:

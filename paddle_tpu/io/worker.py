@@ -150,6 +150,16 @@ class MultiprocessLoaderIter:
         else:
             shares = [None] * self.num_workers
         self.procs = []
+        # shutdown() can race itself: the consumer thread reaches it via
+        # StopIteration while GC runs __del__ on another thread (the
+        # usual shape: a DevicePrefetcher's producer thread is draining
+        # this iter when the owning loader is collected). Both used to
+        # pass the "already shut down?" check and double-close the
+        # native shm handles (shmq_close on a freed handle). The lock
+        # makes exactly one caller the closer; created before the
+        # worker-start loop because a start failure calls shutdown()
+        # from inside __init__.
+        self._shutdown_lock = threading.Lock()
         # Serialize the env scrub across threads: the window mutates
         # process-global env, so concurrent iterator construction must
         # not interleave save/restore (and the window is kept as short
@@ -219,6 +229,14 @@ class MultiprocessLoaderIter:
             self._next = (self._next + 1) % self.num_workers
             if self._done[w]:
                 continue
+            # take the ring/process references under the shutdown lock:
+            # a concurrent shutdown() (e.g. GC __del__ on another
+            # thread) swaps the lists out, and this iteration must end
+            # cleanly rather than index into the emptied lists
+            with self._shutdown_lock:
+                if not self.queues:
+                    raise StopIteration
+                ring, proc = self.queues[w], self.procs[w]
             # poll in short slices so a dead worker is detected promptly
             # instead of only after the full user-facing timeout
             deadline = time.monotonic() + self.timeout
@@ -226,12 +244,11 @@ class MultiprocessLoaderIter:
             while True:
                 remaining = deadline - time.monotonic()
                 try:
-                    rec = self.queues[w].pop(
+                    rec = ring.pop(
                         timeout_s=max(0.05, min(1.0, remaining)))
                     self._started[w] = True
                     break
                 except TimeoutError:
-                    proc = self.procs[w]
                     if not proc.is_alive():
                         self.shutdown()
                         raise RuntimeError(
@@ -259,10 +276,12 @@ class MultiprocessLoaderIter:
         raise StopIteration
 
     def shutdown(self):
-        if not self.queues:
-            return  # idempotent: StopIteration and finally both call this
-        queues, self.queues = self.queues, []
-        procs, self.procs = self.procs, []
+        with self._shutdown_lock:
+            if not self.queues:
+                return  # idempotent: StopIteration, __del__, and error
+                # paths all call this; only the first caller closes
+            queues, self.queues = self.queues, []
+            procs, self.procs = self.procs, []
         for q in queues:
             try:
                 q.mark_closed()

@@ -150,7 +150,10 @@ class RequestQueue:
             self._depth = 0
             return out
 
-    def _oldest_key(self):
+    def _oldest_key_locked(self):
+        # _locked suffix: caller must hold self._cond (graft_lint's
+        # lock-discipline convention for helpers factored out of with
+        # blocks)
         best_key, best_seq = None, None
         for k, q in self._by_key.items():
             if q and (best_seq is None or q[0].seq < best_seq):
@@ -170,7 +173,7 @@ class RequestQueue:
                 if stop.is_set():
                     return None, []
                 self._cond.wait(poll_s)
-            key = self._oldest_key()
+            key = self._oldest_key_locked()
             batch: List[Request] = []
             expired: List[Request] = []
             t_end = time.monotonic() + max(0.0, timeout_s)

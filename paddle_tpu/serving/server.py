@@ -251,7 +251,15 @@ class Server:
         """Enqueue one request. Each positional arg is ONE example (no
         batch dim). Returns a Future; full queue raises ServerOverloaded,
         a closed server raises ServerClosed."""
-        if self._closed:
+        # _closed is guarded by _lock (shutdown() writes it under the
+        # lock); an unguarded read here was the check-then-act race
+        # graft_lint GL202 was built to catch — the queue's own closed
+        # check would still reject the request, but only after this
+        # thread had already counted it into "submitted", skewing the
+        # drain invariant on the shutdown path
+        with self._lock:
+            closed = self._closed
+        if closed:
             raise ServerClosed("server is shutting down")
         if not args:
             raise ValueError("submit() needs at least one input array")
@@ -376,7 +384,9 @@ class Server:
 
     def __del__(self):  # best-effort: never leak the worker thread
         try:
-            if not self._closed:
+            with self._lock:
+                closed = self._closed
+            if not closed:
                 self.shutdown(drain=False, timeout=1.0)
         except Exception:
             pass

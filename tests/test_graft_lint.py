@@ -1,0 +1,459 @@
+"""tools/graft_lint (ISSUE 4 tentpole): fixture-driven tests per pass
+(good/bad snippets), suppression comments, baseline handling, and a CLI
+smoke test for --json output."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO) if REPO not in sys.path else None
+
+from tools.graft_lint import (Baseline, lint_file, lint_paths,  # noqa: E402
+                              registered_passes)
+from tools.graft_lint.core import parse_suppressions  # noqa: E402
+
+
+def _lint_src(tmp_path, src, name="mod.py", **kw):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    passes = [cls() for cls in registered_passes().values()]
+    findings, suppressed, err = lint_file(str(p), passes, **kw)
+    assert err is None, err
+    return findings, suppressed
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+def test_four_passes_registered():
+    names = set(registered_passes())
+    assert {"trace-purity", "lock-discipline", "thread-hygiene",
+            "slow-marker"} <= names
+
+
+# -- trace-purity ------------------------------------------------------------
+
+def test_trace_purity_flags_impure_jitted_fn(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import time
+        import random
+        import numpy as np
+        import jax
+
+        def step(x):
+            t = time.time()
+            print("stepping", t)
+            noise = np.random.randn(4)
+            r = random.random()
+            return x + float(x) + x.item()
+
+        jitted = jax.jit(step)
+    """)
+    rules = _rules(findings)
+    assert "GL101" in rules   # time.time
+    assert "GL102" in rules   # print
+    assert rules.count("GL103") == 2   # np.random + random.random
+    assert rules.count("GL104") == 2   # float(param) + .item()
+
+
+def test_trace_purity_decorator_and_global(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import jax
+
+        _calls = 0
+
+        @jax.jit
+        def fn(x):
+            global _calls
+            _calls += 1
+            return x * 2
+    """)
+    assert _rules(findings) == ["GL105"]
+
+
+def test_trace_purity_ignores_untraced_functions(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import time
+
+        def host_loop(x):
+            t = time.time()
+            print(t)
+            return float(x)
+    """)
+    assert findings == []
+
+
+def test_trace_purity_to_static_and_multistep(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import time
+        from paddle_tpu.jit import to_static
+        from paddle_tpu.models import create_multistep_train_step
+
+        def body(x):
+            return time.time() + x
+
+        sf = to_static(body)
+
+        def step(p, b):
+            print(p)
+            return p
+
+        ms = create_multistep_train_step(step, steps=4)
+    """)
+    assert _rules(findings) == ["GL101", "GL102"]
+
+
+# -- lock-discipline ---------------------------------------------------------
+
+_LOCKY = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._closed = False
+            self._items = []
+
+        def close(self):
+            with self._lock:
+                self._closed = True
+
+        def is_closed(self):
+            return self._closed{suffix}
+"""
+
+
+def test_lock_discipline_flags_unlocked_read(tmp_path):
+    findings, _ = _lint_src(tmp_path, _LOCKY.format(suffix=""))
+    assert _rules(findings) == ["GL202"]
+    assert findings[0].symbol == "Box._closed"
+
+
+def test_lock_discipline_clean_when_read_locked(tmp_path):
+    src = _LOCKY.format(suffix="") .replace(
+        "        def is_closed(self):\n            return self._closed",
+        "        def is_closed(self):\n"
+        "            with self._lock:\n"
+        "                return self._closed")
+    findings, _ = _lint_src(tmp_path, src)
+    assert findings == []
+
+
+def test_lock_discipline_flags_mixed_writes(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def reset(self):
+                self._n = 0
+    """)
+    assert _rules(findings) == ["GL201"]
+    assert findings[0].symbol == "Box._n"
+
+
+def test_lock_discipline_locked_suffix_convention(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+                    self._prune_locked()
+
+            def _prune_locked(self):
+                for k in list(self._items):
+                    del self._items[k]
+    """)
+    assert findings == []
+
+
+def test_lock_discipline_mutator_calls_count_as_writes(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []
+
+            def put(self, v):
+                with self._lock:
+                    self._q.append(v)
+
+            def put_fast(self, v):
+                self._q.append(v)
+    """)
+    assert _rules(findings) == ["GL201"]
+
+
+def test_lock_discipline_ignores_lockless_classes(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        class Plain:
+            def __init__(self):
+                self.x = 0
+
+            def bump(self):
+                self.x += 1
+    """)
+    assert findings == []
+
+
+# -- thread-hygiene ----------------------------------------------------------
+
+def test_thread_hygiene_daemonless_thread_and_blocking_get(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import queue
+        import threading
+
+        q = queue.Queue()
+
+        def run():
+            t = threading.Thread(target=print)
+            t.start()
+            item = q.get()
+            t.join()
+    """)
+    rules = _rules(findings)
+    assert rules == ["GL301", "GL302", "GL302"]
+
+
+def test_thread_hygiene_clean_variants(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import queue
+        import threading
+
+        q = queue.Queue()
+        d = {}
+
+        def run():
+            t = threading.Thread(target=print, daemon=True)
+            t2 = threading.Thread(target=print)
+            t2.daemon = False
+            t.start()
+            item = q.get(timeout=1.0)
+            item = q.get_nowait()
+            val = d.get("k")        # dict.get: not a queue
+            t.join(timeout=2.0)
+    """)
+    assert findings == []
+
+
+# -- slow-marker (pass form; the shim keeps its own test file) ---------------
+
+def test_slow_marker_pass_flags_unmarked_test(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import time
+
+        def test_sleepy():
+            for _ in range(100):
+                time.sleep(0.1)
+    """, name="test_bad.py")
+    assert _rules(findings) == ["GL401"]
+    assert findings[0].symbol == "test_sleepy"
+
+
+def test_slow_marker_pass_skips_non_test_files(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import time
+
+        def test_sleepy():
+            for _ in range(100):
+                time.sleep(0.1)
+    """, name="helper.py")
+    assert findings == []
+
+
+# -- suppressions ------------------------------------------------------------
+
+def test_inline_suppression_with_reason(tmp_path):
+    findings, suppressed = _lint_src(tmp_path, _LOCKY.format(
+        suffix="  # graft-lint: disable=GL202 -- consumer thread only"))
+    assert findings == []
+    assert _rules(suppressed) == ["GL202"]
+
+
+def test_standalone_suppression_covers_next_code_line(tmp_path):
+    src = _LOCKY.format(suffix="").replace(
+        "            return self._closed",
+        "            # graft-lint: disable=GL202 -- single-writer: the\n"
+        "            # flag only ever flips False->True\n"
+        "            return self._closed")
+    findings, suppressed = _lint_src(tmp_path, src)
+    assert findings == []
+    assert _rules(suppressed) == ["GL202"]
+
+
+def test_suppression_without_reason_does_not_suppress(tmp_path):
+    findings, suppressed = _lint_src(tmp_path, _LOCKY.format(
+        suffix="  # graft-lint: disable=GL202"))
+    rules = _rules(findings)
+    assert "GL202" in rules          # still reported
+    assert "GL002" in rules          # and the bad suppression is too
+    assert suppressed == []
+
+
+def test_suppression_by_pass_name(tmp_path):
+    findings, suppressed = _lint_src(tmp_path, _LOCKY.format(
+        suffix="  # graft-lint: disable=lock-discipline -- verified "
+               "benign"))
+    assert findings == []
+    assert _rules(suppressed) == ["GL202"]
+
+
+def test_parse_suppressions_shapes():
+    sup, bad = parse_suppressions(
+        "x = 1  # graft-lint: disable=GL101,GL102 -- why not\n"
+        "y = 2  # graft-lint: disable=GL103\n")
+    assert sup[1] == {"GL101", "GL102"}
+    assert bad == [(2, "# graft-lint: disable=GL103")]
+
+
+# -- select / ignore ---------------------------------------------------------
+
+def test_select_and_ignore(tmp_path):
+    src = _LOCKY.format(suffix="")
+    findings, _ = _lint_src(tmp_path, src, select={"GL202"})
+    assert _rules(findings) == ["GL202"]
+    findings, _ = _lint_src(tmp_path, src, ignore={"GL202"})
+    assert findings == []
+    findings, _ = _lint_src(tmp_path, src, ignore={"lock-discipline"})
+    assert findings == []
+
+
+# -- baseline ----------------------------------------------------------------
+
+def test_baseline_accepts_then_catches_new(tmp_path):
+    bad = tmp_path / "box.py"
+    bad.write_text(textwrap.dedent(_LOCKY.format(suffix="")))
+    res = lint_paths([str(tmp_path)])
+    assert _rules(res.findings) == ["GL202"]
+
+    bl_path = tmp_path / "baseline.json"
+    Baseline.write(str(bl_path), res.findings)
+    res2 = lint_paths([str(tmp_path)], baseline=Baseline.load(str(bl_path)))
+    assert res2.findings == []
+    assert _rules(res2.baselined) == ["GL202"]
+
+    # a NEW finding (different attribute) is not absorbed by the baseline
+    bad.write_text(textwrap.dedent(_LOCKY.format(suffix="")) + textwrap.dedent("""
+        class Other:
+            def __init__(self):
+                import threading
+                self._lock = threading.Lock()
+                self._state = 0
+
+            def set(self):
+                with self._lock:
+                    self._state = 1
+
+            def peek(self):
+                return self._state
+    """))
+    res3 = lint_paths([str(tmp_path)], baseline=Baseline.load(str(bl_path)))
+    assert [f.symbol for f in res3.findings] == ["Other._state"]
+    assert _rules(res3.baselined) == ["GL202"]
+
+
+def test_baseline_multiplicity(tmp_path):
+    src = textwrap.dedent(_LOCKY.format(suffix="")) + (
+        "\n        def also_closed(self):\n"
+        "            return self._closed\n").replace("        ", "    ")
+    (tmp_path / "box.py").write_text(src)
+    res = lint_paths([str(tmp_path)])
+    assert _rules(res.findings) == ["GL202", "GL202"]
+    bl = tmp_path / "bl.json"
+    # baseline only ONE of the two identical fingerprints: one stays new
+    Baseline.write(str(bl), res.findings[:1])
+    res2 = lint_paths([str(tmp_path)], baseline=Baseline.load(str(bl)))
+    assert len(res2.findings) == 1 and len(res2.baselined) == 1
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def _run_cli(*args, cwd=REPO):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graft_lint", *args],
+        capture_output=True, text=True, cwd=cwd, env=env)
+
+
+def test_cli_json_smoke(tmp_path):
+    (tmp_path / "box.py").write_text(textwrap.dedent(_LOCKY.format(
+        suffix="")))
+    proc = _run_cli(str(tmp_path), "--json", "--no-baseline")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert [f["rule"] for f in data["findings"]] == ["GL202"]
+    assert data["counts"] == {"GL202": 1}
+    assert set(data["passes"]) == set(registered_passes())
+
+
+def test_cli_clean_exit_zero(tmp_path):
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    proc = _run_cli(str(tmp_path), "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["findings"] == []
+
+
+def test_cli_list_rules():
+    proc = _run_cli("--list-rules", "--json")
+    assert proc.returncode == 0
+    data = json.loads(proc.stdout)
+    for rid in ("GL101", "GL201", "GL301", "GL401", "GL002"):
+        assert rid in data["rules"], rid
+
+
+def test_cli_write_baseline_roundtrip(tmp_path):
+    (tmp_path / "box.py").write_text(textwrap.dedent(_LOCKY.format(
+        suffix="")))
+    bl = tmp_path / "bl.json"
+    proc = _run_cli(str(tmp_path), "--baseline", str(bl),
+                    "--write-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = _run_cli(str(tmp_path), "--baseline", str(bl))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_missing_path_is_an_error():
+    proc = _run_cli("definitely/not/a/path")
+    assert proc.returncode == 2
+
+
+def test_cli_write_baseline_refuses_partial_views(tmp_path):
+    """A baseline regenerated under --select, or over the repo default
+    baseline from a narrowed path set, would silently drop accepted
+    findings — the CLI must refuse instead."""
+    (tmp_path / "box.py").write_text(textwrap.dedent(_LOCKY.format(
+        suffix="")))
+    proc = _run_cli(str(tmp_path), "--baseline", str(tmp_path / "b.json"),
+                    "--select", "GL202", "--write-baseline")
+    assert proc.returncode == 2 and "refusing" in proc.stderr
+    proc = _run_cli(os.path.join(REPO, "paddle_tpu"), "--write-baseline")
+    assert proc.returncode == 2 and "refusing" in proc.stderr
+
+
+def test_cli_baseline_matches_from_any_cwd(tmp_path):
+    """The shipped baseline is repo-relative; a run launched from
+    outside the repo (absolute paths) must still match it."""
+    proc = _run_cli(os.path.join(REPO, "paddle_tpu"),
+                    os.path.join(REPO, "tools"), cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr

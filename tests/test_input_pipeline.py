@@ -164,7 +164,7 @@ class TestPrefetcher:
             except TimeoutError:
                 outcome.append(("timeout", time.perf_counter() - t0))
 
-        t = threading.Thread(target=consume)
+        t = threading.Thread(target=consume, daemon=True)
         t.start()
         time.sleep(0.1)   # let the consumer block on the empty queue
         pf.close()

@@ -46,17 +46,17 @@ class TestTCPStore:
         def bump(s):
             for _ in range(100):
                 s.add("cnt", 1)
-        ts = [threading.Thread(target=bump, args=(s,))
+        ts = [threading.Thread(target=bump, args=(s,), daemon=True)
               for s in (master, client) for _ in range(3)]
         [t.start() for t in ts]
-        [t.join() for t in ts]
+        [t.join(timeout=30) for t in ts]
         assert master.add("cnt", 0) == 600
 
     def test_wait_blocks_then_returns(self, master, client):
         def setter():
             time.sleep(0.2)
             master.set("late-key", "1")
-        threading.Thread(target=setter).start()
+        threading.Thread(target=setter, daemon=True).start()
         t0 = time.time()
         client.wait("late-key", timeout=5)
         assert time.time() - t0 >= 0.15
@@ -73,10 +73,10 @@ class TestTCPStore:
                 s.barrier("t", timeout=5)
             except Exception as e:
                 errs.append(e)
-        ts = [threading.Thread(target=b, args=(s,))
+        ts = [threading.Thread(target=b, args=(s,), daemon=True)
               for s in (master, client)]
         [t.start() for t in ts]
-        [t.join() for t in ts]
+        [t.join(timeout=30) for t in ts]
         assert not errs
 
     def test_barrier_reusable(self, master, client):
@@ -88,10 +88,10 @@ class TestTCPStore:
                     s.barrier("reuse", timeout=5)
                 except Exception as e:
                     errs.append(e)
-            ts = [threading.Thread(target=b, args=(s,))
+            ts = [threading.Thread(target=b, args=(s,), daemon=True)
                   for s in (master, client)]
             [t.start() for t in ts]
-            [t.join() for t in ts]
+            [t.join(timeout=30) for t in ts]
             assert not errs
 
     def test_add_negative_counter(self, master):
@@ -228,11 +228,11 @@ class TestLeaseWatch:
 
         def w():
             res["r"] = client.watch("lw/w1", 0, timeout=5)
-        t = threading.Thread(target=w)
+        t = threading.Thread(target=w, daemon=True)
         t.start()
         time.sleep(0.15)
         master.set("lw/w1", "x")
-        t.join()
+        t.join(timeout=30)
         ver, val = res["r"]
         assert val == b"x" and ver > 0
 
@@ -244,11 +244,11 @@ class TestLeaseWatch:
 
         def w():
             res["r"] = master.watch("lw/w2", ver, timeout=5)
-        t = threading.Thread(target=w)
+        t = threading.Thread(target=w, daemon=True)
         t.start()
         time.sleep(0.15)
         master.delete_key("lw/w2")
-        t.join()
+        t.join(timeout=30)
         v2, val2 = res["r"]
         assert v2 > ver and val2 is None
 
@@ -344,11 +344,11 @@ class TestElasticScale:
 
             def waiter():
                 res["epoch"] = m2.wait_restart_signal(timeout=5)
-            t = threading.Thread(target=waiter)
+            t = threading.Thread(target=waiter, daemon=True)
             t.start()
             time.sleep(0.15)
             m1.signal_restart()
-            t.join()
+            t.join(timeout=30)
             assert res["epoch"] == m1.current_epoch() >= 1
             assert m2.wait_restart_signal(timeout=0.2) is None
         finally:

@@ -41,12 +41,12 @@ def _submit_all(srv, examples, deadline_ms=None):
         except Exception as e:  # noqa: BLE001
             errs.append(e)
 
-    threads = [threading.Thread(target=one, args=(i,))
+    threads = [threading.Thread(target=one, args=(i,), daemon=True)
                for i in range(len(examples))]
     for t in threads:
         t.start()
     for t in threads:
-        t.join()
+        t.join(timeout=30)
     assert not errs, errs
     return futs
 
@@ -214,12 +214,12 @@ def _submit_all_predictor(pred, examples):
         except Exception as e:  # noqa: BLE001
             errs.append(e)
 
-    threads = [threading.Thread(target=one, args=(i,))
+    threads = [threading.Thread(target=one, args=(i,), daemon=True)
                for i in range(len(examples))]
     for t in threads:
         t.start()
     for t in threads:
-        t.join()
+        t.join(timeout=30)
     assert not errs, errs
     return futs
 
@@ -314,7 +314,7 @@ class TestShutdown:
         first = srv.submit(x)
         assert gate.entered.wait(10)
         queued = [srv.submit(x) for _ in range(3)]
-        t = threading.Thread(target=srv.shutdown,
+        t = threading.Thread(target=srv.shutdown, daemon=True,
                              kwargs={"drain": False})
         t.start()
         gate.release.set()

@@ -64,12 +64,12 @@ class TestShmQueue:
         def slow_pop():
             time.sleep(0.2)
             cons.pop(timeout_s=5)
-        t = threading.Thread(target=slow_pop)
+        t = threading.Thread(target=slow_pop, daemon=True)
         t.start()
         t0 = time.time()
         prod.push(b"y" * 80, timeout_s=5)  # must wait for the pop
         assert time.time() - t0 > 0.1
-        t.join()
+        t.join(timeout=30)
         prod.close()
         cons.close()
 

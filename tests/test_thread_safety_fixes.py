@@ -1,0 +1,191 @@
+"""Regression tests for the two races graft_lint surfaced (ISSUE 4
+satellite): Server._closed read outside its lock (serving/server.py,
+GL202) and MultiprocessLoaderIter.shutdown() double-closing the native
+shm rings when the consumer thread and a GC __del__ race (io/worker.py).
+
+The lint-scoped tests re-run the lock-discipline pass over the fixed
+modules: deleting either lock reintroduces the finding and fails here
+(and in tests/test_graft_lint_clean.py)."""
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.graft_lint import lint_file  # noqa: E402
+from tools.graft_lint.passes.lock_discipline import (  # noqa: E402
+    LockDisciplinePass)
+
+
+def _lock_findings(relpath):
+    """lock-discipline findings (suppressed ones included, so a fix
+    cannot be faked with a suppression comment) for one source file."""
+    findings, suppressed, err = lint_file(
+        os.path.join(REPO, relpath), [LockDisciplinePass()])
+    assert err is None, err
+    return findings + suppressed
+
+
+# -- fix 1: Server._closed reads go through the lock -------------------------
+
+def test_server_closed_flag_has_no_lock_discipline_findings():
+    """submit()/__del__ used to read _closed without the lock that
+    shutdown() writes it under — the exact GL202 shape. The fix holds
+    the lock on every read; deleting it resurrects this finding."""
+    bad = [f for f in _lock_findings("paddle_tpu/serving/server.py")
+           if f.symbol == "Server._closed"]
+    assert bad == [], [f.render() for f in bad]
+
+
+def test_serving_module_is_lock_clean():
+    for rel in ("paddle_tpu/serving/server.py",
+                "paddle_tpu/serving/batcher.py"):
+        findings, suppressed, err = lint_file(
+            os.path.join(REPO, rel), [LockDisciplinePass()])
+        assert err is None and findings == [], \
+            (rel, [f.render() for f in findings])
+
+
+def test_server_submit_after_shutdown_raises():
+    from paddle_tpu.serving import Server, ServerClosed
+
+    srv = Server(lambda x: x, max_batch_size=2, batch_timeout_ms=1.0)
+    try:
+        srv.shutdown(drain=True, timeout=5.0)
+        with pytest.raises(ServerClosed):
+            srv.submit([1.0, 2.0])
+    finally:
+        srv.shutdown(drain=False, timeout=1.0)
+
+
+# -- fix 2: loader shutdown has exactly one closer ---------------------------
+
+class _StubRing:
+    """Counts native-handle teardown calls; a tiny sleep widens the
+    race window so the unfixed check-then-swap double-closes reliably."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.mark_closed_calls = 0
+        self.close_calls = 0
+
+    def mark_closed(self):
+        with self._mu:
+            self.mark_closed_calls += 1
+        time.sleep(0.001)
+
+    def close(self):
+        with self._mu:
+            self.close_calls += 1
+        time.sleep(0.001)
+
+
+def _bare_iter(stubs):
+    """A MultiprocessLoaderIter with its post-fork state installed by
+    hand — no real workers, so the test drives shutdown() only."""
+    from paddle_tpu.io.worker import MultiprocessLoaderIter
+
+    it = MultiprocessLoaderIter.__new__(MultiprocessLoaderIter)
+    it.num_workers = len(stubs)
+    it.timeout = 1.0
+    it.queues = list(stubs)
+    it.procs = []
+    it._shutdown_lock = threading.Lock()
+    it._done = [False] * len(stubs)
+    it._started = [False] * len(stubs)
+    it._t0 = time.monotonic()
+    it._startup_grace = 0.0
+    it._next = 0
+    return it
+
+
+def test_loader_concurrent_shutdown_closes_each_ring_once():
+    """The consumer thread (StopIteration path) and GC __del__ used to
+    both pass the 'already shut down?' check and double-close the
+    native rings (shmq_close on a freed handle). With the shutdown
+    lock, exactly one caller closes."""
+    for _ in range(20):
+        stubs = [_StubRing() for _ in range(3)]
+        it = _bare_iter(stubs)
+        barrier = threading.Barrier(4)
+
+        def hammer():
+            barrier.wait()
+            it.shutdown()
+
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        for s in stubs:
+            assert s.mark_closed_calls == 1, s.mark_closed_calls
+            assert s.close_calls == 1, s.close_calls
+        assert it.queues == [] and it.procs == []
+
+
+def test_loader_next_after_shutdown_stops_cleanly():
+    """__next__ takes ring references under the shutdown lock: a
+    concurrent shutdown ends the iteration with StopIteration instead
+    of an IndexError into the emptied lists."""
+    stubs = [_StubRing()]
+    it = _bare_iter(stubs)
+    it.shutdown()
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_shm_queue_guards_closed_handle():
+    """pop()/push() after close() must never hand the native library a
+    NULL handle (the double-close fix makes this window reachable)."""
+    try:
+        from paddle_tpu.core.native import load_native
+        load_native("shm_queue")
+    except Exception as e:  # noqa: BLE001 — env-dependent toolchain
+        pytest.skip(f"native shm_queue unavailable here: {e}")
+    from paddle_tpu.io.shm_queue import ShmQueue
+
+    name = f"/ptpu_guard_{os.getpid()}_{time.monotonic_ns()}"
+    q = ShmQueue(name, capacity=1 << 16, create=True)
+    q.push(b"x", timeout_s=5)
+    q.close()
+    assert q.pop(timeout_s=1) is None
+    with pytest.raises(BrokenPipeError):
+        q.push(b"y", timeout_s=1)
+    assert q.size() == 0
+
+
+# -- bonus triage fix: Generator reseed tears (core/random.py) ---------------
+
+def test_generator_reseed_is_lock_clean_and_untorn():
+    bad = _lock_findings("paddle_tpu/core/random.py")
+    bad = [f for f in bad if f.symbol.startswith("Generator.")]
+    assert bad == [], [f.render() for f in bad]
+
+    from paddle_tpu.core.random import Generator
+
+    g = Generator(0)
+    stop = threading.Event()
+    states = []
+
+    def reader():
+        while not stop.is_set():
+            seed, _ = g.get_state()
+            states.append(seed)
+            g.next_key()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    for i in range(20):
+        g.manual_seed(i % 2)
+        _, off = g.get_state()
+        assert off >= 0
+    stop.set()
+    t.join(timeout=10)
+    assert set(states) <= {0, 1}

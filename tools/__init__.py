@@ -1,0 +1,2 @@
+# repo tooling package (makes ``python -m tools.graft_lint`` resolvable
+# from the repo root regardless of namespace-package behavior)

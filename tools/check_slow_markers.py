@@ -1,187 +1,30 @@
 #!/usr/bin/env python
-"""Lint: sleep/loop-heavy tests must carry @pytest.mark.slow.
+"""DEPRECATION SHIM — the slow-marker lint now lives in graft_lint.
 
-Tier-1 CI runs ``pytest -m 'not slow'`` inside an 870 s budget; one
-unmarked test that sleeps its way past ~5 s silently eats another file's
-share of the window. This walks every ``tests/test_*.py`` AST, estimates
-a worst-case sleep budget per test function (constant ``time.sleep``
-arguments, multiplied through constant-``range`` loops; ``while`` loops
-count x10, non-constant iterables x3, non-constant sleep args as 50 ms),
-and flags any function whose estimate exceeds the threshold without a
-``slow`` marker on itself or its class.
+The real implementation moved to ``tools/graft_lint/passes/slow_marker.py``
+(rule GL401), where it runs alongside the trace-purity / lock-discipline /
+thread-hygiene passes under one CLI::
 
-Heuristic boundaries, chosen so the estimate tracks what the test RUNS
-rather than what it merely defines: nested ``def``s (local producers/
-workers that the test then drives) are included; ``lambda`` bodies are
-not (the suite's lambdas are waiter callbacks that the code under test
-interrupts — e.g. the comm-watchdog tests hand in ``lambda:
-time.sleep(10)`` precisely to prove it never runs that long).
+    python -m tools.graft_lint tests --select GL401
 
-Usage: python tools/check_slow_markers.py [tests_dir ...]
-Exit 1 when violations exist, listing file:line, estimate, and function.
+This file keeps the original entry points (``check_file``, ``check_dirs``,
+``main``; ``python tools/check_slow_markers.py [dirs]``) so existing
+invocations and tests keep working. New callers should use graft_lint.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-THRESHOLD_S = 5.0
-UNKNOWN_SLEEP_S = 0.05     # time.sleep(<non-constant>)
-WHILE_LOOP_X = 10          # while loops: assume up to 10 iterations
-UNKNOWN_ITER_X = 3         # for loops over non-constant iterables
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # script/spec-loaded use: make `tools.` importable
+    sys.path.insert(0, _REPO)
+
+from tools.graft_lint.passes.slow_marker import (  # noqa: E402,F401
+    THRESHOLD_S, UNKNOWN_ITER_X, UNKNOWN_SLEEP_S, WHILE_LOOP_X,
+    check_dirs, check_file, main)
 
 __all__ = ["check_file", "check_dirs", "main"]
-
-
-def _is_sleep(call: ast.Call) -> bool:
-    f = call.func
-    return (isinstance(f, ast.Attribute) and f.attr == "sleep"
-            and isinstance(f.value, ast.Name) and f.value.id == "time") \
-        or (isinstance(f, ast.Name) and f.id == "sleep")
-
-
-def _const_loop_count(node: ast.For):
-    """len of a constant range(...) / list / tuple iterable, else None."""
-    it = node.iter
-    if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
-            and it.func.id == "range" and 1 <= len(it.args) <= 3:
-        vals = []
-        for a in it.args:
-            if not (isinstance(a, ast.Constant)
-                    and isinstance(a.value, (int, float))):
-                return None
-            vals.append(a.value)
-        try:
-            return max(0, len(range(*[int(v) for v in vals])))
-        except (TypeError, ValueError):
-            return None
-    if isinstance(it, (ast.List, ast.Tuple)):
-        return len(it.elts)
-    return None
-
-
-def _estimate(body, helpers=None, _resolving=None) -> float:
-    """Worst-case seconds of sleeping a statement list can do.
-
-    ``helpers`` maps module-level function names to their def nodes: a
-    DIRECT call ``helper(...)`` adds that helper's own estimate (so a
-    test that hides its poll loop in a module-level ``_wait_for_x()``
-    is still seen), while a mere reference (``Process(target=helper)``)
-    adds nothing — the callee runs in another process/thread outside
-    this test's budget. ``_resolving`` breaks recursion cycles."""
-    helpers = helpers or {}
-    _resolving = _resolving if _resolving is not None else set()
-    total = 0.0
-    for node in body:
-        if isinstance(node, (ast.For, ast.AsyncFor)):
-            n = _const_loop_count(node) if isinstance(node, ast.For) \
-                else None
-            mult = n if n is not None else UNKNOWN_ITER_X
-            total += mult * _estimate(node.body, helpers, _resolving) \
-                + _estimate(node.orelse, helpers, _resolving)
-        elif isinstance(node, ast.While):
-            total += WHILE_LOOP_X * _estimate(node.body, helpers,
-                                              _resolving) \
-                + _estimate(node.orelse, helpers, _resolving)
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            # a locally defined producer/worker the test presumably runs
-            total += _estimate(node.body, helpers, _resolving)
-        elif isinstance(node, ast.Lambda):
-            continue
-        else:
-            for child in ast.iter_child_nodes(node):
-                total += _estimate([child], helpers, _resolving)
-            if isinstance(node, ast.Call):
-                if _is_sleep(node):
-                    args = node.args
-                    if args and isinstance(args[0], ast.Constant) \
-                            and isinstance(args[0].value, (int, float)):
-                        total += float(args[0].value)
-                    else:
-                        total += UNKNOWN_SLEEP_S
-                elif isinstance(node.func, ast.Name) \
-                        and node.func.id in helpers \
-                        and node.func.id not in _resolving:
-                    _resolving.add(node.func.id)
-                    total += _estimate(helpers[node.func.id].body,
-                                       helpers, _resolving)
-                    _resolving.discard(node.func.id)
-    return total
-
-
-def _has_slow_marker(node) -> bool:
-    for dec in getattr(node, "decorator_list", []):
-        target = dec.func if isinstance(dec, ast.Call) else dec
-        # pytest.mark.slow / mark.slow / a marker list entry
-        parts = []
-        while isinstance(target, ast.Attribute):
-            parts.append(target.attr)
-            target = target.value
-        if isinstance(target, ast.Name):
-            parts.append(target.id)
-        if "slow" in parts and "mark" in parts:
-            return True
-    return False
-
-
-def check_file(path: str):
-    """Return [(lineno, qualname, estimate_s), ...] violations."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    out = []
-    helpers = {n.name: n for n in tree.body
-               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-               and not n.name.startswith("test")}
-
-    def visit_fn(fn, prefix, class_marked):
-        if not fn.name.startswith("test"):
-            return
-        if class_marked or _has_slow_marker(fn):
-            return
-        est = _estimate(fn.body, helpers)
-        if est > THRESHOLD_S:
-            out.append((fn.lineno, prefix + fn.name, est))
-
-    for node in tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            visit_fn(node, "", False)
-        elif isinstance(node, ast.ClassDef):
-            marked = _has_slow_marker(node)
-            for sub in node.body:
-                if isinstance(sub, (ast.FunctionDef,
-                                    ast.AsyncFunctionDef)):
-                    visit_fn(sub, node.name + ".", marked)
-    return out
-
-
-def check_dirs(dirs):
-    violations = []
-    for d in dirs:
-        for fname in sorted(os.listdir(d)):
-            if not (fname.startswith("test") and fname.endswith(".py")):
-                continue
-            path = os.path.join(d, fname)
-            for lineno, name, est in check_file(path):
-                violations.append((path, lineno, name, est))
-    return violations
-
-
-def main(argv=None) -> int:
-    argv = list(sys.argv[1:] if argv is None else argv)
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    dirs = argv or [os.path.join(repo, "tests")]
-    violations = check_dirs(dirs)
-    for path, lineno, name, est in violations:
-        print(f"{path}:{lineno}: {name} sleeps an estimated {est:.1f}s "
-              f"without @pytest.mark.slow")
-    if violations:
-        print(f"{len(violations)} unmarked slow test(s); mark them "
-              f"@pytest.mark.slow or shrink the sleeps")
-        return 1
-    print(f"check_slow_markers: clean ({', '.join(dirs)})")
-    return 0
-
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1,0 +1,43 @@
+"""graft_lint — trace-safety and thread-safety static analysis for
+paddle_tpu and its tests.
+
+CLI::
+
+    python -m tools.graft_lint [paths...] [--json]
+        [--select IDS] [--ignore IDS]
+        [--baseline FILE | --no-baseline] [--write-baseline]
+        [--list-rules]
+
+Passes (see README "Static analysis" for the rule table):
+
+- ``trace-purity``   (GL101-GL105): host side effects inside functions
+  that reach ``jax.jit``/``to_static``/``StaticFunction``/
+  ``create_*_train_step`` tracing.
+- ``lock-discipline`` (GL201-GL202): per-class lock inventory; flags
+  attributes written both under and outside the lock, and attributes
+  read outside the lock that guards all their writes.
+- ``thread-hygiene`` (GL301-GL302): ``threading.Thread`` without an
+  explicit ``daemon=``; blocking ``Queue.get()``/``join()`` without a
+  timeout.
+- ``slow-marker``    (GL401): the ported ``tools/check_slow_markers.py``
+  — estimated-slow tests must carry ``@pytest.mark.slow``.
+
+Suppress a finding inline (the reason is mandatory)::
+
+    self._x = 1  # graft-lint: disable=GL202 -- consumer-thread only
+
+Accept pre-existing findings wholesale in
+``tools/graft_lint/baseline.json`` (regenerate with
+``--write-baseline``); tier-1's ``tests/test_graft_lint_clean.py``
+fails on any NEW finding.
+"""
+from .core import (Baseline, Finding, LintPass, lint_file, lint_paths,
+                   iter_python_files, register, registered_passes)
+
+__all__ = ["Baseline", "Finding", "LintPass", "lint_file", "lint_paths",
+           "iter_python_files", "register", "registered_passes", "main"]
+
+
+def main(argv=None) -> int:
+    from .cli import main as _main
+    return _main(argv)

@@ -1,0 +1,8 @@
+import sys
+
+from .cli import main
+
+try:
+    sys.exit(main())
+except BrokenPipeError:   # e.g. `... --list-rules | head`
+    sys.exit(0)

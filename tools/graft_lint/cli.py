@@ -1,0 +1,131 @@
+"""graft_lint command line. See package docstring for the contract."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .core import (Baseline, all_rules, iter_python_files, lint_paths,
+                   registered_passes)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+DEFAULT_PATHS = ["paddle_tpu", "tools", "tests"]
+
+
+def _split_ids(value: Optional[str]):
+    if value is None:
+        return None
+    return {v.strip() for v in value.replace(",", " ").split() if v.strip()}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.graft_lint",
+        description="trace-safety / thread-safety static analysis for "
+                    "paddle_tpu and its tests")
+    p.add_argument("paths", nargs="*",
+                   help=f"files/dirs to lint (default: {DEFAULT_PATHS} "
+                        "relative to the repo root)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--select", metavar="IDS",
+                   help="only these rule ids / pass names "
+                        "(comma-separated, e.g. GL202,slow-marker)")
+    p.add_argument("--ignore", metavar="IDS",
+                   help="drop these rule ids / pass names")
+    p.add_argument("--baseline", metavar="FILE", default=None,
+                   help="baseline file of accepted findings "
+                        f"(default: {os.path.relpath(DEFAULT_BASELINE, _REPO)}"
+                        " when it exists)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings to the baseline "
+                        "file and exit 0")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        passes = registered_passes()
+        rows = [(rid, desc) for rid, desc in sorted(all_rules().items())]
+        if args.as_json:
+            print(json.dumps({
+                "passes": sorted(passes),
+                "rules": {rid: desc for rid, desc in rows}}, indent=1))
+        else:
+            print(f"passes: {', '.join(sorted(passes))}")
+            for rid, desc in rows:
+                print(f"  {rid}  {desc}")
+        return 0
+
+    paths = args.paths or [os.path.join(_REPO, d) for d in DEFAULT_PATHS]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"graft_lint: no such path(s): {missing}", file=sys.stderr)
+        return 2
+    if not iter_python_files(paths):
+        print("graft_lint: no python files under the given paths",
+              file=sys.stderr)
+        return 2
+
+    baseline = None
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    if not args.no_baseline and not args.write_baseline \
+            and os.path.exists(baseline_path):
+        baseline = Baseline.load(baseline_path)
+
+    result = lint_paths(paths, select=_split_ids(args.select),
+                        ignore=_split_ids(args.ignore), baseline=baseline)
+
+    if args.write_baseline:
+        # a baseline written from a partial view would silently drop the
+        # accepted findings outside that view, and the next full run
+        # fails on them with no hint why — refuse the footgun
+        if args.select or args.ignore:
+            print("graft_lint: refusing --write-baseline with "
+                  "--select/--ignore (a partial rule view would drop "
+                  "accepted findings from the baseline)", file=sys.stderr)
+            return 2
+        if baseline_path == DEFAULT_BASELINE and args.paths:
+            default_abs = {os.path.abspath(os.path.join(_REPO, d))
+                           for d in DEFAULT_PATHS}
+            if {os.path.abspath(p) for p in args.paths} != default_abs:
+                print("graft_lint: refusing to overwrite the repo "
+                      "baseline from a non-default path set (run with no "
+                      "paths, or pass an explicit --baseline FILE)",
+                      file=sys.stderr)
+                return 2
+        Baseline.write(baseline_path, result.findings)
+        print(f"graft_lint: wrote {len(result.findings)} finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps(result.to_dict(), indent=1))
+    else:
+        for f in result.findings:
+            print(f.render())
+        for e in result.errors:
+            print(f"ERROR {e}")
+        n = len(result.findings)
+        tail = (f"; {len(result.baselined)} baselined"
+                if result.baselined else "")
+        tail += (f"; {len(result.suppressed)} suppressed"
+                 if result.suppressed else "")
+        print(f"graft_lint: {n} finding(s) across "
+              f"{len(result.passes)} passes{tail}")
+    return 1 if (result.findings or result.errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,357 @@
+"""graft_lint core: findings, pass registry, suppressions, baseline, runner.
+
+The framework is jit/trace-centric (``jit.StaticFunction``, multistep
+train steps) wrapped in thread-heavy subsystems (``serving/``,
+``io/prefetch.py``) — exactly the two bug classes pure-Python review
+misses: host side effects leaking into traced code, and shared state
+touched outside its lock. graft_lint is the repo's gate for both: an
+AST-based multi-pass analyzer with one CLI, inline suppressions, and a
+findings baseline, run by tier-1 (tests/test_graft_lint_clean.py).
+
+Anatomy
+-------
+- A *pass* subclasses :class:`LintPass`, declares ``name`` + ``rules``
+  (id -> description), implements ``check_module`` returning
+  :class:`Finding`s, and registers itself with :func:`register`.
+- *Suppression*: ``# graft-lint: disable=GL202 -- why`` on the flagged
+  line (or the line directly above it). The reason after ``--`` is
+  MANDATORY: a reason-less suppression does not suppress and is itself
+  reported (GL002), so every silenced finding carries its justification
+  in the diff forever.
+- *Baseline*: a JSON file of accepted pre-existing findings matched by
+  (rule, path, symbol) — line numbers drift, fingerprints don't. New
+  findings not in the baseline fail the run; ``--write-baseline``
+  regenerates it.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from typing import Dict, List, Optional, Sequence, Tuple, Type
+
+__all__ = ["Finding", "LintPass", "register", "registered_passes",
+           "iter_python_files", "lint_file", "lint_paths", "Baseline",
+           "parse_suppressions", "SUPPRESSION_RULES"]
+
+# meta-rules emitted by the framework itself (not by any pass)
+SUPPRESSION_RULES = {
+    "GL002": "suppression comment has no reason (add '-- <why>'); it "
+             "suppresses nothing until it does",
+}
+
+
+@dataclass
+class Finding:
+    """One diagnostic. ``symbol`` is the stable fingerprint component
+    (e.g. ``Server._closed``) so baselines survive line drift."""
+
+    rule: str          # e.g. "GL202"
+    path: str          # as given on the command line
+    line: int
+    message: str
+    symbol: str = ""   # class.attr / function qualname / "" when n/a
+    pass_name: str = ""
+
+    def fingerprint(self) -> Tuple[str, str, str]:
+        return (self.rule, _norm_path(self.path),
+                self.symbol or self.message)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message,
+                "pass": self.pass_name}
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}: {self.rule}{sym} {self.message}"
+
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _norm_path(path: str) -> str:
+    """Repo-relative forward-slash form, so one baseline matches runs
+    launched with either relative or absolute paths (files outside the
+    repo — e.g. tmp fixtures — normalize to their absolute path)."""
+    ap = os.path.abspath(path)
+    rel = os.path.relpath(ap, _REPO_ROOT)
+    norm = ap if rel.startswith("..") else rel
+    return os.path.normpath(norm).replace(os.sep, "/")
+
+
+class LintPass:
+    """Base class for analysis passes. Subclass, set ``name`` and
+    ``rules`` (rule-id -> one-line description), implement
+    ``check_module``, and decorate with :func:`register`."""
+
+    name: str = ""
+    rules: Dict[str, str] = {}
+
+    def applies_to(self, path: str) -> bool:
+        """Whether this pass wants ``path`` at all (e.g. slow-marker
+        only reads test files). Default: every .py file."""
+        return True
+
+    def check_module(self, tree: ast.Module, src: str,
+                     path: str) -> List[Finding]:
+        raise NotImplementedError
+
+    def _finding(self, rule: str, path: str, line: int, message: str,
+                 symbol: str = "") -> Finding:
+        assert rule in self.rules, f"{rule} not declared by {self.name}"
+        return Finding(rule=rule, path=path, line=line, message=message,
+                       symbol=symbol, pass_name=self.name)
+
+
+_REGISTRY: Dict[str, Type[LintPass]] = {}
+
+
+def register(cls: Type[LintPass]) -> Type[LintPass]:
+    """Class decorator: add a pass to the global registry."""
+    if not cls.name:
+        raise ValueError(f"{cls.__name__} must set a pass name")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def registered_passes() -> Dict[str, Type[LintPass]]:
+    # importing the package's passes module populates the registry;
+    # done lazily so `import tools.graft_lint.core` alone stays cheap
+    from . import passes  # noqa: F401
+    return dict(_REGISTRY)
+
+
+def all_rules() -> Dict[str, str]:
+    out = dict(SUPPRESSION_RULES)
+    for cls in registered_passes().values():
+        out.update(cls.rules)
+    return out
+
+
+# -- suppressions ------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"graft-lint:\s*disable=(?P<rules>[A-Za-z0-9_,\- ]+?)"
+    r"(?:\s+--\s*(?P<reason>\S.*))?\s*$")
+
+
+def parse_suppressions(src: str):
+    """Scan comments for ``# graft-lint: disable=ID[,ID...] -- reason``.
+
+    A trailing comment silences its own line. A standalone comment
+    silences the first code line after the comment block (so a
+    multi-line reason wrapped across several ``#`` lines still reaches
+    the statement it annotates).
+
+    Returns (suppressions, bad): ``suppressions`` maps line -> set of
+    rule ids/pass names silenced at that line; ``bad`` lists
+    (line, text) for reason-less suppressions.
+    """
+    lines = src.splitlines()
+
+    def _standalone(line_no: int) -> bool:
+        if not (1 <= line_no <= len(lines)):
+            return False
+        text = lines[line_no - 1].strip()
+        return not text or text.startswith("#")
+
+    suppressions: Dict[int, set] = {}
+    bad: List[Tuple[int, str]] = []
+    try:
+        tokens = tokenize.generate_tokens(StringIO(src).readline)
+        comments = [(t.start[0], t.string) for t in tokens
+                    if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        comments = [(i + 1, line[line.index("#"):])
+                    for i, line in enumerate(src.splitlines())
+                    if "#" in line]
+    for line, text in comments:
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        if not m.group("reason"):
+            bad.append((line, text.strip()))
+            continue
+        ids = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+        targets = {line}
+        if _standalone(line):
+            nxt = line + 1
+            while _standalone(nxt) and nxt <= len(lines):
+                nxt += 1
+            targets.add(nxt)
+        for t in targets:
+            suppressions.setdefault(t, set()).update(ids)
+    return suppressions, bad
+
+
+def _is_suppressed(f: Finding, suppressions: Dict[int, set]) -> bool:
+    ids = suppressions.get(f.line)
+    return bool(ids) and (f.rule in ids or f.pass_name in ids
+                          or "all" in ids)
+
+
+# -- baseline ----------------------------------------------------------------
+
+class Baseline:
+    """Accepted pre-existing findings, matched by fingerprint with
+    multiplicity (two identical findings need two baseline entries)."""
+
+    def __init__(self, entries: Optional[List[dict]] = None):
+        self._counts: Dict[Tuple[str, str, str], int] = {}
+        for e in entries or []:
+            # stored paths are already normalized by write(): relative
+            # ones are repo-relative — resolving them against the CWD
+            # would break runs launched outside the repo root
+            path = e["path"]
+            path = _norm_path(path) if os.path.isabs(path) \
+                else os.path.normpath(path).replace(os.sep, "/")
+            fp = (e["rule"], path,
+                  e.get("symbol") or e.get("message", ""))
+            self._counts[fp] = self._counts.get(fp, 0) + 1
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path) as f:
+            data = json.load(f)
+        return cls(data.get("findings", []))
+
+    @staticmethod
+    def write(path: str, findings: Sequence[Finding]) -> None:
+        data = {"version": 1, "findings": [
+            {"rule": f.rule, "path": _norm_path(f.path),
+             "symbol": f.symbol or f.message}
+            for f in sorted(findings, key=lambda x: x.fingerprint())]}
+        with open(path, "w") as fh:
+            json.dump(data, fh, indent=1)
+            fh.write("\n")
+
+    def split(self, findings: Sequence[Finding]
+              ) -> Tuple[List[Finding], List[Finding]]:
+        """(new, baselined) — consumes baseline entries as they match."""
+        remaining = dict(self._counts)
+        new, old = [], []
+        for f in findings:
+            fp = f.fingerprint()
+            if remaining.get(fp, 0) > 0:
+                remaining[fp] -= 1
+                old.append(f)
+            else:
+                new.append(f)
+        return new, old
+
+
+# -- runner ------------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", "build", "dist", ".eggs",
+              "node_modules"}
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+            for fname in sorted(files):
+                if fname.endswith(".py"):
+                    out.append(os.path.join(root, fname))
+    return out
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)   # actionable
+    suppressed: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)         # parse failures
+    passes: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": len(self.suppressed),
+            "baselined": len(self.baselined),
+            "errors": self.errors,
+            "passes": self.passes,
+            "counts": _count_by_rule(self.findings),
+        }
+
+
+def _count_by_rule(findings: Sequence[Finding]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for f in findings:
+        out[f.rule] = out.get(f.rule, 0) + 1
+    return out
+
+
+def _rule_selected(rule: str, pass_name: str, select, ignore) -> bool:
+    def match(ids):
+        return rule in ids or pass_name in ids
+    if select is not None and not match(select):
+        return False
+    if ignore is not None and match(ignore):
+        return False
+    return True
+
+
+def lint_file(path: str, passes: Sequence[LintPass],
+              select=None, ignore=None):
+    """Run ``passes`` over one file. Returns (findings, suppressed,
+    error) — findings still include baselined ones; the caller splits.
+    """
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [], [], f"{path}: syntax error: {e.msg} (line {e.lineno})"
+    suppressions, bad = parse_suppressions(src)
+    raw: List[Finding] = []
+    for p in passes:
+        if not p.applies_to(path):
+            continue
+        raw.extend(p.check_module(tree, src, path))
+    for line, text in bad:
+        raw.append(Finding(rule="GL002", path=path, line=line,
+                           message=f"suppression without a reason: {text!r}"
+                                   " (append ' -- <why>')",
+                           symbol=f"line{line}", pass_name="core"))
+    raw.sort(key=lambda f: (f.line, f.rule))
+    kept, suppressed = [], []
+    for f in raw:
+        if not _rule_selected(f.rule, f.pass_name, select, ignore):
+            continue
+        # GL002 is the meta-rule about suppressions; it cannot itself be
+        # silenced by the comment it complains about
+        if f.rule != "GL002" and _is_suppressed(f, suppressions):
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    return kept, suppressed, None
+
+
+def lint_paths(paths: Sequence[str], select=None, ignore=None,
+               baseline: Optional[Baseline] = None) -> LintResult:
+    passes = [cls() for _, cls in sorted(registered_passes().items())]
+    result = LintResult(passes=[p.name for p in passes])
+    all_findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        found, suppressed, err = lint_file(path, passes, select, ignore)
+        all_findings.extend(found)
+        result.suppressed.extend(suppressed)
+        if err:
+            result.errors.append(err)
+    if baseline is not None:
+        result.findings, result.baselined = baseline.split(all_findings)
+    else:
+        result.findings = all_findings
+    return result

@@ -1,0 +1,290 @@
+"""lock-discipline: shared attributes touched outside the lock that
+guards them.
+
+Per class, the pass inventories lock attributes (``self._lock =
+threading.Lock()/RLock()/Condition()`` — plus any ``with self.X:`` where
+``X`` is named like a lock) and classifies every ``self.<attr>`` access
+in every method as a read or a write, under or outside a ``with
+self.<lock>:`` block. Two rules fall out:
+
+GL201 — an attribute written both under and outside the lock: the lock
+        is decorative; half the writers race the other half.
+GL202 — an attribute whose writes are all lock-guarded but that is read
+        outside the lock: the classic check-then-act / stale-read race
+        (exactly the ``Server._closed`` bug this pass was built on).
+
+Conventions the pass understands (and the codebase adopts):
+- ``__init__`` is exempt — the object is not yet published to other
+  threads while its constructor runs.
+- a method whose name ends in ``_locked`` is assumed to be called with
+  the lock already held (helpers factored out of ``with`` blocks);
+  naming it so is the fix for such helpers, not a suppression.
+- attributes holding threading primitives (the locks/events themselves)
+  are not data and are not checked.
+- writes include mutating method calls (``self.q.append(x)``,
+  ``self.d.setdefault(k, v)``) and subscript stores/deletes, traced to
+  the ``self.<attr>`` root.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, LintPass, register
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_THREADING_CTORS = _LOCK_CTORS | {"Event", "Barrier", "Thread", "Timer",
+                                  "local"}
+_LOCKY_NAME_SUFFIXES = ("lock", "cond", "mutex", "condition")
+
+# method calls that mutate their receiver
+_MUTATORS = {"append", "appendleft", "add", "clear", "extend", "insert",
+             "pop", "popleft", "popitem", "remove", "discard", "update",
+             "setdefault", "sort", "reverse", "rotate", "put",
+             "put_nowait", "extendleft", "__setitem__"}
+
+
+def _call_ctor_name(node) -> Optional[str]:
+    """threading.Lock() / mp.RLock() / Condition() -> ctor name."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _self_attr(node) -> Optional[str]:
+    """self.X -> "X" (any ctx)."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _self_attr_root(node) -> Optional[str]:
+    """Strip Attribute/Subscript/Call layers down to a self.X root:
+    self.X[k].append -> "X"; self.X.setdefault(k, d).append -> "X"."""
+    while True:
+        direct = _self_attr(node)
+        if direct is not None:
+            return direct
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        else:
+            return None
+
+
+@dataclass
+class _Access:
+    line: int
+    method: str
+    under_lock: bool
+    is_write: bool
+
+
+@dataclass
+class _ClassInfo:
+    name: str
+    lock_attrs: Set[str] = field(default_factory=set)
+    primitive_attrs: Set[str] = field(default_factory=set)
+    accesses: Dict[str, List[_Access]] = field(default_factory=dict)
+
+
+class _ClassScanner:
+    """Walk one ClassDef and record per-attribute access discipline."""
+
+    def __init__(self, cls: ast.ClassDef):
+        self.cls = cls
+        self.info = _ClassInfo(cls.name)
+        self._discover_locks()
+
+    def _discover_locks(self):
+        for node in ast.walk(self.cls):
+            if isinstance(node, ast.Assign):
+                ctor = _call_ctor_name(node.value)
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is None or ctor is None:
+                        continue
+                    if ctor in _LOCK_CTORS:
+                        self.info.lock_attrs.add(attr)
+                    if ctor in _THREADING_CTORS:
+                        self.info.primitive_attrs.add(attr)
+            elif isinstance(node, ast.With):
+                # subclasses use with self._lock: where the lock is
+                # assigned in a base class in another module
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None and attr.lower().endswith(
+                            _LOCKY_NAME_SUFFIXES):
+                        self.info.lock_attrs.add(attr)
+                        self.info.primitive_attrs.add(attr)
+
+    # -- per-method traversal -------------------------------------------
+    def scan(self) -> _ClassInfo:
+        if not self.info.lock_attrs:
+            return self.info           # class has no lock: out of scope
+        for node in self.cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name == "__init__":
+                    continue           # not yet published to threads
+                assumed = node.name.endswith("_locked")
+                self._scan_stmts(node.body, node.name, assumed)
+        return self.info
+
+    def _record(self, attr: str, line: int, method: str, under: bool,
+                write: bool):
+        if attr in self.info.primitive_attrs:
+            return
+        self.info.accesses.setdefault(attr, []).append(
+            _Access(line, method, under, write))
+
+    def _is_lock_with(self, withnode: ast.With) -> bool:
+        for item in withnode.items:
+            attr = _self_attr(item.context_expr)
+            if attr in self.info.lock_attrs:
+                return True
+            # with self._cond / cond.acquire-style: also accept
+            # self.X.acquire() context calls
+            if isinstance(item.context_expr, ast.Call):
+                root = _self_attr_root(item.context_expr.func)
+                if root in self.info.lock_attrs:
+                    return True
+        return False
+
+    def _scan_stmts(self, stmts, method: str, under: bool):
+        for node in stmts:
+            self._scan_stmt(node, method, under)
+
+    def _scan_stmt(self, node, method: str, under: bool):
+        if isinstance(node, ast.With):
+            locked = under or self._is_lock_with(node)
+            for item in node.items:
+                self._scan_expr(item.context_expr, method, under)
+            self._scan_stmts(node.body, method, locked)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def runs later (often on another thread): its
+            # body is NOT covered by the enclosing with-block
+            self._scan_stmts(node.body, f"{method}.{node.name}", False)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                self._scan_target(t, method, under)
+            self._scan_expr(node.value, method, under)
+        elif isinstance(node, ast.AugAssign):
+            self._scan_target(node.target, method, under, also_read=True)
+            self._scan_expr(node.value, method, under)
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self._scan_target(node.target, method, under)
+                self._scan_expr(node.value, method, under)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._scan_target(t, method, under)
+        else:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt):
+                    self._scan_stmt(child, method, under)
+                elif isinstance(child, ast.expr):
+                    self._scan_expr(child, method, under)
+                elif isinstance(child, (ast.excepthandler,)):
+                    self._scan_stmts(child.body, method, under)
+
+    def _scan_target(self, t, method: str, under: bool,
+                     also_read: bool = False):
+        attr = _self_attr(t)
+        if attr is not None:
+            self._record(attr, t.lineno, method, under, write=True)
+            if also_read:
+                self._record(attr, t.lineno, method, under, write=False)
+            return
+        root = _self_attr_root(t)
+        if root is not None:
+            # self.X[k] = v / del self.X[k] mutate X (and read it)
+            self._record(root, t.lineno, method, under, write=True)
+            self._record(root, t.lineno, method, under, write=False)
+        # visit index expressions etc.
+        for child in ast.iter_child_nodes(t):
+            if isinstance(child, ast.expr) and child is not t:
+                self._scan_expr(child, method, under)
+
+    def _scan_expr(self, node, method: str, under: bool):
+        if node is None:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Lambda,)):
+                continue
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in _MUTATORS \
+                    and not (sub.func.attr == "update"
+                             and len(sub.args) > 1):
+                # .update(a, b, ...) with several positional args cannot
+                # be dict.update — it's a domain method on the receiver,
+                # not a container mutation
+                root = _self_attr_root(sub.func.value)
+                if root is not None:
+                    self._record(root, sub.lineno, method, under,
+                                 write=True)
+            attr = _self_attr(sub)
+            if attr is not None and isinstance(getattr(sub, "ctx", None),
+                                               ast.Load):
+                self._record(attr, sub.lineno, method, under,
+                             write=False)
+
+
+@register
+class LockDisciplinePass(LintPass):
+    name = "lock-discipline"
+    rules = {
+        "GL201": "attribute written both under and outside the class "
+                 "lock: the unguarded writers race the guarded ones",
+        "GL202": "attribute read outside the lock that guards all of "
+                 "its writes (check-then-act / stale-read race)",
+    }
+
+    def check_module(self, tree: ast.Module, src: str,
+                     path: str) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(node, path))
+        return out
+
+    def _check_class(self, cls: ast.ClassDef, path: str) -> List[Finding]:
+        info = _ClassScanner(cls).scan()
+        out: List[Finding] = []
+        if not info.lock_attrs:
+            return out
+        for attr, accesses in sorted(info.accesses.items()):
+            writes_under = [a for a in accesses if a.is_write
+                            and a.under_lock]
+            writes_out = [a for a in accesses if a.is_write
+                          and not a.under_lock]
+            reads_out = [a for a in accesses if not a.is_write
+                         and not a.under_lock]
+            sym = f"{info.name}.{attr}"
+            if writes_under and writes_out:
+                for a in writes_out:
+                    out.append(self._finding(
+                        "GL201", path, a.line,
+                        f"{sym} is written under the lock elsewhere "
+                        f"(e.g. line {writes_under[0].line}) but "
+                        f"{a.method}() writes it without the lock",
+                        sym))
+            elif writes_under and reads_out:
+                for a in reads_out:
+                    out.append(self._finding(
+                        "GL202", path, a.line,
+                        f"{sym} is only ever written under the lock "
+                        f"(e.g. line {writes_under[0].line}) but "
+                        f"{a.method}() reads it without the lock "
+                        "(stale value / check-then-act race)", sym))
+        return out

@@ -1,0 +1,182 @@
+"""thread-hygiene: threads that outlive their owners and waits that
+cannot be interrupted.
+
+The framework's background threads (serving worker, prefetch producer,
+reader decorators, PS/elastic services) must all satisfy two shutdown
+invariants, and both are statically checkable:
+
+GL301 — ``threading.Thread(...)`` without an explicit ``daemon=``
+        argument (and no visible ``t.daemon = ...`` assignment in the
+        same scope): a non-daemon background thread blocks interpreter
+        exit when a shutdown path misses it; the choice must be
+        explicit either way.
+GL302 — a blocking wait with no timeout on an object we can resolve to
+        a ``queue.Queue``/``mp.Queue`` (``.get()``/``.join()``) or a
+        ``threading.Thread``/``mp.Process`` (``.join()``): an
+        uninterruptible wait turns a wedged peer into a wedged process;
+        shutdown paths need a timeout (or ``get_nowait``) so close()
+        stays prompt. Only receivers the pass can trace to a
+        constructor are flagged — ``dict.get()`` and friends never
+        match.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, LintPass, register
+
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+                "JoinableQueue"}
+_THREAD_CTORS = {"Thread", "Process", "Timer"}
+
+
+def _ctor_name(node) -> Optional[str]:
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _target_key(node) -> Optional[str]:
+    """Name -> "x"; self.X -> "self.X" (tracked per module, good
+    enough: classes rarely reuse attr names for different kinds)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name):
+        return f"{node.value.id}.{node.attr}"
+    return None
+
+
+def _has_kw(call: ast.Call, name: str) -> bool:
+    return any(k.arg == name for k in call.keywords)
+
+
+class _Binder(ast.NodeVisitor):
+    """module-wide map of variable/attr keys -> kind (queue/thread)."""
+
+    def __init__(self):
+        self.kinds: Dict[str, str] = {}
+
+    def visit_Assign(self, node: ast.Assign):
+        ctor = _ctor_name(node.value)
+        kind = ("queue" if ctor in _QUEUE_CTORS else
+                "thread" if ctor in _THREAD_CTORS else None)
+        if kind:
+            for t in node.targets:
+                key = _target_key(t)
+                if key:
+                    self.kinds[key] = kind
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        ctor = _ctor_name(node.value)
+        kind = ("queue" if ctor in _QUEUE_CTORS else
+                "thread" if ctor in _THREAD_CTORS else None)
+        key = _target_key(node.target)
+        if kind and key:
+            self.kinds[key] = kind
+        self.generic_visit(node)
+
+
+@register
+class ThreadHygienePass(LintPass):
+    name = "thread-hygiene"
+    rules = {
+        "GL301": "threading.Thread without an explicit daemon= (a "
+                 "forgotten non-daemon worker blocks process exit)",
+        "GL302": "blocking Queue.get()/Thread.join() with no timeout: "
+                 "a wedged peer wedges shutdown",
+    }
+
+    def check_module(self, tree: ast.Module, src: str,
+                     path: str) -> List[Finding]:
+        binder = _Binder()
+        binder.visit(tree)
+        # names whose .daemon is assigned anywhere in the module
+        daemon_assigned: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and t.attr in ("daemon",):
+                        key = _target_key(t.value)
+                        if key:
+                            daemon_assigned.add(key)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "setDaemon":
+                key = _target_key(node.func.value)
+                if key:
+                    daemon_assigned.add(key)
+
+        # Thread(...) calls assigned to a target whose .daemon is set
+        # explicitly elsewhere are already "decided" — exempt them
+        exempt_calls: Set[int] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                if _ctor_name(node.value) == "Thread":
+                    for t in targets:
+                        key = _target_key(t)
+                        if key in daemon_assigned:
+                            exempt_calls.add(id(node.value))
+
+        out: List[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            ctor = _ctor_name(node)
+            func = node.func
+            # GL301: Thread(...) with no daemon=
+            if ctor == "Thread" and isinstance(func, ast.Attribute) \
+                    and isinstance(func.value, ast.Name) \
+                    and func.value.id in ("threading",) \
+                    and not _has_kw(node, "daemon") \
+                    and id(node) not in exempt_calls:
+                out.append(self._finding(
+                    "GL301", path, node.lineno,
+                    "threading.Thread(...) without an explicit daemon= "
+                    "— decide (and show) whether this worker may "
+                    "outlive the process teardown", "Thread"))
+            # GL302: obj.get() / obj.join() with no timeout
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in ("get", "join"):
+                key = _target_key(func.value)
+                kind = binder.kinds.get(key or "")
+                if kind is None:
+                    continue
+                if kind == "queue" and func.attr == "get":
+                    blocking = not node.args and not node.keywords
+                    # get(True)/get(block=True) with no timeout
+                    if node.args and isinstance(node.args[0],
+                                                ast.Constant):
+                        blocking = node.args[0].value is True \
+                            and len(node.args) < 2
+                    if _has_kw(node, "timeout"):
+                        blocking = False
+                    for k in node.keywords:
+                        if k.arg == "block" \
+                                and isinstance(k.value, ast.Constant) \
+                                and k.value.value is False:
+                            blocking = False
+                    if blocking:
+                        out.append(self._finding(
+                            "GL302", path, node.lineno,
+                            f"{key}.get() blocks forever: pass a "
+                            "timeout (poll) so close()/shutdown stays "
+                            "prompt", f"{key}.get"))
+                elif kind == "thread" and func.attr == "join":
+                    if not node.args and not _has_kw(node, "timeout"):
+                        out.append(self._finding(
+                            "GL302", path, node.lineno,
+                            f"{key}.join() without a timeout: a wedged "
+                            "worker wedges the caller; join with a "
+                            "timeout and escalate", f"{key}.join"))
+        return out

@@ -1,0 +1,256 @@
+"""trace-purity: host side effects inside traced (jitted) functions.
+
+jax tracing runs a function ONCE with abstract values and bakes whatever
+it observes into the XLA program. Host-side effects inside that function
+are therefore silent correctness bugs: ``time.time()`` is constant-folded
+to trace time, ``print`` fires once per compile (not per step), Python/
+numpy RNG draws freeze into constants, ``.item()``/``float()`` force a
+concretization error (or a device sync at best), and global mutation
+happens at trace time only. This pass finds the functions that reach a
+tracer — ``@jax.jit``/``to_static`` decorated, or passed by name/lambda
+into ``jax.jit``/``to_static``/``StaticFunction``/
+``create_{multistep_,sharded_,}train_step``/``jit.save``-style entry
+points — and flags those constructs inside them (nested defs included:
+jax inlines everything the traced function calls locally).
+
+Rules
+-----
+GL101 wall-clock read inside a traced function
+GL102 print() inside a traced function
+GL103 host RNG (random.* / np.random.*) inside a traced function
+GL104 concretization (.item()/.numpy()/.tolist(), float/int/bool(param))
+GL105 global/nonlocal mutation declared inside a traced function
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, LintPass, register
+
+# call targets whose function-valued arguments get traced
+_TRACE_ENTRY_NAMES = {
+    "jit", "to_static", "StaticFunction", "create_train_step",
+    "create_multistep_train_step", "create_sharded_train_step",
+    "checkpoint", "remat", "grad", "value_and_grad", "vmap", "pmap",
+    "scan", "while_loop",
+}
+# decorator spellings that mark the decorated def itself as traced
+_TRACE_DECOR_LAST = {"jit", "to_static"}
+
+_WALLCLOCK_ATTRS = {"time", "perf_counter", "monotonic", "process_time",
+                    "time_ns", "perf_counter_ns", "monotonic_ns"}
+_CONCRETIZE_METHODS = {"item", "tolist", "numpy"}
+_CASTS = {"float", "int", "bool", "complex"}
+
+
+def _attr_chain(node) -> List[str]:
+    """x.y.z -> ["x", "y", "z"]; [] when the root is not a Name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+class _ModuleImports(ast.NodeVisitor):
+    """What do top-level names in this module refer to?"""
+
+    def __init__(self):
+        self.module_of: Dict[str, str] = {}   # alias -> module path
+        self.from_name: Dict[str, str] = {}   # alias -> "module.orig"
+
+    def visit_Import(self, node: ast.Import):
+        for a in node.names:
+            self.module_of[(a.asname or a.name).split(".")[0]] = a.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        for a in node.names:
+            self.from_name[a.asname or a.name] = \
+                f"{node.module or ''}.{a.name}"
+
+
+@register
+class TracePurityPass(LintPass):
+    name = "trace-purity"
+    rules = {
+        "GL101": "wall-clock read (time.time/perf_counter/...) inside a "
+                 "traced function is constant-folded at trace time",
+        "GL102": "print() inside a traced function fires per compile, "
+                 "not per step (use jax.debug.print)",
+        "GL103": "host RNG (random.*/np.random.*) inside a traced "
+                 "function freezes into a constant (use the traced key)",
+        "GL104": "concretization (.item()/.numpy()/.tolist()/float(x)) "
+                 "inside a traced function syncs or raises on tracers",
+        "GL105": "global/nonlocal mutation inside a traced function "
+                 "happens at trace time only",
+    }
+
+    # -- traced-function discovery ---------------------------------------
+    def _is_trace_decorator(self, dec) -> bool:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        chain = _attr_chain(target)
+        return bool(chain) and chain[-1] in _TRACE_DECOR_LAST
+
+    def _entry_call_name(self, call: ast.Call) -> Optional[str]:
+        chain = _attr_chain(call.func)
+        if chain and chain[-1] in _TRACE_ENTRY_NAMES:
+            return chain[-1]
+        return None
+
+    def _collect_traced(self, tree: ast.Module):
+        """Return [(fn_node, how)] of functions that reach a tracer."""
+        defs_by_name: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+        traced = []
+        seen: Set[int] = set()
+
+        def add(fn, how):
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                traced.append((fn, how))
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self._is_trace_decorator(dec):
+                        add(node, "traced decorator")
+            elif isinstance(node, ast.Call):
+                entry = self._entry_call_name(node)
+                if entry is None:
+                    continue
+                # jax.jit(fn) / to_static(fn) / create_*_train_step(fn)
+                # only the FIRST positional argument is the traced fn for
+                # every entry point we model
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Lambda):
+                        add(arg, f"lambda passed to {entry}")
+                    elif isinstance(arg, ast.Name):
+                        for fn in defs_by_name.get(arg.id, []):
+                            add(fn, f"passed to {entry}")
+        return traced
+
+    # -- purity checks inside one traced function ------------------------
+    def _check_traced_fn(self, fn, how: str, imports: _ModuleImports,
+                         path: str) -> List[Finding]:
+        out: List[Finding] = []
+        qual = getattr(fn, "name", "<lambda>")
+        params: Set[str] = set()
+        if not isinstance(fn, ast.Lambda):
+            a = fn.args
+            params = {p.arg for p in (a.posonlyargs + a.args
+                                      + a.kwonlyargs)}
+            if a.vararg:
+                params.add(a.vararg.arg)
+
+        time_mods = {alias for alias, mod in imports.module_of.items()
+                     if mod == "time"}
+        random_mods = {alias for alias, mod in imports.module_of.items()
+                       if mod == "random"}
+        numpy_mods = {alias for alias, mod in imports.module_of.items()
+                      if mod == "numpy"}
+        time_fns = {alias for alias, orig in imports.from_name.items()
+                    if orig.startswith("time.")
+                    and orig.split(".", 1)[1] in _WALLCLOCK_ATTRS}
+        random_fns = {alias for alias, orig in imports.from_name.items()
+                      if orig.startswith("random.")}
+
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for node in ast.walk(ast.Module(body=body, type_ignores=[])):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                kind = ("global" if isinstance(node, ast.Global)
+                        else "nonlocal")
+                out.append(self._finding(
+                    "GL105", path, node.lineno,
+                    f"traced function {qual!r} ({how}) declares {kind} "
+                    f"{', '.join(node.names)}: the mutation happens at "
+                    "trace time, not per step", f"{qual}.{kind}"))
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain:
+                # method call like (...).item()
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _CONCRETIZE_METHODS \
+                        and not node.args:
+                    out.append(self._finding(
+                        "GL104", path, node.lineno,
+                        f"traced function {qual!r} ({how}) calls "
+                        f".{node.func.attr}() — concretizes a tracer",
+                        f"{qual}.{node.func.attr}"))
+                continue
+            head, last = chain[0], chain[-1]
+            if len(chain) == 1:
+                if head == "print":
+                    out.append(self._finding(
+                        "GL102", path, node.lineno,
+                        f"traced function {qual!r} ({how}) calls print() "
+                        "— fires once per compile, not per step; use "
+                        "jax.debug.print", f"{qual}.print"))
+                elif head in time_fns:
+                    out.append(self._finding(
+                        "GL101", path, node.lineno,
+                        f"traced function {qual!r} ({how}) reads the "
+                        f"wall clock via {head}() — constant-folded at "
+                        "trace time", f"{qual}.{head}"))
+                elif head in random_fns:
+                    out.append(self._finding(
+                        "GL103", path, node.lineno,
+                        f"traced function {qual!r} ({how}) draws host "
+                        f"randomness via {head}() — frozen into the "
+                        "trace; thread the jax PRNG key instead",
+                        f"{qual}.{head}"))
+                elif head in _CASTS and len(node.args) == 1 \
+                        and isinstance(node.args[0], ast.Name) \
+                        and node.args[0].id in params:
+                    out.append(self._finding(
+                        "GL104", path, node.lineno,
+                        f"traced function {qual!r} ({how}) calls "
+                        f"{head}({node.args[0].id}) on a traced argument "
+                        "— raises ConcretizationTypeError under jit",
+                        f"{qual}.{head}({node.args[0].id})"))
+                continue
+            if head in time_mods and last in _WALLCLOCK_ATTRS:
+                out.append(self._finding(
+                    "GL101", path, node.lineno,
+                    f"traced function {qual!r} ({how}) reads the wall "
+                    f"clock via {'.'.join(chain)}() — constant-folded "
+                    "at trace time", f"{qual}.{'.'.join(chain)}"))
+            elif head in random_mods and len(chain) >= 2 \
+                    and chain[-1] != "seed":
+                out.append(self._finding(
+                    "GL103", path, node.lineno,
+                    f"traced function {qual!r} ({how}) draws host "
+                    f"randomness via {'.'.join(chain)}() — frozen into "
+                    "the trace", f"{qual}.{'.'.join(chain)}"))
+            elif head in numpy_mods and len(chain) >= 3 \
+                    and chain[1] == "random":
+                out.append(self._finding(
+                    "GL103", path, node.lineno,
+                    f"traced function {qual!r} ({how}) draws host "
+                    f"randomness via {'.'.join(chain)}() — frozen into "
+                    "the trace", f"{qual}.{'.'.join(chain)}"))
+            elif last in _CONCRETIZE_METHODS and not node.args \
+                    and len(chain) >= 2 and head != "np" \
+                    and head not in numpy_mods:
+                out.append(self._finding(
+                    "GL104", path, node.lineno,
+                    f"traced function {qual!r} ({how}) calls "
+                    f"{'.'.join(chain)}() — concretizes a tracer",
+                    f"{qual}.{'.'.join(chain)}"))
+        return out
+
+    def check_module(self, tree: ast.Module, src: str,
+                     path: str) -> List[Finding]:
+        imports = _ModuleImports()
+        imports.visit(tree)
+        out: List[Finding] = []
+        for fn, how in self._collect_traced(tree):
+            out.extend(self._check_traced_fn(fn, how, imports, path))
+        return out
