@@ -341,6 +341,8 @@ def main():
                 step_i += 1
             # the fetch closes the window: the scalar's bytes depend on the
             # whole step chain, so they cannot arrive before the work is done
+            # graft-lint: disable=GL504 -- timing honesty: the same-iteration
+            # sync IS the measurement (closes the timed window)
             l1 = float(jax.device_get(loss))
             best_dt = min(best_dt, time.perf_counter() - t0)
         return (batch * seq * iters / best_dt, best_dt / iters * 1e3,
@@ -373,6 +375,8 @@ def main():
                 3e-4)
             # the fetch pulls every per-step loss: bytes depend on the
             # whole K-step chain, closing the window honestly
+            # graft-lint: disable=GL504 -- timing honesty: the same-iteration
+            # sync IS the measurement (closes the timed window)
             l1 = float(jax.device_get(losses)[-1])
             best_dt = min(best_dt, time.perf_counter() - t0)
         return (batch * seq * iters / best_dt, best_dt / iters * 1e3,

@@ -228,6 +228,8 @@ def main():
         best = float("inf")
         for _ in range(3):
             t0 = time.perf_counter()
+            # graft-lint: disable=GL503 -- timing: re-dispatching the
+            # same chain and syncing on it IS the measurement
             float(jax.device_get(mm_chain(a, bmat)))
             best = min(best, time.perf_counter() - t0)
         res["measured_matmul_tflops"] = round(

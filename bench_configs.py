@@ -444,7 +444,8 @@ def bench_serving(dev, on_tpu):
                     futs[i] = srv.submit(examples[i])
 
             t0 = time.perf_counter()
-            threads = [threading.Thread(target=client, args=(c,))
+            threads = [threading.Thread(target=client, args=(c,),
+                                        daemon=True)
                        for c in range(n_clients)]
             for t in threads:
                 t.start()
@@ -520,6 +521,8 @@ def bench_input_pipeline(dev, on_tpu):
     for i in range(4):
         loss, p, s = step(p, s, jax.random.fold_in(key, 100 + i),
                           xs[i % n_steps], ys[i % n_steps], lr)
+        # graft-lint: disable=GL504 -- calibration: the per-step sync is
+        # the synchronous-step time being measured
         float(jax.device_get(loss))
     t_step = (time.perf_counter() - t0) / 4
     delay = max(0.002, 0.8 * t_step)
@@ -531,6 +534,8 @@ def bench_input_pipeline(dev, on_tpu):
     t0 = time.perf_counter()
     for i, (x, y) in enumerate(producer(delay)):
         loss, p, s = step(p, s, jax.random.fold_in(key, i), x, y, lr)
+        # graft-lint: disable=GL504 -- this loop IS the synchronous
+        # baseline the pipelined loop is measured against
         sync_losses.append(float(jax.device_get(loss)))
     t_sync = time.perf_counter() - t0
 

@@ -107,15 +107,21 @@ class GradScaler:
         if self._passthrough():
             self._found_inf = False
             return
-        found = False
+        import jax
+        finite = None
         for p in optimizer._parameter_list or []:
             if p.grad is None:
                 continue
             g = p.grad._data.astype(jnp.float32) / self._scale
-            if not bool(jnp.all(jnp.isfinite(g))):
-                found = True
+            # per-grad finite flags stay ON DEVICE and AND-reduce there;
+            # a bool() here (one blocking D2H round trip per parameter
+            # per step) is what graft_lint GL502 flags
+            ok = jnp.all(jnp.isfinite(g))
+            finite = ok if finite is None else jnp.logical_and(finite, ok)
             p.grad._data = g
-        self._found_inf = found
+        # the single host sync per step: step() must branch on found_inf
+        self._found_inf = (False if finite is None
+                           else not bool(jax.device_get(finite)))
 
     def step(self, optimizer):
         if self._passthrough():

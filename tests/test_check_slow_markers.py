@@ -101,3 +101,16 @@ def test_lambda_waiters_and_small_sleeps_pass(tmp_path):
     tool = _tool()
     vios = tool.check_dirs([str(tmp_path)])
     assert [v[2] for v in vios] == ["test_nested_producer_counted"]
+
+
+def test_shim_emits_deprecation_warning_pointing_at_gl401():
+    """The script is a shim over graft_lint GL401 (ISSUE 7 satellite):
+    importing it must say so, loudly but only as a DeprecationWarning."""
+    import warnings
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        _tool()
+    depr = [w for w in caught
+            if issubclass(w.category, DeprecationWarning)]
+    assert depr, "shim import emitted no DeprecationWarning"
+    assert "GL401" in str(depr[0].message)

@@ -457,3 +457,633 @@ def test_cli_baseline_matches_from_any_cwd(tmp_path):
     proc = _run_cli(os.path.join(REPO, "paddle_tpu"),
                     os.path.join(REPO, "tools"), cwd=str(tmp_path))
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- wave 2: device-placement (GL5xx) ----------------------------------------
+
+def _lint_hot(tmp_path, src, rel="paddle_tpu/serving/mod.py", **kw):
+    """Lint ``src`` at a hot-path location (see passes/_hotpath.py)."""
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    passes = [cls() for cls in registered_passes().values()]
+    findings, suppressed, err = lint_file(str(p), passes, **kw)
+    assert err is None, err
+    return findings, suppressed
+
+
+def test_wave2_passes_registered():
+    assert {"device-placement", "recompile-hazard"} <= set(
+        registered_passes())
+
+
+def test_gl501_float_of_device_value_in_hot_loop(tmp_path):
+    findings, _ = _lint_hot(tmp_path, """
+        import jax.numpy as jnp
+
+        def _run_loop(batches):
+            acc = jnp.zeros(())
+            out = []
+            for b in batches:
+                acc = acc + b
+                out.append(float(acc))
+                out.append(acc.item())
+            return out
+    """)
+    assert _rules(findings) == ["GL501", "GL501"]
+
+
+def test_gl501_jitted_result_is_device_seeded(tmp_path):
+    findings, _ = _lint_hot(tmp_path, """
+        import jax
+
+        step = jax.jit(lambda x: x.sum())
+
+        def _run_loop(xs):
+            out = []
+            for x in xs:
+                loss = step(x)
+                out.append(float(loss))
+            return out
+    """)
+    assert _rules(findings) == ["GL501"]
+
+
+def test_gl501_prefetch_iteration_is_device_seeded(tmp_path):
+    findings, _ = _lint_hot(tmp_path, """
+        from paddle_tpu.io.prefetch import prefetch_to_device
+
+        def _run_loop(loader):
+            for ids, labels in prefetch_to_device(loader):
+                print(float(ids))
+    """)
+    assert "GL501" in _rules(findings)
+
+
+def test_gl501_quiet_outside_hot_modules(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import jax.numpy as jnp
+
+        def _run_loop(batches):
+            acc = jnp.zeros(())
+            return [float(acc) for _ in batches]
+    """, name="cold_mod.py")
+    assert [f for f in findings if f.rule.startswith("GL5")] == []
+
+
+def test_gl502_branching_on_device_value(tmp_path):
+    findings, _ = _lint_hot(tmp_path, """
+        import jax.numpy as jnp
+
+        def _run_loop(x):
+            v = jnp.sum(x)
+            if v:
+                return 1
+            return bool(v)
+    """)
+    assert _rules(findings) == ["GL502", "GL502"]
+
+
+def test_gl503_loop_invariant_device_get_carries_hoist_fix(tmp_path):
+    findings, _ = _lint_hot(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        base = jnp.ones(())
+
+        def _run_loop(batches):
+            out = []
+            for b in batches:
+                ref = jax.device_get(base)
+                out.append(ref + b)
+            return out
+    """)
+    assert _rules(findings) == ["GL503"]
+    assert findings[0].fix is not None, "GL503 must be autofixable"
+
+
+def test_gl504_same_iteration_fetch_flagged(tmp_path):
+    findings, _ = _lint_hot(tmp_path, """
+        import jax
+
+        def _run_loop(step, batches):
+            out = []
+            for b in batches:
+                loss = step(b)
+                out.append(jax.device_get(loss))
+            return out
+    """)
+    assert _rules(findings) == ["GL504"]
+
+
+def test_gl504_lagged_fetch_allowance(tmp_path):
+    """The one-step-behind idiom (trainer.run_steps): the fetched name
+    is reassigned AFTER the fetch, so the fetch reads the previous
+    iteration's value — not a defect."""
+    findings, _ = _lint_hot(tmp_path, """
+        import jax
+
+        def _run_loop(step, batches):
+            out = []
+            pending = None
+            for b in batches:
+                if pending is not None:
+                    out.append(jax.device_get(pending))
+                pending = step(b)
+            if pending is not None:
+                out.append(jax.device_get(pending))
+            return out
+    """)
+    assert [f for f in findings if f.rule.startswith("GL5")] == []
+
+
+def test_gl504_lagged_fetch_through_local_helper(tmp_path):
+    """run_steps routes the lagged fetch through a nested helper; the
+    allowance must follow device_get into local defs."""
+    findings, _ = _lint_hot(tmp_path, """
+        import jax
+
+        def _run_loop(step, batches):
+            out = []
+
+            def fetch(val):
+                out.append(jax.device_get(val))
+
+            pending = None
+            for b in batches:
+                if pending is not None:
+                    fetch(pending)
+                pending = step(b)
+            return out
+    """)
+    assert [f for f in findings if f.rule.startswith("GL5")] == []
+
+
+def test_gl505_param_derived_materialization_and_upload_exemption(
+        tmp_path):
+    findings, _ = _lint_hot(tmp_path, """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def _produce(items):
+            return np.stack(items)
+
+        def next_batch(items):
+            return jnp.asarray(np.stack(items))
+    """, rel="paddle_tpu/io/mod.py")
+    assert _rules(findings) == ["GL505"]
+    assert findings[0].symbol == "_produce.np.stack"
+
+
+# -- wave 2: recompile-hazard (GL6xx) ----------------------------------------
+
+def test_gl601_loop_varying_shape_argument(tmp_path):
+    findings, _ = _lint_hot(tmp_path, """
+        import jax
+        import numpy as np
+
+        step = jax.jit(lambda x: x.sum())
+
+        def bench_loop(sizes):
+            out = []
+            for n in sizes:
+                out.append(step(np.zeros(n)))
+            out.append(step(np.zeros(128)))
+            return out
+    """, rel="bench_mod.py")
+    assert [f.rule for f in findings if f.rule == "GL601"] == ["GL601"]
+
+
+def test_gl601_loop_varying_slice(tmp_path):
+    findings, _ = _lint_hot(tmp_path, """
+        import jax
+
+        step = jax.jit(lambda x: x.sum())
+
+        def bench_loop(x, lens):
+            out = []
+            for n in lens:
+                out.append(step(x[:n]))
+            return out
+    """, rel="bench_mod2.py")
+    assert "GL601" in _rules(findings)
+
+
+def test_gl602_non_hashable_and_array_static_args(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import jax
+        import numpy as np
+
+        f = jax.jit(lambda a, b: a, static_argnums=1)
+        arr = np.zeros(3)
+
+        def call_list(x):
+            return f(x, [1, 2])
+
+        def call_array(x):
+            return f(x, arr)
+    """)
+    assert _rules(findings) == ["GL602", "GL602"]
+
+
+def test_gl602_loop_varying_static_arg(tmp_path):
+    findings, _ = _lint_hot(tmp_path, """
+        import jax
+
+        f = jax.jit(lambda a, b: a * b, static_argnums=1)
+
+        def bench_loop(x):
+            out = []
+            for i in range(10):
+                out.append(f(x, i))
+            return out
+    """, rel="bench_mod.py")
+    assert "GL602" in _rules(findings)
+
+
+def test_gl603_traced_closure_over_mutable_global(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import jax
+
+        scale = 1.0
+        LIMIT = 8.0
+
+        def bump():
+            global scale
+            scale = scale * 2
+
+        @jax.jit
+        def fn(x):
+            return x * scale + LIMIT
+    """)
+    assert _rules(findings) == ["GL603"]
+    assert findings[0].symbol == "fn.scale"
+
+
+def test_gl603_quiet_for_constants_and_untraced_readers(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import jax
+
+        factor = 2.0
+
+        @jax.jit
+        def fn(x):
+            return x * factor
+
+        def host_reader():
+            return factor
+    """)
+    assert [f for f in findings if f.rule == "GL603"] == []
+
+
+def test_gl604_shape_branch_around_jitted_dispatch(tmp_path):
+    findings, _ = _lint_hot(tmp_path, """
+        import jax
+
+        pred = jax.jit(lambda x: x * 2)
+
+        def _execute(self, x):
+            if x.shape[0] > 4:
+                return pred(x)
+            return pred(x[:4])
+    """)
+    assert "GL604" in _rules(findings)
+
+
+def test_gl604_quiet_when_bucketing_is_involved(tmp_path):
+    findings, _ = _lint_hot(tmp_path, """
+        import jax
+
+        pred = jax.jit(lambda x: x * 2)
+
+        def _execute(self, x, buckets):
+            b = next_bucket(x.shape[0], buckets)
+            if x.shape[0] != b:
+                x = pad_to(x, b)
+            return pred(x)
+    """)
+    assert [f for f in findings if f.rule == "GL604"] == []
+
+
+# -- wave 2: family-prefix selection + autofix + prune -----------------------
+
+_SYNCY_HOT = """
+    import jax
+    import jax.numpy as jnp
+    import threading
+
+    base = jnp.ones(())
+
+    def _run_loop(batches, q):
+        t = threading.Thread(target=print)
+        out = []
+        for b in batches:
+            ref = jax.device_get(base)
+            out.append(float(jnp.zeros(()) + b) + ref)
+        return out
+"""
+
+
+def test_family_prefix_select_and_ignore(tmp_path):
+    findings, _ = _lint_hot(tmp_path, _SYNCY_HOT, select={"GL5"},
+                            rel="paddle_tpu/serving/fam.py")
+    assert findings and all(f.rule.startswith("GL5") for f in findings)
+    findings, _ = _lint_hot(tmp_path, _SYNCY_HOT, ignore={"GL5"},
+                            rel="paddle_tpu/serving/fam2.py")
+    assert findings and not any(f.rule.startswith("GL5")
+                                for f in findings)
+    # exact ids still work alongside families
+    findings, _ = _lint_hot(tmp_path, _SYNCY_HOT,
+                            select={"GL503", "GL301"},
+                            rel="paddle_tpu/serving/fam3.py")
+    assert set(_rules(findings)) == {"GL503", "GL301"}
+
+
+def test_cli_list_rules_groups_by_pass():
+    proc = _run_cli("--list-rules", "--json")
+    assert proc.returncode == 0
+    data = json.loads(proc.stdout)
+    assert "GL501" in data["groups"]["device-placement"]
+    assert "GL601" in data["groups"]["recompile-hazard"]
+    assert "GL002" in data["groups"]["core"]
+    # flat view stays for old consumers
+    assert "GL604" in data["rules"]
+
+
+def test_cli_fix_diff_is_a_dry_run(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+        t = threading.Thread(target=print)
+    """))
+    before = mod.read_text()
+    proc = _run_cli(str(tmp_path), "--no-baseline", "--fix", "--diff")
+    assert "+t = threading.Thread(target=print, daemon=True)" \
+        in proc.stdout
+    assert mod.read_text() == before, "--fix --diff must not write"
+
+
+def test_cli_fix_applies_and_is_idempotent(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+        import queue
+
+        q = queue.Queue()
+        t = threading.Thread(target=print)
+        x = 1  # graft-lint: disable=GL202
+
+        def waiter():
+            q.get()
+            t.join()
+    """))
+    proc = _run_cli(str(tmp_path), "--no-baseline", "--fix")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "applied 4 fix(es)" in proc.stdout
+    fixed = mod.read_text()
+    assert "daemon=True" in fixed
+    assert "q.get(timeout=5.0)" in fixed
+    assert "t.join(timeout=5.0)" in fixed
+    assert "-- TODO: justify this suppression" in fixed
+    # second run: nothing left to do, file untouched
+    proc = _run_cli(str(tmp_path), "--no-baseline", "--fix")
+    assert proc.returncode == 0
+    assert "applied 0 fix(es)" in proc.stdout
+    assert mod.read_text() == fixed
+
+
+def test_cli_fix_hoists_loop_invariant_device_get(tmp_path):
+    sub = tmp_path / "paddle_tpu" / "io"
+    sub.mkdir(parents=True)
+    mod = sub / "mod.py"
+    mod.write_text(textwrap.dedent("""
+        import jax
+        import jax.numpy as jnp
+
+        base = jnp.ones(())
+
+        def _produce(batches):
+            out = []
+            for b in batches:
+                ref = jax.device_get(base)
+                out.append(ref + b)
+            return out
+    """))
+    proc = _run_cli(str(tmp_path), "--no-baseline", "--fix")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    fixed = mod.read_text()
+    lines = [l.strip() for l in fixed.splitlines()]
+    hoisted = lines.index("ref = jax.device_get(base)")
+    assert lines[hoisted + 1].startswith("for b in batches"), fixed
+    # idempotent: re-run reports nothing to fix
+    proc = _run_cli(str(tmp_path), "--no-baseline", "--fix")
+    assert "applied 0 fix(es)" in proc.stdout
+
+
+def test_cli_prune_baseline_drops_stale_entries(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+        t = threading.Thread(target=print)
+    """))
+    bl = tmp_path / "bl.json"
+    proc = _run_cli(str(tmp_path), "--baseline", str(bl),
+                    "--write-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # the finding goes away; its baseline entry is now stale
+    mod.write_text("import threading\n"
+                   "t = threading.Thread(target=print, daemon=True)\n")
+    proc = _run_cli(str(tmp_path), "--baseline", str(bl),
+                    "--prune-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "pruned 1 stale baseline entry" in proc.stdout
+    data = json.loads(bl.read_text())
+    assert data["findings"] == []
+    # idempotent
+    proc = _run_cli(str(tmp_path), "--baseline", str(bl),
+                    "--prune-baseline")
+    assert "pruned 0 stale baseline entries" in proc.stdout
+
+
+def test_cli_prune_baseline_refuses_partial_views(tmp_path):
+    (tmp_path / "m.py").write_text("x = 1\n")
+    bl = tmp_path / "bl.json"
+    bl.write_text('{"version": 1, "findings": []}\n')
+    proc = _run_cli(str(tmp_path), "--baseline", str(bl),
+                    "--select", "GL202", "--prune-baseline")
+    assert proc.returncode == 2 and "refusing" in proc.stderr
+
+
+# -- review fixes: lattice precision and fix-engine safety -------------------
+
+def test_gl502_identity_comparison_is_not_a_sync(tmp_path):
+    """`pending is not None` is a host identity test even when pending
+    is a device value (module-level jitted step) — flagging it would
+    penalize the blessed lagged-fetch idiom itself."""
+    findings, _ = _lint_hot(tmp_path, """
+        import jax
+
+        step = jax.jit(lambda x: x * 2)
+
+        def _run_loop(batches):
+            out = []
+            pending = None
+            for b in batches:
+                if pending is not None:
+                    out.append(jax.device_get(pending))
+                pending = step(b)
+            if pending is not None:
+                out.append(jax.device_get(pending))
+            return out
+    """)
+    assert [f for f in findings if f.rule.startswith("GL5")] == []
+
+
+def test_gl501_same_name_rebind_is_flagged(tmp_path):
+    """`acc = float(acc)` must be checked against the PRE-assignment
+    lattice: the rebind to host happens after the blocking sync."""
+    findings, _ = _lint_hot(tmp_path, """
+        import jax
+
+        step = jax.jit(lambda x: x * 2)
+
+        def _run_loop(batches):
+            hist = []
+            for b in batches:
+                acc = step(b)
+                acc = float(acc)
+                hist.append(acc)
+            return hist
+    """)
+    assert "GL501" in _rules(findings)
+
+
+def test_fix_hoist_refuses_sole_statement_loop_body(tmp_path):
+    """Hoisting a loop's only statement would leave an empty body —
+    the fix must be refused and the file left untouched (and valid)."""
+    import ast
+    sub = tmp_path / "paddle_tpu" / "io"
+    sub.mkdir(parents=True)
+    mod = sub / "mod.py"
+    mod.write_text(textwrap.dedent("""
+        import jax
+        import jax.numpy as jnp
+
+        base = jnp.ones(())
+
+        def _produce(batches):
+            for b in batches:
+                ref = jax.device_get(base)
+    """))
+    before = mod.read_text()
+    proc = _run_cli(str(tmp_path), "--no-baseline", "--fix")
+    assert mod.read_text() == before, "sole-statement hoist must refuse"
+    ast.parse(mod.read_text())
+
+
+def test_fix_hoist_refuses_statement_nested_in_guard(tmp_path):
+    """A fetch under `if cond:` inside the loop is conditional; hoisting
+    it above the loop would un-condition it — refuse."""
+    sub = tmp_path / "paddle_tpu" / "io"
+    sub.mkdir(parents=True)
+    mod = sub / "mod.py"
+    mod.write_text(textwrap.dedent("""
+        import jax
+        import jax.numpy as jnp
+
+        base = jnp.ones(())
+
+        def _produce(batches, verbose):
+            out = []
+            for b in batches:
+                if verbose:
+                    ref = jax.device_get(base)
+                    out.append(ref)
+                out.append(b)
+            return out
+    """))
+    before = mod.read_text()
+    proc = _run_cli(str(tmp_path), "--no-baseline", "--fix")
+    assert mod.read_text() == before, "guarded hoist must refuse"
+
+
+def test_fix_keyword_insert_with_trailing_comma_comment(tmp_path):
+    """A trailing comma hidden behind a comment must not produce a
+    double comma — the rewrite has to stay valid Python."""
+    import ast
+    mod = tmp_path / "mod.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+        t = threading.Thread(
+            target=print,  # worker
+        )
+    """))
+    proc = _run_cli(str(tmp_path), "--no-baseline", "--fix")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    fixed = mod.read_text()
+    ast.parse(fixed)
+    assert "daemon=True" in fixed
+    # idempotent second run
+    proc = _run_cli(str(tmp_path), "--no-baseline", "--fix")
+    assert "applied 0 fix(es)" in (proc.stdout + proc.stderr)
+    assert mod.read_text() == fixed
+
+
+def test_cli_fix_json_stdout_is_pure_json(tmp_path):
+    """--fix --json: the fix summary (and --diff output) go to stderr;
+    stdout must stay a single machine-readable JSON document."""
+    mod = tmp_path / "mod.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+        t = threading.Thread(target=print)
+    """))
+    proc = _run_cli(str(tmp_path), "--no-baseline", "--fix", "--diff",
+                    "--json")
+    data = json.loads(proc.stdout)   # must not raise
+    assert "would apply 1 fix(es)" in proc.stderr
+    assert "+t = threading.Thread(target=print, daemon=True)" \
+        in proc.stderr
+    proc = _run_cli(str(tmp_path), "--no-baseline", "--fix", "--json")
+    data = json.loads(proc.stdout)
+    assert data["findings"] == []
+    assert "applied 1 fix(es)" in proc.stderr
+
+
+def test_assigned_names_handles_with_as_in_loop(tmp_path):
+    """`with ... as fh:` inside a hot loop goes through the shared
+    assigned_names helper — withitem nodes carry no lineno of their own
+    and must not crash the pass."""
+    findings, _ = _lint_hot(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        base = jnp.ones(())
+
+        def _produce(paths, batches):
+            out = []
+            for p in paths:
+                with open(p) as fh:
+                    ref = jax.device_get(base)
+                    out.append((fh.read(), ref))
+            return out
+    """, rel="paddle_tpu/io/mod.py")
+    assert "GL503" in _rules(findings)
+
+
+def test_bench_hotness_is_repo_root_only(tmp_path):
+    """bench*.py is a hot module at the repo ROOT; a bench-named helper
+    inside a subsystem tree (tools/bench_utils.py) must not silently
+    make its every top-level function a hot root."""
+    src = """
+        import jax.numpy as jnp
+
+        def summarize(batches):
+            total = 0.0
+            for b in batches:
+                total += float(jnp.sum(b))
+            return total
+    """
+    findings, _ = _lint_hot(tmp_path, src, rel="tools/bench_utils.py")
+    assert [f for f in findings if f.rule.startswith("GL5")] == []
+    findings, _ = _lint_hot(tmp_path, src, rel="bench_utils.py")
+    assert "GL501" in _rules(findings)

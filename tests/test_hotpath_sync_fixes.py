@@ -1,0 +1,184 @@
+"""Regression tests for the hot-path host syncs graft_lint's wave-2
+passes surfaced (ISSUE 7 satellite):
+
+- ``amp.GradScaler.unscale_`` used to ``bool(jnp.all(jnp.isfinite(g)))``
+  PER PARAMETER — N blocking D2H round trips every optimizer step (the
+  GL502 shape the device-placement pass flags). The fix AND-reduces the
+  finite flags on device and pays exactly ONE host sync per step.
+- the serving ``_CallableExecutor`` converted batch outputs to numpy
+  INSIDE the executor lock; dispatch is async, so the conversion is
+  where the device wait lands — every concurrent caller (warmup, a
+  second client thread) serialized behind the whole batch execution.
+
+The lint-scoped tests re-run the device-placement pass over the fixed
+modules with suppressions counted as failures, so neither fix can be
+faked with a suppression comment."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import paddle_tpu as paddle  # noqa: E402
+from tools.graft_lint import lint_file  # noqa: E402
+from tools.graft_lint.passes.device_placement import (  # noqa: E402
+    DevicePlacementPass)
+
+
+def _fp16_scaler(monkeypatch, init_scale=2.0):
+    """A GradScaler on the real (non-passthrough) float16 path."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import amp_state
+    monkeypatch.setattr(amp_state.STATE, "dtype", jnp.float16)
+    return paddle.amp.GradScaler(init_loss_scaling=init_scale)
+
+
+def _opt_with_grads(n_params=8, grad_value=2.0):
+    params = [paddle.nn.Parameter(np.ones((4,), np.float32))
+              for _ in range(n_params)]
+    opt = paddle.optimizer.SGD(0.1, parameters=params)
+    for p in params:
+        p.grad = paddle.to_tensor(np.full((4,), grad_value, np.float32))
+    return opt, params
+
+
+# -- fix 1: GradScaler.unscale_ syncs once, not once per param ---------------
+
+def test_unscale_pays_one_host_sync_for_many_params(monkeypatch):
+    import jax
+    scaler = _fp16_scaler(monkeypatch, init_scale=2.0)
+    opt, params = _opt_with_grads(n_params=8)
+
+    calls = []
+    real_get = jax.device_get
+    monkeypatch.setattr(jax, "device_get",
+                        lambda v: (calls.append(1), real_get(v))[1])
+    scaler.unscale_(opt)
+    # the defect was 8 per-param bool() syncs (and zero device_gets);
+    # the fix is exactly one device_get for the AND-reduced flag
+    assert len(calls) == 1, f"expected 1 batched sync, saw {len(calls)}"
+    assert scaler._found_inf is False
+    np.testing.assert_allclose(params[0].grad.numpy(),
+                               np.full((4,), 1.0), rtol=1e-6)
+
+
+def test_unscale_still_detects_inf_and_nan(monkeypatch):
+    scaler = _fp16_scaler(monkeypatch, init_scale=2.0)
+    opt, params = _opt_with_grads(n_params=4)
+    params[2].grad = paddle.to_tensor(
+        np.array([1.0, np.inf, 1.0, 1.0], np.float32))
+    scaler.unscale_(opt)
+    assert scaler._found_inf is True
+
+    opt2, params2 = _opt_with_grads(n_params=3)
+    params2[0].grad = paddle.to_tensor(
+        np.array([np.nan, 1.0, 1.0, 1.0], np.float32))
+    scaler.unscale_(opt2)
+    assert scaler._found_inf is True
+
+
+def test_scaler_step_skips_update_on_inf_and_decays_scale(monkeypatch):
+    scaler = _fp16_scaler(monkeypatch, init_scale=4.0)
+    opt, params = _opt_with_grads(n_params=2)
+    before = params[0].numpy().copy()
+    params[1].grad = paddle.to_tensor(
+        np.full((4,), np.inf, np.float32))
+    scaler.step(opt)
+    # inf grad: the optimizer step must be skipped and the scale halved
+    np.testing.assert_array_equal(params[0].numpy(), before)
+    assert scaler._scale == pytest.approx(2.0)
+
+
+def test_unscale_handles_empty_param_list(monkeypatch):
+    scaler = _fp16_scaler(monkeypatch)
+    opt = paddle.optimizer.SGD(0.1, parameters=[paddle.nn.Parameter(
+        np.ones((2,), np.float32))])
+    # no grads at all -> no sync, no inf
+    scaler.unscale_(opt)
+    assert scaler._found_inf is False
+
+
+def test_amp_module_is_device_placement_clean():
+    """Reintroducing a per-param bool()/float() sync in the scaler
+    re-fails this (the amp module is part of graft_lint's hot-path
+    model; suppressions count as failures here)."""
+    findings, suppressed, err = lint_file(
+        os.path.join(REPO, "paddle_tpu", "amp", "__init__.py"),
+        [DevicePlacementPass()])
+    assert err is None
+    assert findings + suppressed == [], \
+        [f.render() for f in findings + suppressed]
+
+
+# -- fix 2: serving output conversion happens outside the executor lock ------
+
+class _Probe:
+    """Pretends to be a batched model output; records whether the
+    executor lock was held when numpy first materialized it."""
+
+    def __init__(self, batch, lock_ref):
+        self._batch = batch
+        self._lock_ref = lock_ref
+        self.locked_during_conversion = None
+
+    def __array__(self, dtype=None, copy=None):
+        if self.locked_during_conversion is None:
+            self.locked_during_conversion = self._lock_ref[0].locked()
+        arr = np.zeros((self._batch, 4), np.float32)
+        return arr.astype(dtype) if dtype is not None else arr
+
+
+def test_serving_converts_outputs_outside_executor_lock():
+    from paddle_tpu import serving
+
+    lock_ref = [None]
+    probes = []
+
+    def model(x):
+        p = _Probe(x.shape[0], lock_ref)
+        probes.append(p)
+        return p
+
+    srv = serving.Server(model, max_batch_size=2, batch_timeout_ms=1.0)
+    lock_ref[0] = srv._executor._lock
+    try:
+        out = srv.submit(np.zeros((4,), np.float32)).result(timeout=30)
+        assert out.shape == (4,)
+    finally:
+        srv.shutdown()
+    assert probes, "model was never executed"
+    assert all(p.locked_during_conversion is False for p in probes), \
+        "output D2H conversion ran while holding the executor lock"
+
+
+def test_serving_module_is_device_placement_clean():
+    """server.py must stay free of device-placement findings; the one
+    documented suppression is the admission-side host staging in
+    submit()."""
+    findings, suppressed, err = lint_file(
+        os.path.join(REPO, "paddle_tpu", "serving", "server.py"),
+        [DevicePlacementPass()])
+    assert err is None
+    assert findings == [], [f.render() for f in findings]
+    assert [s.symbol for s in suppressed] == ["submit.np.asarray"]
+
+def test_to_numpy_duck_types_foreign_numpy_wrappers():
+    """A wrapped callable may return objects exposing only a .numpy()
+    method (no __array__): _to_numpy must convert through it instead of
+    handing back a 0-d object array around the wrapper."""
+    from paddle_tpu.serving.server import _to_numpy
+
+    class Foreign:
+        def numpy(self):
+            return np.arange(6, dtype=np.float32).reshape(2, 3)
+
+    outs = _to_numpy([Foreign(), np.ones((2,), np.float32)])
+    assert outs[0].dtype == np.float32 and outs[0].shape == (2, 3)
+    np.testing.assert_array_equal(
+        outs[0], np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert outs[1].dtype == np.float32

@@ -15,10 +15,17 @@ from __future__ import annotations
 
 import os
 import sys
+import warnings
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:  # script/spec-loaded use: make `tools.` importable
     sys.path.insert(0, _REPO)
+
+warnings.warn(
+    "tools/check_slow_markers.py is a deprecation shim: the slow-marker "
+    "lint is graft_lint rule GL401 — run "
+    "'python -m tools.graft_lint tests --select GL401' instead",
+    DeprecationWarning, stacklevel=2)
 
 from tools.graft_lint.passes.slow_marker import (  # noqa: E402,F401
     THRESHOLD_S, UNKNOWN_ITER_X, UNKNOWN_SLEEP_S, WHILE_LOOP_X,

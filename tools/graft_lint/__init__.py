@@ -4,8 +4,10 @@ paddle_tpu and its tests.
 CLI::
 
     python -m tools.graft_lint [paths...] [--json]
-        [--select IDS] [--ignore IDS]
-        [--baseline FILE | --no-baseline] [--write-baseline]
+        [--select IDS] [--ignore IDS]          # ids, families (GL5), passes
+        [--baseline FILE | --no-baseline]
+        [--write-baseline | --prune-baseline]
+        [--fix [--diff]]
         [--list-rules]
 
 Passes (see README "Static analysis" for the rule table):
@@ -21,6 +23,16 @@ Passes (see README "Static analysis" for the rule table):
   timeout.
 - ``slow-marker``    (GL401): the ported ``tools/check_slow_markers.py``
   — estimated-slow tests must carry ``@pytest.mark.slow``.
+- ``device-placement`` (GL501-GL505): host materializations/syncs of
+  device values on the hot path (serving/io/trainer/amp + bench
+  files), with the lagged one-step-behind fetch allowance.
+- ``recompile-hazard`` (GL601-GL604): loop-varying shapes into jitted
+  calls, ``static_argnums`` misuse, traced closures over mutable
+  module globals, bucketless shape-dependent dispatch.
+
+``--fix`` applies the conservative mechanical repairs attached to
+GL002/GL301/GL302/GL503 findings (exact-span edits, idempotent);
+``--fix --diff`` previews them without writing.
 
 Suppress a finding inline (the reason is mandatory)::
 

@@ -5,10 +5,10 @@ import argparse
 import json
 import os
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
-from .core import (Baseline, all_rules, iter_python_files, lint_paths,
-                   registered_passes)
+from .core import (SUPPRESSION_RULES, Baseline, _norm_path, all_rules,
+                   iter_python_files, lint_paths, registered_passes)
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -26,18 +26,21 @@ def _split_ids(value: Optional[str]):
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m tools.graft_lint",
-        description="trace-safety / thread-safety static analysis for "
-                    "paddle_tpu and its tests")
+        description="trace-safety / thread-safety / device-placement / "
+                    "recompile-hazard static analysis for paddle_tpu "
+                    "and its tests")
     p.add_argument("paths", nargs="*",
                    help=f"files/dirs to lint (default: {DEFAULT_PATHS} "
                         "relative to the repo root)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
     p.add_argument("--select", metavar="IDS",
-                   help="only these rule ids / pass names "
-                        "(comma-separated, e.g. GL202,slow-marker)")
+                   help="only these rule ids, rule families, or pass "
+                        "names (comma-separated, e.g. "
+                        "GL202,GL5,slow-marker — GL5 selects every "
+                        "GL5xx rule)")
     p.add_argument("--ignore", metavar="IDS",
-                   help="drop these rule ids / pass names")
+                   help="drop these rule ids / families / pass names")
     p.add_argument("--baseline", metavar="FILE", default=None,
                    help="baseline file of accepted findings "
                         f"(default: {os.path.relpath(DEFAULT_BASELINE, _REPO)}"
@@ -47,26 +50,145 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--write-baseline", action="store_true",
                    help="write the current findings to the baseline "
                         "file and exit 0")
+    p.add_argument("--prune-baseline", action="store_true",
+                   help="drop baseline entries whose fingerprint no "
+                        "longer matches any live finding, keep the "
+                        "rest, and exit 0")
+    p.add_argument("--fix", action="store_true",
+                   help="apply the mechanical repairs attached to "
+                        "autofixable findings (GL002/GL301/GL302/GL503); "
+                        "second run is a no-op")
+    p.add_argument("--diff", action="store_true",
+                   help="with --fix: print the unified diff of what "
+                        "--fix would change, write nothing")
     p.add_argument("--list-rules", action="store_true",
-                   help="print the rule table and exit")
+                   help="print the rule table grouped by pass and exit")
     return p
+
+
+def _list_rules(as_json: bool) -> int:
+    passes = registered_passes()
+    groups: Dict[str, Dict[str, str]] = {
+        "core": dict(SUPPRESSION_RULES)}
+    for name, cls in sorted(passes.items()):
+        groups[name] = dict(sorted(cls.rules.items()))
+    if as_json:
+        print(json.dumps({
+            "passes": sorted(passes),
+            "groups": groups,
+            "rules": {rid: desc for rid, desc in
+                      sorted(all_rules().items())}}, indent=1))
+    else:
+        for name in ["core"] + sorted(passes):
+            if name == "core":
+                doc = "framework meta-rules (suppression hygiene)"
+            else:
+                cls = passes[name]
+                raw = (cls.__doc__
+                       or sys.modules[cls.__module__].__doc__ or "")
+                lines = raw.strip().splitlines()
+                doc = lines[0].rstrip(".") if lines else ""
+            print(f"{name}: {doc}" if doc else name)
+            for rid, desc in sorted(groups[name].items()):
+                print(f"  {rid}  {desc}")
+    return 0
+
+
+def _prune_baseline(baseline_path: str, paths: List[str]) -> int:
+    if not os.path.exists(baseline_path):
+        print(f"graft_lint: no baseline at {baseline_path}",
+              file=sys.stderr)
+        return 2
+    with open(baseline_path) as f:
+        data = json.load(f)
+    entries = data.get("findings", [])
+    # live fingerprints with multiplicity, from a baseline-free run
+    result = lint_paths(paths)
+    live: Dict[tuple, int] = {}
+    for f in result.findings:
+        fp = f.fingerprint()
+        live[fp] = live.get(fp, 0) + 1
+    kept, dropped = [], 0
+    for e in entries:
+        path = e["path"]
+        path = _norm_path(path) if os.path.isabs(path) \
+            else os.path.normpath(path).replace(os.sep, "/")
+        fp = (e["rule"], path, e.get("symbol") or e.get("message", ""))
+        if live.get(fp, 0) > 0:
+            live[fp] -= 1
+            kept.append(e)
+        else:
+            dropped += 1
+    if dropped:
+        data["findings"] = kept
+        with open(baseline_path, "w") as fh:
+            json.dump(data, fh, indent=1)
+            fh.write("\n")
+    print(f"graft_lint: pruned {dropped} stale baseline entr"
+          f"{'y' if dropped == 1 else 'ies'}; {len(kept)} kept")
+    return 0
+
+
+def _apply_fixes(result, diff_only: bool, stream):
+    """Apply (or diff) every fix attached to an actionable finding.
+    Returns (n_applied, n_files, n_skipped, fixed_findings)."""
+    import ast as _ast
+
+    from .fixes import apply_fixes, unified_diff
+    by_path: Dict[str, list] = {}
+    for f in result.findings:
+        if f.fix is not None:
+            by_path.setdefault(f.path, []).append(f)
+    n_applied = n_skipped = n_files = 0
+    fixed = []
+    for path in sorted(by_path):
+        fs = by_path[path]
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        new, applied, skipped = apply_fixes(src, [f.fix for f in fs])
+        if new == src:
+            n_skipped += len(skipped)
+            continue
+        # a rewrite that doesn't parse must never reach disk: refuse the
+        # whole file and keep its findings actionable
+        try:
+            _ast.parse(new)
+        except SyntaxError:
+            n_skipped += len(fs)
+            print(f"graft_lint --fix: refusing {path}: the rewrite "
+                  "does not parse (left untouched)", file=sys.stderr)
+            continue
+        n_files += 1
+        n_applied += applied
+        n_skipped += len(skipped)
+        skipped_fixes = set(map(id, skipped))
+        fixed.extend(f for f in fs if id(f.fix) not in skipped_fixes)
+        rel = os.path.relpath(path) if not os.path.isabs(path) else path
+        if diff_only:
+            stream.write(unified_diff(rel, src, new))
+        else:
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write(new)
+    return n_applied, n_files, n_skipped, fixed
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
     if args.list_rules:
-        passes = registered_passes()
-        rows = [(rid, desc) for rid, desc in sorted(all_rules().items())]
-        if args.as_json:
-            print(json.dumps({
-                "passes": sorted(passes),
-                "rules": {rid: desc for rid, desc in rows}}, indent=1))
-        else:
-            print(f"passes: {', '.join(sorted(passes))}")
-            for rid, desc in rows:
-                print(f"  {rid}  {desc}")
-        return 0
+        return _list_rules(args.as_json)
+
+    if args.diff and not args.fix:
+        print("graft_lint: --diff only makes sense with --fix",
+              file=sys.stderr)
+        return 2
+    exclusive = [n for n, v in [("--write-baseline", args.write_baseline),
+                                ("--prune-baseline", args.prune_baseline),
+                                ("--fix", args.fix)] if v]
+    if len(exclusive) > 1:
+        print(f"graft_lint: {' and '.join(exclusive)} are mutually "
+              "exclusive", file=sys.stderr)
+        return 2
 
     paths = args.paths or [os.path.join(_REPO, d) for d in DEFAULT_PATHS]
     missing = [p for p in paths if not os.path.exists(p)]
@@ -78,8 +200,31 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 2
 
-    baseline = None
     baseline_path = args.baseline or DEFAULT_BASELINE
+    if args.write_baseline or args.prune_baseline:
+        # a baseline touched from a partial view would silently drop the
+        # accepted findings outside that view, and the next full run
+        # fails on them with no hint why — refuse the footgun
+        what = "--write-baseline" if args.write_baseline \
+            else "--prune-baseline"
+        if args.select or args.ignore:
+            print(f"graft_lint: refusing {what} with --select/--ignore "
+                  "(a partial rule view would drop accepted findings "
+                  "from the baseline)", file=sys.stderr)
+            return 2
+        if baseline_path == DEFAULT_BASELINE and args.paths:
+            default_abs = {os.path.abspath(os.path.join(_REPO, d))
+                           for d in DEFAULT_PATHS}
+            if {os.path.abspath(p) for p in args.paths} != default_abs:
+                print(f"graft_lint: refusing to touch the repo baseline "
+                      "via a non-default path set (run with no paths, or "
+                      "pass an explicit --baseline FILE)",
+                      file=sys.stderr)
+                return 2
+    if args.prune_baseline:
+        return _prune_baseline(baseline_path, paths)
+
+    baseline = None
     if not args.no_baseline and not args.write_baseline \
             and os.path.exists(baseline_path):
         baseline = Baseline.load(baseline_path)
@@ -88,27 +233,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                         ignore=_split_ids(args.ignore), baseline=baseline)
 
     if args.write_baseline:
-        # a baseline written from a partial view would silently drop the
-        # accepted findings outside that view, and the next full run
-        # fails on them with no hint why — refuse the footgun
-        if args.select or args.ignore:
-            print("graft_lint: refusing --write-baseline with "
-                  "--select/--ignore (a partial rule view would drop "
-                  "accepted findings from the baseline)", file=sys.stderr)
-            return 2
-        if baseline_path == DEFAULT_BASELINE and args.paths:
-            default_abs = {os.path.abspath(os.path.join(_REPO, d))
-                           for d in DEFAULT_PATHS}
-            if {os.path.abspath(p) for p in args.paths} != default_abs:
-                print("graft_lint: refusing to overwrite the repo "
-                      "baseline from a non-default path set (run with no "
-                      "paths, or pass an explicit --baseline FILE)",
-                      file=sys.stderr)
-                return 2
         Baseline.write(baseline_path, result.findings)
         print(f"graft_lint: wrote {len(result.findings)} finding(s) to "
               f"{baseline_path}")
         return 0
+
+    if args.fix:
+        # with --json, stdout is a single JSON document — the fix
+        # summary and any diff must not corrupt it
+        fix_stream = sys.stderr if args.as_json else sys.stdout
+        n_applied, n_files, n_skipped, fixed = _apply_fixes(
+            result, diff_only=args.diff, stream=fix_stream)
+        if not args.diff:
+            fixed_ids = set(map(id, fixed))
+            result.findings = [f for f in result.findings
+                               if id(f) not in fixed_ids]
+        verb = "would apply" if args.diff else "applied"
+        tail = f" ({n_skipped} overlapping fix(es) skipped)" \
+            if n_skipped else ""
+        print(f"graft_lint --fix: {verb} {n_applied} fix(es) in "
+              f"{n_files} file(s){tail}", file=fix_stream)
 
     if args.as_json:
         print(json.dumps(result.to_dict(), indent=1))

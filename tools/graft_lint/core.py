@@ -38,6 +38,8 @@ __all__ = ["Finding", "LintPass", "register", "registered_passes",
            "iter_python_files", "lint_file", "lint_paths", "Baseline",
            "parse_suppressions", "SUPPRESSION_RULES"]
 
+_FAMILY_RE = re.compile(r"^GL\d{1,2}$")   # GL5, GL50: rule-family prefixes
+
 # meta-rules emitted by the framework itself (not by any pass)
 SUPPRESSION_RULES = {
     "GL002": "suppression comment has no reason (add '-- <why>'); it "
@@ -48,7 +50,9 @@ SUPPRESSION_RULES = {
 @dataclass
 class Finding:
     """One diagnostic. ``symbol`` is the stable fingerprint component
-    (e.g. ``Server._closed``) so baselines survive line drift."""
+    (e.g. ``Server._closed``) so baselines survive line drift. ``fix``
+    (optional) is a :class:`tools.graft_lint.fixes.Fix` the ``--fix``
+    engine can apply mechanically."""
 
     rule: str          # e.g. "GL202"
     path: str          # as given on the command line
@@ -56,6 +60,7 @@ class Finding:
     message: str
     symbol: str = ""   # class.attr / function qualname / "" when n/a
     pass_name: str = ""
+    fix: Optional[object] = None   # fixes.Fix; None = report-only
 
     def fingerprint(self) -> Tuple[str, str, str]:
         return (self.rule, _norm_path(self.path),
@@ -64,11 +69,12 @@ class Finding:
     def to_dict(self) -> dict:
         return {"rule": self.rule, "path": self.path, "line": self.line,
                 "symbol": self.symbol, "message": self.message,
-                "pass": self.pass_name}
+                "pass": self.pass_name, "fixable": self.fix is not None}
 
     def render(self) -> str:
         sym = f" [{self.symbol}]" if self.symbol else ""
-        return f"{self.path}:{self.line}: {self.rule}{sym} {self.message}"
+        tail = " (autofixable: --fix)" if self.fix is not None else ""
+        return f"{self.path}:{self.line}: {self.rule}{sym} {self.message}{tail}"
 
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -295,7 +301,13 @@ def _count_by_rule(findings: Sequence[Finding]) -> Dict[str, int]:
 
 def _rule_selected(rule: str, pass_name: str, select, ignore) -> bool:
     def match(ids):
-        return rule in ids or pass_name in ids
+        if rule in ids or pass_name in ids:
+            return True
+        # rule-family prefixes: GL5 selects GL501..GL505, GL2 selects
+        # GL201/GL202 — an id shaped like GL<digits> that is a proper
+        # prefix of the rule id
+        return any(_FAMILY_RE.match(i) and rule.startswith(i)
+                   for i in ids)
     if select is not None and not match(select):
         return False
     if ignore is not None and match(ignore):
@@ -320,11 +332,13 @@ def lint_file(path: str, passes: Sequence[LintPass],
         if not p.applies_to(path):
             continue
         raw.extend(p.check_module(tree, src, path))
+    from .fixes import reason_template_fix
     for line, text in bad:
         raw.append(Finding(rule="GL002", path=path, line=line,
                            message=f"suppression without a reason: {text!r}"
                                    " (append ' -- <why>')",
-                           symbol=f"line{line}", pass_name="core"))
+                           symbol=f"line{line}", pass_name="core",
+                           fix=reason_template_fix(src, line)))
     raw.sort(key=lambda f: (f.line, f.rule))
     kept, suppressed = [], []
     for f in raw:

@@ -1,0 +1,220 @@
+"""graft_lint autofix engine: conservative, exact-span source rewrites.
+
+A pass that knows the mechanical repair for a rule attaches a
+:class:`Fix` to the finding; ``--fix`` applies them file by file and
+``--fix --diff`` shows the unified diff without writing. The engine is
+deliberately conservative:
+
+- Every edit is an exact character span computed from AST node
+  positions against the source that was linted; if the file changed
+  under us, spans no longer match and nothing half-applies.
+- Overlapping fixes are refused (the first wins, the rest are skipped
+  and reported), so two rules can never splice into each other.
+- Fixes are idempotent by construction: applying a fix removes the
+  finding that produced it, so re-running ``--fix`` converges — a run
+  that applied nothing leaves every file byte-identical. (A GL503 hoist
+  out of N nested loops takes one run per level: each hoist moves the
+  statement above its innermost loop, and the re-lint judges it against
+  the next one.)
+
+Only four rules are autofixable — GL301 (insert an explicit
+``daemon=True``), GL302 (insert a ``timeout=``), GL002 (insert a
+suppression-reason template for a human to edit), and GL503 (hoist a
+loop-invariant ``device_get`` out of the loop). Everything else stays
+report-only: a rewrite that needs judgment is a review comment, not an
+edit. GL302 is the one repair that changes runtime behavior — a
+blocking wait becomes a 5-second one, so ``queue.Empty`` / a returning
+``join`` become reachable; its fix note flags exactly that for review,
+and ``--fix --diff`` exists to read before writing.
+"""
+from __future__ import annotations
+
+import difflib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Edit", "Fix", "line_offsets", "span_offset", "apply_fixes",
+           "call_keyword_fix", "reason_template_fix", "hoist_stmt_fix",
+           "unified_diff"]
+
+
+@dataclass(frozen=True)
+class Edit:
+    """Replace src[start:end] with ``text`` (absolute offsets)."""
+
+    start: int
+    end: int
+    text: str
+
+
+@dataclass
+class Fix:
+    """One finding's mechanical repair: a set of edits + a short note
+    shown in ``--fix`` output."""
+
+    edits: List[Edit] = field(default_factory=list)
+    note: str = ""
+
+
+def line_offsets(src: str) -> List[int]:
+    """offsets[i] = absolute offset of 1-based line i+1's first char."""
+    offs = [0]
+    for line in src.splitlines(keepends=True):
+        offs.append(offs[-1] + len(line))
+    return offs
+
+
+def span_offset(src: str, lineno: int, col: int,
+                _offs: Optional[List[int]] = None) -> int:
+    offs = _offs if _offs is not None else line_offsets(src)
+    return offs[lineno - 1] + col
+
+
+def _line_end_offset(src: str, lineno: int) -> int:
+    """Offset just before the newline terminating 1-based ``lineno``."""
+    offs = line_offsets(src)
+    end = offs[lineno] if lineno < len(offs) else len(src)
+    while end > offs[lineno - 1] and src[end - 1] in "\r\n":
+        end -= 1
+    return end
+
+
+# -- fix builders ------------------------------------------------------------
+
+def _first_code_char(src: str, start: int, end: int) -> Optional[str]:
+    """First non-whitespace, non-comment char in src[start:end]. Safe
+    only where no string literals can appear (between a call's last
+    argument and its closing paren: comma / comments / whitespace)."""
+    j = start
+    while j < end:
+        ch = src[j]
+        if ch in " \t\r\n\\":
+            j += 1
+        elif ch == "#":
+            nl = src.find("\n", j, end)
+            if nl == -1:
+                return None
+            j = nl + 1
+        else:
+            return ch
+    return None
+
+
+def call_keyword_fix(src: str, call, keyword: str, value: str,
+                     note: str) -> Optional[Fix]:
+    """Insert ``keyword=value`` as the last argument of ``call`` (an
+    ast.Call with position info). Returns None when the span cannot be
+    edited safely (no closing paren where expected)."""
+    if call.end_lineno is None or call.end_col_offset is None:
+        return None
+    end = span_offset(src, call.end_lineno, call.end_col_offset)
+    if end == 0 or end > len(src) or src[end - 1] != ")":
+        return None
+    ins = end - 1
+    # where real argument text ends, from AST positions — scanning raw
+    # chars backward would mistake a trailing `,  # comment` for code
+    last_end = None
+    for a in list(call.args) + [kw.value for kw in call.keywords]:
+        if a.end_lineno is None or a.end_col_offset is None:
+            return None
+        e = span_offset(src, a.end_lineno, a.end_col_offset)
+        last_end = e if last_end is None else max(last_end, e)
+    if last_end is None:
+        text = f"{keyword}={value}"
+    elif _first_code_char(src, last_end, ins) == ",":
+        text = f" {keyword}={value}"
+    else:
+        text = f", {keyword}={value}"
+    return Fix(edits=[Edit(ins, ins, text)], note=note)
+
+
+def reason_template_fix(src: str, lineno: int) -> Fix:
+    """GL002: append the reason template to the reason-less suppression
+    comment so the author has an explicit TODO to fill in (the template
+    is a valid reason, so the suppression starts working — and carries
+    its own review flag)."""
+    end = _line_end_offset(src, lineno)
+    return Fix(edits=[Edit(end, end, " -- TODO: justify this suppression")],
+               note="insert suppression-reason template")
+
+
+def hoist_stmt_fix(src: str, stmt, loop, note: str) -> Optional[Fix]:
+    """GL503: move a whole simple statement from inside ``loop`` to just
+    above it (re-indented to the loop's column). Conservative: the
+    statement must be a DIRECT child of the loop body (hoisting out of a
+    nested ``if`` would un-condition it), must not be the loop's only
+    statement (an empty body is a SyntaxError), and its physical lines
+    must contain nothing but the statement."""
+    body = getattr(loop, "body", [])
+    if len(body) < 2 or not any(s is stmt for s in body):
+        return None
+    offs = line_offsets(src)
+    lines = src.splitlines(keepends=True)
+    if stmt.end_lineno is None:
+        return None
+    # the statement must own its physical lines outright
+    body_lines = lines[stmt.lineno - 1:stmt.end_lineno]
+    first = lines[stmt.lineno - 1]
+    if first[:stmt.col_offset].strip():
+        return None   # something else shares the first line
+    tail = lines[stmt.end_lineno - 1]
+    after = tail[stmt.end_col_offset:].strip()
+    if after and not after.startswith("#"):
+        return None   # something else shares the last line
+    del_start = offs[stmt.lineno - 1]
+    del_end = offs[stmt.end_lineno] if stmt.end_lineno < len(offs) \
+        else len(src)
+    loop_line = lines[loop.lineno - 1]
+    loop_indent = loop_line[:len(loop_line) - len(loop_line.lstrip())]
+    stmt_indent = first[:stmt.col_offset]
+    moved = []
+    for l in body_lines:
+        if l.startswith(stmt_indent):
+            moved.append(loop_indent + l[len(stmt_indent):])
+        else:
+            moved.append(loop_indent + l.lstrip())
+    if moved and not moved[-1].endswith("\n"):
+        moved[-1] += "\n"
+    ins = offs[loop.lineno - 1]
+    return Fix(edits=[Edit(del_start, del_end, ""),
+                      Edit(ins, ins, "".join(moved))],
+               note=note)
+
+
+# -- applying ----------------------------------------------------------------
+
+def apply_fixes(src: str, fixes: Sequence[Fix]
+                ) -> Tuple[str, int, List[Fix]]:
+    """Apply non-overlapping fixes to ``src``. Returns
+    (new_src, n_applied, skipped_fixes). A fix whose edits overlap an
+    already-accepted fix's edits is skipped whole — never partially."""
+    accepted: List[Edit] = []
+    applied = 0
+    skipped: List[Fix] = []
+    for fx in fixes:
+        if not fx.edits:
+            continue
+        spans = sorted((e.start, e.end) for e in fx.edits)
+        ok = all(0 <= s <= e <= len(src) for s, e in spans)
+        for (s, e) in spans:
+            for a in accepted:
+                # pure insertions at the same point still conflict: order
+                # would be ambiguous
+                if s < a.end and e > a.start or (s == a.start == e == a.end):
+                    ok = False
+        if not ok:
+            skipped.append(fx)
+            continue
+        accepted.extend(fx.edits)
+        applied += 1
+    out = src
+    for e in sorted(accepted, key=lambda e: (e.start, e.end),
+                    reverse=True):
+        out = out[:e.start] + e.text + out[e.end:]
+    return out, applied, skipped
+
+
+def unified_diff(path: str, old: str, new: str) -> str:
+    return "".join(difflib.unified_diff(
+        old.splitlines(keepends=True), new.splitlines(keepends=True),
+        fromfile=f"a/{path}", tofile=f"b/{path}"))

@@ -1,7 +1,9 @@
 """Pass plugins. Importing this package registers every built-in pass;
 a new pass is one module that defines a ``LintPass`` subclass decorated
 with ``@register`` plus an import line here."""
+from . import device_placement  # noqa: F401
 from . import lock_discipline  # noqa: F401
+from . import recompile_hazard  # noqa: F401
 from . import slow_marker  # noqa: F401
 from . import thread_hygiene  # noqa: F401
 from . import trace_purity  # noqa: F401

@@ -1,0 +1,512 @@
+"""device-placement: host materializations of device values in hot loops.
+
+Kernel-level wins (Pallas fusion, multistep scan) are silently eaten one
+layer up when the step/serving loop forces a device->host sync per
+iteration: ``float(loss)`` blocks dispatch until the device drains,
+``np.asarray`` downloads a device array the next line re-uploads, and an
+``if device_value:`` hides the same sync behind ``__bool__``. This pass
+builds a lightweight dataflow lattice (host / device / unknown) over each
+hot-path function (see ``_hotpath`` for the hot model), seeded by
+``jax.device_put``/``jnp.*`` results, jitted-callable results (names
+bound from ``jax.jit``/``StaticFunction``/``to_static`` or unpacked from
+``create_*_train_step``), and iteration over
+``prefetch_to_device``/``DevicePrefetcher`` feeds — then flags host
+materializations of device-lattice values.
+
+Rules
+-----
+GL501 host materialization (float/int/.item()/.tolist()/np.asarray) of a
+      device value inside a hot loop
+GL502 implicit sync: device value used as a truth value / len in a hot
+      function (if/while/assert/bool()/len())
+GL503 loop-invariant ``jax.device_get`` inside a hot loop (autofixable:
+      hoist above the loop)
+GL504 per-iteration ``jax.device_get`` in a hot loop that is NOT the
+      lagged one-step-behind fetch idiom
+GL505 possible host round-trip: parameter-derived (unknown-provenance)
+      leaves materialized via np.asarray/np.array/np.stack in a hot
+      function, away from an explicit upload site
+
+The lagged-fetch allowance (GL504): ``run_steps`` fetches step ``i-1``'s
+metrics while the device runs step ``i`` — ``device_get(v)`` (directly or
+through a local helper that device_gets its parameter) where ``v`` is
+reassigned LATER in the same loop body reads the previous iteration's
+value by construction and is the overlap idiom, not a defect. The upload
+exemption (GL505): ``jnp.asarray(np.stack(...))``/``device_put(np...)``
+is the H2D staging point itself — materializing there is the point.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, LintPass, register
+from ..fixes import hoist_stmt_fix
+from . import _hotpath
+from .trace_purity import _attr_chain
+
+_NP_MATERIALIZERS = {"asarray", "array", "stack"}
+_CONCRETIZE_METHODS = {"item", "tolist"}
+_CASTS = {"float", "int", "bool"}
+_DEVICE_ITER_CALLS = {"prefetch_to_device", "DevicePrefetcher"}
+_JIT_FACTORIES = _hotpath.JIT_FACTORIES
+_STEP_FACTORIES = _hotpath.STEP_FACTORIES
+_assigned_names = _hotpath.assigned_names
+
+DEVICE, HOST, UNKNOWN, JITFN, DEVITER = \
+    "device", "host", "unknown", "jitfn", "device_iter"
+
+
+class _ModuleAliases(ast.NodeVisitor):
+    """numpy / jax.numpy / jax import aliases in this module."""
+
+    def __init__(self):
+        self.numpy: Set[str] = set()
+        self.jnp: Set[str] = set()
+        self.jax: Set[str] = set()
+
+    def visit_Import(self, node: ast.Import):
+        for a in node.names:
+            alias = (a.asname or a.name).split(".")[0]
+            if a.name == "numpy":
+                self.numpy.add(alias)
+            elif a.name == "jax.numpy" and a.asname:
+                self.jnp.add(a.asname)
+            elif a.name in ("jax", "jax.numpy"):
+                self.jax.add(alias)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        if node.module == "jax" or (node.module or "").startswith("jax."):
+            for a in node.names:
+                if a.name == "numpy":
+                    self.jnp.add(a.asname or a.name)
+
+
+@register
+class DevicePlacementPass(LintPass):
+    name = "device-placement"
+    rules = {
+        "GL501": "host materialization (float()/.item()/.tolist()/"
+                 "np.asarray) of a device value inside a hot loop — "
+                 "blocks dispatch every iteration",
+        "GL502": "implicit device sync: device value used as a truth "
+                 "value or length (if/while/assert/bool()/len()) in a "
+                 "hot-path function",
+        "GL503": "loop-invariant jax.device_get inside a hot loop — "
+                 "hoist it above the loop (autofixable)",
+        "GL504": "per-iteration jax.device_get in a hot loop that is "
+                 "not the lagged one-step-behind fetch idiom",
+        "GL505": "possible host round-trip: parameter-derived leaves "
+                 "materialized via np.asarray/np.array/np.stack in a "
+                 "hot path (stack device leaves with jnp, or stage at "
+                 "the explicit upload site)",
+    }
+
+    def applies_to(self, path: str) -> bool:
+        import os
+        base = os.path.basename(path)
+        return not base.startswith("test") \
+            and _hotpath.is_hot_module(path)
+
+    # -- lattice -----------------------------------------------------------
+    def _seed_call_state(self, call: ast.Call, state: Dict[str, str],
+                         al: _ModuleAliases) -> str:
+        chain = _attr_chain(call.func)
+        if not chain:
+            # method call: x.numpy()/.item()/.tolist() give host values
+            if isinstance(call.func, ast.Attribute) \
+                    and call.func.attr in (_CONCRETIZE_METHODS | {"numpy"}):
+                return HOST
+            return UNKNOWN
+        head, last = chain[0], chain[-1]
+        if head in al.jax and last == "device_get":
+            return HOST
+        if head in al.jax and last == "device_put":
+            return DEVICE
+        if head in al.jnp or (head in al.jax and len(chain) >= 2
+                              and chain[1] in ("numpy",)):
+            return DEVICE
+        if head in al.jax and len(chain) >= 2 and chain[1] == "random":
+            return DEVICE
+        if head in al.numpy:
+            return HOST
+        if last in _DEVICE_ITER_CALLS:
+            return DEVITER
+        if len(chain) == 1:
+            st = state.get(head)
+            if st == JITFN:
+                return DEVICE
+            if head in _CASTS:
+                return HOST
+        if last in _CONCRETIZE_METHODS or last == "numpy" \
+                and not call.args:
+            return HOST
+        return UNKNOWN
+
+    def _state_of(self, node, state: Dict[str, str],
+                  al: _ModuleAliases) -> str:
+        if isinstance(node, ast.Name):
+            return state.get(node.id, UNKNOWN)
+        if isinstance(node, ast.Constant):
+            return HOST
+        if isinstance(node, ast.Call):
+            return self._seed_call_state(node, state, al)
+        if isinstance(node, (ast.BinOp,)):
+            l = self._state_of(node.left, state, al)
+            r = self._state_of(node.right, state, al)
+            if DEVICE in (l, r):
+                return DEVICE
+            if l == r == HOST:
+                return HOST
+            return UNKNOWN
+        if isinstance(node, ast.UnaryOp):
+            return self._state_of(node.operand, state, al)
+        if isinstance(node, ast.Compare):
+            # identity tests (x is None / x is not y) are pure host
+            # bools — no __bool__, no sync — even on device operands;
+            # they are HOW the lagged-fetch idiom guards its tail flush
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return HOST
+            sts = [self._state_of(node.left, state, al)] + \
+                [self._state_of(c, state, al) for c in node.comparators]
+            return DEVICE if DEVICE in sts else UNKNOWN
+        if isinstance(node, ast.BoolOp):
+            sts = [self._state_of(v, state, al) for v in node.values]
+            return DEVICE if DEVICE in sts else UNKNOWN
+        if isinstance(node, ast.Subscript):
+            return self._state_of(node.value, state, al)
+        if isinstance(node, ast.IfExp):
+            a = self._state_of(node.body, state, al)
+            b = self._state_of(node.orelse, state, al)
+            return a if a == b else UNKNOWN
+        return UNKNOWN
+
+    def _bind(self, stmt, state: Dict[str, str], al: _ModuleAliases):
+        """Update the lattice for one assignment-bearing statement."""
+        def set_targets(targets, value_state):
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    state[t.id] = value_state
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    set_targets(t.elts, value_state)
+
+        if isinstance(stmt, ast.Assign):
+            v = stmt.value
+            if isinstance(v, ast.Call):
+                chain = _attr_chain(v.func)
+                last = chain[-1] if chain else ""
+                if last in _JIT_FACTORIES:
+                    set_targets(stmt.targets, JITFN)
+                    return
+                if last in _STEP_FACTORIES:
+                    # step, params, opt_state = create_train_step(...)
+                    for t in stmt.targets:
+                        if isinstance(t, (ast.Tuple, ast.List)) and t.elts:
+                            if isinstance(t.elts[0], ast.Name):
+                                state[t.elts[0].id] = JITFN
+                            set_targets(t.elts[1:], DEVICE)
+                        elif isinstance(t, ast.Name):
+                            state[t.id] = UNKNOWN
+                    return
+            st = self._state_of(v, state, al)
+            set_targets(stmt.targets, st)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            set_targets([stmt.target],
+                        self._state_of(stmt.value, state, al))
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name):
+                cur = state.get(stmt.target.id, UNKNOWN)
+                rhs = self._state_of(stmt.value, state, al)
+                state[stmt.target.id] = DEVICE \
+                    if DEVICE in (cur, rhs) else UNKNOWN
+
+    # -- helpers for fetch sites -------------------------------------------
+    def _device_get_arg(self, call: ast.Call,
+                        al: _ModuleAliases) -> Optional[ast.AST]:
+        chain = _attr_chain(call.func)
+        if chain and chain[0] in al.jax and chain[-1] == "device_get" \
+                and call.args:
+            return call.args[0]
+        return None
+
+    def _collect_fetch_helpers(self, fn, al: _ModuleAliases) -> Set[str]:
+        """Local defs whose body device_gets one of their own params —
+        calling them is a fetch site for allowance purposes."""
+        out: Set[str] = set()
+        for sub in ast.walk(fn):
+            if not isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) or sub is fn:
+                continue
+            params = {p.arg for p in sub.args.args + sub.args.posonlyargs}
+            for node in ast.walk(sub):
+                if isinstance(node, ast.Call):
+                    arg = self._device_get_arg(node, al)
+                    if isinstance(arg, ast.Name) and arg.id in params:
+                        out.add(sub.name)
+        return out
+
+    # -- per-function check ------------------------------------------------
+    def _check_fn(self, fn, why: str, al: _ModuleAliases, path: str,
+                  out: List[Finding], src: str,
+                  seed_state: Optional[Dict[str, str]] = None):
+        qual = getattr(fn, "name", "<lambda>")
+        state: Dict[str, str] = dict(seed_state or {})
+        params: Set[str] = set()
+        if not isinstance(fn, ast.Lambda):
+            a = fn.args
+            params = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+            if a.vararg:
+                params.add(a.vararg.arg)
+            if a.kwarg:
+                params.add(a.kwarg.arg)
+        fetch_helpers = self._collect_fetch_helpers(fn, al) \
+            if not isinstance(fn, ast.Lambda) else set()
+        # parameter-derived names (for GL505): params plus comprehension/
+        # loop targets iterating over them, plus nested-lambda params
+        derived: Set[str] = set(params)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Lambda):
+                la = node.args
+                derived.update(p.arg for p in la.posonlyargs + la.args
+                               + la.kwonlyargs)
+                if la.vararg:
+                    derived.add(la.vararg.arg)
+            elif isinstance(node, ast.comprehension):
+                if isinstance(node.iter, ast.Name) \
+                        and node.iter.id in derived:
+                    for n in ast.walk(node.target):
+                        if isinstance(n, ast.Name):
+                            derived.add(n.id)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if isinstance(node.iter, ast.Name) \
+                        and node.iter.id in derived:
+                    for n in ast.walk(node.target):
+                        if isinstance(n, ast.Name):
+                            derived.add(n.id)
+
+        seen_lines: Set[Tuple[int, str]] = set()
+
+        def emit(rule, line, msg, sym, fix=None):
+            if (line, rule) in seen_lines:
+                return
+            seen_lines.add((line, rule))
+            f = self._finding(rule, path, line, msg, sym)
+            f.fix = fix
+            out.append(f)
+
+        def flag_call(call: ast.Call, loops: List[ast.AST]):
+            chain = _attr_chain(call.func)
+            in_loop = bool(loops)
+            # jax.device_get(x): GL503 / GL504 (loop sites only)
+            arg = self._device_get_arg(call, al)
+            is_fetch = arg is not None
+            helper_call = (not is_fetch and chain and len(chain) == 1
+                           and chain[0] in fetch_helpers and call.args)
+            if helper_call:
+                arg, is_fetch = call.args[0], True
+            if is_fetch and in_loop:
+                loop = loops[-1]
+                assigned = _assigned_names(loop)
+                names = {n.id for n in ast.walk(arg)
+                         if isinstance(n, ast.Name)}
+                variant = names & set(assigned)
+                if not variant:
+                    fix = None
+                    stmt = getattr(call, "_gl_stmt", None)
+                    if not helper_call and stmt is not None \
+                            and isinstance(stmt, ast.Assign) \
+                            and stmt.value is call:
+                        # hoist above the INNERMOST loop: invariance was
+                        # established against it, and an outer loop may
+                        # still rebind the fetched names
+                        fix = hoist_stmt_fix(
+                            src, stmt, loops[-1],
+                            "hoist loop-invariant device_get above "
+                            "the loop")
+                    emit("GL503", call.lineno,
+                         f"hot function {qual!r} ({why}): loop-invariant "
+                         "device_get inside the loop fetches the same "
+                         "value every iteration — hoist it above the "
+                         "loop", f"{qual}.device_get", fix)
+                else:
+                    lagged = any(assigned.get(n, 0) > call.lineno
+                                 for n in variant)
+                    if not lagged:
+                        emit("GL504", call.lineno,
+                             f"hot function {qual!r} ({why}): "
+                             "device_get of a value produced in the "
+                             "same iteration blocks the pipeline every "
+                             "step; fetch one step behind (assign after "
+                             "the fetch) like trainer.run_steps",
+                             f"{qual}.device_get")
+                return
+            # x.item()/x.tolist() on a device value (any receiver shape)
+            if isinstance(call.func, ast.Attribute) \
+                    and call.func.attr in _CONCRETIZE_METHODS \
+                    and in_loop \
+                    and self._state_of(call.func.value, state,
+                                       al) == DEVICE:
+                emit("GL501", call.lineno,
+                     f"hot function {qual!r} ({why}): "
+                     f".{call.func.attr}() on a device value inside "
+                     "a loop syncs every iteration",
+                     f"{qual}.{call.func.attr}")
+                return
+            if not chain:
+                return
+            head, last = chain[0], chain[-1]
+            # float(x)/int(x)/bool(x)/len(x) on device values
+            if len(chain) == 1 and call.args:
+                st0 = self._state_of(call.args[0], state, al)
+                if head in ("float", "int") and st0 == DEVICE and in_loop:
+                    emit("GL501", call.lineno,
+                         f"hot function {qual!r} ({why}): {head}() of a "
+                         "device value inside a loop blocks dispatch "
+                         "every iteration; keep it on device or fetch "
+                         "lagged", f"{qual}.{head}")
+                elif head in ("bool", "len") and st0 == DEVICE:
+                    emit("GL502", call.lineno,
+                         f"hot function {qual!r} ({why}): {head}() of a "
+                         "device value forces a host sync",
+                         f"{qual}.{head}")
+                return
+            # np.asarray / np.array / np.stack
+            if head in al.numpy and last in _NP_MATERIALIZERS \
+                    and call.args:
+                st0 = self._state_of(call.args[0], state, al)
+                if st0 == DEVICE and in_loop:
+                    emit("GL501", call.lineno,
+                         f"hot function {qual!r} ({why}): "
+                         f"np.{last}() downloads a device value inside "
+                         "a loop", f"{qual}.np.{last}")
+                    return
+                if st0 == UNKNOWN and getattr(call, "_gl_uploaded",
+                                              False) is False:
+                    names = {n.id for n in ast.walk(call.args[0])
+                             if isinstance(n, ast.Name)}
+                    if names & derived:
+                        emit("GL505", call.lineno,
+                             f"hot function {qual!r} ({why}): "
+                             f"np.{last}() materializes parameter-"
+                             "derived leaves that may already live on "
+                             "device — a silent D2H round-trip; branch "
+                             "on the leaf type (jnp.stack device "
+                             "leaves) or materialize at the upload "
+                             "site", f"{qual}.np.{last}")
+
+        def mark_uploads(node):
+            """Tag np.* calls syntactically nested in an upload call
+            (jnp.asarray(...)/jax.device_put(...)/Tensor(...)): staging
+            host memory right at the H2D point is the intended idiom."""
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                chain = _attr_chain(sub.func)
+                is_upload = False
+                if chain:
+                    head, last = chain[0], chain[-1]
+                    if head in al.jnp or (head in al.jax
+                                          and last == "device_put"):
+                        is_upload = True
+                    if len(chain) == 1 and head == "Tensor":
+                        is_upload = True
+                if is_upload:
+                    for inner in ast.walk(sub):
+                        if inner is not sub and isinstance(inner,
+                                                           ast.Call):
+                            inner._gl_uploaded = True
+
+        def walk_stmts(body, loops: List[ast.AST]):
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue   # nested defs analyzed as their own fn
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    it_state = self._state_of(stmt.iter, state, al)
+                    if it_state == DEVITER:
+                        for n in ast.walk(stmt.target):
+                            if isinstance(n, ast.Name):
+                                state[n.id] = DEVICE
+                    walk_exprs([stmt.iter], loops)
+                    walk_stmts(stmt.body, loops + [stmt])
+                    walk_stmts(stmt.orelse, loops)
+                elif isinstance(stmt, ast.While):
+                    check_test(stmt.test, loops)
+                    walk_exprs([stmt.test], loops + [stmt])
+                    walk_stmts(stmt.body, loops + [stmt])
+                    walk_stmts(stmt.orelse, loops)
+                elif isinstance(stmt, ast.If):
+                    check_test(stmt.test, loops)
+                    walk_exprs([stmt.test], loops)
+                    walk_stmts(stmt.body, loops)
+                    walk_stmts(stmt.orelse, loops)
+                elif isinstance(stmt, ast.Assert):
+                    check_test(stmt.test, loops)
+                    walk_exprs([stmt.test], loops)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    walk_exprs([i.context_expr for i in stmt.items],
+                               loops)
+                    walk_stmts(stmt.body, loops)
+                elif isinstance(stmt, ast.Try):
+                    walk_stmts(stmt.body, loops)
+                    for h in stmt.handlers:
+                        walk_stmts(h.body, loops)
+                    walk_stmts(stmt.orelse, loops)
+                    walk_stmts(stmt.finalbody, loops)
+                else:
+                    # tag the owning statement on calls so GL503 can
+                    # decide hoistability
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Call):
+                            sub._gl_stmt = stmt
+                    # flag the RHS against the PRE-assignment lattice:
+                    # `acc = float(acc)` must see acc's device state,
+                    # not the host state the rebind is about to set
+                    walk_exprs([stmt], loops)
+                    self._bind(stmt, state, al)
+
+        def check_test(test, loops):
+            if isinstance(test, ast.Name) or isinstance(
+                    test, (ast.Compare, ast.BoolOp, ast.UnaryOp)):
+                if self._state_of(test, state, al) == DEVICE:
+                    emit("GL502", test.lineno,
+                         f"hot function {qual!r} ({why}): branching on "
+                         "a device value forces a host sync per "
+                         "evaluation (__bool__); compare on host after "
+                         "an explicit fetch", f"{qual}.__bool__")
+
+        def walk_exprs(nodes, loops):
+            for node in nodes:
+                mark_uploads(node)
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        if not hasattr(sub, "_gl_stmt") \
+                                and isinstance(node, ast.stmt):
+                            sub._gl_stmt = node
+                        flag_call(sub, loops)
+
+        if isinstance(fn.body, list):
+            walk_stmts(fn.body, [])
+        else:   # lambda: a single expression, no statements
+            walk_exprs([fn.body], [])
+
+    def check_module(self, tree: ast.Module, src: str,
+                     path: str) -> List[Finding]:
+        hot = _hotpath.hot_functions(tree, path)
+        if not hot:
+            return []
+        al = _ModuleAliases()
+        al.visit(tree)
+        # module-level bindings visible to every function (e.g. a bench
+        # file's `step, params, opt = create_train_step(...)` at top
+        # level, or jitted = jax.jit(fn))
+        module_state: Dict[str, str] = {}
+        for stmt in tree.body:
+            self._bind(stmt, module_state, al)
+        out: List[Finding] = []
+        for fn, why in hot:
+            # each function starts from the module-level bindings
+            self._check_fn(fn, why, al, path, out, src,
+                           seed_state=module_state)
+        return out

@@ -1,0 +1,427 @@
+"""recompile-hazard: call patterns that silently retrace/recompile.
+
+A jitted function recompiles whenever the abstract signature of a call
+changes — and nothing tells you. The serving bucket design and the
+multistep trainer both exist to keep the executable set BOUNDED; these
+rules flag the patterns that quietly unbound it:
+
+GL601 per-iteration shapes: a jitted callable invoked in a hot loop with
+      an argument whose SHAPE derives from a loop-varying Python scalar
+      (``np.zeros(n)``, ``x[:n]``, ``jnp.arange(i)`` …) — one XLA
+      compile per distinct value.
+GL602 static_argnums misuse: a static position fed a non-hashable or
+      array-valued argument (TypeError at best), or a loop-varying value
+      (one retrace per distinct value).
+GL603 traced closure over a mutable module global: the trace freezes the
+      value it saw; later mutations never reach the compiled program.
+GL604 bucketless shape-dependent branching: a hot function that branches
+      on ``.shape`` and dispatches to a jitted callable without any
+      bucketing in sight — every distinct shape becomes a fresh
+      executable, defeating the serving pow2-bucket guarantee.
+
+GL601/GL604 only fire inside the hot-path model (``_hotpath``): that is
+where an unbounded compile cache actually bleeds throughput. GL602 and
+GL603 are trace-level hazards and fire module-wide, like trace-purity.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, LintPass, register
+from . import _hotpath
+from .trace_purity import _attr_chain
+
+# calls whose result's SHAPE is the (first) size-like argument
+_SHAPE_FACTORIES = {"zeros", "ones", "full", "empty", "arange",
+                    "linspace", "eye", "tri", "randn", "rand", "randint",
+                    "uniform", "normal"}
+_JIT_FACTORIES = _hotpath.JIT_FACTORIES
+_STEP_FACTORIES = _hotpath.STEP_FACTORIES
+_BUCKET_HINTS = ("bucket", "pad_to", "pow2")
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _assigned_names(node: ast.AST) -> Set[str]:
+    """Names (re)bound anywhere inside ``node`` — loop variance test."""
+    return set(_hotpath.assigned_names(node))
+
+
+def _static_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """The literal static_argnums of a jit(...) call, or None."""
+    for k in call.keywords:
+        if k.arg != "static_argnums":
+            continue
+        v = k.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.append(e.value)
+                else:
+                    return None
+            return tuple(out)
+    return None
+
+
+class _JitBinder(ast.NodeVisitor):
+    """name/self.attr -> static positions (possibly empty tuple) for
+    every visible ``x = jax.jit(f, ...)``-style binding, plus the names
+    of array-valued bindings (``a = np.zeros(...)``) for GL602."""
+
+    def __init__(self):
+        self.jitted: Dict[str, Tuple[int, ...]] = {}
+        self.arrays: Set[str] = set()
+
+    @staticmethod
+    def _key(t) -> Optional[str]:
+        if isinstance(t, ast.Name):
+            return t.id
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name):
+            return f"{t.value.id}.{t.attr}"
+        return None
+
+    def visit_Assign(self, node: ast.Assign):
+        v = node.value
+        if isinstance(v, ast.Call):
+            chain = _attr_chain(v.func)
+            last = chain[-1] if chain else ""
+            head = chain[0] if chain else ""
+            if last in _JIT_FACTORIES:
+                statics = _static_positions(v) or ()
+                for t in node.targets:
+                    key = self._key(t)
+                    if key:
+                        self.jitted[key] = statics
+            elif last in _STEP_FACTORIES:
+                for t in node.targets:
+                    if isinstance(t, (ast.Tuple, ast.List)) and t.elts \
+                            and isinstance(t.elts[0], ast.Name):
+                        self.jitted[t.elts[0].id] = ()
+            elif head in ("np", "numpy", "jnp") \
+                    or last in _SHAPE_FACTORIES:
+                for t in node.targets:
+                    key = self._key(t)
+                    if key:
+                        self.arrays.add(key)
+        self.generic_visit(node)
+
+
+def _mutable_module_globals(tree: ast.Module) -> Set[str]:
+    """Module-level names the module itself mutates after definition:
+    assigned at module scope more than once, augassigned at module
+    scope, or rebound through a ``global`` declaration inside any
+    function. ALL_CAPS constants and defs/imports don't count."""
+    assign_counts: Dict[str, int] = {}
+    mutated: Set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    assign_counts[t.id] = assign_counts.get(t.id, 0) + 1
+        elif isinstance(stmt, ast.AugAssign) \
+                and isinstance(stmt.target, ast.Name):
+            mutated.add(stmt.target.id)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Global):
+            mutated.update(node.names)
+    mutated.update(n for n, c in assign_counts.items() if c > 1)
+    return {n for n in mutated if not n.isupper() and n != "_"}
+
+
+@register
+class RecompileHazardPass(LintPass):
+    name = "recompile-hazard"
+    rules = {
+        "GL601": "jitted call in a hot loop with an argument shape "
+                 "derived from a loop-varying Python scalar — one XLA "
+                 "compile per distinct value; pad to a bucket or lift "
+                 "the scalar out of the shape",
+        "GL602": "static_argnums position fed a non-hashable/array "
+                 "value (TypeError) or a loop-varying value (retrace "
+                 "per iteration) — static args must be few, hashable, "
+                 "and stable",
+        "GL603": "traced function closes over a mutable module global: "
+                 "the compile froze the value it saw; later mutations "
+                 "silently never reach the program (pass it as an "
+                 "argument instead)",
+        "GL604": "shape-dependent branching around a jitted dispatch "
+                 "with no bucketing — every distinct shape compiles a "
+                 "fresh executable; bucket the shape first (serving "
+                 "pow2 buckets) or brand the branch with a bucket "
+                 "helper",
+    }
+
+    def applies_to(self, path: str) -> bool:
+        return not os.path.basename(path).startswith("test")
+
+    # -- GL603: module-wide ------------------------------------------------
+    def _check_traced_globals(self, tree: ast.Module, path: str,
+                              out: List[Finding]):
+        mutables = _mutable_module_globals(tree)
+        if not mutables:
+            return
+        # traced defs: @jit/@to_static decorated, or passed by name into
+        # a jit factory anywhere in the module
+        traced: List[ast.AST] = []
+        jit_args: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if chain and chain[-1] in _JIT_FACTORIES:
+                    for a in node.args:
+                        if isinstance(a, ast.Name):
+                            jit_args.add(a.id)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            deco = {(_attr_chain(d) or ["?"])[-1] for d in
+                    node.decorator_list}
+            if deco & _JIT_FACTORIES or node.name in jit_args:
+                traced.append(node)
+        for fn in traced:
+            local: Set[str] = _assigned_names(fn)
+            local |= {a.arg for a in fn.args.args + fn.args.posonlyargs
+                      + fn.args.kwonlyargs}
+            # names the fn declares global are GL105's (mutation inside
+            # the trace), not a frozen-read hazard
+            declared_global: Set[str] = set()
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Global):
+                    declared_global.update(sub.names)
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Name) \
+                        and isinstance(sub.ctx, ast.Load) \
+                        and sub.id in mutables \
+                        and sub.id not in local \
+                        and sub.id not in declared_global:
+                    out.append(self._finding(
+                        "GL603", path, sub.lineno,
+                        f"traced function {fn.name!r} reads module "
+                        f"global {sub.id!r}, which this module mutates "
+                        "— the compiled program keeps the trace-time "
+                        "value forever; pass it as an argument",
+                        f"{fn.name}.{sub.id}"))
+                    break   # one finding per (fn, first offending read)
+
+    # -- GL601/GL602/GL604: hot-path + call-site checks --------------------
+    @staticmethod
+    def _gl604(stmt, fn, why, has_bucketing, jit_key, emit):
+        """Flag a shape-dependent If/While that wraps a jitted dispatch
+        in a function with no bucketing vocabulary at all."""
+        if has_bucketing:
+            return
+        test_chains = [_attr_chain(n) for n in ast.walk(stmt.test)
+                       if isinstance(n, ast.Attribute)]
+        if not any("shape" in c for c in test_chains):
+            return
+        if any(isinstance(s, ast.Call) and jit_key(s) is not None
+               for s in ast.walk(stmt)):
+            emit("GL604", stmt.test.lineno,
+                 f"hot function {fn.name!r} ({why}): branching on "
+                 ".shape around a jitted dispatch with no bucketing — "
+                 "every distinct shape compiles a fresh executable",
+                 f"{fn.name}.shape_branch")
+
+    def _shape_varying_arg(self, arg: ast.AST, varying: Set[str]
+                           ) -> Optional[str]:
+        """Does ``arg``'s shape depend on a loop-varying name? Returns
+        the offending name, else None."""
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Call):
+                chain = _attr_chain(sub.func)
+                if chain and chain[-1] in _SHAPE_FACTORIES:
+                    hit: Set[str] = set()
+                    for a in sub.args:
+                        hit |= _names_in(a) & varying
+                    if hit:
+                        return sorted(hit)[0]
+            elif isinstance(sub, ast.Subscript):
+                sl = sub.slice
+                slices = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+                for s in slices:
+                    if isinstance(s, ast.Slice):
+                        for bound in (s.lower, s.upper, s.step):
+                            if bound is not None:
+                                hit = _names_in(bound) & varying
+                                if hit:
+                                    return sorted(hit)[0]
+        return None
+
+    def check_module(self, tree: ast.Module, src: str,
+                     path: str) -> List[Finding]:
+        out: List[Finding] = []
+        self._check_traced_globals(tree, path, out)
+
+        binder = _JitBinder()
+        binder.visit(tree)
+
+        # GL602 part 1 (module-wide): call sites of jitted names with
+        # static positions fed non-hashable literals / array bindings
+        def static_misuse(call: ast.Call, qual: str,
+                          varying: Set[str]):
+            chain = _attr_chain(call.func)
+            key = None
+            if len(chain) == 1:
+                key = chain[0]
+            elif len(chain) == 2 and chain[0] in ("self", "cls"):
+                key = f"{chain[0]}.{chain[1]}"
+            if key is None or key not in binder.jitted:
+                return
+            statics = binder.jitted[key]
+            for pos in statics:
+                if pos >= len(call.args):
+                    continue
+                a = call.args[pos]
+                if isinstance(a, (ast.List, ast.Dict, ast.Set)):
+                    out.append(self._finding(
+                        "GL602", path, call.lineno,
+                        f"{qual}: static_argnums position {pos} of "
+                        f"{key!r} gets a non-hashable "
+                        f"{type(a).__name__.lower()} literal — jit "
+                        "will raise (static args are hashed into the "
+                        "cache key)", f"{qual}.{key}.static{pos}"))
+                    continue
+                a_names = _names_in(a)
+                if a_names & binder.arrays or (
+                        isinstance(a, ast.Call)
+                        and (_attr_chain(a.func) or ["?"])[0]
+                        in ("np", "numpy", "jnp")):
+                    out.append(self._finding(
+                        "GL602", path, call.lineno,
+                        f"{qual}: static_argnums position {pos} of "
+                        f"{key!r} gets an array value — arrays are "
+                        "unhashable; pass it traced or mark it "
+                        "non-static", f"{qual}.{key}.static{pos}"))
+                elif a_names & varying:
+                    nm = sorted(a_names & varying)[0]
+                    out.append(self._finding(
+                        "GL602", path, call.lineno,
+                        f"{qual}: static_argnums position {pos} of "
+                        f"{key!r} varies per iteration ({nm!r}) — one "
+                        "retrace per distinct value",
+                        f"{qual}.{key}.static{pos}"))
+
+        hot = _hotpath.hot_functions(tree, path)
+        hot_ids = {id(fn) for fn, _ in hot}
+
+        def own_nodes(fn):
+            """Walk ``fn`` without descending into nested defs, so a
+            call is attributed to its innermost function only."""
+            stack = list(ast.iter_child_nodes(fn))
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                yield n
+                stack.extend(ast.iter_child_nodes(n))
+
+        # module-wide GL602 for non-hot functions (no loop context)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and id(node) not in hot_ids:
+                for sub in own_nodes(node):
+                    if isinstance(sub, ast.Call):
+                        static_misuse(sub, node.name, set())
+
+        # hot functions: GL601 + loop-aware GL602 + GL604
+        for fn, why in hot:
+            local_binder = _JitBinder()
+            for stmt in ast.walk(fn):
+                if isinstance(stmt, ast.Assign):
+                    local_binder.visit_Assign(stmt)
+            jitted_here = dict(binder.jitted)
+            jitted_here.update(local_binder.jitted)
+            has_bucketing = any(
+                h in n.lower() for n in _names_in(fn)
+                for h in _BUCKET_HINTS)
+
+            def jit_key(call: ast.Call) -> Optional[str]:
+                chain = _attr_chain(call.func)
+                if len(chain) == 1 and chain[0] in jitted_here:
+                    return chain[0]
+                if len(chain) == 2 and chain[0] in ("self", "cls") \
+                        and f"{chain[0]}.{chain[1]}" in jitted_here:
+                    return f"{chain[0]}.{chain[1]}"
+                return None
+
+            seen: Set[Tuple[int, str]] = set()
+
+            def emit(rule, line, msg, sym):
+                if (line, rule) in seen:
+                    return
+                seen.add((line, rule))
+                out.append(self._finding(rule, path, line, msg, sym))
+
+            def check_calls(exprs, loops):
+                """GL601 + loop-aware GL602 over the calls in ``exprs``
+                (expression subtrees only — never whole compound
+                statements, so every call is visited exactly once)."""
+                varying = _assigned_names(loops[-1]) if loops else set()
+                for e in exprs:
+                    if e is None:
+                        continue
+                    for sub in ast.walk(e):
+                        if not isinstance(sub, ast.Call):
+                            continue
+                        static_misuse(sub, fn.name, varying)
+                        key = jit_key(sub)
+                        if key is None or not loops:
+                            continue
+                        for a in sub.args:
+                            nm = self._shape_varying_arg(a, varying)
+                            if nm is not None:
+                                emit("GL601", sub.lineno,
+                                     f"hot function {fn.name!r} ({why}): "
+                                     f"jitted {key!r} called with an "
+                                     "argument whose shape depends on "
+                                     f"loop-varying {nm!r} — one "
+                                     "compile per distinct value; pad "
+                                     "to a bucket",
+                                     f"{fn.name}.{key}")
+                                break
+
+            def walk(body, loops):
+                for stmt in body:
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        continue
+                    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                        check_calls([stmt.iter], loops)
+                        walk(stmt.body, loops + [stmt])
+                        walk(stmt.orelse, loops)
+                    elif isinstance(stmt, ast.While):
+                        self._gl604(stmt, fn, why, has_bucketing,
+                                    jit_key, emit)
+                        check_calls([stmt.test], loops + [stmt])
+                        walk(stmt.body, loops + [stmt])
+                        walk(stmt.orelse, loops)
+                    elif isinstance(stmt, ast.If):
+                        self._gl604(stmt, fn, why, has_bucketing,
+                                    jit_key, emit)
+                        check_calls([stmt.test], loops)
+                        walk(stmt.body, loops)
+                        walk(stmt.orelse, loops)
+                    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                        check_calls([i.context_expr for i in stmt.items],
+                                    loops)
+                        walk(stmt.body, loops)
+                    elif isinstance(stmt, ast.Try):
+                        walk(stmt.body, loops)
+                        for h in stmt.handlers:
+                            walk(h.body, loops)
+                        walk(stmt.orelse, loops)
+                        walk(stmt.finalbody, loops)
+                    else:
+                        check_calls([stmt], loops)
+
+            if isinstance(fn.body, list):
+                walk(fn.body, [])
+        return out

@@ -25,6 +25,7 @@ import ast
 from typing import Dict, List, Optional, Set
 
 from ..core import Finding, LintPass, register
+from ..fixes import call_keyword_fix
 
 _QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
                 "JoinableQueue"}
@@ -140,11 +141,17 @@ class ThreadHygienePass(LintPass):
                     and func.value.id in ("threading",) \
                     and not _has_kw(node, "daemon") \
                     and id(node) not in exempt_calls:
-                out.append(self._finding(
+                f = self._finding(
                     "GL301", path, node.lineno,
                     "threading.Thread(...) without an explicit daemon= "
                     "— decide (and show) whether this worker may "
-                    "outlive the process teardown", "Thread"))
+                    "outlive the process teardown", "Thread")
+                f.fix = call_keyword_fix(
+                    src, node, "daemon", "True",
+                    "insert daemon=True (the explicit background-worker "
+                    "default; flip to False if this thread must block "
+                    "exit)")
+                out.append(f)
             # GL302: obj.get() / obj.join() with no timeout
             if isinstance(func, ast.Attribute) \
                     and func.attr in ("get", "join"):
@@ -167,16 +174,26 @@ class ThreadHygienePass(LintPass):
                                 and k.value.value is False:
                             blocking = False
                     if blocking:
-                        out.append(self._finding(
+                        f = self._finding(
                             "GL302", path, node.lineno,
                             f"{key}.get() blocks forever: pass a "
                             "timeout (poll) so close()/shutdown stays "
-                            "prompt", f"{key}.get"))
+                            "prompt", f"{key}.get")
+                        f.fix = call_keyword_fix(
+                            src, node, "timeout", "5.0",
+                            "insert timeout=5.0 (review: pick a poll "
+                            "interval and handle queue.Empty)")
+                        out.append(f)
                 elif kind == "thread" and func.attr == "join":
                     if not node.args and not _has_kw(node, "timeout"):
-                        out.append(self._finding(
+                        f = self._finding(
                             "GL302", path, node.lineno,
                             f"{key}.join() without a timeout: a wedged "
                             "worker wedges the caller; join with a "
-                            "timeout and escalate", f"{key}.join"))
+                            "timeout and escalate", f"{key}.join")
+                        f.fix = call_keyword_fix(
+                            src, node, "timeout", "5.0",
+                            "insert timeout=5.0 (review: escalate if "
+                            "the thread is still alive after the join)")
+                        out.append(f)
         return out
