@@ -69,9 +69,19 @@ class StaticFunction:
     models.create_train_step, which compiles fwd+bwd+opt as one program.
     """
 
-    def __init__(self, obj, input_spec=None, full_graph=True):
+    def __init__(self, obj, input_spec=None, full_graph=True,
+                 donate_argnums=()):
         del full_graph
         self._input_spec = input_spec
+        # indices into the USER arrays (the ``*arrays`` of the traced
+        # fn — state and key are never donatable): XLA then aliases
+        # those input buffers to outputs, which is how the serving
+        # decode engine updates its KV pools in place instead of
+        # copying them every step. Donated buffers are dead after the
+        # call — only for callers that re-feed the outputs (the AOT
+        # ``compile_for`` path); the live ``__call__`` path donates too,
+        # so don't set this on a function whose caller keeps its inputs.
+        self._donate = tuple(donate_argnums)
         if isinstance(obj, Layer):
             self._layer: Optional[Layer] = obj
             self._fn = None
@@ -109,7 +119,10 @@ class StaticFunction:
                 if isinstance(out, (tuple, list)):
                     return tuple(_unwrap(o) for o in out)
                 return _unwrap(out)
-        self._jitted = jax.jit(fn)
+        # user array i sits at jit position i + 2 (after state, key)
+        self._jitted = jax.jit(
+            fn, donate_argnums=tuple(i + 2 for i in self._donate)) \
+            if self._donate else jax.jit(fn)
         return self._jitted
 
     def _state(self):

@@ -1,0 +1,138 @@
+"""Shared autoregressive-decode helpers for the model families.
+
+The decode-mode forwards in ``gpt.py``/``llama.py`` (``decode_step``)
+are written against a tiny cache-ops protocol so the SAME model code
+serves two cache layouts:
+
+- ``ContiguousKV`` (here): one dense ``[B, T, Hkv, D]`` k/v pair per
+  layer, written at each slot's current position via a vmapped
+  ``dynamic_update_slice``. This is the plain ``use_cache`` path for
+  standalone generation and the parity oracle in tests.
+- ``serving.decode.kvcache.PagedKV``: per-slot bucketed pages gathered
+  through a page table — the continuous-batching server's layout. The
+  model never sees pages; it only calls ``kv_ops.update(...)`` and
+  attends over whatever total-length view comes back.
+
+The protocol (duck-typed, one method)::
+
+    kv_ops.update(layer_idx, cache_layer, k_new, v_new, positions)
+        -> (k_all, v_all, new_cache_layer)
+
+where ``k_new``/``v_new`` are this step's ``[B, S, Hkv, D]`` entries,
+``positions`` is the ``[B]`` int32 write start per slot, and
+``k_all``/``v_all`` are ``[B, T, Hkv, D]`` views covering at least every
+written position. Entries past a slot's current length may be garbage —
+``decode_attention`` masks them by position, never by buffer extent.
+
+Everything here is trace-pure (no host syncs, no wall clock): these
+functions run inside the jitted per-step program the serving engine
+compiles once per shape bucket.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import run_op
+
+__all__ = ["ContiguousKV", "init_contiguous_cache", "decode_attention",
+           "apply_rope_at", "unwrap_array"]
+
+
+def unwrap_array(x):
+    """Tensor -> jax array passthrough (decode entry points accept both:
+    eager callers pass Tensors, the jitted serving path passes arrays)."""
+    from ..core.tensor import Tensor
+    return x._data if isinstance(x, Tensor) else x
+
+
+def init_contiguous_cache(num_layers: int, batch: int, max_len: int,
+                          num_kv_heads: int, head_dim: int,
+                          dtype="float32"):
+    """Per-layer ``(k, v)`` zero caches ``[B, T, Hkv, D]`` for the
+    contiguous ``use_cache`` path."""
+    return [(jnp.zeros((batch, max_len, num_kv_heads, head_dim), dtype),
+             jnp.zeros((batch, max_len, num_kv_heads, head_dim), dtype))
+            for _ in range(num_layers)]
+
+
+class ContiguousKV:
+    """Default kv_ops: dense per-layer cache, per-slot positioned write.
+
+    ``dynamic_update_slice`` takes traced start indices, so each slot in
+    the batch writes at its OWN position under one ``vmap`` — no
+    per-slot Python loop, no recompile when positions change."""
+
+    def update(self, layer_idx, cache, k_new, v_new, positions):
+        del layer_idx
+
+        def fn(ck, cv, kn, vn, pos):
+            def write(c, n, p):
+                z = jnp.zeros((), p.dtype)   # lax wants uniform index dtypes
+                return jax.lax.dynamic_update_slice(
+                    c, n.astype(c.dtype), (p, z, z))
+            return (jax.vmap(write)(ck, kn, pos),
+                    jax.vmap(write)(cv, vn, pos))
+
+        ck, cv = run_op("kv_cache_update", fn,
+                        (cache[0], cache[1], k_new, v_new, positions),
+                        out_stop_gradient=True)
+        return ck, cv, (ck, cv)
+
+
+def decode_attention(q, k, v, positions):
+    """Length-masked attention of ``S`` query tokens over a ``T``-long
+    cached prefix.
+
+    ``q``: [B, S, H, D]; ``k``/``v``: [B, T, Hkv, D] (GQA when
+    ``Hkv < H`` — keys/values repeat ``H // Hkv`` times); ``positions``:
+    [B] int32 absolute position of each slot's FIRST query token. Query
+    token ``i`` (absolute position ``positions + i``) attends keys
+    ``j <= positions + i`` — the causal-over-cache rule that makes
+    right-padded prefills and stale page contents invisible. Returns
+    [B, S, H, D]."""
+    def fn(qa, ka, va, pos):
+        b, s, h, d = qa.shape
+        t, hkv = ka.shape[1], ka.shape[2]
+        if hkv != h:
+            rep = h // hkv
+            ka = jnp.repeat(ka, rep, axis=2)
+            va = jnp.repeat(va, rep, axis=2)
+        scores = jnp.einsum("bshd,bthd->bhst", qa, ka) / math.sqrt(d)
+        qpos = pos[:, None] + jnp.arange(s, dtype=pos.dtype)       # [B,S]
+        mask = jnp.arange(t, dtype=pos.dtype)[None, None, :] \
+            <= qpos[:, :, None]                                    # [B,S,T]
+        scores = jnp.where(mask[:, None, :, :], scores,
+                           jnp.finfo(scores.dtype).min)
+        p = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhst,bthd->bshd", p, va)
+
+    return run_op("decode_attention", fn, (q, k, v, positions),
+                  out_stop_gradient=True)
+
+
+def apply_rope_at(q, k, cos, sin, positions):
+    """Rotate-half RoPE at per-slot absolute positions.
+
+    Same math as ``llama.apply_rotary_pos_emb`` but the cos/sin rows are
+    gathered per batch element at ``positions + i`` instead of the
+    shared ``[0, S)`` prefix — decode steps sit at different depths per
+    slot. ``q``/``k``: [B, S, H(.kv), D]; ``cos``/``sin``: [max_len, D/2]
+    closed-over constants; ``positions``: [B] int32."""
+    def fn(qa, ka, pos):
+        s = qa.shape[1]
+        idx = pos[:, None] + jnp.arange(s, dtype=pos.dtype)        # [B,S]
+        c = cos[idx][:, :, None, :]                                # [B,S,1,D/2]
+        sn = sin[idx][:, :, None, :]
+
+        def rot(x):
+            x1, x2 = x[..., ::2], x[..., 1::2]
+            o1 = x1 * c - x2 * sn
+            o2 = x2 * c + x1 * sn
+            return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+        return rot(qa), rot(ka)
+
+    return run_op("fused_rope_at", fn, (q, k, positions),
+                  out_stop_gradient=True)

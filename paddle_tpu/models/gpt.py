@@ -25,6 +25,9 @@ from ..nn.layer.layers import functional_call, functional_state
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "create_train_step",
            "gpt2_small", "gpt2_tiny"]
 
+# the decode protocol (ContiguousKV default cache ops, masked attention
+# over a cached prefix) is shared with llama via models/decode.py
+
 
 @dataclass
 class GPTConfig:
@@ -119,6 +122,70 @@ class GPTForCausalLM(nn.Layer):
         b, s, v = logits.shape
         return F.cross_entropy(logits.reshape([b * s, v]),
                                labels.reshape([b * s]))
+
+    # -- autoregressive decode (use_cache path) ---------------------------
+    def decode_meta(self) -> dict:
+        """Cache geometry the serving decode engine sizes its KV pools
+        from (one entry per fact the engine cannot infer from a Layer)."""
+        cfg = self.config
+        return {"num_layers": cfg.num_layers,
+                "num_kv_heads": cfg.num_heads,
+                "head_dim": cfg.hidden_size // cfg.num_heads,
+                "max_len": cfg.max_position_embeddings,
+                "vocab_size": cfg.vocab_size}
+
+    def init_decode_cache(self, batch: int, max_len: int = None):
+        """Contiguous per-layer (k, v) caches for ``decode_step``."""
+        from .decode import init_contiguous_cache
+        m = self.decode_meta()
+        return init_contiguous_cache(
+            m["num_layers"], batch, max_len or m["max_len"],
+            m["num_kv_heads"], m["head_dim"])
+
+    def decode_step(self, tokens, positions, kv_caches, kv_ops=None):
+        """One cached decode (or prefill) step: write this step's K/V at
+        ``positions`` and attend over the cached prefix.
+
+        tokens: [B, S] (or [B]) int token ids — S=1 for a decode step,
+        S=prompt bucket for a prefill. positions: [B] int32, the number
+        of tokens already cached per slot (the write start). kv_caches:
+        per-layer cache pytrees owned by ``kv_ops`` (default: the
+        contiguous [B, T, H, D] pairs from ``init_decode_cache``).
+        Returns ``(logits [B, S, V], new_kv_caches)``. Inference-only:
+        dropout is never applied. Trace-pure — shapes are static, so the
+        serving engine compiles one executable per shape bucket."""
+        from ..core.tensor import Tensor
+        from .decode import (ContiguousKV, decode_attention, unwrap_array)
+        kv_ops = kv_ops or ContiguousKV()
+        tok = unwrap_array(tokens)
+        if tok.ndim == 1:
+            tok = tok[:, None]
+        pos = unwrap_array(positions).astype(jnp.int32)
+        b, s = tok.shape
+        gpt = self.gpt
+        pos_ids = pos[:, None] + jnp.arange(s, dtype=jnp.int32)
+        h = gpt.wte(Tensor(tok)) + gpt.wpe(Tensor(pos_ids))
+        new_caches = []
+        # pre-norm encoder layers, replayed with positioned cache writes
+        # (the stock TransformerEncoder cache path concatenates, which
+        # grows the shape every step — one recompile per token)
+        for i, layer in enumerate(gpt.encoder.layers):
+            attn = layer.self_attn
+            hn = layer.norm1(h)
+            q = attn._shape(attn.q_proj(hn))
+            k = attn._shape(attn.k_proj(hn))
+            v = attn._shape(attn.v_proj(hn))
+            k_all, v_all, cache = kv_ops.update(i, kv_caches[i], k, v, pos)
+            o = decode_attention(q, k_all, v_all, pos)
+            h = h + attn.out_proj(o.reshape([b, s, attn.embed_dim]))
+            hn = layer.norm2(h)
+            h = h + layer.linear2(layer.activation(layer.linear1(hn)))
+            new_caches.append(cache)
+        h = gpt.ln_f(h)
+        from ..core.dispatch import run_op
+        logits = run_op("lm_head", lambda a, w: jnp.matmul(a, w.T),
+                        (h, gpt.wte.weight))
+        return logits, new_caches
 
 
 # the jitted train-step factory is shared by all model families

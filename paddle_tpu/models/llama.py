@@ -258,6 +258,60 @@ class LlamaForCausalLM(nn.Layer):
                                      transpose_w=True)
         return causal_lm_loss(self(input_ids), labels)
 
+    # -- autoregressive decode (use_cache path) ---------------------------
+    def decode_meta(self) -> dict:
+        """Cache geometry for the serving decode engine. Llama caches
+        ``num_kv_heads`` heads (GQA: the pool stays small, queries repeat
+        heads at attention time)."""
+        cfg = self.cfg
+        return {"num_layers": cfg.num_layers,
+                "num_kv_heads": cfg.num_kv_heads,
+                "head_dim": cfg.hidden_size // cfg.num_heads,
+                "max_len": cfg.max_position_embeddings,
+                "vocab_size": cfg.vocab_size}
+
+    def init_decode_cache(self, batch: int, max_len: int = None):
+        """Contiguous per-layer (k, v) caches for ``decode_step``."""
+        from .decode import init_contiguous_cache
+        m = self.decode_meta()
+        return init_contiguous_cache(
+            m["num_layers"], batch, max_len or m["max_len"],
+            m["num_kv_heads"], m["head_dim"])
+
+    def decode_step(self, tokens, positions, kv_caches, kv_ops=None):
+        """One cached decode (or prefill) step — same contract as
+        ``GPTForCausalLM.decode_step`` (see models/decode.py for the
+        kv_ops protocol). RoPE is applied at each slot's absolute
+        positions; only ``num_kv_heads`` K/V heads are cached and the
+        GQA head expansion happens inside ``decode_attention``."""
+        from ..core.tensor import Tensor
+        from .decode import (ContiguousKV, apply_rope_at, decode_attention,
+                             unwrap_array)
+        kv_ops = kv_ops or ContiguousKV()
+        tok = unwrap_array(tokens)
+        if tok.ndim == 1:
+            tok = tok[:, None]
+        pos = unwrap_array(positions).astype(jnp.int32)
+        b, s = tok.shape
+        cfg, m = self.cfg, self.model
+        cos, sin = m._cos_sin
+        head_dim = cfg.hidden_size // cfg.num_heads
+        h = m.embed_tokens(Tensor(tok))
+        new_caches = []
+        for i, layer in enumerate(m.layers):
+            a = layer.self_attn
+            hn = layer.input_layernorm(h)
+            q = a.q_proj(hn).reshape([b, s, cfg.num_heads, head_dim])
+            k = a.k_proj(hn).reshape([b, s, cfg.num_kv_heads, head_dim])
+            v = a.v_proj(hn).reshape([b, s, cfg.num_kv_heads, head_dim])
+            q, k = apply_rope_at(q, k, cos, sin, pos)
+            k_all, v_all, cache = kv_ops.update(i, kv_caches[i], k, v, pos)
+            o = decode_attention(q, k_all, v_all, pos)
+            h = h + a.o_proj(o.reshape([b, s, cfg.num_heads * head_dim]))
+            h = h + layer.mlp(layer.post_attention_layernorm(h))
+            new_caches.append(cache)
+        return self.lm_head(m.norm(h)), new_caches
+
 
 class _LlamaEmbedPipe(nn.Layer):
     def __init__(self, cfg: LlamaConfig):

@@ -25,7 +25,9 @@ __all__ = ["ProfilerState", "ProfilerTarget", "make_scheduler",
            "load_profiler_result", "SummaryView", "serving_stats",
            "register_serving_source", "unregister_serving_source",
            "pipeline_stats", "register_pipeline_source",
-           "unregister_pipeline_source", "record_placement_fallback"]
+           "unregister_pipeline_source", "record_placement_fallback",
+           "decode_stats", "register_decode_source",
+           "unregister_decode_source", "export_stats"]
 
 
 class ProfilerState(Enum):
@@ -368,6 +370,7 @@ class _SourceRegistry:
 
 _serving_registry = _SourceRegistry("serving")
 _pipeline_registry = _SourceRegistry("pipeline")
+_decode_registry = _SourceRegistry("decode")
 
 
 def register_serving_source(name: str, metrics) -> None:
@@ -437,6 +440,72 @@ def pipeline_stats(name: Optional[str] = None):
     with _placement_lock:
         out["placement_fallbacks"] = list(_placement_fallbacks)
     return out
+
+
+def register_decode_source(name: str, metrics) -> None:
+    """Register a decode-server metrics source (an object with
+    .snapshot()). Called by serving.decode.DecodeServer on
+    construction."""
+    _decode_registry.register(name, metrics)
+
+
+def unregister_decode_source(name: str, metrics=None) -> None:
+    """Remove a decode source (only if it still points at ``metrics``,
+    when given)."""
+    _decode_registry.unregister(name, metrics)
+
+
+def decode_stats(name: Optional[str] = None):
+    """Snapshot of continuous-batching decode metrics: slot occupancy,
+    page utilization, prefill vs decode step time, preemptions,
+    time-to-first-token — per registered DecodeServer.
+
+    Returns ``{server_name: snapshot_dict}``, or one snapshot when
+    ``name`` is given (KeyError when that server is gone)."""
+    return _decode_registry.stats(name)
+
+
+def _flatten_scrape(prefix: str, value, out: list) -> None:
+    """dict/number tree -> ``name value`` exposition lines (labels are
+    flattened into the metric name; non-numeric leaves are dropped —
+    a scrape is numbers, not strings)."""
+    if isinstance(value, dict):
+        for k, v in value.items():
+            _flatten_scrape(f"{prefix}_{k}", v, out)
+    elif isinstance(value, (list, tuple)):
+        out.append(f"{_sanitize(prefix)}_count {len(value)}")
+    elif isinstance(value, bool):
+        out.append(f"{_sanitize(prefix)} {int(value)}")
+    elif isinstance(value, (int, float)):
+        out.append(f"{_sanitize(prefix)} {value}")
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+
+
+def export_stats(format: str = "dict"):
+    """One scrape over every metrics registry — the fleet-dashboard
+    endpoint payload combining ``pipeline_stats()``, ``serving_stats()``
+    and ``decode_stats()``.
+
+    format="dict" returns the nested dict, "json" a JSON string, and
+    "text" a Prometheus-style exposition (one ``name value`` line per
+    numeric leaf, names prefixed ``paddle_tpu_<registry>_<source>_``).
+    """
+    data = {"pipeline": pipeline_stats(), "serving": serving_stats(),
+            "decode": decode_stats()}
+    if format == "dict":
+        return data
+    if format == "json":
+        return json.dumps(data, sort_keys=True, default=str)
+    if format == "text":
+        lines: list = []
+        _flatten_scrape("paddle_tpu", data, lines)
+        return "\n".join(lines) + "\n"
+    raise ValueError(
+        f"unknown export_stats format {format!r}: expected 'dict', "
+        "'json', or 'text'")
 
 
 class SummaryView(Enum):

@@ -20,8 +20,18 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["pow2_buckets", "next_bucket", "pad_axis", "bucket_example",
+__all__ = ["BucketOverflow", "pow2_buckets", "page_buckets", "next_bucket",
+           "next_bucket_strict", "pad_axis", "bucket_example",
            "stack_and_pad"]
+
+
+class BucketOverflow(ValueError):
+    """A value exceeds every admissible bucket. Raised instead of
+    propagating a silent ``None`` out of ``next_bucket``: every caller
+    that cannot serve an over-max shape must fail loudly at admission
+    time, not with an index error (or a fresh XLA compile) later.
+    Subclasses ValueError so pre-existing callers catching the old
+    ``bucket_example`` ValueError keep working."""
 
 
 def pow2_buckets(max_value: int, min_value: int = 1) -> List[int]:
@@ -38,6 +48,15 @@ def pow2_buckets(max_value: int, min_value: int = 1) -> List[int]:
     return sorted(buckets)
 
 
+def page_buckets(max_pages: int, min_pages: int = 1) -> List[int]:
+    """Admissible KV-page-table widths for the decode engine: powers of
+    two up to ``max_pages`` (``max_pages`` always included). One decode
+    executable exists per (batch bucket, page bucket) pair, so this set
+    bounds the gathered-attention shapes exactly the way ``pow2_buckets``
+    bounds the batch axis."""
+    return pow2_buckets(max_pages, min_pages)
+
+
 def next_bucket(n: int, buckets: Sequence[int]) -> Optional[int]:
     """Smallest bucket >= n, or None when n exceeds every bucket."""
     best = None
@@ -45,6 +64,20 @@ def next_bucket(n: int, buckets: Sequence[int]) -> Optional[int]:
         if b >= n and (best is None or b < best):
             best = b
     return best
+
+
+def next_bucket_strict(n: int, buckets: Sequence[int],
+                       what: str = "value") -> int:
+    """``next_bucket`` that raises ``BucketOverflow`` instead of
+    returning None — the uniform over-max handling for every hot-path
+    caller (silent None propagation turned into a TypeError two frames
+    later in the old serving code)."""
+    b = next_bucket(n, buckets)
+    if b is None:
+        raise BucketOverflow(
+            f"{what} {n} exceeds the largest bucket {max(buckets)} "
+            f"(buckets: {list(buckets)})")
+    return b
 
 
 def pad_axis(arr: np.ndarray, axis: int, target: int,
@@ -69,12 +102,8 @@ def bucket_example(arr: np.ndarray, seq_buckets: Optional[Sequence[int]]
     identical shapes only)."""
     shape = list(arr.shape)
     if seq_buckets and arr.ndim >= 1:
-        b = next_bucket(shape[0], seq_buckets)
-        if b is None:
-            raise ValueError(
-                f"example axis-0 length {shape[0]} exceeds the largest "
-                f"sequence bucket {max(seq_buckets)}")
-        shape[0] = b
+        shape[0] = next_bucket_strict(shape[0], seq_buckets,
+                                      "example axis-0 length")
     return tuple(shape)
 
 

@@ -1,0 +1,35 @@
+"""paddle_tpu.serving.decode — continuous batching for autoregressive
+decode over a paged KV cache.
+
+The batch server (``serving.Server``) coalesces one-shot forward calls;
+this subsystem serves *generation*: requests join and leave the running
+decode batch between steps (continuous batching), each sequence's KV
+cache lives in bucketed pages of preallocated device pools (admit/evict
+never recompiles), and every step runs through one AOT executable per
+(batch bucket, page bucket) pair.
+
+Quick start::
+
+    from paddle_tpu.serving import decode
+
+    model.eval()
+    with decode.DecodeServer(model, max_slots=8, page_len=16,
+                             max_context=256) as srv:
+        stream = srv.submit(prompt_ids, max_new_tokens=32)
+        for tok in stream:
+            ...
+
+Metrics: ``paddle_tpu.profiler.decode_stats()`` (and the combined
+``profiler.export_stats()`` scrape).
+"""
+from .engine import DecodeServer, DecodeStream  # noqa: F401
+from .kvcache import (PageAllocator, PagedKV, PagesExhausted,  # noqa: F401
+                      init_paged_cache, page_table_array, pages_for)
+from .metrics import DecodeMetrics  # noqa: F401
+from .scheduler import (AdmissionQueue, DecodeRequest,  # noqa: F401
+                        Scheduler, Slot)
+
+__all__ = ["DecodeServer", "DecodeStream", "DecodeMetrics",
+           "PageAllocator", "PagedKV", "PagesExhausted",
+           "init_paged_cache", "page_table_array", "pages_for",
+           "AdmissionQueue", "DecodeRequest", "Scheduler", "Slot"]

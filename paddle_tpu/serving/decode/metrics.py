@@ -1,0 +1,37 @@
+"""Decode-server observability (surfaced via ``profiler.decode_stats()``
+and the combined ``profiler.export_stats()`` scrape)."""
+from __future__ import annotations
+
+from ...profiler.metrics import MetricsBase
+
+__all__ = ["DecodeMetrics"]
+
+
+class DecodeMetrics(MetricsBase):
+    """Thread-safe counters/histograms for one DecodeServer.
+
+    Counters: submitted, completed, rejected_overload, expired, failed,
+    preempted, prefills, decode_steps, tokens_generated, compile_count.
+    Histograms: batch_size (active slots per decode step),
+    slot_occupancy (active / max_slots), page_utilization (used pages /
+    usable pool), prefill_ms, decode_step_ms (device step wall time),
+    queue_wait_ms (submit -> admission), ttft_ms (submit -> first
+    token), tokens_per_request.
+    Gauge: queue_depth (pull-type, read at snapshot time).
+    """
+
+    COUNTERS = ("submitted", "completed", "rejected_overload", "expired",
+                "failed", "preempted", "prefills", "decode_steps",
+                "tokens_generated", "compile_count")
+    HISTS = ("batch_size", "slot_occupancy", "page_utilization",
+             "prefill_ms", "decode_step_ms", "queue_wait_ms", "ttft_ms",
+             "tokens_per_request")
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self._counters)
+            out["name"] = self.name
+            for k, h in self._hists.items():
+                out[k] = h.snapshot()
+        out["queue_depth"] = self._read_gauge()
+        return out

@@ -1,0 +1,53 @@
+"""Shared server lifecycle: drain / close / context manager / __del__.
+
+``Server`` and ``DecodeServer`` settle every accepted request into
+exactly one of completed / expired / failed, so the drain invariant
+(settled == submitted), the close-idempotence entry points, and the
+GC-time worker reclaim are identical — this mixin keeps them in ONE
+place. Hosts provide ``self._lock`` guarding ``self._closed``, a
+``self._metrics`` ServingMetrics, and an idempotent
+``shutdown(drain=..., timeout=...)``.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+__all__ = ["ServerLifecycleMixin"]
+
+
+class ServerLifecycleMixin:
+    """Drain/close/context-manager/__del__ shared by the serving hosts."""
+
+    def _is_closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Wait until every submitted request has settled (completed,
+        expired, or failed) — does not close the server. Returns False
+        on timeout."""
+        end = None if timeout is None else time.monotonic() + timeout
+        m = self._metrics
+        while (m["completed"] + m["expired"] + m["failed"]
+               < m["submitted"]):
+            if end is not None and time.monotonic() > end:
+                return False
+            time.sleep(0.002)
+        return True
+
+    def close(self):
+        self.shutdown(drain=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc[0] is None)
+
+    def __del__(self):  # best-effort: never leak the worker thread
+        try:
+            if not self._is_closed():
+                self.shutdown(drain=False, timeout=1.0)
+        except Exception:
+            pass

@@ -35,8 +35,9 @@ import numpy as np
 
 from .batcher import (DeadlineExceeded, Future, Request, RequestQueue,
                       ServerClosed, ServerOverloaded, ServingError)
-from .bucketing import (bucket_example, next_bucket, pow2_buckets,
+from .bucketing import (bucket_example, next_bucket_strict, pow2_buckets,
                         stack_and_pad)
+from .lifecycle import ServerLifecycleMixin
 from .metrics import ServingMetrics
 
 __all__ = ["Server", "ServingError", "ServerOverloaded", "DeadlineExceeded",
@@ -147,7 +148,7 @@ class _CallableExecutor:
         return _to_numpy(out)
 
 
-class Server:
+class Server(ServerLifecycleMixin):
     """Dynamic-batching inference server over one model.
 
     Example::
@@ -280,9 +281,7 @@ class Server:
         # check would still reject the request, but only after this
         # thread had already counted it into "submitted", skewing the
         # drain invariant on the shutdown path
-        with self._lock:
-            closed = self._closed
-        if closed:
+        if self._is_closed():
             raise ServerClosed("server is shutting down")
         if not args:
             raise ValueError("submit() needs at least one input array")
@@ -363,19 +362,7 @@ class Server:
         return self._queue.qsize()
 
     # -- lifecycle ---------------------------------------------------------
-    def drain(self, timeout: Optional[float] = None) -> bool:
-        """Wait until every submitted request has settled (completed,
-        expired, or failed) — does not close the server. Returns False on
-        timeout."""
-        end = None if timeout is None else time.monotonic() + timeout
-        m = self._metrics
-        while (m["completed"] + m["expired"] + m["failed"]
-               < m["submitted"]):
-            if end is not None and time.monotonic() > end:
-                return False
-            time.sleep(0.002)
-        return True
-
+    # drain/close/__enter__/__exit__/__del__ come from ServerLifecycleMixin
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = None):
         """Stop admitting requests; with ``drain`` finish queued work,
@@ -398,24 +385,6 @@ class Server:
         # identity-checked: a newer server reusing this name keeps its
         # registry entry when this one shuts down
         unregister_serving_source(self.name, self._metrics)
-
-    def close(self):
-        self.shutdown(drain=True)
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.shutdown(drain=exc[0] is None)
-
-    def __del__(self):  # best-effort: never leak the worker thread
-        try:
-            with self._lock:
-                closed = self._closed
-            if not closed:
-                self.shutdown(drain=False, timeout=1.0)
-        except Exception:
-            pass
 
     # -- worker ------------------------------------------------------------
     def _run_loop(self):
@@ -449,9 +418,11 @@ class Server:
         from ..profiler import RecordEvent
 
         n = len(batch)
-        bb = next_bucket(n, self._batch_buckets)
-        if bb is None:                   # cannot happen: n <= max_batch
-            bb = max(self._batch_buckets)
+        # invariant: n <= max_batch_size <= max bucket; a violation is a
+        # bug and raises BucketOverflow loudly (the old silent
+        # None-fallback masked it as a mis-sized batch)
+        bb = next_bucket_strict(n, self._batch_buckets,
+                                "coalesced batch size")
         t0 = time.monotonic()
         for r in batch:
             self._metrics.observe("queue_wait_ms",

@@ -37,7 +37,11 @@ HOT_MODULE_RES = (
 
 HOT_ROOT_NAMES = {"run_steps", "_run_loop", "_execute", "_produce",
                   "__next__", "next_batch", "submit", "run",
-                  "step", "unscale_", "update"}
+                  "step", "unscale_", "update",
+                  # the decode scheduler's per-token loop: every decode
+                  # subsystem function reachable from it (admit, prefill,
+                  # decode step, emit) is per-step hot
+                  "_step_loop"}
 
 # callables whose result is a jitted function / whose first unpacked
 # element is one — shared by device-placement and recompile-hazard so a
