@@ -30,6 +30,9 @@ from .long_context import (ring_attention, ulysses_attention,  # noqa: F401
 from . import passes  # noqa: F401
 from .comm_watchdog import (CommTaskManager, CommTimeoutError,  # noqa: F401
                             get_comm_task_manager, set_comm_task_manager)
+from . import resilience  # noqa: F401
+from .resilience import (AsyncCheckpointer, CheckpointManager,  # noqa: F401
+                         CheckpointWriteError, latest_checkpoint)
 
 from .extras import (spawn, scatter_object_list, broadcast_object_list,  # noqa: F401
                      gloo_init_parallel_env, gloo_barrier, gloo_release,

@@ -13,24 +13,106 @@ Layout of a checkpoint directory::
       meta_r{rank}.json     per-process chunk table (merged then kept)
       metadata.json         global table (coordinator)
       extras.pkl            non-tensor leaves (coordinator)
+
+Crash consistency: this module writes the files; the commit protocol
+(staging dir + ``COMMITTED`` marker + atomic ``latest`` pointer) lives in
+``paddle_tpu.distributed.resilience`` and reuses these writers through the
+injectable ``fs`` layer, which is also how the fault-injection harness
+kills a save at any write boundary.
 """
 from __future__ import annotations
 
 import json
 import os
 import pickle
+import re
 import time
 
 import numpy as np
 
 from ..parallel import get_rank, get_world_size
-from .metadata import (LocalTensorIndex, LocalTensorMetadata, Metadata,
-                       TensorMetadata)
-from .utils import array_chunks, flatten_state_dict, to_jax_array
+from .metadata import Metadata, TensorMetadata
+from .utils import npz_key, snapshot_state_dict
+
+_RANK_FILE_RE = re.compile(r"^(?:shard_r(\d+)\.npz|meta_r(\d+)\.json)$")
 
 
-def _npz_key(name: str, offset) -> str:
-    return f"{name}|{','.join(map(str, offset))}"
+def _npz_key(name: str, offset) -> str:  # back-compat alias
+    return npz_key(name, offset)
+
+
+def _default_fs():
+    from ..resilience.faults import get_fs
+    return get_fs()
+
+
+def _npz_writer(chunks):
+    """Streaming npz producer for ``Fs.write_stream`` — the archive goes
+    straight to the file instead of materializing shard-sized bytes."""
+    return lambda f: np.savez(f, **chunks)
+
+
+def resolve_participants(process_group=None, coordinator_rank: int = 0):
+    """(rank, ranks, coordinator) for this process — or ``None`` when this
+    process is not a participant of ``process_group``."""
+    if process_group is not None:
+        ranks = list(process_group.ranks)
+        rank = get_rank()
+        if rank not in ranks:
+            return None
+        coordinator = ranks[coordinator_rank]
+    else:
+        ranks = list(range(get_world_size()))
+        rank = get_rank()
+        coordinator = coordinator_rank
+    return rank, ranks, coordinator
+
+
+def write_rank_files(path: str, rank: int, chunks, meta: Metadata,
+                     uid: int, fs=None) -> None:
+    """This rank's durable writes: the shard npz, then (npz first, so a
+    merged table never references bytes not yet on disk) the per-rank
+    chunk table, atomically."""
+    fs = fs or _default_fs()
+    fs.makedirs(path)
+    fs.write_stream(os.path.join(path, f"shard_r{rank}.npz"),
+                    _npz_writer(chunks), label="shard")
+    meta_json = meta.to_json()
+    meta_json["uid"] = uid
+    tmp = os.path.join(path, f".meta_r{rank}.json.tmp")
+    fs.write_bytes(tmp, json.dumps(meta_json).encode(), label="meta.tmp")
+    fs.replace(tmp, os.path.join(path, f"meta_r{rank}.json"), label="meta")
+
+
+def gc_stale_rank_files(path: str, ranks, fs=None) -> list:
+    """Remove ``shard_r*.npz``/``meta_r*.json`` left by ranks that are not
+    participants of THIS save — a re-save into a fixed directory from a
+    shrunk world must not let the coordinator merge (or a later load read)
+    stale shards from the previous, larger world. Returns removed names."""
+    fs = fs or _default_fs()
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return []
+    keep = {f"shard_r{r}.npz" for r in ranks} | \
+           {f"meta_r{r}.json" for r in ranks}
+    removed = []
+    for fn in sorted(names):
+        if _RANK_FILE_RE.match(fn) and fn not in keep:
+            fs.remove(os.path.join(path, fn), label="gc-stale-rank")
+            removed.append(fn)
+    return removed
+
+
+def coordinator_finalize(path: str, extras: dict, ranks, uid: int,
+                         fs=None, merge_timeout_s: float = 300.0) -> None:
+    """Coordinator-side tail of a save: extras sidecar, stale-rank GC,
+    then the rank-table merge into ``metadata.json``."""
+    fs = fs or _default_fs()
+    fs.write_bytes(os.path.join(path, "extras.pkl"), pickle.dumps(extras),
+                   label="extras")
+    gc_stale_rank_files(path, ranks, fs=fs)
+    _merge_metadata(path, ranks, uid, timeout_s=merge_timeout_s, fs=fs)
 
 
 def save_state_dict(state_dict, path, process_group=None,
@@ -46,64 +128,53 @@ def save_state_dict(state_dict, path, process_group=None,
     (the reference's contract): when re-saving to a fixed path, pass a
     value all processes agree on (e.g. the global step) so the coordinator
     never merges a stale table from a previous save.
+
+    ``async_save=True`` snapshots device shards to host RAM (one batched
+    ``device_get``) and performs every disk write on the shared
+    write-behind thread; the bare flag registers an atexit ``wait()`` so
+    the bytes are durable before interpreter exit — prefer
+    ``paddle_tpu.distributed.resilience.CheckpointManager``, which adds
+    the crash-consistent commit protocol, rotation and error surfacing.
     """
-    del async_save
     uid = 0 if unique_id is None else int(unique_id)
-    if process_group is not None:
-        ranks = list(process_group.ranks)
-        rank = get_rank()
-        if rank not in ranks:
-            return  # not a participant
-        coordinator = ranks[coordinator_rank]
-    else:
-        ranks = list(range(get_world_size()))
-        rank = get_rank()
-        coordinator = coordinator_rank
-    os.makedirs(path, exist_ok=True)
+    parts = resolve_participants(process_group, coordinator_rank)
+    if parts is None:
+        return  # not a participant
+    rank, ranks, coordinator = parts
 
-    flat, mapping = flatten_state_dict(state_dict)
-    meta = Metadata(flat_mapping=mapping)
-    extras = {}
-    chunks_out = {}
-    shard_file = f"shard_r{rank}.npz"
+    if async_save:
+        import warnings
+        warnings.warn(
+            "save_state_dict(async_save=True) without a CheckpointManager "
+            "still blocks on wait() at interpreter exit and has no "
+            "crash-consistent commit; use "
+            "paddle_tpu.distributed.resilience.CheckpointManager",
+            DeprecationWarning, stacklevel=2)
+        from ..resilience.async_ckpt import default_async_checkpointer
+        default_async_checkpointer().save_legacy(
+            state_dict, path, uid=uid, rank=rank, ranks=ranks,
+            coordinator=coordinator)
+        return
 
-    for name, leaf in flat.items():
-        arr = to_jax_array(leaf)
-        if arr is None:
-            extras[name] = leaf
-            continue
-        tm = TensorMetadata(tuple(arr.shape), str(np.dtype(arr.dtype)))
-        for offset, data in array_chunks(arr):
-            key = _npz_key(name, offset)
-            chunks_out[key] = data
-            tm.chunks.append((
-                LocalTensorMetadata(offset, tuple(data.shape),
-                                    str(data.dtype)),
-                LocalTensorIndex(shard_file, key)))
-        meta.state_dict_metadata[name] = tm
-
-    np.savez(os.path.join(path, shard_file), **chunks_out)
-    # npz first, then the table atomically: a merged table never references
-    # bytes that are not yet on disk
-    meta_json = meta.to_json()
-    meta_json["uid"] = uid
-    tmp = os.path.join(path, f".meta_r{rank}.json.tmp")
-    with open(tmp, "w") as f:
-        json.dump(meta_json, f)
-    os.replace(tmp, os.path.join(path, f"meta_r{rank}.json"))
-
+    chunks, meta, extras = snapshot_state_dict(state_dict,
+                                               f"shard_r{rank}.npz")
+    write_rank_files(path, rank, chunks, meta, uid)
     if rank == coordinator:
-        with open(os.path.join(path, "extras.pkl"), "wb") as f:
-            pickle.dump(extras, f)
-        _merge_metadata(path, ranks, uid)
+        coordinator_finalize(path, extras, ranks, uid)
 
 
 def _merge_metadata(path: str, ranks, uid: int,
-                    timeout_s: float = 300.0) -> None:
+                    timeout_s: float = 300.0, fs=None) -> None:
     """Coordinator: wait for every participant's table (matching this save's
     uid — stale tables from a previous save into the same dir are ignored),
-    merge, write the global table."""
+    merge, write the global table atomically.
+
+    Waiting backs off exponentially (50 ms doubling to a 1 s cap — a
+    300 s multi-host straggler window must not busy-spin the coordinator);
+    on timeout a ``FAILED`` marker is written so the resilience manager's
+    GC can identify and delete the partial directory."""
     deadline = time.time() + timeout_s
+    delay = 0.05
     metas = {}
     while len(metas) < len(ranks):
         for r in ranks:
@@ -120,11 +191,14 @@ def _merge_metadata(path: str, ranks, uid: int,
                     pass  # still being written
         if len(metas) < len(ranks):
             if time.time() > deadline:
+                _write_failed_marker(path, ranks, uid, metas, timeout_s,
+                                     fs=fs)
                 raise TimeoutError(
                     f"save_state_dict: only {len(metas)}/{len(ranks)} "
                     f"process metadata files (uid={uid}) appeared in "
                     f"{timeout_s}s")
-            time.sleep(0.05)
+            time.sleep(delay)
+            delay = min(delay * 2, 1.0)
 
     merged = Metadata()
     for r in sorted(metas):
@@ -138,5 +212,24 @@ def _merge_metadata(path: str, ranks, uid: int,
                 if c[0].global_offset not in seen:
                     dst.chunks.append(c)
                     seen.add(c[0].global_offset)
-    with open(os.path.join(path, "metadata.json"), "w") as f:
-        json.dump(merged.to_json(), f)
+    merged_json = merged.to_json()
+    merged_json["uid"] = uid
+    fs = fs or _default_fs()
+    tmp = os.path.join(path, ".metadata.json.tmp")
+    fs.write_bytes(tmp, json.dumps(merged_json).encode(),
+                   label="metadata.tmp")
+    fs.replace(tmp, os.path.join(path, "metadata.json"), label="metadata")
+
+
+def _write_failed_marker(path, ranks, uid, metas, timeout_s, fs=None):
+    """Best-effort tombstone: an unmarked partial dir is indistinguishable
+    from one still being written; ``FAILED`` makes it GC-able."""
+    failed = {"reason": f"merge timed out after {timeout_s}s",
+              "uid": uid, "want_ranks": sorted(ranks),
+              "have_ranks": sorted(metas)}
+    try:
+        (fs or _default_fs()).write_bytes(
+            os.path.join(path, "FAILED"), json.dumps(failed).encode(),
+            label="failed-marker")
+    except Exception:
+        pass  # the marker is advisory; the TimeoutError is the signal

@@ -71,6 +71,11 @@ class CommTaskManager:
             else (lambda: jax.block_until_ready(value))
         if deadline <= 0:
             return sync()
+        injected = _injected_hang(desc)
+        if injected is not None:
+            # fault harness: this sync "hangs" like a dead peer — only
+            # consulted under a deadline, so it can never wedge a wait
+            sync = injected
 
         from concurrent.futures import TimeoutError as FuturesTimeout
         start = time.monotonic()
@@ -102,6 +107,20 @@ class CommTaskManager:
         import jax.numpy as jnp
         return self.wait(jnp.zeros(()) + 0, desc=desc, timeout_s=timeout_s)
 
+    def close(self) -> None:
+        """Release the watchdog worker pool. Never waits: a worker stuck
+        inside a hung sync would block a clean shutdown forever — the
+        pool is abandoned exactly like the hang path abandons it."""
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    def __enter__(self) -> "CommTaskManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
     def _notify_elastic(self, desc: str) -> None:
         """Elastic integration (reference: watchdog error propagation aborts
         training so the elastic manager relaunches): flag the local agent
@@ -114,6 +133,20 @@ class CommTaskManager:
             notify_comm_hang(desc)
         except Exception:
             pass
+
+
+def _injected_hang(desc: str):
+    """Fault-harness hook: a parked waiter when a sync-hang is armed for
+    ``desc``, else None. Import is lazy and failure-proof — the watchdog
+    must work even if the resilience package is unavailable."""
+    try:
+        from .resilience.faults import get_fault_injector
+    except Exception:
+        return None
+    inj = get_fault_injector()
+    if not inj.armed:
+        return None
+    return inj.sync_hang_waiter(desc)
 
 
 _GLOBAL = CommTaskManager()

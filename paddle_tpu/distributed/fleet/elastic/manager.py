@@ -46,7 +46,8 @@ class ElasticManager:
                  np_target, heartbeat_interval: float = 1.0,
                  heartbeat_timeout: float = 5.0,
                  level: Optional[int] = None,
-                 job_id: str = "default"):
+                 job_id: str = "default",
+                 comm_manager=None):
         self.store = store
         self.node_id = node_id
         if isinstance(np_target, (tuple, list)):
@@ -66,6 +67,7 @@ class ElasticManager:
         self._epoch_key = f"{self.prefix}/epoch"
         self._epoch_ver = 0
         self._last_epoch = 0
+        self._comm_manager = comm_manager
 
     # -- heartbeats --------------------------------------------------------
     # each node renews a server-side LEASE (csrc/kv_store.cpp LEASE_SET):
@@ -89,6 +91,12 @@ class ElasticManager:
         self._thread.start()
         _ACTIVE_MANAGERS[id(self)] = self
 
+    def attach_comm_manager(self, comm_manager) -> None:
+        """Tie a ``CommTaskManager``'s lifetime to this node's elastic
+        membership: ``stop()`` closes it, so the watchdog worker pool
+        cannot outlive the node it watches."""
+        self._comm_manager = comm_manager
+
     def stop(self):
         _ACTIVE_MANAGERS.pop(id(self), None)
         self._stop.set()
@@ -96,6 +104,8 @@ class ElasticManager:
             self._thread.join(self.interval * 3)
             self._thread = None
         self.store.delete_key(f"{self.prefix}/hb/{self.node_id}")
+        if self._comm_manager is not None:
+            self._comm_manager.close()
 
     def _beat(self):  # kept for API compatibility; start() uses _beat_loop
         _beat_loop(lambda: self, self._stop, self.interval)
@@ -253,12 +263,29 @@ def _beat_loop(ref, stop_event, interval):
         m = ref()
         if m is None:
             return
+        if not _heartbeat_allowed(m.node_id):
+            # fault harness: renewal suppressed — the server-side lease
+            # expires and peers observe this node dead, process alive
+            del m
+            continue
         try:
             m.store.lease_set(f"{m.prefix}/hb/{m.node_id}", "1",
                               ttl=m.timeout)
         except Exception:
             return  # store gone: the watcher will see us dead
         del m  # don't hold the strong ref across the sleep
+
+
+def _heartbeat_allowed(node_id: str) -> bool:
+    """Fault-harness hook (resilience.faults heartbeat-drop injector)."""
+    try:
+        from ...resilience.faults import get_fault_injector
+    except Exception:
+        return True
+    inj = get_fault_injector()
+    if not inj.armed:
+        return True
+    return inj.heartbeat_allowed(node_id)
 
 
 # comm-watchdog integration (reference: the NCCL watchdog aborts training

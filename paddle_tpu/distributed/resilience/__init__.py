@@ -1,0 +1,27 @@
+"""paddle_tpu.distributed.resilience — preemption-tolerant training.
+
+The loop the rest of ``distributed/`` leaves open, closed: async
+checkpointing with crash-consistent commits (``AsyncCheckpointer`` +
+the ``commit`` protocol), interval/rotation/GC/resume management
+(``CheckpointManager``), and the deterministic fault-injection harness
+(``faults``) the tests drive — kill-at-nth-write, sync-hang into the
+comm watchdog, heartbeat-drop into the elastic manager.
+
+Recovery story: ``models.trainer.run_steps(checkpoint_manager=,
+on_fault=)`` — a ``CommTimeoutError`` flows watchdog →
+``notify_comm_hang`` → elastic restart signal, and the fault handler
+restores ``latest_checkpoint`` with reshard-on-restore into the (possibly
+shrunk) new world, resuming within one checkpoint interval.
+"""
+from .async_ckpt import (AsyncCheckpointer,  # noqa: F401
+                         CheckpointWriteError,
+                         default_async_checkpointer)
+from .commit import (COMMITTED_MARKER, FAILED_MARKER,  # noqa: F401
+                     LATEST_POINTER, HostSnapshot, latest_checkpoint,
+                     list_committed_steps, read_latest_pointer,
+                     staging_dir, step_dir, take_snapshot,
+                     validate_checkpoint_dir, write_committed_checkpoint)
+from .faults import (FaultInjector, Fs, InjectedCrash,  # noqa: F401
+                     fault_injection, get_fault_injector, get_fs)
+from .manager import CheckpointManager  # noqa: F401
+from .metrics import ResilienceMetrics  # noqa: F401

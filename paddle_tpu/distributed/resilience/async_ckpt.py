@@ -1,0 +1,251 @@
+"""Async distributed checkpointing: snapshot to host RAM, write behind.
+
+The training loop pays exactly one cost per save — the device→host
+snapshot (one batched ``device_get`` of this rank's replica-0 shards) —
+and the write-behind thread does every disk write, through the
+crash-consistent commit protocol in ``commit.py``.
+
+Double-buffered and bounded: ``save()`` first waits for the previous
+write to finish (surfacing its error if it failed), so host RAM holds at
+most ONE pending checkpoint copy no matter how small the save interval —
+a slow disk backpressures the save cadence instead of blowing up RSS.
+
+Background-writer failures are never swallowed: they re-raise as
+``CheckpointWriteError`` from the NEXT ``save()``/``wait()``/``poll()``
+on the training thread.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+from ..checkpoint.save_state_dict import (coordinator_finalize,
+                                          resolve_participants,
+                                          write_rank_files)
+from .commit import take_snapshot, write_committed_checkpoint
+
+__all__ = ["AsyncCheckpointer", "CheckpointWriteError",
+           "default_async_checkpointer"]
+
+_STOP = object()
+
+
+class CheckpointWriteError(RuntimeError):
+    """A write-behind checkpoint job failed. Raised on the training
+    thread at the next save/wait/poll — the failed step's staging dir
+    stays torn (never resumable); the previous committed checkpoint is
+    untouched."""
+
+
+class _Job:
+    __slots__ = ("fn", "done", "error")
+
+    def __init__(self, fn: Callable[[], None]):
+        self.fn = fn
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+
+    def run(self) -> None:
+        try:
+            self.fn()
+        except BaseException as e:
+            # InjectedCrash (a BaseException) included: the simulated
+            # kill leaves the staging dir torn, exactly like a real one
+            self.error = e
+        finally:
+            # drop the closure NOW: it captures the HostSnapshot, and any
+            # lingering reference (worker local, _inflight) would keep a
+            # second full checkpoint copy in host RAM past completion
+            self.fn = None
+            self.done.set()
+
+
+class AsyncCheckpointer:
+    """One write-behind worker + a one-slot job queue (see module
+    docstring). Not thread-safe for concurrent ``save()`` calls — it
+    belongs to one training loop, the ``CheckpointManager``'s."""
+
+    def __init__(self, metrics=None):
+        self._metrics = metrics
+        self._queue: "queue.Queue" = queue.Queue(maxsize=1)
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._inflight: Optional[_Job] = None
+        if metrics is not None:
+            metrics.set_depth_gauge(self._queue.qsize)
+
+    # -- worker ------------------------------------------------------------
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._write_loop, name="ckpt-write-behind",
+                    daemon=True)
+                self._thread.start()
+
+    def _write_loop(self) -> None:
+        while True:
+            try:
+                job = self._queue.get(timeout=1.0)
+            except queue.Empty:
+                continue  # periodic wake keeps shutdown prompt (GL302)
+            if job is _STOP:
+                return
+            job.run()
+
+    def _submit(self, job: _Job) -> None:
+        self._ensure_thread()
+        with self._lock:
+            self._inflight = job
+        self._queue.put(job)
+
+    # -- error surfacing ---------------------------------------------------
+    def _take_done_job(self, block: bool) -> Optional[_Job]:
+        with self._lock:
+            job = self._inflight
+            if job is None:
+                return None
+            if not block and not job.done.is_set():
+                return None
+            self._inflight = None
+        job.done.wait()
+        return job
+
+    def _surface(self, job: Optional[_Job]) -> None:
+        if job is None or job.error is None:
+            return
+        if self._metrics is not None:
+            self._metrics.inc("write_errors")
+        raise CheckpointWriteError(
+            f"background checkpoint write failed: {job.error}"
+        ) from job.error
+
+    def wait(self) -> None:
+        """Block until the in-flight write finishes; raise its error."""
+        self._surface(self._take_done_job(block=True))
+
+    def poll(self) -> None:
+        """Non-blocking: raise the in-flight write's error if it already
+        failed (lets every ``maybe_save`` — saving or not — surface
+        background failures promptly)."""
+        self._surface(self._take_done_job(block=False))
+
+    # -- saves -------------------------------------------------------------
+    def save(self, state_dict, root: str, step: int, *, uid=None,
+             process_group=None, coordinator_rank: int = 0,
+             merge_timeout_s: float = 300.0,
+             on_commit: Optional[Callable[[int, str], None]] = None
+             ) -> bool:
+        """Snapshot now, commit behind (protocol in ``commit.py``).
+        ``on_commit(step, path)`` runs on the write-behind thread after
+        the pointer flip. Returns False when this process is not a
+        participant."""
+        parts = resolve_participants(process_group, coordinator_rank)
+        if parts is None:
+            return False
+        rank, ranks, coordinator = parts
+        self.wait()  # the one-in-flight bound + error surfacing
+        snap = self._snapshot(state_dict, rank,
+                              step if uid is None else uid)
+        metrics = self._metrics
+
+        def job():
+            t0 = time.perf_counter()
+            final = write_committed_checkpoint(
+                snap, root, step, rank=rank, ranks=ranks,
+                coordinator=coordinator, merge_timeout_s=merge_timeout_s)
+            # only the coordinator's return means COMMITTED (other ranks
+            # return after their shard writes, before the marker exists)
+            # — commit metrics elsewhere would report commits that may
+            # never have happened
+            if metrics is not None and rank == coordinator:
+                metrics.observe("commit_s", time.perf_counter() - t0)
+                metrics.inc("commits")
+                metrics.set_last_committed_step(step)
+            if on_commit is not None:
+                on_commit(step, final)
+
+        self._submit(_Job(job))
+        return True
+
+    def save_legacy(self, state_dict, path: str, *, uid: int, rank: int,
+                    ranks, coordinator: int) -> None:
+        """The ``save_state_dict(async_save=True)`` path: identical final
+        layout to the sync save (no staging/commit protocol — flat dir,
+        pre-existing contract), but snapshotted now and written behind.
+        An atexit hook waits for durability before interpreter exit."""
+        self.wait()
+        snap = self._snapshot(state_dict, rank, uid)
+
+        def job():
+            write_rank_files(path, rank, snap.chunks, snap.meta, snap.uid)
+            if rank == coordinator:
+                coordinator_finalize(path, snap.extras, ranks, snap.uid)
+
+        self._submit(_Job(job))
+        _register_atexit_wait(self)
+
+    def _snapshot(self, state_dict, rank: int, uid: int):
+        t0 = time.perf_counter()
+        snap = take_snapshot(state_dict, rank=rank, uid=uid)
+        if self._metrics is not None:
+            self._metrics.observe("snapshot_s", time.perf_counter() - t0)
+            self._metrics.inc("snapshots")
+        return snap
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self, wait: bool = True) -> None:
+        """Drain (surfacing any pending error when ``wait=True``) and
+        stop the write-behind thread. The thread is stopped even when
+        the pending error raises — close() must never leak it."""
+        try:
+            if wait:
+                self.wait()
+        finally:
+            with self._lock:
+                thread, self._thread = self._thread, None
+            if thread is not None and thread.is_alive():
+                self._queue.put(_STOP)
+                thread.join(timeout=10.0)
+
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(wait=exc[0] is None)
+
+
+_default: Optional[AsyncCheckpointer] = None
+_default_lock = threading.Lock()
+_atexit_registered = False
+
+
+def default_async_checkpointer() -> AsyncCheckpointer:
+    """Shared checkpointer behind bare ``save_state_dict(async_save=True)``
+    calls; its atexit hook blocks until the last write is durable."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = AsyncCheckpointer()
+        return _default
+
+
+def _register_atexit_wait(ckpt: AsyncCheckpointer) -> None:
+    global _atexit_registered
+    with _default_lock:
+        if _atexit_registered:
+            return
+        _atexit_registered = True
+    import atexit
+
+    def _drain():
+        try:
+            ckpt.wait()
+        except Exception as e:
+            import sys
+            print(f"paddle_tpu: async checkpoint write failed at exit: "
+                  f"{e}", file=sys.stderr)
+
+    atexit.register(_drain)

@@ -1,0 +1,229 @@
+"""Crash-consistent checkpoint commits.
+
+Protocol (every durable mutation goes through the injectable ``Fs``
+layer, so the fault harness can kill the save at any byte offset)::
+
+    <root>/
+      step_12/            committed: COMMITTED marker + merged metadata
+      step_14.tmp/        staging: being written, or torn by a crash
+      latest              pointer file, atomically replaced last
+
+    write order (coordinator):
+      1  step_N.tmp/shard_r*.npz, meta_r*.json   (per-rank writers)
+      2  step_N.tmp/extras.pkl, metadata.json    (merge of rank tables)
+      3  step_N.tmp/COMMITTED                    (marker written LAST)
+      4  rename step_N.tmp -> step_N             (atomic dir rename)
+      5  latest.tmp -> latest                    (atomic pointer flip)
+
+A kill anywhere before 4 leaves a ``.tmp`` staging dir that is NEVER
+eligible for resume (``latest_checkpoint`` only considers ``step_N``
+dirs); a kill between 4 and 5 leaves a committed ``step_N`` that the
+descending scan finds without the pointer. Either way the previous
+committed checkpoint survives intact.
+
+``latest_checkpoint`` re-validates the manifest on every resolve (marker
+parses, uid matches the merged table, every referenced shard file
+exists) and falls back to the previous committed step on corruption —
+the pointer file is a human/ops hint, never trusted over validation.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Optional, Tuple
+
+from ..checkpoint.metadata import Metadata
+from ..checkpoint.save_state_dict import (coordinator_finalize,
+                                          write_rank_files)
+from ..checkpoint.utils import snapshot_state_dict
+from .faults import get_fs
+
+__all__ = ["COMMITTED_MARKER", "FAILED_MARKER", "LATEST_POINTER",
+           "HostSnapshot", "take_snapshot", "write_committed_checkpoint",
+           "validate_checkpoint_dir", "latest_checkpoint",
+           "list_committed_steps", "step_dir", "staging_dir"]
+
+COMMITTED_MARKER = "COMMITTED"
+FAILED_MARKER = "FAILED"
+LATEST_POINTER = "latest"
+
+_STEP_DIR_RE = re.compile(r"^step_(\d+)$")
+_STAGING_DIR_RE = re.compile(r"^step_(\d+)\.tmp$")
+
+
+def step_dir(step: int) -> str:
+    return f"step_{int(step)}"
+
+
+def staging_dir(step: int) -> str:
+    return f"step_{int(step)}.tmp"
+
+
+@dataclasses.dataclass
+class HostSnapshot:
+    """One rank's checkpoint data, already on host RAM: the write-behind
+    thread needs no device access (and therefore no device sync) to make
+    it durable."""
+    chunks: dict        # npz_key -> np.ndarray
+    meta: Metadata      # this rank's chunk table
+    extras: dict        # non-tensor leaves (coordinator writes these)
+    uid: int
+    nbytes: int
+
+
+def take_snapshot(state_dict, rank: int = 0, uid: int = 0) -> HostSnapshot:
+    """Device→host snapshot (ONE batched ``jax.device_get`` — the only
+    point the training loop blocks for a save)."""
+    chunks, meta, extras = snapshot_state_dict(state_dict,
+                                               f"shard_r{rank}.npz")
+    nbytes = sum(int(a.nbytes) for a in chunks.values())
+    return HostSnapshot(chunks, meta, extras, int(uid), nbytes)
+
+
+def write_committed_checkpoint(snap: HostSnapshot, root: str, step: int,
+                               *, rank: int = 0, ranks=(0,),
+                               coordinator: int = 0, fs=None,
+                               merge_timeout_s: float = 300.0) -> str:
+    """Write ``snap`` into ``<root>/step_N.tmp`` and commit it (see the
+    module docstring for the write order). Returns the committed dir.
+
+    Non-coordinator ranks return after their shard+table writes; the
+    coordinator merges, writes the marker, renames, and flips the
+    pointer."""
+    fs = fs or get_fs()
+    staging = os.path.join(root, staging_dir(step))
+    final = os.path.join(root, step_dir(step))
+    fs.makedirs(root)
+    if rank == coordinator and os.path.isdir(staging):
+        # a previous crashed attempt at this very step: torn by
+        # construction (no rename happened), safe to clear
+        fs.rmtree(staging, label="gc-torn-staging")
+    write_rank_files(staging, rank, snap.chunks, snap.meta, snap.uid,
+                     fs=fs)
+    if rank != coordinator:
+        return final
+    coordinator_finalize(staging, snap.extras, ranks, snap.uid, fs=fs,
+                         merge_timeout_s=merge_timeout_s)
+    marker = {
+        "step": int(step),
+        "uid": int(snap.uid),
+        "world_size": len(ranks),
+        "ranks": sorted(int(r) for r in ranks),
+        "files": sorted(
+            [f"shard_r{r}.npz" for r in ranks]
+            + [f"meta_r{r}.json" for r in ranks]
+            + ["metadata.json", "extras.pkl"]),
+    }
+    tmp = os.path.join(staging, f".{COMMITTED_MARKER}.tmp")
+    fs.write_bytes(tmp, json.dumps(marker).encode(), label="marker.tmp")
+    fs.replace(tmp, os.path.join(staging, COMMITTED_MARKER),
+               label="marker")
+    if os.path.isdir(final):
+        # re-save of an already-committed step (uid collision / retry):
+        # clear the old dir so the rename below can land
+        fs.rmtree(final, label="gc-stale-final")
+    fs.replace(staging, final, label="commit-rename")
+    ptmp = os.path.join(root, f".{LATEST_POINTER}.tmp")
+    fs.write_bytes(ptmp, step_dir(step).encode(), label="pointer.tmp")
+    fs.replace(ptmp, os.path.join(root, LATEST_POINTER), label="pointer")
+    return final
+
+
+def validate_checkpoint_dir(path: str,
+                            expect_step: Optional[int] = None
+                            ) -> Tuple[bool, str]:
+    """Is ``path`` a crash-consistent committed checkpoint? Checks the
+    COMMITTED manifest (parses, step matches the dir name, uid matches
+    the merged table) and that every shard file the merged table
+    references exists. Returns (ok, reason)."""
+    if os.path.exists(os.path.join(path, FAILED_MARKER)):
+        return False, "FAILED marker present"
+    marker_p = os.path.join(path, COMMITTED_MARKER)
+    if not os.path.exists(marker_p):
+        return False, "no COMMITTED marker"
+    try:
+        with open(marker_p) as f:
+            marker = json.load(f)
+    except (json.JSONDecodeError, OSError) as e:
+        return False, f"COMMITTED marker unreadable: {e}"
+    if expect_step is not None and marker.get("step") != int(expect_step):
+        return False, (f"marker step {marker.get('step')} != dir step "
+                       f"{expect_step}")
+    meta_p = os.path.join(path, "metadata.json")
+    try:
+        with open(meta_p) as f:
+            meta_json = json.load(f)
+    except (json.JSONDecodeError, OSError) as e:
+        return False, f"metadata.json unreadable: {e}"
+    if meta_json.get("uid") != marker.get("uid"):
+        return False, (f"uid mismatch: metadata {meta_json.get('uid')} "
+                       f"!= marker {marker.get('uid')}")
+    for fn in marker.get("files", []):
+        if not os.path.exists(os.path.join(path, fn)):
+            return False, f"manifest file missing: {fn}"
+    meta = Metadata.from_json(meta_json)
+    for name, tm in meta.state_dict_metadata.items():
+        for _, idx in tm.chunks:
+            if not os.path.exists(os.path.join(path, idx.file_name)):
+                return False, (f"shard file missing: {idx.file_name} "
+                               f"(referenced by {name!r})")
+    return True, "ok"
+
+
+def list_committed_steps(root: str):
+    """Candidate committed dirs, ``[(step, name)]`` newest first —
+    ``.tmp`` staging dirs are never candidates."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        m = _STEP_DIR_RE.match(name)
+        if m and os.path.isdir(os.path.join(root, name)):
+            out.append((int(m.group(1)), name))
+    out.sort(reverse=True)
+    return out
+
+
+def list_staging_dirs(root: str):
+    """``[(step, name)]`` of staging dirs (torn unless a write is in
+    flight), newest first."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        m = _STAGING_DIR_RE.match(name)
+        if m and os.path.isdir(os.path.join(root, name)):
+            out.append((int(m.group(1)), name))
+    out.sort(reverse=True)
+    return out
+
+
+def latest_checkpoint(root: str) -> Optional[Tuple[int, str]]:
+    """Newest committed, VALIDATED checkpoint under ``root`` as
+    ``(step, path)``, or None. Walks committed dirs newest-first and
+    falls back past any that fail manifest validation (torn by a crash,
+    corrupted on disk) — a torn save can therefore never be resumed
+    from, only the previous committed one."""
+    for step, name in list_committed_steps(root):
+        path = os.path.join(root, name)
+        ok, _why = validate_checkpoint_dir(path, expect_step=step)
+        if ok:
+            return step, path
+    return None
+
+
+def read_latest_pointer(root: str) -> Optional[str]:
+    """The ``latest`` pointer's target dir name (a hint for humans and
+    dashboards; resume resolution always goes through
+    ``latest_checkpoint``'s validation instead)."""
+    try:
+        with open(os.path.join(root, LATEST_POINTER)) as f:
+            return f.read().strip() or None
+    except OSError:
+        return None

@@ -1,0 +1,265 @@
+"""Deterministic fault-injection harness for preemption-tolerance tests.
+
+Three injector families, all armed on one process-global ``FaultInjector``
+(tests drive it via the ``fault_injection()`` context manager, which
+resets on exit so a failing test can't leak faults into the next):
+
+- **kill-at-nth-write** — every durable checkpoint mutation funnels
+  through the ``Fs`` layer below; the injector crashes the "process"
+  (raises ``InjectedCrash``, a ``BaseException`` so production
+  ``except Exception`` cleanup can't accidentally survive a simulated
+  SIGKILL) immediately before the nth write, optionally after flushing
+  half the bytes — a genuinely torn file at a byte offset, not a tidy
+  missing one.
+- **sync-hang** — ``CommTaskManager.wait`` consults the injector: an
+  armed matching description swaps the device sync for a parked wait, so
+  the watchdog deadline fires exactly like a peer dying mid-collective.
+  The parked waiter blocks on an Event with a bounded timeout and
+  ``reset()`` releases it — an injected hang can never wedge interpreter
+  exit behind a stuck watchdog worker.
+- **heartbeat-drop** — the elastic ``_beat_loop`` skips lease renewals
+  for armed node ids, so peers observe the node dead without killing it.
+
+``arm_slow_disk`` is the latency sibling of the kill injector: it delays
+every ``Fs`` write, which is how tests prove the write-behind thread —
+not the training loop — absorbs disk time.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["InjectedCrash", "FaultInjector", "Fs", "get_fault_injector",
+           "get_fs", "fault_injection"]
+
+
+class InjectedCrash(BaseException):
+    """Simulated process death mid-write (fault-injection only).
+
+    Deliberately NOT an ``Exception``: a real SIGKILL gives cleanup code
+    no chance to run, so generic ``except Exception`` recovery in the
+    write path must not be able to "survive" an injected kill either."""
+
+
+class Fs:
+    """The durable-mutation layer for checkpoint writes.
+
+    Every byte that reaches disk during a checkpoint save goes through
+    one of these ops, each a named write boundary the injector can kill
+    at. Disarmed cost is one locked flag check per file operation — per
+    save, a handful."""
+
+    def __init__(self, injector: Optional["FaultInjector"] = None):
+        self._injector = injector
+
+    def _check(self, label: str, path: str, data: Optional[bytes] = None):
+        inj = self._injector or get_fault_injector()
+        if inj.armed:
+            inj.on_write(label, path, data)
+        else:
+            inj.count_write()
+
+    def makedirs(self, path: str, label: str = "mkdir") -> None:
+        self._check(label, path)
+        os.makedirs(path, exist_ok=True)
+
+    def write_bytes(self, path: str, data: bytes, label: str = "write"
+                    ) -> None:
+        self._check(label, path, data)
+        with open(path, "wb") as f:
+            f.write(data)
+
+    def write_stream(self, path: str, writer, label: str = "write"
+                     ) -> None:
+        """Streaming write: ``writer(fileobj)`` produces the payload
+        directly into the file — no full in-RAM materialization for
+        multi-GB shard archives. Only when a kill is armed is the
+        payload buffered first, so the injector can tear it at a byte
+        offset like any other boundary."""
+        inj = self._injector or get_fault_injector()
+        if inj.armed:
+            import io as _io
+            buf = _io.BytesIO()
+            writer(buf)
+            inj.on_write(label, path, buf.getvalue())  # may crash/tear
+            with open(path, "wb") as f:
+                f.write(buf.getvalue())
+        else:
+            inj.count_write()
+            with open(path, "wb") as f:
+                writer(f)
+
+    def replace(self, src: str, dst: str, label: str = "replace") -> None:
+        self._check(label, dst)
+        os.replace(src, dst)
+
+    def remove(self, path: str, label: str = "remove") -> None:
+        self._check(label, path)
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass
+
+    def rmtree(self, path: str, label: str = "rmtree") -> None:
+        self._check(label, path)
+        import shutil
+        shutil.rmtree(path, ignore_errors=True)
+
+
+class FaultInjector:
+    """Process-global, deterministic fault arming (see module docstring).
+
+    ``writes_seen`` counts every ``Fs`` boundary crossed since the last
+    ``reset()`` — tests run one clean save to enumerate the boundaries,
+    then re-run with ``arm_kill_at_write(n)`` for every n."""
+
+    _HANG_MAX_S = 60.0  # parked waiters always wake: never wedge exit
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hang_release = threading.Event()
+        self._reset_locked()
+
+    def _reset_locked(self):
+        self._kill_at: Optional[int] = None
+        self._kill_partial = True
+        self._write_count = 0
+        self._slow_disk_s = 0.0
+        self._hang_match: Optional[str] = None
+        self._hang_after = 0
+        self._hang_times = 0
+        self._hang_seen = 0
+        self._dropped_heartbeats: set = set()
+        self.crashes = 0
+        self.hangs_fired = 0
+        self.heartbeats_dropped = 0
+
+    def reset(self) -> None:
+        """Disarm everything and release any parked hang waiters."""
+        with self._lock:
+            self._hang_release.set()
+            self._hang_release = threading.Event()
+            self._reset_locked()
+
+    @property
+    def armed(self) -> bool:
+        with self._lock:
+            return (self._kill_at is not None or self._slow_disk_s > 0.0
+                    or self._hang_match is not None
+                    or bool(self._dropped_heartbeats))
+
+    @property
+    def writes_seen(self) -> int:
+        with self._lock:
+            return self._write_count
+
+    # -- kill-at-nth-write -------------------------------------------------
+    def arm_kill_at_write(self, n: int, partial: bool = True) -> None:
+        """Crash at the nth (0-based) ``Fs`` boundary crossed from now.
+        ``partial=True`` flushes half the payload first when the boundary
+        carries bytes — the torn-file case."""
+        with self._lock:
+            self._kill_at = int(n)
+            self._kill_partial = partial
+            self._write_count = 0
+
+    def arm_slow_disk(self, seconds: float) -> None:
+        """Delay every ``Fs`` write by ``seconds`` (injected slow disk)."""
+        with self._lock:
+            self._slow_disk_s = float(seconds)
+
+    def count_write(self) -> None:
+        with self._lock:
+            self._write_count += 1
+
+    def on_write(self, label: str, path: str,
+                 data: Optional[bytes] = None) -> None:
+        with self._lock:
+            n = self._write_count
+            self._write_count += 1
+            kill = self._kill_at is not None and n >= self._kill_at
+            delay = self._slow_disk_s
+            partial = self._kill_partial
+            if kill:
+                self.crashes += 1
+        if delay > 0.0:
+            time.sleep(delay)
+        if kill:
+            if data is not None and partial and len(data) > 1:
+                # flush a prefix so the surviving file is torn at a byte
+                # offset, not merely absent
+                with open(path, "wb") as f:
+                    f.write(data[:len(data) // 2])
+            raise InjectedCrash(
+                f"injected kill at write #{n} ({label}: {path})")
+
+    # -- sync-hang ---------------------------------------------------------
+    def arm_sync_hang(self, match: str = "", after: int = 0,
+                      times: int = 1) -> None:
+        """Hang device syncs whose watchdog description contains
+        ``match``: skip the first ``after`` matching waits, then hang the
+        next ``times`` of them."""
+        with self._lock:
+            self._hang_match = match
+            self._hang_after = int(after)
+            self._hang_times = int(times)
+            self._hang_seen = 0
+
+    def sync_hang_waiter(self, desc: str) -> Optional[Callable[[], None]]:
+        """The waiter ``CommTaskManager.wait`` should run instead of the
+        real sync, or None when this wait is not being hung."""
+        with self._lock:
+            if self._hang_match is None or self._hang_match not in desc:
+                return None
+            seen = self._hang_seen
+            self._hang_seen += 1
+            if seen < self._hang_after:
+                return None
+            if seen >= self._hang_after + self._hang_times:
+                return None
+            self.hangs_fired += 1
+            release = self._hang_release
+        return lambda: release.wait(self._HANG_MAX_S)
+
+    # -- heartbeat-drop ----------------------------------------------------
+    def arm_heartbeat_drop(self, node_id: str) -> None:
+        """Suppress elastic lease renewals for ``node_id`` — peers see it
+        dead after the heartbeat timeout while the process lives on."""
+        with self._lock:
+            self._dropped_heartbeats.add(str(node_id))
+
+    def heartbeat_allowed(self, node_id: str) -> bool:
+        with self._lock:
+            if node_id in self._dropped_heartbeats:
+                self.heartbeats_dropped += 1
+                return False
+            return True
+
+
+_INJECTOR = FaultInjector()
+_FS = Fs(_INJECTOR)
+
+
+def get_fault_injector() -> FaultInjector:
+    return _INJECTOR
+
+
+def get_fs() -> Fs:
+    """The default durable-write layer (consults the global injector)."""
+    return _FS
+
+
+@contextlib.contextmanager
+def fault_injection():
+    """``with fault_injection() as inj: inj.arm_...()`` — resets (and
+    releases parked hang waiters) on exit, so a failing test cannot leak
+    an armed fault into the next."""
+    inj = get_fault_injector()
+    inj.reset()
+    try:
+        yield inj
+    finally:
+        inj.reset()

@@ -1,0 +1,252 @@
+"""CheckpointManager: interval saves, rotation, GC, resume resolution.
+
+The training-loop face of the resilience stack (``run_steps`` drives it
+via ``checkpoint_manager=``): ``maybe_save(step, state)`` snapshots on
+interval and commits asynchronously; ``restore(state)`` resolves the
+newest VALIDATED committed checkpoint (falling back past torn ones) and
+loads it with the existing reshard-on-restore, so a shrunk world resumes
+from shards saved by a larger one.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Tuple
+
+from ..checkpoint.load_state_dict import load_state_dict
+from ..checkpoint.save_state_dict import resolve_participants
+from .async_ckpt import AsyncCheckpointer
+from .commit import (latest_checkpoint, list_committed_steps,
+                     list_staging_dirs, step_dir, take_snapshot,
+                     validate_checkpoint_dir, write_committed_checkpoint)
+from .faults import get_fs
+from .metrics import ResilienceMetrics
+
+__all__ = ["CheckpointManager"]
+
+
+class CheckpointManager:
+    """Owns one checkpoint root directory.
+
+    Knobs: ``interval`` (save every N steps through ``maybe_save``),
+    ``keep_n`` (committed checkpoints retained, newest first; None keeps
+    all), ``async_save`` (snapshot-then-write-behind vs fully blocking
+    saves), ``merge_timeout_s`` (coordinator wait for straggler rank
+    tables). Metrics surface as ``profiler.resilience_stats()[name]``.
+
+    Construction GCs leftovers of a previous crash (torn ``.tmp``
+    staging dirs, FAILED-marked and unvalidatable step dirs), so a
+    relaunched worker starts from a clean root.
+    """
+
+    def __init__(self, root, interval: int = 1,
+                 keep_n: Optional[int] = None, async_save: bool = True,
+                 process_group=None, coordinator_rank: int = 0,
+                 merge_timeout_s: float = 300.0,
+                 name: Optional[str] = None):
+        self.root = str(root)
+        self.interval = int(interval)
+        self.keep_n = keep_n
+        self._pg = process_group
+        self._coordinator_rank = coordinator_rank
+        self._merge_timeout_s = float(merge_timeout_s)
+        self.name = name or f"ckpt:{os.path.basename(self.root) or 'root'}"
+        self._metrics = ResilienceMetrics(self.name)
+        try:
+            from ..comm_watchdog import get_comm_task_manager
+            self._metrics.set_hang_count_fn(
+                lambda: get_comm_task_manager().hang_count)
+        except Exception:
+            pass
+        from ... import profiler
+        profiler.register_resilience_source(self.name, self._metrics)
+        self._ckpt = AsyncCheckpointer(self._metrics) if async_save \
+            else None
+        self._state_lock = threading.Lock()
+        self._inflight_step: Optional[int] = None
+        self._last_saved_step: Optional[int] = None
+        self._closed = False
+        self.gc()
+
+    @property
+    def metrics(self) -> ResilienceMetrics:
+        return self._metrics
+
+    # -- saving ------------------------------------------------------------
+    def maybe_save(self, step: int, state_dict) -> bool:
+        """Save iff ``step`` lands on the interval (and wasn't already
+        saved). Non-saving calls still ``poll()`` the write-behind
+        thread, so a background failure surfaces within one step."""
+        if self._ckpt is not None:
+            self._surfacing(self._ckpt.poll)
+        if self.interval <= 0 or step % self.interval != 0:
+            return False
+        if step == self._last_saved_step:
+            return False
+        return self.save(step, state_dict)
+
+    def _surfacing(self, fn):
+        """Run a call that may surface a write-behind failure; on one,
+        un-mark the in-flight step first — its staging dir is torn, and
+        leaving it marked in-flight would shield it from GC forever."""
+        try:
+            return fn()
+        except BaseException:
+            with self._state_lock:
+                self._inflight_step = None
+            raise
+
+    def save(self, step: int, state_dict,
+             blocking: Optional[bool] = None) -> bool:
+        """Checkpoint ``state_dict`` as committed step ``step``. Async
+        unless constructed with ``async_save=False`` or called with
+        ``blocking=True``. Returns False when this process is not a
+        participant of the process group (nothing was saved)."""
+        step = int(step)
+        if self._ckpt is not None and not blocking:
+            # marked in-flight BEFORE submit: a fast background commit
+            # may fire _on_commit before save() returns
+            with self._state_lock:
+                self._inflight_step = step
+            submitted = self._surfacing(lambda: self._ckpt.save(
+                state_dict, self.root, step,
+                process_group=self._pg,
+                coordinator_rank=self._coordinator_rank,
+                merge_timeout_s=self._merge_timeout_s,
+                on_commit=self._on_commit))
+            if not submitted:
+                with self._state_lock:
+                    self._inflight_step = None
+                return False
+        else:
+            parts = resolve_participants(self._pg, self._coordinator_rank)
+            if parts is None:
+                return False
+            rank, ranks, coordinator = parts
+            import time as _time
+            t0 = _time.perf_counter()
+            snap = take_snapshot(state_dict, rank=rank, uid=step)
+            self._metrics.observe("snapshot_s",
+                                  _time.perf_counter() - t0)
+            self._metrics.inc("snapshots")
+            t1 = _time.perf_counter()
+            final = write_committed_checkpoint(
+                snap, self.root, step, rank=rank, ranks=ranks,
+                coordinator=coordinator,
+                merge_timeout_s=self._merge_timeout_s)
+            if rank == coordinator:
+                # only the coordinator's return means COMMITTED (other
+                # ranks return after their shard writes, pre-marker)
+                self._metrics.observe("commit_s",
+                                      _time.perf_counter() - t1)
+                self._metrics.inc("commits")
+                self._metrics.set_last_committed_step(step)
+            self._on_commit(step, final)
+        self._last_saved_step = step
+        return True
+
+    def _on_commit(self, step: int, final: str) -> None:
+        # runs on the write-behind thread for async saves
+        with self._state_lock:
+            if self._inflight_step == step:
+                self._inflight_step = None
+        self.gc()
+
+    def wait(self) -> None:
+        """Block until the in-flight write commits; raise its error."""
+        if self._ckpt is not None:
+            self._surfacing(self._ckpt.wait)
+
+    def record_restart(self) -> None:
+        """Count one fault recovery (``run_steps(on_fault=)`` calls this
+        after a successful restore-and-resume)."""
+        self._metrics.inc("restarts")
+
+    # -- resolution / restore ----------------------------------------------
+    def latest_checkpoint(self) -> Optional[Tuple[int, str]]:
+        """Newest committed VALIDATED ``(step, path)``, or None."""
+        return latest_checkpoint(self.root)
+
+    def latest_step(self) -> Optional[int]:
+        found = self.latest_checkpoint()
+        return None if found is None else found[0]
+
+    def restore(self, state_dict) -> Optional[int]:
+        """Load the newest committed checkpoint into ``state_dict`` in
+        place (reshard-on-restore: each leaf keeps its CURRENT sharding,
+        data is overlap-read from the saved layout — a shrunk/regrown
+        world restores transparently). Returns the step, or None when no
+        committed checkpoint exists."""
+        found = self.latest_checkpoint()
+        if found is None:
+            return None
+        step, path = found
+        load_state_dict(state_dict, path)
+        return step
+
+    # -- GC ----------------------------------------------------------------
+    def gc(self) -> list:
+        """Delete torn staging dirs, FAILED/unvalidatable step dirs, and
+        committed checkpoints beyond ``keep_n`` (newest kept). The dir of
+        an in-flight async save is never touched. Coordinator-only on
+        multi-rank groups (one process must own deletions)."""
+        parts = resolve_participants(self._pg, self._coordinator_rank)
+        if parts is None:
+            return []
+        rank, _ranks, coordinator = parts
+        if rank != coordinator:
+            return []
+        with self._state_lock:
+            inflight = self._inflight_step
+        fs = get_fs()
+        removed = []
+        for step, dname in list_staging_dirs(self.root):
+            if step == inflight:
+                continue
+            fs.rmtree(os.path.join(self.root, dname), label="gc-torn")
+            removed.append(dname)
+        committed = []
+        for step, dname in list_committed_steps(self.root):
+            if step == inflight:
+                continue
+            path = os.path.join(self.root, dname)
+            ok, _why = validate_checkpoint_dir(path, expect_step=step)
+            if ok:
+                committed.append((step, dname))
+            else:
+                fs.rmtree(path, label="gc-unvalidatable")
+                removed.append(dname)
+        if self.keep_n is not None and self.keep_n > 0:
+            for step, dname in committed[self.keep_n:]:
+                fs.rmtree(os.path.join(self.root, dname),
+                          label="gc-rotate")
+                removed.append(dname)
+        if removed:
+            self._metrics.inc("gc_removed", len(removed))
+        return removed
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        """Drain the write-behind thread (raising any pending write
+        error) and unregister metrics."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            if self._ckpt is not None:
+                self._ckpt.close(wait=True)
+        finally:
+            from ... import profiler
+            profiler.unregister_resilience_source(self.name,
+                                                  self._metrics)
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __repr__(self) -> str:
+        return (f"CheckpointManager(root={self.root!r}, "
+                f"interval={self.interval}, keep_n={self.keep_n}, "
+                f"last_committed={step_dir(self._last_saved_step) if self._last_saved_step is not None else None})")

@@ -1,0 +1,59 @@
+"""Resilience observability: one metrics bundle per CheckpointManager,
+surfaced through ``profiler.resilience_stats()`` / ``export_stats()``.
+
+Counters: snapshots (device→host captures), commits (checkpoints made
+durable), write_errors (background writer failures surfaced), restarts
+(recoveries through ``run_steps(on_fault=)``), gc_removed (torn/stale
+dirs deleted). Histograms: snapshot_s (the only training-loop block),
+commit_s (staging-dir write through pointer flip, on the write-behind
+thread). Gauges: write_behind_queue_depth, last_committed_step,
+hang_count (mirrored from the comm watchdog at snapshot time).
+"""
+from __future__ import annotations
+
+import threading
+
+from ...profiler.metrics import MetricsBase
+
+__all__ = ["ResilienceMetrics"]
+
+
+class ResilienceMetrics(MetricsBase):
+    COUNTERS = ("snapshots", "commits", "write_errors", "restarts",
+                "gc_removed")
+    HISTS = ("snapshot_s", "commit_s")
+    TIMES = ()
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self._gauge_lock = threading.Lock()
+        self._last_committed_step = -1
+        self._hang_count_fn = None
+
+    def set_last_committed_step(self, step: int) -> None:
+        with self._gauge_lock:
+            self._last_committed_step = int(step)
+
+    def set_hang_count_fn(self, fn) -> None:
+        """Pull-type: read the comm watchdog's hang counter at snapshot
+        time instead of duplicating state."""
+        self._hang_count_fn = fn
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out: dict = dict(self._counters)
+            out["name"] = self.name
+            for k, h in self._hists.items():
+                out[k] = h.snapshot()
+        with self._gauge_lock:
+            out["last_committed_step"] = self._last_committed_step
+        out["write_behind_queue_depth"] = self._read_gauge()
+        fn = self._hang_count_fn
+        if fn is not None:
+            try:
+                out["hang_count"] = int(fn())
+            except Exception:
+                out["hang_count"] = -1
+        else:
+            out["hang_count"] = 0
+        return out

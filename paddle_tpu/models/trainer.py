@@ -18,7 +18,7 @@ from ..nn.layer.layers import _swapped_state, functional_state
 
 __all__ = ["create_train_step", "create_multistep_train_step",
            "create_sharded_train_step", "place_by_spec", "run_steps",
-           "write_back"]
+           "restore_training_state", "write_back"]
 
 
 def place_by_spec(arr, spec, mesh, name=None):
@@ -280,8 +280,53 @@ def create_sharded_train_step(model, optimizer, mesh, param_spec_fn,
     return sharded_step, params, opt_state, shard_batch
 
 
+def _recoverable_fault_types():
+    """Exceptions ``run_steps(on_fault=)`` treats as recoverable faults:
+    the comm watchdog's deadline abort and the fault harness's injected
+    worker death. Lazy — the distributed package only loads when a fault
+    handler is installed."""
+    from ..distributed.comm_watchdog import CommTimeoutError
+    from ..distributed.resilience.faults import InjectedCrash
+    return (CommTimeoutError, InjectedCrash)
+
+
+def restore_training_state(checkpoint_manager, params, opt_state):
+    """Resolve the newest committed checkpoint and load it over copies of
+    the given training trees — each leaf keeps its CURRENT sharding, so a
+    relaunched (possibly shrunk) world reshards on restore. Returns
+    ``(params, opt_state, step)`` where ``step`` is the committed step the
+    trees now hold, or ``None`` when no committed checkpoint exists.
+
+    This is the restore half of the ``run_steps`` checkpoint layout
+    (``{"params": ..., "opt_state": ..., "step": ...}``); a typical
+    ``on_fault`` handler is::
+
+        def on_fault(exc, step):
+            got = restore_training_state(manager, params0, opt_state0)
+            if got is None:
+                return None          # nothing committed: re-raise
+            p, s, committed = got
+            return p, s, committed + 1
+    """
+    state = {"params": dict(params),
+             "opt_state": {k: dict(v) for k, v in opt_state.items()},
+             "step": -1}
+    step = checkpoint_manager.restore(state)
+    if step is None:
+        return None
+
+    def unwrap(v):
+        return v._data if isinstance(v, Tensor) else v
+
+    params = {k: unwrap(v) for k, v in state["params"].items()}
+    opt_state = {k: {n: unwrap(v) for n, v in st.items()}
+                 for k, st in state["opt_state"].items()}
+    return params, opt_state, step
+
+
 def run_steps(step, params, opt_state, feed, *, key=None, lr=1e-3,
-              log_every=0, on_log=None, name=None):
+              log_every=0, on_log=None, name=None, start_step=0,
+              checkpoint_manager=None, on_fault=None):
     """Overlap-aware loop runner: drive ``step`` over every ``(ids,
     labels)`` batch in ``feed`` WITHOUT ever blocking on the current
     step's loss. JAX dispatch is async — the returned loss is a future —
@@ -309,6 +354,24 @@ def run_steps(step, params, opt_state, feed, *, key=None, lr=1e-3,
     metrics object is reused (one snapshot answers for the whole
     pipeline); otherwise a fresh source named ``name`` (default
     ``"run_steps"``) is registered for the duration of the run.
+
+    Preemption tolerance (``distributed.resilience``): with
+    ``checkpoint_manager=`` the loop calls ``maybe_save(i, state)``
+    after dispatching step ``i`` with the post-step trees under
+    ``{"params", "opt_state", "step"}`` — an async manager blocks only
+    for the device→host snapshot; every disk write happens behind. With
+    ``on_fault=`` a ``CommTimeoutError`` (watchdog deadline: a peer died
+    mid-collective) or ``InjectedCrash`` (fault harness) is caught and
+    ``on_fault(exc, step_index)`` decides: return ``None`` to re-raise,
+    or ``(params, opt_state, resume_step)`` (usually via
+    ``restore_training_state``) to resume — losses past ``resume_step``
+    are discarded and the feed replays from there, so the trajectory is
+    exactly what an unkilled run restored from the same checkpoint
+    produces (per-step RNG is ``fold_in(key, i)``, a function of the
+    global step). Recovery needs a replayable feed: pass a *callable*
+    ``feed(start) -> iterable`` yielding batches for steps ``start,
+    start+1, ...``; ``start_step`` offsets the whole run (resuming a
+    previous process at the step after its restored checkpoint).
     """
     import time
 
@@ -318,6 +381,13 @@ def run_steps(step, params, opt_state, feed, *, key=None, lr=1e-3,
         key = jax.random.key(0)
     lr_fn = lr if callable(lr) else (lambda i: lr)
 
+    feed_is_factory = callable(feed) and not hasattr(feed, "__iter__")
+    if on_fault is not None and not feed_is_factory:
+        # fail at call time, not after the first fault has already paid
+        # for a full checkpoint restore it can't use
+        raise TypeError(
+            "run_steps fault recovery needs a replayable feed: pass "
+            "feed as a callable feed(start) -> iterable of batches")
     owns_metrics = not isinstance(feed, DevicePrefetcher)
     if owns_metrics:
         from .. import profiler
@@ -325,6 +395,8 @@ def run_steps(step, params, opt_state, feed, *, key=None, lr=1e-3,
         profiler.register_pipeline_source(metrics.name, metrics)
     else:
         metrics = feed.metrics
+    recoverable = _recoverable_fault_types() if on_fault is not None \
+        else ()
 
     losses = []
     pending = None
@@ -337,27 +409,49 @@ def run_steps(step, params, opt_state, feed, *, key=None, lr=1e-3,
         if log_every and on_log is not None and i % log_every == 0:
             on_log(i, got)
 
+    i0 = start_step
     try:
-        it = iter(feed)
-        i = 0
+        it = iter(feed(i0) if feed_is_factory else feed)
+        i = i0
         while True:
-            t0 = time.perf_counter()
             try:
-                batch = next(it)
-            except StopIteration:
-                break
-            if owns_metrics:
-                metrics.add_time("host_blocked_s",
-                                 time.perf_counter() - t0)
-                metrics.inc("batches_out")
-            ids, labels = batch
-            loss, params, opt_state = step(
-                params, opt_state, jax.random.fold_in(key, i), ids,
-                labels, lr_fn(i))
-            if pending is not None:
-                fetch(pending, i - 1)
-            pending = loss
-            i += 1
+                t0 = time.perf_counter()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                if owns_metrics:
+                    metrics.add_time("host_blocked_s",
+                                     time.perf_counter() - t0)
+                    metrics.inc("batches_out")
+                ids, labels = batch
+                loss, params, opt_state = step(
+                    params, opt_state, jax.random.fold_in(key, i), ids,
+                    labels, lr_fn(i))
+                if checkpoint_manager is not None:
+                    checkpoint_manager.maybe_save(
+                        i, {"params": params, "opt_state": opt_state,
+                            "step": i})
+                if pending is not None:
+                    fetch(pending, i - 1)
+                pending = loss
+                i += 1
+            except recoverable as e:
+                recovered = on_fault(e, i)
+                if recovered is None:
+                    raise
+                params, opt_state, resume = recovered
+                if pending is not None and i - 1 < resume:
+                    # the lagged loss of step i-1 is BEFORE the resume
+                    # point: part of the kept trajectory, fetch it (its
+                    # step completed; the fault hit a later boundary)
+                    fetch(pending, i - 1)
+                del losses[max(0, resume - i0):]
+                pending = None
+                i = int(resume)
+                it = iter(feed(i))
+                if checkpoint_manager is not None:
+                    checkpoint_manager.record_restart()
         if pending is not None:
             fetch(pending, i - 1)
     finally:

@@ -27,7 +27,9 @@ __all__ = ["ProfilerState", "ProfilerTarget", "make_scheduler",
            "pipeline_stats", "register_pipeline_source",
            "unregister_pipeline_source", "record_placement_fallback",
            "decode_stats", "register_decode_source",
-           "unregister_decode_source", "export_stats"]
+           "unregister_decode_source", "resilience_stats",
+           "register_resilience_source", "unregister_resilience_source",
+           "export_stats"]
 
 
 class ProfilerState(Enum):
@@ -371,6 +373,7 @@ class _SourceRegistry:
 _serving_registry = _SourceRegistry("serving")
 _pipeline_registry = _SourceRegistry("pipeline")
 _decode_registry = _SourceRegistry("decode")
+_resilience_registry = _SourceRegistry("resilience")
 
 
 def register_serving_source(name: str, metrics) -> None:
@@ -465,6 +468,29 @@ def decode_stats(name: Optional[str] = None):
     return _decode_registry.stats(name)
 
 
+def register_resilience_source(name: str, metrics) -> None:
+    """Register a resilience metrics source (an object with
+    .snapshot()). Called by distributed.resilience.CheckpointManager on
+    construction."""
+    _resilience_registry.register(name, metrics)
+
+
+def unregister_resilience_source(name: str, metrics=None) -> None:
+    """Remove a resilience source (only if it still points at
+    ``metrics``, when given)."""
+    _resilience_registry.unregister(name, metrics)
+
+
+def resilience_stats(name: Optional[str] = None):
+    """Snapshot of preemption-tolerance metrics: snapshot/commit latency,
+    write-behind queue depth, comm-watchdog hang count, restarts, last
+    committed step — per registered CheckpointManager.
+
+    Returns ``{manager_name: snapshot_dict}``, or one snapshot when
+    ``name`` is given (KeyError when that manager is gone)."""
+    return _resilience_registry.stats(name)
+
+
 def _flatten_scrape(prefix: str, value, out: list) -> None:
     """dict/number tree -> ``name value`` exposition lines (labels are
     flattened into the metric name; non-numeric leaves are dropped —
@@ -494,7 +520,7 @@ def export_stats(format: str = "dict"):
     numeric leaf, names prefixed ``paddle_tpu_<registry>_<source>_``).
     """
     data = {"pipeline": pipeline_stats(), "serving": serving_stats(),
-            "decode": decode_stats()}
+            "decode": decode_stats(), "resilience": resilience_stats()}
     if format == "dict":
         return data
     if format == "json":

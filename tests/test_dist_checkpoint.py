@@ -1,9 +1,17 @@
-"""Distributed checkpoint: save sharded → load under a different layout.
+"""Distributed checkpoint: save sharded → load under a different layout,
+and crash-consistent commits that survive a kill at any write boundary.
 
 Mirrors the reference test strategy for ``python/paddle/distributed/
 checkpoint/`` (reshard-on-load across changed mesh/placements) on the
-8-virtual-device CPU platform.
+8-virtual-device CPU platform. The torn-checkpoint sweep drives the
+``resilience.faults`` injector through every ``Fs`` write boundary of a
+commit (mid-npz, pre-marker, pre-pointer, ...) and asserts resume
+resolution NEVER lands on a torn save.
 """
+import json
+import os
+import shutil
+
 import numpy as np
 import pytest
 
@@ -162,3 +170,198 @@ class TestCoverageMask:
         reader = _ChunkReader(d)
         with pytest.raises(ValueError, match="cover only"):
             _assemble(reader, meta, "w", (0, 0), (4, 4), np.float32)
+
+
+def _commit(root, step, value, uid=None):
+    """One committed single-rank checkpoint holding w=full(value)."""
+    from paddle_tpu.distributed.resilience import (take_snapshot,
+                                                   write_committed_checkpoint)
+    state = {"w": paddle.to_tensor(np.full((4, 4), value, np.float32)),
+             "step": int(step)}
+    snap = take_snapshot(state, rank=0, uid=step if uid is None else uid)
+    return write_committed_checkpoint(snap, root, step)
+
+
+class TestCrashConsistentCommit:
+    def test_kill_at_every_write_boundary(self, tmp_path):
+        """Sweep the injected kill across EVERY durable write boundary of
+        a commit. Invariant: ``latest_checkpoint`` always resolves a
+        VALIDATED checkpoint — the previous committed step for any kill
+        before the atomic dir rename (the save is torn), the new step
+        only once the rename made it durable. A torn save is never
+        resumable."""
+        from paddle_tpu.distributed.resilience import (
+            InjectedCrash, fault_injection, latest_checkpoint,
+            validate_checkpoint_dir)
+        root = str(tmp_path / "root")
+        _commit(root, 1, 1.0)
+        assert latest_checkpoint(root)[0] == 1
+
+        # enumerate the write boundaries with one clean dry-run commit
+        with fault_injection() as inj:
+            _commit(str(tmp_path / "scratch"), 2, 2.0)
+            n_writes = inj.writes_seen
+        assert n_writes >= 10  # shard, tables, extras, marker, rename...
+
+        saw_fallback = saw_committed = False
+        for n in range(n_writes):
+            for leftover in ("step_2", "step_2.tmp"):
+                p = os.path.join(root, leftover)
+                if os.path.isdir(p):
+                    shutil.rmtree(p)
+            with fault_injection() as inj:
+                inj.arm_kill_at_write(n)
+                with pytest.raises(InjectedCrash):
+                    _commit(root, 2, 2.0)
+            got = latest_checkpoint(root)
+            assert got is not None, f"boundary {n}: nothing resumable"
+            step, path = got
+            ok, why = validate_checkpoint_dir(path, expect_step=step)
+            assert ok, f"boundary {n}: resolved invalid ckpt: {why}"
+            final = os.path.join(root, "step_2")
+            renamed = os.path.isdir(final) and \
+                validate_checkpoint_dir(final, expect_step=2)[0]
+            if renamed:
+                assert step == 2
+                saw_committed = True
+            else:
+                assert step == 1, \
+                    f"boundary {n}: torn save resolved as step {step}"
+                saw_fallback = True
+            # resolved data must be intact, not torn bytes
+            tgt = {"w": paddle.zeros([4, 4]), "step": -1}
+            dist.load_state_dict(tgt, path)
+            np.testing.assert_array_equal(tgt["w"].numpy(),
+                                          np.full((4, 4), float(step)))
+        # the sweep must exercise both regimes (pre- and post-rename)
+        assert saw_fallback and saw_committed
+
+    def test_recommit_same_step_replaces_cleanly(self, tmp_path):
+        """uid collision: re-committing an already-committed step (retry
+        after a reported-failed save) replaces the old dir atomically and
+        stays resolvable/valid."""
+        from paddle_tpu.distributed.resilience import (latest_checkpoint,
+                                                       validate_checkpoint_dir)
+        root = str(tmp_path / "root")
+        _commit(root, 3, 1.0)
+        _commit(root, 3, 9.0)
+        step, path = latest_checkpoint(root)
+        assert step == 3
+        assert validate_checkpoint_dir(path, expect_step=3)[0]
+        tgt = {"w": paddle.zeros([4, 4]), "step": -1}
+        dist.load_state_dict(tgt, path)
+        np.testing.assert_array_equal(tgt["w"].numpy(),
+                                      np.full((4, 4), 9.0))
+
+    def test_uid_mismatch_invalidates_dir(self, tmp_path):
+        """A metadata table whose uid disagrees with the COMMITTED marker
+        (mixed-generation dir) must fail validation and fall back."""
+        from paddle_tpu.distributed.resilience import (latest_checkpoint,
+                                                       validate_checkpoint_dir)
+        root = str(tmp_path / "root")
+        _commit(root, 1, 1.0)
+        path2 = _commit(root, 2, 2.0)
+        meta_p = os.path.join(path2, "metadata.json")
+        with open(meta_p) as f:
+            meta_json = json.load(f)
+        meta_json["uid"] = 999  # stale table from another save generation
+        with open(meta_p, "w") as f:
+            json.dump(meta_json, f)
+        ok, why = validate_checkpoint_dir(path2, expect_step=2)
+        assert not ok and "uid" in why
+        assert latest_checkpoint(root)[0] == 1
+
+
+class TestStaleRankGC:
+    def test_shrunk_world_save_removes_stale_rank_files(self, ckpt_dir):
+        """A re-save into a fixed dir from a SHRUNK world must GC the
+        shard/meta files of ranks that are no longer participants —
+        otherwise a later load can resurrect stale shards."""
+        from paddle_tpu.distributed.checkpoint.utils import \
+            snapshot_state_dict
+        from paddle_tpu.distributed.checkpoint.save_state_dict import \
+            write_rank_files
+        dist.save_state_dict(
+            {"w": paddle.to_tensor(np.ones((4, 4), np.float32))}, ckpt_dir)
+        # plant rank-7 leftovers as if a previous 8-rank world saved here
+        chunks, meta, _ = snapshot_state_dict(
+            {"w": paddle.to_tensor(np.full((4, 4), 7.0, np.float32))},
+            "shard_r7.npz")
+        write_rank_files(ckpt_dir, 7, chunks, meta, uid=0)
+        assert "shard_r7.npz" in os.listdir(ckpt_dir)
+
+        dist.save_state_dict(
+            {"w": paddle.to_tensor(np.full((4, 4), 5.0, np.float32))},
+            ckpt_dir, unique_id=1)
+        names = set(os.listdir(ckpt_dir))
+        assert "shard_r7.npz" not in names
+        assert "meta_r7.json" not in names
+        with open(os.path.join(ckpt_dir, "metadata.json")) as f:
+            merged = json.load(f)
+        assert merged["uid"] == 1
+        blob = json.dumps(merged)
+        assert "shard_r7.npz" not in blob
+        tgt = {"w": paddle.zeros([4, 4])}
+        dist.load_state_dict(tgt, ckpt_dir)
+        np.testing.assert_array_equal(tgt["w"].numpy(),
+                                      np.full((4, 4), 5.0))
+
+
+class TestMergeTimeout:
+    def test_timeout_writes_failed_marker(self, ckpt_dir, monkeypatch):
+        """A coordinator whose straggler rank never lands its table must
+        (a) raise, (b) tombstone the partial dir with a FAILED marker so
+        the resilience GC can identify it, (c) back off instead of
+        busy-spinning the 50 ms floor."""
+        import time as _time
+        from paddle_tpu.distributed.checkpoint.save_state_dict import (
+            _merge_metadata, write_rank_files)
+        from paddle_tpu.distributed.checkpoint.utils import \
+            snapshot_state_dict
+        from paddle_tpu.distributed.resilience import validate_checkpoint_dir
+        chunks, meta, _ = snapshot_state_dict(
+            {"w": paddle.to_tensor(np.ones((2, 2), np.float32))},
+            "shard_r0.npz")
+        write_rank_files(ckpt_dir, 0, chunks, meta, uid=0)
+        sleeps = []
+        real_sleep = _time.sleep
+
+        def spy_sleep(s):
+            sleeps.append(s)
+            real_sleep(min(s, 0.01))  # record the backoff, stay fast
+
+        monkeypatch.setattr(_time, "sleep", spy_sleep)
+        with pytest.raises(TimeoutError, match="1/2"):
+            _merge_metadata(ckpt_dir, [0, 1], 0, timeout_s=0.5)
+        failed = os.path.join(ckpt_dir, "FAILED")
+        assert os.path.exists(failed)
+        with open(failed) as f:
+            info = json.load(f)
+        assert info["have_ranks"] == [0] and info["want_ranks"] == [0, 1]
+        # exponential backoff: strictly growing toward the 1 s cap
+        assert sleeps and sleeps[0] == pytest.approx(0.05)
+        assert all(b >= a for a, b in zip(sleeps, sleeps[1:]))
+        assert max(sleeps) <= 1.0
+        # a FAILED-marked dir is never resumable
+        assert not validate_checkpoint_dir(ckpt_dir)[0]
+
+
+class TestAsyncSaveFlag:
+    def test_async_save_routes_through_write_behind(self, ckpt_dir):
+        """The once-ignored ``async_save`` flag now runs every disk write
+        behind (deprecation-warned: the bare flag blocks at exit instead
+        of committing crash-consistently) and produces the identical flat
+        layout."""
+        from paddle_tpu.distributed.resilience.async_ckpt import \
+            default_async_checkpointer
+        x = np.arange(16, dtype=np.float32).reshape(4, 4)
+        with pytest.warns(DeprecationWarning, match="CheckpointManager"):
+            dist.save_state_dict({"w": paddle.to_tensor(x)}, ckpt_dir,
+                                 async_save=True)
+        default_async_checkpointer().wait()  # durable before reading
+        names = set(os.listdir(ckpt_dir))
+        assert {"shard_r0.npz", "meta_r0.json", "metadata.json",
+                "extras.pkl"} <= names
+        tgt = {"w": paddle.zeros([4, 4])}
+        dist.load_state_dict(tgt, ckpt_dir)
+        np.testing.assert_array_equal(tgt["w"].numpy(), x)

@@ -1,0 +1,205 @@
+"""Resilience checkpointing: CheckpointManager interval/rotation/GC,
+AsyncCheckpointer off-hot-path saves, background-error surfacing.
+
+Named ``test_zz_*`` so it sorts after the tier-1 870 s truncation point
+(around ``test_pallas_*``) — run directly::
+
+    python -m pytest tests/test_zz_resilience_ckpt.py -q
+
+Oracles: the async save may block the caller only for the device→host
+snapshot (proved with an injected slow disk + a device_get counter); a
+write-behind failure must surface on the NEXT maybe_save, never be
+swallowed; construction/GC must delete exactly the torn and rotated
+dirs, never a committed-and-kept one.
+"""
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import profiler
+from paddle_tpu.distributed.resilience import (CheckpointManager,
+                                               CheckpointWriteError,
+                                               fault_injection,
+                                               latest_checkpoint,
+                                               validate_checkpoint_dir)
+
+
+def _state(value=1.0):
+    return {"w": jnp.full((64,), value, jnp.float32),
+            "b": jnp.arange(8.0), "step": int(value)}
+
+
+class TestManagerLifecycle:
+    def test_interval_rotation_restore(self, tmp_path):
+        root = str(tmp_path / "root")
+        with CheckpointManager(root, interval=2, keep_n=2) as mgr:
+            for s in range(7):
+                saved = mgr.maybe_save(s, _state(s))
+                assert saved == (s % 2 == 0)
+            assert mgr.maybe_save(6, _state(6)) is False  # already saved
+            mgr.wait()
+            mgr.gc()
+            assert mgr.latest_step() == 6
+            # keep_n=2: only the two newest committed dirs survive
+            dirs = sorted(d for d in os.listdir(root)
+                          if d.startswith("step_"))
+            assert dirs == ["step_4", "step_6"]
+            tgt = {"w": jnp.zeros((64,)), "b": jnp.zeros((8,)), "step": -1}
+            assert mgr.restore(tgt) == 6
+            assert tgt["step"] == 6
+            np.testing.assert_array_equal(
+                np.asarray(tgt["w"]._data), np.full((64,), 6.0))
+
+    def test_construction_gc_cleans_crash_leftovers(self, tmp_path):
+        """A relaunched worker must start from a clean root: torn .tmp
+        staging dirs, FAILED-marked dirs, and unvalidatable step dirs of
+        the previous incarnation are deleted; committed ones survive."""
+        root = str(tmp_path / "root")
+        with CheckpointManager(root, interval=1) as mgr:
+            mgr.save(1, _state(1), blocking=True)
+        # simulate a crash's leftovers
+        os.makedirs(os.path.join(root, "step_2.tmp"))
+        with open(os.path.join(root, "step_2.tmp", "shard_r0.npz"),
+                  "wb") as f:
+            f.write(b"torn bytes")
+        os.makedirs(os.path.join(root, "step_3"))
+        with open(os.path.join(root, "step_3", "FAILED"), "w") as f:
+            json.dump({"reason": "merge timed out"}, f)
+        os.makedirs(os.path.join(root, "step_4"))  # no marker at all
+
+        with CheckpointManager(root, interval=1) as mgr2:
+            names = set(os.listdir(root))
+            assert "step_2.tmp" not in names
+            assert "step_3" not in names
+            assert "step_4" not in names
+            assert "step_1" in names
+            assert mgr2.latest_step() == 1
+            assert mgr2.metrics["gc_removed"] == 3
+
+    def test_stats_registered_in_profiler_export(self, tmp_path):
+        root = str(tmp_path / "root")
+        with CheckpointManager(root, interval=1, name="t_stats") as mgr:
+            mgr.save(0, _state(0))
+            mgr.wait()
+            snap = profiler.resilience_stats("t_stats")
+            assert snap["snapshots"] == 1 and snap["commits"] == 1
+            assert snap["last_committed_step"] == 0
+            assert snap["snapshot_s"]["count"] == 1
+            assert snap["commit_s"]["count"] == 1
+            assert "hang_count" in snap
+            assert "t_stats" in profiler.export_stats()["resilience"]
+            text = profiler.export_stats(format="text")
+            assert "paddle_tpu_resilience_t_stats_commits 1" in text
+        # close() unregisters
+        assert "t_stats" not in profiler.resilience_stats()
+
+
+class TestAsyncOffHotPath:
+    def test_save_blocks_only_for_snapshot(self, tmp_path, monkeypatch):
+        """With an injected slow disk, the caller-side maybe_save cost
+        must stay the snapshot (ONE batched device_get, zero fs waits)
+        while wait() absorbs the disk time on the write-behind thread —
+        and no device_get happens beyond the snapshot."""
+        import paddle_tpu.distributed.checkpoint.utils as cu
+        gets = []
+        real_get = cu.jax.device_get
+
+        def counting_get(x):
+            gets.append(1)
+            return real_get(x)
+
+        monkeypatch.setattr(cu.jax, "device_get", counting_get)
+        root = str(tmp_path / "root")
+        delay = 0.05
+        with fault_injection() as inj:
+            with CheckpointManager(root, interval=1) as mgr:
+                # enumerate this save's write count with a clean run
+                mgr.save(0, _state(0))
+                mgr.wait()
+                n_writes = inj.writes_seen
+                assert n_writes >= 10
+                inj.arm_slow_disk(delay)
+                n_before = len(gets)
+                t0 = time.perf_counter()
+                mgr.maybe_save(1, _state(1))
+                t_save = time.perf_counter() - t0
+                assert len(gets) - n_before == 1  # one batched snapshot
+                t1 = time.perf_counter()
+                mgr.wait()
+                t_wait = time.perf_counter() - t1
+                assert len(gets) - n_before == 1  # zero beyond snapshot
+                disk_s = n_writes * delay
+                assert t_save < disk_s / 2, \
+                    f"save blocked {t_save:.2f}s of {disk_s:.2f}s disk"
+                assert t_save + t_wait >= disk_s * 0.8
+                assert mgr.latest_step() == 1
+
+    def test_double_buffer_bounds_inflight_to_one(self, tmp_path):
+        """Back-to-back saves on a slow disk backpressure the cadence:
+        the second save() waits for the first write to land, so host RAM
+        never holds two pending snapshots."""
+        root = str(tmp_path / "root")
+        with fault_injection() as inj:
+            with CheckpointManager(root, interval=1) as mgr:
+                mgr.save(0, _state(0))
+                mgr.wait()
+                per_save = inj.writes_seen * 0.02
+                inj.arm_slow_disk(0.02)
+                t0 = time.perf_counter()
+                mgr.save(1, _state(1))   # returns fast (queue empty)
+                mgr.save(2, _state(2))   # must absorb save 1's disk time
+                elapsed = time.perf_counter() - t0
+                assert elapsed >= per_save * 0.8
+                mgr.wait()
+                assert mgr.latest_step() == 2
+
+    def test_background_error_surfaces_on_next_maybe_save(self, tmp_path):
+        """A write-behind failure (injected kill mid-npz) is raised on
+        the training thread by the NEXT maybe_save — and the torn
+        staging dir is never resumable; the manager recovers."""
+        root = str(tmp_path / "root")
+        with fault_injection() as inj:
+            with CheckpointManager(root, interval=10) as mgr:
+                mgr.save(0, _state(0))
+                mgr.wait()
+                inj.arm_kill_at_write(2)  # mid shard write of save 10
+                assert mgr.maybe_save(10, _state(10)) is True
+                err = None
+                for _ in range(400):  # background job finishes quickly
+                    try:
+                        mgr.maybe_save(11, _state(11))  # non-save: polls
+                    except CheckpointWriteError as e:
+                        err = e
+                        break
+                    time.sleep(0.005)
+                assert err is not None, "write error never surfaced"
+                assert isinstance(err.__cause__, BaseException)
+                assert mgr.metrics["write_errors"] == 1
+                inj.reset()
+                # the failed step is not resumable; the manager recovers
+                assert mgr.latest_step() == 0
+                mgr.save(12, _state(12), blocking=True)
+                assert mgr.latest_step() == 12
+                assert not os.path.isdir(os.path.join(root, "step_10.tmp"))
+
+    def test_async_kill_leaves_previous_committed(self, tmp_path):
+        """An async save torn by a kill at any point leaves the previous
+        committed checkpoint resolvable (the manager-level version of the
+        per-boundary sweep in test_dist_checkpoint.py)."""
+        root = str(tmp_path / "root")
+        with fault_injection() as inj:
+            with CheckpointManager(root, interval=1) as mgr:
+                mgr.save(3, _state(3))
+                mgr.wait()
+                inj.arm_kill_at_write(4)
+                mgr.save(4, _state(4))
+                with pytest.raises(CheckpointWriteError):
+                    mgr.wait()
+                inj.reset()
+                got = latest_checkpoint(root)
+                assert got is not None and got[0] == 3
+                assert validate_checkpoint_dir(got[1], expect_step=3)[0]

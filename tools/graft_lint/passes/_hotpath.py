@@ -33,6 +33,10 @@ HOT_MODULE_RES = (
     # the GradScaler runs once per optimizer step by design — its
     # scale/unscale/update path is as hot as the step function itself
     re.compile(r"(^|[\\/])paddle_tpu[\\/]amp[\\/]__init__\.py$"),
+    # resilience runs inside the training loop: maybe_save every step,
+    # the write-behind worker concurrently with it, the Fs boundary on
+    # every durable checkpoint byte
+    re.compile(r"(^|[\\/])paddle_tpu[\\/]distributed[\\/]resilience[\\/]"),
 )
 
 HOT_ROOT_NAMES = {"run_steps", "_run_loop", "_execute", "_produce",
@@ -41,7 +45,11 @@ HOT_ROOT_NAMES = {"run_steps", "_run_loop", "_execute", "_produce",
                   # the decode scheduler's per-token loop: every decode
                   # subsystem function reachable from it (admit, prefill,
                   # decode step, emit) is per-step hot
-                  "_step_loop"}
+                  "_step_loop",
+                  # resilience: the per-step save gate, the write-behind
+                  # worker loop, and the per-write fault/Fs boundary
+                  "maybe_save", "save", "_write_loop", "poll",
+                  "on_write"}
 
 # callables whose result is a jitted function / whose first unpacked
 # element is one — shared by device-placement and recompile-hazard so a
