@@ -678,9 +678,106 @@ def bench_continuous_batching(dev, on_tpu):
     return entry
 
 
+def bench_router_failover(dev, on_tpu):
+    """Multi-host serving router over 3 in-process DecodeServer
+    backends: routing overhead vs a direct single server on the same
+    mixed-length decode traffic, then the same traffic with one backend
+    KILLED mid-run (the loss-free failover path). Scored quantities:
+    ``routing_overhead`` (routed wall / direct wall on 1/3 of the
+    traffic each — overhead should be small), ``kill_slowdown`` (killed
+    wall / clean routed wall), and ``parity_ok`` (every phase's greedy
+    outputs bitwise-identical)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.resilience.faults import \
+        get_fault_injector
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import decode
+    from paddle_tpu.serving.router import InProcessBackend, Router
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny())
+    model.eval()
+    n_requests = 36 if on_tpu else 18
+    rng = np.random.RandomState(0)
+    reqs = [(rng.randint(0, 250, (int(rng.randint(4, 13)),)
+                         ).astype(np.int32), int(rng.randint(6, 13)))
+            for _ in range(n_requests)]
+    total_new = sum(g for _, g in reqs)
+
+    def srv(name):
+        return decode.DecodeServer(model, max_slots=8, page_len=8,
+                                   max_context=32, prefill_buckets=[16],
+                                   max_queue_size=n_requests + 8,
+                                   name=name)
+
+    def run_all(submit, kill_after_tokens=None, victim_of=None):
+        streams = [submit(p, g) for p, g in reqs]
+        if kill_after_tokens is not None:
+            while streams[0].token_count() < kill_after_tokens:
+                time.sleep(0.001)
+            get_fault_injector().arm_backend_kill(victim_of())
+        return [[int(t) for t in s.result(timeout=600)]
+                for s in streams]
+
+    entry = {"n_requests": n_requests, "total_new_tokens": total_new}
+
+    # -- direct single server (no router) --------------------------------
+    with srv("rb_direct") as d:
+        d.warmup()
+        t0 = time.perf_counter()
+        ref = run_all(lambda p, g: d.submit(p, max_new_tokens=g))
+        wall_direct = time.perf_counter() - t0
+    entry["direct"] = {"tokens_per_sec": round(total_new / wall_direct, 1),
+                       "wall_s": round(wall_direct, 3)}
+
+    # -- routed over 3 backends, clean then with a mid-run kill ----------
+    for phase, kill in (("routed", False), ("routed_killed", True)):
+        servers = [srv(f"rb_{phase}_{i}") for i in range(3)]
+        for s in servers:
+            s.warmup()
+        backends = [InProcessBackend(f"rb_{phase}_h{i}", decode_server=s)
+                    for i, s in enumerate(servers)]
+        compiles0 = sum(s.stats()["compile_count"] for s in servers)
+        with get_fault_injector().scoped():
+            with Router(backends, default_deadline_ms=600_000,
+                        num_workers=n_requests,
+                        probe_interval_ms=25) as router:
+                t0 = time.perf_counter()
+                outs = run_all(
+                    lambda p, g: router.submit_decode(
+                        p, max_new_tokens=g),
+                    kill_after_tokens=2 if kill else None,
+                    victim_of=lambda: list(
+                        router.sticky_assignment().values())[0])
+                wall = time.perf_counter() - t0
+                rst = router.stats()
+        compiles = sum(s.stats()["compile_count"]
+                       for s in servers) - compiles0
+        for s in servers:
+            s.close()
+        entry[phase] = {
+            "tokens_per_sec": round(total_new / wall, 1),
+            "wall_s": round(wall, 3),
+            "parity_ok": bool(outs == ref),
+            "failovers": rst["failovers"],
+            "decode_failovers": rst["decode_failovers"],
+            "tokens_resumed": rst["tokens_resumed"],
+            "retries": rst["retries"],
+            "compiles_during_run": compiles,
+            "latency_ms_p99": round(rst["latency_ms"]["p99"], 2)}
+
+    entry["routing_overhead"] = round(
+        entry["routed"]["wall_s"] / entry["direct"]["wall_s"], 3)
+    entry["kill_slowdown"] = round(
+        entry["routed_killed"]["wall_s"] / entry["routed"]["wall_s"], 3)
+    entry["parity_ok"] = bool(entry["routed"]["parity_ok"]
+                              and entry["routed_killed"]["parity_ok"])
+    return entry
+
+
 CONFIG_NAMES = ("llama_tp_chip", "llama_zero3_layout", "bert_1f1b",
                 "resnet50", "serving_throughput", "input_pipeline",
-                "continuous_batching")
+                "continuous_batching", "router_failover")
 
 
 def _run_config(name, dev, on_tpu):
@@ -693,6 +790,7 @@ def _run_config(name, dev, on_tpu):
         "input_pipeline": lambda: bench_input_pipeline(dev, on_tpu),
         "continuous_batching":
             lambda: bench_continuous_batching(dev, on_tpu),
+        "router_failover": lambda: bench_router_failover(dev, on_tpu),
     }
     return fns[name]()
 

@@ -36,6 +36,10 @@ def parse_args(argv=None):
     p.add_argument("--log_dir", default=None)
     p.add_argument("--max_restart", type=int, default=3,
                    help="fault-tolerance: restarts before giving up")
+    p.add_argument("--max_elastic_restart", type=int, default=10,
+                   help="elastic: restart-signal relaunches before "
+                        "giving up (budgeted separately from crash "
+                        "restarts)")
     p.add_argument("--rendezvous_timeout", type=float, default=300.0)
     p.add_argument("script", help="training script (.py) or executable")
     p.add_argument("script_args", nargs=argparse.REMAINDER)

@@ -42,6 +42,7 @@ class CollectiveController:
         self.pod = Pod()
         self.store: Optional[TCPStore] = None
         self._stop = False
+        self._seen_epoch = 0
 
     # -- rendezvous --------------------------------------------------------
     def build_job(self) -> Job:
@@ -115,6 +116,27 @@ class CollectiveController:
         except OSError:
             return False
 
+    # -- elastic restart signal --------------------------------------------
+    # The elastic layer (fleet/elastic/manager.py) signals a required
+    # re-rendezvous by bumping the job epoch key: the comm watchdog's
+    # notify_comm_hang and any ElasticManager.signal_restart() land
+    # there. Consuming it HERE closes the loop the resilience stack left
+    # to the caller's on_fault: the launcher itself tears the pod down
+    # and relaunches every process, no training-script cooperation
+    # needed.
+    def _elastic_epoch_key(self) -> str:
+        return f"__elastic/{self.args.job_id}/epoch"
+
+    def _elastic_epoch(self) -> int:
+        """Current job epoch (0 when single-node without a store, or
+        when the store is unreachable — pod status then governs alone)."""
+        if self.store is None:
+            return 0
+        try:
+            return self.store.add(self._elastic_epoch_key(), 0)
+        except Exception:
+            return 0
+
     # -- run & watch -------------------------------------------------------
     def run(self) -> int:
         self.build_job()
@@ -124,6 +146,8 @@ class CollectiveController:
             except ValueError:
                 pass  # not the main thread (tests)
         restarts = 0
+        elastic_restarts = 0
+        self._seen_epoch = self._elastic_epoch()
         while True:
             self.pod.deploy()
             status = self._watch()
@@ -131,19 +155,32 @@ class CollectiveController:
                 return 0
             if self._stop:
                 return 1
-            restarts += 1
-            if restarts > self.args.max_restart:
-                print(f"launch: pod failed and exceeded max_restart="
-                      f"{self.args.max_restart}, giving up")
-                self.pod.stop(force=True)
-                return 1
-            print(f"launch: pod failed, restart {restarts}/"
-                  f"{self.args.max_restart}")
+            if status == "elastic_restart":
+                # a deliberate re-rendezvous, not a crash: budgeted
+                # separately from failure restarts so a long elastic job
+                # is not starved of its crash budget
+                elastic_restarts += 1
+                if elastic_restarts > self.args.max_elastic_restart:
+                    print("launch: exceeded max_elastic_restart="
+                          f"{self.args.max_elastic_restart}, giving up")
+                    self.pod.stop(force=True)
+                    return 1
+                print(f"launch: elastic restart signal, relaunch "
+                      f"{elastic_restarts}/{self.args.max_elastic_restart}")
+            else:
+                restarts += 1
+                if restarts > self.args.max_restart:
+                    print(f"launch: pod failed and exceeded max_restart="
+                          f"{self.args.max_restart}, giving up")
+                    self.pod.stop(force=True)
+                    return 1
+                print(f"launch: pod failed, restart {restarts}/"
+                      f"{self.args.max_restart}")
             self.pod.stop(force=True)
             fresh = Pod()
             fresh.containers = [Container(c.entrypoint, c.env, c.log_path)
                                 for c in self.pod.containers]
-            fresh.restart_count = restarts
+            fresh.restart_count = restarts + elastic_restarts
             self.pod = fresh
 
     def _watch(self) -> str:
@@ -153,6 +190,10 @@ class CollectiveController:
                 if status == "failed":
                     self.pod.stop(force=True)
                 return status
+            epoch = self._elastic_epoch()
+            if epoch > self._seen_epoch:
+                self._seen_epoch = epoch
+                return "elastic_restart"
             time.sleep(0.2)
         self.pod.stop(force=True)
         return "stopped"

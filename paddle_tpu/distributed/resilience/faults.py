@@ -1,8 +1,9 @@
 """Deterministic fault-injection harness for preemption-tolerance tests.
 
-Three injector families, all armed on one process-global ``FaultInjector``
-(tests drive it via the ``fault_injection()`` context manager, which
-resets on exit so a failing test can't leak faults into the next):
+Four injector families, all armed on one process-global ``FaultInjector``
+(tests drive it via ``FaultInjector.scoped()`` — or the legacy
+``fault_injection()`` wrapper — which restores the prior state on exit so
+a failing test can't leak an armed fault into the next):
 
 - **kill-at-nth-write** — every durable checkpoint mutation funnels
   through the ``Fs`` layer below; the injector crashes the "process"
@@ -19,6 +20,15 @@ resets on exit so a failing test can't leak faults into the next):
   exit behind a stuck watchdog worker.
 - **heartbeat-drop** — the elastic ``_beat_loop`` skips lease renewals
   for armed node ids, so peers observe the node dead without killing it.
+- **backend faults** — the serving router's in-process backends consult
+  ``backend_action()`` on every operation (submit, probe, per-token
+  liveness check): ``arm_backend_kill`` makes a backend dead from now on
+  (every op fails, simulating host death mid-request), ``arm_backend_slow``
+  delays each op, ``arm_backend_hang`` blackholes it (ops block until the
+  caller's bounded timeout — the probe-timeout path), and
+  ``arm_backend_flap`` alternates dead/alive phases every ``period``
+  consultations. ``heal_backend`` clears one backend's faults so breaker
+  half-open recovery drills can bring it back.
 
 ``arm_slow_disk`` is the latency sibling of the kill injector: it delays
 every ``Fs`` write, which is how tests prove the write-behind thread —
@@ -133,9 +143,11 @@ class FaultInjector:
         self._hang_times = 0
         self._hang_seen = 0
         self._dropped_heartbeats: set = set()
+        self._backend_faults: dict = {}
         self.crashes = 0
         self.hangs_fired = 0
         self.heartbeats_dropped = 0
+        self.backend_ops_faulted = 0
 
     def reset(self) -> None:
         """Disarm everything and release any parked hang waiters."""
@@ -144,12 +156,46 @@ class FaultInjector:
             self._hang_release = threading.Event()
             self._reset_locked()
 
+    # every field reset()/scoped() must cover; a new fault kind that adds
+    # state registers it here so scopes can't leak it
+    _SCOPED_FIELDS = ("_kill_at", "_kill_partial", "_write_count",
+                      "_slow_disk_s", "_hang_match", "_hang_after",
+                      "_hang_times", "_hang_seen", "crashes", "hangs_fired",
+                      "heartbeats_dropped", "backend_ops_faulted")
+
+    @contextlib.contextmanager
+    def scoped(self):
+        """``with get_fault_injector().scoped() as inj: inj.arm_...()`` —
+        snapshots the injector on entry, enters the scope disarmed with
+        zeroed counters (so ``writes_seen`` and friends are deterministic
+        inside), and restores the snapshot on exit, releasing any hang
+        waiters parked inside the scope. A failing test can never leak an
+        armed fault into the next test, and nesting a scope inside an
+        armed outer scope hands the outer arming back intact on exit."""
+        with self._lock:
+            saved = {f: getattr(self, f) for f in self._SCOPED_FIELDS}
+            saved["_dropped_heartbeats"] = set(self._dropped_heartbeats)
+            saved["_backend_faults"] = {k: dict(v) for k, v in
+                                        self._backend_faults.items()}
+            self._hang_release.set()
+            self._hang_release = threading.Event()
+            self._reset_locked()
+        try:
+            yield self
+        finally:
+            with self._lock:
+                self._hang_release.set()
+                self._hang_release = threading.Event()
+                for f, v in saved.items():
+                    setattr(self, f, v)
+
     @property
     def armed(self) -> bool:
         with self._lock:
             return (self._kill_at is not None or self._slow_disk_s > 0.0
                     or self._hang_match is not None
-                    or bool(self._dropped_heartbeats))
+                    or bool(self._dropped_heartbeats)
+                    or bool(self._backend_faults))
 
     @property
     def writes_seen(self) -> int:
@@ -224,6 +270,75 @@ class FaultInjector:
             release = self._hang_release
         return lambda: release.wait(self._HANG_MAX_S)
 
+    # -- serving-router backend faults -------------------------------------
+    def arm_backend_kill(self, backend_id: str) -> None:
+        """The backend is dead from now on: every consulted operation
+        fails, including in-flight decode streams at their next liveness
+        check — host death mid-request."""
+        with self._lock:
+            self._backend_faults[str(backend_id)] = {"mode": "kill"}
+
+    def arm_backend_slow(self, backend_id: str, seconds: float) -> None:
+        """Delay every consulted operation by ``seconds`` (a slow but
+        live backend — degrades, never dies)."""
+        with self._lock:
+            self._backend_faults[str(backend_id)] = {
+                "mode": "slow", "seconds": float(seconds)}
+
+    def arm_backend_hang(self, backend_id: str) -> None:
+        """Blackhole the backend: consulted operations block until the
+        caller's own bounded timeout expires (probe timeout / request
+        deadline), exactly like a host that stops answering without
+        closing connections."""
+        with self._lock:
+            self._backend_faults[str(backend_id)] = {"mode": "hang"}
+
+    def arm_backend_flap(self, backend_id: str, period: int = 3) -> None:
+        """Alternate dead/alive phases every ``period`` consultations,
+        starting dead — the link-flap pattern that exercises breaker
+        reopen and retry-budget behavior."""
+        with self._lock:
+            self._backend_faults[str(backend_id)] = {
+                "mode": "flap", "period": max(1, int(period)), "count": 0}
+
+    def heal_backend(self, backend_id: str) -> None:
+        """Clear one backend's fault (and release its parked hang
+        waiters) — the recovery half of a breaker open→half-open→closed
+        drill."""
+        with self._lock:
+            self._backend_faults.pop(str(backend_id), None)
+            self._hang_release.set()
+            self._hang_release = threading.Event()
+
+    def backend_action(self, backend_id: str):
+        """What an armed fault does to this backend operation:
+        ``None`` (healthy), ``("kill",)`` (fail now), ``("slow", s)``
+        (delay then proceed), or ``("hang", waiter)`` where
+        ``waiter(timeout)`` parks the op and returns True iff the fault
+        was cleared (heal/reset) before the timeout."""
+        with self._lock:
+            st = self._backend_faults.get(str(backend_id))
+            if st is None:
+                return None
+            mode = st["mode"]
+            if mode == "flap":
+                n = st["count"]
+                st["count"] = n + 1
+                if (n // st["period"]) % 2 == 0:   # dead phase first
+                    self.backend_ops_faulted += 1
+                    return ("kill",)
+                return None
+            if mode == "kill":
+                self.backend_ops_faulted += 1
+                return ("kill",)
+            if mode == "slow":
+                return ("slow", st["seconds"])
+            self.backend_ops_faulted += 1
+            release = self._hang_release
+        return ("hang",
+                lambda timeout: release.wait(
+                    min(float(timeout), self._HANG_MAX_S)))
+
     # -- heartbeat-drop ----------------------------------------------------
     def arm_heartbeat_drop(self, node_id: str) -> None:
         """Suppress elastic lease renewals for ``node_id`` — peers see it
@@ -254,12 +369,8 @@ def get_fs() -> Fs:
 
 @contextlib.contextmanager
 def fault_injection():
-    """``with fault_injection() as inj: inj.arm_...()`` — resets (and
-    releases parked hang waiters) on exit, so a failing test cannot leak
-    an armed fault into the next."""
-    inj = get_fault_injector()
-    inj.reset()
-    try:
+    """Legacy wrapper over ``FaultInjector.scoped()``: a clean slate on
+    entry, prior state restored (parked hang waiters released) on exit.
+    New tests should use ``get_fault_injector().scoped()`` directly."""
+    with get_fault_injector().scoped() as inj:
         yield inj
-    finally:
-        inj.reset()

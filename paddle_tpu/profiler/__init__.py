@@ -29,7 +29,8 @@ __all__ = ["ProfilerState", "ProfilerTarget", "make_scheduler",
            "decode_stats", "register_decode_source",
            "unregister_decode_source", "resilience_stats",
            "register_resilience_source", "unregister_resilience_source",
-           "export_stats"]
+           "router_stats", "register_router_source",
+           "unregister_router_source", "export_stats"]
 
 
 class ProfilerState(Enum):
@@ -374,6 +375,7 @@ _serving_registry = _SourceRegistry("serving")
 _pipeline_registry = _SourceRegistry("pipeline")
 _decode_registry = _SourceRegistry("decode")
 _resilience_registry = _SourceRegistry("resilience")
+_router_registry = _SourceRegistry("router")
 
 
 def register_serving_source(name: str, metrics) -> None:
@@ -491,6 +493,28 @@ def resilience_stats(name: Optional[str] = None):
     return _resilience_registry.stats(name)
 
 
+def register_router_source(name: str, metrics) -> None:
+    """Register a serving-router metrics source (an object with
+    .snapshot()). Called by serving.router.Router on construction."""
+    _router_registry.register(name, metrics)
+
+
+def unregister_router_source(name: str, metrics=None) -> None:
+    """Remove a router source (only if it still points at ``metrics``,
+    when given)."""
+    _router_registry.unregister(name, metrics)
+
+
+def router_stats(name: Optional[str] = None):
+    """Snapshot of serving-router metrics: per-backend health/breaker
+    state and breaker transitions, retry/failover/shed/hedge counts,
+    latency and attempt histograms — per registered Router.
+
+    Returns ``{router_name: snapshot_dict}``, or one snapshot when
+    ``name`` is given (KeyError when that router is gone)."""
+    return _router_registry.stats(name)
+
+
 def _flatten_scrape(prefix: str, value, out: list) -> None:
     """dict/number tree -> ``name value`` exposition lines (labels are
     flattened into the metric name; non-numeric leaves are dropped —
@@ -520,7 +544,8 @@ def export_stats(format: str = "dict"):
     numeric leaf, names prefixed ``paddle_tpu_<registry>_<source>_``).
     """
     data = {"pipeline": pipeline_stats(), "serving": serving_stats(),
-            "decode": decode_stats(), "resilience": resilience_stats()}
+            "decode": decode_stats(), "resilience": resilience_stats(),
+            "router": router_stats()}
     if format == "dict":
         return data
     if format == "json":

@@ -22,12 +22,14 @@ Quick start::
 See also ``inference.Config.enable_serving()`` for the predictor-side
 entry point.
 """
-from . import decode  # noqa: F401
+from . import decode, router  # noqa: F401
 from .batcher import Future, Request, RequestQueue  # noqa: F401
 from .bucketing import (BucketOverflow, next_bucket,  # noqa: F401
                         next_bucket_strict, page_buckets, pow2_buckets)
 from .decode import DecodeServer, DecodeStream  # noqa: F401
 from .metrics import Histogram, ServingMetrics  # noqa: F401
+from .router import (BackendUnavailable, InProcessBackend,  # noqa: F401
+                     Router, RouterOverloaded)
 from .server import (DeadlineExceeded, Server, ServerClosed,  # noqa: F401
                      ServerOverloaded, ServingError)
 
@@ -35,4 +37,5 @@ __all__ = ["Server", "ServingError", "ServerOverloaded", "DeadlineExceeded",
            "ServerClosed", "Future", "ServingMetrics", "Histogram",
            "pow2_buckets", "page_buckets", "next_bucket",
            "next_bucket_strict", "BucketOverflow", "decode",
-           "DecodeServer", "DecodeStream"]
+           "DecodeServer", "DecodeStream", "router", "Router",
+           "InProcessBackend", "RouterOverloaded", "BackendUnavailable"]

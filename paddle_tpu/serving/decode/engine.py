@@ -384,6 +384,22 @@ class DecodeServer(ServerLifecycleMixin):
     def queue_depth(self) -> int:
         return self._queue.qsize()
 
+    def active_slots(self) -> int:
+        """Running sequences right now (a cross-thread occupancy sample;
+        the serving router reads it for weighted-least-loaded placement)."""
+        return self._sched.active_count()
+
+    def bucket_config(self) -> dict:
+        """The (batch, prefill, page) bucket sets this server compiled
+        its step executables for. The serving router requires identical
+        configs across its backends so a failed-over stream resumes on a
+        warm executable."""
+        return {"batch_buckets": list(self._batch_buckets),
+                "prefill_buckets": list(self._prefill_buckets),
+                "page_buckets": list(self._page_buckets),
+                "page_len": self.page_len,
+                "max_context": self.max_context}
+
     def num_executables(self) -> int:
         return len(self._exec.signatures())
 

@@ -300,6 +300,12 @@ class Scheduler:
     def active(self) -> List[Slot]:
         return [s for s in self.slots if s is not None]
 
+    def active_count(self) -> int:
+        """Occupancy sample safe to read from OUTSIDE the worker thread:
+        one pass over the fixed-size slot list (entries flip atomically
+        between None and a Slot), no shared mutable state touched."""
+        return sum(1 for s in self.slots if s is not None)
+
     def _free_index(self) -> Optional[int]:
         for i, s in enumerate(self.slots):
             if s is None:

@@ -1,0 +1,43 @@
+"""paddle_tpu.serving.router — fault-tolerant multi-host serving router.
+
+A front-end that fans one-shot requests (``serving.Server`` semantics)
+and decode token streams (``serving.decode.DecodeServer`` semantics)
+over N backends behind a transport-agnostic ``Backend`` protocol.
+Per-backend health (active probes + passive accounting), circuit
+breakers with half-open recovery, deadline-aware budgeted retries,
+sticky-by-bucket routing with weighted-least-loaded failover, load
+shedding, and **loss-free decode failover** (a stream resumed on
+another backend is bit-identical — already-emitted tokens fold into the
+effective prompt).
+
+Quick start::
+
+    from paddle_tpu.serving import decode
+    from paddle_tpu.serving.router import InProcessBackend, Router
+
+    servers = [decode.DecodeServer(model, ...) for _ in range(3)]
+    backends = [InProcessBackend(f"host{i}", decode_server=s)
+                for i, s in enumerate(servers)]
+    with Router(backends, default_deadline_ms=30_000) as router:
+        stream = router.submit_decode(prompt, max_new_tokens=32)
+        for tok in stream:
+            ...
+
+Metrics: ``paddle_tpu.profiler.router_stats()`` (and the combined
+``profiler.export_stats()`` scrape). Fault drills: the
+``distributed.resilience.faults`` backend-fault injectors
+(kill / slow / hang / flap).
+"""
+from .backend import Backend, InProcessBackend  # noqa: F401
+from .breaker import BreakerState, CircuitBreaker  # noqa: F401
+from .errors import (BackendDied, BackendUnavailable,  # noqa: F401
+                     RouterError, RouterOverloaded)
+from .health import BackendHealth, HealthState  # noqa: F401
+from .metrics import RouterMetrics  # noqa: F401
+from .retry import RetryPolicy  # noqa: F401
+from .router import Router  # noqa: F401
+
+__all__ = ["Router", "Backend", "InProcessBackend", "RouterError",
+           "RouterOverloaded", "BackendUnavailable", "BackendDied",
+           "CircuitBreaker", "BreakerState", "BackendHealth",
+           "HealthState", "RetryPolicy", "RouterMetrics"]

@@ -1,0 +1,260 @@
+"""Transport-agnostic ``Backend`` protocol + the in-process transport.
+
+The router never talks to a ``Server``/``DecodeServer`` directly; it
+talks to a ``Backend``, whose contract is exactly what a remote
+transport can also satisfy (submit returns a future-shaped handle,
+decode returns a token stream, liveness is an explicit ``check_alive``
+that RAISES when the host is gone rather than a flag that can go stale).
+``InProcessBackend`` is the first transport: it fronts servers living in
+this process, and consults the resilience fault injector
+(``distributed.resilience.faults``) on every operation so the PR 9
+harness can kill, slow, blackhole, or flap a "host" deterministically —
+which is how the router's failover machinery is proven without a real
+multi-host deployment. A gRPC/HTTP transport plugs in later by
+implementing the same five methods.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+from ..batcher import DeadlineExceeded
+from .errors import BackendDied
+
+__all__ = ["Backend", "InProcessBackend"]
+
+
+def _injector():
+    """The process-global fault injector, or None when the resilience
+    harness is unavailable (minimal builds) — lazy so serving does not
+    import the distributed stack at module load."""
+    try:
+        from ...distributed.resilience.faults import get_fault_injector
+    except Exception:  # pragma: no cover - harness always present here
+        return None
+    return get_fault_injector()
+
+
+class Backend:
+    """What the router requires of one serving host.
+
+    Implementations must be thread-safe: the router's dispatch workers
+    and health loop call in concurrently. Every method either answers or
+    raises — a dead host surfaces as ``BackendDied`` (never a hang; the
+    transport owns bounding its own waits).
+    """
+
+    backend_id: str
+
+    def bucket_config(self) -> dict:
+        """The shape-bucket configuration this host compiled its
+        executables for, keyed by capability (``"oneshot"`` and/or
+        ``"decode"``). The router requires every backend to share one
+        config — that is what makes failover land on a warm executable
+        instead of a cold compile."""
+        raise NotImplementedError
+
+    def submit(self, args: Sequence, deadline_ms: Optional[float] = None):
+        """Enqueue one one-shot request; returns a Future-shaped handle
+        (``result(timeout)`` / ``done()``)."""
+        raise NotImplementedError
+
+    def submit_decode(self, prompt, *, max_new_tokens: int,
+                      eos_id: Optional[int] = None):
+        """Enqueue one generation request; returns a DecodeStream."""
+        raise NotImplementedError
+
+    def check_alive(self) -> None:
+        """Raise ``BackendDied`` if the host is gone or not answering
+        *right now* (no waiting — the router's relay loop calls this
+        between tokens)."""
+        raise NotImplementedError
+
+    def probe(self, timeout: float) -> float:
+        """Active health probe: round-trip a trivial host operation and
+        return its latency in seconds; raise ``BackendDied`` when the
+        host is dead or does not answer within ``timeout``."""
+        raise NotImplementedError
+
+    def load(self) -> float:
+        """Current load score (queued + running work) for
+        weighted-least-loaded placement. Best-effort; must not block."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        """Release the transport (and the host, when owned)."""
+        raise NotImplementedError
+
+
+class _GuardedFuture:
+    """A backend future whose ``result`` re-checks host liveness before
+    handing the payload over: a response computed by a host that died
+    meanwhile must not reach the client (on a real network it never
+    would), so the router retries instead of returning it.
+
+    An injected slow fault is modeled as a slow ANSWER, not a slow
+    enqueue: the response "arrives" ``delay`` after submit, and a
+    ``result(timeout)`` that ends before the arrival times out exactly
+    like a real laggy host — which is what lets the router's hedging
+    observe the slowness."""
+
+    __slots__ = ("_fut", "_backend", "_arrival")
+
+    def __init__(self, fut, backend: "InProcessBackend",
+                 delay_s: Optional[float] = None):
+        self._fut = fut
+        self._backend = backend
+        self._arrival = (None if delay_s is None
+                         else time.monotonic() + delay_s)
+
+    def _wait_arrival(self, timeout: Optional[float]) -> Optional[float]:
+        """Block until the injected arrival time; returns the remaining
+        timeout (or raises DeadlineExceeded if it ends first)."""
+        if self._arrival is None:
+            return timeout
+        pending = self._arrival - time.monotonic()
+        if pending <= 0:
+            return timeout
+        if timeout is not None and timeout < pending:
+            time.sleep(timeout)
+            raise DeadlineExceeded(
+                f"no result within {timeout}s (backend slow)")
+        time.sleep(pending)
+        return None if timeout is None else max(0.0, timeout - pending)
+
+    def result(self, timeout: Optional[float] = None):
+        timeout = self._wait_arrival(timeout)
+        res = self._fut.result(timeout)
+        self._backend.check_alive()
+        return res
+
+    def done(self) -> bool:
+        if self._arrival is not None \
+                and time.monotonic() < self._arrival:
+            return False
+        return self._fut.done()
+
+    def exception(self, timeout: Optional[float] = None):
+        timeout = self._wait_arrival(timeout)
+        return self._fut.exception(timeout)
+
+
+class InProcessBackend(Backend):
+    """One in-process serving host: a ``serving.Server`` (one-shots),
+    a ``serving.decode.DecodeServer`` (token streams), or both.
+
+    Fault-injection contract: every operation consults the global
+    ``FaultInjector``'s backend faults under this backend's id —
+    an armed kill fails the op with ``BackendDied``, a slow fault delays
+    it, a hang parks it until the caller's bounded timeout (probe
+    timeout / ``op_timeout_s``) and then fails it, and a flap alternates
+    dead/alive phases. Disarmed cost is one ``armed`` flag check.
+    """
+
+    def __init__(self, backend_id: str, *, server=None, decode_server=None,
+                 op_timeout_s: float = 0.25, owns_servers: bool = False):
+        if server is None and decode_server is None:
+            raise ValueError(
+                "InProcessBackend needs a server and/or a decode_server")
+        self.backend_id = str(backend_id)
+        self._server = server
+        self._decode = decode_server
+        self._op_timeout_s = float(op_timeout_s)
+        self._owns = bool(owns_servers)
+
+    # -- fault-injection consultation --------------------------------------
+    def _consult(self, timeout: float,
+                 defer_slow: bool = False) -> Optional[float]:
+        """Apply an armed fault to this operation. Returns None, or —
+        with ``defer_slow`` — the slow-fault delay the caller should
+        model as response latency instead of sleeping here."""
+        inj = _injector()
+        if inj is None or not inj.armed:
+            return None
+        while True:
+            act = inj.backend_action(self.backend_id)
+            if act is None:
+                return None
+            if act[0] == "slow":
+                if defer_slow:
+                    return act[1]
+                time.sleep(act[1])
+                return None
+            if act[0] == "kill":
+                raise BackendDied(
+                    f"backend {self.backend_id!r} is dead (injected kill)")
+            # hang: park bounded by the caller's timeout; a release means
+            # the fault was cleared mid-wait (heal/reset) — re-consult
+            if timeout <= 0 or not act[1](timeout):
+                raise BackendDied(
+                    f"backend {self.backend_id!r} blackholed "
+                    f"(no response within {max(timeout, 0.0):.3f}s)")
+
+    # -- Backend protocol --------------------------------------------------
+    def bucket_config(self) -> dict:
+        cfg = {}
+        if self._server is not None:
+            cfg["oneshot"] = self._server.bucket_config()
+        if self._decode is not None:
+            cfg["decode"] = self._decode.bucket_config()
+        return cfg
+
+    def submit(self, args: Sequence, deadline_ms: Optional[float] = None):
+        if self._server is None:
+            raise TypeError(
+                f"backend {self.backend_id!r} has no one-shot server")
+        delay = self._consult(self._op_timeout_s, defer_slow=True)
+        fut = self._server.submit(*args, deadline_ms=deadline_ms)
+        return _GuardedFuture(fut, self, delay)
+
+    def submit_decode(self, prompt, *, max_new_tokens: int,
+                      eos_id: Optional[int] = None):
+        if self._decode is None:
+            raise TypeError(
+                f"backend {self.backend_id!r} has no decode server")
+        self._consult(self._op_timeout_s)
+        # no per-request deadline at the host: the router owns deadline
+        # enforcement (it must keep doing so across failovers; a host-side
+        # expiry would settle the stream the router still wants to resume)
+        return self._decode.submit(prompt, max_new_tokens=max_new_tokens,
+                                   eos_id=eos_id, deadline_ms=None)
+
+    def check_alive(self) -> None:
+        self._consult(0.0)
+        for host in (self._server, self._decode):
+            if host is not None and host._is_closed():
+                raise BackendDied(
+                    f"backend {self.backend_id!r} server is closed")
+
+    def probe(self, timeout: float) -> float:
+        t0 = time.monotonic()
+        self._consult(timeout)
+        # trivial host round-trips: queue depths answer iff the worker
+        # machinery is alive; a closed server is a dead host
+        for host in (self._server, self._decode):
+            if host is not None:
+                if host._is_closed():
+                    raise BackendDied(
+                        f"backend {self.backend_id!r} server is closed")
+                host.queue_depth()
+        return time.monotonic() - t0
+
+    def load(self) -> float:
+        n = 0.0
+        if self._server is not None:
+            n += self._server.queue_depth()
+        if self._decode is not None:
+            n += self._decode.queue_depth() + self._decode.active_slots()
+        return n
+
+    def close(self) -> None:
+        if not self._owns:
+            return
+        for host in (self._server, self._decode):
+            if host is not None and not host._is_closed():
+                host.shutdown(drain=False)
+
+    def __repr__(self) -> str:
+        kinds = [k for k, v in (("oneshot", self._server),
+                                ("decode", self._decode)) if v is not None]
+        return f"InProcessBackend({self.backend_id!r}, {'+'.join(kinds)})"

@@ -1,0 +1,140 @@
+"""Per-backend circuit breaker.
+
+Classic three-state machine, one per backend:
+
+::
+
+            failure x threshold                reset timeout elapses
+    CLOSED ---------------------> OPEN --------------------------------+
+      ^                            ^                                   |
+      |  trial success             |  trial failure                    v
+      +------------- HALF_OPEN <---+----------------------------- (allow()
+                        |                                          admits ONE
+                        +---- exactly one in-flight trial ----+    trial)
+
+While OPEN, ``allow()`` answers False — the router stops sending the
+backend ANY traffic (requests or probes), so a dead host costs nothing
+per request. After ``reset_timeout_s`` the next ``allow()`` admits
+exactly one trial (whichever caller gets there first: a health probe or
+a live request) and the breaker sits in HALF_OPEN until that trial
+reports. Success closes the breaker; failure re-opens it and restarts
+the timeout. Every transition is timestamped into a bounded log and
+mirrored to an optional callback (the router counts them into
+``router_stats()``).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+__all__ = ["BreakerState", "CircuitBreaker"]
+
+
+class BreakerState:
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """Thread-safe circuit breaker (see module docstring).
+
+    Parameters
+    ----------
+    failure_threshold: consecutive failures that open a CLOSED breaker.
+    reset_timeout_s: OPEN dwell time before one half-open trial is
+        admitted.
+    on_transition: optional ``fn(old_state, new_state)`` called OUTSIDE
+        the breaker lock on every state change.
+    """
+
+    def __init__(self, *, failure_threshold: int = 3,
+                 reset_timeout_s: float = 1.0,
+                 on_transition: Optional[Callable[[str, str], None]] = None,
+                 max_log: int = 64):
+        if failure_threshold < 1:
+            raise ValueError(
+                f"failure_threshold must be >= 1, got {failure_threshold}")
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = BreakerState.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._trial_started = 0.0
+        self._transitions: deque = deque(maxlen=max_log)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def transitions(self) -> list:
+        """Bounded history of ``(monotonic_t, old, new)`` transitions."""
+        with self._lock:
+            return list(self._transitions)
+
+    # -- decisions ---------------------------------------------------------
+    def allow(self) -> bool:
+        """May the caller send this backend one request/probe right now?
+        CLOSED: always. OPEN: no, until ``reset_timeout_s`` has elapsed —
+        then the breaker moves to HALF_OPEN and this call admits the ONE
+        trial. HALF_OPEN: no (a trial is already in flight)."""
+        fire = None
+        with self._lock:
+            now = time.monotonic()
+            if self._state == BreakerState.CLOSED:
+                return True
+            if self._state == BreakerState.HALF_OPEN:
+                # one trial at a time — but a trial whose caller vanished
+                # (worker died mid-request) must not wedge the breaker in
+                # HALF_OPEN forever: after a dwell, admit a fresh trial
+                if now - self._trial_started < self.reset_timeout_s:
+                    return False
+                self._trial_started = now
+                return True
+            if now - self._opened_at < self.reset_timeout_s:
+                return False
+            fire = (self._state, BreakerState.HALF_OPEN)
+            self._state = BreakerState.HALF_OPEN
+            self._trial_started = now
+            self._transitions.append((now,) + fire)
+        self._fire(fire)
+        return True
+
+    def record_success(self) -> None:
+        fire = None
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state != BreakerState.CLOSED:
+                fire = (self._state, BreakerState.CLOSED)
+                self._state = BreakerState.CLOSED
+                self._transitions.append((time.monotonic(),) + fire)
+        self._fire(fire)
+
+    def record_failure(self) -> None:
+        fire = None
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._state == BreakerState.HALF_OPEN:
+                # the trial failed: back to OPEN, restart the dwell
+                fire = (self._state, BreakerState.OPEN)
+            elif (self._state == BreakerState.CLOSED
+                  and self._consecutive_failures >= self.failure_threshold):
+                fire = (self._state, BreakerState.OPEN)
+            if fire is not None:
+                self._state = BreakerState.OPEN
+                self._opened_at = time.monotonic()
+                self._transitions.append((time.monotonic(),) + fire)
+        self._fire(fire)
+
+    def _fire(self, fire) -> None:
+        if fire is not None and self._on_transition is not None:
+            try:
+                self._on_transition(*fire)
+            except Exception:   # a metrics hiccup must not poison routing
+                pass

@@ -1,0 +1,42 @@
+"""Typed error surface of the serving router.
+
+Every router failure a client can observe is one of these (all rooted at
+``serving.ServingError`` so existing catch-sites keep working):
+
+- ``RouterOverloaded`` — load shedding: the router's own admission queue
+  is full, or every backend is saturated and the deadline/retry budget
+  ran out before one freed up. Back off and retry.
+- ``BackendUnavailable`` — no backend could serve the request: all DOWN
+  or breaker-open, or the retry budget/deadline was exhausted on
+  failures. The message carries the last underlying error.
+- ``BackendDied`` — internal signal between a transport and the router's
+  dispatch loop: the backend stopped answering mid-operation (killed,
+  blackholed, or its server closed). The router retries/fails over on
+  it; it only escapes to clients wrapped in ``BackendUnavailable``.
+"""
+from __future__ import annotations
+
+from ..batcher import ServerOverloaded, ServingError
+
+__all__ = ["RouterError", "RouterOverloaded", "BackendUnavailable",
+           "BackendDied"]
+
+
+class RouterError(ServingError):
+    """Base class for router-path failures."""
+
+
+class RouterOverloaded(RouterError, ServerOverloaded):
+    """The router (or every backend behind it) is saturated; the request
+    was shed rather than queued unboundedly. Subclasses
+    ``ServerOverloaded`` so callers' existing backoff handling applies."""
+
+
+class BackendUnavailable(RouterError):
+    """No healthy backend could complete the request within its deadline
+    and the retry budget."""
+
+
+class BackendDied(RouterError):
+    """A backend stopped answering mid-operation (transport-level death
+    signal; retried/failed-over by the router, not client-facing)."""

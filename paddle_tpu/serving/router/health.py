@@ -1,0 +1,149 @@
+"""Per-backend health accounting: active probes + passive outcomes.
+
+Two signal streams feed one state per backend:
+
+- **active**: the router's health loop round-trips a trivial probe every
+  ``probe_interval_ms``; ``down_after`` consecutive probe failures mark
+  the backend DOWN, one success marks it reachable again.
+- **passive**: every routed request reports its outcome. Two signal
+  classes are kept apart: a transport DEATH (``record_death`` — the
+  host stopped answering) is a *reachability* signal that counts
+  toward DOWN exactly like a probe failure, while an ordinary failure
+  (``record_request(False)``) is a *quality* signal feeding a windowed
+  error rate. Error rate over ``degrade_error_rate`` — or windowed
+  mean latency over ``degrade_latency_ms`` — marks a reachable backend
+  DEGRADED, which the placement policy de-weights but does not exclude
+  (graceful degradation: slow capacity is still capacity).
+
+DOWN is decided by reachability only (probe failures or consecutive
+transport deaths): quality failures alone cannot take a backend out of
+rotation (one poisoned request class must not evict a host the prober
+can still reach) — the per-backend circuit breaker is the fast-path
+guard against those. On recovery (a probe success after DOWN) the
+passive window is cleared: it was recorded against the host's previous
+life and must not pin the revived host DEGRADED until traffic happens
+to wash it out.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+__all__ = ["HealthState", "BackendHealth"]
+
+
+class HealthState:
+    HEALTHY = "healthy"
+    DEGRADED = "degraded"
+    DOWN = "down"
+
+
+class BackendHealth:
+    """Thread-safe health state for one backend (see module docstring).
+
+    ``record_probe``/``record_request`` return ``(old_state, new_state)``
+    so the caller can count transitions into its metrics."""
+
+    def __init__(self, *, window: int = 32, min_samples: int = 4,
+                 down_after: int = 2, degrade_error_rate: float = 0.5,
+                 degrade_latency_ms: Optional[float] = None):
+        if down_after < 1:
+            raise ValueError(f"down_after must be >= 1, got {down_after}")
+        self._lock = threading.Lock()
+        self._outcomes: deque = deque(maxlen=window)   # (ok, latency_ms)
+        self._min_samples = int(min_samples)
+        self._down_after = int(down_after)
+        self._degrade_error_rate = float(degrade_error_rate)
+        self._degrade_latency_ms = degrade_latency_ms
+        self._consec_probe_failures = 0
+        self._consec_deaths = 0
+        self._probe_ok = True        # until proven otherwise
+        self._last_probe_ms = 0.0
+        self._state = HealthState.HEALTHY
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            n = len(self._outcomes)
+            errs = sum(1 for ok, _ in self._outcomes if not ok)
+            lats = [l for ok, l in self._outcomes
+                    if ok and l is not None]
+            return {"state": self._state,
+                    "consecutive_probe_failures":
+                        self._consec_probe_failures,
+                    "consecutive_deaths": self._consec_deaths,
+                    "last_probe_ms": round(self._last_probe_ms, 3),
+                    "window_requests": n,
+                    "window_error_rate": (errs / n) if n else 0.0,
+                    "window_latency_ms_mean":
+                        (sum(lats) / len(lats)) if lats else 0.0}
+
+    # -- signals -----------------------------------------------------------
+    def record_probe(self, ok: bool, latency_ms: float = 0.0):
+        with self._lock:
+            old = self._state
+            if ok:
+                if not self._probe_ok:
+                    # recovery from DOWN: the passive window was
+                    # recorded against the host's previous life (every
+                    # request failed while it was dead) — judging the
+                    # revived host by it would pin DEGRADED until new
+                    # traffic happens to wash it out
+                    self._outcomes.clear()
+                self._consec_probe_failures = 0
+                self._consec_deaths = 0
+                self._probe_ok = True
+                self._last_probe_ms = float(latency_ms)
+            else:
+                self._consec_probe_failures += 1
+                if self._consec_probe_failures >= self._down_after:
+                    self._probe_ok = False
+            self._recompute_locked()
+            return old, self._state
+
+    def record_death(self):
+        """Transport-level death (the host stopped answering a request
+        mid-flight): a reachability signal — ``down_after`` consecutive
+        deaths mark the backend DOWN without waiting for the prober to
+        notice. Deaths never enter the quality window."""
+        with self._lock:
+            old = self._state
+            self._consec_deaths += 1
+            if self._consec_deaths >= self._down_after:
+                self._probe_ok = False
+            self._recompute_locked()
+            return old, self._state
+
+    def record_request(self, ok: bool,
+                       latency_ms: Optional[float] = None):
+        with self._lock:
+            old = self._state
+            if ok:
+                self._consec_deaths = 0
+            self._outcomes.append((bool(ok), latency_ms))
+            self._recompute_locked()
+            return old, self._state
+
+    def _recompute_locked(self) -> None:
+        if not self._probe_ok:
+            self._state = HealthState.DOWN
+            return
+        n = len(self._outcomes)
+        if n >= self._min_samples:
+            errs = sum(1 for ok, _ in self._outcomes if not ok)
+            if errs / n >= self._degrade_error_rate:
+                self._state = HealthState.DEGRADED
+                return
+            if self._degrade_latency_ms is not None:
+                lats = [l for ok, l in self._outcomes
+                        if ok and l is not None]
+                if lats and (sum(lats) / len(lats)
+                             > self._degrade_latency_ms):
+                    self._state = HealthState.DEGRADED
+                    return
+        self._state = HealthState.HEALTHY

@@ -1,0 +1,61 @@
+"""Router observability, surfaced via ``profiler.router_stats()`` and
+the combined ``profiler.export_stats()`` scrape."""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ...profiler.metrics import MetricsBase
+
+__all__ = ["RouterMetrics"]
+
+
+class RouterMetrics(MetricsBase):
+    """Thread-safe counters/histograms for one Router.
+
+    Counters: submitted, completed, failed, expired, rejected_overload
+    (router queue full), shed (all backends saturated within deadline),
+    retries, retry_budget_exhausted, backend_overloads (per-backend
+    ServerOverloaded absorbed), failovers (request moved off a failed
+    backend), decode_failovers (mid-stream failovers), tokens_resumed
+    (tokens folded into a failover re-prompt), sticky_moves (sticky key
+    reassigned), hedges / hedge_wins, probes / probe_failures,
+    breaker_open / breaker_half_open / breaker_close (transition
+    counts).
+    Histograms: latency_ms (submit -> settle), queue_wait_ms,
+    attempts (tries per completed request), backoff_ms.
+    Gauge: queue_depth.
+    Snapshot extra: ``backends`` — per-backend health/breaker/load,
+    pulled live from the router at snapshot time.
+    """
+
+    COUNTERS = ("submitted", "completed", "failed", "expired",
+                "rejected_overload", "shed", "retries",
+                "retry_budget_exhausted", "backend_overloads",
+                "failovers", "decode_failovers", "tokens_resumed",
+                "sticky_moves", "hedges", "hedge_wins", "probes",
+                "probe_failures", "breaker_open", "breaker_half_open",
+                "breaker_close")
+    HISTS = ("latency_ms", "queue_wait_ms", "attempts", "backoff_ms")
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self._backends_fn: Optional[Callable[[], dict]] = None
+
+    def set_backends_fn(self, fn: Callable[[], dict]) -> None:
+        """Pull-type per-backend state provider (health/breaker/load),
+        read at snapshot time so the registry never pins the router."""
+        self._backends_fn = fn
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self._counters)
+            out["name"] = self.name
+            for k, h in self._hists.items():
+                out[k] = h.snapshot()
+        out["queue_depth"] = self._read_gauge()
+        if self._backends_fn is not None:
+            try:
+                out["backends"] = self._backends_fn()
+            except Exception:
+                out["backends"] = {}
+        return out

@@ -1,0 +1,102 @@
+"""Deadline-aware retry policy: exponential backoff + jitter + budget.
+
+Two safety properties every retry must satisfy, both enforced here and
+at the dispatch site:
+
+1. **Never retry past the deadline.** A retry whose backoff sleep would
+   land beyond the request's absolute deadline is not attempted — the
+   request fails NOW with the typed error, handing the client its
+   remaining deadline back instead of burning it inside the router.
+2. **Retries are globally budgeted.** A token bucket (gRPC-style)
+   accrues ``budget_ratio`` tokens per admitted request up to
+   ``budget_cap`` and spends one per retry: when a backend outage makes
+   every request fail, retries self-limit to a bounded multiple of the
+   incoming rate instead of amplifying the overload 3x.
+
+Backoff is ``base * 2^attempt`` capped at ``max_backoff_ms``, with
+symmetric ±``jitter`` randomization from a seeded PRNG (deterministic
+across runs for the fault drills, decorrelated across attempts).
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Optional
+
+__all__ = ["RetryPolicy"]
+
+
+class RetryPolicy:
+    """Thread-safe retry budget + backoff schedule (see module doc).
+
+    Parameters
+    ----------
+    max_attempts: total tries per request (1 = never retry).
+    base_backoff_ms / max_backoff_ms: exponential schedule bounds.
+    jitter: fractional ± randomization of each backoff (0 disables).
+    budget_ratio: retry tokens accrued per admitted request.
+    budget_cap: token bucket capacity (also the starting balance, so a
+        cold router can absorb an immediate fault burst).
+    seed: PRNG seed for the jitter (deterministic drills).
+    """
+
+    def __init__(self, *, max_attempts: int = 4,
+                 base_backoff_ms: float = 5.0,
+                 max_backoff_ms: float = 200.0, jitter: float = 0.5,
+                 budget_ratio: float = 0.2, budget_cap: float = 32.0,
+                 seed: int = 0):
+        if max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {max_attempts}")
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError(f"jitter must be in [0, 1], got {jitter}")
+        self.max_attempts = int(max_attempts)
+        self.base_backoff_s = float(base_backoff_ms) / 1e3
+        self.max_backoff_s = float(max_backoff_ms) / 1e3
+        self.jitter = float(jitter)
+        self.budget_ratio = float(budget_ratio)
+        self.budget_cap = float(budget_cap)
+        self._tokens = float(budget_cap)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    # -- budget ------------------------------------------------------------
+    def on_request(self) -> None:
+        """Accrue budget for one admitted request."""
+        with self._lock:
+            self._tokens = min(self.budget_cap,
+                               self._tokens + self.budget_ratio)
+
+    def try_acquire(self) -> bool:
+        """Spend one retry token; False when the budget is exhausted."""
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    # -- schedule ----------------------------------------------------------
+    def backoff_s(self, attempt: int) -> float:
+        """Sleep before retry number ``attempt`` (1-based): exponential,
+        capped, ±jitter."""
+        d = min(self.max_backoff_s,
+                self.base_backoff_s * (2.0 ** max(0, attempt - 1)))
+        if self.jitter > 0.0:
+            with self._lock:
+                r = self._rng.random()
+            d *= 1.0 + self.jitter * (2.0 * r - 1.0)
+        return max(0.0, d)
+
+    def allows_attempt(self, attempt: int) -> bool:
+        """True while ``attempt`` (1-based) is within ``max_attempts``."""
+        return attempt <= self.max_attempts
+
+    def fits_deadline(self, delay_s: float,
+                      remaining_s: Optional[float]) -> bool:
+        """Would sleeping ``delay_s`` still leave deadline to execute?
+        (None = no deadline = always fits.)"""
+        return remaining_s is None or delay_s < remaining_s

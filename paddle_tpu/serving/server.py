@@ -361,6 +361,17 @@ class Server(ServerLifecycleMixin):
     def queue_depth(self) -> int:
         return self._queue.qsize()
 
+    def bucket_config(self) -> dict:
+        """The shape-bucket configuration requests execute under. The
+        serving router requires identical configs across its backends —
+        that is what makes a failed-over request land on an executable
+        the target already compiled."""
+        return {"batch_buckets": list(self._batch_buckets),
+                "seq_buckets": (list(self._seq_buckets)
+                                if self._seq_buckets else None),
+                "max_batch_size": self.max_batch_size,
+                "pad_value": self._pad_value}
+
     # -- lifecycle ---------------------------------------------------------
     # drain/close/__enter__/__exit__/__del__ come from ServerLifecycleMixin
     def shutdown(self, drain: bool = True,

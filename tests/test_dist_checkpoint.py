@@ -191,14 +191,14 @@ class TestCrashConsistentCommit:
         only once the rename made it durable. A torn save is never
         resumable."""
         from paddle_tpu.distributed.resilience import (
-            InjectedCrash, fault_injection, latest_checkpoint,
+            InjectedCrash, get_fault_injector, latest_checkpoint,
             validate_checkpoint_dir)
         root = str(tmp_path / "root")
         _commit(root, 1, 1.0)
         assert latest_checkpoint(root)[0] == 1
 
         # enumerate the write boundaries with one clean dry-run commit
-        with fault_injection() as inj:
+        with get_fault_injector().scoped() as inj:
             _commit(str(tmp_path / "scratch"), 2, 2.0)
             n_writes = inj.writes_seen
         assert n_writes >= 10  # shard, tables, extras, marker, rename...
@@ -209,7 +209,7 @@ class TestCrashConsistentCommit:
                 p = os.path.join(root, leftover)
                 if os.path.isdir(p):
                     shutil.rmtree(p)
-            with fault_injection() as inj:
+            with get_fault_injector().scoped() as inj:
                 inj.arm_kill_at_write(n)
                 with pytest.raises(InjectedCrash):
                     _commit(root, 2, 2.0)

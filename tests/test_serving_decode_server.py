@@ -364,7 +364,8 @@ class TestObservability:
             srv.generate(rng.randint(0, 250, (5,)).astype(np.int32),
                          max_new_tokens=2, timeout=120)
             scrape = profiler.export_stats()
-            assert set(scrape) == {"pipeline", "serving", "decode"}
+            assert set(scrape) == {"pipeline", "serving", "decode",
+                                   "resilience", "router"}
             assert "decode_test_export" in scrape["decode"]
 
             import json

@@ -1,0 +1,718 @@
+"""Serving-router units + one-shot fault drills (ISSUE 10).
+
+Covers the robustness primitives in isolation (circuit breaker, retry
+policy/budget, backend health, the fault injector's ``scoped()`` and
+backend fault kinds, lifecycle idempotence) and the router's one-shot
+path end-to-end: fan-out correctness, sticky buckets, kill-mid-traffic
+failover with breaker open→half-open→closed recovery, deadline-aware
+shedding, hedging, and ``router_stats()`` in ``export_stats()``.
+
+Decode-stream drills live in test_serving_router_decode.py. These files
+sort after this env's tier-1 870 s truncation point — run directly.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import profiler
+from paddle_tpu.distributed.resilience.faults import get_fault_injector
+from paddle_tpu.serving import Server
+from paddle_tpu.serving.batcher import DeadlineExceeded, ServerClosed
+from paddle_tpu.serving.bucketing import BucketOverflow
+from paddle_tpu.serving.router import (Backend, BackendDied,
+                                       BackendHealth, BackendUnavailable,
+                                       BreakerState, CircuitBreaker,
+                                       HealthState, InProcessBackend,
+                                       RetryPolicy, Router,
+                                       RouterOverloaded)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    # belt and braces: every test runs inside its own injector scope
+    with get_fault_injector().scoped():
+        yield
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+class TestCircuitBreaker:
+    def test_opens_after_threshold_consecutive_failures(self):
+        br = CircuitBreaker(failure_threshold=3, reset_timeout_s=60.0)
+        br.record_failure()
+        br.record_failure()
+        assert br.state == BreakerState.CLOSED
+        br.record_failure()
+        assert br.state == BreakerState.OPEN
+        assert not br.allow()
+
+    def test_success_resets_the_failure_streak(self):
+        br = CircuitBreaker(failure_threshold=2, reset_timeout_s=60.0)
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        assert br.state == BreakerState.CLOSED
+
+    def test_half_open_admits_exactly_one_trial(self):
+        br = CircuitBreaker(failure_threshold=1, reset_timeout_s=0.05)
+        br.record_failure()
+        assert br.state == BreakerState.OPEN
+        assert not br.allow()           # dwell not elapsed
+        time.sleep(0.06)
+        assert br.allow()               # THE half-open trial
+        assert br.state == BreakerState.HALF_OPEN
+        assert not br.allow()           # second caller is rejected
+
+    def test_trial_success_closes_failure_reopens(self):
+        for outcome in ("success", "failure"):
+            br = CircuitBreaker(failure_threshold=1, reset_timeout_s=0.02)
+            br.record_failure()
+            time.sleep(0.03)
+            assert br.allow()
+            if outcome == "success":
+                br.record_success()
+                assert br.state == BreakerState.CLOSED
+                assert br.allow()
+            else:
+                br.record_failure()
+                assert br.state == BreakerState.OPEN
+                assert not br.allow()   # dwell restarted
+
+    def test_transition_log_and_callback(self):
+        seen = []
+        br = CircuitBreaker(failure_threshold=1, reset_timeout_s=0.02,
+                            on_transition=lambda a, b: seen.append((a, b)))
+        br.record_failure()
+        time.sleep(0.03)
+        br.allow()
+        br.record_success()
+        assert seen == [(BreakerState.CLOSED, BreakerState.OPEN),
+                        (BreakerState.OPEN, BreakerState.HALF_OPEN),
+                        (BreakerState.HALF_OPEN, BreakerState.CLOSED)]
+        assert [(a, b) for _, a, b in br.transitions()] == seen
+
+    def test_vanished_trial_does_not_wedge_half_open(self):
+        br = CircuitBreaker(failure_threshold=1, reset_timeout_s=0.02)
+        br.record_failure()
+        time.sleep(0.03)
+        assert br.allow()               # trial whose caller "dies"
+        time.sleep(0.03)
+        assert br.allow()               # a fresh trial is admitted
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+class TestRetryPolicy:
+    def test_backoff_is_exponential_and_capped(self):
+        p = RetryPolicy(base_backoff_ms=10, max_backoff_ms=40, jitter=0.0)
+        assert p.backoff_s(1) == pytest.approx(0.010)
+        assert p.backoff_s(2) == pytest.approx(0.020)
+        assert p.backoff_s(3) == pytest.approx(0.040)
+        assert p.backoff_s(6) == pytest.approx(0.040)   # capped
+
+    def test_jitter_stays_within_fraction(self):
+        p = RetryPolicy(base_backoff_ms=100, max_backoff_ms=1000,
+                        jitter=0.5, seed=7)
+        for _ in range(100):
+            d = p.backoff_s(1)
+            assert 0.05 <= d <= 0.15
+
+    def test_budget_exhausts_and_accrues(self):
+        p = RetryPolicy(budget_ratio=0.5, budget_cap=2.0)
+        assert p.try_acquire() and p.try_acquire()
+        assert not p.try_acquire()          # bucket empty
+        p.on_request()
+        p.on_request()                      # 2 x 0.5 = 1 token
+        assert p.try_acquire()
+        assert not p.try_acquire()
+
+    def test_never_past_deadline(self):
+        p = RetryPolicy(base_backoff_ms=50, jitter=0.0)
+        assert p.fits_deadline(0.05, None)          # no deadline
+        assert p.fits_deadline(0.05, 0.1)
+        assert not p.fits_deadline(0.05, 0.05)      # would land ON it
+        assert not p.fits_deadline(0.05, 0.01)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError):
+            RetryPolicy(jitter=1.5)
+
+
+# ---------------------------------------------------------------------------
+# backend health
+# ---------------------------------------------------------------------------
+class TestBackendHealth:
+    def test_probe_failures_mark_down_and_success_recovers(self):
+        h = BackendHealth(down_after=2)
+        assert h.state == HealthState.HEALTHY
+        h.record_probe(False)
+        assert h.state == HealthState.HEALTHY   # one strike
+        old, new = h.record_probe(False)
+        assert (old, new) == (HealthState.HEALTHY, HealthState.DOWN)
+        old, new = h.record_probe(True, 1.0)
+        assert (old, new) == (HealthState.DOWN, HealthState.HEALTHY)
+
+    def test_error_rate_degrades_but_does_not_down(self):
+        h = BackendHealth(min_samples=4, degrade_error_rate=0.5)
+        for ok in (True, False, False, True):
+            h.record_request(ok, 1.0)
+        assert h.state == HealthState.DEGRADED
+        for _ in range(8):
+            h.record_request(True, 1.0)
+        assert h.state == HealthState.HEALTHY
+
+    def test_latency_degrades(self):
+        h = BackendHealth(min_samples=4, degrade_latency_ms=10.0)
+        for _ in range(4):
+            h.record_request(True, 50.0)
+        assert h.state == HealthState.DEGRADED
+
+    def test_consecutive_transport_deaths_mark_down_without_probes(self):
+        h = BackendHealth(down_after=2)
+        h.record_death()
+        assert h.state == HealthState.HEALTHY
+        old, new = h.record_death()
+        assert new == HealthState.DOWN      # faster than the prober
+        old, new = h.record_probe(True, 1.0)
+        assert new == HealthState.HEALTHY
+        # a quality failure is NOT a death: it degrades, never downs
+        h2 = BackendHealth(down_after=1, min_samples=2)
+        h2.record_request(False)
+        h2.record_request(False)
+        assert h2.state == HealthState.DEGRADED
+
+    def test_recovery_from_down_clears_the_stale_passive_window(self):
+        h = BackendHealth(down_after=2, min_samples=4,
+                          degrade_error_rate=0.5)
+        for _ in range(6):              # every request failed: host dead
+            h.record_request(False)
+        h.record_probe(False)
+        h.record_probe(False)
+        assert h.state == HealthState.DOWN
+        # the host comes back: the dead-life failures must not pin it
+        # DEGRADED until traffic happens to wash the window out
+        old, new = h.record_probe(True, 1.0)
+        assert (old, new) == (HealthState.DOWN, HealthState.HEALTHY)
+        assert h.snapshot()["window_requests"] == 0
+
+    def test_snapshot_shape(self):
+        h = BackendHealth()
+        h.record_request(True, 2.0)
+        s = h.snapshot()
+        assert s["state"] == HealthState.HEALTHY
+        assert s["window_requests"] == 1
+        assert s["window_error_rate"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# fault injector: scoped() + backend fault kinds (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+class TestFaultInjectorScoped:
+    def test_scoped_restores_prior_state(self):
+        inj = get_fault_injector()
+        inj.arm_backend_kill("outer")
+        try:
+            with inj.scoped():
+                # entered disarmed despite the outer arming
+                assert inj.backend_action("outer") is None
+                inj.arm_backend_kill("inner")
+                inj.arm_slow_disk(0.5)
+                assert inj.armed
+            # inner arming gone, outer arming restored
+            assert inj.backend_action("inner") is None
+            assert inj.backend_action("outer") == ("kill",)
+            assert inj.armed
+        finally:
+            inj.reset()
+        assert not inj.armed
+
+    def test_scoped_exits_clean_on_exception(self):
+        inj = get_fault_injector()
+        with pytest.raises(RuntimeError):
+            with inj.scoped():
+                inj.arm_backend_hang("h")
+                raise RuntimeError("boom")
+        assert not inj.armed
+
+    def test_write_counter_zeroed_on_entry(self):
+        inj = get_fault_injector()
+        inj.count_write()
+        with inj.scoped():
+            assert inj.writes_seen == 0
+            inj.count_write()
+            assert inj.writes_seen == 1
+
+    def test_backend_kill_slow_flap_actions(self):
+        inj = get_fault_injector()
+        with inj.scoped():
+            assert inj.backend_action("b") is None
+            inj.arm_backend_slow("b", 0.25)
+            assert inj.backend_action("b") == ("slow", 0.25)
+            inj.arm_backend_flap("b", period=2)
+            # dead phase first, then alive, alternating per 2 consults
+            acts = [inj.backend_action("b") for _ in range(8)]
+            assert acts == [("kill",), ("kill",), None, None,
+                            ("kill",), ("kill",), None, None]
+            inj.heal_backend("b")
+            assert inj.backend_action("b") is None
+
+    def test_backend_hang_waiter_bounded_and_released_by_heal(self):
+        inj = get_fault_injector()
+        with inj.scoped():
+            inj.arm_backend_hang("b")
+            kind, waiter = inj.backend_action("b")
+            assert kind == "hang"
+            t0 = time.monotonic()
+            assert waiter(0.05) is False          # bounded timeout
+            assert time.monotonic() - t0 < 1.0
+            kind, waiter = inj.backend_action("b")
+            released = []
+            th = threading.Thread(
+                target=lambda: released.append(waiter(5.0)), daemon=True)
+            th.start()
+            time.sleep(0.02)
+            inj.heal_backend("b")
+            th.join(2.0)
+            assert released == [True]             # heal released it
+
+
+# ---------------------------------------------------------------------------
+# lifecycle idempotence under interpreter shutdown (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+class TestLifecycleShutdownIdempotence:
+    def _server(self, name):
+        return Server(lambda x: x, max_batch_size=2, batch_timeout_ms=1.0,
+                      name=name)
+
+    def test_del_after_close_is_a_noop(self):
+        srv = self._server("lc_a")
+        srv.close()
+        srv.close()                     # close is idempotent
+        srv.__del__()                   # and __del__ after close no-ops
+
+    def test_del_does_not_steal_a_successors_registry_entry(self):
+        first = self._server("lc_name_reuse")
+        first.close()
+        second = self._server("lc_name_reuse")
+        try:
+            first.__del__()             # must not unregister `second`
+            assert "lc_name_reuse" in profiler.serving_stats()
+        finally:
+            second.close()
+        assert "lc_name_reuse" not in profiler.serving_stats()
+
+    def test_del_on_half_constructed_host_never_raises(self):
+        # __init__ raised before _lock/_closed existed: __del__ must
+        # treat it as closed instead of raising AttributeError
+        broken = object.__new__(Server)
+        broken.__del__()
+        assert broken._is_closed()
+
+    def test_del_survives_torn_down_attributes(self):
+        srv = self._server("lc_torn")
+        srv.close()
+        del srv._lock                   # interpreter-teardown stand-in
+        srv.__del__()
+
+    def test_drain_on_half_constructed_host(self):
+        broken = object.__new__(Server)
+        assert broken.drain(timeout=0.01) is True
+
+
+# ---------------------------------------------------------------------------
+# router one-shot path
+# ---------------------------------------------------------------------------
+def _echo_servers(n, name_prefix, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("batch_timeout_ms", 1.0)
+    kw.setdefault("seq_buckets", [8])
+    return [Server(lambda x: x * 2.0, name=f"{name_prefix}{i}", **kw)
+            for i in range(n)]
+
+
+class TestRouterOneShot:
+    def test_fanout_correctness_and_exactly_once(self):
+        servers = _echo_servers(3, "os_a")
+        backends = [InProcessBackend(f"a{i}", server=s)
+                    for i, s in enumerate(servers)]
+        try:
+            with Router(backends, default_deadline_ms=10_000,
+                        num_workers=4) as r:
+                futs = [r.submit(np.full((5,), float(i)))
+                        for i in range(12)]
+                for i, f in enumerate(futs):
+                    np.testing.assert_allclose(
+                        f.result(timeout=10), np.full((5,), 2.0 * i))
+                st = r.stats()
+                assert st["completed"] == 12
+                assert st["submitted"] == 12
+                assert st["failed"] == st["expired"] == 0
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_mismatched_bucket_config_is_rejected(self):
+        servers = _echo_servers(1, "os_b") + \
+            _echo_servers(1, "os_c", seq_buckets=[16])
+        backends = [InProcessBackend(f"b{i}", server=s)
+                    for i, s in enumerate(servers)]
+        try:
+            with pytest.raises(ValueError, match="share one bucket"):
+                Router(backends)
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_duplicate_backend_ids_rejected(self):
+        servers = _echo_servers(2, "os_d")
+        backends = [InProcessBackend("dup", server=s) for s in servers]
+        try:
+            with pytest.raises(ValueError, match="duplicate"):
+                Router(backends)
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_sticky_bucket_keeps_landing_on_one_backend(self):
+        servers = _echo_servers(3, "os_e")
+        backends = [InProcessBackend(f"e{i}", server=s)
+                    for i, s in enumerate(servers)]
+        try:
+            with Router(backends, default_deadline_ms=10_000) as r:
+                for _ in range(6):
+                    r.run(np.ones((5,)), timeout=10)
+                sticky = r.sticky_assignment()
+                assert len(sticky) == 1
+                (key, owner), = sticky.items()
+                assert key[0] == "oneshot"
+                # all traffic landed on the sticky owner
+                counts = {s.name: s.stats()["completed"] for s in servers}
+                idx = int(owner[1:])
+                assert counts[servers[idx].name] == 6
+                assert sum(counts.values()) == 6
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_kill_mid_traffic_fails_over_and_breaker_recovers(self):
+        inj = get_fault_injector()
+        servers = _echo_servers(3, "os_f")
+        backends = [InProcessBackend(f"f{i}", server=s)
+                    for i, s in enumerate(servers)]
+        try:
+            with Router(backends, default_deadline_ms=15_000,
+                        num_workers=4, probe_interval_ms=20,
+                        failure_threshold=2, breaker_reset_ms=150,
+                        down_after=2) as r:
+                # a first wave settles the sticky owner
+                r.run(np.ones((5,)), timeout=10)
+                victim = next(iter(r.sticky_assignment().values()))
+                inj.arm_backend_kill(victim)
+                futs = [r.submit(np.full((5,), float(i)))
+                        for i in range(8)]
+                for i, f in enumerate(futs):
+                    np.testing.assert_allclose(
+                        f.result(timeout=15), np.full((5,), 2.0 * i))
+                st = r.stats()
+                assert st["completed"] == 9
+                assert st["failovers"] >= 1 or st["retries"] >= 0
+                # probes drive the victim's breaker open and health DOWN
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline:
+                    b = r.stats()["backends"][victim]
+                    if b["breaker"] == BreakerState.OPEN \
+                            and b["health"]["state"] == HealthState.DOWN:
+                        break
+                    time.sleep(0.02)
+                b = r.stats()["backends"][victim]
+                assert b["breaker"] == BreakerState.OPEN
+                assert b["health"]["state"] == HealthState.DOWN
+                # recovery: heal -> half-open probe trial -> closed
+                inj.heal_backend(victim)
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline:
+                    b = r.stats()["backends"][victim]
+                    if b["breaker"] == BreakerState.CLOSED \
+                            and b["health"]["state"] == HealthState.HEALTHY:
+                        break
+                    time.sleep(0.02)
+                b = r.stats()["backends"][victim]
+                assert b["breaker"] == BreakerState.CLOSED
+                assert b["health"]["state"] == HealthState.HEALTHY
+                trans = [(a, z) for _, a, z in b["breaker_transitions"]]
+                assert (BreakerState.CLOSED, BreakerState.OPEN) in trans
+                assert (BreakerState.OPEN, BreakerState.HALF_OPEN) in trans
+                assert (BreakerState.HALF_OPEN,
+                        BreakerState.CLOSED) in trans
+                # and the healed backend serves traffic again
+                r.run(np.ones((5,)), timeout=10)
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_all_backends_dead_is_typed_backend_unavailable(self):
+        inj = get_fault_injector()
+        servers = _echo_servers(2, "os_g")
+        backends = [InProcessBackend(f"g{i}", server=s)
+                    for i, s in enumerate(servers)]
+        try:
+            with Router(backends, num_workers=2, probe_interval_ms=20,
+                        shed_timeout_ms=300,
+                        retry=RetryPolicy(jitter=0.0)) as r:
+                inj.arm_backend_kill("g0")
+                inj.arm_backend_kill("g1")
+                fut = r.submit(np.ones((5,)))       # NO deadline
+                with pytest.raises(BackendUnavailable):
+                    fut.result(timeout=15)
+                assert r.stats()["failed"] == 1
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_deadline_never_outlived_by_retries(self):
+        inj = get_fault_injector()
+        servers = _echo_servers(2, "os_h")
+        backends = [InProcessBackend(f"h{i}", server=s)
+                    for i, s in enumerate(servers)]
+        try:
+            # huge attempt budget: the DEADLINE must be what stops the
+            # retry loop, and the request must settle promptly at it
+            with Router(backends, num_workers=2, probe_interval_ms=20,
+                        retry=RetryPolicy(jitter=0.0, max_attempts=1000,
+                                          base_backoff_ms=20,
+                                          max_backoff_ms=40,
+                                          budget_cap=1000)) as r:
+                inj.arm_backend_kill("h0")
+                inj.arm_backend_kill("h1")
+                t0 = time.monotonic()
+                fut = r.submit(np.ones((5,)), deadline_ms=200)
+                with pytest.raises((DeadlineExceeded,
+                                    BackendUnavailable)):
+                    fut.result(timeout=15)
+                # settled at the deadline, not after the 1000-attempt
+                # schedule
+                assert time.monotonic() - t0 < 2.0
+                st = r.stats()
+                assert st["expired"] + st["failed"] == 1
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_queue_full_sheds_with_router_overloaded(self):
+        inj = get_fault_injector()
+        servers = _echo_servers(1, "os_i")
+        backends = [InProcessBackend("i0", server=servers[0])]
+        try:
+            # one worker, hung backend, tiny queue: the queue must fill
+            with Router(backends, num_workers=1, max_queue_size=2,
+                        probe_interval_ms=10_000) as r:
+                inj.arm_backend_hang("i0")
+                futs = []
+                shed = 0
+                for _ in range(8):
+                    try:
+                        futs.append(r.submit(np.ones((5,)),
+                                             deadline_ms=1500))
+                    except RouterOverloaded:
+                        shed += 1
+                assert shed >= 1
+                assert r.stats()["rejected_overload"] == shed
+                inj.heal_backend("i0")
+                for f in futs:
+                    f.result(timeout=15)    # accepted work completes
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_hedge_wins_on_a_slow_backend(self):
+        inj = get_fault_injector()
+        servers = _echo_servers(2, "os_j")
+        backends = [InProcessBackend(f"j{i}", server=s)
+                    for i, s in enumerate(servers)]
+        try:
+            with Router(backends, default_deadline_ms=10_000,
+                        num_workers=2, hedge_after_ms=40,
+                        probe_interval_ms=10_000) as r:
+                r.run(np.ones((5,)), timeout=10)    # settle sticky
+                victim = next(iter(r.sticky_assignment().values()))
+                inj.arm_backend_slow(victim, 0.5)
+                out = r.run(np.ones((5,)), timeout=10)
+                np.testing.assert_allclose(out, np.full((5,), 2.0))
+                st = r.stats()
+                assert st["hedges"] >= 1
+                assert st["hedge_wins"] >= 1
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_router_stats_in_export_stats(self):
+        servers = _echo_servers(1, "os_k")
+        backends = [InProcessBackend("k0", server=servers[0])]
+        try:
+            with Router(backends, name="router_export_probe") as r:
+                r.run(np.ones((5,)), timeout=10)
+                data = profiler.export_stats()
+                assert "router_export_probe" in data["router"]
+                snap = data["router"]["router_export_probe"]
+                assert snap["completed"] == 1
+                assert snap["backends"]["k0"]["breaker"] == "closed"
+                text = profiler.export_stats(format="text")
+                assert "router_export_probe" in text
+            assert "router_export_probe" not in profiler.router_stats()
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_open_breaker_fallback_consumes_only_one_trial(self):
+        """When every breaker is open and eligible, placement must
+        admit the half-open trial on exactly ONE backend — consuming
+        the single trial of candidates it does not dispatch to would
+        wedge them in HALF_OPEN for a full dwell."""
+        servers = _echo_servers(3, "os_m")
+        backends = [InProcessBackend(f"m{i}", server=s)
+                    for i, s in enumerate(servers)]
+        try:
+            with Router(backends, probe_interval_ms=60_000,
+                        failure_threshold=1,
+                        breaker_reset_ms=30) as r:
+                for e in r._backends:
+                    e.breaker.record_failure()
+                assert all(e.breaker.state == BreakerState.OPEN
+                           for e in r._backends)
+                time.sleep(0.05)            # all dwell-eligible
+                entry = r._pick_backend(("probe-key",), set())
+                assert entry is not None
+                states = [e.breaker.state for e in r._backends]
+                assert states.count(BreakerState.HALF_OPEN) == 1
+                assert states.count(BreakerState.OPEN) == 2
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_router_lifecycle_close_idempotent(self):
+        servers = _echo_servers(1, "os_l")
+        backends = [InProcessBackend("l0", server=servers[0])]
+        try:
+            r = Router(backends, name="router_lc")
+            r.run(np.ones((5,)), timeout=10)
+            r.close()
+            r.close()
+            r.__del__()
+            with pytest.raises(ServerClosed):
+                r.submit(np.ones((5,)))
+            st = r.stats()
+            assert st["completed"] == st["submitted"] == 1
+        finally:
+            for s in servers:
+                s.close()
+
+
+# ---------------------------------------------------------------------------
+# decode failover edge cases (scripted transport — no model needed)
+# ---------------------------------------------------------------------------
+class _ScriptedStream:
+    """Stands in for a backend DecodeStream: yields scripted tokens,
+    then either finishes or dies."""
+
+    def __init__(self, tokens, die_at_end=False, finish_reason="length"):
+        self._toks = list(tokens)
+        self._die = die_at_end
+        self.finish_reason = finish_reason
+
+    def next_token(self, index, timeout=None):
+        if index < len(self._toks):
+            return self._toks[index]
+        if self._die:
+            raise BackendDied("scripted host death")
+        return None
+
+
+class _ScriptedBackend(Backend):
+    """Minimal decode transport whose submit_decode runs a script
+    (per-call), recording every admission it sees."""
+
+    def __init__(self, backend_id, script):
+        self.backend_id = backend_id
+        self._script = script
+        self.calls = []
+
+    def bucket_config(self):
+        return {"decode": {"batch_buckets": [1],
+                           "prefill_buckets": [16],
+                           "page_buckets": [1, 2, 4], "page_len": 8,
+                           "max_context": 32}}
+
+    def submit_decode(self, prompt, *, max_new_tokens, eos_id=None):
+        self.calls.append((list(map(int, prompt)), int(max_new_tokens)))
+        return self._script(len(self.calls), prompt, max_new_tokens)
+
+    def submit(self, args, deadline_ms=None):
+        raise TypeError("decode-only scripted backend")
+
+    def check_alive(self):
+        pass
+
+    def probe(self, timeout):
+        return 0.0
+
+    def load(self):
+        return float(len(self.calls))
+
+    def close(self):
+        pass
+
+
+class TestDecodeFailoverEdgeCases:
+    def test_death_after_eos_does_not_resume_past_eos(self):
+        """A backend that dies AFTER relaying eos but before the finish
+        signal must complete the stream as 'eos' — re-admitting would
+        append post-eos tokens and break the bit-identical guarantee."""
+        eos = 9
+        b0 = _ScriptedBackend(
+            "sb0", lambda n, p, m: _ScriptedStream([7, eos],
+                                                   die_at_end=True))
+        b1 = _ScriptedBackend(
+            "sb1", lambda n, p, m: _ScriptedStream([999]))
+        with Router([b0, b1], probe_interval_ms=60_000,
+                    default_deadline_ms=10_000) as r:
+            stream = r.submit_decode(np.asarray([1, 2, 3], np.int32),
+                                     max_new_tokens=5, eos_id=eos)
+            out = [int(t) for t in stream.result(timeout=10)]
+            assert out == [7, eos]
+            assert stream.finish_reason == "eos"
+            st = r.stats()
+            assert st["completed"] == 1
+            assert st["decode_failovers"] == 0
+        assert b1.calls == []           # never re-admitted anywhere
+
+    def test_failover_grown_prompt_over_buckets_is_typed(self):
+        """A mid-stream failover whose effective prompt outgrew the
+        shared prefill buckets settles with the typed BucketOverflow,
+        not an opaque dispatch-failed ServingError."""
+        def script(n, prompt, mnt):
+            if len(prompt) > 16:
+                from paddle_tpu.serving.bucketing import \
+                    next_bucket_strict
+                next_bucket_strict(len(prompt), [16], "prompt length")
+            return _ScriptedStream([5] * 4, die_at_end=True)
+
+        b0 = _ScriptedBackend("sc0", script)
+        b1 = _ScriptedBackend("sc1", script)
+        with Router([b0, b1], probe_interval_ms=60_000,
+                    default_deadline_ms=10_000,
+                    retry=RetryPolicy(jitter=0.0)) as r:
+            # 14-token prompt + 4 relayed tokens = 18 > bucket 16 on
+            # the re-admission after the scripted death
+            stream = r.submit_decode(np.arange(14, dtype=np.int32),
+                                     max_new_tokens=10)
+            with pytest.raises(BucketOverflow):
+                stream.result(timeout=10)
+            st = r.stats()
+            assert st["failed"] == 1
+            assert st["decode_failovers"] >= 1

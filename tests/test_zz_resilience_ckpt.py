@@ -23,7 +23,7 @@ import pytest
 from paddle_tpu import profiler
 from paddle_tpu.distributed.resilience import (CheckpointManager,
                                                CheckpointWriteError,
-                                               fault_injection,
+                                               get_fault_injector,
                                                latest_checkpoint,
                                                validate_checkpoint_dir)
 
@@ -115,7 +115,7 @@ class TestAsyncOffHotPath:
         monkeypatch.setattr(cu.jax, "device_get", counting_get)
         root = str(tmp_path / "root")
         delay = 0.05
-        with fault_injection() as inj:
+        with get_fault_injector().scoped() as inj:
             with CheckpointManager(root, interval=1) as mgr:
                 # enumerate this save's write count with a clean run
                 mgr.save(0, _state(0))
@@ -143,7 +143,7 @@ class TestAsyncOffHotPath:
         the second save() waits for the first write to land, so host RAM
         never holds two pending snapshots."""
         root = str(tmp_path / "root")
-        with fault_injection() as inj:
+        with get_fault_injector().scoped() as inj:
             with CheckpointManager(root, interval=1) as mgr:
                 mgr.save(0, _state(0))
                 mgr.wait()
@@ -162,7 +162,7 @@ class TestAsyncOffHotPath:
         the training thread by the NEXT maybe_save — and the torn
         staging dir is never resumable; the manager recovers."""
         root = str(tmp_path / "root")
-        with fault_injection() as inj:
+        with get_fault_injector().scoped() as inj:
             with CheckpointManager(root, interval=10) as mgr:
                 mgr.save(0, _state(0))
                 mgr.wait()
@@ -191,7 +191,7 @@ class TestAsyncOffHotPath:
         committed checkpoint resolvable (the manager-level version of the
         per-boundary sweep in test_dist_checkpoint.py)."""
         root = str(tmp_path / "root")
-        with fault_injection() as inj:
+        with get_fault_injector().scoped() as inj:
             with CheckpointManager(root, interval=1) as mgr:
                 mgr.save(3, _state(3))
                 mgr.wait()

@@ -24,7 +24,7 @@ from paddle_tpu.distributed import CommTaskManager, CommTimeoutError
 from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
                                                   ElasticStatus)
 from paddle_tpu.distributed.resilience import (CheckpointManager,
-                                               fault_injection,
+                                               get_fault_injector,
                                                validate_checkpoint_dir)
 from paddle_tpu.distributed.resilience.faults import InjectedCrash
 from paddle_tpu.distributed.store import TCPStore
@@ -53,7 +53,7 @@ class TestWatchdogFaultFlow:
         try:
             epoch0 = mgr.current_epoch()
             ctm = CommTaskManager(timeout_s=0.3)
-            with fault_injection() as inj:
+            with get_fault_injector().scoped() as inj:
                 inj.arm_sync_hang("allreduce")
                 with pytest.raises(CommTimeoutError, match="allreduce"):
                     ctm.wait(jnp.zeros(()) + 1, desc="allreduce grads")
@@ -104,7 +104,7 @@ class TestWatchdogFaultFlow:
                     set(a.alive_nodes()) != {"a", "b"}:
                 time.sleep(0.1)
             assert set(a.alive_nodes()) == {"a", "b"}
-            with fault_injection() as inj:
+            with get_fault_injector().scoped() as inj:
                 inj.arm_heartbeat_drop("b")
                 deadline = time.time() + 10
                 while time.time() < deadline and "b" not in a.dead_nodes():

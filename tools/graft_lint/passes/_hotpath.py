@@ -46,6 +46,11 @@ HOT_ROOT_NAMES = {"run_steps", "_run_loop", "_execute", "_produce",
                   # subsystem function reachable from it (admit, prefill,
                   # decode step, emit) is per-step hot
                   "_step_loop",
+                  # the serving router: dispatch workers run once per
+                  # request (retries/failovers included) and the health
+                  # prober once per backend per tick — both multiply any
+                  # silent sync or retrace by the traffic rate
+                  "_dispatch_loop", "_health_loop", "submit_decode",
                   # resilience: the per-step save gate, the write-behind
                   # worker loop, and the per-write fault/Fs boundary
                   "maybe_save", "save", "_write_loop", "poll",
