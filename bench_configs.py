@@ -682,17 +682,23 @@ def bench_router_failover(dev, on_tpu):
     """Multi-host serving router over 3 in-process DecodeServer
     backends: routing overhead vs a direct single server on the same
     mixed-length decode traffic, then the same traffic with one backend
-    KILLED mid-run (the loss-free failover path). Scored quantities:
-    ``routing_overhead`` (routed wall / direct wall on 1/3 of the
-    traffic each — overhead should be small), ``kill_slowdown`` (killed
-    wall / clean routed wall), and ``parity_ok`` (every phase's greedy
-    outputs bitwise-identical)."""
+    KILLED mid-run (the loss-free failover path), then BOTH phases again
+    ACROSS REAL SOCKETS (``serving.transport``: RemoteBackend clients,
+    BackendServer listeners, a fault proxy whose mid-stream RST is the
+    kill). Scored quantities: ``routing_overhead`` (routed wall / direct
+    wall on 1/3 of the traffic each — overhead should be small),
+    ``kill_slowdown`` (killed wall / clean routed wall),
+    ``wire_overhead`` (wire wall / in-process routed wall — the cost of
+    pickling frames through localhost TCP), ``wire_kill_slowdown``, and
+    ``parity_ok`` (every phase's greedy outputs bitwise-identical)."""
     import paddle_tpu as paddle
     from paddle_tpu.distributed.resilience.faults import \
         get_fault_injector
     from paddle_tpu.models import LlamaForCausalLM, llama_tiny
     from paddle_tpu.serving import decode
     from paddle_tpu.serving.router import InProcessBackend, Router
+    from paddle_tpu.serving.transport import (BackendServer, FaultProxy,
+                                              RemoteBackend)
 
     paddle.seed(0)
     model = LlamaForCausalLM(llama_tiny())
@@ -710,12 +716,13 @@ def bench_router_failover(dev, on_tpu):
                                    max_queue_size=n_requests + 8,
                                    name=name)
 
-    def run_all(submit, kill_after_tokens=None, victim_of=None):
+    def run_all(submit, kill_after_tokens=None, victim_of=None,
+                arm=None):
         streams = [submit(p, g) for p, g in reqs]
         if kill_after_tokens is not None:
             while streams[0].token_count() < kill_after_tokens:
                 time.sleep(0.001)
-            get_fault_injector().arm_backend_kill(victim_of())
+            (arm or get_fault_injector().arm_backend_kill)(victim_of())
         return [[int(t) for t in s.result(timeout=600)]
                 for s in streams]
 
@@ -766,12 +773,74 @@ def bench_router_failover(dev, on_tpu):
             "compiles_during_run": compiles,
             "latency_ms_p99": round(rst["latency_ms"]["p99"], 2)}
 
+    # -- routed over 3 backends ACROSS REAL SOCKETS (wire transport) -----
+    for phase, kill in (("routed_wire", False),
+                        ("routed_wire_killed", True)):
+        servers = [srv(f"rb_{phase}_{i}") for i in range(3)]
+        for s in servers:
+            s.warmup()
+        hosts = [BackendServer(backend_id=f"rb_{phase}_h{i}",
+                               decode_server=s)
+                 for i, s in enumerate(servers)]
+        proxies = [FaultProxy(h.address, proxy_id=f"rb_{phase}_h{i}")
+                   for i, h in enumerate(hosts)]
+        compiles0 = sum(s.stats()["compile_count"] for s in servers)
+        inj = get_fault_injector()
+        with inj.scoped():
+            backends = [RemoteBackend(f"rb_{phase}_h{i}", p.address,
+                                      liveness_timeout_s=0.6,
+                                      keepalive_s=0.1)
+                        for i, p in enumerate(proxies)]
+            with Router(backends, default_deadline_ms=600_000,
+                        num_workers=n_requests, probe_interval_ms=25,
+                        close_backends=True) as router:
+                t0 = time.perf_counter()
+                outs = run_all(
+                    lambda p, g: router.submit_decode(
+                        p, max_new_tokens=g),
+                    kill_after_tokens=2 if kill else None,
+                    victim_of=lambda: list(
+                        router.sticky_assignment().values())[0],
+                    arm=inj.arm_socket_reset)
+                wall = time.perf_counter() - t0
+                rst = router.stats()
+                snaps = [b.metrics.snapshot() for b in backends]
+                wire_bytes = sum(s["bytes_sent"] + s["bytes_received"]
+                                 for s in snaps)
+        compiles = sum(s.stats()["compile_count"]
+                       for s in servers) - compiles0
+        for p in proxies:
+            p.close()
+        for h in hosts:
+            h.shutdown(drain=False)
+        for s in servers:
+            s.close()
+        entry[phase] = {
+            "tokens_per_sec": round(total_new / wall, 1),
+            "wall_s": round(wall, 3),
+            "parity_ok": bool(outs == ref),
+            "failovers": rst["failovers"],
+            "decode_failovers": rst["decode_failovers"],
+            "tokens_resumed": rst["tokens_resumed"],
+            "retries": rst["retries"],
+            "compiles_during_run": compiles,
+            "wire_bytes": int(wire_bytes),
+            "latency_ms_p99": round(rst["latency_ms"]["p99"], 2)}
+
     entry["routing_overhead"] = round(
         entry["routed"]["wall_s"] / entry["direct"]["wall_s"], 3)
     entry["kill_slowdown"] = round(
         entry["routed_killed"]["wall_s"] / entry["routed"]["wall_s"], 3)
-    entry["parity_ok"] = bool(entry["routed"]["parity_ok"]
-                              and entry["routed_killed"]["parity_ok"])
+    entry["wire_overhead"] = round(
+        entry["routed_wire"]["wall_s"] / entry["routed"]["wall_s"], 3)
+    entry["wire_kill_slowdown"] = round(
+        entry["routed_wire_killed"]["wall_s"]
+        / entry["routed_wire"]["wall_s"], 3)
+    entry["parity_ok"] = bool(
+        entry["routed"]["parity_ok"]
+        and entry["routed_killed"]["parity_ok"]
+        and entry["routed_wire"]["parity_ok"]
+        and entry["routed_wire_killed"]["parity_ok"])
     return entry
 
 

@@ -17,10 +17,12 @@ from .async_ckpt import (AsyncCheckpointer,  # noqa: F401
                          CheckpointWriteError,
                          default_async_checkpointer)
 from .commit import (COMMITTED_MARKER, FAILED_MARKER,  # noqa: F401
-                     LATEST_POINTER, HostSnapshot, latest_checkpoint,
-                     list_committed_steps, read_latest_pointer,
-                     staging_dir, step_dir, take_snapshot,
-                     validate_checkpoint_dir, write_committed_checkpoint)
+                     LATEST_POINTER, CheckpointTransport, HostSnapshot,
+                     LocalFsTransport, latest_checkpoint,
+                     list_committed_steps, load_for_serving,
+                     read_latest_pointer, staging_dir, step_dir,
+                     take_snapshot, validate_checkpoint_dir,
+                     write_committed_checkpoint)
 from .faults import (FaultInjector, Fs, InjectedCrash,  # noqa: F401
                      fault_injection, get_fault_injector, get_fs)
 from .manager import CheckpointManager  # noqa: F401

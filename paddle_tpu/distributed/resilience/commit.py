@@ -43,7 +43,8 @@ from .faults import get_fs
 __all__ = ["COMMITTED_MARKER", "FAILED_MARKER", "LATEST_POINTER",
            "HostSnapshot", "take_snapshot", "write_committed_checkpoint",
            "validate_checkpoint_dir", "latest_checkpoint",
-           "list_committed_steps", "step_dir", "staging_dir"]
+           "list_committed_steps", "step_dir", "staging_dir",
+           "CheckpointTransport", "LocalFsTransport", "load_for_serving"]
 
 COMMITTED_MARKER = "COMMITTED"
 FAILED_MARKER = "FAILED"
@@ -216,6 +217,117 @@ def latest_checkpoint(root: str) -> Optional[Tuple[int, str]]:
         if ok:
             return step, path
     return None
+
+
+class CheckpointTransport:
+    """Where committed checkpoints live, behind three methods.
+
+    The commit protocol above assumes one shared filesystem (rank files
+    meet in ``step_N.tmp``, resume reads ``step_N`` in place). This seam
+    is what lets a SERVING host on another machine consume the same
+    committed checkpoints training writes: ``resolve_latest`` finds the
+    newest validated step, ``fetch`` makes one committed step dir
+    locally readable, ``list_steps`` enumerates candidates. The local-fs
+    default is the identity transport; an object-store backend (download
+    into a local cache dir, validate, return the cache path) implements
+    the same three methods — that backend is the ROADMAP remainder, the
+    seam is what lands here."""
+
+    def list_steps(self, root: str):
+        """Candidate committed steps under ``root``: ``[(step, name)]``
+        newest first."""
+        raise NotImplementedError
+
+    def resolve_latest(self, root: str) -> Optional[Tuple[int, str]]:
+        """Newest committed VALIDATED checkpoint under ``root`` as
+        ``(step, path)`` — ``path`` is transport-scoped until
+        ``fetch``ed."""
+        raise NotImplementedError
+
+    def fetch(self, path: str) -> str:
+        """Make the committed checkpoint at transport-scoped ``path``
+        readable on the local filesystem; returns the local dir."""
+        raise NotImplementedError
+
+
+class LocalFsTransport(CheckpointTransport):
+    """The shared-filesystem default: paths are already local."""
+
+    def list_steps(self, root: str):
+        return list_committed_steps(root)
+
+    def resolve_latest(self, root: str) -> Optional[Tuple[int, str]]:
+        return latest_checkpoint(root)
+
+    def fetch(self, path: str) -> str:
+        return path
+
+
+def load_for_serving(path: str, target, *,
+                     transport: Optional[CheckpointTransport] = None
+                     ) -> int:
+    """Cold-start (or hot-swap) serving weights from a committed
+    training checkpoint.
+
+    ``path`` is either a checkpoint ROOT (the newest committed,
+    validated step is resolved — torn saves are skipped, exactly like
+    training resume) or one specific committed step dir (validated
+    before loading). ``target`` is a ``Layer`` — its live state-dict
+    tensors are loaded in place, so a serving host can swap weights
+    between steps without rebuilding servers — or a plain state dict.
+    Uses the same reshard-on-load path training resume uses, so a
+    single-host server restores shards a multi-host trainer wrote.
+    Name contract: the checkpoint must hold the names ``target``
+    exposes — a checkpoint of ``model.state_dict()`` loads into the
+    model directly; for a ``run_steps``-layout checkpoint
+    (``{"params": ..., "opt_state": ...}``) pass
+    ``target={"params": model.state_dict()}``.
+    Returns the loaded step. Raises ``FileNotFoundError`` when nothing
+    committed exists and ``ValueError`` for a torn/invalid step dir."""
+    transport = transport or LocalFsTransport()
+    base = os.path.basename(os.path.normpath(str(path)))
+    m = _STEP_DIR_RE.match(base)
+    if m:
+        step = int(m.group(1))
+        local = transport.fetch(str(path))
+        ok, why = validate_checkpoint_dir(local, expect_step=step)
+        if not ok:
+            raise ValueError(
+                f"checkpoint {path!r} is not a committed save: {why}")
+    else:
+        found = transport.resolve_latest(str(path))
+        if found is None:
+            raise FileNotFoundError(
+                f"no committed checkpoint under {path!r}")
+        step, remote = found
+        local = transport.fetch(remote)
+    sd = target.state_dict() if callable(getattr(target, "state_dict",
+                                                 None)) else target
+    # fail loudly on a name-contract mismatch BEFORE loading:
+    # load_state_dict tolerates missing keys (reference behavior), so a
+    # run_steps-layout checkpoint loaded into a bare model would
+    # otherwise "succeed" with zero tensors restored — and a whole fleet
+    # serving random weights still passes bitwise-parity drills
+    try:
+        with open(os.path.join(local, "metadata.json")) as f:
+            saved = set(json.load(f).get("state_dict_metadata", {}))
+    except (OSError, json.JSONDecodeError) as e:
+        raise ValueError(
+            f"checkpoint {path!r} metadata unreadable: {e}") from e
+    from ..checkpoint.utils import flatten_state_dict
+    flat, _mapping = flatten_state_dict(sd)
+    if saved and not (saved & set(flat)):
+        raise ValueError(
+            "checkpoint/target name mismatch: none of the "
+            f"{len(saved)} saved tensors match the target's "
+            f"{len(flat)} names (saved e.g. "
+            f"{sorted(saved)[:3]}, target e.g. "
+            f"{sorted(flat)[:3]}) — save model.state_dict(), or pass "
+            "target={'params': model.state_dict()} for a "
+            "run_steps-layout checkpoint")
+    from ..checkpoint.load_state_dict import load_state_dict
+    load_state_dict(sd, local)
+    return step
 
 
 def read_latest_pointer(root: str) -> Optional[str]:

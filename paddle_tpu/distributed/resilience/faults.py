@@ -29,6 +29,19 @@ a failing test can't leak an armed fault into the next):
   ``arm_backend_flap`` alternates dead/alive phases every ``period``
   consultations. ``heal_backend`` clears one backend's faults so breaker
   half-open recovery drills can bring it back.
+- **socket faults** — the wire-level siblings of the backend faults,
+  consulted by the serving transport's fault proxy
+  (``serving.transport.FaultProxy``) per accepted connection and per
+  forwarded chunk, so the PR 10 drills re-run across REAL sockets:
+  ``arm_socket_blackhole`` (new connects refused, established
+  connections park every byte until heal — the host that stops
+  answering without closing anything), ``arm_socket_reset`` (next
+  forwarded chunk hard-closes the connection with an RST — death
+  mid-stream), ``arm_socket_trickle`` (bytes dribble through at a
+  bounded rate — the pathological slow link), and ``arm_socket_flap``
+  (accepts alternate refuse/allow phases every ``period`` connection
+  attempts — the flapping link). ``heal_socket`` clears one proxy's
+  fault and releases parked forwarders.
 
 ``arm_slow_disk`` is the latency sibling of the kill injector: it delays
 every ``Fs`` write, which is how tests prove the write-behind thread —
@@ -144,10 +157,12 @@ class FaultInjector:
         self._hang_seen = 0
         self._dropped_heartbeats: set = set()
         self._backend_faults: dict = {}
+        self._socket_faults: dict = {}
         self.crashes = 0
         self.hangs_fired = 0
         self.heartbeats_dropped = 0
         self.backend_ops_faulted = 0
+        self.socket_ops_faulted = 0
 
     def reset(self) -> None:
         """Disarm everything and release any parked hang waiters."""
@@ -161,7 +176,8 @@ class FaultInjector:
     _SCOPED_FIELDS = ("_kill_at", "_kill_partial", "_write_count",
                       "_slow_disk_s", "_hang_match", "_hang_after",
                       "_hang_times", "_hang_seen", "crashes", "hangs_fired",
-                      "heartbeats_dropped", "backend_ops_faulted")
+                      "heartbeats_dropped", "backend_ops_faulted",
+                      "socket_ops_faulted")
 
     @contextlib.contextmanager
     def scoped(self):
@@ -177,6 +193,8 @@ class FaultInjector:
             saved["_dropped_heartbeats"] = set(self._dropped_heartbeats)
             saved["_backend_faults"] = {k: dict(v) for k, v in
                                         self._backend_faults.items()}
+            saved["_socket_faults"] = {k: dict(v) for k, v in
+                                       self._socket_faults.items()}
             self._hang_release.set()
             self._hang_release = threading.Event()
             self._reset_locked()
@@ -195,7 +213,8 @@ class FaultInjector:
             return (self._kill_at is not None or self._slow_disk_s > 0.0
                     or self._hang_match is not None
                     or bool(self._dropped_heartbeats)
-                    or bool(self._backend_faults))
+                    or bool(self._backend_faults)
+                    or bool(self._socket_faults))
 
     @property
     def writes_seen(self) -> int:
@@ -334,6 +353,86 @@ class FaultInjector:
             if mode == "slow":
                 return ("slow", st["seconds"])
             self.backend_ops_faulted += 1
+            release = self._hang_release
+        return ("hang",
+                lambda timeout: release.wait(
+                    min(float(timeout), self._HANG_MAX_S)))
+
+    # -- wire-level socket faults (consulted by transport.FaultProxy) ------
+    def arm_socket_blackhole(self, proxy_id: str) -> None:
+        """Blackhole the wire: new connection attempts are refused and
+        every byte on established connections parks until heal — the
+        host that stops answering without closing anything (the
+        socket-level sibling of ``arm_backend_hang``)."""
+        with self._lock:
+            self._socket_faults[str(proxy_id)] = {"mode": "blackhole"}
+
+    def arm_socket_reset(self, proxy_id: str) -> None:
+        """Hard-close every connection at its next forwarded chunk (RST,
+        not FIN) and refuse new ones — death mid-stream, the
+        socket-level sibling of ``arm_backend_kill``."""
+        with self._lock:
+            self._socket_faults[str(proxy_id)] = {"mode": "reset"}
+
+    def arm_socket_trickle(self, proxy_id: str,
+                           bytes_per_s: float) -> None:
+        """Dribble forwarded bytes through at ``bytes_per_s`` — the
+        pathologically slow link (degrades, never dies)."""
+        with self._lock:
+            self._socket_faults[str(proxy_id)] = {
+                "mode": "trickle", "bps": max(1.0, float(bytes_per_s))}
+
+    def arm_socket_flap(self, proxy_id: str, period: int = 3) -> None:
+        """Alternate refuse/allow phases every ``period`` connection
+        attempts, starting refused — the flapping link (established
+        connections are left alone; only connects flap)."""
+        with self._lock:
+            self._socket_faults[str(proxy_id)] = {
+                "mode": "flap", "period": max(1, int(period)), "count": 0}
+
+    def heal_socket(self, proxy_id: str) -> None:
+        """Clear one proxy's socket fault and release its parked
+        forwarders — the recovery half of a wire drill."""
+        with self._lock:
+            self._socket_faults.pop(str(proxy_id), None)
+            self._hang_release.set()
+            self._hang_release = threading.Event()
+
+    def socket_action(self, proxy_id: str, op: str):
+        """What an armed socket fault does to one proxy operation.
+        ``op`` is ``"accept"`` (a new inbound connection), ``"io"``
+        (one forwarded chunk), or ``"io-retry"`` (re-consult while a
+        chunk is parked — counted as the SAME faulted op, so
+        ``socket_ops_faulted`` stays one-per-operation like its
+        backend sibling). Returns ``None`` (healthy), ``("refuse",)``
+        (hard-close the connection now), ``("trickle", bytes_per_s)``
+        (forward at a bounded rate), or ``("hang", waiter)`` where
+        ``waiter(timeout)`` parks the forwarder and returns True iff
+        the fault was cleared (heal/reset) before the timeout."""
+        with self._lock:
+            st = self._socket_faults.get(str(proxy_id))
+            if st is None:
+                return None
+            mode = st["mode"]
+            if mode == "flap":
+                if op != "accept":
+                    return None     # only connects flap
+                n = st["count"]
+                st["count"] = n + 1
+                if (n // st["period"]) % 2 == 0:   # refused phase first
+                    self.socket_ops_faulted += 1
+                    return ("refuse",)
+                return None
+            if mode == "reset":
+                self.socket_ops_faulted += 1
+                return ("refuse",)
+            if mode == "trickle":
+                return None if op == "accept" else ("trickle", st["bps"])
+            # blackhole: refuse connects, park established-io until heal
+            if op != "io-retry":
+                self.socket_ops_faulted += 1
+            if op == "accept":
+                return ("refuse",)
             release = self._hang_release
         return ("hang",
                 lambda timeout: release.wait(

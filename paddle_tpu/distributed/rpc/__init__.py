@@ -20,8 +20,31 @@ from typing import Any, Dict, List, Optional
 from ..store import TCPStore
 
 __all__ = ["init_rpc", "shutdown", "rpc_sync", "rpc_async",
-           "get_worker_info", "get_all_worker_infos", "WorkerInfo", "get_current_worker_info",
-]
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo",
+           "get_current_worker_info",
+           # the blessed wire-RPC surface (serving.transport re-exports)
+           "RemoteBackend", "BackendServer", "FaultProxy", "FrameReader",
+           "send_msg", "WireError", "ConnectionClosedError", "FrameError",
+           "WIRE_VERSION"]
+
+# The serving wire transport (paddle_tpu/serving/transport/) is the one
+# full-duplex, streaming RPC implementation in this codebase; its
+# client/server primitives are re-exported here so there is a single
+# blessed RPC surface (the TCPStore-backed rpc_sync/rpc_async above stay
+# as the reference-parity control-plane API). Lazy via PEP 562: the
+# serving stack imports jax at module load, and distributed.rpc must
+# stay importable in minimal/control-plane contexts.
+_WIRE_EXPORTS = ("RemoteBackend", "BackendServer", "FaultProxy",
+                 "FrameReader", "send_msg", "WireError",
+                 "ConnectionClosedError", "FrameError", "WIRE_VERSION")
+
+
+def __getattr__(name):
+    if name in _WIRE_EXPORTS:
+        from ...serving import transport
+        return getattr(transport, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
 
 _PREFIX = "__rpc"
 

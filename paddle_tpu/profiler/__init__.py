@@ -30,7 +30,9 @@ __all__ = ["ProfilerState", "ProfilerTarget", "make_scheduler",
            "unregister_decode_source", "resilience_stats",
            "register_resilience_source", "unregister_resilience_source",
            "router_stats", "register_router_source",
-           "unregister_router_source", "export_stats"]
+           "unregister_router_source", "transport_stats",
+           "register_transport_source", "unregister_transport_source",
+           "export_stats"]
 
 
 class ProfilerState(Enum):
@@ -376,6 +378,7 @@ _pipeline_registry = _SourceRegistry("pipeline")
 _decode_registry = _SourceRegistry("decode")
 _resilience_registry = _SourceRegistry("resilience")
 _router_registry = _SourceRegistry("router")
+_transport_registry = _SourceRegistry("transport")
 
 
 def register_serving_source(name: str, metrics) -> None:
@@ -515,6 +518,30 @@ def router_stats(name: Optional[str] = None):
     return _router_registry.stats(name)
 
 
+def register_transport_source(name: str, metrics) -> None:
+    """Register a wire-transport metrics source (an object with
+    .snapshot()). Called by serving.transport.RemoteBackend /
+    BackendServer on construction."""
+    _transport_registry.register(name, metrics)
+
+
+def unregister_transport_source(name: str, metrics=None) -> None:
+    """Remove a transport source (only if it still points at
+    ``metrics``, when given)."""
+    _transport_registry.unregister(name, metrics)
+
+
+def transport_stats(name: Optional[str] = None):
+    """Snapshot of wire-transport metrics: bytes in/out, connects /
+    reconnects / disconnects, frame errors, per-RPC round-trip latency,
+    streamed tokens, deadline sheds — per registered transport endpoint
+    (RemoteBackend clients and BackendServer hosts).
+
+    Returns ``{endpoint_name: snapshot_dict}``, or one snapshot when
+    ``name`` is given (KeyError when that endpoint is gone)."""
+    return _transport_registry.stats(name)
+
+
 def _flatten_scrape(prefix: str, value, out: list) -> None:
     """dict/number tree -> ``name value`` exposition lines (labels are
     flattened into the metric name; non-numeric leaves are dropped —
@@ -545,7 +572,7 @@ def export_stats(format: str = "dict"):
     """
     data = {"pipeline": pipeline_stats(), "serving": serving_stats(),
             "decode": decode_stats(), "resilience": resilience_stats(),
-            "router": router_stats()}
+            "router": router_stats(), "transport": transport_stats()}
     if format == "dict":
         return data
     if format == "json":

@@ -32,10 +32,13 @@ from .router import (BackendUnavailable, InProcessBackend,  # noqa: F401
                      Router, RouterOverloaded)
 from .server import (DeadlineExceeded, Server, ServerClosed,  # noqa: F401
                      ServerOverloaded, ServingError)
+from . import transport  # noqa: F401  (after router: it builds on it)
+from .transport import BackendServer, RemoteBackend  # noqa: F401
 
 __all__ = ["Server", "ServingError", "ServerOverloaded", "DeadlineExceeded",
            "ServerClosed", "Future", "ServingMetrics", "Histogram",
            "pow2_buckets", "page_buckets", "next_bucket",
            "next_bucket_strict", "BucketOverflow", "decode",
            "DecodeServer", "DecodeStream", "router", "Router",
-           "InProcessBackend", "RouterOverloaded", "BackendUnavailable"]
+           "InProcessBackend", "RouterOverloaded", "BackendUnavailable",
+           "transport", "RemoteBackend", "BackendServer"]

@@ -384,6 +384,35 @@ class DecodeServer(ServerLifecycleMixin):
     def queue_depth(self) -> int:
         return self._queue.qsize()
 
+    def cancel(self, stream: DecodeStream) -> bool:
+        """Best-effort server-side cancel of one in-flight request,
+        identified by its stream: the request's deadline is forced into
+        the past, so the worker expires it at its next step (settling
+        the stream as DeadlineExceeded, pages freed). Used by the wire
+        transport when a remote client disconnects or abandons a stream
+        after failover — the engine stops spending decode steps on
+        tokens nobody will read. Returns False when the stream is
+        already settled or unknown."""
+        # a request in transit between the queue pop and its slot
+        # install is visible to neither scan — re-scan a few times so
+        # the admission window (pure host bookkeeping, microseconds)
+        # cannot orphan the stream
+        for attempt in range(3):
+            if stream.done():
+                return False
+            if self._queue.expire_stream(stream):
+                return True
+            # slot entries flip atomically between None and a Slot (the
+            # active_slots contract); forcing req.deadline from this
+            # thread is a benign cross-thread store the worker re-reads
+            # every step
+            for slot in list(self._sched.slots):
+                if slot is not None and slot.req.stream is stream:
+                    slot.req.deadline = time.monotonic() - 1.0
+                    return True
+            time.sleep(0.002)
+        return False
+
     def active_slots(self) -> int:
         """Running sequences right now (a cross-thread occupancy sample;
         the serving router reads it for weighted-least-loaded placement)."""

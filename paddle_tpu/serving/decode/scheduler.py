@@ -231,6 +231,17 @@ class AdmissionQueue:
         with self._cond:
             return self._q[0] if self._q else None
 
+    def expire_stream(self, stream) -> bool:
+        """Force-expire the queued request owning ``stream`` (the
+        transport-side cancel: the remote client abandoned it). It
+        settles as DeadlineExceeded at the next pop."""
+        with self._cond:
+            for r in self._q:
+                if r.stream is stream:
+                    r.deadline = time.monotonic() - 1.0
+                    return True
+        return False
+
     def wait_nonempty(self, timeout: float) -> bool:
         with self._cond:
             if self._q:

@@ -946,7 +946,14 @@ class Router(ServerLifecycleMixin):
                 return "died", exc
             try:
                 tok = bs.next_token(i, timeout=self._relay_poll_s)
-            except DeadlineExceeded:
+            except DeadlineExceeded as exc:
+                if bs.done():
+                    # the BACKEND stream's terminal state is itself a
+                    # DeadlineExceeded (host-side deadline config,
+                    # server-side cancel) — a backend failure to the
+                    # router, which owns the request deadline: fail
+                    # over instead of spinning on the settled stream
+                    return "died", exc
                 continue            # poll tick: re-check liveness/expiry
             except ServingError as exc:
                 return "died", exc  # stream failed terminally host-side

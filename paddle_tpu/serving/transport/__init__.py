@@ -1,0 +1,41 @@
+"""paddle_tpu.serving.transport — the serving stack's remote transport.
+
+Stdlib-only wire protocol (length-prefixed pickle frames over TCP — see
+``wire.py``) implementing the router's exact five-method ``Backend``
+protocol across process and machine boundaries:
+
+- ``RemoteBackend`` — the client half: every wait bounded (a dead host
+  is ``BackendDied``, never a hang), decode tokens streamed
+  frame-by-frame into the router's existing relay loop, deadline
+  propagation in request metadata, keepalive-based liveness so a
+  blackholed host is detected, reconnection driven by the health
+  prober.
+- ``BackendServer`` — the host half: fronts a warm ``Server`` /
+  ``DecodeServer`` behind a listener; usually run via the standalone
+  ``python -m paddle_tpu.serving.host`` entrypoint (SIGTERM =
+  drain-then-exit).
+- ``FaultProxy`` — wire-level fault injection (blackhole / reset /
+  trickle / flap) driven by ``distributed.resilience.faults``, so the
+  router's kill/hang/flap drills run over real sockets.
+
+Topology::
+
+    client ─► Router ─► RemoteBackend ══ TCP ══ BackendServer ─► DecodeServer
+                   │                                └─► Server      (warm)
+                   └─► RemoteBackend ══ TCP ══ ... (one per host process)
+
+Metrics: ``profiler.transport_stats()`` (bytes in/out, reconnects,
+frame errors, per-RPC latency) inside ``profiler.export_stats()``.
+These primitives are also re-exported as the blessed RPC surface at
+``paddle_tpu.distributed.rpc``.
+"""
+from .client import RemoteBackend  # noqa: F401
+from .metrics import TransportMetrics  # noqa: F401
+from .proxy import FaultProxy  # noqa: F401
+from .server import BackendServer  # noqa: F401
+from .wire import (WIRE_VERSION, ConnectionClosedError,  # noqa: F401
+                   FrameError, FrameReader, WireError, send_msg)
+
+__all__ = ["RemoteBackend", "BackendServer", "FaultProxy",
+           "TransportMetrics", "WireError", "ConnectionClosedError",
+           "FrameError", "FrameReader", "send_msg", "WIRE_VERSION"]

@@ -1,0 +1,591 @@
+"""``RemoteBackend`` — the router's ``Backend`` protocol over a real
+socket.
+
+One TCP connection per backend, one receiver thread demultiplexing
+reply frames to pending requests by request id: one-shots settle a
+``Future``, decode tokens stream frame-by-frame into a ``DecodeStream``
+(the same object the router's relay loop already consumes), probes
+round-trip a ping. Every wait is bounded:
+
+- enqueue round-trips (submit/decode acks, probes, config) are bounded
+  by ``op_timeout_s`` / the probe timeout — a dead host surfaces as
+  ``BackendDied``, never a hang;
+- a host that stops answering WITHOUT closing the connection (the
+  blackhole case) is caught by liveness: a keepalive thread pings every
+  ``keepalive_s`` and ``check_alive`` raises once nothing — pong, token,
+  or any other frame — has arrived within ``liveness_timeout_s``;
+- a killed host (RST/FIN) fails the receiver immediately, which fails
+  every pending future and stream with ``BackendDied``.
+
+Reconnection happens on the PROBE path only (plus the construction-time
+``bucket_config`` fetch): a dead backend stays dead for requests until
+the router's health prober revives it, which is exactly how the
+breaker's half-open recovery is supposed to find it.
+
+Deadline propagation: ``submit`` forwards the remaining
+``deadline_ms`` in the request frame, so the host sheds work the client
+has already given up on. ``submit_decode`` deliberately forwards NO
+deadline — the router owns stream deadlines across failovers (a
+host-side expiry would settle a stream the router still wants to
+resume); abandoning a stream is signalled with a ``cancel`` frame
+instead.
+"""
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+import time
+from typing import Optional, Sequence
+
+from ..batcher import DeadlineExceeded, Future, ServerClosed
+from ..decode.scheduler import DecodeStream
+from ..router.backend import Backend
+from ..router.errors import BackendDied
+from .metrics import TransportMetrics
+from .wire import WIRE_VERSION, FrameReader, WireError, send_msg
+
+__all__ = ["RemoteBackend"]
+
+_client_ids = itertools.count()
+
+
+def parse_address(address) -> tuple:
+    """``(host, port)`` from a tuple or a ``"host:port"`` string."""
+    if isinstance(address, (tuple, list)):
+        return str(address[0]), int(address[1])
+    host, port = str(address).rsplit(":", 1)
+    return host, int(port)
+
+
+class RemoteBackend(Backend):
+    """One remote serving host behind the five-method ``Backend``
+    protocol (see ``router.backend``), over the stdlib TCP wire.
+
+    Example::
+
+        backends = [RemoteBackend(f"host{i}", addr)
+                    for i, addr in enumerate(host_addresses)]
+        with Router(backends, close_backends=True) as router:
+            stream = router.submit_decode(prompt, max_new_tokens=32)
+
+    Parameters
+    ----------
+    backend_id: the router-visible id (health, breaker, sticky keys).
+    address: ``(host, port)`` or ``"host:port"`` of a ``BackendServer``
+        (usually a ``python -m paddle_tpu.serving.host`` process).
+    connect_timeout_s: bound on one TCP connect + hello handshake.
+    op_timeout_s: bound on one enqueue round-trip (submit ack, config).
+    liveness_timeout_s: how long the wire may be silent before
+        ``check_alive`` declares the host dead (keepalive pings flow
+        every ``keepalive_s``, so a healthy idle connection is never
+        silent this long).
+    keepalive_s: ping cadence (also refreshes the cached load score).
+    lazy: don't connect in the constructor (the first probe connects).
+    """
+
+    def __init__(self, backend_id: str, address, *,
+                 connect_timeout_s: float = 5.0, op_timeout_s: float = 5.0,
+                 liveness_timeout_s: float = 1.0,
+                 keepalive_s: float = 0.2, lazy: bool = False,
+                 name: Optional[str] = None):
+        self.backend_id = str(backend_id)
+        self._addr = parse_address(address)
+        self._connect_timeout_s = float(connect_timeout_s)
+        self._op_timeout_s = float(op_timeout_s)
+        self._liveness_timeout_s = float(liveness_timeout_s)
+        self._keepalive_s = float(keepalive_s)
+        self._poll_s = 0.05
+        self.name = name or f"wire_client_{self.backend_id}" \
+                            f"_{next(_client_ids)}"
+        self._metrics = TransportMetrics(self.name)
+
+        self._rids = itertools.count()
+        self._send_lock = threading.Lock()   # frames never interleave
+        self._connect_lock = threading.Lock()
+        self._ever_connected = False         # guarded by _connect_lock
+        # _lock guards everything else that is shared with the receiver
+        # and keepalive threads
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._gen = 0               # bumped per (re)connect and on close
+        self._dead = True
+        self._dead_reason: Optional[str] = "never connected"
+        self._last_rx = 0.0
+        self._last_load = 0.0
+        self._bucket_cfg: Optional[dict] = None
+        self._pending: dict = {}    # rid -> entry dict
+        self._closed = False
+
+        from ...profiler import register_transport_source
+        register_transport_source(self.name, self._metrics)
+        self._metrics.set_depth_gauge(self._pending_depth)
+        self._keepalive = threading.Thread(
+            target=self._keepalive_loop, name=f"{self.name}_keepalive",
+            daemon=True)
+        self._keepalive.start()
+        if not lazy:
+            try:
+                self._ensure_connected(self._connect_timeout_s)
+            except BaseException:
+                self.close()    # release the keepalive + registry entry
+                raise
+
+    # -- connection management ---------------------------------------------
+    def _pending_depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def _ensure_connected(self, timeout: float) -> None:
+        """Connect + handshake if there is no live connection. Raises
+        ``BackendDied`` on failure, ``ServerClosed`` after close()."""
+        with self._connect_lock:
+            with self._lock:
+                if self._closed:
+                    raise ServerClosed(f"transport to {self.backend_id!r} "
+                                       "is closed")
+                if self._sock is not None and not self._dead:
+                    return
+                reconnect = self._ever_connected
+            timeout = max(0.05, float(timeout))
+            try:
+                sock = socket.create_connection(self._addr,
+                                                timeout=timeout)
+            except OSError as e:
+                raise BackendDied(
+                    f"backend {self.backend_id!r} unreachable at "
+                    f"{self._addr[0]}:{self._addr[1]}: {e!r}") from None
+            sock.settimeout(self._poll_s)
+            reader = FrameReader(sock, self._metrics)
+            end = time.monotonic() + timeout
+            try:
+                send_msg(sock, ("hello", WIRE_VERSION),
+                         metrics=self._metrics)
+                msg = None
+                while msg is None:
+                    if time.monotonic() > end:
+                        raise BackendDied(
+                            f"backend {self.backend_id!r} accepted the "
+                            f"connection but sent no hello within "
+                            f"{timeout:.2f}s")
+                    msg = reader.poll()
+            except (WireError, OSError) as e:
+                sock.close()
+                raise BackendDied(
+                    f"handshake with {self.backend_id!r} failed: "
+                    f"{e!r}") from None
+            except BackendDied:
+                sock.close()
+                raise
+            if not (isinstance(msg, tuple) and msg and msg[0] == "hello"):
+                sock.close()
+                if isinstance(msg, tuple) and msg and msg[0] == "error":
+                    raise BackendDied(
+                        f"backend {self.backend_id!r} refused the "
+                        f"handshake: {msg[-1]}")
+                raise BackendDied(
+                    f"backend {self.backend_id!r} sent a non-hello "
+                    f"first frame: {msg!r}")
+            info = msg[1] if len(msg) > 1 and isinstance(msg[1], dict) \
+                else {}
+            if info.get("version") != WIRE_VERSION:
+                sock.close()
+                raise BackendDied(
+                    f"backend {self.backend_id!r} speaks wire version "
+                    f"{info.get('version')!r}, this client speaks "
+                    f"{WIRE_VERSION} — mismatched deployments")
+            with self._lock:
+                if self._closed:
+                    # close() raced this connect (its _lock pass beat
+                    # ours): installing the socket would leak it live
+                    # on a closed transport
+                    sock.close()
+                    raise ServerClosed(
+                        f"transport to {self.backend_id!r} is closed")
+                self._gen += 1
+                gen = self._gen
+                self._sock = sock
+                self._dead = False
+                self._dead_reason = None
+                self._last_rx = time.monotonic()
+                self._last_load = float(info.get("load", 0.0))
+                if self._bucket_cfg is None:
+                    self._bucket_cfg = info.get("bucket_config")
+            self._metrics.inc("reconnects" if reconnect else "connects")
+            self._ever_connected = True
+            threading.Thread(target=self._recv_loop,
+                             args=(reader, gen),
+                             name=f"{self.name}_recv{gen}",
+                             daemon=True).start()
+
+    def _conn_died(self, gen: int, reason: str) -> None:
+        """Mark connection ``gen`` dead and fail everything pending on
+        it. A stale generation (already superseded by a reconnect) is a
+        no-op, so an old receiver can never kill a new connection."""
+        with self._lock:
+            if gen != self._gen:
+                return
+            if self._dead and not self._pending:
+                return
+            self._dead = True
+            self._dead_reason = reason
+            sock = self._sock
+            self._sock = None
+            entries = list(self._pending.values())
+            self._pending.clear()
+        self._metrics.inc("disconnects")
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        exc = BackendDied(f"backend {self.backend_id!r}: {reason}")
+        for e in entries:
+            self._settle_dead_entry(e, exc)
+
+    @staticmethod
+    def _settle_dead_entry(entry: dict, exc: BaseException) -> None:
+        if entry.get("stream") is not None:
+            entry["stream"]._fail(exc)
+        if entry.get("fut") is not None:
+            entry["fut"].set_exception(exc)
+        entry["ack"].set()
+
+    # -- receiver / keepalive (graft_lint hot-path roots) ------------------
+    def _recv_loop(self, reader: FrameReader, gen: int) -> None:
+        """Demultiplex reply frames for connection ``gen`` until it dies
+        or is superseded."""
+        while True:
+            with self._lock:
+                if self._closed or self._gen != gen:
+                    return
+            try:
+                msg = reader.poll()
+            except (WireError, OSError) as e:
+                self._conn_died(gen, f"connection lost: {e!r}")
+                return
+            if msg is None:
+                continue
+            try:
+                self._on_msg(msg)
+            except Exception as e:   # noqa: BLE001 — receiver must survive
+                self._metrics.inc("frame_errors")
+                del e
+
+    def _on_msg(self, msg) -> None:
+        if not isinstance(msg, tuple) or not msg:
+            self._metrics.inc("frame_errors")
+            return
+        kind = msg[0]
+        settle = None
+        with self._lock:
+            self._last_rx = time.monotonic()
+            if kind == "pong":
+                _, rid, load = msg
+                self._last_load = float(load)
+                entry = self._pending.pop(rid, None)
+                if entry is not None:
+                    settle = (entry, "result", float(load))
+            elif kind == "ack":
+                entry = self._pending.get(msg[1])
+                if entry is not None:
+                    entry["ack"].set()
+            elif kind == "reject":
+                _, rid, exc = msg
+                entry = self._pending.pop(rid, None)
+                if entry is not None:
+                    entry["rejected"] = exc
+                    settle = (entry, "exc", exc)
+            elif kind == "error":
+                _, rid, exc = msg
+                entry = self._pending.pop(rid, None)
+                if entry is not None:
+                    settle = (entry, "exc", exc)
+            elif kind == "result":
+                _, rid, payload = msg
+                entry = self._pending.pop(rid, None)
+                if entry is not None:
+                    settle = (entry, "result", payload)
+            elif kind == "tok":
+                entry = self._pending.get(msg[1])
+                if entry is not None and entry.get("stream") is not None:
+                    settle = (entry, "tok", msg[2])
+            elif kind == "fin":
+                entry = self._pending.pop(msg[1], None)
+                if entry is not None:
+                    settle = (entry, "fin", msg[2])
+        if settle is None:
+            return
+        entry, what, value = settle
+        # settle OUTSIDE the lock: stream/future notification wakes
+        # client threads that may immediately call back in
+        if what == "tok":
+            entry["stream"]._put(value)
+        elif what == "fin":
+            if entry.get("stream") is not None:
+                entry["stream"]._finish(value)
+            entry["ack"].set()
+        elif what == "result":
+            if entry.get("fut") is not None:
+                entry["fut"].set_result(value)
+            entry["ack"].set()
+        else:
+            self._metrics.inc("rpc_failures")
+            self._settle_dead_entry(entry, value)
+
+    def _keepalive_loop(self) -> None:
+        """Ping the host every ``keepalive_s`` so liveness staleness is
+        meaningful on an idle connection (and the cached load score
+        stays fresh). Fire-and-forget: pongs for rid -1 just refresh
+        ``_last_rx``/``_last_load``."""
+        while True:
+            with self._lock:
+                if self._closed:
+                    return
+                sock = None if self._dead else self._sock
+                gen = self._gen
+            if sock is not None:
+                try:
+                    send_msg(sock, ("ping", -1), lock=self._send_lock,
+                             metrics=self._metrics)
+                except (WireError, OSError) as e:
+                    self._conn_died(gen, f"keepalive send failed: {e!r}")
+            time.sleep(self._keepalive_s)
+
+    # -- request plumbing --------------------------------------------------
+    def _register(self, kind: str) -> tuple:
+        entry = {"kind": kind, "ack": threading.Event(), "fut": None,
+                 "stream": None, "rejected": None}
+        if kind in ("oneshot", "probe", "rpc"):
+            entry["fut"] = Future()
+        elif kind == "decode":
+            entry["stream"] = DecodeStream()
+        rid = next(self._rids)
+        with self._lock:
+            if self._closed:
+                raise ServerClosed(f"transport to {self.backend_id!r} "
+                                   "is closed")
+            if self._dead:
+                raise BackendDied(
+                    f"backend {self.backend_id!r} is dead "
+                    f"({self._dead_reason})")
+            gen = self._gen
+            self._pending[rid] = entry
+        return rid, entry, gen
+
+    def _unregister(self, rid: int) -> None:
+        with self._lock:
+            self._pending.pop(rid, None)
+
+    def _send(self, msg, gen: int) -> None:
+        with self._lock:
+            sock = None if self._dead or self._gen != gen else self._sock
+        if sock is None:
+            raise BackendDied(
+                f"backend {self.backend_id!r} connection is gone")
+        try:
+            send_msg(sock, msg, lock=self._send_lock,
+                     metrics=self._metrics)
+        except (WireError, OSError) as e:
+            self._conn_died(gen, f"send failed: {e!r}")
+            raise BackendDied(
+                f"backend {self.backend_id!r} died mid-send: "
+                f"{e!r}") from None
+
+    def _await_ack(self, rid: int, entry: dict, gen: int,
+                   what: str) -> None:
+        """Bounded wait for the host's enqueue acknowledgement; a
+        rejection raises the host's own typed error synchronously."""
+        if not entry["ack"].wait(self._op_timeout_s):
+            self._unregister(rid)
+            self._conn_died(gen, f"no {what} ack within "
+                                 f"{self._op_timeout_s:.2f}s")
+            raise BackendDied(
+                f"backend {self.backend_id!r} sent no {what} ack within "
+                f"{self._op_timeout_s:.2f}s")
+        with self._lock:
+            rejected = entry["rejected"]
+        if rejected is not None:
+            raise rejected
+
+    def _rpc(self, msg_kind: str, timeout: Optional[float] = None):
+        """One request/result round-trip (config, stats, probe pings go
+        through their own paths). Bounded by ``timeout``."""
+        timeout = self._op_timeout_s if timeout is None else float(timeout)
+        rid, entry, gen = self._register("rpc")
+        t0 = time.monotonic()
+        try:
+            self._send((msg_kind, rid), gen)
+            out = entry["fut"].result(timeout)
+        except DeadlineExceeded:
+            self._unregister(rid)
+            raise BackendDied(
+                f"backend {self.backend_id!r} did not answer "
+                f"{msg_kind!r} within {timeout:.2f}s") from None
+        self._metrics.inc("rpcs")
+        self._metrics.observe("rpc_ms", (time.monotonic() - t0) * 1e3)
+        return out
+
+    # -- Backend protocol --------------------------------------------------
+    def bucket_config(self) -> dict:
+        with self._lock:
+            cfg = self._bucket_cfg
+        if cfg is not None:
+            return cfg
+        self._ensure_connected(self._connect_timeout_s)
+        with self._lock:
+            cfg = self._bucket_cfg
+        if cfg is None:
+            cfg = self._rpc("bucket_config")
+            with self._lock:
+                self._bucket_cfg = cfg
+        return cfg
+
+    def submit(self, args: Sequence, deadline_ms: Optional[float] = None):
+        rid, entry, gen = self._register("oneshot")
+        t0 = time.monotonic()
+        try:
+            self._send(("submit", rid, tuple(args), deadline_ms), gen)
+            self._await_ack(rid, entry, gen, "submit")
+        except BaseException:
+            self._unregister(rid)
+            raise
+        self._metrics.inc("rpcs")
+        self._metrics.observe("submit_ms", (time.monotonic() - t0) * 1e3)
+        return entry["fut"]
+
+    def submit_decode(self, prompt, *, max_new_tokens: int,
+                      eos_id: Optional[int] = None):
+        rid, entry, gen = self._register("decode")
+        t0 = time.monotonic()
+        try:
+            # deadline deliberately None on the wire: the router owns
+            # stream deadlines across failovers (see module docstring)
+            self._send(("decode", rid, prompt, int(max_new_tokens),
+                        eos_id, None), gen)
+            self._await_ack(rid, entry, gen, "decode")
+        except BaseException:
+            self._unregister(rid)
+            raise
+        self._metrics.inc("rpcs")
+        self._metrics.observe("decode_ack_ms",
+                              (time.monotonic() - t0) * 1e3)
+        return entry["stream"]
+
+    def cancel_decode(self, stream: DecodeStream) -> None:
+        """Best-effort abandon of a stream this backend is serving
+        (failover happened elsewhere; stop burning steps on it)."""
+        with self._lock:
+            rid = None
+            for r, e in self._pending.items():
+                if e.get("stream") is stream:
+                    rid = r
+                    break
+            if rid is not None:
+                del self._pending[rid]
+            gen = self._gen
+        if rid is None:
+            return
+        self._metrics.inc("cancels")
+        try:
+            self._send(("cancel", rid), gen)
+        except BackendDied:
+            pass        # dead host needs no cancel
+
+    def check_alive(self) -> None:
+        with self._lock:
+            if self._closed:
+                raise BackendDied(
+                    f"transport to {self.backend_id!r} is closed")
+            if self._dead:
+                raise BackendDied(
+                    f"backend {self.backend_id!r} is dead "
+                    f"({self._dead_reason})")
+            stale = time.monotonic() - self._last_rx
+            gen = self._gen
+        if stale > self._liveness_timeout_s:
+            reason = (f"no frames for {stale:.2f}s "
+                      f"(> liveness {self._liveness_timeout_s:.2f}s; "
+                      "blackholed?)")
+            self._conn_died(gen, reason)
+            raise BackendDied(f"backend {self.backend_id!r}: {reason}")
+
+    def probe(self, timeout: float) -> float:
+        """Active probe: (re)connect if needed, then one ping/pong
+        round-trip — the ONLY path that revives a dead connection, so
+        recovery is driven by the router's health prober."""
+        t0 = time.monotonic()
+        timeout = max(1e-3, float(timeout))
+        self._ensure_connected(timeout)
+        rid, entry, gen = self._register("probe")
+        try:
+            self._send(("ping", rid), gen)
+            remaining = timeout - (time.monotonic() - t0)
+            entry["fut"].result(max(1e-3, remaining))
+        except DeadlineExceeded:
+            self._unregister(rid)
+            reason = f"probe unanswered within {timeout:.2f}s"
+            # an unanswered probe fails the PROBE (health prober counts
+            # it), but only a wire silent past the liveness window kills
+            # the connection — a pong merely delayed under load must not
+            # nuke healthy in-flight streams on this host
+            with self._lock:
+                stale = time.monotonic() - self._last_rx
+            if stale > self._liveness_timeout_s:
+                self._conn_died(gen, f"{reason}; no frames for "
+                                     f"{stale:.2f}s")
+            raise BackendDied(
+                f"backend {self.backend_id!r}: {reason}") from None
+        lat = time.monotonic() - t0
+        self._metrics.observe("probe_ms", lat * 1e3)
+        return lat
+
+    def load(self) -> float:
+        # best-effort and non-blocking by contract: the cached score
+        # from the last pong (keepalives refresh it every keepalive_s)
+        with self._lock:
+            return self._last_load
+
+    @property
+    def metrics(self) -> TransportMetrics:
+        return self._metrics
+
+    def host_stats(self, timeout: Optional[float] = None) -> dict:
+        """The remote host's metrics snapshot (decode/one-shot server
+        stats incl. compile counts, plus its transport metrics) — what
+        the wire drills pin their zero-new-compiles assertions on."""
+        return self._rpc("stats", timeout)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._gen += 1      # stops receiver loops at their next tick
+            sock = self._sock
+            self._sock = None
+            self._dead = True
+            self._dead_reason = "transport closed"
+            entries = list(self._pending.values())
+            self._pending.clear()
+        exc = ServerClosed(f"transport to {self.backend_id!r} closed")
+        for e in entries:
+            self._settle_dead_entry(e, exc)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._keepalive.join(timeout=2 * self._keepalive_s + 1.0)
+        from ...profiler import unregister_transport_source
+        unregister_transport_source(self.name, self._metrics)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __repr__(self) -> str:
+        return (f"RemoteBackend({self.backend_id!r}, "
+                f"{self._addr[0]}:{self._addr[1]})")

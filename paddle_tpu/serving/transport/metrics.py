@@ -1,0 +1,45 @@
+"""Wire-transport observability, surfaced via
+``profiler.transport_stats()`` and the combined ``export_stats()``
+scrape — wire health lives next to the router/decode/resilience
+registries so one scrape answers "is the fleet healthy AND is the wire
+healthy"."""
+from __future__ import annotations
+
+from ...profiler.metrics import MetricsBase
+
+__all__ = ["TransportMetrics"]
+
+
+class TransportMetrics(MetricsBase):
+    """Thread-safe counters/histograms for one transport endpoint
+    (a ``RemoteBackend`` client or a ``BackendServer`` host).
+
+    Counters: connects / reconnects (client re-established a dead
+    connection), disconnects (connections that died or closed),
+    frames_sent / frames_received, bytes_sent / bytes_received,
+    frame_errors (malformed frames), rpcs / rpc_failures,
+    tokens_streamed (decode tokens relayed over the wire),
+    deadline_shed (requests the host refused because the client's
+    propagated deadline had already passed), cancels (streams abandoned
+    by the peer).
+    Histograms: per-RPC round-trip latency — rpc_ms (all methods
+    combined), probe_ms, submit_ms, decode_ack_ms — plus stream_tokens
+    (tokens per relayed decode stream).
+    Gauge: open connections (host) / in-flight RPCs (client).
+    """
+
+    COUNTERS = ("connects", "reconnects", "disconnects", "frames_sent",
+                "frames_received", "bytes_sent", "bytes_received",
+                "frame_errors", "rpcs", "rpc_failures",
+                "tokens_streamed", "deadline_shed", "cancels")
+    HISTS = ("rpc_ms", "probe_ms", "submit_ms", "decode_ack_ms",
+             "stream_tokens")
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out: dict = dict(self._counters)
+            out["name"] = self.name
+            for k, h in self._hists.items():
+                out[k] = h.snapshot()
+        out["depth"] = self._read_gauge()
+        return out

@@ -1,0 +1,226 @@
+"""``FaultProxy`` — a wire-level fault injector for transport drills.
+
+Sits between a ``RemoteBackend`` and a ``BackendServer`` (or a real
+``serving.host`` process) and forwards bytes verbatim — until the
+process-global ``FaultInjector``'s socket faults are armed for its
+``proxy_id``:
+
+- ``arm_socket_blackhole``: new connects are hard-closed; established
+  connections park every byte until ``heal_socket`` — the host that
+  stops answering without closing anything (probes time out, liveness
+  goes stale, streams fail over).
+- ``arm_socket_reset``: every connection hard-closes (RST via
+  SO_LINGER-0) at its next forwarded chunk, and new connects are
+  refused — host death mid-stream.
+- ``arm_socket_trickle``: forwarded bytes dribble through at a bounded
+  rate — the pathological slow link (degrades, never dies).
+- ``arm_socket_flap``: connection attempts alternate refused/allowed
+  phases — the flapping link.
+
+The drills in ``tests/test_zz_serving_wire.py`` run the PR 10
+kill/hang/flap scenarios through this proxy over real sockets and pin
+the same guarantees: bitwise-identical resumed greedy streams,
+exactly-once delivery, zero new executables at failover.
+"""
+from __future__ import annotations
+
+import itertools
+import socket
+import struct
+import threading
+from typing import Optional
+
+__all__ = ["FaultProxy"]
+
+_proxy_ids = itertools.count()
+
+
+def _injector():
+    try:
+        from ...distributed.resilience.faults import get_fault_injector
+    except Exception:  # pragma: no cover - harness always present here
+        return None
+    return get_fault_injector()
+
+
+def _hard_close(sock: socket.socket) -> None:
+    """Close with an RST (SO_LINGER 0), so the peer sees a reset — a
+    crash, not a polite FIN."""
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class FaultProxy:
+    """TCP pass-through proxy consulting the fault injector's socket
+    faults per accepted connection and per forwarded chunk.
+
+    Example::
+
+        proxy = FaultProxy(backend_server.address, proxy_id="host0")
+        backend = RemoteBackend("host0", proxy.address)
+        ...
+        get_fault_injector().arm_socket_reset("host0")   # the drill
+    """
+
+    def __init__(self, target, *, proxy_id: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 poll_s: float = 0.1, chunk_bytes: int = 65536):
+        from .client import parse_address
+        self._target = parse_address(target)
+        self.proxy_id = str(proxy_id if proxy_id is not None
+                            else f"sockproxy{next(_proxy_ids)}")
+        self._poll_s = float(poll_s)
+        self._chunk = int(chunk_bytes)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(64)
+        self._listener.settimeout(self._poll_s)
+        self.address = self._listener.getsockname()
+        self._lock = threading.Lock()
+        self._socks: set = set()
+        self._stop = threading.Event()
+        self._acceptor = threading.Thread(target=self._accept_loop,
+                                          name=f"proxy_{self.proxy_id}",
+                                          daemon=True)
+        self._acceptor.start()
+
+    def _action(self, op: str):
+        inj = _injector()
+        if inj is None or not inj.armed:
+            return None
+        return inj.socket_action(self.proxy_id, op)
+
+    def _track(self, *socks) -> None:
+        with self._lock:
+            self._socks.update(socks)
+
+    def _untrack_and_close(self, *socks) -> None:
+        with self._lock:
+            for s in socks:
+                self._socks.discard(s)
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    # -- loops (graft_lint hot-path roots) ---------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _peer = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            act = self._action("accept")
+            if act is not None and act[0] == "refuse":
+                _hard_close(conn)
+                continue
+            try:
+                upstream = socket.create_connection(self._target,
+                                                    timeout=2.0)
+            except OSError:
+                _hard_close(conn)
+                continue
+            conn.settimeout(self._poll_s)
+            upstream.settimeout(self._poll_s)
+            self._track(conn, upstream)
+            for src, dst in ((conn, upstream), (upstream, conn)):
+                threading.Thread(target=self._pump, args=(src, dst),
+                                 name=f"proxy_{self.proxy_id}_pump",
+                                 daemon=True).start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+        """Forward one direction until EOF/reset/shutdown, applying the
+        armed socket fault to every chunk."""
+        while not self._stop.is_set():
+            try:
+                data = src.recv(self._chunk)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not data:
+                break
+            faulted = False
+            op = "io"
+            while not self._stop.is_set():
+                act = self._action(op)
+                op = "io-retry"     # re-consults of the SAME parked chunk
+                if act is None:
+                    break
+                if act[0] == "refuse":
+                    # armed reset: die mid-stream with a genuine RST
+                    # (SO_LINGER 0), not a polite FIN — the drill must
+                    # exercise crash semantics, not graceful shutdown
+                    _hard_close(src)
+                    _hard_close(dst)
+                    self._untrack_and_close(src, dst)
+                    return
+                if act[0] == "trickle":
+                    faulted = True
+                    if not self._trickle(dst, data, act[1]):
+                        self._untrack_and_close(src, dst)
+                        return
+                    break
+                # blackhole: park this chunk until heal/reset clears it
+                act[1](self._poll_s)
+            if faulted:
+                continue
+            try:
+                dst.sendall(data)
+            except OSError:
+                break
+        self._untrack_and_close(src, dst)
+
+    def _trickle(self, dst: socket.socket, data: bytes,
+                 bytes_per_s: float) -> bool:
+        """Dribble ``data`` out at ``bytes_per_s`` (still whole)."""
+        import time as _time
+        step = max(1, int(bytes_per_s * self._poll_s))
+        for i in range(0, len(data), step):
+            if self._stop.is_set():
+                return False
+            try:
+                dst.sendall(data[i:i + step])
+            except OSError:
+                return False
+            _time.sleep(min(self._poll_s,
+                            len(data[i:i + step]) / bytes_per_s))
+        return True
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._acceptor.join(self._poll_s * 4 + 1.0)
+        with self._lock:
+            socks = list(self._socks)
+            self._socks.clear()
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __repr__(self) -> str:
+        return (f"FaultProxy({self.proxy_id!r}, "
+                f"{self.address[0]}:{self.address[1]} -> "
+                f"{self._target[0]}:{self._target[1]})")

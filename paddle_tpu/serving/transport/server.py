@@ -1,0 +1,548 @@
+"""``BackendServer`` — one serving host's wire endpoint.
+
+Listens on TCP and exposes a warm ``serving.Server`` (one-shots) and/or
+``serving.decode.DecodeServer`` (token streams) to remote
+``RemoteBackend`` clients: per-connection reader threads decode request
+frames, one-shot results are pushed back when their Future settles,
+decode tokens are relayed frame-by-frame as the engine emits them, and
+pings answer with the host's load score. The hello handshake advertises
+the host's bucket config, so a router fronting many hosts can validate
+the shared-bucket invariant (failover lands on warm executables)
+without an extra round-trip.
+
+Deadline metadata: a ``submit`` frame carries the client's REMAINING
+deadline in ms; it is re-anchored on this host's clock and a request
+whose deadline already passed is shed immediately (``deadline_shed``)
+instead of burning a batch slot. A client that disconnects (or sends
+``cancel``) gets its in-flight decode streams cancelled server-side —
+work nobody will read stops consuming decode steps.
+
+Shutdown: ``shutdown(drain=True)`` stops admitting, lets in-flight
+relays and one-shot waiters finish (the SIGTERM drain-then-exit path of
+``python -m paddle_tpu.serving.host``), then closes connections and —
+when it owns them — the servers.
+"""
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+import time
+from typing import Optional
+
+from ..batcher import (DeadlineExceeded, ServerClosed, ServerOverloaded,
+                       ServingError)
+from .metrics import TransportMetrics
+from .wire import (WIRE_VERSION, ConnectionClosedError, FrameReader,
+                   WireError, send_msg)
+
+__all__ = ["BackendServer"]
+
+_server_ids = itertools.count()
+
+
+class _Conn:
+    """One accepted client connection: socket + send lock + the decode
+    streams it is relaying (so a vanished client's work can be
+    cancelled)."""
+
+    __slots__ = ("sock", "send_lock", "lock", "streams", "closed",
+                 "dropped")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self.lock = threading.Lock()
+        self.streams: dict = {}       # rid -> (stream, cancel Event)
+        self.closed = threading.Event()
+        self.dropped = False          # guarded by lock: teardown once
+
+
+class BackendServer:
+    """Wire endpoint over a warm ``Server`` / ``DecodeServer`` pair.
+
+    Example::
+
+        with decode.DecodeServer(model, ...) as dsrv:
+            dsrv.warmup()
+            bs = BackendServer(backend_id="host0", decode_server=dsrv,
+                               port=0)
+            print(bs.address)       # ("127.0.0.1", <bound port>)
+            ...
+            bs.shutdown(drain=True)
+
+    Parameters
+    ----------
+    backend_id: advertised in the hello handshake (diagnostics only —
+        the router keys health on ITS OWN backend ids).
+    server / decode_server: the warm hosts (at least one required).
+    host / port: bind address; port 0 binds an ephemeral port
+        (``self.address`` carries the real one).
+    owns_servers: close the servers on ``shutdown`` too.
+    """
+
+    def __init__(self, *, backend_id: str = "host", server=None,
+                 decode_server=None, host: str = "127.0.0.1",
+                 port: int = 0, owns_servers: bool = False,
+                 name: Optional[str] = None, accept_poll_s: float = 0.2,
+                 relay_poll_s: float = 0.02):
+        if server is None and decode_server is None:
+            raise ValueError(
+                "BackendServer needs a server and/or a decode_server")
+        self.backend_id = str(backend_id)
+        self._server = server
+        self._decode = decode_server
+        self._owns = bool(owns_servers)
+        self._accept_poll_s = float(accept_poll_s)
+        self._relay_poll_s = float(relay_poll_s)
+        self.name = name or f"wire_host_{self.backend_id}" \
+                            f"_{next(_server_ids)}"
+        self._metrics = TransportMetrics(self.name)
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(64)
+        self._listener.settimeout(self._accept_poll_s)
+        self.address = self._listener.getsockname()
+
+        self._lock = threading.Lock()
+        self._conns: set = set()
+        self._active = 0            # in-flight relays + oneshot waiters
+        self._closing = False       # reject new work (drain window)
+        self._closed = False
+        self._stop = threading.Event()
+
+        from ...profiler import register_transport_source
+        register_transport_source(self.name, self._metrics)
+        self._metrics.set_depth_gauge(self._conn_count)
+        self._acceptor = threading.Thread(target=self._accept_loop,
+                                          name=f"{self.name}_accept",
+                                          daemon=True)
+        self._acceptor.start()
+
+    def _conn_count(self) -> int:
+        with self._lock:
+            return len(self._conns)
+
+    def _load(self) -> float:
+        n = 0.0
+        if self._server is not None:
+            n += self._server.queue_depth()
+        if self._decode is not None:
+            n += self._decode.queue_depth() + self._decode.active_slots()
+        return n
+
+    def bucket_config(self) -> dict:
+        cfg = {}
+        if self._server is not None:
+            cfg["oneshot"] = self._server.bucket_config()
+        if self._decode is not None:
+            cfg["decode"] = self._decode.bucket_config()
+        return cfg
+
+    def _host_stats(self) -> dict:
+        out = {"backend_id": self.backend_id,
+               "transport": self._metrics.snapshot()}
+        if self._server is not None:
+            out["oneshot"] = self._server.stats()
+        if self._decode is not None:
+            out["decode"] = self._decode.stats()
+        return out
+
+    # -- accept / per-connection service (graft_lint hot-path roots) -------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _peer = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return          # listener closed under us: shutting down
+            sock.settimeout(self._accept_poll_s)
+            conn = _Conn(sock)
+            with self._lock:
+                if self._closing:
+                    conn.closed.set()
+                else:
+                    self._conns.add(conn)
+            if conn.closed.is_set():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            self._metrics.inc("connects")
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name=f"{self.name}_conn", daemon=True).start()
+
+    def _serve_conn(self, conn: _Conn) -> None:
+        reader = FrameReader(conn.sock, self._metrics)
+        try:
+            msg = self._handshake(conn, reader)
+            if msg is None:
+                return
+            while not conn.closed.is_set() and not self._stop.is_set():
+                try:
+                    msg = reader.poll()
+                except (WireError, OSError):
+                    return
+                if msg is None:
+                    continue
+                try:
+                    self._dispatch(conn, msg)
+                except ConnectionClosedError:
+                    return
+                except Exception:  # noqa: BLE001 — one bad frame must
+                    self._metrics.inc("frame_errors")  # not kill the conn
+        finally:
+            self._drop_conn(conn)
+
+    def _handshake(self, conn: _Conn, reader: FrameReader):
+        """First frame must be hello; reply with identity + buckets."""
+        end = time.monotonic() + 10.0
+        msg = None
+        while msg is None:
+            if (time.monotonic() > end or conn.closed.is_set()
+                    or self._stop.is_set()):
+                return None
+            try:
+                msg = reader.poll()
+            except (WireError, OSError):
+                return None
+        if not (isinstance(msg, tuple) and msg and msg[0] == "hello"):
+            self._metrics.inc("frame_errors")
+            return None
+        if len(msg) < 2 or msg[1] != WIRE_VERSION:
+            # fail fast at handshake: mismatched deployments would
+            # otherwise misread frames at runtime (frame_errors / hangs)
+            self._metrics.inc("frame_errors")
+            self._safe_reply(conn, ("error", -1, WireError(
+                f"wire version mismatch: host speaks {WIRE_VERSION}, "
+                f"client sent {msg[1] if len(msg) > 1 else None!r}")))
+            return None
+        try:
+            send_msg(conn.sock,
+                     ("hello", {"version": WIRE_VERSION,
+                                "backend_id": self.backend_id,
+                                "bucket_config": self.bucket_config(),
+                                "load": self._load()}),
+                     lock=conn.send_lock, metrics=self._metrics)
+        except (WireError, OSError):
+            return None
+        return msg
+
+    def _drop_conn(self, conn: _Conn) -> None:
+        """Tear one connection down; a vanished client's in-flight
+        decode streams are cancelled server-side (work nobody reads).
+        Once-only: shutdown() and the _serve_conn finally both call in,
+        and the teardown (metrics included) must not run twice."""
+        conn.closed.set()
+        with conn.lock:
+            if conn.dropped:
+                return
+            conn.dropped = True
+            streams = list(conn.streams.values())
+            conn.streams.clear()
+        with self._lock:
+            self._conns.discard(conn)
+        for stream, cancel in streams:
+            cancel.set()
+            if self._decode is not None:
+                try:
+                    self._decode.cancel(stream)
+                except Exception:  # noqa: BLE001 — best-effort shed
+                    pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        self._metrics.inc("disconnects")
+
+    def _reply(self, conn: _Conn, msg) -> None:
+        send_msg(conn.sock, msg, lock=conn.send_lock,
+                 metrics=self._metrics)
+
+    def _begin_work(self) -> bool:
+        with self._lock:
+            if self._closing:
+                return False
+            self._active += 1
+            return True
+
+    def _end_work(self) -> None:
+        with self._lock:
+            self._active -= 1
+
+    @staticmethod
+    def _deadline_remaining(deadline_ms) -> Optional[float]:
+        """Normalize the client's RELATIVE remaining-ms value (<= 0
+        means the client already gave up). The actual re-anchoring onto
+        this host's clock happens where it is consumed —
+        ``Server.submit`` / ``DecodeServer.submit`` turn the relative
+        value into an absolute monotonic deadline."""
+        return None if deadline_ms is None else float(deadline_ms)
+
+    def _dispatch(self, conn: _Conn, msg) -> None:
+        if not isinstance(msg, tuple) or not msg:
+            self._metrics.inc("frame_errors")
+            return
+        kind = msg[0]
+        if kind == "ping":
+            self._reply(conn, ("pong", msg[1], self._load()))
+            return
+        if kind == "bucket_config":
+            self._metrics.inc("rpcs")
+            self._reply(conn, ("result", msg[1], self.bucket_config()))
+            return
+        if kind == "stats":
+            self._metrics.inc("rpcs")
+            self._reply(conn, ("result", msg[1], self._host_stats()))
+            return
+        if kind == "submit":
+            self._handle_submit(conn, msg)
+            return
+        if kind == "decode":
+            self._handle_decode(conn, msg)
+            return
+        if kind == "cancel":
+            self._handle_cancel(conn, msg[1])
+            return
+        if kind == "hello":
+            return      # duplicate handshake: harmless
+        self._metrics.inc("frame_errors")
+
+    # -- wire admission (shared by one-shots and decode) -------------------
+    def _admit_wire(self, conn: _Conn, rid: int, deadline_ms, host,
+                    kind: str):
+        """Deadline shed + missing-capability + draining rejects, in ONE
+        place so the drain/shed invariant cannot diverge between the
+        request kinds. Returns ``(admitted, remaining_deadline)``; when
+        admitted, ``_begin_work`` has been charged and the caller owns
+        the matching ``_end_work``."""
+        self._metrics.inc("rpcs")
+        remaining = self._deadline_remaining(deadline_ms)
+        if remaining is not None and remaining <= 0:
+            # the client's propagated deadline already passed: shed
+            # before the queue, not after the batch
+            self._metrics.inc("deadline_shed")
+            self._metrics.inc("rpc_failures")
+            self._reply(conn, ("reject", rid, DeadlineExceeded(
+                "deadline already passed at the host (shed)")))
+            return False, None
+        if host is None or not self._begin_work():
+            self._metrics.inc("rpc_failures")
+            exc = (TypeError(f"host has no {kind} server")
+                   if host is None
+                   else ServerClosed("host is draining"))
+            self._reply(conn, ("reject", rid, exc))
+            return False, None
+        return True, remaining
+
+    # -- one-shots ---------------------------------------------------------
+    def _handle_submit(self, conn: _Conn, msg) -> None:
+        _, rid, args, deadline_ms = msg
+        admitted, remaining = self._admit_wire(conn, rid, deadline_ms,
+                                               self._server, "one-shot")
+        if not admitted:
+            return
+        try:
+            fut = self._server.submit(*args, deadline_ms=remaining)
+        except Exception as e:  # noqa: BLE001 — typed reject to the peer
+            self._end_work()
+            self._metrics.inc("rpc_failures")
+            self._reply(conn, ("reject", rid, e))
+            return
+        if not self._safe_reply(conn, ("ack", rid)):
+            # client vanished before the ack: no waiter thread will run,
+            # so the work charge must be released HERE or drain wedges
+            self._end_work()
+            return
+        threading.Thread(target=self._await_oneshot,
+                         args=(conn, rid, fut),
+                         name=f"{self.name}_oneshot", daemon=True).start()
+
+    def _await_oneshot(self, conn: _Conn, rid: int, fut) -> None:
+        """Push the Future's outcome back when it settles (bounded
+        polls: server shutdown settles every accepted future, so this
+        thread always ends)."""
+        try:
+            while True:
+                try:
+                    res = fut.result(timeout=0.1)
+                except DeadlineExceeded:
+                    if fut.done():
+                        # settled, and the terminal state may itself be
+                        # a DeadlineExceeded: re-read the real outcome
+                        try:
+                            res = fut.result(0)
+                        except Exception as e:  # noqa: BLE001
+                            self._safe_reply(conn, ("error", rid, e))
+                            return
+                        self._safe_reply(conn, ("result", rid, res))
+                        return
+                    if conn.closed.is_set():
+                        return
+                    continue
+                except Exception as e:  # noqa: BLE001 — ship it back
+                    self._safe_reply(conn, ("error", rid, e))
+                    return
+                self._safe_reply(conn, ("result", rid, res))
+                return
+        finally:
+            self._end_work()
+
+    def _safe_reply(self, conn: _Conn, msg) -> bool:
+        try:
+            self._reply(conn, msg)
+            return True
+        except (WireError, OSError):
+            return False
+
+    # -- decode streams ----------------------------------------------------
+    def _handle_decode(self, conn: _Conn, msg) -> None:
+        _, rid, prompt, mnt, eos_id, deadline_ms = msg
+        admitted, remaining = self._admit_wire(conn, rid, deadline_ms,
+                                               self._decode, "decode")
+        if not admitted:
+            return
+        try:
+            stream = self._decode.submit(prompt, max_new_tokens=mnt,
+                                         eos_id=eos_id,
+                                         deadline_ms=remaining)
+        except Exception as e:  # noqa: BLE001 — typed reject to the peer
+            self._end_work()
+            self._metrics.inc("rpc_failures")
+            self._reply(conn, ("reject", rid, e))
+            return
+        cancel = threading.Event()
+        with conn.lock:
+            conn.streams[rid] = (stream, cancel)
+        if not self._safe_reply(conn, ("ack", rid)):
+            # client vanished before the ack: no relay thread will run —
+            # release the work charge and stop the engine-side work
+            with conn.lock:
+                conn.streams.pop(rid, None)
+            self._decode.cancel(stream)
+            self._end_work()
+            return
+        threading.Thread(target=self._relay_stream,
+                         args=(conn, rid, stream, cancel),
+                         name=f"{self.name}_relay", daemon=True).start()
+
+    def _relay_stream(self, conn: _Conn, rid: int, stream,
+                      cancel: threading.Event) -> None:
+        """Forward tokens frame-by-frame as the engine emits them —
+        the wire half of streaming decode."""
+        i = 0
+        try:
+            while True:
+                if cancel.is_set():
+                    return
+                if conn.closed.is_set():
+                    # client vanished: stop the engine-side work too
+                    if self._decode is not None:
+                        self._decode.cancel(stream)
+                    return
+                try:
+                    tok = stream.next_token(i, timeout=self._relay_poll_s)
+                except DeadlineExceeded as e:
+                    if stream.done():
+                        # the stream's TERMINAL state is itself a
+                        # DeadlineExceeded (engine expiry, server-side
+                        # cancel) — ship it and end the relay; treating
+                        # it as a poll tick would spin forever and
+                        # wedge drain
+                        self._safe_reply(conn, ("error", rid, e))
+                        return
+                    continue            # poll tick
+                except Exception as e:  # noqa: BLE001 — terminal failure
+                    self._safe_reply(conn, ("error", rid, e))
+                    return
+                if tok is None:
+                    self._safe_reply(
+                        conn, ("fin", rid, stream.finish_reason))
+                    self._metrics.observe("stream_tokens", i)
+                    return
+                if not self._safe_reply(conn, ("tok", rid, tok)):
+                    if self._decode is not None:
+                        self._decode.cancel(stream)
+                    return
+                self._metrics.inc("tokens_streamed")
+                i += 1
+        finally:
+            with conn.lock:
+                conn.streams.pop(rid, None)
+            self._end_work()
+
+    def _handle_cancel(self, conn: _Conn, rid: int) -> None:
+        with conn.lock:
+            entry = conn.streams.pop(rid, None)
+        if entry is None:
+            return
+        stream, cancel = entry
+        cancel.set()
+        self._metrics.inc("cancels")
+        if self._decode is not None:
+            self._decode.cancel(stream)
+
+    # -- lifecycle ---------------------------------------------------------
+    def stats(self) -> dict:
+        return self._metrics.snapshot()
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> bool:
+        """Stop admitting wire requests; with ``drain`` wait for
+        in-flight relays/one-shots to settle (the servers keep running
+        so they CAN settle), then close every connection and — when
+        owned — the servers. Idempotent. Returns False when the drain
+        timed out."""
+        with self._lock:
+            if self._closed:
+                return True
+            self._closed = True
+            self._closing = True
+        drained = True
+        if drain:
+            end = None if timeout is None else time.monotonic() + timeout
+            while True:
+                with self._lock:
+                    if self._active <= 0:
+                        break
+                if end is not None and time.monotonic() > end:
+                    drained = False
+                    break
+                time.sleep(0.005)
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._acceptor.join(self._accept_poll_s * 4 + 1.0)
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            self._drop_conn(c)
+        if self._owns:
+            for host in (self._server, self._decode):
+                if host is not None and not host._is_closed():
+                    host.shutdown(drain=drain, timeout=timeout)
+        from ...profiler import unregister_transport_source
+        unregister_transport_source(self.name, self._metrics)
+        return drained
+
+    def close(self) -> None:
+        self.shutdown(drain=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc[0] is None)
+
+    def __repr__(self) -> str:
+        kinds = [k for k, v in (("oneshot", self._server),
+                                ("decode", self._decode)) if v is not None]
+        return (f"BackendServer({self.backend_id!r}, "
+                f"{self.address[0]}:{self.address[1]}, "
+                f"{'+'.join(kinds)})")

@@ -1,0 +1,186 @@
+"""Length-prefixed framing over TCP — the wire layer of the remote
+serving transport.
+
+One frame is a 4-byte big-endian length followed by a pickled payload
+(stdlib only — this is a trusted intra-cluster control plane, the same
+trust model as the launcher's TCPStore RPC; do not expose a listener to
+untrusted peers). Every read is bounded: ``FrameReader.poll`` buffers
+partial frames across socket timeouts so a slow (trickling) peer can
+never desynchronize the stream, and a peer that goes away surfaces as
+``ConnectionClosedError`` — never a hang.
+
+Message vocabulary (client → host)::
+
+    ("hello", version)                               handshake, first frame
+    ("bucket_config", rid)                           -> ("result", rid, cfg)
+    ("ping", rid)                                    -> ("pong", rid, load)
+    ("stats", rid)                                   -> ("result", rid, {...})
+    ("submit", rid, args, deadline_ms)               -> ("ack", rid) then
+                                                        ("result", rid, out)
+    ("decode", rid, prompt, mnt, eos_id, deadline_ms)-> ("ack", rid) then
+                                                        ("tok", rid, t)...
+                                                        ("fin", rid, reason)
+    ("cancel", rid)                                  best-effort abandon
+
+Host → client error frames: ``("reject", rid, exc)`` for enqueue-time
+failures (overload, closed, bucket overflow — raised synchronously at
+the client's submit site) and ``("error", rid, exc)`` for later
+failures (surfaced through the Future / DecodeStream). The deadline in
+request metadata is RELATIVE milliseconds remaining at send time; the
+host re-anchors it on its own clock, so no cross-host clock sync is
+assumed.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import time
+from typing import Optional
+
+from ..batcher import ServingError
+
+__all__ = ["WIRE_VERSION", "MAX_FRAME_BYTES", "SEND_TIMEOUT_S",
+           "WireError", "ConnectionClosedError", "FrameError", "send_msg",
+           "FrameReader"]
+
+WIRE_VERSION = 1
+
+# a frame bigger than this is protocol garbage (a misframed stream would
+# otherwise ask for gigabytes and look like a hang) — fail fast instead
+MAX_FRAME_BYTES = 1 << 30
+
+# total bound on one frame send. The socket's own (short) timeout is the
+# RECV poll interval; a send must not inherit it — a multi-MB frame or a
+# moment of congestion would read as "peer gone". A peer that accepts no
+# bytes for this long really is wedged.
+SEND_TIMEOUT_S = 10.0
+
+_HEADER = struct.Struct("!I")
+
+
+def _sendall_bounded(sock: socket.socket, data: bytes) -> None:
+    """sendall with partial-progress tracking: the socket's short
+    recv-poll timeout may interrupt a large send mid-frame, and a plain
+    ``sendall`` retry would be unsafe (its progress on timeout is
+    undefined). ``send`` either writes some bytes or raises having
+    written none, so tracking the offset ourselves makes retry exact."""
+    view = memoryview(data)
+    sent = 0
+    deadline = time.monotonic() + SEND_TIMEOUT_S
+    while sent < len(view):
+        try:
+            n = sock.send(view[sent:])
+        except socket.timeout:
+            if time.monotonic() > deadline:
+                raise ConnectionClosedError(
+                    f"peer accepted no more bytes for "
+                    f"{SEND_TIMEOUT_S:.0f}s (send wedged at "
+                    f"{sent}/{len(view)})") from None
+            continue
+        if n > 0:
+            sent += n
+            # progress resets the stall clock: this bound detects a
+            # WEDGED peer, not a slow one (a trickling link that keeps
+            # draining must degrade, never die)
+            deadline = time.monotonic() + SEND_TIMEOUT_S
+
+
+class WireError(ServingError):
+    """Transport-level failure (framing, protocol, or connection)."""
+
+
+class ConnectionClosedError(WireError):
+    """The peer closed (or reset) the connection."""
+
+
+class FrameError(WireError):
+    """A malformed frame: oversized length prefix or an unpicklable /
+    undecodable payload."""
+
+
+def send_msg(sock: socket.socket, obj, lock=None, metrics=None) -> int:
+    """Serialize ``obj`` into one frame and send it whole. ``lock`` (when
+    given) serializes concurrent writers on the same socket so frames
+    never interleave. Returns the bytes written. Raises
+    ``ConnectionClosedError`` when the peer is gone."""
+    try:
+        payload = pickle.dumps(obj, protocol=4)
+    except Exception as e:
+        raise FrameError(f"unpicklable wire message: {e!r}") from e
+    if len(payload) > MAX_FRAME_BYTES:
+        raise FrameError(
+            f"frame of {len(payload)} bytes exceeds the "
+            f"{MAX_FRAME_BYTES}-byte wire bound")
+    data = _HEADER.pack(len(payload)) + payload
+    try:
+        if lock is not None:
+            with lock:
+                _sendall_bounded(sock, data)
+        else:
+            _sendall_bounded(sock, data)
+    except ConnectionClosedError:
+        raise
+    except (BrokenPipeError, ConnectionError, OSError) as e:
+        raise ConnectionClosedError(f"peer gone mid-send: {e!r}") from e
+    if metrics is not None:
+        metrics.inc("frames_sent")
+        metrics.inc("bytes_sent", len(data))
+    return len(data)
+
+
+class FrameReader:
+    """Incremental frame decoder over one socket.
+
+    ``poll()`` returns the next decoded message, or ``None`` when the
+    socket's timeout elapsed first — partial header/payload bytes stay
+    buffered, so a timeout (or a byte-trickling link) never
+    desynchronizes framing. Single-reader by contract (each connection
+    owns one reader thread)."""
+
+    def __init__(self, sock: socket.socket, metrics=None):
+        self._sock = sock
+        self._metrics = metrics
+        self._buf = bytearray()
+        self._need: Optional[int] = None
+
+    def poll(self):
+        """One message, or None on socket timeout. Raises
+        ``ConnectionClosedError`` on EOF/reset and ``FrameError`` on a
+        malformed frame."""
+        while True:
+            if self._need is None and len(self._buf) >= _HEADER.size:
+                (self._need,) = _HEADER.unpack(
+                    bytes(self._buf[:_HEADER.size]))
+                del self._buf[:_HEADER.size]
+                if self._need > MAX_FRAME_BYTES:
+                    if self._metrics is not None:
+                        self._metrics.inc("frame_errors")
+                    raise FrameError(
+                        f"peer announced a {self._need}-byte frame "
+                        f"(> {MAX_FRAME_BYTES}): misframed stream")
+            if self._need is not None and len(self._buf) >= self._need:
+                payload = bytes(self._buf[:self._need])
+                del self._buf[:self._need]
+                self._need = None
+                if self._metrics is not None:
+                    self._metrics.inc("frames_received")
+                    self._metrics.inc("bytes_received",
+                                      len(payload) + _HEADER.size)
+                try:
+                    return pickle.loads(payload)
+                except Exception as e:
+                    if self._metrics is not None:
+                        self._metrics.inc("frame_errors")
+                    raise FrameError(
+                        f"undecodable frame payload: {e!r}") from e
+            try:
+                chunk = self._sock.recv(65536)
+            except socket.timeout:
+                return None
+            except (ConnectionError, OSError) as e:
+                raise ConnectionClosedError(
+                    f"peer gone mid-recv: {e!r}") from e
+            if not chunk:
+                raise ConnectionClosedError("peer closed the connection")
+            self._buf += chunk

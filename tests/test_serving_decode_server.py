@@ -365,7 +365,7 @@ class TestObservability:
                          max_new_tokens=2, timeout=120)
             scrape = profiler.export_stats()
             assert set(scrape) == {"pipeline", "serving", "decode",
-                                   "resilience", "router"}
+                                   "resilience", "router", "transport"}
             assert "decode_test_export" in scrape["decode"]
 
             import json

@@ -51,6 +51,14 @@ HOT_ROOT_NAMES = {"run_steps", "_run_loop", "_execute", "_produce",
                   # prober once per backend per tick — both multiply any
                   # silent sync or retrace by the traffic rate
                   "_dispatch_loop", "_health_loop", "submit_decode",
+                  # the wire transport: the client receiver demuxes one
+                  # frame per token/reply, the host's accept/serve/relay
+                  # loops run per connection and per streamed token, and
+                  # the fault proxy's pump forwards every wire byte —
+                  # all per-token/per-request hot
+                  "_recv_loop", "_keepalive_loop", "_accept_loop",
+                  "_serve_conn", "_relay_stream", "_await_oneshot",
+                  "_pump",
                   # resilience: the per-step save gate, the write-behind
                   # worker loop, and the per-write fault/Fs boundary
                   "maybe_save", "save", "_write_loop", "poll",
