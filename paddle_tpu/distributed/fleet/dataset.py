@@ -274,6 +274,11 @@ class InMemoryDataset(DatasetBase):
 
     def preload_into_memory(self, thread_num=None):
         """Async load (reference preload_into_memory/wait_preload_done)."""
+        # a second preload while one is in flight would race two loader
+        # threads into self._memory and drop the first thread's handle
+        # unjoined (the wave-3 GL706/GL80x sweep's leak shape) — finish
+        # the outstanding one first
+        self.wait_preload_done()
         self._preload_thread = threading.Thread(
             target=self.load_into_memory, daemon=True)
         self._preload_thread.start()

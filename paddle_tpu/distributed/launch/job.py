@@ -88,7 +88,13 @@ class Pod:
                 c.wait(max(0.1, deadline - time.time()))
             except subprocess.TimeoutExpired:
                 c.terminate(force=True)
-                c.wait()
+                try:
+                    # even SIGKILL reaping gets a bound: a process stuck
+                    # in the kernel (D-state) must orphan, not wedge the
+                    # launcher's teardown forever
+                    c.wait(10)
+                except subprocess.TimeoutExpired:
+                    pass
 
     def join(self):
         for c in self.containers:

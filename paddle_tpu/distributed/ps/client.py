@@ -59,22 +59,40 @@ class PSClient:
     issues shard requests in parallel; serialized round trips would put
     n_servers x RTT on the training hot path)."""
 
-    def __init__(self, endpoints: Sequence[str], table_defaults=None):
+    def __init__(self, endpoints: Sequence[str], table_defaults=None,
+                 op_timeout_s: float = 120.0):
         from concurrent.futures import ThreadPoolExecutor
         self._conns = [_Conn(e) for e in endpoints]
         self.n = len(self._conns)
         self._defaults = dict(table_defaults or {})
+        # bound on one sharded pull/push fan-in: must exceed _Conn's
+        # 60 s socket timeout so per-socket errors surface first
+        self._op_timeout_s = float(op_timeout_s)
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, self.n),
             thread_name_prefix="ps-client") if self.n > 1 else None
 
     def _fanout(self, calls):
         """Run [(conn, meta, arrays), ...] concurrently; returns results
-        in order, raising the first failure after all complete."""
+        in order, raising the first failure after all complete. The
+        fan-in is bounded: a wedged shard surfaces as PSError instead
+        of parking the training step forever."""
         if self._pool is None or len(calls) <= 1:
             return [c.call(m, a) for c, m, a in calls]
+        from concurrent.futures import TimeoutError as _FutTimeout
         futs = [self._pool.submit(c.call, m, a) for c, m, a in calls]
-        return [f.result() for f in futs]
+        # one deadline for the whole fan-in, not per future: n_servers
+        # cascading slow shards must not stack n x op_timeout_s
+        end = time.monotonic() + self._op_timeout_s
+        try:
+            return [f.result(timeout=max(0.0, end - time.monotonic()))
+                    for f in futs]
+        except _FutTimeout:
+            for f in futs:
+                f.cancel()
+            raise PSError(
+                f"parameter-server RPC gave no reply within "
+                f"{self._op_timeout_s:.1f}s (wedged server?)") from None
 
     def _meta(self, cmd: str, table: str, dim: int, **kw) -> dict:
         m = {"cmd": cmd, "table": table, "dim": int(dim)}

@@ -106,6 +106,11 @@ class PSServer:
             self._srv.server_close()
         except Exception:
             pass
+        # reclaim the serve_forever thread (GL706): shutdown() returns
+        # once the serve loop notices, but only the join proves the
+        # worker is gone before the owner drops the server
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
 
     @property
     def endpoint(self) -> str:

@@ -162,22 +162,36 @@ class _Future:
 
     def _poll(self):
         deadline = time.monotonic() + self._timeout
-        while time.monotonic() < deadline:
-            try:
-                raw = self._store.get(self._key, wait=False)
-            except KeyError:
+        try:
+            while time.monotonic() < deadline:
+                try:
+                    raw = self._store.get(self._key, wait=False)
+                except KeyError:
+                    time.sleep(0.01)
+                    continue
+                if raw:
+                    self._result = pickle.loads(raw)
+                    return
                 time.sleep(0.01)
-                continue
-            if raw:
-                self._result = pickle.loads(raw)
-                self._done.set()
-                return
-            time.sleep(0.01)
-        self._result = (False, f"rpc reply timed out after {self._timeout}s")
-        self._done.set()
+            self._result = (False,
+                            f"rpc reply timed out after {self._timeout}s")
+        except Exception as e:  # noqa: BLE001 — a dying reply channel
+            # (store closed under us, undecodable reply) must wake the
+            # waiter with a typed error; before this finally, it killed
+            # the poll thread with _done never set and wait() hung
+            # forever (GL701's failure mode, found by the wave-3 sweep)
+            self._result = (False, f"rpc reply channel failed: {e!r}")
+        finally:
+            self._done.set()
 
     def wait(self):
-        self._done.wait()
+        # bounded even if the poll thread is itself wedged inside a
+        # store call: one grace period past the rpc deadline
+        if not self._done.wait(self._timeout + 5.0):
+            raise RuntimeError(
+                "rpc reply poll thread unresponsive "
+                f"{self._timeout + 5.0:.1f}s past submission")
+        self._thread.join(timeout=1.0)   # reclaim the poll thread
         ok, value = self._result
         if not ok:
             raise RuntimeError(f"remote call failed: {value}")

@@ -228,6 +228,9 @@ class MultiprocessLoaderIter:
             w = self._next
             self._next = (self._next + 1) % self.num_workers
             if self._done[w]:
+                # graft-lint: disable=GL705 -- bounded skip, not a spin:
+                # rotates to the next non-done worker (at most
+                # num_workers hops) and that worker's ring.pop blocks
                 continue
             # take the ring/process references under the shutdown lock:
             # a concurrent shutdown() (e.g. GC __del__ on another

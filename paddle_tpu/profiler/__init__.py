@@ -542,6 +542,27 @@ def transport_stats(name: Optional[str] = None):
     return _transport_registry.stats(name)
 
 
+# the one table of metrics-source scrapes: export_stats() and the
+# registry introspection below both derive from it, so adding a stats
+# source is ONE entry here — and tests derive their expected registry
+# set instead of hardcoding a count that breaks on every new subsystem
+_STATS_SCRAPES = {
+    "pipeline": pipeline_stats,
+    "serving": serving_stats,
+    "decode": decode_stats,
+    "resilience": resilience_stats,
+    "router": router_stats,
+    "transport": transport_stats,
+}
+
+
+def stats_registries() -> tuple:
+    """Names of every metrics-source registry ``export_stats()``
+    scrapes (sorted). The introspection surface consumers (dashboards,
+    tests) use to stay correct as stats sources are added."""
+    return tuple(sorted(_STATS_SCRAPES))
+
+
 def _flatten_scrape(prefix: str, value, out: list) -> None:
     """dict/number tree -> ``name value`` exposition lines (labels are
     flattened into the metric name; non-numeric leaves are dropped —
@@ -569,10 +590,10 @@ def export_stats(format: str = "dict"):
     format="dict" returns the nested dict, "json" a JSON string, and
     "text" a Prometheus-style exposition (one ``name value`` line per
     numeric leaf, names prefixed ``paddle_tpu_<registry>_<source>_``).
+    The registry set is ``stats_registries()`` — one scrape per entry
+    in ``_STATS_SCRAPES``.
     """
-    data = {"pipeline": pipeline_stats(), "serving": serving_stats(),
-            "decode": decode_stats(), "resilience": resilience_stats(),
-            "router": router_stats(), "transport": transport_stats()}
+    data = {name: scrape() for name, scrape in _STATS_SCRAPES.items()}
     if format == "dict":
         return data
     if format == "json":

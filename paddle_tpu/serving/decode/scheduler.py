@@ -246,6 +246,9 @@ class AdmissionQueue:
         with self._cond:
             if self._q:
                 return True
+            # graft-lint: disable=GL704 -- the predicate re-check IS the
+            # return value: this is the bounded wait primitive, and every
+            # caller loops on it (wait_nonempty -> pop_ready -> repeat)
             self._cond.wait(timeout)
             return bool(self._q)
 

@@ -149,16 +149,22 @@ class RemoteBackend(Backend):
                 reconnect = self._ever_connected
             timeout = max(0.05, float(timeout))
             try:
+                # graft-lint: disable=GL702 -- _connect_lock exists to
+                # serialize (re)connects; the shared-state _lock is
+                # never held across this blocking connect
                 sock = socket.create_connection(self._addr,
                                                 timeout=timeout)
             except OSError as e:
                 raise BackendDied(
                     f"backend {self.backend_id!r} unreachable at "
                     f"{self._addr[0]}:{self._addr[1]}: {e!r}") from None
-            sock.settimeout(self._poll_s)
-            reader = FrameReader(sock, self._metrics)
             end = time.monotonic() + timeout
             try:
+                # settimeout/FrameReader live INSIDE the protected
+                # region: anything raising between the connect and the
+                # handlers below would leak the fresh fd (GL801)
+                sock.settimeout(self._poll_s)
+                reader = FrameReader(sock, self._metrics)
                 send_msg(sock, ("hello", WIRE_VERSION),
                          metrics=self._metrics)
                 msg = None

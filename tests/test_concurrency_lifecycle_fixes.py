@@ -1,0 +1,180 @@
+"""Regression tests for the real defects the wave-3 graft_lint passes
+(wait-discipline GL7xx, resource-lifecycle GL8xx) surfaced across the
+distributed control plane — each test pins one hand-verified fix:
+
+- rpc._Future: a dying reply channel used to kill the poll thread with
+  ``_done`` never set, hanging ``wait()`` forever (GL701's failure
+  mode); and ``wait()`` itself was unbounded.
+- PSClient._fanout: ``f.result()`` with no timeout parked the training
+  step on a wedged shard forever (GL701).
+- PSServer.stop: the ``serve_forever`` thread was never joined (GL706).
+- launch Pod.stop: the post-SIGKILL reap was unbounded — an unkillable
+  (D-state) child wedged launcher teardown (the job.py unbounded wait).
+- fleet InMemoryDataset: a second ``preload_into_memory`` raced two
+  loader threads into ``self._memory`` and dropped the first thread's
+  handle unjoined.
+"""
+import socket
+import subprocess
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet.dataset import InMemoryDataset
+from paddle_tpu.distributed.launch.job import Pod
+from paddle_tpu.distributed.ps.client import PSClient, PSError
+from paddle_tpu.distributed.ps.service import PSServer
+from paddle_tpu.distributed.rpc import _Future
+
+
+# ---------------------------------------------------------------------------
+# rpc._Future: bounded wait + error-path wakeup
+# ---------------------------------------------------------------------------
+class _ExplodingStore:
+    """A reply channel that dies mid-poll (store closed under us)."""
+
+    def get(self, key, wait=True):
+        raise RuntimeError("store closed")
+
+
+class _SilentStore:
+    """A reply channel where the reply never arrives."""
+
+    def get(self, key, wait=True):
+        raise KeyError(key)
+
+
+def test_rpc_future_store_error_wakes_the_waiter():
+    """Pre-fix: a non-KeyError from the store killed the poll thread
+    BEFORE _done.set(), and wait() hung forever. The waiter must get a
+    typed error promptly."""
+    fut = _Future(_ExplodingStore(), "q", 0, timeout=30.0)
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="reply channel failed"):
+        fut.wait()
+    assert time.monotonic() - t0 < 5.0
+    assert fut.done()
+    assert not fut._thread.is_alive()   # wait() reclaimed the poller
+
+
+def test_rpc_future_timeout_still_raises_typed_error():
+    fut = _Future(_SilentStore(), "q", 0, timeout=0.2)
+    with pytest.raises(RuntimeError, match="timed out"):
+        fut.wait()
+
+
+# ---------------------------------------------------------------------------
+# PSClient._fanout: bounded fan-in
+# ---------------------------------------------------------------------------
+def _silent_listener():
+    """A server socket that accepts connects (kernel backlog) but never
+    reads or replies — the wedged-shard shape."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    return srv
+
+
+def test_ps_fanout_times_out_on_wedged_server():
+    """Pre-fix: ``f.result()`` with no timeout parked pull() forever on
+    a server that accepted the RPC and never answered."""
+    srv1, srv2 = _silent_listener(), _silent_listener()
+    client = None
+    try:
+        eps = [f"127.0.0.1:{s.getsockname()[1]}" for s in (srv1, srv2)]
+        client = PSClient(eps, op_timeout_s=0.5)
+        t0 = time.monotonic()
+        with pytest.raises(PSError, match="no reply"):
+            # ids 0 and 1 shard onto both servers -> the pooled fanout
+            client.pull("emb", [0, 1], dim=4)
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        # unblock the pool workers parked in recv so interpreter exit
+        # does not wait out the 60 s socket timeout
+        if client is not None:
+            for c in client._conns:
+                try:
+                    c.sock.close()
+                except OSError:
+                    pass
+            if client._pool is not None:
+                client._pool.shutdown(wait=False)
+        srv1.close()
+        srv2.close()
+
+
+# ---------------------------------------------------------------------------
+# PSServer.stop: serve thread reclaimed
+# ---------------------------------------------------------------------------
+def test_ps_server_stop_joins_serve_thread():
+    srv = PSServer().start()
+    assert srv._thread.is_alive()
+    srv.stop()
+    assert not srv._thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# launch Pod.stop: bounded even when the child cannot be reaped
+# ---------------------------------------------------------------------------
+class _UnreapableContainer:
+    """A container whose process never exits, even under SIGKILL — the
+    D-state child."""
+
+    def __init__(self):
+        self.force_kills = 0
+        self.wait_timeouts = []
+
+    def terminate(self, force=False):
+        if force:
+            self.force_kills += 1
+
+    def wait(self, timeout=None):
+        self.wait_timeouts.append(timeout)
+        raise subprocess.TimeoutExpired(cmd="fake", timeout=timeout or 0)
+
+
+def test_pod_stop_never_waits_unbounded():
+    pod = Pod()
+    pod.containers = [_UnreapableContainer()]
+    t0 = time.monotonic()
+    pod.stop()                       # pre-fix: hung in c.wait() forever
+    assert time.monotonic() - t0 < 5.0
+    c = pod.containers[0]
+    assert c.force_kills >= 1
+    assert all(t is not None for t in c.wait_timeouts), c.wait_timeouts
+
+
+# ---------------------------------------------------------------------------
+# fleet InMemoryDataset: double preload is serialized, not raced
+# ---------------------------------------------------------------------------
+def test_double_preload_serializes_loads():
+    """Pre-fix: the second preload_into_memory() overwrote the running
+    loader thread's handle and both threads raced into self._memory
+    (duplicated/duplicating records). The second call must finish the
+    outstanding load first."""
+    ds = InMemoryDataset()
+    ds.set_filelist(["a", "b"])
+    reads = []
+
+    def slow_read(path):
+        time.sleep(0.05)
+        reads.append(path)
+        return [("rec", path)]
+
+    ds._read_file = slow_read
+    ds.preload_into_memory()
+    ds.preload_into_memory()         # pre-fix: races the first load
+    ds.wait_preload_done()
+    assert ds._memory == [("rec", "a"), ("rec", "b")]
+    assert reads == ["a", "b", "a", "b"]     # two loads, serialized
+    assert ds._preload_thread is None
+
+
+def test_preload_then_wait_is_still_the_reference_contract():
+    ds = InMemoryDataset()
+    ds.set_filelist(["only"])
+    ds._read_file = lambda path: [(path, 1)]
+    ds.preload_into_memory()
+    ds.wait_preload_done()
+    assert ds._memory == [("only", 1)]

@@ -33,8 +33,8 @@ def _result():
 def test_all_passes_registered():
     passes = set(_result().passes)
     assert {"trace-purity", "lock-discipline", "thread-hygiene",
-            "slow-marker", "device-placement",
-            "recompile-hazard"} <= passes
+            "slow-marker", "device-placement", "recompile-hazard",
+            "wait-discipline", "resource-lifecycle"} <= passes
 
 
 def test_wave2_rules_are_in_the_gate():
@@ -52,14 +52,44 @@ def test_wave2_rules_are_in_the_gate():
     assert gl5_gl6 == [], "\n".join(f.render() for f in gl5_gl6)
 
 
+def _repro_commands(findings):
+    """The exact --select invocations that reproduce these findings one
+    rule family at a time — printed on failure so the fix loop is
+    copy-paste, not archaeology."""
+    families = sorted({f.rule[:3] for f in findings})
+    return "\n".join(
+        f"    python -m tools.graft_lint paddle_tpu tools tests "
+        f"--select {fam}" for fam in families)
+
+
+def _render_failure(findings):
+    return "\n" + "\n".join(f.render() for f in findings) + (
+        "\n^ new graft_lint finding(s): fix them, suppress inline with "
+        "a reason, or (last resort) extend tools/graft_lint/baseline.json"
+        " via --write-baseline\nreproduce one family locally with:\n"
+        + _repro_commands(findings))
+
+
+def test_wave3_rules_are_in_the_gate():
+    """The wait-discipline (GL7xx) and resource-lifecycle (GL8xx)
+    families must be live in this gate: zero unbaselined findings over
+    paddle_tpu + tools is an ISSUE 13 acceptance criterion, not an
+    accident of the passes not running. (Both passes skip test files
+    by design — tests park on events deliberately.)"""
+    from tools.graft_lint.core import all_rules
+    rules = all_rules()
+    assert {"GL701", "GL702", "GL703", "GL704", "GL705", "GL706",
+            "GL801", "GL802", "GL803", "GL804"} <= set(rules)
+    res = _result()
+    gl7_gl8 = [f for f in res.findings
+               if f.rule.startswith(("GL7", "GL8"))]
+    assert gl7_gl8 == [], _render_failure(gl7_gl8)
+
+
 def test_framework_and_tools_are_lint_clean():
     res = _result()
     assert res.errors == [], res.errors
-    assert res.findings == [], "\n" + "\n".join(
-        f.render() for f in res.findings) + (
-        "\n^ new graft_lint finding(s): fix them, suppress inline with "
-        "a reason, or (last resort) extend tools/graft_lint/baseline.json"
-        " via --write-baseline")
+    assert res.findings == [], _render_failure(res.findings)
 
 
 def test_every_suppression_carries_a_reason():

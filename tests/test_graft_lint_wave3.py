@@ -1,0 +1,757 @@
+"""graft_lint wave 3 (ISSUE 13 tentpole): concurrency-lifecycle
+analysis. Fixture-driven good/bad snippets for the wait-discipline
+(GL701-GL706) and resource-lifecycle (GL801-GL804) passes, --fix
+idempotence for GL701/GL704, family selection, and the --changed-only
+CLI mode."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.graft_lint import lint_file, registered_passes  # noqa: E402
+
+
+def _lint_src(tmp_path, src, name="mod.py", **kw):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    passes = [cls() for cls in registered_passes().values()]
+    findings, suppressed, err = lint_file(str(p), passes, **kw)
+    assert err is None, err
+    return findings, suppressed
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+def test_wave3_passes_registered():
+    assert {"wait-discipline", "resource-lifecycle"} <= set(
+        registered_passes())
+
+
+# -- GL701: unbounded blocking waits -----------------------------------------
+
+def test_gl701_unbounded_event_wait_and_future_result(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        done = threading.Event()
+        pool = ThreadPoolExecutor(2)
+
+        def close():
+            done.wait()
+
+        def collect(items):
+            futs = [pool.submit(str, i) for i in items]
+            return [f.result() for f in futs]
+    """)
+    gl701 = [f for f in findings if f.rule == "GL701"]
+    assert len(gl701) == 2
+    assert all(f.fix is not None for f in gl701), \
+        "GL701 must be autofixable"
+    # teardown reachability is named when provable
+    assert any("teardown" in f.message for f in gl701)
+
+
+def test_gl701_bounded_waits_are_clean(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        done = threading.Event()
+        pool = ThreadPoolExecutor(2)
+
+        def close():
+            if not done.wait(timeout=5.0):
+                raise RuntimeError("worker wedged")
+
+        def collect(items):
+            futs = [pool.submit(str, i) for i in items]
+            return [f.result(5.0) for f in futs]
+    """)
+    assert [f for f in findings if f.rule == "GL701"] == []
+
+
+def test_gl701_unbounded_wait_for_flagged(tmp_path):
+    """wait_for(predicate) with no timeout is still unbounded — the
+    mandatory predicate positional must not read as a bound."""
+    findings, _ = _lint_src(tmp_path, """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._ready = False
+
+            def block(self):
+                with self._cond:
+                    self._cond.wait_for(lambda: self._ready)
+    """)
+    gl701 = [f for f in findings if f.rule == "GL701"]
+    assert len(gl701) == 1
+    assert gl701[0].fix is not None
+
+
+def test_gl701_queue_join_reported_without_fix(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import queue
+
+        q = queue.Queue()
+
+        def drain():
+            q.join()
+    """)
+    gl701 = [f for f in findings if f.rule == "GL701"]
+    assert len(gl701) == 1
+    assert gl701[0].fix is None    # Queue.join has no timeout to insert
+
+
+def test_gl701_does_not_double_flag_gl302_territory(tmp_path):
+    """Thread.join()/Queue.get() stay GL302's: one defect, one rule."""
+    findings, _ = _lint_src(tmp_path, """
+        import queue
+        import threading
+
+        q = queue.Queue()
+        t = threading.Thread(target=print, daemon=True)
+
+        def run():
+            q.get()
+            t.join()
+    """)
+    assert [f for f in findings if f.rule == "GL701"] == []
+    assert _rules(findings).count("GL302") == 2
+
+
+# -- GL702: blocking while holding a lock ------------------------------------
+
+def test_gl702_sleep_and_queue_get_under_lock(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import queue
+        import threading
+        import time
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def tick(self):
+                with self._lock:
+                    time.sleep(0.1)
+                    item = self._q.get(timeout=1.0)
+                return item
+    """)
+    assert _rules([f for f in findings if f.rule == "GL702"]) \
+        == ["GL702", "GL702"]
+
+
+def test_gl702_condition_wait_on_held_cond_is_exempt(tmp_path):
+    """`with self._cond: self._cond.wait(...)` releases that lock by
+    design — the condition idiom must not be flagged."""
+    findings, _ = _lint_src(tmp_path, """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._ready = False
+
+            def block(self):
+                with self._cond:
+                    while not self._ready:
+                        self._cond.wait(0.5)
+                    return True
+    """)
+    assert [f for f in findings if f.rule == "GL702"] == []
+
+
+def test_gl702_blocking_outside_the_lock_is_clean(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import threading
+        import time
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def tick(self):
+                with self._lock:
+                    self._n += 1
+                time.sleep(0.1)
+    """)
+    assert [f for f in findings if f.rule == "GL702"] == []
+
+
+# -- GL703: lock-order cycles ------------------------------------------------
+
+def test_gl703_ab_ba_cycle(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        return 1
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        return 2
+    """)
+    gl703 = [f for f in findings if f.rule == "GL703"]
+    assert len(gl703) == 1
+    assert gl703[0].symbol == "Pair._a/_b"
+
+
+def test_gl703_self_deadlock_through_a_call(tmp_path):
+    """Holding a non-reentrant Lock and calling a method that takes it
+    again — one level of call expansion catches the self-deadlock."""
+    findings, _ = _lint_src(tmp_path, """
+        import threading
+
+        class SelfLock:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def flush(self):
+                with self._lock:
+                    self._reset()
+
+            def _reset(self):
+                with self._lock:
+                    self._n = 0
+    """)
+    gl703 = [f for f in findings if f.rule == "GL703"]
+    assert len(gl703) == 1
+    assert "re-acquired" in gl703[0].message
+
+
+def test_gl703_consistent_order_is_clean(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import threading
+
+        class Ordered:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        return 1
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        return 2
+    """)
+    assert [f for f in findings if f.rule == "GL703"] == []
+
+
+# -- GL704: condition wait without predicate re-check ------------------------
+
+_GL704_BAD = """
+    import threading
+
+    class WaitBox:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self._ready = False
+
+        def block(self):
+            with self._cond:
+                if not self._ready:
+                    self._cond.wait(1.0)
+                return self._ready
+"""
+
+
+def test_gl704_if_guarded_wait_flagged_with_fix(tmp_path):
+    findings, _ = _lint_src(tmp_path, _GL704_BAD)
+    gl704 = [f for f in findings if f.rule == "GL704"]
+    assert len(gl704) == 1
+    assert gl704[0].fix is not None, \
+        "`if pred: wait()` must carry the while rewrite"
+
+
+def test_gl704_while_loop_wait_is_clean(tmp_path):
+    findings, _ = _lint_src(tmp_path, _GL704_BAD.replace(
+        "if not self._ready:", "while not self._ready:"))
+    assert [f for f in findings if f.rule == "GL704"] == []
+
+
+def test_gl704_wait_for_is_clean(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import threading
+
+        class WaitBox:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._ready = False
+
+            def block(self):
+                with self._cond:
+                    self._cond.wait_for(lambda: self._ready,
+                                        timeout=1.0)
+    """)
+    assert [f for f in findings if f.rule == "GL704"] == []
+
+
+# -- GL705: busy-spin continue paths -----------------------------------------
+
+def test_gl705_nowait_retry_spin(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import queue
+
+        def pump(q, stop, handle):
+            while not stop.is_set():
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    continue
+                handle(item)
+    """)
+    gl705 = [f for f in findings if f.rule == "GL705"]
+    assert len(gl705) == 1
+
+
+def test_gl705_bounded_get_dominates_the_continue(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import queue
+
+        def pump(q, stop, handle):
+            while not stop.is_set():
+                try:
+                    item = q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                handle(item)
+    """)
+    assert [f for f in findings if f.rule == "GL705"] == []
+
+
+def test_gl705_worklist_loops_are_out_of_scope(tmp_path):
+    """`while stack:` drains its own test state — a compute loop, not a
+    spin on another thread."""
+    findings, _ = _lint_src(tmp_path, """
+        def walk(stack, seen):
+            while stack:
+                node = stack.pop()
+                if node in seen:
+                    continue
+                seen.add(node)
+    """)
+    assert [f for f in findings if f.rule == "GL705"] == []
+
+
+def test_gl705_progress_before_continue_is_clean(tmp_path):
+    """Consuming work before looping back is progress, not a spin."""
+    findings, _ = _lint_src(tmp_path, """
+        def pump(q, stop, handle):
+            while True:
+                item, dropped = q.pop_ready()
+                for d in dropped:
+                    d.settle()
+                if item is None:
+                    continue
+                handle(item)
+    """)
+    assert [f for f in findings if f.rule == "GL705"] == []
+
+
+# -- GL706: init-started thread with no teardown join ------------------------
+
+_GL706_SRC = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._stop = threading.Event()
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            while not self._stop.wait(0.1):
+                pass
+
+        def close(self):
+            self._stop.set()
+{join}
+"""
+
+
+def test_gl706_unjoined_init_thread(tmp_path):
+    findings, _ = _lint_src(tmp_path, _GL706_SRC.format(join=""))
+    gl706 = [f for f in findings if f.rule == "GL706"]
+    assert len(gl706) == 1
+    assert gl706[0].symbol == "Worker._t"
+
+
+def test_gl706_join_in_close_is_clean(tmp_path):
+    findings, _ = _lint_src(tmp_path, _GL706_SRC.format(
+        join="            self._t.join(timeout=1.0)\n"))
+    assert [f for f in findings if f.rule == "GL706"] == []
+
+
+def test_gl706_join_through_teardown_helper_is_clean(tmp_path):
+    src = _GL706_SRC.format(
+        join="            self._reap()\n\n"
+             "        def _reap(self):\n"
+             "            self._t.join(timeout=1.0)\n")
+    findings, _ = _lint_src(tmp_path, src)
+    assert [f for f in findings if f.rule == "GL706"] == []
+
+
+# -- GL801: exception window between acquire and release ---------------------
+
+def test_gl801_raising_call_before_release_registered(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import socket
+
+        def connect(addr, handshake):
+            sock = socket.create_connection(addr)
+            handshake(sock)
+            return sock
+    """)
+    gl801 = [f for f in findings if f.rule == "GL801"]
+    assert len(gl801) == 1
+    assert gl801[0].symbol == "connect.sock"
+
+
+def test_gl801_protected_by_closing_handler_is_clean(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import socket
+
+        def connect(addr, handshake):
+            sock = socket.create_connection(addr)
+            try:
+                handshake(sock)
+            except Exception:
+                sock.close()
+                raise
+            return sock
+    """)
+    assert [f for f in findings if f.rule == "GL801"] == []
+
+
+def test_gl801_with_block_and_immediate_publish_are_clean(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import socket
+
+        def read_all(path, register):
+            with open(path) as fh:
+                data = fh.read()
+            sock = socket.create_connection(("h", 1))
+            register.append(sock)
+            return data
+    """)
+    assert [f for f in findings if f.rule == "GL801"] == []
+
+
+# -- GL802: publish without re-checking the closed flag ----------------------
+
+_GL802_SRC = """
+    import socket
+    import threading
+
+    class Client:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._closed = False
+            self._sock = None
+
+        def connect(self, addr):
+            sock = socket.create_connection(addr)
+            with self._lock:
+{check}                self._sock = sock
+
+        def close(self):
+            with self._lock:
+                self._closed = True
+                if self._sock is not None:
+                    self._sock.close()
+"""
+
+
+def test_gl802_publish_without_closed_recheck(tmp_path):
+    findings, _ = _lint_src(tmp_path, _GL802_SRC.format(check=""))
+    gl802 = [f for f in findings if f.rule == "GL802"]
+    assert len(gl802) == 1
+    assert gl802[0].symbol == "Client._sock"
+
+
+def test_gl802_recheck_under_lock_is_clean(tmp_path):
+    findings, _ = _lint_src(tmp_path, _GL802_SRC.format(
+        check="                if self._closed:\n"
+              "                    sock.close()\n"
+              "                    raise RuntimeError(\"closed\")\n"))
+    assert [f for f in findings if f.rule == "GL802"] == []
+
+
+# -- GL803: charge without finally-guaranteed release ------------------------
+
+def test_gl803_unprotected_charge(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import threading
+
+        class Work:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._active = 0
+
+            def run_one(self, job):
+                with self._lock:
+                    self._active += 1
+                job()
+                with self._lock:
+                    self._active -= 1
+    """)
+    gl803 = [f for f in findings if f.rule == "GL803"]
+    assert len(gl803) == 1
+    assert gl803[0].symbol == "run_one._active"
+
+
+def test_gl803_finally_guarded_charge_is_clean(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        import threading
+
+        class Work:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._active = 0
+
+            def run_one(self, job):
+                with self._lock:
+                    self._active += 1
+                try:
+                    job()
+                finally:
+                    with self._lock:
+                        self._active -= 1
+    """)
+    assert [f for f in findings if f.rule == "GL803"] == []
+
+
+# -- GL804: teardown callbacks without a once-guard --------------------------
+
+_GL804_SRC = """
+    import threading
+
+    class Owner:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._dropped = False
+            self._drops = 0
+
+        def _drop_conn(self):
+            with self._lock:
+{guard}                self._drops += 1
+
+        def worker(self):
+            self._drop_conn()
+
+        def shutdown(self):
+            self._drop_conn()
+"""
+
+
+def test_gl804_two_owners_no_once_guard(tmp_path):
+    findings, _ = _lint_src(tmp_path, _GL804_SRC.format(guard=""))
+    gl804 = [f for f in findings if f.rule == "GL804"]
+    assert len(gl804) == 1
+    assert gl804[0].symbol == "Owner._drop_conn"
+
+
+def test_gl804_early_return_guard_is_clean(tmp_path):
+    findings, _ = _lint_src(tmp_path, _GL804_SRC.format(
+        guard="                if self._dropped:\n"
+              "                    return\n"
+              "                self._dropped = True\n"))
+    assert [f for f in findings if f.rule == "GL804"] == []
+
+
+def test_gl804_single_owner_is_clean(tmp_path):
+    src = _GL804_SRC.format(guard="").replace(
+        "        def shutdown(self):\n"
+        "            self._drop_conn()\n", "")
+    findings, _ = _lint_src(tmp_path, src)
+    assert [f for f in findings if f.rule == "GL804"] == []
+
+
+# -- both passes skip test files ---------------------------------------------
+
+def test_wave3_passes_skip_test_files(tmp_path):
+    src = """
+        import threading
+
+        done = threading.Event()
+
+        def test_blocking():
+            done.wait()
+    """
+    findings, _ = _lint_src(tmp_path, src, name="test_fixture.py")
+    assert [f for f in findings
+            if f.rule.startswith(("GL7", "GL8"))] == []
+    findings, _ = _lint_src(tmp_path, src, name="helper.py")
+    assert [f for f in findings if f.rule == "GL701"] != []
+
+
+# -- CLI: family selection, --fix idempotence, --changed-only ----------------
+
+def _run_cli(*args, cwd=REPO):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graft_lint", *args],
+        capture_output=True, text=True, cwd=cwd, env=env)
+
+
+def test_cli_gl7_gl8_family_select(tmp_path):
+    (tmp_path / "mod.py").write_text(textwrap.dedent("""
+        import socket
+        import threading
+
+        done = threading.Event()
+
+        def close(handshake):
+            sock = socket.create_connection(("h", 1))
+            handshake(sock)
+            done.wait()
+    """))
+    proc = _run_cli(str(tmp_path), "--no-baseline", "--select", "GL7",
+                    "--json")
+    data = json.loads(proc.stdout)
+    assert [f["rule"] for f in data["findings"]] == ["GL701"]
+    proc = _run_cli(str(tmp_path), "--no-baseline", "--select", "GL8",
+                    "--json")
+    data = json.loads(proc.stdout)
+    assert [f["rule"] for f in data["findings"]] == ["GL801"]
+
+
+def test_cli_list_rules_includes_wave3_groups():
+    proc = _run_cli("--list-rules", "--json")
+    assert proc.returncode == 0
+    data = json.loads(proc.stdout)
+    assert "GL701" in data["groups"]["wait-discipline"]
+    assert "GL801" in data["groups"]["resource-lifecycle"]
+    for rid in ("GL702", "GL703", "GL704", "GL705", "GL706",
+                "GL802", "GL803", "GL804"):
+        assert rid in data["rules"], rid
+
+
+def test_cli_fix_gl701_and_gl704_idempotent(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+
+        done = threading.Event()
+
+        class WaitBox:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._ready = False
+
+            def block(self):
+                with self._cond:
+                    if not self._ready:
+                        self._cond.wait()
+
+        def close():
+            done.wait()
+    """))
+    proc = _run_cli(str(tmp_path), "--no-baseline", "--fix")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    fixed = mod.read_text()
+    assert "done.wait(timeout=5.0)" in fixed
+    assert "while not self._ready:" in fixed
+    assert "self._cond.wait(timeout=5.0)" in fixed
+    # second run: converged — nothing applied, file byte-identical
+    proc = _run_cli(str(tmp_path), "--no-baseline", "--fix")
+    assert "applied 0 fix(es)" in proc.stdout
+    assert mod.read_text() == fixed
+    # and the fixed file is wave3-clean
+    proc = _run_cli(str(tmp_path), "--no-baseline", "--select", "GL7,GL8")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+_CHANGED_CLEAN = "x = 1\n"
+_CHANGED_BAD = textwrap.dedent("""
+    import threading
+
+    done = threading.Event()
+
+    def close():
+        done.wait()
+""")
+
+
+def _git(cwd, *args):
+    return subprocess.run(
+        ["git", "-C", str(cwd), *args], capture_output=True, text=True,
+        env={**os.environ, "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL":
+             "t@t", "GIT_COMMITTER_NAME": "t",
+             "GIT_COMMITTER_EMAIL": "t@t"})
+
+
+def test_cli_changed_only_lints_only_the_diff(tmp_path):
+    repo = tmp_path / "r"
+    repo.mkdir()
+    assert _git(repo, "init", "-b", "main").returncode == 0
+    # a pre-existing offender on main must NOT be linted in changed-only
+    (repo / "old.py").write_text(_CHANGED_BAD)
+    (repo / "base.py").write_text(_CHANGED_CLEAN)
+    _git(repo, "add", "-A")
+    assert _git(repo, "commit", "-m", "base").returncode == 0
+    _git(repo, "checkout", "-b", "feature")
+    (repo / "new.py").write_text(_CHANGED_BAD.replace("done", "fresh"))
+    proc = _run_cli(str(repo), "--no-baseline", "--changed-only",
+                    "--json")
+    data = json.loads(proc.stdout)
+    assert data["findings"], proc.stdout + proc.stderr
+    assert {os.path.basename(f["path"]) for f in data["findings"]} \
+        == {"new.py"}
+
+
+def test_cli_changed_only_trivially_clean_when_no_changes(tmp_path):
+    repo = tmp_path / "r"
+    repo.mkdir()
+    assert _git(repo, "init", "-b", "main").returncode == 0
+    (repo / "old.py").write_text(_CHANGED_BAD)
+    _git(repo, "add", "-A")
+    assert _git(repo, "commit", "-m", "base").returncode == 0
+    proc = _run_cli(str(repo), "--no-baseline", "--changed-only")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no changed python files" in proc.stdout
+
+
+def test_cli_changed_only_falls_back_without_git(tmp_path):
+    (tmp_path / "mod.py").write_text(_CHANGED_BAD)
+    proc = _run_cli(str(tmp_path), "--no-baseline", "--changed-only",
+                    "--json")
+    data = json.loads(proc.stdout)
+    assert data["findings"], "fallback must lint the full path set"
+    assert "falling back" in proc.stderr or "full path set" in proc.stderr
+
+
+def test_cli_changed_only_refuses_baseline_writes(tmp_path):
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    proc = _run_cli(str(tmp_path), "--baseline",
+                    str(tmp_path / "b.json"), "--changed-only",
+                    "--write-baseline")
+    assert proc.returncode == 2 and "refusing" in proc.stderr

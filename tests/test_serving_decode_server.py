@@ -364,8 +364,11 @@ class TestObservability:
             srv.generate(rng.randint(0, 250, (5,)).astype(np.int32),
                          max_new_tokens=2, timeout=120)
             scrape = profiler.export_stats()
-            assert set(scrape) == {"pipeline", "serving", "decode",
-                                   "resilience", "router", "transport"}
+            # derive the expected registry set from the profiler's own
+            # introspection: hardcoding it here broke this test in two
+            # separate PRs every time a new stats source landed
+            assert set(scrape) == set(profiler.stats_registries())
+            assert {"pipeline", "serving", "decode"} <= set(scrape)
             assert "decode_test_export" in scrape["decode"]
 
             import json

@@ -281,6 +281,42 @@ class TestFaultInjectorScoped:
             th.join(2.0)
             assert released == [True]             # heal released it
 
+    def test_scoped_nesting_with_socket_faults_in_teardown(self):
+        """ISSUE 13 satellite: scoped() nesting with the PR 11 socket
+        fault kinds armed, exercised through a real transport teardown.
+        Pins two things at once: (1) the inner scope enters disarmed
+        and hands the outer socket arming back intact on exit; (2) the
+        wave-3 bounded-wait discipline (RemoteBackend.close joins its
+        keepalive with a timeout) does not change fault-drill
+        semantics — close() returns promptly with a blackhole armed."""
+        from paddle_tpu.serving.transport import RemoteBackend
+        inj = get_fault_injector()
+        with inj.scoped():
+            inj.arm_socket_trickle("outer_px", bytes_per_s=128.0)
+            with inj.scoped() as inner:
+                # entered disarmed despite the outer socket arming
+                assert inj.socket_action("outer_px", "io") is None
+                inner.arm_socket_blackhole("inner_px")
+                kind, waiter = inj.socket_action("inner_px", "io")
+                assert kind == "hang"
+                # the teardown path under an armed fault: a lazy (never
+                # connected) backend's close() must be prompt — the
+                # keepalive join is bounded, the fault stays armed
+                b = RemoteBackend("inner_px", ("127.0.0.1", 1),
+                                  lazy=True, keepalive_s=0.05)
+                t0 = time.monotonic()
+                b.close()
+                assert time.monotonic() - t0 < 2.0
+                assert inj.socket_action("inner_px", "accept") \
+                    == ("refuse",)
+                # a parked forwarder inside the scope is bounded too
+                assert waiter(0.05) is False
+            # inner arming gone, outer trickle restored verbatim
+            assert inj.socket_action("inner_px", "accept") is None
+            assert inj.socket_action("outer_px", "io") \
+                == ("trickle", 128.0)
+        assert not inj.armed
+
 
 # ---------------------------------------------------------------------------
 # lifecycle idempotence under interpreter shutdown (ISSUE 10 satellite)

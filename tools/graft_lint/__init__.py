@@ -29,10 +29,20 @@ Passes (see README "Static analysis" for the rule table):
 - ``recompile-hazard`` (GL601-GL604): loop-varying shapes into jitted
   calls, ``static_argnums`` misuse, traced closures over mutable
   module globals, bucketless shape-dependent dispatch.
+- ``wait-discipline`` (GL701-GL706): unbounded blocking waits,
+  blocking calls under a lock, AB/BA lock-order cycles, condition
+  waits without a predicate re-check loop, busy-spin ``continue``
+  paths, init-started threads with no teardown join.
+- ``resource-lifecycle`` (GL801-GL804): fd-leaking exception windows
+  between acquire and release, acquire-then-publish races past the
+  closed flag, charges without a finally-guaranteed release, teardown
+  callbacks invoked from two owners without a once-guard.
 
 ``--fix`` applies the conservative mechanical repairs attached to
-GL002/GL301/GL302/GL503 findings (exact-span edits, idempotent);
-``--fix --diff`` previews them without writing.
+GL002/GL301/GL302/GL503/GL701/GL704 findings (exact-span edits,
+idempotent); ``--fix --diff`` previews them without writing.
+``--changed-only`` narrows the run to files changed vs
+``git merge-base HEAD main`` for the inner loop.
 
 Suppress a finding inline (the reason is mandatory)::
 

@@ -54,10 +54,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="drop baseline entries whose fingerprint no "
                         "longer matches any live finding, keep the "
                         "rest, and exit 0")
+    p.add_argument("--changed-only", action="store_true",
+                   help="lint only files changed vs `git merge-base "
+                        "HEAD main` (plus untracked files); falls back "
+                        "to the full path set when git or main is "
+                        "unavailable — keeps the heavier passes fast "
+                        "in the inner loop")
     p.add_argument("--fix", action="store_true",
                    help="apply the mechanical repairs attached to "
-                        "autofixable findings (GL002/GL301/GL302/GL503); "
-                        "second run is a no-op")
+                        "autofixable findings (GL002/GL301/GL302/GL503/"
+                        "GL701/GL704); second run is a no-op")
     p.add_argument("--diff", action="store_true",
                    help="with --fix: print the unified diff of what "
                         "--fix would change, write nothing")
@@ -129,6 +135,43 @@ def _prune_baseline(baseline_path: str, paths: List[str]) -> int:
     return 0
 
 
+def _changed_files(paths: List[str]):
+    """Absolute paths of .py files changed vs ``merge-base(HEAD,
+    main)`` or untracked, or None when git cannot answer (not a repo,
+    no main, git missing)."""
+    import subprocess
+    anchor = os.path.abspath(paths[0])
+    if os.path.isfile(anchor):
+        anchor = os.path.dirname(anchor)
+
+    def run(*args):
+        try:
+            return subprocess.run(["git", "-C", anchor, *args],
+                                  capture_output=True, text=True,
+                                  timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+
+    top = run("rev-parse", "--show-toplevel")
+    if top is None or top.returncode != 0:
+        return None
+    root = top.stdout.strip()
+    mb = run("merge-base", "HEAD", "main")
+    if mb is None or mb.returncode != 0:
+        return None
+    diff = run("diff", "--name-only", mb.stdout.strip())
+    untracked = run("ls-files", "--others", "--exclude-standard")
+    if diff is None or untracked is None \
+            or diff.returncode != 0 or untracked.returncode != 0:
+        return None
+    out = set()
+    for line in (diff.stdout + untracked.stdout).splitlines():
+        line = line.strip()
+        if line.endswith(".py"):
+            out.add(os.path.abspath(os.path.join(root, line)))
+    return out
+
+
 def _apply_fixes(result, diff_only: bool, stream):
     """Apply (or diff) every fix attached to an actionable finding.
     Returns (n_applied, n_files, n_skipped, fixed_findings)."""
@@ -189,6 +232,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"graft_lint: {' and '.join(exclusive)} are mutually "
               "exclusive", file=sys.stderr)
         return 2
+    if args.changed_only and (args.write_baseline or args.prune_baseline):
+        # a baseline touched from the changed-files view would silently
+        # drop every accepted finding outside the diff
+        print("graft_lint: refusing --write-baseline/--prune-baseline "
+              "with --changed-only (a partial file view would drop "
+              "accepted findings from the baseline)", file=sys.stderr)
+        return 2
 
     paths = args.paths or [os.path.join(_REPO, d) for d in DEFAULT_PATHS]
     missing = [p for p in paths if not os.path.exists(p)]
@@ -223,6 +273,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                 return 2
     if args.prune_baseline:
         return _prune_baseline(baseline_path, paths)
+
+    if args.changed_only:
+        changed = _changed_files(paths)
+        if changed is None:
+            print("graft_lint: --changed-only: git/main unavailable; "
+                  "falling back to the full path set", file=sys.stderr)
+        else:
+            files = [f for f in iter_python_files(paths)
+                     if os.path.abspath(f) in changed]
+            if not files:
+                print("graft_lint: --changed-only: no changed python "
+                      "files under the given paths; 0 finding(s)")
+                return 0
+            paths = files
 
     baseline = None
     if not args.no_baseline and not args.write_baseline \

@@ -17,15 +17,17 @@ deliberately conservative:
   statement above its innermost loop, and the re-lint judges it against
   the next one.)
 
-Only four rules are autofixable — GL301 (insert an explicit
-``daemon=True``), GL302 (insert a ``timeout=``), GL002 (insert a
-suppression-reason template for a human to edit), and GL503 (hoist a
-loop-invariant ``device_get`` out of the loop). Everything else stays
+Only six rules are autofixable — GL301 (insert an explicit
+``daemon=True``), GL302/GL701 (insert a ``timeout=``), GL002 (insert a
+suppression-reason template for a human to edit), GL503 (hoist a
+loop-invariant ``device_get`` out of the loop), and GL704 (rewrite the
+``if pred: cond.wait()`` guard to ``while``). Everything else stays
 report-only: a rewrite that needs judgment is a review comment, not an
-edit. GL302 is the one repair that changes runtime behavior — a
-blocking wait becomes a 5-second one, so ``queue.Empty`` / a returning
-``join`` become reachable; its fix note flags exactly that for review,
-and ``--fix --diff`` exists to read before writing.
+edit. GL302/GL701 are the repairs that change runtime behavior — a
+blocking wait becomes a 5-second one, so ``queue.Empty`` / a timing-out
+``result()`` / a returning ``join`` become reachable; their fix notes
+flag exactly that for review, and ``--fix --diff`` exists to read
+before writing.
 """
 from __future__ import annotations
 
@@ -35,7 +37,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Edit", "Fix", "line_offsets", "span_offset", "apply_fixes",
            "call_keyword_fix", "reason_template_fix", "hoist_stmt_fix",
-           "unified_diff"]
+           "if_to_while_fix", "unified_diff"]
 
 
 @dataclass(frozen=True)
@@ -179,6 +181,17 @@ def hoist_stmt_fix(src: str, stmt, loop, note: str) -> Optional[Fix]:
     return Fix(edits=[Edit(del_start, del_end, ""),
                       Edit(ins, ins, "".join(moved))],
                note=note)
+
+
+def if_to_while_fix(src: str, if_node, note: str) -> Optional[Fix]:
+    """GL704: rewrite ``if pred: cond.wait()`` to ``while pred:
+    cond.wait()`` — the predicate re-check loop the condition protocol
+    requires. The caller has already verified the shape (single-
+    statement body, no else); this just swaps the keyword token."""
+    start = span_offset(src, if_node.lineno, if_node.col_offset)
+    if src[start:start + 2] != "if":
+        return None
+    return Fix(edits=[Edit(start, start + 2, "while")], note=note)
 
 
 # -- applying ----------------------------------------------------------------

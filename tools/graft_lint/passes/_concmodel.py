@@ -1,0 +1,479 @@
+"""Shared concurrency model for the wave-3 lifecycle passes
+(wait-discipline GL7xx, resource-lifecycle GL8xx).
+
+PRs 8-11 made the codebase thread-heavy (receiver/relay/dispatch/
+write-behind/keepalive/watchdog loops), and their review-hardening
+sections are lists of hand-found deadlocks and leaks. The two wave-3
+passes share one inventory so they agree on what things ARE:
+
+- *kinds*: a module-wide map from value keys (``x`` locals, ``self.X``
+  attributes) to concurrency kinds — lock/condition/event/thread/queue/
+  future/socket/executor — resolved from constructor calls the way
+  ``thread_hygiene`` already does, extended with ``pool.submit(...)``
+  futures (including lists of them fanned back in via ``for f in
+  futs``).
+- *teardown roots*: the methods a shutdown path enters
+  (``close``/``shutdown``/``stop``/``__exit__``/``__del__``/...), with
+  ``_hotpath``-style intra-module reachability, so "reachable from a
+  teardown root" means the same thing in every rule message.
+- *blocking calls*: one classification of which calls park the calling
+  thread, in two strictness tiers — a narrow, kind-resolved tier for
+  "you are holding a lock across this" findings, and a broad,
+  name-based tier for "this loop never yields the CPU" domination
+  checks (broad on purpose: for busy-spin detection a false
+  "it blocks" is the safe direction).
+
+Both passes skip test files: tests park on events and futures
+deliberately, and pytest's own timeouts bound them — the gate the
+ISSUE specifies is zero findings over ``paddle_tpu + tools``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ._hotpath import HOT_ROOT_NAMES, _called_names
+
+# -- kinds -------------------------------------------------------------------
+
+KIND_CTORS = {
+    "Lock": "lock", "RLock": "rlock", "Semaphore": "lock",
+    "BoundedSemaphore": "lock", "Condition": "condition",
+    "Event": "event",
+    "Thread": "thread", "Process": "thread", "Timer": "thread",
+    "Queue": "queue", "LifoQueue": "queue", "PriorityQueue": "queue",
+    "SimpleQueue": "queue", "JoinableQueue": "queue",
+    "Future": "future",
+    "socket": "socket", "create_connection": "socket",
+    "mmap": "mmap",
+    "ThreadPoolExecutor": "executor", "ProcessPoolExecutor": "executor",
+}
+
+LOCK_KINDS = {"lock", "rlock", "condition"}
+_LOCKY_NAME_SUFFIXES = ("lock", "cond", "mutex", "condition")
+
+TEARDOWN_ROOT_NAMES = {"close", "shutdown", "stop", "terminate", "abort",
+                       "release", "disconnect", "drain", "__del__",
+                       "__exit__"}
+
+
+def ctor_name(node) -> Optional[str]:
+    """``threading.Event()`` / ``Queue()`` -> the constructor name."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def target_key(node) -> Optional[str]:
+    """Name -> ``"x"``; ``self.X``/``cls.X`` -> ``"self.X"`` (tracked
+    per module like thread_hygiene: classes rarely reuse attr names for
+    different kinds)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        owner = "self" if node.value.id in ("self", "cls") \
+            else node.value.id
+        return f"{owner}.{node.attr}"
+    return None
+
+
+def dotted_name(node) -> Optional[str]:
+    """``time.sleep`` -> "time.sleep"; ``sleep`` -> "sleep"."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return f"{node.value.id}.{node.attr}"
+    return None
+
+
+def _is_submit_call(node) -> bool:
+    return isinstance(node, ast.Call) \
+        and isinstance(node.func, ast.Attribute) \
+        and node.func.attr == "submit"
+
+
+class Binder(ast.NodeVisitor):
+    """Module-wide kinds map (key -> kind). ``futures`` marks a
+    list/generator of ``.submit(...)`` results, so ``for f in futs:``
+    (statement or comprehension) resolves ``f`` to a future."""
+
+    def __init__(self):
+        self.kinds: Dict[str, str] = {}
+
+    def _bind_value(self, targets: Iterable[ast.AST], value) -> None:
+        kind = KIND_CTORS.get(ctor_name(value) or "")
+        if kind is None and _is_submit_call(value):
+            kind = "future"
+        if kind is None and isinstance(value, (ast.ListComp,
+                                               ast.GeneratorExp)) \
+                and _is_submit_call(value.elt):
+            kind = "futures"
+        if kind is None:
+            return
+        for t in targets:
+            key = target_key(t)
+            if key:
+                self.kinds[key] = kind
+
+    def visit_Assign(self, node: ast.Assign):
+        self._bind_value(node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._bind_value([node.target], node.value)
+        self.generic_visit(node)
+
+    def visit_withitem(self, node: ast.withitem):
+        if node.optional_vars is not None:
+            self._bind_value([node.optional_vars], node.context_expr)
+        self.generic_visit(node)
+
+    def _bind_iteration(self, target, iter_node):
+        key = target_key(iter_node)
+        if key and self.kinds.get(key) == "futures":
+            tkey = target_key(target)
+            if tkey:
+                self.kinds[tkey] = "future"
+
+    def visit_For(self, node: ast.For):
+        self._bind_iteration(node.target, node.iter)
+        self.generic_visit(node)
+
+    def visit_comprehension(self, node: ast.comprehension):
+        self._bind_iteration(node.target, node.iter)
+        self.generic_visit(node)
+
+
+def bind_kinds(tree: ast.AST) -> Dict[str, str]:
+    b = Binder()
+    b.visit(tree)
+    return b.kinds
+
+
+def receiver_kind(call: ast.Call, kinds: Dict[str, str]) -> Optional[str]:
+    """Resolved kind of ``recv`` in ``recv.attr(...)``, following the
+    direct ``pool.submit(...).result()`` chain."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    recv = call.func.value
+    if _is_submit_call(recv):
+        return "future"
+    key = target_key(recv)
+    return kinds.get(key) if key else None
+
+
+def lock_key_of_withitem(item: ast.withitem,
+                         kinds: Dict[str, str]) -> Optional[str]:
+    """The kinds-map key when this ``with`` item holds a lock: resolved
+    via the kinds map, or (for locks assigned in a base class in
+    another module) via the ``*_lock``/``*_cond`` naming convention."""
+    expr = item.context_expr
+    if isinstance(expr, ast.Call):        # with lock.acquire()-ish
+        expr = expr.func
+        if isinstance(expr, ast.Attribute):
+            expr = expr.value
+    key = target_key(expr)
+    if key is None:
+        return None
+    if kinds.get(key) in LOCK_KINDS:
+        return key
+    if key.lower().endswith(_LOCKY_NAME_SUFFIXES):
+        return key
+    return None
+
+
+# -- bounded / unbounded waits ----------------------------------------------
+
+def has_timeout(call: ast.Call, skip_args: int = 0) -> bool:
+    """Whether this wait carries any bound: a positional arg (wait(5),
+    join(2), result(0.1)) or a ``timeout=`` keyword that is not the
+    literal None. ``skip_args`` ignores leading mandatory positionals
+    that are NOT the timeout (``wait_for(predicate, timeout)``)."""
+    for a in call.args[skip_args:]:
+        if not (isinstance(a, ast.Constant) and a.value is None):
+            return True
+    for k in call.keywords:
+        if k.arg == "timeout":
+            return not (isinstance(k.value, ast.Constant)
+                        and k.value.value is None)
+    return False
+
+
+def classify_unbounded_wait(call: ast.Call, kinds: Dict[str, str]
+                            ) -> Optional[Tuple[str, str, bool]]:
+    """``(key, label, fixable)`` when ``call`` is an unbounded blocking
+    wait of the kinds GL701 owns: ``Event.wait``, ``Condition.wait`` /
+    ``wait_for``, ``Future.result``, ``Queue.join``. (``Thread.join``
+    and blocking ``Queue.get`` stay GL302's — one defect, one rule.)
+    ``fixable`` is False where the API has no timeout parameter."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    kind = receiver_kind(call, kinds)
+    key = target_key(call.func.value) or "<expr>"
+    if kind == "event" and attr == "wait" and not has_timeout(call):
+        return key, f"{key}.wait()", True
+    if kind == "condition" and attr in ("wait", "wait_for") \
+            and not has_timeout(call,
+                                skip_args=1 if attr == "wait_for" else 0):
+        # wait_for's first positional is the predicate, not a bound
+        return key, f"{key}.{attr}()", True
+    if kind == "future" and attr == "result" and not has_timeout(call):
+        return key, f"{key}.result()", True
+    if kind == "queue" and attr == "join":
+        # Queue.join() takes no timeout at all: report-only
+        return key, f"{key}.join()", False
+    return None
+
+
+# -- blocking-call classification --------------------------------------------
+
+# narrow tier: calls we are CONFIDENT park the thread (GL702 flags these
+# while a lock is held, so false positives are expensive)
+_NARROW_BLOCKING_ATTRS = {"recv", "recv_into", "accept", "sendall",
+                          "communicate"}
+_BLOCKING_DOTTED = {"time.sleep", "socket.create_connection",
+                    "select.select"}
+
+
+def blocking_under_lock(call: ast.Call, kinds: Dict[str, str],
+                        held: Set[str]) -> Optional[str]:
+    """A short label when ``call`` blocks and should not run under a
+    lock. ``held`` excludes the condition idiom: ``with self._cond:
+    self._cond.wait()`` releases the lock it waits on."""
+    name = dotted_name(call.func)
+    if name in _BLOCKING_DOTTED or name == "sleep":
+        return name or "sleep"
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    if attr in _NARROW_BLOCKING_ATTRS:
+        return f"{target_key(call.func.value) or '<expr>'}.{attr}()"
+    kind = receiver_kind(call, kinds)
+    key = target_key(call.func.value) or "<expr>"
+    label = f"{key}.{attr}()"
+    if kind == "event" and attr == "wait":
+        return label
+    if kind == "condition" and attr in ("wait", "wait_for") \
+            and key not in held:
+        return label
+    if kind == "future" and attr == "result":
+        return label
+    if kind == "thread" and attr == "join":
+        return label
+    if kind == "queue" and attr in ("get", "put", "join"):
+        # get/put(block=False) / _nowait variants don't park
+        for k in call.keywords:
+            if k.arg == "block" and isinstance(k.value, ast.Constant) \
+                    and k.value.value is False:
+                return None
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and call.args[0].value is False:
+            return None
+        return label
+    return None
+
+
+# broad tier: anything that plausibly yields the CPU (GL705 uses this to
+# prove a continue-path is NOT a busy spin — over-matching is the safe
+# direction there)
+_BROAD_BLOCKING_ATTRS = _NARROW_BLOCKING_ATTRS | {
+    "wait", "wait_for", "result", "join", "get", "acquire", "connect",
+    "send", "poll", "select", "read", "readline", "readinto",
+    "next_token", "put", "recv_msg", "readexactly"}
+
+
+def yields_cpu(node: ast.AST) -> bool:
+    """Whether any call under ``node`` plausibly parks/yields."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = dotted_name(sub.func)
+        if name in _BLOCKING_DOTTED or name == "sleep":
+            return True
+        if isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr in _BROAD_BLOCKING_ATTRS:
+            if sub.func.attr in ("get", "put"):   # *_nowait handled below
+                for k in sub.keywords:
+                    if k.arg == "block" \
+                            and isinstance(k.value, ast.Constant) \
+                            and k.value.value is False:
+                        break
+                else:
+                    return True
+                continue
+            return True
+    return False
+
+
+# pure checks: calls that neither park the thread nor consume work, so
+# a continue-path made of nothing else is a spin. Everything NOT listed
+# here is assumed to make progress — for busy-spin detection the safe
+# error is the false "it made progress".
+_NONPROGRESS_ATTRS = {"is_set", "done", "empty", "full", "qsize",
+                      "monotonic", "time", "perf_counter", "is_alive",
+                      "locked", "getpid", "items", "values", "keys"}
+_NONPROGRESS_NAMES = {"len", "bool", "int", "float", "str", "repr",
+                      "isinstance", "getattr", "hasattr", "id", "min",
+                      "max", "abs", "all", "any", "list", "tuple",
+                      "sorted", "set", "dict", "print"}
+
+
+def makes_progress(node: ast.AST) -> bool:
+    """Whether ``node`` blocks, sleeps, or does ANY work beyond pure
+    state checks — i.e. whether a loop path through it is not a spin."""
+    if yields_cpu(node):
+        return True
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _NONPROGRESS_ATTRS \
+                    or f.attr.endswith("_nowait"):
+                continue
+            return True
+        if isinstance(f, ast.Name) and f.id in _NONPROGRESS_NAMES:
+            continue
+        return True
+    return False
+
+
+# -- resources (GL8xx) -------------------------------------------------------
+
+_RESOURCE_CTORS = {
+    "socket.socket": "socket", "socket.create_connection": "socket",
+    "create_connection": "socket", "open": "file", "os.open": "file",
+    "os.fdopen": "file", "io.open": "file", "gzip.open": "file",
+    "mmap.mmap": "mmap",
+}
+_RESOURCE_METHOD_CTORS = {"accept": "socket", "makefile": "file",
+                          "dup": "socket"}
+
+
+def resource_ctor(value) -> Optional[str]:
+    """The resource kind a call expression acquires, or None."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = dotted_name(value.func)
+    if name in _RESOURCE_CTORS:
+        return _RESOURCE_CTORS[name]
+    if isinstance(value.func, ast.Attribute) \
+            and value.func.attr in _RESOURCE_METHOD_CTORS:
+        return _RESOURCE_METHOD_CTORS[value.func.attr]
+    return None
+
+
+def closes_name(node: ast.AST, name: str) -> bool:
+    """Whether ``node`` contains ``name.close()`` / ``name.shutdown()``
+    or passes ``name`` to a *close-ish* helper (``_hard_close(sock)``)."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        if isinstance(f, ast.Attribute) \
+                and f.attr in ("close", "shutdown", "release") \
+                and isinstance(f.value, ast.Name) and f.value.id == name:
+            return True
+        fname = dotted_name(f) or ""
+        if "close" in fname.lower() and len(sub.args) == 1 \
+                and isinstance(sub.args[0], ast.Name) \
+                and sub.args[0].id == name:
+            return True
+    return False
+
+
+# -- functions & reachability ------------------------------------------------
+
+FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def enclosing_function_map(tree: ast.AST) -> Dict[int, ast.AST]:
+    """node-id -> innermost enclosing function def (a nested def's own
+    node maps to its PARENT def; its body maps to the nested def)."""
+    out: Dict[int, ast.AST] = {}
+
+    def fill(fn: ast.AST) -> None:
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            sub = stack.pop()
+            out[id(sub)] = fn
+            if isinstance(sub, FuncDef):
+                fill(sub)
+                continue
+            stack.extend(ast.iter_child_nodes(sub))
+
+    stack = list(ast.iter_child_nodes(tree))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, FuncDef):
+            fill(node)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def reachable_functions(tree: ast.AST, root_names: Set[str]
+                        ) -> Dict[int, Tuple[ast.AST, str]]:
+    """fn-id -> (fn, why) for every function def reachable from a root
+    name through the module's own call graph (plain-name and ``self.``
+    calls, nested defs included) — the ``_hotpath`` model over an
+    arbitrary root set."""
+    defs: List[ast.AST] = [n for n in ast.walk(tree)
+                           if isinstance(n, FuncDef)]
+    by_name: Dict[str, List[ast.AST]] = {}
+    for d in defs:
+        by_name.setdefault(d.name, []).append(d)
+    hot: Dict[int, Tuple[ast.AST, str]] = {}
+    stack: List[Tuple[ast.AST, str]] = [
+        (d, f"teardown/hot root {d.name!r}")
+        for d in defs if d.name in root_names]
+    while stack:
+        fn, why = stack.pop()
+        if id(fn) in hot:
+            continue
+        hot[id(fn)] = (fn, why)
+        for name in _called_names(fn):
+            for callee in by_name.get(name, []):
+                if id(callee) not in hot:
+                    stack.append((callee,
+                                  f"reachable from {why.split()[-1]} "
+                                  f"via {name!r}"))
+        for sub in ast.walk(fn):
+            if sub is not fn and isinstance(sub, FuncDef) \
+                    and id(sub) not in hot:
+                stack.append((sub, f"nested in {fn.name!r}"))
+    return hot
+
+
+def lifecycle_roots() -> Set[str]:
+    """Teardown + hot roots: the scopes where an unbounded wait turns a
+    wedged peer into a wedged shutdown or a wedged steady-state loop."""
+    return set(TEARDOWN_ROOT_NAMES) | set(HOT_ROOT_NAMES)
+
+
+def is_test_file(path: str) -> bool:
+    base = os.path.basename(path)
+    return base.startswith("test_") or base == "conftest.py"
+
+
+def parent_map(fn: ast.AST) -> Dict[int, ast.AST]:
+    """child-id -> parent node, within one function def (not crossing
+    into nested defs)."""
+    out: Dict[int, ast.AST] = {}
+    stack = [fn]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            out[id(child)] = node
+            if isinstance(child, FuncDef):
+                continue
+            stack.append(child)
+    return out

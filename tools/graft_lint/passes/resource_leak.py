@@ -1,0 +1,391 @@
+"""resource-lifecycle: fds, charges, and teardown callbacks that leak
+on the paths nobody tests — the error path and the race window.
+
+Each rule is the static form of a bug PR 8-11 reviewers found by hand
+in the serving/transport/resilience stack:
+
+GL801 — a socket/file/mmap is acquired into a local and a call that
+        can raise runs before the release is registered (no protecting
+        ``try`` that closes it, not yet published/closed): the
+        exception leaks the fd. The fix is mechanical in shape — move
+        the risky calls inside the ``try`` whose handlers close the
+        resource, or acquire under ``with``.
+GL802 — acquire-then-publish race: a freshly created resource is
+        installed into shared state (``self.X = sock``) without
+        re-reading the closed flag between acquire and publish. A
+        concurrent ``close()`` that ran in between leaves the new
+        resource live on a closed owner — the PR 11
+        ``_ensure_connected`` fd-leak shape.
+GL803 — a counter/charge (``self._active += 1``) whose decrement in
+        the same function is not ``finally``-guaranteed: the error
+        path leaks the charge, and anything draining on the counter
+        (``shutdown(drain=True)``) wedges forever — the PR 11 leaked-
+        ``_active`` shape.
+GL804 — a teardown callback invoked from two or more owners (the
+        worker's ``finally`` AND ``shutdown()``) that mutates counters
+        or metrics without a once-guard (an early ``return`` behind a
+        flag/``pop``): both owners run it and the teardown double-fires
+        — the PR 11 ``_drop_conn`` double-count shape.
+
+Test files are skipped (same rationale as wait-discipline): the gate
+pins zero findings over ``paddle_tpu + tools``.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, LintPass, register
+from ._concmodel import (FuncDef, closes_name, enclosing_function_map,
+                         is_test_file, parent_map, resource_ctor,
+                         target_key)
+
+_CLOSED_FLAG_RE = re.compile(
+    r"^_?(closed|closing|stopped|shutdown|shutting_down|dead|done)$")
+_TEARDOWN_CB_RE = re.compile(
+    r"(drop|died|die\b|close|teardown|cleanup|release|disconnect|"
+    r"shutdown|abort|fail)")
+
+
+def _acquired_local(stmt) -> Optional[Tuple[str, str]]:
+    """``(local_name, kind)`` when ``stmt`` acquires a resource into a
+    local (``sock = socket.create_connection(...)``; ``conn, peer =
+    listener.accept()`` binds the first tuple element)."""
+    if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+        return None
+    kind = resource_ctor(stmt.value)
+    if kind is None:
+        return None
+    t = stmt.targets[0]
+    if isinstance(t, ast.Tuple) and t.elts:
+        t = t.elts[0]
+    if isinstance(t, ast.Name):
+        return t.id, kind
+    return None
+
+
+def _try_protects(try_node: ast.Try, name: str) -> bool:
+    """Handlers or finally close ``name`` — releases are registered."""
+    for h in try_node.handlers:
+        if any(closes_name(s, name) for s in h.body):
+            return True
+    if any(closes_name(s, name) for s in try_node.finalbody):
+        return True
+    return False
+
+
+def _publishes(stmt, name: str) -> bool:
+    """The resource escapes to an owner that can release it: assigned
+    to an attribute/subscript, returned, yielded, registered into a
+    container, or entered as a context manager."""
+    if isinstance(stmt, ast.Assign):
+        if isinstance(stmt.value, ast.Name) and stmt.value.id == name:
+            return True
+    if isinstance(stmt, ast.Return) and stmt.value is not None:
+        for sub in ast.walk(stmt.value):
+            if isinstance(sub, ast.Name) and sub.id == name:
+                return True
+    if isinstance(stmt, ast.With):
+        for item in stmt.items:
+            for sub in ast.walk(item.context_expr):
+                if isinstance(sub, ast.Name) and sub.id == name:
+                    return True
+    for sub in ast.walk(stmt):
+        if isinstance(sub, ast.Yield) and sub.value is not None:
+            if any(isinstance(n, ast.Name) and n.id == name
+                   for n in ast.walk(sub.value)):
+                return True
+        if isinstance(sub, ast.Call) \
+                and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr in ("append", "add", "put",
+                                      "put_nowait", "register",
+                                      "setdefault"):
+            if any(isinstance(a, ast.Name) and a.id == name
+                   for a in sub.args):
+                return True
+    return False
+
+
+def _has_risky_call(stmt, name: str) -> bool:
+    """Any call that can raise, other than closing ``name`` itself and
+    the pure check/clock calls the progress model already whitelists."""
+    from ._concmodel import _NONPROGRESS_ATTRS, _NONPROGRESS_NAMES
+    for sub in ast.walk(stmt):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in ("close", "shutdown") \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == name:
+                continue
+            if f.attr in _NONPROGRESS_ATTRS:
+                continue
+        if isinstance(f, ast.Name) and f.id in _NONPROGRESS_NAMES:
+            continue
+        return True
+    return False
+
+
+@register
+class ResourceLifecyclePass(LintPass):
+    name = "resource-lifecycle"
+    rules = {
+        "GL801": "resource acquired, then a raising call before the "
+                 "release is registered: the exception leaks the fd — "
+                 "move the call inside the protecting try (or use "
+                 "with)",
+        "GL802": "fresh resource published into shared state without "
+                 "re-checking the closed flag: a concurrent close() "
+                 "leaves it alive on a closed owner",
+        "GL803": "counter incremented without a finally-guaranteed "
+                 "decrement: the error path leaks the charge and "
+                 "drain waits forever",
+        "GL804": "teardown callback invoked from two owners without a "
+                 "once-guard: the teardown (and its metrics) double-"
+                 "fires",
+    }
+
+    def applies_to(self, path: str) -> bool:
+        return not is_test_file(path)
+
+    def check_module(self, tree: ast.Module, src: str,
+                     path: str) -> List[Finding]:
+        out: List[Finding] = []
+        encl = enclosing_function_map(tree)
+        outer = [n for n in ast.walk(tree)
+                 if isinstance(n, FuncDef) and encl.get(id(n)) is None]
+        for fn in outer:
+            self._check_acquire_windows(fn, path, out)
+            self._check_charge_balance(fn, path, out)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                self._check_publish_recheck(node, path, out)
+                self._check_once_guards(node, path, out)
+        out.sort(key=lambda f: (f.line, f.rule))
+        return out
+
+    # -- GL801 ---------------------------------------------------------------
+    def _check_acquire_windows(self, outer_fn, path, out):
+        for fn in [outer_fn] + [n for n in ast.walk(outer_fn)
+                                if n is not outer_fn
+                                and isinstance(n, FuncDef)]:
+            pm = parent_map(fn)
+            for stmt in ast.walk(fn):
+                if not isinstance(stmt, ast.stmt):
+                    continue
+                acq = _acquired_local(stmt)
+                if acq is None:
+                    continue
+                name, kind = acq
+                if self._ancestor_protects(stmt, name, pm, fn):
+                    continue
+                risky = self._first_unprotected_risk(stmt, name, pm, fn)
+                if risky is not None:
+                    out.append(self._finding(
+                        "GL801", path, risky.lineno,
+                        f"{name} (a {kind}) is acquired at line "
+                        f"{stmt.lineno} but this statement can raise "
+                        "before any except/finally closes it — the "
+                        f"exception leaks the {kind}; move it inside "
+                        "the protecting try (or acquire under with)",
+                        f"{fn.name}.{name}"))
+
+    @staticmethod
+    def _ancestor_protects(stmt, name, pm, fn) -> bool:
+        cur = stmt
+        while cur is not fn:
+            parent = pm.get(id(cur))
+            if parent is None:
+                return False
+            if isinstance(parent, ast.Try) and cur in parent.body \
+                    and _try_protects(parent, name):
+                return True
+            cur = parent
+        return False
+
+    @staticmethod
+    def _first_unprotected_risk(stmt, name, pm, fn):
+        """Walk the statements that run after the acquisition (same
+        block, then enclosing blocks upward) until the release is
+        registered / the resource escapes; return the first statement
+        that can raise inside that window."""
+        cur = stmt
+        while cur is not fn:
+            parent = pm.get(id(cur))
+            if parent is None:
+                return None
+            if isinstance(parent, (ast.While, ast.For)):
+                return None     # loop-carried lifetimes: out of scope
+            for attr in ("body", "orelse", "finalbody"):
+                block = getattr(parent, attr, None)
+                if not (isinstance(block, list) and cur in block):
+                    continue
+                for nxt in block[block.index(cur) + 1:]:
+                    if isinstance(nxt, ast.Try) \
+                            and _try_protects(nxt, name):
+                        return None
+                    if closes_name(nxt, name):
+                        return None
+                    if _publishes(nxt, name):
+                        return None
+                    if _has_risky_call(nxt, name):
+                        return nxt
+            cur = parent
+        return None
+
+    # -- GL802 ---------------------------------------------------------------
+    def _check_publish_recheck(self, cls: ast.ClassDef, path, out):
+        flags = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    key = target_key(t)
+                    if key and key.startswith("self.") \
+                            and _CLOSED_FLAG_RE.match(key[5:]):
+                        flags.add(key[5:])
+        if not flags:
+            return
+        for m in cls.body:
+            if not isinstance(m, FuncDef) or m.name == "__init__":
+                continue
+            acquired: Dict[str, int] = {}
+            for stmt in ast.walk(m):
+                if isinstance(stmt, ast.stmt):
+                    acq = _acquired_local(stmt)
+                    if acq:
+                        acquired[acq[0]] = stmt.lineno
+            if not acquired:
+                continue
+            for node in ast.walk(m):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in acquired
+                        and node.lineno > acquired[node.value.id]):
+                    continue
+                keys = [target_key(t) for t in node.targets]
+                pub = next((k for k in keys
+                            if k and k.startswith("self.")), None)
+                if pub is None:
+                    continue
+                lo = acquired[node.value.id]
+                rechecked = any(
+                    isinstance(sub, ast.Attribute)
+                    and isinstance(sub.ctx, ast.Load)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"
+                    and sub.attr in flags
+                    and lo <= sub.lineno <= node.lineno
+                    for sub in ast.walk(m))
+                if not rechecked:
+                    out.append(self._finding(
+                        "GL802", path, node.lineno,
+                        f"{pub} is installed from a resource acquired "
+                        f"at line {lo} without re-reading "
+                        f"self.{sorted(flags)[0]} in between: a "
+                        "concurrent close() in that window leaves the "
+                        "fresh resource live on a closed owner — "
+                        "re-check the flag under the lock and close "
+                        "the new resource if it flipped",
+                        f"{cls.name}.{pub.split('.', 1)[1]}"))
+
+    # -- GL803 ---------------------------------------------------------------
+    def _check_charge_balance(self, outer_fn, path, out):
+        for fn in [outer_fn] + [n for n in ast.walk(outer_fn)
+                                if n is not outer_fn
+                                and isinstance(n, FuncDef)]:
+            incs: Dict[str, List[ast.AugAssign]] = {}
+            decs: Dict[str, List[ast.AugAssign]] = {}
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.AugAssign):
+                    continue
+                key = target_key(node.target)
+                if not key or not key.startswith("self."):
+                    continue
+                if isinstance(node.op, ast.Add):
+                    incs.setdefault(key, []).append(node)
+                elif isinstance(node.op, ast.Sub):
+                    decs.setdefault(key, []).append(node)
+            if not incs or not decs:
+                continue
+            finally_nodes: Set[int] = set()
+            for t in ast.walk(fn):
+                if isinstance(t, ast.Try):
+                    for s in t.finalbody:
+                        finally_nodes.update(id(n) for n in ast.walk(s))
+            for key, inc_nodes in sorted(incs.items()):
+                dec_nodes = decs.get(key)
+                if not dec_nodes:
+                    continue
+                if any(id(d) in finally_nodes for d in dec_nodes):
+                    continue
+                inc = min(inc_nodes, key=lambda n: n.lineno)
+                dec = min(dec_nodes, key=lambda n: n.lineno)
+                if inc.lineno >= dec.lineno:
+                    continue
+                out.append(self._finding(
+                    "GL803", path, inc.lineno,
+                    f"{key} += ... is decremented at line {dec.lineno} "
+                    "but not in a finally: an exception between them "
+                    "leaks the charge, and anything draining on the "
+                    "counter wedges — wrap the work in try/finally",
+                    f"{fn.name}.{key.split('.', 1)[1]}"))
+
+    # -- GL804 ---------------------------------------------------------------
+    def _check_once_guards(self, cls: ast.ClassDef, path, out):
+        methods = [n for n in cls.body if isinstance(n, FuncDef)]
+        by_name = {m.name: m for m in methods}
+        callers: Dict[str, Set[str]] = {}
+        for m in methods:
+            for node in ast.walk(m):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "self" \
+                        and node.func.attr in by_name \
+                        and node.func.attr != m.name:
+                    callers.setdefault(node.func.attr, set()).add(m.name)
+        for name, who in sorted(callers.items()):
+            if len(who) < 2 or not _TEARDOWN_CB_RE.search(name):
+                continue
+            m = by_name[name]
+            mutation = None
+            for node in ast.walk(m):
+                if isinstance(node, ast.AugAssign) \
+                        and target_key(node.target):
+                    mutation = node
+                    break
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "inc":
+                    mutation = node
+                    break
+            if mutation is None:
+                continue
+            guarded = False
+            for node in ast.walk(m):
+                line = getattr(node, "lineno", None)
+                if line is None or line >= mutation.lineno:
+                    continue
+                if isinstance(node, ast.If) \
+                        and any(isinstance(s, ast.Return)
+                                for s in ast.walk(node)):
+                    guarded = True
+                    break
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "pop" \
+                        and len(node.args) >= 2:
+                    guarded = True
+                    break
+            if guarded:
+                continue
+            out.append(self._finding(
+                "GL804", path, m.lineno,
+                f"{cls.name}.{name}() is called from "
+                f"{len(who)} owners ({', '.join(sorted(who))}) and "
+                "mutates state with no once-guard: both owners run the "
+                "teardown and it double-fires — guard with a flag "
+                "checked-and-set under the lock (early return)",
+                f"{cls.name}.{name}"))

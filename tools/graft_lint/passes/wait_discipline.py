@@ -1,0 +1,486 @@
+"""wait-discipline: deadlocks and unbounded waits in the threaded
+serving/transport/resilience stack.
+
+Every review-hardening section of PRs 8-11 is a list of hand-found
+concurrency-lifecycle bugs: relay loops that hot-spun and wedged
+``shutdown(drain=True)``, unbounded ``wait()``/``result()`` sites that
+turn a wedged peer into a wedged process, fd teardown racing probes.
+This pass makes the repo's bounded-waits-everywhere doctrine (see
+``serving/transport/client.py``: "EVERY wait bounded") statically
+checkable:
+
+GL701 — unbounded blocking wait: ``Event.wait()`` /
+        ``Condition.wait()``/``wait_for()`` / ``Future.result()`` with
+        no timeout, or ``Queue.join()`` (which has none to give). A
+        wedged peer wedges the caller forever; teardown and hot-loop
+        reachability is named in the message when the module's own call
+        graph proves it. Autofixable (``timeout=5.0``) except
+        ``Queue.join``. (``Thread.join``/blocking ``Queue.get`` remain
+        GL302's — one defect, one rule.)
+GL702 — blocking call while holding a lock: socket I/O, ``join``,
+        queue ``get``/``put``, ``sleep``, ``Future.result`` inside a
+        ``with self._lock:`` block. Every other thread that needs the
+        lock now waits on the slow peer too — the one-wedged-request-
+        stalls-the-server shape. ``with self._cond: self._cond.wait()``
+        is exempt (waiting releases that lock by design).
+GL703 — lock-acquisition-order cycle across a class's methods (with
+        one level of ``self.m()`` call expansion), the classic AB/BA
+        deadlock; plus re-acquiring a non-reentrant ``Lock`` you
+        already hold.
+GL704 — ``Condition.wait`` outside a ``while``-loop predicate re-check
+        (spurious wakeups and stolen predicates are real); the
+        ``if pred: cond.wait()`` shape is autofixed to ``while``.
+GL705 — a loop path that reaches ``continue`` without any blocking or
+        sleeping call — the busy-spin shape behind both PR 11
+        relay-wedge bugs (a hot spin starves the GIL and wedges
+        ``shutdown(drain=True)``).
+GL706 — a thread started in ``__init__`` with no ``join`` reachable
+        from ``close()``/``shutdown()``: the owner that created the
+        worker cannot reclaim it at teardown.
+
+Test files are skipped: tests park on events deliberately, and the
+gate this pass feeds (tests/test_graft_lint_clean.py) pins zero
+findings over ``paddle_tpu + tools``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, LintPass, register
+from ..fixes import call_keyword_fix, if_to_while_fix
+from ._concmodel import (FuncDef, bind_kinds, blocking_under_lock,
+                         classify_unbounded_wait, enclosing_function_map,
+                         is_test_file, lifecycle_roots,
+                         lock_key_of_withitem, parent_map,
+                         makes_progress, reachable_functions,
+                         receiver_kind, target_key)
+
+
+@register
+class WaitDisciplinePass(LintPass):
+    name = "wait-discipline"
+    rules = {
+        "GL701": "unbounded Event.wait()/Condition.wait()/"
+                 "Future.result()/Queue.join(): a wedged peer wedges "
+                 "the caller forever — bound every wait",
+        "GL702": "blocking call (I/O, join, queue get/put, sleep) while "
+                 "holding a lock: every thread needing the lock now "
+                 "waits on the slow peer too",
+        "GL703": "lock-acquisition-order cycle across methods (AB/BA "
+                 "deadlock), or re-acquiring a non-reentrant Lock "
+                 "already held",
+        "GL704": "Condition.wait outside a while-loop predicate "
+                 "re-check (spurious wakeup / stolen predicate)",
+        "GL705": "loop can reach `continue` without a blocking/sleeping "
+                 "call on the path: busy-spin that starves the GIL and "
+                 "wedges drain",
+        "GL706": "thread started in __init__ with no join reachable "
+                 "from close()/shutdown(): teardown cannot reclaim the "
+                 "worker",
+    }
+
+    def applies_to(self, path: str) -> bool:
+        return not is_test_file(path)
+
+    def check_module(self, tree: ast.Module, src: str,
+                     path: str) -> List[Finding]:
+        kinds = bind_kinds(tree)
+        encl = enclosing_function_map(tree)
+        reach = reachable_functions(tree, lifecycle_roots())
+        out: List[Finding] = []
+        self._check_unbounded_waits(tree, src, path, kinds, encl, reach,
+                                    out)
+        self._check_blocking_under_lock(tree, path, kinds, out)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                self._check_lock_order(node, path, out)
+                self._check_init_thread_join(node, path, out)
+        self._check_condition_wait_loops(tree, src, path, kinds, encl,
+                                         out)
+        self._check_busy_spin(tree, path, encl, out)
+        out.sort(key=lambda f: (f.line, f.rule))
+        return out
+
+    # -- GL701 ---------------------------------------------------------------
+    def _check_unbounded_waits(self, tree, src, path, kinds, encl, reach,
+                               out):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = classify_unbounded_wait(node, kinds)
+            if hit is None:
+                continue
+            key, label, fixable = hit
+            fn = encl.get(id(node))
+            fn_name = fn.name if fn is not None else "<module>"
+            ctx = ""
+            if fn is not None and id(fn) in reach:
+                ctx = f" — {fn_name}() is {reach[id(fn)][1]}"
+            f = self._finding(
+                "GL701", path, node.lineno,
+                f"{label} blocks with no timeout: a wedged peer wedges "
+                f"this thread forever{ctx}; bound the wait and escalate "
+                "(or poll a closed flag)",
+                f"{fn_name}.{label[:-2] if label.endswith('()') else label}")
+            if fixable:
+                f.fix = call_keyword_fix(
+                    src, node, "timeout", "5.0",
+                    "insert timeout=5.0 (review: a bounded wait can now "
+                    "return/raise without the result — handle it)")
+            out.append(f)
+
+    # -- GL702 ---------------------------------------------------------------
+    def _check_blocking_under_lock(self, tree, path, kinds, out):
+        def scan_expr(expr, held: Set[str], fn_name: str):
+            if not held or expr is None:
+                return
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Call):
+                    label = blocking_under_lock(sub, kinds, held)
+                    if label:
+                        out.append(self._finding(
+                            "GL702", path, sub.lineno,
+                            f"{label} blocks while holding "
+                            f"{sorted(held)[0]}: every thread needing "
+                            "the lock now waits on this peer too — move "
+                            "the blocking call outside the with block",
+                            f"{fn_name}.{label[:-2]}"))
+
+        def scan_stmts(stmts, held: Set[str], fn_name: str):
+            for stmt in stmts:
+                scan_stmt(stmt, held, fn_name)
+
+        def scan_stmt(stmt, held: Set[str], fn_name: str):
+            if isinstance(stmt, FuncDef):
+                # a nested def runs later (often on another thread):
+                # the enclosing with-block does not cover its body
+                scan_stmts(stmt.body, set(), f"{fn_name}.{stmt.name}")
+                return
+            if isinstance(stmt, ast.ClassDef):
+                return
+            if isinstance(stmt, ast.With):
+                newly = set()
+                for item in stmt.items:
+                    k = lock_key_of_withitem(item, kinds)
+                    if k:
+                        newly.add(k)
+                    scan_expr(item.context_expr, held, fn_name)
+                scan_stmts(stmt.body, held | newly, fn_name)
+                return
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.stmt):
+                    scan_stmt(child, held, fn_name)
+                elif isinstance(child, ast.excepthandler):
+                    scan_stmts(child.body, held, fn_name)
+                elif isinstance(child, ast.expr):
+                    scan_expr(child, held, fn_name)
+
+        # start only at outermost defs: nested defs are reached through
+        # scan_stmt with a reset lock set (they run later, elsewhere)
+        encl = enclosing_function_map(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, FuncDef) and encl.get(id(node)) is None:
+                scan_stmts(node.body, set(), node.name)
+
+    # -- GL703 ---------------------------------------------------------------
+    def _check_lock_order(self, cls: ast.ClassDef, path, out):
+        cls_kinds = bind_kinds(cls)
+        lock_keys = {k for k, v in cls_kinds.items()
+                     if k.startswith("self.")
+                     and v in ("lock", "rlock", "condition")}
+        if not lock_keys:
+            return
+        nonreentrant = {k for k in lock_keys
+                        if cls_kinds.get(k) == "lock"}
+        methods = [n for n in cls.body if isinstance(n, FuncDef)]
+        # per method: lock keys it acquires anywhere, and (held ->
+        # acquired) nesting edges + (held -> self-call) call sites
+        acquires: Dict[str, Set[str]] = {}
+        edges: Dict[Tuple[str, str], int] = {}
+        call_sites: List[Tuple[str, str, int]] = []   # (held, callee, line)
+
+        def scan(stmts, held: List[str], meth: str):
+            for stmt in stmts:
+                if isinstance(stmt, FuncDef):
+                    scan(stmt.body, [], meth)
+                    continue
+                if isinstance(stmt, ast.With):
+                    newly = []
+                    for item in stmt.items:
+                        k = lock_key_of_withitem(item, cls_kinds)
+                        if k in lock_keys:
+                            newly.append(k)
+                            acquires.setdefault(meth, set()).add(k)
+                            for h in held:
+                                if (h, k) not in edges:
+                                    edges[(h, k)] = stmt.lineno
+                    scan(stmt.body, held + newly, meth)
+                    continue
+                if held:
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Call) \
+                                and isinstance(sub.func, ast.Attribute) \
+                                and isinstance(sub.func.value, ast.Name) \
+                                and sub.func.value.id == "self":
+                            for h in held:
+                                call_sites.append((h, sub.func.attr,
+                                                   sub.lineno))
+                for child in ast.iter_child_nodes(stmt):
+                    if isinstance(child, ast.stmt):
+                        scan([child], held, meth)
+                    elif isinstance(child, ast.excepthandler):
+                        scan(child.body, held, meth)
+
+        for m in methods:
+            scan(m.body, [], m.name)
+        # one level of call expansion: holding A and calling a method
+        # that acquires B adds the A->B edge
+        by_name = {m.name: m for m in methods}
+        for held, callee, line in call_sites:
+            if callee in by_name:
+                for k in acquires.get(callee, ()):  # noqa: B905
+                    if (held, k) not in edges:
+                        edges[(held, k)] = line
+
+        reported: Set[frozenset] = set()
+        for (a, b), line in sorted(edges.items(), key=lambda e: e[1]):
+            if a == b:
+                if a in nonreentrant and frozenset((a,)) not in reported:
+                    reported.add(frozenset((a,)))
+                    out.append(self._finding(
+                        "GL703", path, line,
+                        f"{a} (a non-reentrant Lock) is re-acquired "
+                        "while already held: self-deadlock",
+                        f"{cls.name}.{a.split('.', 1)[1]}"))
+                continue
+            if edges.get((b, a)) is not None:
+                pair = frozenset((a, b))
+                if pair in reported:
+                    continue
+                reported.add(pair)
+                x, y = sorted((a, b))
+                out.append(self._finding(
+                    "GL703", path, min(line, edges[(b, a)]),
+                    f"lock order cycle: {a} is taken under {b} (line "
+                    f"{edges[(b, a)]}) and {b} under {a} (line "
+                    f"{edges[(a, b)]}) — two threads interleaving these "
+                    "paths deadlock (AB/BA)",
+                    f"{cls.name}.{x.split('.', 1)[1]}/"
+                    f"{y.split('.', 1)[1]}"))
+
+    # -- GL704 ---------------------------------------------------------------
+    def _check_condition_wait_loops(self, tree, src, path, kinds, encl,
+                                    out):
+        pm_cache: Dict[int, Dict[int, ast.AST]] = {}
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "wait"
+                    and receiver_kind(node, kinds) == "condition"):
+                continue
+            fn = encl.get(id(node))
+            if fn is None:
+                continue
+            pm = pm_cache.setdefault(id(fn), parent_map(fn))
+            cur, in_while, wait_stmt = node, False, None
+            while cur is not fn:
+                parent = pm.get(id(cur))
+                if parent is None:
+                    break
+                if isinstance(cur, ast.stmt) and wait_stmt is None:
+                    wait_stmt = cur
+                if isinstance(parent, ast.While):
+                    in_while = True
+                    break
+                cur = parent
+            if in_while:
+                continue
+            key = target_key(node.func.value) or "<cond>"
+            fn_name = fn.name
+            f = self._finding(
+                "GL704", path, node.lineno,
+                f"{key}.wait() outside a predicate re-check loop: "
+                "spurious wakeups and stolen predicates are real — use "
+                "`while not <pred>: wait()` (or wait_for)",
+                f"{fn_name}.{key}.wait")
+            # `if pred: cond.wait()` with a single-statement body and no
+            # else is the mechanical while-rewrite
+            if wait_stmt is not None:
+                guard = pm.get(id(wait_stmt))
+                if isinstance(guard, ast.If) and not guard.orelse \
+                        and len(guard.body) == 1 \
+                        and guard.body[0] is wait_stmt \
+                        and not isinstance(pm.get(id(guard)), ast.While):
+                    f.fix = if_to_while_fix(
+                        src, guard,
+                        "turn the `if` guard into `while` so the "
+                        "predicate is re-checked after every wakeup")
+            out.append(f)
+
+    # -- GL705 ---------------------------------------------------------------
+    def _check_busy_spin(self, tree, path, encl, out):
+        pm_cache: Dict[int, Dict[int, ast.AST]] = {}
+
+        def owner_pm(node):
+            fn = encl.get(id(node))
+            if fn is None:
+                if id(tree) not in pm_cache:
+                    pm_cache[id(tree)] = parent_map(tree)
+                return pm_cache[id(tree)]
+            return pm_cache.setdefault(id(fn), parent_map(fn))
+
+        for loop in ast.walk(tree):
+            if not (isinstance(loop, ast.While)
+                    and _is_indefinite(loop)):
+                continue
+            pm = owner_pm(loop)
+            for cont in ast.walk(loop):
+                if not isinstance(cont, ast.Continue):
+                    continue
+                # nearest enclosing loop must be THIS while
+                chain: List[ast.AST] = []
+                cur = cont
+                nearest = None
+                while cur is not loop:
+                    parent = pm.get(id(cur))
+                    if parent is None:
+                        nearest = None
+                        break
+                    chain.append(cur)
+                    if isinstance(parent, (ast.While, ast.For)):
+                        nearest = parent
+                        break
+                    cur = parent
+                if nearest is not loop:
+                    continue
+                if self._continue_dominated(loop, chain, pm):
+                    continue
+                fn = encl.get(id(cont))
+                fn_name = fn.name if fn is not None else "<module>"
+                out.append(self._finding(
+                    "GL705", path, cont.lineno,
+                    "this `continue` re-enters the loop without any "
+                    "blocking or sleeping call on its path: a busy spin "
+                    "that burns a core, starves the GIL, and can wedge "
+                    "shutdown(drain=True) — sleep/poll with a timeout "
+                    "before retrying",
+                    f"{fn_name}.busy-continue"))
+
+    @staticmethod
+    def _continue_dominated(loop: ast.While, chain: List[ast.AST],
+                            pm: Dict[int, ast.AST]) -> bool:
+        """True when a CPU-yielding call runs on the path from the top
+        of one loop iteration to this ``continue``. The path is walked
+        level by level: statements before the continue's branch at each
+        nesting level count; for a continue inside an except handler
+        the try body counts too (the exception proves it ran)."""
+        if makes_progress(loop.test):
+            return True
+        # chain is [continue, ..., top-level stmt]; walk outermost-in
+        steps = list(reversed(chain)) or [loop]
+        containers: List[Tuple[ast.AST, ast.AST]] = []  # (parent, child)
+        parent = loop
+        for child in steps:
+            containers.append((parent, child))
+            parent = child
+        for parent, child in containers:
+            for blocks in _stmt_blocks(parent):
+                if child in blocks:
+                    for stmt in blocks[:blocks.index(child)]:
+                        if makes_progress(stmt):
+                            return True
+            if isinstance(parent, ast.Try):
+                in_handler = any(child is h or (hasattr(h, "body")
+                                 and child in getattr(h, "body", []))
+                                 for h in parent.handlers)
+                if child in parent.handlers or in_handler:
+                    if any(makes_progress(s) for s in parent.body):
+                        return True
+            if isinstance(parent, ast.If) \
+                    and makes_progress(parent.test):
+                return True
+            if isinstance(parent, ast.With) \
+                    and any(makes_progress(i.context_expr)
+                            for i in parent.items):
+                return True
+        return False
+
+    # -- GL706 ---------------------------------------------------------------
+    def _check_init_thread_join(self, cls: ast.ClassDef, path, out):
+        methods = [n for n in cls.body if isinstance(n, FuncDef)]
+        init = next((m for m in methods if m.name == "__init__"), None)
+        if init is None:
+            return
+        from ._concmodel import TEARDOWN_ROOT_NAMES, ctor_name
+        thread_attrs: Dict[str, int] = {}
+        for node in ast.walk(init):
+            if isinstance(node, ast.Assign) \
+                    and ctor_name(node.value) in ("Thread", "Process",
+                                                  "Timer"):
+                for t in node.targets:
+                    key = target_key(t)
+                    if key and key.startswith("self."):
+                        thread_attrs[key] = node.lineno
+        if not thread_attrs:
+            return
+        started: Set[str] = set()
+        joiners: Dict[str, Set[str]] = {}
+        for m in methods:
+            for node in ast.walk(m):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute):
+                    key = target_key(node.func.value)
+                    if key in thread_attrs:
+                        if node.func.attr == "start":
+                            started.add(key)
+                        elif node.func.attr == "join":
+                            joiners.setdefault(key, set()).add(m.name)
+        teardown_methods = {m.name for m in methods
+                            if m.name in TEARDOWN_ROOT_NAMES}
+        # teardown-reachable method names within this class (one hop of
+        # self-calls is what the codebase uses; reuse the module model)
+        reach = reachable_functions(cls, set(teardown_methods))
+        reach_names = {fn.name for fn, _ in reach.values()}
+        for key, line in sorted(thread_attrs.items()):
+            if key not in started:
+                continue
+            attr = key.split(".", 1)[1]
+            joining = joiners.get(key, set())
+            if joining and (not teardown_methods
+                            or joining & reach_names):
+                continue
+            detail = ("no method ever joins it" if not joining else
+                      f"the join in {sorted(joining)[0]}() is not "
+                      "reachable from close()/shutdown()")
+            out.append(self._finding(
+                "GL706", path, line,
+                f"{key} is started in __init__ but {detail}: teardown "
+                "cannot reclaim the worker — join it (with a timeout) "
+                "from the close()/shutdown() path",
+                f"{cls.name}.{attr}"))
+
+
+def _is_indefinite(loop: ast.While) -> bool:
+    """Busy-spin scope: loops whose termination is EXTERNALLY driven —
+    ``while True:`` and ``while not <flag/event>:`` — where spinning
+    waits on another thread. A ``while stack:`` worklist loop drains
+    its own test state and terminates; compute loops are not spins."""
+    test = loop.test
+    if isinstance(test, ast.Constant) and test.value is True:
+        return True
+    return isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not)
+
+
+def _stmt_blocks(node: ast.AST) -> List[List[ast.stmt]]:
+    """The statement lists a compound node owns (body/orelse/handlers'
+    bodies/finalbody), for before-the-continue scanning."""
+    out: List[List[ast.stmt]] = []
+    for attr in ("body", "orelse", "finalbody"):
+        blk = getattr(node, attr, None)
+        if isinstance(blk, list) and blk \
+                and isinstance(blk[0], ast.stmt):
+            out.append(blk)
+    for h in getattr(node, "handlers", []) or []:
+        out.append(h.body)
+    return out
