@@ -45,8 +45,11 @@ def load_native(name: str, extra_flags=()) -> ctypes.CDLL:
         so = os.path.join(_cache_dir(), f"lib{name}_{tag}.so")
         if not os.path.exists(so):
             tmp = so + f".build{os.getpid()}"
+            # extra_flags go AFTER the source: -l libraries are resolved
+            # left-to-right, so listed before the object they'd satisfy
+            # the linker drops them and the .so ships unresolved symbols
             cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                   "-pthread", *extra_flags, "-o", tmp, src]
+                   "-pthread", "-o", tmp, src, *extra_flags]
             r = subprocess.run(cmd, capture_output=True, text=True)
             if r.returncode != 0:
                 raise NativeBuildError(

@@ -147,7 +147,10 @@ def _ring_flash_fwd(q, k, v, axis_name, axis_size, causal, scale,
                     interpret):
     from ..ops.pallas.flash_attention import flash_chunk_fwd
     B, sc, H, D = q.shape
-    idx = jax.lax.axis_index(axis_name)
+    # only the causal schedule consults the device index; a dead
+    # axis_index in the non-causal graph survives DCE and lowers to a
+    # PartitionId instruction the SPMD partitioner rejects
+    idx = jax.lax.axis_index(axis_name) if causal else None
     perm = [((r + 1) % axis_size, r) for r in range(axis_size)]
     out0 = jnp.zeros((B, sc, H, D), jnp.float32)
     lse0 = jnp.full((B, H, sc), _NEG_INF, jnp.float32)
@@ -165,11 +168,11 @@ def _ring_flash_fwd(q, k, v, axis_name, axis_size, causal, scale,
 
     def body(carry, t):
         kc, vc, out_acc, lse_acc = carry
-        j = (idx + t) % axis_size
         if causal:
             # j < idx: chunk fully visible; j == idx: the diagonal chunk
             # (in-kernel causal mask); j > idx: fully masked — skip the
             # compute entirely (lax.switch runs one branch at runtime)
+            j = (idx + t) % axis_size
             br = jnp.where(j == idx, 1, jnp.where(j < idx, 0, 2))
             o, lse = jax.lax.switch(br, (full, diag, skip), kc, vc)
         else:
@@ -190,7 +193,7 @@ def _ring_flash_bwd(axis_name, axis_size, causal, scale, interpret, res,
     from ..ops.pallas.flash_attention import flash_chunk_bwd
     q, k, v, out, lse = res
     B, sc, H, D = q.shape
-    idx = jax.lax.axis_index(axis_name)
+    idx = jax.lax.axis_index(axis_name) if causal else None
     perm = [((r + 1) % axis_size, r) for r in range(axis_size)]
     delta = _bwd_delta(do, out)
     dq0 = jnp.zeros((B, sc, H, D), jnp.float32)
@@ -212,8 +215,8 @@ def _ring_flash_bwd(axis_name, axis_size, causal, scale, interpret, res,
 
     def body(carry, t):
         kc, vc, dkc, dvc, dq_acc = carry
-        j = (idx + t) % axis_size
         if causal:
+            j = (idx + t) % axis_size
             br = jnp.where(j == idx, 1, jnp.where(j < idx, 0, 2))
             dq_c, dk_c, dv_c = jax.lax.switch(br, (full, diag, skip),
                                               kc, vc)
@@ -528,10 +531,11 @@ def ring_attention_local(q, k, v, axis_name, axis_size, causal=True,
     B, sc, H, D = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(D)
+    from ..ops.pallas.common import pallas_interpret
     if impl is None:
-        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        impl = "xla" if pallas_interpret() else "pallas"
     if impl == "pallas":
-        interpret = jax.default_backend() != "tpu"
+        interpret = pallas_interpret()
         return _ring_flash(q, k, v, axis_name, axis_size, causal,
                            float(scale), interpret)
     # GQA kv chunks rotate un-expanded (Hk heads of ICI traffic, not H)
@@ -630,7 +634,8 @@ def ring_attention(q, k, v, mesh=None, seq_axis="sep", causal=True,
                              "layout='contiguous'")
         if scale is None:
             scale = 1.0 / math.sqrt(int(q.shape[-1]))
-        interpret = jax.default_backend() != "tpu"
+        from ..ops.pallas.common import pallas_interpret
+        interpret = pallas_interpret()
         _zigzag_perm(int(q.shape[1]), n)  # validate divisibility early
 
         def shard_body(a, b, c):

@@ -23,7 +23,11 @@ SENTINEL = struct.pack("<I", 0xFFFFFFFF)
 
 
 def _lib():
-    lib = load_native("shm_queue")
+    # -lrt: on pre-2.34 glibc shm_open/shm_unlink live in librt; without
+    # the explicit link the .so carries them unresolved and dlopen in a
+    # forkserver worker (whose process image may not have librt loaded,
+    # unlike the parent) dies with "undefined symbol: shm_open"
+    lib = load_native("shm_queue", extra_flags=("-lrt",))
     lib.shmq_create.restype = ctypes.c_void_p
     lib.shmq_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
     lib.shmq_open.restype = ctypes.c_void_p

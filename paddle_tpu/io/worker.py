@@ -253,6 +253,19 @@ class MultiprocessLoaderIter:
                     break
                 except TimeoutError:
                     if not proc.is_alive():
+                        # exit/drain race: the worker may have pushed its
+                        # remaining batches + sentinel and exited between
+                        # our pop slice expiring and this liveness check.
+                        # Its exit happens-after its pushes, so one more
+                        # drain pop observes anything it left behind; only
+                        # an exited worker with an EMPTY ring (sentinel
+                        # never delivered) has actually died.
+                        try:
+                            rec = ring.pop(timeout_s=0.05)
+                            self._started[w] = True
+                            break
+                        except TimeoutError:
+                            pass
                         self.shutdown()
                         raise RuntimeError(
                             f"DataLoader worker {w} died (exit code "

@@ -50,7 +50,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ...core import flags as _flags
 from ...core.dispatch import register_op_impl
-from .common import _Z
+from .common import _Z, mosaic_params, pallas_interpret
 
 
 __all__ = ["flash_attention_pallas", "flash_attention_ext",
@@ -298,7 +298,7 @@ def _fwd(q3, k3, v3, bias3, seed, hq, hk, causal, scale, offset, sk_real,
             pltpu.VMEM((bq, _LANES), jnp.float32),
             pltpu.VMEM((bq, _LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=mosaic_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*args)
@@ -610,7 +610,7 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, bias3, seed, causal, scale,
         out_specs=dq_out_specs if emit_dbias else dq_out_specs[0],
         out_shape=dq_out_shape if emit_dbias else dq_out_shape[0],
         scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=mosaic_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*args)
@@ -679,7 +679,7 @@ def _bwd_impl(q3, kx, vx, do3, lse, delta, bias3, seed, causal, scale,
             jax.ShapeDtypeStruct((bhk, sk, d), q3.dtype),
         ],
         scratch_shapes=scratch2,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=mosaic_params(
             dimension_semantics=("parallel", "parallel", "arbitrary",
                                  "arbitrary")),
         interpret=interpret,
@@ -1031,8 +1031,8 @@ def _attention_pallas(q, k, v, bias, causal, scale, dropout_p, dropout_key):
     wins below ~2k kv length, measured on v5e), unsupported bias layouts,
     or CPU interpret mode."""
     from ...nn.functional.flash_attention import _attention_xla
-    on_tpu = jax.default_backend() == "tpu"
-    interpret = not on_tpu
+    interpret = pallas_interpret()
+    on_tpu = not interpret
     # measured on v5e: XLA's fused attention wins below ~2k kv length
     # (s=1024: 4.8ms vs 9.7ms fwd); the pallas streaming kernel wins once
     # score materialization bites (s=4096: 14.9ms vs 18.4ms) — pick by

@@ -24,7 +24,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ...core import flags as _flags
 from ...core.dispatch import register_op_impl
-from .common import _Z, pad_rows
+from .common import _Z, pad_rows, pallas_interpret
 
 
 __all__ = ["rms_norm_pallas", "layer_norm_pallas"]
@@ -45,8 +45,8 @@ def _row_block(r: int, n: int) -> int:
 
 
 def _use_pallas(x):
-    on_tpu = jax.default_backend() == "tpu"
-    return on_tpu or _flags.get_flag("pallas_force_interpret")
+    return (not pallas_interpret()
+            or _flags.get_flag("pallas_force_interpret"))
 
 
 def _flatten_rows(x):
@@ -127,7 +127,7 @@ def _rms_norm_pallas_impl(a, w, eps):
     from ...nn.functional.norm import _rms_norm_xla
     if w is None or not _use_pallas(a) or a.shape[-1] % 128 != 0:
         return _rms_norm_xla(a, w, eps)
-    interpret = jax.default_backend() != "tpu"
+    interpret = pallas_interpret()
     # Per-direction shipping decision (VERDICT r3 #2): the norm backward is
     # already plain XLA, but the custom_vjp boundary still costs fusion in
     # a differentiated step — measured on v5e the XLA composite wins
@@ -236,7 +236,7 @@ def _layer_norm_pallas_impl(a, w, b, eps, begin_axis):
     if (w is None or b is None or begin_axis != a.ndim - 1
             or not _use_pallas(a) or a.shape[-1] % 128 != 0):
         return _layer_norm_xla(a, w, b, eps, begin_axis)
-    interpret = jax.default_backend() != "tpu"
+    interpret = pallas_interpret()
     # same shipping rule as rms_norm above: XLA by default under training
     # (it wins the measured fwd+bwd), Pallas via flag or a measured win
     from .select import pick_grad_impl

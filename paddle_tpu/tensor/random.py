@@ -192,11 +192,14 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
                 seeds.reshape(-1).astype(jnp.uint32),
                 (masked.shape[0],))
             row_keys = jax.vmap(jax.random.key)(srows)
+            # draw in the logits dtype: the x64-default float64 would
+            # silently promote masked + g (and make the per-seed draw
+            # depend on the x64 flag rather than on the kernel contract)
             g = jax.vmap(
-                lambda kk: jax.random.gumbel(kk, masked.shape[1:]))(
-                row_keys)
+                lambda kk: jax.random.gumbel(
+                    kk, masked.shape[1:], dtype=logits.dtype))(row_keys)
         else:
-            g = jax.random.gumbel(key, masked.shape)
+            g = jax.random.gumbel(key, masked.shape, dtype=logits.dtype)
         choice = jnp.argmax(masked + g, axis=-1)
         ids = jnp.take_along_axis(sorted_idx, choice[..., None], axis=-1)
         vals = jnp.take_along_axis(logits, ids, axis=-1)

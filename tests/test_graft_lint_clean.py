@@ -34,7 +34,8 @@ def test_all_passes_registered():
     passes = set(_result().passes)
     assert {"trace-purity", "lock-discipline", "thread-hygiene",
             "slow-marker", "device-placement", "recompile-hazard",
-            "wait-discipline", "resource-lifecycle"} <= passes
+            "wait-discipline", "resource-lifecycle",
+            "kernel-hygiene"} <= passes
 
 
 def test_wave2_rules_are_in_the_gate():
@@ -84,6 +85,22 @@ def test_wave3_rules_are_in_the_gate():
     gl7_gl8 = [f for f in res.findings
                if f.rule.startswith(("GL7", "GL8"))]
     assert gl7_gl8 == [], _render_failure(gl7_gl8)
+
+
+def test_wave4_rules_are_in_the_gate():
+    """The kernel-hygiene (GL9xx) family must be live in this gate:
+    zero unbaselined findings over the Pallas kernels is an ISSUE 16
+    acceptance criterion — tiling legality (the r01 rank-1 failure
+    class), grid coverage, padded-tail masks, fp32 accumulation, VMEM
+    budget, and interpret-mode drift are pinned here, before a TPU run
+    can trip them."""
+    from tools.graft_lint.core import all_rules
+    rules = all_rules()
+    assert {"GL901", "GL902", "GL903", "GL904", "GL905",
+            "GL906"} <= set(rules)
+    res = _result()
+    gl9 = [f for f in res.findings if f.rule.startswith("GL9")]
+    assert gl9 == [], _render_failure(gl9)
 
 
 def test_framework_and_tools_are_lint_clean():

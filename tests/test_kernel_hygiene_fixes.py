@@ -1,0 +1,217 @@
+"""ISSUE 16 satellites: the GL9xx sweep over the real kernels stays at
+zero findings WITHOUT suppressions, and the sweep-driven fixes hold up
+numerically at non-multiple-of-block shapes (interpret mode on CPU —
+exactly where the padded tails, odd row counts, and version-shimmed
+compiler params live)."""
+import math
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.graft_lint import lint_paths  # noqa: E402
+
+# the kernel surface GL9xx guards: every module that issues a pallas_call
+KERNEL_PATHS = [
+    os.path.join(REPO, "paddle_tpu", "ops", "pallas"),
+    os.path.join(REPO, "paddle_tpu", "distributed", "long_context.py"),
+]
+
+# GL9xx suppressions the sweep is allowed to carry, as (basename, rule)
+# pairs. Currently EMPTY: every finding the wave-4 sweep raised was fixed
+# outright, none argued away. A new entry here must come with the
+# argument in the suppression comment AND a review of why the fix is
+# wrong, not just inconvenient.
+ALLOWED_GL9_SUPPRESSIONS = set()
+
+
+def test_gl9_sweep_zero_findings_no_baseline():
+    """Acceptance criterion: the kernel tree is GL9xx-clean on its own
+    merits — no baseline absorbing anything."""
+    res = lint_paths(KERNEL_PATHS, baseline=None, select="GL9")
+    assert res.errors == [], res.errors
+    gl9 = [f for f in res.findings if f.rule.startswith("GL9")]
+    assert gl9 == [], "\n".join(f.render() for f in gl9)
+
+
+def test_gl9_suppressions_are_all_accounted_for():
+    """Suppressed findings count as failures unless explicitly allowed
+    above — a drive-by ``# graft-lint: disable=GL9xx`` cannot quietly
+    shrink the kernel-hygiene surface."""
+    res = lint_paths(KERNEL_PATHS, baseline=None, select="GL9")
+    gl9_suppressed = {(os.path.basename(f.path), f.rule)
+                      for f in res.suppressed
+                      if f.rule.startswith("GL9")}
+    unexpected = gl9_suppressed - ALLOWED_GL9_SUPPRESSIONS
+    assert not unexpected, (
+        f"unlisted GL9xx suppressions {sorted(unexpected)}: fix the "
+        "finding or add the pair here with justification")
+
+
+# -- interpret-mode helper (GL906 consolidation target) ----------------------
+
+def test_common_helpers_are_the_single_backend_probe():
+    from paddle_tpu.ops.pallas import common
+    # CPU test runner: interpret mode on, tpu off
+    assert common.on_tpu() is False
+    assert common.pallas_interpret() is True
+
+
+def test_kernel_modules_route_interpret_through_common():
+    """No kernel module keeps a private jax.default_backend() probe —
+    that is GL906's contract, checked here at the source level so the
+    test fails even if the lint pass itself regresses."""
+    import inspect
+
+    from paddle_tpu.distributed import long_context
+    from paddle_tpu.ops.pallas import cross_entropy, flash_attention, norms
+    for mod in (norms, cross_entropy, flash_attention, long_context):
+        src = inspect.getsource(mod)
+        assert "default_backend" not in src, (
+            f"{mod.__name__} grew a local backend probe; use "
+            "ops.pallas.common.pallas_interpret()")
+        assert "pallas_interpret" in src
+
+
+# -- compiler-params version shim (the tile-key test breaker) ----------------
+
+def test_mosaic_params_constructs_on_this_jax():
+    """jax 0.4.x ships pltpu.TPUCompilerParams, newer jax renames it to
+    CompilerParams; mosaic_params() must resolve whichever exists instead
+    of raising AttributeError (which autotune's candidate loop used to
+    swallow, silently disqualifying every pallas candidate)."""
+    from paddle_tpu.ops.pallas.common import mosaic_params
+    p = mosaic_params(
+        dimension_semantics=("parallel", "arbitrary", "arbitrary"))
+    assert p is not None
+
+
+def test_flash_fwd_and_bwd_build_compiler_params():
+    """End-to-end regression for the CompilerParams crash: all three
+    flash pallas_call sites (fwd, dq, dkv) construct their Mosaic params
+    and run in interpret mode."""
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_pallas
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.standard_normal((1, 128, 2, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 128, 2, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 128, 2, 32)), jnp.float32)
+    scale = 1.0 / math.sqrt(32)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention_pallas(q, k, v, True, scale, True))
+
+    out = flash_attention_pallas(q, k, v, True, scale, True)
+    assert np.isfinite(np.asarray(out)).all()
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert all(np.isfinite(np.asarray(g)).all() for g in grads)
+
+
+# -- numerics at non-multiple-of-block shapes --------------------------------
+# The sweep's fix class is padded-tail handling: 13 rows under an 8-row
+# block, 200-length sequences under 128-wide flash tiles. Each kernel is
+# pinned against its XLA oracle exactly where the padding engages.
+
+def test_rms_norm_tail_rows_match_reference():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.standard_normal((13, 256)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((256,)), jnp.float32)
+    from paddle_tpu.ops.pallas.norms import rms_norm_pallas
+    out = rms_norm_pallas(x, w, 1e-6, True)
+    inv = 1.0 / np.sqrt(np.mean(np.asarray(x) ** 2, axis=-1,
+                                keepdims=True) + 1e-6)
+    ref = np.asarray(x) * inv * np.asarray(w)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_rms_norm_tail_rows_grads_match_reference():
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.standard_normal((13, 256)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((256,)), jnp.float32)
+    from paddle_tpu.ops.pallas.norms import rms_norm_pallas
+
+    def ref(x, w):
+        inv = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + 1e-6)
+        return x * inv * w
+
+    gp = jax.grad(lambda x, w: jnp.sum(
+        rms_norm_pallas(x, w, 1e-6, True) ** 2), argnums=(0, 1))(x, w)
+    gr = jax.grad(lambda x, w: jnp.sum(ref(x, w) ** 2),
+                  argnums=(0, 1))(x, w)
+    for a, b, name in zip(gp, gr, "x w".split()):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"rms grad mismatch for {name}")
+
+
+def test_layer_norm_tail_rows_match_reference():
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.standard_normal((13, 256)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((256,)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((256,)), jnp.float32)
+    from paddle_tpu.ops.pallas.norms import layer_norm_pallas
+    out = layer_norm_pallas(x, w, b, 1e-6, True)
+    xn = np.asarray(x)
+    mu = xn.mean(-1, keepdims=True)
+    var = xn.var(-1, keepdims=True)
+    ref = (xn - mu) / np.sqrt(var + 1e-6) * np.asarray(w) + np.asarray(b)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_cross_entropy_tail_rows_match_reference():
+    rng = np.random.RandomState(6)
+    logits = jnp.asarray(rng.standard_normal((13, 200)), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 200, 13))
+    from paddle_tpu.ops.pallas.cross_entropy import softmax_xent_pallas
+    out = softmax_xent_pallas(logits, labels, interpret=True)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = np.asarray(logits)[np.arange(13), np.asarray(labels)]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(lse) - picked,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_flash_attention_200_fwd_and_bwd_match_xla():
+    """Sq = Sk = 200: both sequence axes carry a 56-wide padded tail
+    under the 128 tiles — the GL903 failure class (an unmasked tail
+    would poison the softmax row sums and every gradient)."""
+    from paddle_tpu.nn.functional.flash_attention import _attention_xla
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_pallas
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.standard_normal((1, 200, 2, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 200, 2, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 200, 2, 32)), jnp.float32)
+    scale = 1.0 / math.sqrt(32)
+    ct = jnp.asarray(rng.standard_normal((1, 200, 2, 32)), jnp.float32)
+
+    ref = _attention_xla(q, k, v, None, True, scale, 0.0, None)
+    out = flash_attention_pallas(q, k, v, True, scale, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    gr = jax.grad(lambda q, k, v: jnp.sum(_attention_xla(
+        q, k, v, None, True, scale, 0.0, None) * ct),
+        argnums=(0, 1, 2))(q, k, v)
+    gp = jax.grad(lambda q, k, v: jnp.sum(flash_attention_pallas(
+        q, k, v, True, scale, True) * ct), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gp, gr, "q k v".split()):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"flash grad mismatch for {name}")
+
+
+def test_cross_entropy_odd_vocab_routes_to_xla_and_matches():
+    """The sweep hardened the CE dispatch: on TPU a vocab that is not a
+    lane multiple must take the XLA path instead of handing Mosaic an
+    illegal trailing dim. On CPU we can only pin the numerics, but the
+    dispatch predicate itself is unit-testable."""
+    import inspect
+
+    from paddle_tpu.ops.pallas import cross_entropy
+    src = inspect.getsource(cross_entropy._softmax_xent_pallas_impl)
+    assert "% 128" in src, "lane-alignment guard left the CE dispatch"

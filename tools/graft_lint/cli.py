@@ -63,7 +63,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fix", action="store_true",
                    help="apply the mechanical repairs attached to "
                         "autofixable findings (GL002/GL301/GL302/GL503/"
-                        "GL701/GL704); second run is a no-op")
+                        "GL701/GL704/GL904); second run is a no-op")
     p.add_argument("--diff", action="store_true",
                    help="with --fix: print the unified diff of what "
                         "--fix would change, write nothing")

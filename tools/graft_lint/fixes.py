@@ -17,11 +17,13 @@ deliberately conservative:
   statement above its innermost loop, and the re-lint judges it against
   the next one.)
 
-Only six rules are autofixable — GL301 (insert an explicit
+Only seven rules are autofixable — GL301 (insert an explicit
 ``daemon=True``), GL302/GL701 (insert a ``timeout=``), GL002 (insert a
 suppression-reason template for a human to edit), GL503 (hoist a
-loop-invariant ``device_get`` out of the loop), and GL704 (rewrite the
-``if pred: cond.wait()`` guard to ``while``). Everything else stays
+loop-invariant ``device_get`` out of the loop), GL704 (rewrite the
+``if pred: cond.wait()`` guard to ``while``), and GL904 (insert
+``preferred_element_type=jnp.float32`` on an in-kernel dot so the MXU
+accumulates in f32). Everything else stays
 report-only: a rewrite that needs judgment is a review comment, not an
 edit. GL302/GL701 are the repairs that change runtime behavior — a
 blocking wait becomes a 5-second one, so ``queue.Empty`` / a timing-out

@@ -2,6 +2,7 @@
 a new pass is one module that defines a ``LintPass`` subclass decorated
 with ``@register`` plus an import line here."""
 from . import device_placement  # noqa: F401
+from . import kernel_hygiene  # noqa: F401
 from . import lock_discipline  # noqa: F401
 from . import recompile_hazard  # noqa: F401
 from . import resource_leak  # noqa: F401
