@@ -49,3 +49,18 @@ from . import ps  # noqa: F401
 
 alltoall = all_to_all
 alltoall_single = all_to_all_single
+
+# The canonical sharding vocabulary (spec_layout.SpecLayout) is
+# re-exported lazily via PEP 562, matching the rpc wire re-export
+# pattern: its methods build jax.sharding.PartitionSpecs, and importing
+# paddle_tpu.distributed from control-plane contexts (launcher, elastic
+# agent) must not pull jax in just to name the vocabulary.
+_SPEC_LAYOUT_EXPORTS = ("SpecLayout", "default_layout")
+
+
+def __getattr__(name):
+    if name in _SPEC_LAYOUT_EXPORTS:
+        from . import spec_layout
+        return getattr(spec_layout, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

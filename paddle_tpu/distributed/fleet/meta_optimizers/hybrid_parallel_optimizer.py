@@ -175,13 +175,15 @@ class DygraphShardingOptimizer:
             # after the first step the states exist: lay them over the axis
             # (ZeRO-1 state partition, reference
             # dygraph_sharding_optimizer.py:48 — each rank stores 1/N)
+            from paddle_tpu.distributed.spec_layout import SpecLayout
+            layout = SpecLayout(fsdp_axis=axis)
             n = self._hcg.topology.get_dim(axis)
             for key, state in self._inner_opt._states.items():
                 for name, arr in state.items():
                     if arr.ndim >= 1 and arr.shape[0] % n == 0:
-                        spec = P(axis, *(None,) * (arr.ndim - 1))
                         state[name] = jax.device_put(
-                            arr, NamedSharding(mesh, spec))
+                            arr, NamedSharding(
+                                mesh, layout.fsdp_rows(arr.ndim)))
             self._shard_states_lazily = False
         # post-step broadcast of updated shards (reference
         # _sharding_sync_parameters): the eager update mixes sharded states

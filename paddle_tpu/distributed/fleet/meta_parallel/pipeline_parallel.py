@@ -120,7 +120,7 @@ class PipelineParallel:
 
     # -- sub-mesh construction ----------------------------------------------
     def _build_meshes(self, devices):
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        from jax.sharding import Mesh, NamedSharding
 
         devs = list(devices) if devices is not None else list(jax.devices())
         p = self.num_stages
@@ -143,8 +143,10 @@ class PipelineParallel:
             else:
                 self._stage_meshes.append(
                     Mesh(np.array(sub), ("stage_data",)))
+        from paddle_tpu.distributed.spec_layout import default_layout
         self._stage_shardings = [
-            NamedSharding(m, PartitionSpec()) for m in self._stage_meshes]
+            NamedSharding(m, default_layout().replicated())
+            for m in self._stage_meshes]
         # expose placements so the stateful PipelineLayer.forward can hop
         self._layers._stage_shardings = [
             self._chunk_sharding(c) for c in range(self.num_chunks)]
@@ -346,13 +348,15 @@ class PipelineParallel:
         p2p edge of the pipeline (reference p2p_communication.py:313).
         With ``batch_axis`` the microbatch rows shard over that stage axis
         (dp within the stage); otherwise activations replicate."""
-        from jax.sharding import NamedSharding, PartitionSpec
+        from jax.sharding import NamedSharding
+
+        from paddle_tpu.distributed.spec_layout import SpecLayout
         mesh = self._stage_meshes[self._chunk_mesh_idx(chunk)]
         ba = self._batch_axis
         if (ba is not None and getattr(arr, "ndim", 0) >= 1
                 and arr.shape[0] % self._stage_mesh_axes[ba] == 0):
-            sh = NamedSharding(mesh, PartitionSpec(
-                ba, *([None] * (arr.ndim - 1))))
+            sh = NamedSharding(
+                mesh, SpecLayout(data_axis=ba).batch(arr.ndim))
         else:
             sh = self._chunk_sharding(chunk)
         if getattr(arr, "sharding", None) == sh:

@@ -1,0 +1,110 @@
+"""Canonical PartitionSpecs for paddle_tpu parameters, activations, and
+batches — the one sharding vocabulary shared by the trainer, the input
+prefetcher, and checkpoint reshard.
+
+Every multichip subsystem used to hand-roll its ``PartitionSpec``
+literals; axis-name drift between them ("dp" here, "data" there) is
+exactly the defect class graft_lint's GL10xx family polices. This
+module is the enforcement target: a frozen :class:`SpecLayout` carries
+the repo's axis names once (``dp`` for data/FSDP — FSDP overlays the
+data axis, see ``llama_fsdp_spec`` — ``tp`` for tensor parallel,
+``sep`` for sequence parallel, ``ep`` for experts) and every canonical
+placement is a method returning a ``jax.sharding.PartitionSpec``.
+Inline ``PartitionSpec`` literals that spell one of these canonical
+forms are flagged by GL1006 (autofixable) in modules that bind a
+layout.
+
+jax is imported lazily inside the methods: constructing or passing a
+``SpecLayout`` around (launcher config, control-plane processes) must
+not pull the device runtime in.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = ["SpecLayout", "default_layout"]
+
+Axis = str
+
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """Axis names -> canonical PartitionSpecs. Instances are immutable
+    and cheap; make one per mesh vocabulary (``SpecLayout()`` for the
+    stock ``("dp", "tp")`` meshes, ``SpecLayout(data_axis="batch")`` for
+    a renamed mesh) and route every placement through its methods."""
+
+    data_axis: Axis = "dp"
+    fsdp_axis: Axis = "dp"     # FSDP overlays the data axis in this repo
+    tp_axis: Axis = "tp"
+    seq_axis: Axis = "sep"
+    expert_axis: Axis = "ep"
+
+    @staticmethod
+    def _ps(*entries):
+        from jax.sharding import PartitionSpec
+        return PartitionSpec(*entries)
+
+    # -- parameter-free placements ------------------------------------
+
+    def replicated(self):
+        """Every device holds the full array (scalars, norms, biases)."""
+        return self._ps()
+
+    # -- batch placements ---------------------------------------------
+
+    def batch(self, ndim: int = 1):
+        """Leading batch dim over the data axis, rest replicated —
+        the trainer's per-step input placement."""
+        return self._ps(self.data_axis, *([None] * (ndim - 1)))
+
+    def stacked_batch(self, ndim: int, batch_dim: int = 1):
+        """Batch dim at ``batch_dim`` over the data axis — the scan
+        trainer's [K, B, ...] (and [K, M, B, ...] with accumulation)
+        input placement."""
+        if not 0 <= batch_dim < ndim:
+            raise ValueError(
+                f"batch_dim {batch_dim} out of range for ndim {ndim}")
+        return self._ps(*([None] * batch_dim), self.data_axis,
+                        *([None] * (ndim - batch_dim - 1)))
+
+    # -- parameter placements -----------------------------------------
+
+    def fsdp_rows(self, ndim: int = 2):
+        """Leading dim sharded over the FSDP axis (ZeRO-3 style
+        parameter rows)."""
+        return self._ps(self.fsdp_axis, *([None] * (ndim - 1)))
+
+    def tp_rows(self, ndim: int = 2):
+        """Leading dim over tensor parallel — row-parallel weights
+        (the projection back from a TP-split activation)."""
+        return self._ps(self.tp_axis, *([None] * (ndim - 1)))
+
+    def tp_cols(self, ndim: int = 2):
+        """Trailing dim over tensor parallel — column-parallel weights
+        (QKV/MLP-up style fan-out)."""
+        return self._ps(*([None] * (ndim - 1)), self.tp_axis)
+
+    # -- activation placements ----------------------------------------
+
+    def sequence(self, ndim: int = 4, seq_dim: int = 1):
+        """Sequence dim over the sequence-parallel axis — ring/ulysses
+        attention's [B, S, H, D] activation placement."""
+        if not 0 <= seq_dim < ndim:
+            raise ValueError(
+                f"seq_dim {seq_dim} out of range for ndim {ndim}")
+        return self._ps(*([None] * seq_dim), self.seq_axis,
+                        *([None] * (ndim - seq_dim - 1)))
+
+    def experts(self, ndim: int = 3):
+        """Leading expert dim over the expert-parallel axis — MoE
+        [E, d_in, d_out] expert-weight placement."""
+        return self._ps(self.expert_axis, *([None] * (ndim - 1)))
+
+
+_DEFAULT: SpecLayout = SpecLayout()
+
+
+def default_layout() -> SpecLayout:
+    """The repo-standard layout (``dp``/``tp``/``sep``/``ep`` axes)."""
+    return _DEFAULT

@@ -280,9 +280,15 @@ def prefetch_to_device(iterator: Iterable, depth: int = 2,
     ``stack=K`` auto-stacks K source batches into the ``[K, B, ...]``
     layout of ``create_multistep_train_step(steps=K)``; ``sharding``
     takes a ``jax.sharding.Sharding`` or the ``shard_batch`` callable
-    from ``create_sharded_train_step``. Stats (queue depth, transfer
-    latency, host/device-blocked split) ride
-    ``paddle_tpu.profiler.pipeline_stats(name)``.
+    from ``create_sharded_train_step``. Build multichip shardings from
+    the canonical vocabulary rather than inline specs::
+
+        layout = paddle_tpu.distributed.default_layout()
+        feed = prefetch_to_device(
+            loader, sharding=NamedSharding(mesh, layout.batch()))
+
+    Stats (queue depth, transfer latency, host/device-blocked split)
+    ride ``paddle_tpu.profiler.pipeline_stats(name)``.
     """
     return DevicePrefetcher(iterator, depth=depth, sharding=sharding,
                             stack=stack, name=name)

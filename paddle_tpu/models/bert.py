@@ -271,11 +271,14 @@ def bert_param_spec(name: str):
     """Megatron TP placements over a ('dp','tp') mesh for BERT params:
     column-parallel qkv/fc1, row-parallel out/fc2, vocab-parallel word
     embedding (same scheme the reference's mp_layers apply)."""
-    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed import default_layout
+    layout = default_layout()
     if "word_embeddings" in name:
-        return P("tp", None)
+        return layout.tp_rows()
     if any(k in name for k in ("q_proj", "k_proj", "v_proj", "linear1")):
-        return P(None, "tp") if name.endswith("weight") else P("tp")
+        return (layout.tp_cols() if name.endswith("weight")
+                else layout.tp_rows(ndim=1))
     if any(k in name for k in ("out_proj", "linear2")):
-        return P("tp", None) if name.endswith("weight") else P()
-    return P()
+        return (layout.tp_rows() if name.endswith("weight")
+                else layout.replicated())
+    return layout.replicated()

@@ -387,31 +387,50 @@ def llama_pipeline_model(cfg: LlamaConfig, num_stages: int, loss_fn=None,
                          **pipeline_kwargs)
 
 
-def llama_param_spec(name: str, P=None):
-    """Megatron TP placement by parameter role over axes ('dp', 'tp')
-    (SURVEY.md §2.7; the reference encodes the same mapping in its
-    ColumnParallelLinear/RowParallelLinear wiring)."""
-    from jax.sharding import PartitionSpec
-    P = P or PartitionSpec
-    if "embed_tokens.weight" in name or "lm_head.weight" in name:
-        return P("tp", None) if "embed" in name else P(None, "tp")
+def _llama_param_role(name: str) -> str:
+    """Megatron role of a parameter: 'rows' (leading dim over tp),
+    'cols' (trailing dim over tp), or 'replicated'."""
+    if "embed_tokens.weight" in name:
+        return "rows"                 # vocab-parallel embedding
+    if "lm_head.weight" in name:
+        return "cols"
     if any(k in name for k in ("q_proj.weight", "k_proj.weight",
                                "v_proj.weight", "gate_proj.weight",
                                "up_proj.weight")):
-        return P(None, "tp")
+        return "cols"
     if any(k in name for k in ("o_proj.weight", "down_proj.weight")):
-        return P("tp", None)
-    return P()
+        return "rows"
+    return "replicated"
+
+
+def llama_param_spec(name: str, P=None):
+    """Megatron TP placement by parameter role over axes ('dp', 'tp')
+    (SURVEY.md §2.7; the reference encodes the same mapping in its
+    ColumnParallelLinear/RowParallelLinear wiring), routed through the
+    canonical SpecLayout vocabulary. ``P`` injects a spec constructor
+    for jax-free callers (the completer tests)."""
+    role = _llama_param_role(name)
+    if P is not None:
+        return {"rows": P("tp", None), "cols": P(None, "tp"),
+                "replicated": P()}[role]
+    from ..distributed.spec_layout import default_layout
+    layout = default_layout()
+    return {"rows": layout.tp_rows(), "cols": layout.tp_cols(),
+            "replicated": layout.replicated()}[role]
 
 
 def llama_fsdp_spec(name: str, shape, n_dp: int):
-    """ZeRO-3/FSDP overlay: additionally shard dim 0 over 'dp' when even
-    (applied on top of the TP spec when that dim is free)."""
+    """ZeRO-3/FSDP overlay: additionally shard dim 0 over the FSDP axis
+    (= the data axis, see SpecLayout) when even — applied on top of the
+    TP spec when that dim is free."""
     from jax.sharding import PartitionSpec
+
+    from ..distributed.spec_layout import default_layout
+    layout = default_layout()
     tp = llama_param_spec(name)
     entries = list(tp) + [None] * (len(shape) - len(tp))
     for d in range(len(shape)):
         if entries[d] is None and shape[d] % n_dp == 0:
-            entries[d] = "dp"
+            entries[d] = layout.fsdp_axis
             break
     return PartitionSpec(*entries)

@@ -29,7 +29,9 @@ def place_by_spec(arr, spec, mesh, name=None):
     per call site's reason — a renamed/reshaped param that quietly
     de-shards costs HBM and bandwidth, not correctness, so it only
     surfaces through observability."""
-    from jax.sharding import NamedSharding, PartitionSpec
+    from jax.sharding import NamedSharding
+
+    from ..distributed.spec_layout import default_layout
 
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     ok = True
@@ -52,7 +54,7 @@ def place_by_spec(arr, spec, mesh, name=None):
                   f"{s!r}={size} — replicating (spec was {spec})")
         profiler.record_placement_fallback(reason)
         warnings.warn(reason, RuntimeWarning, stacklevel=2)
-        spec = PartitionSpec()
+        spec = default_layout().replicated()
     return jax.device_put(arr, NamedSharding(mesh, spec))
 
 
@@ -228,8 +230,11 @@ def create_sharded_train_step(model, optimizer, mesh, param_spec_fn,
     batch, over ``data_axis``). ``accumulate=M`` composes with steps
     (inputs [K, M, B, ...]; the batch moves to dim 2 and shard_batch
     follows it)."""
-    from jax.sharding import NamedSharding, PartitionSpec
+    from jax.sharding import NamedSharding
 
+    from ..distributed.spec_layout import SpecLayout
+
+    layout = SpecLayout(data_axis=data_axis)
     if steps:
         step, params, opt_state = create_multistep_train_step(
             model, optimizer, loss_fn, donate=donate, steps=steps,
@@ -248,7 +253,8 @@ def create_sharded_train_step(model, optimizer, mesh, param_spec_fn,
     new_state = {}
     for k, st in opt_state.items():
         new_state[k] = {
-            n: (jax.device_put(v, NamedSharding(mesh, PartitionSpec()))
+            n: (jax.device_put(v, NamedSharding(mesh,
+                                                layout.replicated()))
                 if v.ndim == 0 else place(k, v))
             for n, v in st.items()}
     opt_state = new_state
@@ -264,13 +270,12 @@ def create_sharded_train_step(model, optimizer, mesh, param_spec_fn,
         if steps:
             batch_dim = 2 if accumulate > 1 else 1
             if arr.ndim <= batch_dim:
-                spec = PartitionSpec(*([None] * arr.ndim))
+                spec = layout.replicated()
             else:
-                spec = PartitionSpec(
-                    *([None] * batch_dim), data_axis,
-                    *([None] * (arr.ndim - batch_dim - 1)))
+                spec = layout.stacked_batch(arr.ndim,
+                                            batch_dim=batch_dim)
         else:
-            spec = PartitionSpec(data_axis, *([None] * (arr.ndim - 1)))
+            spec = layout.batch(arr.ndim)
         return jax.device_put(arr, NamedSharding(mesh, spec))
 
     def sharded_step(params, opt_state, key, ids, labels, lr):

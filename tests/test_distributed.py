@@ -786,7 +786,8 @@ class TestHybridPipelineTPDP:
 
     def test_3d_hybrid_parity(self):
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec
+        from jax.sharding import NamedSharding
+        from paddle_tpu.distributed import default_layout
         from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
         from paddle_tpu.distributed.fleet.meta_parallel import (
             PipelineParallel)
@@ -817,7 +818,7 @@ class TestHybridPipelineTPDP:
                     if p._data.ndim == 2:
                         p._data = jax.device_put(
                             p._data,
-                            NamedSharding(mesh, PartitionSpec(None, "tp")))
+                            NamedSharding(mesh, default_layout().tp_cols()))
         opt_pp = paddle.optimizer.SGD(0.05, parameters=pl.parameters())
         opt_pl = paddle.optimizer.SGD(0.05, parameters=plain.parameters())
         x = _t([8, 8], seed=4)

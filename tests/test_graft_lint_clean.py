@@ -35,7 +35,7 @@ def test_all_passes_registered():
     assert {"trace-purity", "lock-discipline", "thread-hygiene",
             "slow-marker", "device-placement", "recompile-hazard",
             "wait-discipline", "resource-lifecycle",
-            "kernel-hygiene"} <= passes
+            "kernel-hygiene", "sharding-discipline"} <= passes
 
 
 def test_wave2_rules_are_in_the_gate():
@@ -57,7 +57,9 @@ def _repro_commands(findings):
     """The exact --select invocations that reproduce these findings one
     rule family at a time — printed on failure so the fix loop is
     copy-paste, not archaeology."""
-    families = sorted({f.rule[:3] for f in findings})
+    # family id = rule id minus its two-digit suffix: GL503 -> GL5,
+    # GL1004 -> GL10 (slicing a fixed [:3] would alias GL10xx onto GL1)
+    families = sorted({f.rule[:-2] for f in findings})
     return "\n".join(
         f"    python -m tools.graft_lint paddle_tpu tools tests "
         f"--select {fam}" for fam in families)
@@ -101,6 +103,22 @@ def test_wave4_rules_are_in_the_gate():
     res = _result()
     gl9 = [f for f in res.findings if f.rule.startswith("GL9")]
     assert gl9 == [], _render_failure(gl9)
+
+
+def test_wave5_rules_are_in_the_gate():
+    """The sharding-discipline (GL10xx) family must be live in this
+    gate: zero unbaselined findings over the SPMD surface is an ISSUE 19
+    acceptance criterion — unknown mesh axes, unscoped collectives,
+    shard_map spec arity, non-bijective ppermute rings, rank-divergent
+    collectives, the SpecLayout vocabulary, and over-long device_put
+    specs are pinned here, before an 8-device run can trip them."""
+    from tools.graft_lint.core import all_rules
+    rules = all_rules()
+    assert {"GL1001", "GL1002", "GL1003", "GL1004", "GL1005",
+            "GL1006", "GL1007"} <= set(rules)
+    res = _result()
+    gl10 = [f for f in res.findings if f.rule.startswith("GL10")]
+    assert gl10 == [], _render_failure(gl10)
 
 
 def test_framework_and_tools_are_lint_clean():

@@ -1,0 +1,471 @@
+"""graft_lint wave 5 (ISSUE 19 tentpole): SPMD sharding & collective
+discipline. Fixture-driven good/bad snippets for the
+sharding-discipline pass (GL1001-GL1007): unknown mesh axes, unscoped
+collectives, shard_map spec arity, non-bijective ppermute rings,
+rank-divergent collectives, the SpecLayout vocabulary (+ --fix
+idempotence for GL1006), and over-long device_put specs — plus the
+--sarif output mode and the GL10 family-select boundary."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest  # noqa: F401
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.graft_lint import lint_file, registered_passes  # noqa: E402
+
+_PRELUDE = """
+    import jax
+    import numpy as np
+    from functools import partial
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "tp"))
+"""
+
+
+def _lint_src(tmp_path, src, name="mod.py", **kw):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(_PRELUDE) + textwrap.dedent(src))
+    passes = [cls() for cls in registered_passes().values()]
+    findings, suppressed, err = lint_file(str(p), passes, **kw)
+    assert err is None, err
+    return findings, suppressed
+
+
+def _gl10(findings, rule=None):
+    return [f for f in findings if f.rule.startswith(rule or "GL10")]
+
+
+def test_wave5_pass_registered():
+    assert "sharding-discipline" in registered_passes()
+
+
+# -- GL1001: axis name no reachable mesh declares ----------------------------
+
+def test_gl1001_unknown_axis_flagged(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        sh = NamedSharding(mesh, P("dp", "model"))
+    """)
+    hits = _gl10(findings, "GL1001")
+    assert len(hits) == 1 and "'model'" in hits[0].message
+
+
+def test_gl1001_declared_axes_clean(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        sh = NamedSharding(mesh, P("dp", "tp"))
+    """)
+    assert _gl10(findings) == []
+
+
+def test_gl1001_shard_map_spec_axis_checked(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        def _f(a):
+            return a
+
+        g = shard_map(_f, mesh, in_specs=(P("sep"),), out_specs=P("sep"))
+    """)
+    assert len(_gl10(findings, "GL1001")) >= 1
+
+
+def test_gl1001_unresolved_mesh_is_silent(tmp_path):
+    # mesh built by a helper the model cannot see: no proof, no finding
+    findings, _ = _lint_src(tmp_path, """
+        mesh2 = make_my_mesh()
+        sh = NamedSharding(mesh2, P("model"))
+    """)
+    assert _gl10(findings) == []
+
+
+# -- GL1002: collective outside any named-axis scope -------------------------
+
+def test_gl1002_module_level_collective_flagged(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        val = jax.lax.psum(np.ones(4), "dp")
+        idx = jax.lax.axis_index("dp")
+    """)
+    assert len(_gl10(findings, "GL1002")) == 2
+
+
+def test_gl1002_shard_mapped_function_clean(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        def _f(a):
+            return jax.lax.psum(a, "dp")
+
+        g = shard_map(_f, mesh, in_specs=(P("dp"),), out_specs=P())
+    """)
+    assert _gl10(findings, "GL1002") == []
+
+
+def test_gl1002_public_function_is_silent(tmp_path):
+    # a public function may be shard_mapped by a caller in another
+    # module — only proven-unscoped execution paths fire
+    findings, _ = _lint_src(tmp_path, """
+        def reduce_all(a):
+            return jax.lax.psum(a, "dp")
+    """)
+    assert _gl10(findings, "GL1002") == []
+
+
+# -- GL1003: shard_map spec arity --------------------------------------------
+
+def test_gl1003_in_specs_arity_mismatch(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        def _f(a, b):
+            return a
+
+        g = shard_map(_f, mesh, in_specs=(P("dp"),), out_specs=P())
+    """)
+    hits = _gl10(findings, "GL1003")
+    assert len(hits) == 1 and "in_specs has 1" in hits[0].message
+
+
+def test_gl1003_out_specs_arity_mismatch(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        def _f(a, b):
+            return a, b
+
+        g = shard_map(_f, mesh, in_specs=(P("dp"), P("dp")),
+                      out_specs=(P(), P(), P()))
+    """)
+    assert len(_gl10(findings, "GL1003")) == 1
+
+
+def test_gl1003_matched_arity_clean(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        def _f(a, b):
+            return a, b
+
+        g = shard_map(_f, mesh, in_specs=(P("dp"), P("dp")),
+                      out_specs=(P(), P()))
+    """)
+    assert _gl10(findings) == []
+
+
+def test_gl1003_single_spec_prefix_broadcast_clean(tmp_path):
+    # a single (non-sequence) spec is a pytree prefix broadcast over all
+    # operands — legal for any arity, so no literal arity proof exists
+    findings, _ = _lint_src(tmp_path, """
+        def _f(a, b):
+            return a
+
+        g = shard_map(_f, mesh, in_specs=P("dp"), out_specs=P("dp"))
+    """)
+    assert _gl10(findings, "GL1003") == []
+
+
+# -- GL1004: non-bijective ppermute ------------------------------------------
+
+def test_gl1004_duplicate_destination_flagged(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        def _ring(x):
+            return jax.lax.ppermute(
+                x, "tp", perm=[(0, 1), (1, 1), (2, 3), (3, 0)])
+
+        r = shard_map(_ring, mesh, in_specs=(P("tp"),), out_specs=P("tp"))
+    """)
+    hits = _gl10(findings, "GL1004")
+    assert len(hits) == 1 and "non-bijective" in hits[0].message
+
+
+def test_gl1004_duplicate_source_flagged(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        def _ring(x):
+            return jax.lax.ppermute(
+                x, "tp", perm=[(0, 1), (0, 2), (2, 3), (3, 0)])
+
+        r = shard_map(_ring, mesh, in_specs=(P("tp"),), out_specs=P("tp"))
+    """)
+    assert len(_gl10(findings, "GL1004")) == 1
+
+
+def test_gl1004_bijective_comprehension_clean(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        def _ring(x):
+            n = 4
+            return jax.lax.ppermute(
+                x, "tp", perm=[(i, (i + 1) % n) for i in range(n)])
+
+        r = shard_map(_ring, mesh, in_specs=(P("tp"),), out_specs=P("tp"))
+    """)
+    assert _gl10(findings, "GL1004") == []
+
+
+def test_gl1004_dynamic_perm_is_silent(tmp_path):
+    # axis size comes from a parameter: not literal-provable, no finding
+    findings, _ = _lint_src(tmp_path, """
+        def _ring(x, axis_size):
+            perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+            return jax.lax.ppermute(x, "tp", perm=perm)
+    """)
+    assert _gl10(findings, "GL1004") == []
+
+
+# -- GL1005: rank-divergent collective ---------------------------------------
+
+def test_gl1005_collective_under_rank_branch(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        def _diverge(x):
+            if jax.lax.axis_index("dp") == 0:
+                x = jax.lax.psum(x, "tp")
+            return x
+
+        rd = shard_map(_diverge, mesh, in_specs=(P("dp"),),
+                       out_specs=P("dp"))
+    """)
+    hits = _gl10(findings, "GL1005")
+    assert len(hits) == 1 and "rank-derived branch" in hits[0].message
+
+
+def test_gl1005_axis_index_probe_itself_clean(tmp_path):
+    # the rank probe in the If test is per-device arithmetic, not a
+    # sync point — only collectives in the branch body diverge
+    findings, _ = _lint_src(tmp_path, """
+        def _ok(x):
+            if jax.lax.axis_index("dp") == 0:
+                x = x * 2
+            return x
+
+        rd = shard_map(_ok, mesh, in_specs=(P("dp"),), out_specs=P("dp"))
+    """)
+    assert _gl10(findings, "GL1005") == []
+
+
+def test_gl1005_one_level_call_expansion(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        def _reduce(x):
+            return jax.lax.psum(x, "tp")
+
+        def _diverge(x):
+            if jax.lax.axis_index("dp") == 0:
+                x = _reduce(x)
+            return x
+
+        rd = shard_map(_diverge, mesh, in_specs=(P("dp"),),
+                       out_specs=P("dp"))
+    """)
+    assert len(_gl10(findings, "GL1005")) == 1
+
+
+def test_gl1005_unconditional_collective_clean(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        def _f(x):
+            r = jax.lax.psum(x, "dp")
+            return r
+
+        g = shard_map(_f, mesh, in_specs=(P("dp"),), out_specs=P())
+    """)
+    assert _gl10(findings, "GL1005") == []
+
+
+# -- GL1006: SpecLayout vocabulary -------------------------------------------
+
+_LAYOUT = """
+        from paddle_tpu.distributed.spec_layout import SpecLayout
+
+        layout = SpecLayout()
+"""
+
+
+def test_gl1006_inline_batch_literal_flagged(tmp_path):
+    findings, _ = _lint_src(tmp_path, _LAYOUT + """
+        batch_spec = P("dp", None, None)
+    """)
+    hits = _gl10(findings, "GL1006")
+    assert len(hits) == 1
+    assert "layout.batch(ndim=3)" in hits[0].message
+    assert hits[0].fix is not None
+
+
+def test_gl1006_without_layout_binding_silent(tmp_path):
+    # no SpecLayout bound in the module: nothing to route through
+    findings, _ = _lint_src(tmp_path, """
+        batch_spec = P("dp", None, None)
+    """)
+    assert _gl10(findings, "GL1006") == []
+
+
+def test_gl1006_noncanonical_literal_silent(tmp_path):
+    findings, _ = _lint_src(tmp_path, _LAYOUT + """
+        odd = P("dp", "tp")
+        dynamic = P(*entries)
+    """)
+    assert _gl10(findings, "GL1006") == []
+
+
+def test_gl1006_binding_must_precede_use(tmp_path):
+    # rewriting a spec above the layout binding would be a NameError
+    findings, _ = _lint_src(tmp_path, """
+        from paddle_tpu.distributed.spec_layout import SpecLayout
+
+        early = P("dp", None)
+
+        layout = SpecLayout()
+    """)
+    assert _gl10(findings, "GL1006") == []
+
+
+# -- GL1007: spec longer than array rank -------------------------------------
+
+def test_gl1007_overlong_spec_flagged(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        def place():
+            arr = np.zeros((8, 16))
+            return jax.device_put(arr, NamedSharding(mesh, P("dp", None, "tp")))
+    """)
+    hits = _gl10(findings, "GL1007")
+    assert len(hits) == 1 and "rank-2" in hits[0].message
+
+
+def test_gl1007_short_spec_is_legal(tmp_path):
+    # a spec shorter than the rank replicates the trailing dims — legal
+    findings, _ = _lint_src(tmp_path, """
+        def place():
+            arr = np.zeros((8, 16, 4))
+            return jax.device_put(arr, NamedSharding(mesh, P("dp")))
+    """)
+    assert _gl10(findings, "GL1007") == []
+
+
+def test_gl1007_unknown_rank_is_silent(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        def place(arr):
+            return jax.device_put(arr, NamedSharding(mesh, P("dp", None, "tp")))
+    """)
+    assert _gl10(findings, "GL1007") == []
+
+
+# -- suppressions ------------------------------------------------------------
+
+def test_suppression_with_reason_honored(tmp_path):
+    findings, suppressed = _lint_src(tmp_path, """
+        val = jax.lax.psum(np.ones(4), "dp")  # graft-lint: disable=GL1002 -- host-sim path, no mesh
+    """)
+    assert _gl10(findings, "GL1002") == []
+    assert len(_gl10(suppressed, "GL1002")) == 1
+
+
+def test_reasonless_suppression_flagged_gl002(tmp_path):
+    findings, _ = _lint_src(tmp_path, """
+        val = jax.lax.psum(np.ones(4), "dp")  # graft-lint: disable=GL1002
+    """)
+    assert any(f.rule == "GL002" for f in findings)
+
+
+# -- CLI integration ---------------------------------------------------------
+
+def _run_cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graft_lint", *args],
+        capture_output=True, text=True, cwd=cwd)
+
+
+def _bad_module(tmp_path):
+    p = tmp_path / "bad_spmd.py"
+    p.write_text(textwrap.dedent(_PRELUDE) + textwrap.dedent("""
+        sh = NamedSharding(mesh, P("dp", "model"))
+        val = jax.lax.psum(np.ones(4), "dp")
+    """))
+    return p
+
+
+def test_cli_gl10_family_select(tmp_path):
+    p = _bad_module(tmp_path)
+    proc = _run_cli(str(p), "--select", "GL10", "--no-baseline", "--json")
+    assert proc.returncode == 1
+    data = json.loads(proc.stdout)
+    assert {f["rule"] for f in data["findings"]} == {"GL1001", "GL1002"}
+
+
+def test_cli_family_select_is_not_prefix_aliased(tmp_path):
+    # GL1 must keep selecting only the GL1xx trace-purity family — the
+    # GL10xx rules share its prefix but are a different family
+    p = _bad_module(tmp_path)
+    proc = _run_cli(str(p), "--select", "GL1", "--no-baseline", "--json")
+    data = json.loads(proc.stdout)
+    assert all(not f["rule"].startswith("GL10")
+               for f in data["findings"])
+    # and GL9 must not pick up GL10xx either
+    proc2 = _run_cli(str(p), "--select", "GL9", "--no-baseline")
+    assert proc2.returncode == 0
+
+
+def test_cli_list_rules_includes_wave5_group():
+    proc = _run_cli("--list-rules", "--json")
+    assert proc.returncode == 0
+    data = json.loads(proc.stdout)
+    assert "sharding-discipline" in data["passes"]
+    assert {"GL1001", "GL1002", "GL1003", "GL1004", "GL1005", "GL1006",
+            "GL1007"} <= set(data["groups"]["sharding-discipline"])
+
+
+def test_cli_fix_gl1006_idempotent(tmp_path):
+    p = tmp_path / "fixme.py"
+    p.write_text(textwrap.dedent(_PRELUDE) + textwrap.dedent("""
+        from paddle_tpu.distributed.spec_layout import SpecLayout
+
+        layout = SpecLayout()
+        batch_spec = P("dp", None, None)
+        param_spec = P(None, "tp")
+    """))
+    proc = _run_cli(str(p), "--select", "GL1006", "--no-baseline",
+                    "--fix")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    fixed = p.read_text()
+    assert "batch_spec = layout.batch(ndim=3)" in fixed
+    assert "param_spec = layout.tp_cols()" in fixed
+    # idempotent: a second --fix run changes nothing
+    proc2 = _run_cli(str(p), "--select", "GL1006", "--no-baseline",
+                     "--fix")
+    assert proc2.returncode == 0
+    assert p.read_text() == fixed
+    assert "applied 0 fix(es)" in proc2.stdout
+
+
+# -- SARIF output (ISSUE 19 satellite) ---------------------------------------
+
+def test_cli_sarif_minimal_schema(tmp_path):
+    p = _bad_module(tmp_path)
+    proc = _run_cli(str(p), "--select", "GL10", "--no-baseline",
+                    "--sarif")
+    assert proc.returncode == 1
+    # stdout purity: the whole stream is one SARIF document
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    assert "sarif-2.1.0" in doc["$schema"]
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graft_lint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"GL1001", "GL1002"} <= rule_ids
+    assert all(r["shortDescription"]["text"]
+               for r in run["tool"]["driver"]["rules"])
+    results = run["results"]
+    assert {r["ruleId"] for r in results} == {"GL1001", "GL1002"}
+    for r in results:
+        assert r["level"] == "warning"
+        assert r["message"]["text"]
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("bad_spmd.py")
+        assert loc["region"]["startLine"] >= 1
+
+
+def test_cli_sarif_clean_run_exits_zero(tmp_path):
+    p = tmp_path / "clean.py"
+    p.write_text("x = 1\n")
+    proc = _run_cli(str(p), "--no-baseline", "--sarif")
+    assert proc.returncode == 0
+    doc = json.loads(proc.stdout)
+    assert doc["runs"][0]["results"] == []
+
+
+def test_cli_sarif_and_json_are_mutually_exclusive(tmp_path):
+    p = tmp_path / "clean.py"
+    p.write_text("x = 1\n")
+    proc = _run_cli(str(p), "--json", "--sarif")
+    assert proc.returncode == 2

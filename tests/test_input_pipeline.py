@@ -90,24 +90,27 @@ class TestPrefetcher:
         """sharding= takes a NamedSharding or the shard_batch-style
         callable from create_sharded_train_step: either way batches land
         distributed over the data axis."""
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        from jax.sharding import Mesh, NamedSharding
 
+        from paddle_tpu.distributed import default_layout
+
+        layout = default_layout()
         mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
                     ("dp", "tp"))
         data = [(np.zeros((4, 8), np.int32), np.zeros((4, 8), np.int32))]
 
-        sh = NamedSharding(mesh, PartitionSpec("dp"))
+        sh = NamedSharding(mesh, layout.batch())
         with prefetch_to_device(iter(data), sharding=sh,
                                 name="t_mesh1") as pf:
             x, _ = next(iter(pf))
-        assert x.sharding.spec == PartitionSpec("dp")
+        assert x.sharding.spec == layout.batch()
         assert len(x.addressable_shards) == 8
         assert x.addressable_shards[0].data.shape[0] == 2   # 4 / dp=2
 
         def shard_batch(a):
             a = jnp.asarray(a)
-            return jax.device_put(a, NamedSharding(
-                mesh, PartitionSpec("dp", *([None] * (a.ndim - 1)))))
+            return jax.device_put(
+                a, NamedSharding(mesh, layout.batch(a.ndim)))
 
         with prefetch_to_device(iter(data), sharding=shard_batch,
                                 name="t_mesh2") as pf:

@@ -34,6 +34,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "relative to the repo root)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
+    p.add_argument("--sarif", action="store_true", dest="as_sarif",
+                   help="SARIF 2.1.0 output (CI inline annotations); "
+                        "stdout is the SARIF document, everything else "
+                        "goes to stderr")
     p.add_argument("--select", metavar="IDS",
                    help="only these rule ids, rule families, or pass "
                         "names (comma-separated, e.g. "
@@ -63,7 +67,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fix", action="store_true",
                    help="apply the mechanical repairs attached to "
                         "autofixable findings (GL002/GL301/GL302/GL503/"
-                        "GL701/GL704/GL904); second run is a no-op")
+                        "GL701/GL704/GL904/GL1006); second run is a "
+                        "no-op")
     p.add_argument("--diff", action="store_true",
                    help="with --fix: print the unified diff of what "
                         "--fix would change, write nothing")
@@ -98,6 +103,33 @@ def _list_rules(as_json: bool) -> int:
             for rid, desc in sorted(groups[name].items()):
                 print(f"  {rid}  {desc}")
     return 0
+
+
+def _sarif_doc(result) -> dict:
+    """Minimal SARIF 2.1.0: one run, the driver's rule table restricted
+    to the rules that fired, one result per actionable finding with a
+    physical location (repo-relative uri + startLine)."""
+    rules_table = all_rules()
+    fired = sorted({f.rule for f in result.findings})
+    return {
+        "version": "2.1.0",
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graft_lint",
+                "rules": [{"id": rid, "shortDescription":
+                           {"text": rules_table.get(rid, rid)}}
+                          for rid in fired]}},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "warning",
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": _norm_path(f.path)},
+                    "region": {"startLine": f.line}}}],
+            } for f in result.findings],
+        }],
+    }
 
 
 def _prune_baseline(baseline_path: str, paths: List[str]) -> int:
@@ -225,6 +257,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("graft_lint: --diff only makes sense with --fix",
               file=sys.stderr)
         return 2
+    if args.as_json and args.as_sarif:
+        print("graft_lint: --json and --sarif are mutually exclusive "
+              "(pick one machine format)", file=sys.stderr)
+        return 2
     exclusive = [n for n, v in [("--write-baseline", args.write_baseline),
                                 ("--prune-baseline", args.prune_baseline),
                                 ("--fix", args.fix)] if v]
@@ -303,9 +339,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     if args.fix:
-        # with --json, stdout is a single JSON document — the fix
-        # summary and any diff must not corrupt it
-        fix_stream = sys.stderr if args.as_json else sys.stdout
+        # with --json/--sarif, stdout is a single JSON document — the
+        # fix summary and any diff must not corrupt it
+        fix_stream = sys.stderr if args.as_json or args.as_sarif \
+            else sys.stdout
         n_applied, n_files, n_skipped, fixed = _apply_fixes(
             result, diff_only=args.diff, stream=fix_stream)
         if not args.diff:
@@ -318,7 +355,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"graft_lint --fix: {verb} {n_applied} fix(es) in "
               f"{n_files} file(s){tail}", file=fix_stream)
 
-    if args.as_json:
+    if args.as_sarif:
+        print(json.dumps(_sarif_doc(result), indent=1))
+        for e in result.errors:
+            print(f"ERROR {e}", file=sys.stderr)
+    elif args.as_json:
         print(json.dumps(result.to_dict(), indent=1))
     else:
         for f in result.findings:

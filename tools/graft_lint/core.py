@@ -38,7 +38,7 @@ __all__ = ["Finding", "LintPass", "register", "registered_passes",
            "iter_python_files", "lint_file", "lint_paths", "Baseline",
            "parse_suppressions", "SUPPRESSION_RULES"]
 
-_FAMILY_RE = re.compile(r"^GL\d{1,2}$")   # GL5, GL50: rule-family prefixes
+_FAMILY_RE = re.compile(r"^GL\d{1,2}$")   # GL5, GL10: rule-family prefixes
 
 # meta-rules emitted by the framework itself (not by any pass)
 SUPPRESSION_RULES = {
@@ -303,10 +303,13 @@ def _rule_selected(rule: str, pass_name: str, select, ignore) -> bool:
     def match(ids):
         if rule in ids or pass_name in ids:
             return True
-        # rule-family prefixes: GL5 selects GL501..GL505, GL2 selects
-        # GL201/GL202 — an id shaped like GL<digits> that is a proper
-        # prefix of the rule id
+        # rule-family prefixes: GL5 selects GL501..GL505, GL10 selects
+        # GL1001..GL1007 — an id shaped like GL<digits> whose rules are
+        # exactly two digits longer. The length check keeps families
+        # disjoint: GL1 is the GL1xx family only (never GL10xx), and
+        # GL10 never swallows GL101..GL105
         return any(_FAMILY_RE.match(i) and rule.startswith(i)
+                   and len(rule) == len(i) + 2
                    for i in ids)
     if select is not None and not match(select):
         return False

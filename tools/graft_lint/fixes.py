@@ -17,13 +17,15 @@ deliberately conservative:
   statement above its innermost loop, and the re-lint judges it against
   the next one.)
 
-Only seven rules are autofixable — GL301 (insert an explicit
+Only eight rules are autofixable — GL301 (insert an explicit
 ``daemon=True``), GL302/GL701 (insert a ``timeout=``), GL002 (insert a
 suppression-reason template for a human to edit), GL503 (hoist a
 loop-invariant ``device_get`` out of the loop), GL704 (rewrite the
-``if pred: cond.wait()`` guard to ``while``), and GL904 (insert
+``if pred: cond.wait()`` guard to ``while``), GL904 (insert
 ``preferred_element_type=jnp.float32`` on an in-kernel dot so the MXU
-accumulates in f32). Everything else stays
+accumulates in f32), and GL1006 (replace an inline ``PartitionSpec``
+literal with the bound ``SpecLayout``'s canonical method — pure span
+substitution, value-identical by construction). Everything else stays
 report-only: a rewrite that needs judgment is a review comment, not an
 edit. GL302/GL701 are the repairs that change runtime behavior — a
 blocking wait becomes a 5-second one, so ``queue.Empty`` / a timing-out
@@ -39,7 +41,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Edit", "Fix", "line_offsets", "span_offset", "apply_fixes",
            "call_keyword_fix", "reason_template_fix", "hoist_stmt_fix",
-           "if_to_while_fix", "unified_diff"]
+           "if_to_while_fix", "replace_span_fix", "unified_diff"]
 
 
 @dataclass(frozen=True)
@@ -194,6 +196,23 @@ def if_to_while_fix(src: str, if_node, note: str) -> Optional[Fix]:
     if src[start:start + 2] != "if":
         return None
     return Fix(edits=[Edit(start, start + 2, "while")], note=note)
+
+
+def replace_span_fix(src: str, node, text: str,
+                     note: str) -> Optional[Fix]:
+    """GL1006: replace ``node``'s exact source span with ``text`` (an
+    expression rewrite — e.g. an inline ``PartitionSpec`` literal with
+    the canonical ``SpecLayout`` method call that builds the same
+    value). Returns None when the node carries no end position."""
+    if getattr(node, "end_lineno", None) is None \
+            or getattr(node, "end_col_offset", None) is None:
+        return None
+    offs = line_offsets(src)
+    start = span_offset(src, node.lineno, node.col_offset, offs)
+    end = span_offset(src, node.end_lineno, node.end_col_offset, offs)
+    if not 0 <= start < end <= len(src):
+        return None
+    return Fix(edits=[Edit(start, end, text)], note=note)
 
 
 # -- applying ----------------------------------------------------------------

@@ -6,6 +6,7 @@ from . import kernel_hygiene  # noqa: F401
 from . import lock_discipline  # noqa: F401
 from . import recompile_hazard  # noqa: F401
 from . import resource_leak  # noqa: F401
+from . import sharding_discipline  # noqa: F401
 from . import slow_marker  # noqa: F401
 from . import thread_hygiene  # noqa: F401
 from . import trace_purity  # noqa: F401

@@ -1,0 +1,615 @@
+"""Shared SPMD model for the sharding-discipline (GL10xx) pass.
+
+The multichip surface is built from four structured vocabularies —
+``Mesh``/``make_mesh`` constructions (axis-name sets), ``PartitionSpec``
+/``NamedSharding`` values, ``shard_map`` wrappings, and raw ``jax.lax``
+collectives — and every GL10xx invariant (axis-name reachability, spec
+rank vs array rank, named-axis scope, ``ppermute`` bijectivity) is a
+property of how those vocabularies connect. This module resolves the
+connections from the AST in the ``_kernelmodel`` provenance spirit:
+single-assignment locals, module-level binds, literal constants, import
+aliases. Anything it cannot prove it reports as unknown (``None`` axes,
+``None`` spec entries, :data:`UNKNOWN` entries), and the pass stays
+silent there — a sharding finding must be a proof, not a guess. In
+particular, dynamically-built specs (``PartitionSpec(*entries)``,
+axis names arriving as parameters, specs assembled in loops) resolve to
+unknown by design.
+
+Resolution the model does:
+
+- ``Mesh(devices, ("dp", "tp"))`` / ``Mesh(..., axis_names=...)`` /
+  ``jax.make_mesh(shape, names)`` / ``ProcessMesh(arr, dim_names)`` —
+  axis-name tuples from string literals, through import aliases and
+  single-assignment binds.
+- ``PartitionSpec(...)`` (any alias: ``P``, ``PS``) — per-entry values:
+  ``None``, a literal axis string, a tuple of literal axis strings, or
+  :data:`UNKNOWN`; a ``*starred`` argument makes the whole spec
+  unresolvable.
+- ``NamedSharding(mesh, spec)`` — both halves resolved as above.
+- ``shard_map(f, mesh, in_specs=..., out_specs=...)``, the
+  ``@partial(shard_map, ...)`` decorator form, and positional-only
+  wrappers — the wrapped function resolved through the def map /
+  ``partial`` / lambdas, plus the operand list when the wrapped callable
+  is invoked in place.
+- ``jax.lax`` collectives (``psum``/``pmean``/``pmax``/``pmin``/
+  ``all_gather``/``ppermute``/``all_to_all``/``pshuffle``/
+  ``psum_scatter``/``axis_index``) — restricted to dotted paths through
+  ``lax`` or names imported from a ``lax`` module, so the repo's own
+  ``all_gather`` wrappers (group-based, not axis-named) never match.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ._kernelmodel import ModuleKernelModel, callee_name, dotted
+
+#: Sentinel for one PartitionSpec entry the model cannot resolve (the
+#: spec's length is still known; its axis content is not).
+UNKNOWN = object()
+
+COLLECTIVES = ("psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+               "all_gather", "all_to_all", "psum_scatter", "axis_index")
+
+# positional index of the axis-name argument per collective; every one
+# also accepts the keyword form ``axis_name=``
+_AXIS_POS = {"axis_index": 0}
+_AXIS_POS.update({k: 1 for k in COLLECTIVES if k != "axis_index"})
+
+# callables that bind named axes over the function they wrap
+_SCOPE_BINDERS = ("shard_map", "shmap", "pmap", "xmap")
+
+_RANK_CALLS = ("axis_index", "process_index", "get_rank")
+
+
+@dataclass
+class SpecVal:
+    """One resolved ``PartitionSpec``. ``entries is None`` means the
+    spec is dynamically built (starred args, opaque value) — length and
+    content both unknown."""
+
+    node: ast.AST
+    entries: Optional[List[object]] = None  # None | str | tuple | UNKNOWN
+
+    def axes(self) -> Set[str]:
+        """Literal axis names mentioned by resolved entries."""
+        out: Set[str] = set()
+        for e in self.entries or []:
+            if isinstance(e, str):
+                out.add(e)
+            elif isinstance(e, tuple):
+                out.update(e)
+        return out
+
+    @property
+    def length(self) -> Optional[int]:
+        return None if self.entries is None else len(self.entries)
+
+    def fully_literal(self) -> bool:
+        return self.entries is not None \
+            and not any(e is UNKNOWN for e in self.entries)
+
+
+@dataclass
+class MeshDecl:
+    """One mesh construction. ``axes is None``: the axis names are not
+    literal (built dynamically / passed in)."""
+
+    node: ast.AST
+    axes: Optional[Tuple[str, ...]] = None
+
+
+@dataclass
+class CollectiveSite:
+    node: ast.Call
+    kind: str                             # "psum", "axis_index", ...
+    axes: Optional[Set[str]] = None       # literal axis names, else None
+    fn: Optional[ast.AST] = None          # innermost enclosing function
+
+
+@dataclass
+class ShardMapSite:
+    node: ast.AST                         # the shard_map(...) call
+    fn_name: str = ""
+    fn: Optional[ast.AST] = None          # FunctionDef / Lambda
+    mesh: Optional[MeshDecl] = None       # resolved mesh, else None
+    in_specs: Optional[List[SpecVal]] = None
+    out_specs: Optional[List[SpecVal]] = None
+    in_specs_is_seq: bool = False         # written as a tuple/list
+    out_specs_is_seq: bool = False
+    operands: Optional[List[ast.expr]] = None  # when invoked in place
+    env: Dict[str, ast.expr] = field(default_factory=dict)
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+
+class ModuleMeshModel:
+    """All mesh/spec/shard_map/collective sites of one module."""
+
+    def __init__(self, tree: ast.Module, path: str):
+        self.tree = tree
+        self.path = path
+        # borrow the _kernelmodel machinery: parents map, def map,
+        # single-assignment local envs, int/operand provenance
+        self.km = ModuleKernelModel(tree, path)
+        self.parents = self.km.parents
+        self.defs = self.km.defs
+        self.aliases: Dict[str, str] = {}   # local name -> imported tail
+        self.lax_names: Dict[str, str] = {}  # local name -> collective
+        self._imports(tree)
+        self.module_env = self._module_env(tree)
+        self.meshes: List[MeshDecl] = []
+        self.shard_maps: List[ShardMapSite] = []
+        self.collectives: List[CollectiveSite] = []
+        self._env_cache: Dict[int, Dict[str, ast.expr]] = {}
+        self._scan(tree)
+
+    # -- imports and binds ---------------------------------------------
+
+    def _imports(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            mod = node.module or ""
+            for alias in node.names:
+                local = alias.asname or alias.name
+                self.aliases[local] = alias.name
+                if alias.name in COLLECTIVES \
+                        and mod.rsplit(".", 1)[-1] == "lax":
+                    self.lax_names[local] = alias.name
+
+    def _module_env(self, tree: ast.Module) -> Dict[str, ast.expr]:
+        """Module-level single-assignment binds (same discipline as the
+        function-local env: a rebound name is dropped)."""
+        env: Dict[str, ast.expr] = {}
+        dead: Set[str] = set()
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                name = stmt.targets[0].id
+                if name in env or name in dead:
+                    dead.add(name)
+                    env.pop(name, None)
+                else:
+                    env[name] = stmt.value
+        return env
+
+    # -- name/value resolution -----------------------------------------
+
+    def deref(self, expr: Optional[ast.expr],
+              env: Dict[str, ast.expr]) -> Optional[ast.expr]:
+        """Chase a Name through the function env, then module binds."""
+        seen = 0
+        while isinstance(expr, ast.Name) and seen < 8:
+            nxt = env.get(expr.id, self.module_env.get(expr.id))
+            if nxt is None or nxt is expr:
+                break
+            expr = nxt
+            seen += 1
+        return expr
+
+    def is_ctor(self, call: ast.Call, target: str) -> bool:
+        """Is ``call`` a construction of ``target`` (``PartitionSpec``,
+        ``Mesh``, ...), via dotted path or import alias?"""
+        name = callee_name(call)
+        if name == target:
+            return True
+        return isinstance(call.func, ast.Name) \
+            and self.aliases.get(call.func.id) == target
+
+    def env_for(self, node: ast.AST) -> Dict[str, ast.expr]:
+        fn = self.km.enclosing_fn(node)
+        key = id(fn)
+        env = self._env_cache.get(key)
+        if env is None:
+            env = self._env_cache[key] = self.km._env(fn)
+        return env
+
+    # -- specs ----------------------------------------------------------
+
+    def resolve_spec(self, expr: Optional[ast.expr],
+                     env: Dict[str, ast.expr]) -> Optional[SpecVal]:
+        """``PartitionSpec(...)`` (directly or through binds) ->
+        :class:`SpecVal`; anything else -> None."""
+        expr = self.deref(expr, env)
+        if not isinstance(expr, ast.Call) \
+                or not self.is_ctor(expr, "PartitionSpec"):
+            return None
+        if any(isinstance(a, ast.Starred) for a in expr.args):
+            return SpecVal(node=expr, entries=None)
+        entries: List[object] = []
+        for a in expr.args:
+            a = self.deref(a, env)
+            if isinstance(a, ast.Constant) and a.value is None:
+                entries.append(None)
+            elif isinstance(a, ast.Constant) and isinstance(a.value, str):
+                entries.append(a.value)
+            elif isinstance(a, (ast.Tuple, ast.List)) and all(
+                    isinstance(e, ast.Constant)
+                    and isinstance(e.value, str) for e in a.elts):
+                entries.append(tuple(e.value for e in a.elts))
+            else:
+                entries.append(UNKNOWN)
+        return SpecVal(node=expr, entries=entries)
+
+    def resolve_sharding(self, expr: Optional[ast.expr],
+                         env: Dict[str, ast.expr]
+                         ) -> Tuple[Optional[MeshDecl], Optional[SpecVal]]:
+        """``NamedSharding(mesh, spec)`` -> (mesh, spec), each half
+        None when unresolvable."""
+        expr = self.deref(expr, env)
+        if not isinstance(expr, ast.Call) \
+                or not self.is_ctor(expr, "NamedSharding"):
+            return None, None
+        kw = {k.arg: k.value for k in expr.keywords if k.arg}
+        mesh_expr = kw.get("mesh", expr.args[0] if expr.args else None)
+        spec_expr = kw.get("spec",
+                           expr.args[1] if len(expr.args) > 1 else None)
+        return (self.resolve_mesh(mesh_expr, env),
+                self.resolve_spec(spec_expr, env))
+
+    # -- meshes ----------------------------------------------------------
+
+    def resolve_mesh(self, expr: Optional[ast.expr],
+                     env: Dict[str, ast.expr]) -> Optional[MeshDecl]:
+        """A mesh construction reachable from ``expr`` (directly or
+        through binds), with its axis names when literal."""
+        expr = self.deref(expr, env)
+        if not isinstance(expr, ast.Call):
+            return None
+        kw = {k.arg: k.value for k in expr.keywords if k.arg}
+        if self.is_ctor(expr, "Mesh") or self.is_ctor(expr, "make_mesh"):
+            names = kw.get("axis_names",
+                           expr.args[1] if len(expr.args) > 1 else None)
+        elif self.is_ctor(expr, "ProcessMesh"):
+            names = kw.get("dim_names",
+                           expr.args[1] if len(expr.args) > 1 else None)
+        else:
+            return None
+        return MeshDecl(node=expr, axes=self._axis_tuple(names, env))
+
+    def _axis_tuple(self, expr: Optional[ast.expr],
+                    env: Dict[str, ast.expr]
+                    ) -> Optional[Tuple[str, ...]]:
+        expr = self.deref(expr, env)
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return (expr.value,)
+        if isinstance(expr, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in expr.elts):
+            return tuple(e.value for e in expr.elts)
+        return None
+
+    # -- collectives -----------------------------------------------------
+
+    def collective_kind(self, call: ast.Call) -> Optional[str]:
+        d = dotted(call.func)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if len(parts) >= 2 and parts[-2] == "lax" \
+                and parts[-1] in COLLECTIVES:
+            return parts[-1]
+        if len(parts) == 1 and parts[0] in self.lax_names:
+            return self.lax_names[parts[0]]
+        return None
+
+    def collective_axes(self, call: ast.Call, kind: str,
+                        env: Dict[str, ast.expr]) -> Optional[Set[str]]:
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        pos = _AXIS_POS[kind]
+        expr = kw.get("axis_name",
+                      call.args[pos] if len(call.args) > pos else None)
+        names = self._axis_tuple(expr, env)
+        return set(names) if names is not None else None
+
+    # -- scan -----------------------------------------------------------
+
+    def _scan(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                mesh = self.resolve_mesh(node, {})
+                if mesh is not None and mesh.node is node:
+                    self.meshes.append(mesh)
+                kind = self.collective_kind(node)
+                if kind is not None:
+                    self.collectives.append(CollectiveSite(
+                        node=node, kind=kind,
+                        axes=self.collective_axes(
+                            node, kind, self.env_for(node)),
+                        fn=self.km.enclosing_fn(node)))
+                if callee_name(node) in ("shard_map", "shmap"):
+                    self.shard_maps.append(
+                        self._shard_map(node, self.env_for(node)))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    sm = self._decorator_shard_map(deco, node)
+                    if sm is not None:
+                        self.shard_maps.append(sm)
+
+    def _shard_map(self, call: ast.Call,
+                   env: Dict[str, ast.expr]) -> ShardMapSite:
+        sm = ShardMapSite(node=call, env=env)
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        if call.args:
+            sm.fn_name, sm.fn = self._resolve_fn(call.args[0], env)
+        sm.mesh = self.resolve_mesh(
+            kw.get("mesh", call.args[1] if len(call.args) > 1 else None),
+            env)
+        sm.in_specs, sm.in_specs_is_seq = self._spec_seq(
+            kw.get("in_specs",
+                   call.args[2] if len(call.args) > 2 else None), env)
+        sm.out_specs, sm.out_specs_is_seq = self._spec_seq(
+            kw.get("out_specs",
+                   call.args[3] if len(call.args) > 3 else None), env)
+        outer = self.parents.get(id(call))
+        if isinstance(outer, ast.Call) and outer.func is call:
+            sm.operands = list(outer.args)
+        return sm
+
+    def _decorator_shard_map(self, deco: ast.AST, fn: ast.AST
+                             ) -> Optional[ShardMapSite]:
+        """``@partial(shard_map, mesh=..., in_specs=..., out_specs=...)``
+        — the decorator form of a shard_map wrapping."""
+        if not (isinstance(deco, ast.Call)
+                and callee_name(deco) == "partial" and deco.args):
+            return None
+        target = dotted(deco.args[0]) or ""
+        if target.rsplit(".", 1)[-1] not in ("shard_map", "shmap"):
+            return None
+        env = self.env_for(fn)
+        sm = ShardMapSite(node=deco, fn_name=getattr(fn, "name", ""),
+                          fn=fn, env=env)
+        kw = {k.arg: k.value for k in deco.keywords if k.arg}
+        sm.mesh = self.resolve_mesh(kw.get("mesh"), env)
+        sm.in_specs, sm.in_specs_is_seq = self._spec_seq(
+            kw.get("in_specs"), env)
+        sm.out_specs, sm.out_specs_is_seq = self._spec_seq(
+            kw.get("out_specs"), env)
+        return sm
+
+    def _resolve_fn(self, expr: ast.expr, env: Dict[str, ast.expr]
+                    ) -> Tuple[str, Optional[ast.AST]]:
+        expr = self.deref(expr, env)
+        if isinstance(expr, ast.Call) and callee_name(expr) == "partial" \
+                and expr.args:
+            expr = self.deref(expr.args[0], env)
+        if isinstance(expr, ast.Lambda):
+            return "<lambda>", expr
+        d = dotted(expr) if expr is not None else None
+        if d is None:
+            return "", None
+        name = d.rsplit(".", 1)[-1]
+        return name, self.defs.get(name)
+
+    def _spec_seq(self, expr: Optional[ast.expr],
+                  env: Dict[str, ast.expr]
+                  ) -> Tuple[Optional[List[SpecVal]], bool]:
+        """in_specs/out_specs -> (list of SpecVals, was-a-sequence).
+        One opaque element poisons the list (None), as in
+        ``_kernelmodel._spec_list``."""
+        expr = self.deref(expr, env)
+        if expr is None:
+            return None, False
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out: List[SpecVal] = []
+            for e in expr.elts:
+                sv = self.resolve_spec(e, env)
+                if sv is None:
+                    return None, True
+                out.append(sv)
+            return out, True
+        sv = self.resolve_spec(expr, env)
+        return ([sv], False) if sv is not None else (None, False)
+
+    # -- named-axis scope ------------------------------------------------
+
+    def scoped_fn_ids(self) -> Set[int]:
+        """ids of FunctionDef/Lambda nodes proven to run under a
+        named-axis binder (shard_map/pmap/...)."""
+        out: Set[int] = set()
+        for sm in self.shard_maps:
+            if sm.fn is not None:
+                out.add(id(sm.fn))
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) \
+                    and callee_name(node) in _SCOPE_BINDERS \
+                    and node.args:
+                _, fn = self._resolve_fn(node.args[0],
+                                         self.env_for(node))
+                if fn is not None:
+                    out.add(id(fn))
+        return out
+
+    def fn_chain(self, node: ast.AST) -> List[ast.AST]:
+        """Enclosing functions/lambdas, innermost first."""
+        chain: List[ast.AST] = []
+        cur = self.parents.get(id(node))
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                chain.append(cur)
+            cur = self.parents.get(id(cur))
+        return chain
+
+    def function_escapes(self, fn: ast.AST) -> bool:
+        """True when ``fn`` may be wrapped by a binder we cannot see:
+        it is decorated, is a method, or its name is used as a value
+        anywhere other than a direct ``fn(...)`` call."""
+        if isinstance(fn, ast.Lambda):
+            return True
+        if fn.decorator_list:
+            return True
+        if isinstance(self.parents.get(id(fn)), ast.ClassDef):
+            return True
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Name) and node.id == fn.name \
+                    and isinstance(node.ctx, ast.Load):
+                parent = self.parents.get(id(node))
+                if not (isinstance(parent, ast.Call)
+                        and parent.func is node):
+                    return True
+        return False
+
+    def direct_call_sites(self, fn: ast.AST) -> List[ast.Call]:
+        out: List[ast.Call] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id == getattr(fn, "name", None):
+                out.append(node)
+        return out
+
+    def collective_scope(self, site: CollectiveSite) -> str:
+        """'named' — provably under a named-axis binder; 'unscoped' —
+        provably executed outside any; 'unknown' — cannot tell (the
+        caller stays silent). 'unscoped' requires a proof the code runs:
+        module-level collectives run at import; a private, non-escaping
+        function runs when a module-level statement calls it (one level
+        of call expansion, like GL703)."""
+        scoped = self.scoped_fn_ids()
+        chain = self.fn_chain(site.node)
+        if any(id(fn) in scoped for fn in chain):
+            return "named"
+        if not chain:
+            return "unscoped"
+        if any(isinstance(fn, ast.Lambda) for fn in chain):
+            return "unknown"      # a lambda's escapes are untrackable
+        outer = chain[-1]
+        if self.function_escapes(outer) \
+                or not getattr(outer, "name", "").startswith("_"):
+            return "unknown"
+        for call in self.direct_call_sites(outer):
+            caller_chain = self.fn_chain(call)
+            if any(id(fn) in scoped for fn in caller_chain):
+                continue
+            if not caller_chain:
+                return "unscoped"     # called at module level
+        return "unknown"
+
+    # -- rank-derived branches (GL1005) ----------------------------------
+
+    def _is_rank_expr(self, expr: ast.AST,
+                      env: Dict[str, ast.expr], depth: int = 0) -> bool:
+        if depth > 6:
+            return False
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                name = callee_name(node)
+                if name in _RANK_CALLS:
+                    return True
+            elif isinstance(node, ast.Name):
+                val = env.get(node.id, self.module_env.get(node.id))
+                if isinstance(val, ast.Call) \
+                        and callee_name(val) in _RANK_CALLS:
+                    return True
+        return False
+
+    def rank_branch(self, node: ast.AST) -> Optional[ast.AST]:
+        """The innermost enclosing ``if``/ternary whose test is derived
+        from ``axis_index()``/``process_index()``/``get_rank()`` — the
+        rank-divergent region — or None. A node inside the TEST itself
+        (the rank probe) is not in the divergent region."""
+        env = self.env_for(node)
+        prev: ast.AST = node
+        cur = self.parents.get(id(node))
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            if isinstance(cur, (ast.If, ast.IfExp)) \
+                    and prev is not cur.test \
+                    and self._is_rank_expr(cur.test, env):
+                return cur
+            prev = cur
+            cur = self.parents.get(id(cur))
+        return None
+
+
+def fixed_arity(fn: ast.AST) -> Optional[int]:
+    """Positional arity of a FunctionDef/Lambda when it is fixed (no
+    *args/**kwargs/keyword-only/defaults), else None."""
+    args = getattr(fn, "args", None)
+    if args is None:
+        return None
+    if args.vararg or args.kwarg or args.kwonlyargs or args.defaults:
+        return None
+    return len(args.posonlyargs) + len(args.args)
+
+
+def return_arity(fn: ast.AST) -> Optional[int]:
+    """Number of returned values when every return of ``fn`` agrees:
+    N for consistent tuple-literal returns, 1 for consistent
+    single-expression returns, None otherwise (mixed, opaque, or no
+    returns)."""
+    if isinstance(fn, ast.Lambda):
+        body = fn.body
+        return len(body.elts) if isinstance(body, ast.Tuple) else 1
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    counts: Set[int] = set()
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue                      # nested defs return elsewhere
+        if isinstance(node, ast.Return):
+            if node.value is None:
+                return None
+            counts.add(len(node.value.elts)
+                       if isinstance(node.value, ast.Tuple) else 1)
+        stack.extend(ast.iter_child_nodes(node))
+    if len(counts) == 1:
+        return counts.pop()
+    return None
+
+
+def literal_permutation(model: ModuleMeshModel, expr: Optional[ast.expr],
+                        env: Dict[str, ast.expr]
+                        ) -> Optional[List[Tuple[int, int]]]:
+    """A ``ppermute`` perm as literal (src, dst) int pairs: from a
+    list/tuple of 2-tuples, or a single-generator comprehension
+    ``[(i, f(i)) for i in range(N)]`` with literal N and arithmetic f
+    the model can evaluate. None when not literal-provable."""
+    expr = model.deref(expr, env)
+    if isinstance(expr, (ast.List, ast.Tuple)):
+        pairs: List[Tuple[int, int]] = []
+        for e in expr.elts:
+            e = model.deref(e, env)
+            if not (isinstance(e, (ast.Tuple, ast.List))
+                    and len(e.elts) == 2):
+                return None
+            s = model.km.eval_int(e.elts[0], env)
+            d = model.km.eval_int(e.elts[1], env)
+            if s is None or d is None:
+                return None
+            pairs.append((s, d))
+        return pairs
+    if isinstance(expr, (ast.ListComp, ast.GeneratorExp)) \
+            and len(expr.generators) == 1:
+        gen = expr.generators[0]
+        if gen.ifs or not isinstance(gen.target, ast.Name):
+            return None
+        it = model.deref(gen.iter, env)
+        if not (isinstance(it, ast.Call) and callee_name(it) == "range"
+                and len(it.args) == 1):
+            return None
+        n = model.km.eval_int(it.args[0], env)
+        elt = expr.elt
+        if n is None or n > 4096 or not (
+                isinstance(elt, (ast.Tuple, ast.List))
+                and len(elt.elts) == 2):
+            return None
+        pairs = []
+        for i in range(n):
+            env_i = dict(env)
+            env_i[gen.target.id] = ast.Constant(value=i)
+            s = model.km.eval_int(elt.elts[0], env_i)
+            d = model.km.eval_int(elt.elts[1], env_i)
+            if s is None or d is None:
+                return None
+            pairs.append((s, d))
+        return pairs
+    return None

@@ -1,0 +1,437 @@
+"""SPMD sharding & collective-discipline pass (GL10xx): axis-name
+reachability, named-axis scope, shard_map spec shape, ppermute
+bijectivity, rank-divergent collectives, and the SpecLayout vocabulary.
+
+The multichip defects this pass checks are exactly the ones that only
+fail on an 8-device mesh, long after tier-1: an axis name no mesh
+declares dies in the first device_put; a collective outside a named-axis
+scope is an UnboundAxisName error at trace time under the real mesh; a
+non-bijective ``ppermute`` permutation deadlocks the ring; a collective
+reachable only on one rank hangs every other rank at the next sync
+point (the class behind the ring-attention ``axis_index`` PartitionId
+crash). All of them are checkable properties of how the module's
+``Mesh``/``PartitionSpec``/``shard_map``/``jax.lax`` sites connect (see
+``_meshmodel``), so they are checked here, at lint time. Every rule
+flags only what the model can PROVE from the AST — dynamically-built
+specs, parameter-typed axis names, and functions that escape to
+binders we cannot see are skipped, never guessed at.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, LintPass, register
+from ..fixes import replace_span_fix
+from ._kernelmodel import callee_name
+from ._meshmodel import (COLLECTIVES, UNKNOWN, CollectiveSite,
+                         ModuleMeshModel, ShardMapSite, SpecVal,
+                         fixed_arity, literal_permutation, return_arity)
+
+# cheap pre-filter: modules with none of these substrings cannot
+# produce a GL10xx finding, so the model is never built for them
+_TRIGGERS = ("PartitionSpec", "NamedSharding", "shard_map", "shmap",
+             "Mesh", "make_mesh") + COLLECTIVES
+
+# SpecLayout construction defaults — keep in sync with
+# paddle_tpu/distributed/spec_layout.py (GL1006 resolves overrides from
+# literal kwargs; a non-literal override makes the layout unknown and
+# the rule stays silent)
+_LAYOUT_DEFAULTS = {"data_axis": "dp", "fsdp_axis": "dp",
+                    "tp_axis": "tp", "seq_axis": "sep",
+                    "expert_axis": "ep"}
+
+
+def _fmt_spec(spec: SpecVal) -> str:
+    if spec.entries is None:
+        return "PartitionSpec(...)"
+    parts = []
+    for e in spec.entries:
+        if e is UNKNOWN:
+            parts.append("?")
+        elif isinstance(e, tuple):
+            parts.append("(" + ", ".join(repr(x) for x in e) + ")")
+        else:
+            parts.append(repr(e))
+    return "PartitionSpec(" + ", ".join(parts) + ")"
+
+
+@register
+class ShardingDisciplinePass(LintPass):
+    """SPMD sharding discipline: mesh axis reachability, named-axis
+    scope, shard_map spec shape, ppermute bijectivity, rank-divergent
+    collectives, SpecLayout vocabulary."""
+
+    name = "sharding-discipline"
+    rules = {
+        "GL1001": "axis name used in a spec or collective that no "
+                  "reachable mesh declares — dies in the first "
+                  "device_put/shard_map under the real mesh",
+        "GL1002": "collective or axis_index provably outside any "
+                  "named-axis scope (no shard_map/pmap binds the axis "
+                  "on this path)",
+        "GL1003": "shard_map in_specs/out_specs arity or literal-proven "
+                  "rank disagrees with the wrapped function's "
+                  "params/returns",
+        "GL1004": "literal-proven non-bijective ppermute permutation "
+                  "(duplicate source = double-send, duplicate "
+                  "destination = hole) — the ring-deadlock class",
+        "GL1005": "collective reachable only under an axis_index()/"
+                  "rank-derived branch — ranks diverge and the "
+                  "program hangs at the next sync point",
+        "GL1006": "inline PartitionSpec literal where the bound "
+                  "SpecLayout has a canonical method — vocabulary "
+                  "drift (autofixable)",
+        "GL1007": "device_put/NamedSharding spec is longer than the "
+                  "literal-proven rank of the array it places",
+    }
+
+    def check_module(self, tree: ast.Module, src: str,
+                     path: str) -> List[Finding]:
+        if not any(t in src for t in _TRIGGERS):
+            return []
+        model = ModuleMeshModel(tree, path)
+        findings: List[Finding] = []
+        self._check_named_sharding_sites(model, path, findings)
+        self._check_device_put_sites(model, path, findings)
+        for sm in model.shard_maps:
+            self._check_shard_map(sm, model, path, findings)
+        for site in model.collectives:
+            self._check_collective(site, model, path, findings)
+        self._check_rank_divergent_calls(model, path, findings)
+        self._check_spec_vocabulary(model, src, path, findings)
+        findings.sort(key=lambda f: (f.line, f.rule, f.message))
+        return findings
+
+    # -- shared helpers ------------------------------------------------
+
+    def _site(self, model: ModuleMeshModel, node: ast.AST) -> str:
+        fn = model.km.enclosing_fn(node)
+        return getattr(fn, "name", "<lambda>") if fn is not None \
+            else "<module>"
+
+    # -- GL1001 / GL1007: NamedSharding sites --------------------------
+
+    def _check_named_sharding_sites(self, model: ModuleMeshModel,
+                                    path: str,
+                                    findings: List[Finding]) -> None:
+        for node in ast.walk(model.tree):
+            if not (isinstance(node, ast.Call)
+                    and model.is_ctor(node, "NamedSharding")):
+                continue
+            env = model.env_for(node)
+            mesh, spec = model.resolve_sharding(node, env)
+            site = self._site(model, node)
+            if mesh is not None and mesh.axes is not None \
+                    and spec is not None:
+                for ax in sorted(spec.axes() - set(mesh.axes)):
+                    findings.append(self._finding(
+                        "GL1001", path, node.lineno,
+                        f"{_fmt_spec(spec)} uses axis {ax!r} but the "
+                        f"mesh it is placed on declares only "
+                        f"{tuple(mesh.axes)}",
+                        symbol=f"{site}.{ax}"))
+
+    def _check_device_put_sites(self, model: ModuleMeshModel, path: str,
+                                findings: List[Finding]) -> None:
+        """GL1007: ``device_put(x, NamedSharding(mesh, spec))`` (spec
+        inline or through a bind) with a spec longer than the
+        literal-proven rank of ``x``."""
+        for node in ast.walk(model.tree):
+            if not (isinstance(node, ast.Call)
+                    and callee_name(node) == "device_put"
+                    and node.args):
+                continue
+            kw = {k.arg: k.value for k in node.keywords if k.arg}
+            sh_expr = kw.get("device", node.args[1]
+                             if len(node.args) > 1 else None)
+            env = model.env_for(node)
+            _, spec = model.resolve_sharding(sh_expr, env)
+            if spec is None or spec.length is None:
+                continue
+            origin = model.km.operand_origin(node.args[0], env)
+            if origin.dims is not None and spec.length > len(origin.dims):
+                site = self._site(model, node)
+                findings.append(self._finding(
+                    "GL1007", path, node.lineno,
+                    f"device_put places a rank-{len(origin.dims)} array "
+                    f"with a {spec.length}-entry {_fmt_spec(spec)} — a "
+                    "spec longer than the array rank is rejected at "
+                    "placement time",
+                    symbol=f"{site}.device_put"))
+
+    # -- GL1001 / GL1003: shard_map sites ------------------------------
+
+    def _check_shard_map(self, sm: ShardMapSite, model: ModuleMeshModel,
+                         path: str, findings: List[Finding]) -> None:
+        site = sm.fn_name or self._site(model, sm.node)
+        mesh_axes = set(sm.mesh.axes) if sm.mesh is not None \
+            and sm.mesh.axes is not None else None
+        # axis reachability of the specs against the resolved mesh
+        if mesh_axes is not None:
+            for role, specs in (("in_specs", sm.in_specs),
+                                ("out_specs", sm.out_specs)):
+                for spec in specs or []:
+                    for ax in sorted(spec.axes() - mesh_axes):
+                        findings.append(self._finding(
+                            "GL1001", path, spec.node.lineno,
+                            f"shard_map {role} {_fmt_spec(spec)} uses "
+                            f"axis {ax!r} but its mesh declares only "
+                            f"{tuple(sorted(mesh_axes))}",
+                            symbol=f"{site}.{role}.{ax}"))
+            # collectives inside the wrapped function must use axes the
+            # mesh declares
+            if sm.fn is not None:
+                for c in model.collectives:
+                    if sm.fn in model.fn_chain(c.node) and c.axes:
+                        for ax in sorted(c.axes - mesh_axes):
+                            findings.append(self._finding(
+                                "GL1001", path, c.node.lineno,
+                                f"{c.kind} uses axis {ax!r} inside a "
+                                f"shard_map whose mesh declares only "
+                                f"{tuple(sorted(mesh_axes))}",
+                                symbol=f"{site}.{c.kind}.{ax}"))
+        if sm.fn is None:
+            return
+        # arity of the spec sequences vs the wrapped function
+        n_params = fixed_arity(sm.fn)
+        if sm.in_specs is not None and sm.in_specs_is_seq \
+                and n_params is not None \
+                and len(sm.in_specs) != n_params:
+            findings.append(self._finding(
+                "GL1003", path, sm.line,
+                f"shard_map in_specs has {len(sm.in_specs)} spec(s) but "
+                f"{site}() takes {n_params} positional parameter(s)",
+                symbol=f"{site}.in_specs"))
+        n_returns = return_arity(sm.fn)
+        if sm.out_specs is not None and sm.out_specs_is_seq \
+                and n_returns is not None \
+                and len(sm.out_specs) != n_returns:
+            findings.append(self._finding(
+                "GL1003", path, sm.line,
+                f"shard_map out_specs has {len(sm.out_specs)} spec(s) "
+                f"but {site}() returns {n_returns} value(s)",
+                symbol=f"{site}.out_specs"))
+        # literal-proven rank of the operands vs the in_specs (a spec
+        # longer than the operand rank is rejected; shorter is legal —
+        # trailing dims stay unsharded)
+        if sm.operands is not None and sm.in_specs is not None \
+                and sm.in_specs_is_seq \
+                and len(sm.operands) == len(sm.in_specs):
+            for i, (op, spec) in enumerate(zip(sm.operands,
+                                               sm.in_specs)):
+                if spec.length is None:
+                    continue
+                origin = model.km.operand_origin(op, sm.env)
+                if origin.dims is not None \
+                        and spec.length > len(origin.dims):
+                    findings.append(self._finding(
+                        "GL1003", path, sm.line,
+                        f"shard_map in_specs[{i}] {_fmt_spec(spec)} has "
+                        f"{spec.length} entries but the operand is "
+                        f"rank-{len(origin.dims)}",
+                        symbol=f"{site}.in_specs[{i}]"))
+
+    # -- GL1002 / GL1004 / GL1005: collective sites --------------------
+
+    def _check_collective(self, site: CollectiveSite,
+                          model: ModuleMeshModel, path: str,
+                          findings: List[Finding]) -> None:
+        where = self._site(model, site.node)
+        if model.collective_scope(site) == "unscoped":
+            findings.append(self._finding(
+                "GL1002", path, site.node.lineno,
+                f"{site.kind} runs outside any named-axis scope — no "
+                "shard_map/pmap binds an axis on this execution path "
+                "(unbound axis name at trace time)",
+                symbol=f"{where}.{site.kind}"))
+        if site.kind == "ppermute":
+            self._check_ppermute(site, model, path, where, findings)
+        if site.kind != "axis_index" \
+                and model.rank_branch(site.node) is not None:
+            # axis_index itself is exempt: it is per-device arithmetic,
+            # not a synchronizing collective
+            findings.append(self._finding(
+                "GL1005", path, site.node.lineno,
+                f"{site.kind} is reachable only under a rank-derived "
+                "branch (axis_index/process_index/get_rank) — ranks "
+                "that skip it hang at the next sync point",
+                symbol=f"{where}.{site.kind}.rank-branch"))
+
+    def _check_ppermute(self, site: CollectiveSite,
+                        model: ModuleMeshModel, path: str, where: str,
+                        findings: List[Finding]) -> None:
+        kw = {k.arg: k.value for k in site.node.keywords if k.arg}
+        perm_expr = kw.get("perm", site.node.args[2]
+                           if len(site.node.args) > 2 else None)
+        env = model.env_for(site.node)
+        pairs = literal_permutation(model, perm_expr, env)
+        if pairs is None:
+            return
+        srcs = [s for s, _ in pairs]
+        dsts = [d for _, d in pairs]
+        problems = []
+        if len(set(srcs)) != len(srcs):
+            dupes = sorted({s for s in srcs if srcs.count(s) > 1})
+            problems.append(f"duplicate source(s) {dupes} double-send")
+        if len(set(dsts)) != len(dsts):
+            dupes = sorted({d for d in dsts if dsts.count(d) > 1})
+            problems.append(f"duplicate destination(s) {dupes} leave "
+                            "holes")
+        if problems:
+            findings.append(self._finding(
+                "GL1004", path, site.node.lineno,
+                "non-bijective ppermute permutation: "
+                + "; ".join(problems)
+                + " — the ring deadlocks under the real mesh",
+                symbol=f"{where}.ppermute"))
+
+    def _check_rank_divergent_calls(self, model: ModuleMeshModel,
+                                    path: str,
+                                    findings: List[Finding]) -> None:
+        """One level of call expansion (like GL703): a direct call,
+        under a rank-derived branch, to a module function that contains
+        a collective."""
+        has_collective: Dict[str, str] = {}
+        for c in model.collectives:
+            if c.kind == "axis_index" or c.fn is None:
+                continue
+            name = getattr(c.fn, "name", None)
+            if name:
+                has_collective.setdefault(name, c.kind)
+        if not has_collective:
+            return
+        for node in ast.walk(model.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in has_collective):
+                continue
+            if model.defs.get(node.func.id) is None:
+                continue
+            if model.rank_branch(node) is not None:
+                where = self._site(model, node)
+                findings.append(self._finding(
+                    "GL1005", path, node.lineno,
+                    f"{node.func.id}() contains a "
+                    f"{has_collective[node.func.id]} but is called "
+                    "only under a rank-derived branch — ranks that "
+                    "skip it hang at the next sync point",
+                    symbol=f"{where}.{node.func.id}.rank-branch"))
+
+    # -- GL1006: SpecLayout vocabulary ---------------------------------
+
+    def _layout_bindings(self, model: ModuleMeshModel,
+                         env: Dict[str, ast.expr], at: ast.AST,
+                         in_function: bool
+                         ) -> List[Tuple[str, Dict[str, str]]]:
+        """(name, axes) for every SpecLayout bound by a name visible at
+        ``at`` — function-local binds first, then module-level ones. A
+        binding textually after the use site only counts when the use
+        runs later (a module-level bind referenced from a function
+        body); same-scope forward references would NameError."""
+        out: List[Tuple[str, Dict[str, str]]] = []
+        seen: Set[str] = set()
+        for scope, local in ((env, True), (model.module_env, False)):
+            for name, value in scope.items():
+                if name in seen:
+                    continue
+                if (local or not in_function) \
+                        and value.lineno >= at.lineno:
+                    continue
+                axes = self._layout_axes(value)
+                if axes is not None:
+                    out.append((name, axes))
+                    seen.add(name)
+        return out
+
+    def _layout_axes(self, value: ast.expr
+                     ) -> Optional[Dict[str, str]]:
+        if not isinstance(value, ast.Call):
+            return None
+        name = callee_name(value)
+        if name == "default_layout" and not value.args \
+                and not value.keywords:
+            return dict(_LAYOUT_DEFAULTS)
+        if name != "SpecLayout" or value.args:
+            return None
+        axes = dict(_LAYOUT_DEFAULTS)
+        for kw in value.keywords:
+            if kw.arg not in axes:
+                return None
+            if not (isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)):
+                return None      # non-literal override: layout unknown
+            axes[kw.arg] = kw.value.value
+        return axes
+
+    def _canonical_method(self, axes: Dict[str, str],
+                          entries: List[object]) -> Optional[str]:
+        """The SpecLayout method call that builds exactly this literal
+        spec under ``axes``, or None. Keep in sync with
+        paddle_tpu/distributed/spec_layout.py."""
+        if any(e is UNKNOWN or isinstance(e, tuple) for e in entries):
+            return None
+        n = len(entries)
+        named = [(i, e) for i, e in enumerate(entries) if e is not None]
+        if n == 0:
+            return "replicated()"
+        if len(named) != 1:
+            return None
+        i, ax = named[0]
+        if ax == axes["data_axis"]:
+            if i == 0:
+                return "batch()" if n == 1 else f"batch(ndim={n})"
+            return (f"stacked_batch(ndim={n})" if i == 1
+                    else f"stacked_batch(ndim={n}, batch_dim={i})")
+        if ax == axes["fsdp_axis"] and i == 0:
+            return "fsdp_rows()" if n == 2 else f"fsdp_rows(ndim={n})"
+        if ax == axes["tp_axis"]:
+            if i == 0:
+                return "tp_rows()" if n == 2 else f"tp_rows(ndim={n})"
+            if i == n - 1:
+                return "tp_cols()" if n == 2 else f"tp_cols(ndim={n})"
+            return None
+        if ax == axes["seq_axis"]:
+            if i == 1:
+                return "sequence()" if n == 4 else f"sequence(ndim={n})"
+            return f"sequence(ndim={n}, seq_dim={i})"
+        if ax == axes["expert_axis"] and i == 0:
+            return "experts()" if n == 3 else f"experts(ndim={n})"
+        return None
+
+    def _check_spec_vocabulary(self, model: ModuleMeshModel, src: str,
+                               path: str,
+                               findings: List[Finding]) -> None:
+        base = os.path.basename(path)
+        if base.startswith("test_") or base == "spec_layout.py":
+            # tests exercise raw specs deliberately; the vocabulary
+            # module is where the literals are supposed to live
+            return
+        for node in ast.walk(model.tree):
+            if not (isinstance(node, ast.Call)
+                    and model.is_ctor(node, "PartitionSpec")):
+                continue
+            env = model.env_for(node)
+            spec = model.resolve_spec(node, env)
+            if spec is None or not spec.fully_literal():
+                continue
+            in_function = model.km.enclosing_fn(node) is not None
+            for name, axes in self._layout_bindings(model, env, node,
+                                                    in_function):
+                method = self._canonical_method(axes, spec.entries)
+                if method is None:
+                    continue
+                site = self._site(model, node)
+                f = self._finding(
+                    "GL1006", path, node.lineno,
+                    f"inline {_fmt_spec(spec)} spells the canonical "
+                    f"form {name}.{method} — route it through the "
+                    "bound SpecLayout",
+                    symbol=f"{site}.{method.split('(')[0]}")
+                f.fix = replace_span_fix(
+                    src, node, f"{name}.{method}",
+                    note=f"replace inline PartitionSpec literal with "
+                         f"{name}.{method}")
+                findings.append(f)
+                break
