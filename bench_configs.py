@@ -678,6 +678,99 @@ def bench_continuous_batching(dev, on_tpu):
     return entry
 
 
+def bench_tracing_overhead(dev, on_tpu):
+    """The flight recorder's cost on the continuous-batching decode
+    workload. The span API is compiled into the serving hot path
+    unconditionally, so the number that matters is the DISABLED mode:
+    a disabled ``trace_span``/``trace_event`` must be one branch + one
+    null-object return. Measured three ways: (a) micro — ns per
+    disabled call; (b) call rate — recorder invocations per generated
+    token, counted from one traced run of the same workload; (c) the
+    derived steady-state fraction (a)x(b) / per-token wall time, pinned
+    under 1 % (``disabled_overhead_ok``). The enabled-mode wall ratio
+    rides along as an informational number (ring pushes are real work;
+    it has no bar)."""
+    import threading
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.profiler import tracing
+    from paddle_tpu.serving import decode
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny())
+    model.eval()
+    n_requests = 48 if on_tpu else 24
+    rng = np.random.RandomState(0)
+    reqs = [(rng.randint(0, 250, (int(rng.randint(4, 17)),)
+                         ).astype(np.int32), int(rng.randint(4, 17)))
+            for _ in range(n_requests)]
+    total_new = sum(g for _, g in reqs)
+
+    def run_clients(dsrv):
+        errs = []
+
+        def client(i):
+            try:
+                p, g = reqs[i]
+                dsrv.submit(p, max_new_tokens=g).result(timeout=600)
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(n_requests)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise RuntimeError(f"{len(errs)} clients failed: {errs[0]}")
+        return time.perf_counter() - t0
+
+    # (a) micro: the disabled record path, ns/call
+    tracing.reset_tracing()
+    tracing.disable_tracing()
+    n_micro = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n_micro):
+        tracing.trace_span("bench::span", cat="bench")
+        tracing.trace_event("bench::event", cat="bench")
+    ns_per_call = (time.perf_counter() - t0) / (2 * n_micro) * 1e9
+
+    entry = {"n_requests": n_requests, "total_new_tokens": total_new,
+             "disabled_ns_per_call": round(ns_per_call, 1)}
+
+    with decode.DecodeServer(model, max_slots=8, page_len=8,
+                             max_context=48, prefill_buckets=[16],
+                             max_queue_size=n_requests + 8) as dsrv:
+        dsrv.warmup()
+        run_clients(dsrv)                   # untimed warm pass
+        wall_off = run_clients(dsrv)        # recorder compiled in, OFF
+        # (b) one traced run of the same workload: events per token is
+        # the recorder's call rate on this exact hot path
+        tracing.enable_tracing(ring_size=1 << 16)
+        wall_on = run_clients(dsrv)
+        n_events = len(tracing.snapshot_events())
+        tracing.reset_tracing()
+        tracing.disable_tracing()
+
+    per_token_s = wall_off / total_new
+    events_per_token = n_events / total_new
+    # (c) the steady-state disabled fraction: call rate x disabled cost
+    frac = events_per_token * ns_per_call / (per_token_s * 1e9)
+    entry.update({
+        "tokens_per_sec_off": round(total_new / wall_off, 1),
+        "tokens_per_sec_on": round(total_new / wall_on, 1),
+        "wall_off_s": round(wall_off, 3),
+        "wall_on_s": round(wall_on, 3),
+        "enabled_wall_ratio": round(wall_on / wall_off, 3),
+        "events_per_token": round(events_per_token, 2),
+        "disabled_overhead_frac": round(frac, 6),
+        "disabled_overhead_ok": bool(frac < 0.01)})
+    return entry
+
+
 def bench_router_failover(dev, on_tpu):
     """Multi-host serving router over 3 in-process DecodeServer
     backends: routing overhead vs a direct single server on the same
@@ -846,7 +939,8 @@ def bench_router_failover(dev, on_tpu):
 
 CONFIG_NAMES = ("llama_tp_chip", "llama_zero3_layout", "bert_1f1b",
                 "resnet50", "serving_throughput", "input_pipeline",
-                "continuous_batching", "router_failover")
+                "continuous_batching", "router_failover",
+                "tracing_overhead")
 
 
 def _run_config(name, dev, on_tpu):
@@ -860,6 +954,7 @@ def _run_config(name, dev, on_tpu):
         "continuous_batching":
             lambda: bench_continuous_batching(dev, on_tpu),
         "router_failover": lambda: bench_router_failover(dev, on_tpu),
+        "tracing_overhead": lambda: bench_tracing_overhead(dev, on_tpu),
     }
     return fns[name]()
 

@@ -381,6 +381,7 @@ def run_steps(step, params, opt_state, feed, *, key=None, lr=1e-3,
     import time
 
     from ..io.prefetch import DevicePrefetcher, PipelineMetrics
+    from ..profiler import tracing
 
     if key is None:
         key = jax.random.key(0)
@@ -408,7 +409,8 @@ def run_steps(step, params, opt_state, feed, *, key=None, lr=1e-3,
 
     def fetch(val, i):
         t0 = time.perf_counter()
-        got = jax.device_get(val)
+        with tracing.trace_span("train::fetch", cat="train", step=i):
+            got = jax.device_get(val)
         metrics.add_time("device_blocked_s", time.perf_counter() - t0)
         losses.append(got)
         if log_every and on_log is not None and i % log_every == 0:
@@ -421,18 +423,25 @@ def run_steps(step, params, opt_state, feed, *, key=None, lr=1e-3,
         while True:
             try:
                 t0 = time.perf_counter()
+                # span handle, not a with-block: a StopIteration break
+                # drops it unrecorded instead of logging a bogus wait
+                feed_span = tracing.trace_span("train::feed_wait",
+                                               cat="train", step=i)
                 try:
                     batch = next(it)
                 except StopIteration:
                     break
+                feed_span.end()
                 if owns_metrics:
                     metrics.add_time("host_blocked_s",
                                      time.perf_counter() - t0)
                     metrics.inc("batches_out")
                 ids, labels = batch
-                loss, params, opt_state = step(
-                    params, opt_state, jax.random.fold_in(key, i), ids,
-                    labels, lr_fn(i))
+                with tracing.trace_span("train::dispatch", cat="train",
+                                        step=i):
+                    loss, params, opt_state = step(
+                        params, opt_state, jax.random.fold_in(key, i),
+                        ids, labels, lr_fn(i))
                 if checkpoint_manager is not None:
                     checkpoint_manager.maybe_save(
                         i, {"params": params, "opt_state": opt_state,

@@ -14,11 +14,20 @@ from __future__ import annotations
 import collections
 import json
 import os
+import re
 import threading
 import time
 import weakref
+import zlib
 from enum import Enum
 from typing import Callable, Iterable, List, Optional
+
+from .tracing import (TraceContext, trace_span, trace_event, new_trace_id,
+                      current_trace_id, enable_tracing, disable_tracing,
+                      tracing_enabled, snapshot_events, export_trace,
+                      start_trace_writer, stop_trace_writer,
+                      set_clock_offset, set_trace_metadata, record_compile,
+                      compile_count, reset_tracing)
 
 __all__ = ["ProfilerState", "ProfilerTarget", "make_scheduler",
            "export_chrome_tracing", "RecordEvent", "Profiler",
@@ -32,7 +41,14 @@ __all__ = ["ProfilerState", "ProfilerTarget", "make_scheduler",
            "router_stats", "register_router_source",
            "unregister_router_source", "transport_stats",
            "register_transport_source", "unregister_transport_source",
-           "export_stats"]
+           "export_stats",
+           # flight-recorder tracing (profiler.tracing re-exports)
+           "TraceContext", "trace_span", "trace_event", "new_trace_id",
+           "current_trace_id", "enable_tracing", "disable_tracing",
+           "tracing_enabled", "snapshot_events", "export_trace",
+           "start_trace_writer", "stop_trace_writer", "set_clock_offset",
+           "set_trace_metadata", "record_compile", "compile_count",
+           "reset_tracing"]
 
 
 class ProfilerState(Enum):
@@ -579,7 +595,18 @@ def _flatten_scrape(prefix: str, value, out: list) -> None:
 
 
 def _sanitize(name: str) -> str:
-    return "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    """Prometheus-legal metric name: every char outside ``[a-zA-Z0-9_]``
+    becomes ``_`` (ASCII-only — ``isalnum`` would wave unicode through),
+    a leading digit gets a ``_`` prefix, and — collision safety — any
+    name the rewrite CHANGED gets a short stable hash of the original
+    appended, so distinct hostile names ("a.b" vs "a-b") cannot collapse
+    onto the same series."""
+    clean = re.sub(r"[^a-zA-Z0-9_]", "_", name)
+    if clean[:1].isdigit():
+        clean = "_" + clean
+    if clean != name:
+        clean = f"{clean}_{zlib.crc32(name.encode('utf-8')):08x}"
+    return clean
 
 
 def export_stats(format: str = "dict"):

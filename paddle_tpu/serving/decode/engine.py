@@ -36,6 +36,7 @@ import numpy as np
 
 from ...jit import StaticFunction
 from ...nn.layer.layers import Layer
+from ...profiler import tracing
 from ..batcher import (DeadlineExceeded, ServerClosed, ServerOverloaded,
                        ServingError)
 from ..bucketing import (BucketOverflow, next_bucket_strict, page_buckets,
@@ -282,11 +283,14 @@ class DecodeServer(ServerLifecycleMixin):
     # -- client API --------------------------------------------------------
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
                eos_id: Optional[int] = None,
-               deadline_ms: Optional[float] = None) -> DecodeStream:
+               deadline_ms: Optional[float] = None,
+               trace_id: Optional[str] = None) -> DecodeStream:
         """Enqueue one generation request (``prompt``: 1-D token ids).
         Returns a DecodeStream; a full queue raises ServerOverloaded, a
         closed server ServerClosed, an over-budget prompt
-        BucketOverflow."""
+        BucketOverflow. ``trace_id`` tags the request's flight-recorder
+        spans (wire-propagated by the router; defaults to the caller's
+        ``TraceContext``, or a fresh id when tracing is enabled)."""
         if self._is_closed():
             raise ServerClosed("server is shutting down")
         # graft-lint: disable=GL505 -- admission-side host staging:
@@ -309,9 +313,17 @@ class DecodeServer(ServerLifecycleMixin):
                 f"max_context {self.max_context}")
         deadline_s = (float(deadline_ms) / 1e3 if deadline_ms is not None
                       else self._default_deadline_s)
+        if trace_id is None:
+            trace_id = tracing.current_trace_id()
+            if trace_id is None and tracing.tracing_enabled():
+                trace_id = tracing.new_trace_id()
         req = DecodeRequest(
             arr, mnt, eos_id if eos_id is not None else self.default_eos_id,
-            None if deadline_s is None else time.monotonic() + deadline_s)
+            None if deadline_s is None else time.monotonic() + deadline_s,
+            trace_id=trace_id)
+        tracing.trace_event("decode::enqueue", cat="decode",
+                            trace_id=trace_id, server=self.name,
+                            prompt_len=int(arr.size))
         # a request whose page budget exceeds the whole pool can never
         # be admitted — fail it here (synchronously) rather than letting
         # it wedge the admission queue head (reads only immutable
@@ -401,6 +413,8 @@ class DecodeServer(ServerLifecycleMixin):
             if stream.done():
                 return False
             if self._queue.expire_stream(stream):
+                tracing.trace_event("decode::cancel", cat="decode",
+                                    server=self.name, where="queued")
                 return True
             # slot entries flip atomically between None and a Slot (the
             # active_slots contract); forcing req.deadline from this
@@ -409,6 +423,9 @@ class DecodeServer(ServerLifecycleMixin):
             for slot in list(self._sched.slots):
                 if slot is not None and slot.req.stream is stream:
                     slot.req.deadline = time.monotonic() - 1.0
+                    tracing.trace_event("decode::cancel", cat="decode",
+                                        trace_id=slot.req.trace_id,
+                                        where="running")
                     return True
             time.sleep(0.002)
         return False
@@ -520,6 +537,12 @@ class DecodeServer(ServerLifecycleMixin):
                 return
             try:
                 slot = self._sched.try_admit(req)
+                if slot is not None:
+                    tracing.trace_event(
+                        "decode::admit", cat="decode",
+                        trace_id=req.trace_id, slot=slot.index,
+                        queue_wait_ms=(time.monotonic() - req.t_submit)
+                        * 1e3)
             except (BucketOverflow, ServingError) as e:
                 # a preemption-grown prompt can outgrow the prefill
                 # buckets — settle it rather than wedging the queue head
@@ -543,6 +566,11 @@ class DecodeServer(ServerLifecycleMixin):
         eff = req.effective_prompt
         t0 = time.monotonic()
         self._metrics.observe("queue_wait_ms", (t0 - req.t_submit) * 1e3)
+        # span handle, closed just before the first-token emit (the
+        # _Span clock starts at construction; .end() records it)
+        span = tracing.trace_span("decode::prefill", cat="decode",
+                                  trace_id=req.trace_id,
+                                  prompt_len=len(eff))
         sb = next_bucket_strict(len(eff), self._prefill_buckets,
                                 "prompt length")
         tokens = np.zeros((1, sb), np.int32)
@@ -567,6 +595,7 @@ class DecodeServer(ServerLifecycleMixin):
         self._metrics.inc("prefills")
         self._metrics.observe("prefill_ms",
                               (time.monotonic() - t0) * 1e3)
+        span.end()
         self._emit(slot, nxt)
 
     def _decode_step(self):
@@ -576,9 +605,20 @@ class DecodeServer(ServerLifecycleMixin):
             if self._sched.slots[slot.index] is not slot:
                 continue      # preempted by an earlier slot's growth
             try:
+                pages_before = len(slot.pages)
                 for req in self._sched.ensure_capacity(slot):
-                    self._metrics.inc("preempted")
+                    self._metrics.inc("preemptions")
+                    tracing.trace_event("decode::preempt", cat="decode",
+                                        trace_id=req.trace_id,
+                                        generated=req.generated)
                     self._queue.put(req, front=True)
+                grown = len(slot.pages) - pages_before
+                if grown > 0:
+                    self._metrics.inc("page_growths", grown)
+                    tracing.trace_event("decode::page_growth",
+                                        cat="decode",
+                                        trace_id=slot.req.trace_id,
+                                        pages=grown)
             except PagesExhausted as e:
                 # pool cannot hold even this one sequence: fail it
                 self._sched.release(slot)
@@ -589,6 +629,8 @@ class DecodeServer(ServerLifecycleMixin):
         if not active:
             return
         t0 = time.monotonic()
+        step_span = tracing.trace_span("decode::step", cat="decode",
+                                       batch=len(active))
         bb, pb = self._sched.decode_shape()
         tokens = np.zeros((bb, 1), np.int32)
         positions = np.zeros((bb,), np.int32)
@@ -607,6 +649,7 @@ class DecodeServer(ServerLifecycleMixin):
         # batched D2H of [B] sampled token ids per decode step (clients
         # stream them; the host scheduler needs them for eos/length)
         nxt = np.asarray(jax.device_get(out[0]))
+        step_span.end()
         alloc = self._sched.allocator
         self._metrics.inc("decode_steps")
         self._metrics.observe("decode_step_ms",
@@ -624,9 +667,16 @@ class DecodeServer(ServerLifecycleMixin):
         """Stream one sampled token and settle the sequence if it just
         finished (eos, generation budget, or context limit)."""
         req = slot.req
+        now = time.monotonic()
         if req.generated == 0:
-            self._metrics.observe("ttft_ms",
-                                  (time.monotonic() - req.t_submit) * 1e3)
+            self._metrics.observe("ttft_ms", (now - req.t_submit) * 1e3)
+            tracing.trace_event("decode::first_token", cat="decode",
+                                trace_id=req.trace_id,
+                                ttft_ms=(now - req.t_submit) * 1e3)
+        elif slot.t_last_emit is not None:
+            self._metrics.observe("inter_token_ms",
+                                  (now - slot.t_last_emit) * 1e3)
+        slot.t_last_emit = now
         slot.last_token = token       # input of the next decode step
         req.stream._put(token)
         self._metrics.inc("tokens_generated")
@@ -642,4 +692,7 @@ class DecodeServer(ServerLifecycleMixin):
             self._sched.release(slot)
             self._metrics.inc("completed")
             self._metrics.observe("tokens_per_request", req.generated)
+            tracing.trace_event("decode::finish", cat="decode",
+                                trace_id=req.trace_id, reason=reason,
+                                tokens=req.generated)
             req.stream._finish(reason)

@@ -11,21 +11,26 @@ class DecodeMetrics(MetricsBase):
     """Thread-safe counters/histograms for one DecodeServer.
 
     Counters: submitted, completed, rejected_overload, expired, failed,
-    preempted, prefills, decode_steps, tokens_generated, compile_count.
+    preemptions (slots evicted for page pressure; also emitted under the
+    legacy name ``preempted``), page_growths (ensure_capacity page
+    allocations mid-decode), prefills, decode_steps, tokens_generated,
+    compile_count.
     Histograms: batch_size (active slots per decode step),
     slot_occupancy (active / max_slots), page_utilization (used pages /
     usable pool), prefill_ms, decode_step_ms (device step wall time),
     queue_wait_ms (submit -> admission), ttft_ms (submit -> first
-    token), tokens_per_request.
+    token), inter_token_ms (gap between consecutive emitted tokens of
+    one request — the serving SLO pair with ttft_ms),
+    tokens_per_request.
     Gauge: queue_depth (pull-type, read at snapshot time).
     """
 
     COUNTERS = ("submitted", "completed", "rejected_overload", "expired",
-                "failed", "preempted", "prefills", "decode_steps",
-                "tokens_generated", "compile_count")
+                "failed", "preemptions", "page_growths", "prefills",
+                "decode_steps", "tokens_generated", "compile_count")
     HISTS = ("batch_size", "slot_occupancy", "page_utilization",
              "prefill_ms", "decode_step_ms", "queue_wait_ms", "ttft_ms",
-             "tokens_per_request")
+             "inter_token_ms", "tokens_per_request")
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -33,5 +38,7 @@ class DecodeMetrics(MetricsBase):
             out["name"] = self.name
             for k, h in self._hists.items():
                 out[k] = h.snapshot()
+        # legacy alias: pre-rename consumers read ``preempted``
+        out["preempted"] = out["preemptions"]
         out["queue_depth"] = self._read_gauge()
         return out

@@ -143,10 +143,11 @@ class DecodeRequest:
     anything."""
 
     __slots__ = ("prompt", "max_new_tokens", "eos_id", "deadline",
-                 "stream", "t_submit", "seq")
+                 "stream", "t_submit", "seq", "trace_id")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
-                 eos_id: Optional[int], deadline: Optional[float]):
+                 eos_id: Optional[int], deadline: Optional[float],
+                 trace_id: Optional[str] = None):
         self.prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
@@ -154,6 +155,9 @@ class DecodeRequest:
         self.stream = DecodeStream()
         self.t_submit = time.monotonic()
         self.seq = next(_seq)
+        # request-scoped flight-recorder id (router-stamped over the
+        # wire, or locally minted) — every lifecycle span carries it
+        self.trace_id = trace_id
 
     @property
     def generated(self) -> int:
@@ -268,7 +272,7 @@ class Slot:
     """One row of the decode batch: a running sequence's host state."""
 
     __slots__ = ("index", "req", "pages", "length", "last_token",
-                 "reserved", "t_admitted")
+                 "reserved", "t_admitted", "t_last_emit")
 
     def __init__(self, index: int, req: DecodeRequest,
                  pages: List[int], reserved: int):
@@ -279,6 +283,7 @@ class Slot:
         self.last_token: int = 0      # feeds the next decode step
         self.reserved = reserved      # worst-case pages not yet allocated
         self.t_admitted = time.monotonic()
+        self.t_last_emit: Optional[float] = None   # inter_token_ms anchor
 
     @property
     def generated(self) -> int:

@@ -95,6 +95,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "serving")
     p.add_argument("--drain-timeout-s", type=float, default=30.0,
                    help="bound on the SIGTERM drain before exit")
+    p.add_argument("--trace-dir", default=None,
+                   help="enable the flight recorder and background-"
+                        "flush this host's chrome trace to "
+                        "<dir>/<backend-id>.trace.json (the file a "
+                        "SIGKILLed host leaves behind for "
+                        "tools/trace_merge.py); defaults to "
+                        "$PADDLE_TRACE_DIR when set")
     return p
 
 
@@ -122,8 +129,18 @@ def main(argv=None) -> int:
     backend_id = args.backend_id or f"host{os.getpid()}"
 
     # heavyweight imports AFTER arg parsing so --help stays instant
+    from paddle_tpu.profiler import tracing
     from paddle_tpu.serving import Server, decode
     from paddle_tpu.serving.transport import BackendServer
+
+    # flight recorder BEFORE model build so warmup compiles are traced;
+    # the background writer is what makes SIGKILL leave a trace behind
+    trace_dir = args.trace_dir or os.environ.get("PADDLE_TRACE_DIR")
+    if trace_dir:
+        tracing.enable_tracing()
+        tracing.set_trace_metadata(backend_id=backend_id, role="host")
+        tracing.start_trace_writer(
+            os.path.join(trace_dir, f"{backend_id}.trace.json"))
 
     model = _build_model(args)
     if args.checkpoint:
@@ -179,6 +196,11 @@ def main(argv=None) -> int:
     # drain-then-exit: stop admitting, finish in-flight work, close
     print("draining (SIGTERM)", flush=True)
     drained = bs.shutdown(drain=True, timeout=args.drain_timeout_s)
+    if trace_dir:
+        # final flush: the clean-exit counterpart of the SIGKILL case
+        tracing.stop_trace_writer()
+        tracing.export_trace(
+            os.path.join(trace_dir, f"{backend_id}.trace.json"))
     print(f"drained={drained} exiting", flush=True)
     return 0 if drained else 1
 

@@ -47,12 +47,15 @@ class ServerLifecycleMixin:
         m = getattr(self, "_metrics", None)
         if m is None:       # half-constructed host: nothing in flight
             return True
+        from ..profiler import tracing
         end = None if timeout is None else time.monotonic() + timeout
-        while (m["completed"] + m["expired"] + m["failed"]
-               < m["submitted"]):
-            if end is not None and time.monotonic() > end:
-                return False
-            time.sleep(0.002)
+        with tracing.trace_span("serving::drain", cat="serving",
+                                host=getattr(self, "name", None)):
+            while (m["completed"] + m["expired"] + m["failed"]
+                   < m["submitted"]):
+                if end is not None and time.monotonic() > end:
+                    return False
+                time.sleep(0.002)
         return True
 
     def close(self):

@@ -81,6 +81,14 @@ class Backend:
         weighted-least-loaded placement. Best-effort; must not block."""
         raise NotImplementedError
 
+    def host_stats(self, timeout: Optional[float] = None) -> dict:
+        """This host's metrics snapshot, keyed by section (``oneshot``,
+        ``decode``, transports add ``transport``) — what
+        ``Router.scrape_fleet`` flattens into the fleet exposition.
+        Raise ``BackendDied`` (within ``timeout``) when the host cannot
+        answer."""
+        raise NotImplementedError
+
     def close(self) -> None:
         """Release the transport (and the host, when owned)."""
         raise NotImplementedError
@@ -246,6 +254,16 @@ class InProcessBackend(Backend):
         if self._decode is not None:
             n += self._decode.queue_depth() + self._decode.active_slots()
         return n
+
+    def host_stats(self, timeout: Optional[float] = None) -> dict:
+        del timeout     # in-process: nothing to wait on
+        self._consult(0.0)      # a killed/blackholed "host" scrapes down
+        out = {"backend_id": self.backend_id}
+        if self._server is not None:
+            out["oneshot"] = self._server.stats()
+        if self._decode is not None:
+            out["decode"] = self._decode.stats()
+        return out
 
     def close(self) -> None:
         if not self._owns:

@@ -44,6 +44,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ...profiler import tracing
 from ..batcher import (DeadlineExceeded, Future, ServerClosed,
                        ServerOverloaded, ServingError)
 from ..bucketing import BucketOverflow, bucket_example, next_bucket_strict
@@ -69,7 +70,7 @@ class _RouterRequest:
 
     __slots__ = ("kind", "args", "key", "prompt", "max_new_tokens",
                  "eos_id", "deadline", "future", "stream", "t_submit",
-                 "settled")
+                 "settled", "trace_id")
 
     def __init__(self, kind: str, key: tuple, deadline: Optional[float]):
         self.kind = kind
@@ -83,6 +84,7 @@ class _RouterRequest:
         self.stream = DecodeStream() if kind == "decode" else None
         self.t_submit = time.monotonic()
         self.settled = False
+        self.trace_id = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None
@@ -291,6 +293,21 @@ class Router(ServerLifecycleMixin):
             self._metrics.inc("submitted", -1)
             raise
 
+    def _stamp_trace(self, rr: _RouterRequest) -> None:
+        """Flight-recorder admission stamp: the router is the trace ROOT
+        for routed requests. The id minted (or inherited from the
+        caller's ``TraceContext``) here rides the request through every
+        downstream hop — dispatch spans, wire frame meta, host-side
+        decode lifecycle — so ``tools/trace_merge.py`` can stitch one
+        request's timeline across processes. No-cost when tracing is
+        disabled (``trace_id`` stays None, nothing is stamped)."""
+        tid = tracing.current_trace_id()
+        if tid is None and tracing.tracing_enabled():
+            tid = tracing.new_trace_id()
+        rr.trace_id = tid
+        tracing.trace_event("router::submit", cat="router", trace_id=tid,
+                            kind=rr.kind)
+
     def submit(self, *args, deadline_ms: Optional[float] = None) -> Future:
         """Route one one-shot request (per-example arrays, no batch dim —
         the ``Server.submit`` contract). Returns a Future; a full router
@@ -312,6 +329,7 @@ class Router(ServerLifecycleMixin):
             (bucket_example(a, seq_buckets), str(a.dtype)) for a in arrs)
         rr = _RouterRequest("oneshot", key, self._deadline(deadline_ms))
         rr.args = arrs
+        self._stamp_trace(rr)
         self._retry.on_request()
         self._enqueue(rr)
         return rr.future
@@ -362,6 +380,7 @@ class Router(ServerLifecycleMixin):
         rr.prompt = arr
         rr.max_new_tokens = mnt
         rr.eos_id = eos_id
+        self._stamp_trace(rr)
         self._retry.on_request()
         self._enqueue(rr)
         return rr.stream
@@ -388,6 +407,32 @@ class Router(ServerLifecycleMixin):
 
     def backends(self) -> List[Backend]:
         return [e.backend for e in self._backends]
+
+    def scrape_fleet(self, timeout_s: float = 1.0) -> str:
+        """One Prometheus-style text scrape over the whole fleet: the
+        router's own metrics plus every backend's ``host_stats()``
+        (one-shot/decode server snapshots incl. the latency histograms,
+        transport counters), flattened to ``name value`` exposition
+        lines under ``paddle_tpu_backend_<id>_...``. A backend that
+        cannot answer within ``timeout_s`` (dead, blackholed) scrapes
+        as its ``..._up 0`` line alone — a down host must not wedge or
+        empty the fleet scrape. Names pass through the collision-safe
+        sanitizer, so hostile backend ids cannot collapse onto one
+        series."""
+        from ...profiler import _flatten_scrape, _sanitize
+        lines: list = []
+        _flatten_scrape(f"paddle_tpu_router_{self.name}",
+                        self._metrics.snapshot(), lines)
+        for e in self._backends:
+            prefix = f"paddle_tpu_backend_{e.backend.backend_id}"
+            try:
+                st = e.backend.host_stats(timeout=timeout_s)
+            except Exception:
+                lines.append(f"{_sanitize(prefix)}_up 0")
+                continue
+            lines.append(f"{_sanitize(prefix)}_up 1")
+            _flatten_scrape(prefix, st, lines)
+        return "\n".join(lines) + "\n"
 
     def _backend_states(self) -> dict:
         out = {}
@@ -482,10 +527,14 @@ class Router(ServerLifecycleMixin):
             self._metrics.observe("queue_wait_ms",
                                   (now - rr.t_submit) * 1e3)
             try:
-                if rr.kind == "decode":
-                    self._dispatch_decode(rr)
-                else:
-                    self._dispatch_oneshot(rr)
+                # the dispatch worker runs under the request's trace id:
+                # every backend call below (and the wire client's frame
+                # meta) picks it up from the thread context
+                with tracing.TraceContext(rr.trace_id):
+                    if rr.kind == "decode":
+                        self._dispatch_decode(rr)
+                    else:
+                        self._dispatch_oneshot(rr)
             except Exception as e:  # noqa: BLE001 — the worker must survive
                 if not rr.settled:
                     rr.settle_exc(
@@ -797,6 +846,10 @@ class Router(ServerLifecycleMixin):
         last_exc = None
         overload_only = True
         waiting_since = None
+        # open while a failover is in progress: starts at the mid-stream
+        # death, ends at the successful re-admission elsewhere — the
+        # merged timeline shows the failover GAP as one explicit span
+        fo_span = None
         while True:
             if self._abort:
                 rr.settle_exc(ServerClosed("router aborted"))
@@ -873,7 +926,13 @@ class Router(ServerLifecycleMixin):
                                           attempt)
                     return
                 continue
-            outcome, exc = self._relay(rr, entry, bs)
+            if fo_span is not None:     # re-admitted: failover complete
+                fo_span.end()
+                fo_span = None
+            with tracing.trace_span("router::relay", cat="router",
+                                    trace_id=rr.trace_id,
+                                    backend=entry.backend.backend_id):
+                outcome, exc = self._relay(rr, entry, bs)
             if outcome == "done":
                 entry.health.record_request(
                     True, (time.monotonic() - t0) * 1e3)
@@ -921,6 +980,10 @@ class Router(ServerLifecycleMixin):
             self._metrics.inc("failovers")
             self._metrics.inc("decode_failovers")
             self._metrics.inc("tokens_resumed", rr.stream.token_count())
+            fo_span = tracing.trace_span(
+                "router::failover", cat="router", trace_id=rr.trace_id,
+                from_backend=entry.backend.backend_id,
+                tokens_resumed=rr.stream.token_count())
             excluded = {entry.backend.backend_id}
             if failovers > self._max_decode_failovers:
                 self._settle_unserved(rr, last_exc, overload_only,

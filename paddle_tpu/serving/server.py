@@ -33,6 +33,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..profiler import tracing
 from .batcher import (DeadlineExceeded, Future, Request, RequestQueue,
                       ServerClosed, ServerOverloaded, ServingError)
 from .bucketing import (bucket_example, next_bucket_strict, pow2_buckets,
@@ -310,6 +311,11 @@ class Server(ServerLifecycleMixin):
                       else time.monotonic() + deadline_s)
         req.real_len = int(arrs[0].shape[0]) if arrs[0].ndim else 0
         req.padded_len = key[0][0][0] if arrs[0].ndim else 0
+        # trace_id rides in from the caller's TraceContext (the wire
+        # handler enters one per frame) — the enqueue instant is the
+        # server-side start of this request's timeline
+        tracing.trace_event("serving::submit", cat="serving",
+                            server=self.name)
         # counted BEFORE put so drain()'s submitted==settled invariant
         # never transiently undercounts an in-flight request
         self._metrics.inc("submitted")
@@ -447,7 +453,9 @@ class Server(ServerLifecycleMixin):
             real += real_i
             padded += int(arr.size)
         try:
-            with RecordEvent(f"serving::execute[b{bb}]", "Serving"):
+            with RecordEvent(f"serving::execute[b{bb}]", "Serving"), \
+                    tracing.trace_span("serving::execute", cat="serving",
+                                       batch=n, bucket=bb):
                 outs = self._executor.run(stacked)
         except Exception as e:  # noqa: BLE001 — fail the batch, not the server
             for r in batch:

@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Optional, Sequence
 
+from ...profiler import tracing
 from ..batcher import DeadlineExceeded, Future, ServerClosed
 from ..decode.scheduler import DecodeStream
 from ..router.backend import Backend
@@ -165,6 +166,7 @@ class RemoteBackend(Backend):
                 # handlers below would leak the fresh fd (GL801)
                 sock.settimeout(self._poll_s)
                 reader = FrameReader(sock, self._metrics)
+                t_send = time.time()
                 send_msg(sock, ("hello", WIRE_VERSION),
                          metrics=self._metrics)
                 msg = None
@@ -175,6 +177,7 @@ class RemoteBackend(Backend):
                             f"connection but sent no hello within "
                             f"{timeout:.2f}s")
                     msg = reader.poll()
+                t_recv = time.time()
             except (WireError, OSError) as e:
                 sock.close()
                 raise BackendDied(
@@ -200,6 +203,16 @@ class RemoteBackend(Backend):
                     f"backend {self.backend_id!r} speaks wire version "
                     f"{info.get('version')!r}, this client speaks "
                     f"{WIRE_VERSION} — mismatched deployments")
+            if isinstance(info.get("time"), (int, float)):
+                # NTP-style one-sample offset: the host stamped its wall
+                # clock somewhere inside [t_send, t_recv]; the midpoint
+                # estimate is what trace_merge uses to align timelines
+                # (localhost RTTs make the error microseconds)
+                offset = float(info["time"]) - (t_send + t_recv) / 2.0
+                tracing.set_clock_offset(
+                    str(info.get("backend_id", self.backend_id)), offset)
+            tracing.trace_event("wire::connected", cat="wire",
+                                backend_id=self.backend_id)
             with self._lock:
                 if self._closed:
                     # close() raced this connect (its _lock pass beat
@@ -331,6 +344,11 @@ class RemoteBackend(Backend):
             if entry.get("stream") is not None:
                 entry["stream"]._finish(value)
             entry["ack"].set()
+            meta = msg[3] if len(msg) > 3 and isinstance(msg[3], dict) \
+                else {}
+            tracing.trace_event("client::fin", cat="wire",
+                                trace_id=meta.get("trace_id"),
+                                backend_id=self.backend_id, reason=value)
         elif what == "result":
             if entry.get("fut") is not None:
                 entry["fut"].set_result(value)
@@ -447,12 +465,24 @@ class RemoteBackend(Backend):
                 self._bucket_cfg = cfg
         return cfg
 
+    @staticmethod
+    def _trace_meta() -> Optional[tuple]:
+        """The optional trailing meta element for a request frame:
+        ``({"trace_id": ...},)`` when the calling thread is inside a
+        ``TraceContext`` (the router's dispatch stamps one), else ``()``
+        so the frame stays at its v1 arity."""
+        tid = tracing.current_trace_id()
+        return ({"trace_id": tid},) if tid is not None else ()
+
     def submit(self, args: Sequence, deadline_ms: Optional[float] = None):
         rid, entry, gen = self._register("oneshot")
         t0 = time.monotonic()
         try:
-            self._send(("submit", rid, tuple(args), deadline_ms), gen)
-            self._await_ack(rid, entry, gen, "submit")
+            with tracing.trace_span("client::submit", cat="wire",
+                                    backend_id=self.backend_id, rid=rid):
+                self._send(("submit", rid, tuple(args), deadline_ms)
+                           + self._trace_meta(), gen)
+                self._await_ack(rid, entry, gen, "submit")
         except BaseException:
             self._unregister(rid)
             raise
@@ -467,9 +497,11 @@ class RemoteBackend(Backend):
         try:
             # deadline deliberately None on the wire: the router owns
             # stream deadlines across failovers (see module docstring)
-            self._send(("decode", rid, prompt, int(max_new_tokens),
-                        eos_id, None), gen)
-            self._await_ack(rid, entry, gen, "decode")
+            with tracing.trace_span("client::decode", cat="wire",
+                                    backend_id=self.backend_id, rid=rid):
+                self._send(("decode", rid, prompt, int(max_new_tokens),
+                            eos_id, None) + self._trace_meta(), gen)
+                self._await_ack(rid, entry, gen, "decode")
         except BaseException:
             self._unregister(rid)
             raise

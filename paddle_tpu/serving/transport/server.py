@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Optional
 
+from ...profiler import tracing
 from ..batcher import (DeadlineExceeded, ServerClosed, ServerOverloaded,
                        ServingError)
 from .metrics import TransportMetrics
@@ -222,14 +223,19 @@ class BackendServer:
                 f"client sent {msg[1] if len(msg) > 1 else None!r}")))
             return None
         try:
+            # "time": this host's wall clock at handshake — the client
+            # measures the offset for cross-process trace alignment
             send_msg(conn.sock,
                      ("hello", {"version": WIRE_VERSION,
                                 "backend_id": self.backend_id,
                                 "bucket_config": self.bucket_config(),
-                                "load": self._load()}),
+                                "load": self._load(),
+                                "time": time.time()}),
                      lock=conn.send_lock, metrics=self._metrics)
         except (WireError, OSError):
             return None
+        tracing.trace_event("wire::handshake", cat="wire",
+                            backend_id=self.backend_id)
         return msg
 
     def _drop_conn(self, conn: _Conn) -> None:
@@ -339,15 +345,28 @@ class BackendServer:
             return False, None
         return True, remaining
 
+    @staticmethod
+    def _frame_trace_id(msg, arity: int) -> Optional[str]:
+        """The trace_id from a request frame's optional trailing meta
+        dict (wire v2): ``msg[arity]`` when present. Tolerates absence
+        and malformed meta (observability must never fail a request)."""
+        if len(msg) > arity and isinstance(msg[arity], dict):
+            tid = msg[arity].get("trace_id")
+            return tid if isinstance(tid, str) else None
+        return None
+
     # -- one-shots ---------------------------------------------------------
     def _handle_submit(self, conn: _Conn, msg) -> None:
-        _, rid, args, deadline_ms = msg
+        _, rid, args, deadline_ms = msg[:4]
+        trace_id = self._frame_trace_id(msg, 4)
         admitted, remaining = self._admit_wire(conn, rid, deadline_ms,
                                                self._server, "one-shot")
         if not admitted:
             return
         try:
-            fut = self._server.submit(*args, deadline_ms=remaining)
+            with tracing.TraceContext(trace_id):
+                tracing.trace_event("wire::submit", cat="wire", rid=rid)
+                fut = self._server.submit(*args, deadline_ms=remaining)
         except Exception as e:  # noqa: BLE001 — typed reject to the peer
             self._end_work()
             self._metrics.inc("rpc_failures")
@@ -401,15 +420,19 @@ class BackendServer:
 
     # -- decode streams ----------------------------------------------------
     def _handle_decode(self, conn: _Conn, msg) -> None:
-        _, rid, prompt, mnt, eos_id, deadline_ms = msg
+        _, rid, prompt, mnt, eos_id, deadline_ms = msg[:6]
+        trace_id = self._frame_trace_id(msg, 6)
         admitted, remaining = self._admit_wire(conn, rid, deadline_ms,
                                                self._decode, "decode")
         if not admitted:
             return
         try:
+            tracing.trace_event("wire::decode", cat="wire", rid=rid,
+                                trace_id=trace_id)
             stream = self._decode.submit(prompt, max_new_tokens=mnt,
                                          eos_id=eos_id,
-                                         deadline_ms=remaining)
+                                         deadline_ms=remaining,
+                                         trace_id=trace_id)
         except Exception as e:  # noqa: BLE001 — typed reject to the peer
             self._end_work()
             self._metrics.inc("rpc_failures")
@@ -427,13 +450,18 @@ class BackendServer:
             self._end_work()
             return
         threading.Thread(target=self._relay_stream,
-                         args=(conn, rid, stream, cancel),
+                         args=(conn, rid, stream, cancel, trace_id),
                          name=f"{self.name}_relay", daemon=True).start()
 
     def _relay_stream(self, conn: _Conn, rid: int, stream,
-                      cancel: threading.Event) -> None:
+                      cancel: threading.Event,
+                      trace_id: Optional[str] = None) -> None:
         """Forward tokens frame-by-frame as the engine emits them —
-        the wire half of streaming decode."""
+        the wire half of streaming decode. ``tok``/``fin`` frames echo
+        the request's trace meta so the client's timeline stitches."""
+        meta = {"trace_id": trace_id} if trace_id is not None else None
+        span = tracing.trace_span("wire::relay", cat="wire",
+                                  trace_id=trace_id, rid=rid)
         i = 0
         try:
             while True:
@@ -460,17 +488,21 @@ class BackendServer:
                     self._safe_reply(conn, ("error", rid, e))
                     return
                 if tok is None:
+                    fin = ("fin", rid, stream.finish_reason)
                     self._safe_reply(
-                        conn, ("fin", rid, stream.finish_reason))
+                        conn, fin + (meta,) if meta else fin)
                     self._metrics.observe("stream_tokens", i)
                     return
-                if not self._safe_reply(conn, ("tok", rid, tok)):
+                frame = ("tok", rid, tok)
+                if not self._safe_reply(
+                        conn, frame + (meta,) if meta else frame):
                     if self._decode is not None:
                         self._decode.cancel(stream)
                     return
                 self._metrics.inc("tokens_streamed")
                 i += 1
         finally:
+            span.end()
             with conn.lock:
                 conn.streams.pop(rid, None)
             self._end_work()
@@ -505,14 +537,16 @@ class BackendServer:
         drained = True
         if drain:
             end = None if timeout is None else time.monotonic() + timeout
-            while True:
-                with self._lock:
-                    if self._active <= 0:
+            with tracing.trace_span("wire::drain", cat="wire",
+                                    host=self.name):
+                while True:
+                    with self._lock:
+                        if self._active <= 0:
+                            break
+                    if end is not None and time.monotonic() > end:
+                        drained = False
                         break
-                if end is not None and time.monotonic() > end:
-                    drained = False
-                    break
-                time.sleep(0.005)
+                    time.sleep(0.005)
         self._stop.set()
         try:
             self._listener.close()

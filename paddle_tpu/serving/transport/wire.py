@@ -15,12 +15,21 @@ Message vocabulary (client → host)::
     ("bucket_config", rid)                           -> ("result", rid, cfg)
     ("ping", rid)                                    -> ("pong", rid, load)
     ("stats", rid)                                   -> ("result", rid, {...})
-    ("submit", rid, args, deadline_ms)               -> ("ack", rid) then
+    ("submit", rid, args, deadline_ms[, meta])       -> ("ack", rid) then
                                                         ("result", rid, out)
-    ("decode", rid, prompt, mnt, eos_id, deadline_ms)-> ("ack", rid) then
-                                                        ("tok", rid, t)...
-                                                        ("fin", rid, reason)
+    ("decode", rid, prompt, mnt, eos_id, deadline_ms[, meta])
+                                                     -> ("ack", rid) then
+                                                        ("tok", rid, t[, meta])...
+                                                        ("fin", rid, reason[, meta])
     ("cancel", rid)                                  best-effort abandon
+
+Since wire version 2 the request frames (``submit``/``decode``) and the
+stream frames (``tok``/``fin``) carry an OPTIONAL trailing ``meta``
+dict — today a single key, ``{"trace_id": str}`` — stamped by the
+router at admission and echoed back by the host, so one request's
+flight-recorder spans stitch across every process they touched
+(``tools/trace_merge.py``). Receivers must tolerate its absence (a v2
+peer may omit it when tracing never stamped an id).
 
 Host → client error frames: ``("reject", rid, exc)`` for enqueue-time
 failures (overload, closed, bucket overflow — raised synchronously at
@@ -28,7 +37,9 @@ the client's submit site) and ``("error", rid, exc)`` for later
 failures (surfaced through the Future / DecodeStream). The deadline in
 request metadata is RELATIVE milliseconds remaining at send time; the
 host re-anchors it on its own clock, so no cross-host clock sync is
-assumed.
+assumed — the hello reply's ``"time"`` field (the host's ``time.time()``
+at handshake) exists only so trace timelines can be offset-aligned,
+never to anchor deadlines.
 """
 from __future__ import annotations
 
@@ -44,7 +55,9 @@ __all__ = ["WIRE_VERSION", "MAX_FRAME_BYTES", "SEND_TIMEOUT_S",
            "WireError", "ConnectionClosedError", "FrameError", "send_msg",
            "FrameReader"]
 
-WIRE_VERSION = 1
+# v2: optional trailing trace-metadata element on submit/decode/tok/fin
+# frames + "time" in the hello reply (see the vocabulary above)
+WIRE_VERSION = 2
 
 # a frame bigger than this is protocol garbage (a misframed stream would
 # otherwise ask for gigabytes and look like a hang) — fail fast instead

@@ -123,3 +123,160 @@ class TestTensorChecker:
             paddle.amp.debugging.disable_tensor_checker()
         # disabled again: no raise
         _ = paddle.to_tensor(np.array([1.0], np.float32)) / 0.0
+
+
+class TestSanitize:
+    def test_legal_names_pass_through_unchanged(self):
+        from paddle_tpu.profiler import _sanitize
+        assert _sanitize("paddle_tpu_decode_ttft_ms_p99") == \
+            "paddle_tpu_decode_ttft_ms_p99"
+        assert _sanitize("A_z0_9") == "A_z0_9"
+
+    def test_hostile_names_stay_distinct(self):
+        """Collision safety: distinct hostile names must NOT collapse
+        onto one series after sanitization ("a.b" and "a-b" both rewrote
+        to "a_b" before the hash suffix existed)."""
+        from paddle_tpu.profiler import _sanitize
+        import re
+        hostile = ["a.b", "a-b", "a b", "a/b", "héllo", "hèllo",
+                   "0lead", "_lead", "x:y", "x;y"]
+        cleaned = [_sanitize(n) for n in hostile]
+        assert len(set(cleaned)) == len(hostile), cleaned
+        pat = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+        for c in cleaned:
+            assert pat.match(c), c
+        # stability: the suffix is a pure function of the input
+        assert _sanitize("a.b") == _sanitize("a.b")
+
+    def test_export_stats_text_lines_are_prometheus_legal(self):
+        import re
+        text = profiler.export_stats(format="text")
+        pat = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+        for line in text.strip().splitlines():
+            name, _, value = line.rpartition(" ")
+            assert pat.match(name), line
+            float(value)
+
+
+class TestFlightRecorder:
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        from paddle_tpu.profiler import tracing
+        tracing.reset_tracing()
+        tracing.disable_tracing()
+        yield
+        tracing.reset_tracing()
+        tracing.disable_tracing()
+
+    def test_disabled_mode_is_a_shared_noop(self):
+        from paddle_tpu.profiler import tracing
+        s1 = tracing.trace_span("x")
+        s2 = tracing.trace_span("y", cat="z", k=1)
+        assert s1 is s2                     # shared singleton, no alloc
+        with s1:
+            tracing.trace_event("e", k=2)
+        assert tracing.snapshot_events() == []
+
+    def test_span_and_event_record_with_context_trace_id(self):
+        from paddle_tpu.profiler import tracing
+        tracing.enable_tracing()
+        with tracing.TraceContext("tid1"):
+            with tracing.trace_span("outer", cat="t", k=1):
+                tracing.trace_event("inner", cat="t")
+            with tracing.TraceContext("tid2"):
+                tracing.trace_event("nested")
+            tracing.trace_event("restored")
+        evs = {e["name"]: e for e in tracing.snapshot_events()}
+        assert evs["outer"]["args"]["trace_id"] == "tid1"
+        assert evs["outer"]["ph"] == "X" and evs["outer"]["dur"] >= 0
+        assert evs["outer"]["args"]["k"] == 1
+        assert evs["inner"]["args"]["trace_id"] == "tid1"
+        assert evs["inner"]["ph"] == "i"
+        assert evs["nested"]["args"]["trace_id"] == "tid2"
+        assert evs["restored"]["args"]["trace_id"] == "tid1"  # unwound
+        assert tracing.current_trace_id() is None
+
+    def test_explicit_trace_id_wins_over_context(self):
+        from paddle_tpu.profiler import tracing
+        tracing.enable_tracing()
+        with tracing.TraceContext("ctx"):
+            with tracing.trace_span("s", trace_id="explicit"):
+                pass
+        (ev,) = tracing.snapshot_events()
+        assert ev["args"]["trace_id"] == "explicit"
+
+    def test_ring_is_bounded_and_keeps_newest(self):
+        from paddle_tpu.profiler import tracing
+        tracing.enable_tracing(ring_size=8)
+        for i in range(50):
+            tracing.trace_event(f"e{i}")
+        evs = tracing.snapshot_events()
+        assert len(evs) == 8
+        assert [e["name"] for e in evs] == [f"e{i}" for i in range(42, 50)]
+
+    def test_span_end_is_idempotent(self):
+        from paddle_tpu.profiler import tracing
+        tracing.enable_tracing()
+        span = tracing.trace_span("once")
+        span.end()
+        span.end()
+        with span:      # a later with-block must not re-record either
+            pass
+        assert len(tracing.snapshot_events()) == 1
+
+    def test_compile_watcher_counts_and_emits(self):
+        from paddle_tpu.profiler import tracing
+        tracing.enable_tracing()
+        assert tracing.compile_count() == 0
+        tracing.record_compile("fwd")
+        tracing.record_compile("bwd")
+        assert tracing.compile_count() == 2
+        names = [e["name"] for e in tracing.snapshot_events()]
+        assert names.count("jit::compile") == 2
+
+    def test_export_schema_and_metadata(self, tmp_path):
+        from paddle_tpu.profiler import tracing
+        tracing.enable_tracing()
+        tracing.set_trace_metadata(backend_id="hA", role="host")
+        tracing.set_clock_offset("peer0", 0.25)
+        with tracing.trace_span("s", cat="t"):
+            pass
+        path = str(tmp_path / "sub" / "t.json")
+        assert tracing.export_trace(path) == path
+        doc = json.load(open(path))
+        assert doc["displayTimeUnit"] == "ms"
+        pt = doc["paddleTrace"]
+        assert pt["pid"] == os.getpid()
+        assert pt["metadata"] == {"backend_id": "hA", "role": "host"}
+        assert pt["clock_offsets"] == {"peer0": 0.25}
+        phs = {e["ph"] for e in doc["traceEvents"]}
+        assert "M" in phs and "X" in phs    # thread names + the span
+        span = [e for e in doc["traceEvents"] if e["ph"] == "X"][0]
+        assert span["ts"] > 1e15            # wall-clock µs, not perf_counter
+        assert span["dur"] >= 0
+
+    def test_background_writer_survives_and_flushes(self, tmp_path):
+        import time as _time
+        from paddle_tpu.profiler import tracing
+        tracing.enable_tracing()
+        path = str(tmp_path / "flight.json")
+        tracing.start_trace_writer(path, interval_s=0.02)
+        tracing.trace_event("before_kill")
+        end = _time.monotonic() + 5
+        seen = False
+        while _time.monotonic() < end and not seen:
+            if os.path.exists(path):
+                names = [e["name"]
+                         for e in json.load(open(path))["traceEvents"]]
+                seen = "before_kill" in names
+            _time.sleep(0.02)
+        assert seen     # flushed WITHOUT stop: the SIGKILL property
+        tracing.trace_event("at_stop")
+        tracing.stop_trace_writer()
+        names = [e["name"] for e in json.load(open(path))["traceEvents"]]
+        assert "at_stop" in names           # final flush on stop
+
+    def test_enable_rejects_bad_ring_size(self):
+        from paddle_tpu.profiler import tracing
+        with pytest.raises(ValueError):
+            tracing.enable_tracing(ring_size=0)

@@ -37,6 +37,11 @@ HOT_MODULE_RES = (
     # the write-behind worker concurrently with it, the Fs boundary on
     # every durable checkpoint byte
     re.compile(r"(^|[\\/])paddle_tpu[\\/]distributed[\\/]resilience[\\/]"),
+    # the flight recorder is compiled into every serving/training hot
+    # path: its record path (trace_span/trace_event -> ring push) runs
+    # per request/step/token whenever tracing is on, and its background
+    # flusher concurrently with everything
+    re.compile(r"(^|[\\/])paddle_tpu[\\/]profiler[\\/]tracing\.py$"),
 )
 
 HOT_ROOT_NAMES = {"run_steps", "_run_loop", "_execute", "_produce",
@@ -62,7 +67,14 @@ HOT_ROOT_NAMES = {"run_steps", "_run_loop", "_execute", "_produce",
                   # resilience: the per-step save gate, the write-behind
                   # worker loop, and the per-write fault/Fs boundary
                   "maybe_save", "save", "_write_loop", "poll",
-                  "on_write"}
+                  "on_write",
+                  # flight recorder (profiler/tracing.py): the record
+                  # path runs inside every other hot loop, so its own
+                  # writer functions are roots — span/event entry
+                  # points, the per-thread ring accessor, the ring
+                  # store, and the span close (the background flusher's
+                  # _write_loop is already a root above)
+                  "trace_span", "trace_event", "_ring", "push", "end"}
 
 # callables whose result is a jitted function / whose first unpacked
 # element is one — shared by device-placement and recompile-hazard so a

@@ -41,9 +41,15 @@ TIER2_PATTERNS = ("tests/test_zz_*.py", "tests/test_serving_router*.py",
 
 
 def tier2_files() -> list:
+    # deduped while keeping pattern order: a file matching two patterns
+    # (a test_zz_* drill also named by an explicit entry) must run once
     out = []
+    seen = set()
     for pat in TIER2_PATTERNS:
-        out.extend(sorted(glob.glob(os.path.join(REPO, pat))))
+        for f in sorted(glob.glob(os.path.join(REPO, pat))):
+            if f not in seen:
+                seen.add(f)
+                out.append(f)
     return out
 
 

@@ -1,0 +1,140 @@
+"""Merge per-process flight-recorder traces into ONE chrome://tracing
+timeline — ``python -m tools.trace_merge out.json in1.json in2.json``.
+
+Each input is what ``paddle_tpu.profiler.tracing.export_trace`` (or the
+background writer a SIGKILLed host left behind) wrote: chrome trace
+events plus a ``paddleTrace`` section carrying the process's pid, its
+``metadata`` (``backend_id``, ``role``) and the wall-clock offsets it
+measured to its wire peers at the hello handshake. The merge:
+
+- **Clock alignment.** One process is the reference clock (the first
+  input whose metadata has no ``role: host`` — typically the router's
+  process — else the first input). Every other process is shifted by
+  the reference's measured offset to it, keyed by ``backend_id``: the
+  reference recorded ``offset[b] = clock_b - clock_ref`` at handshake,
+  so a host's events come BACK by that much to land on the reference
+  timeline. A process the reference never measured merges unshifted
+  (wall clocks are usually close; the offset is a refinement, not a
+  requirement).
+- **Pid/tid mapping.** Chrome requires distinct pids per process; the
+  inputs already carry their real pids, which are preserved, and each
+  process gets a ``process_name`` metadata event naming its
+  ``backend_id``/``role`` so the timeline reads "router / host0 /
+  host1" instead of bare numbers.
+- **Trace filtering.** ``--trace-id`` keeps only events stamped with
+  that id (plus metadata events), which is how the failover drill pulls
+  ONE request's cross-process story out of three flight recorders.
+
+The output is a plain chrome trace (load it at chrome://tracing or
+ui.perfetto.dev) with a ``paddleTrace.merged`` section recording the
+per-input shifts applied, so the alignment itself is auditable.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from typing import List, Optional
+
+__all__ = ["merge_traces", "main"]
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError(f"{path}: not a chrome trace export")
+    return doc
+
+
+def _backend_id(doc: dict) -> Optional[str]:
+    meta = doc.get("paddleTrace", {}).get("metadata", {})
+    bid = meta.get("backend_id")
+    return str(bid) if bid is not None else None
+
+
+def _pick_reference(docs: List[dict]) -> int:
+    """The reference clock: the first non-host process (the router side
+    measured the offsets, so its clock is the one they map back to)."""
+    for i, doc in enumerate(docs):
+        meta = doc.get("paddleTrace", {}).get("metadata", {})
+        if meta.get("role") != "host":
+            return i
+    return 0
+
+
+def merge_traces(paths: List[str],
+                 trace_id: Optional[str] = None) -> dict:
+    """Merge per-process trace exports into one chrome trace dict.
+
+    ``trace_id`` filters the merged events down to one request's spans
+    (metadata "M" events are always kept — they carry thread/process
+    names)."""
+    if not paths:
+        raise ValueError("merge_traces needs at least one input trace")
+    docs = [_load(p) for p in paths]
+    ref = _pick_reference(docs)
+    offsets = docs[ref].get("paddleTrace", {}).get("clock_offsets", {})
+
+    events: list = []
+    applied = []
+    for i, doc in enumerate(docs):
+        pt = doc.get("paddleTrace", {})
+        pid = pt.get("pid")
+        bid = _backend_id(doc)
+        meta = pt.get("metadata", {})
+        # shift this process's wall clock onto the reference's:
+        # offset[bid] = clock_bid - clock_ref, so subtract it
+        shift_us = 0.0
+        if i != ref and bid is not None and bid in offsets:
+            shift_us = -float(offsets[bid]) * 1e6
+        applied.append({"path": paths[i], "pid": pid,
+                        "backend_id": bid, "shift_us": shift_us,
+                        "reference": i == ref})
+        label = bid or meta.get("role") or f"process {pid}"
+        if pid is not None:
+            events.append({"name": "process_name", "ph": "M",
+                           "pid": pid, "tid": 0,
+                           "args": {"name": label}})
+        for ev in doc.get("traceEvents", []):
+            ph = ev.get("ph")
+            if ph == "M":
+                events.append(ev)
+                continue
+            if trace_id is not None and \
+                    ev.get("args", {}).get("trace_id") != trace_id:
+                continue
+            if shift_us and isinstance(ev.get("ts"), (int, float)):
+                ev = dict(ev)
+                ev["ts"] = ev["ts"] + shift_us
+            events.append(ev)
+
+    events.sort(key=lambda e: (e.get("ph") != "M",
+                               e.get("ts", 0)))
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "paddleTrace": {"merged": applied,
+                            "trace_id_filter": trace_id}}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.trace_merge",
+        description="Stitch per-process flight-recorder traces into one "
+                    "chrome://tracing timeline.")
+    p.add_argument("out", help="merged chrome trace JSON to write")
+    p.add_argument("inputs", nargs="+",
+                   help="per-process trace exports (router + hosts)")
+    p.add_argument("--trace-id", default=None,
+                   help="keep only this request's spans")
+    args = p.parse_args(argv)
+    merged = merge_traces(args.inputs, trace_id=args.trace_id)
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    n = sum(1 for e in merged["traceEvents"] if e.get("ph") != "M")
+    print(f"merged {len(args.inputs)} trace(s) -> {args.out} "
+          f"({n} events)")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())
